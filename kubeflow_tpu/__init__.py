@@ -1,0 +1,29 @@
+"""kubeflow_tpu — a TPU-native ML control plane and compute framework.
+
+A ground-up rebuild of the capabilities of kubeflow/kubeflow (reference at
+/root/reference) designed for Cloud TPU rather than GPU node pools:
+
+- ``topology``:   TPU slice types (v4/v5e/v5p/v6e) and ICI-topology-aware
+  mesh planning — the first-class concept that replaces the reference's
+  ``nvidia.com/gpu`` resource strings
+  (reference: components/jupyter-web-app/backend/kubeflow_jupyter/common/utils.py:390-443).
+- ``parallel``:   mesh axes (dp/fsdp/tp/sp/ep), sharding rules, ring-attention
+  and Ulysses sequence parallelism, expert-parallel all-to-all.
+- ``ops``:        TPU kernels (pallas) and reference implementations.
+- ``models``:     flagship model zoo (Llama, Mixtral, ResNet-50, ViT) —
+  replaces the reference's tf_cnn_benchmarks payload images
+  (reference: tf-controller-examples/tf-cnn/).
+- ``train``:      sharded training loop, orbax checkpoint service, auto-resume.
+- ``serving``:    continuous-batching TPU inference engine.
+- ``controlplane``: CRD types + controllers (TpuJob, Notebook, Profile,
+  PodDefault, Tensorboard), in-memory API server for envtest-style testing,
+  kfam-equivalent access management
+  (reference: components/{notebook,profile,tensorboard}-controller/,
+  components/admission-webhook/, components/access-management/).
+- ``tools``:      ``tpuctl`` deployment CLI (kfctl equivalent, reference:
+  bootstrap/).
+"""
+
+from kubeflow_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,42 @@
+"""TPU slice topology: the scheduling substrate of the platform.
+
+In the reference, accelerators are an opaque resource count
+(``nvidia.com/gpu`` limits injected by the spawner UI,
+reference: components/jupyter-web-app/backend/kubeflow_jupyter/common/utils.py:390-443)
+and multi-worker wiring is a flat hostname list (``TF_CONFIG``,
+reference: tf-controller-examples/tf-cnn/launcher.py:68-80). On TPU the
+interconnect topology *is* the resource: a slice is a named ICI mesh/torus
+(e.g. ``v5e-16`` = a 4x4 mesh of chips across 4 hosts) and performance
+depends on mapping parallelism axes onto ICI rings. This package owns that
+mapping.
+"""
+
+from kubeflow_tpu.topology.slices import (
+    SliceType,
+    SliceTopology,
+    TpuGeneration,
+    get_slice,
+    list_slices,
+    register_slice,
+)
+from kubeflow_tpu.topology.mesh import (
+    AxisSpec,
+    MeshPlan,
+    plan_mesh,
+    make_mesh,
+    make_host_local_mesh,
+)
+
+__all__ = [
+    "SliceType",
+    "SliceTopology",
+    "TpuGeneration",
+    "get_slice",
+    "list_slices",
+    "register_slice",
+    "AxisSpec",
+    "MeshPlan",
+    "plan_mesh",
+    "make_mesh",
+    "make_host_local_mesh",
+]

@@ -1,0 +1,240 @@
+"""TPU slice catalogue: generations, ICI topologies, host layouts.
+
+Replaces the reference's accelerator model (a bare integer of
+``nvidia.com/gpu`` on an interchangeable node,
+reference: components/jupyter-web-app/backend/kubeflow_jupyter/common/utils.py:390-443)
+with a typed slice spec. A slice name like ``v5e-16`` fully determines:
+chip count, ICI topology shape (mesh or torus per dimension), number of
+TPU-VM hosts, and chips per host — everything the gang scheduler and the
+mesh planner need.
+
+Numbers follow the public Cloud TPU documentation: v4/v5p are 3D tori
+(4 chips/host), v5e/v6e are 2D meshes (up to 8 chips/host single-host,
+4 chips/host multi-host), with wraparound links on dimensions of size >= 16
+for v5e-256-class slices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import math
+from typing import Dict, List, Tuple
+
+
+class TpuGeneration(str, enum.Enum):
+    V4 = "v4"
+    V5E = "v5e"
+    V5P = "v5p"
+    V6E = "v6e"
+
+    @property
+    def hbm_gib_per_chip(self) -> float:
+        return {"v4": 32.0, "v5e": 16.0, "v5p": 95.0, "v6e": 32.0}[self.value]
+
+    @property
+    def bf16_tflops_per_chip(self) -> float:
+        return {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}[self.value]
+
+    @property
+    def is_3d(self) -> bool:
+        return self in (TpuGeneration.V4, TpuGeneration.V5P)
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceTopology:
+    """ICI topology of a slice: per-dimension extent and wraparound."""
+
+    dims: Tuple[int, ...]            # e.g. (4, 4) for v5e-16, (4, 4, 4) for v4-128
+    wrap: Tuple[bool, ...]           # torus link per dimension
+
+    def __post_init__(self) -> None:
+        if len(self.dims) != len(self.wrap):
+            raise ValueError(f"dims {self.dims} and wrap {self.wrap} length mismatch")
+
+    @property
+    def num_chips(self) -> int:
+        return math.prod(self.dims)
+
+    @property
+    def rank(self) -> int:
+        return len(self.dims)
+
+    def ring_dims(self) -> List[int]:
+        """Indices of dimensions that form a true ICI ring (wraparound, or
+        extent <= 2 where a mesh is trivially a ring)."""
+        return [i for i, (d, w) in enumerate(zip(self.dims, self.wrap)) if w or d <= 2]
+
+    def largest_ring(self) -> int:
+        """Extent of the largest dimension usable as a true bidirectional
+        ring (wraparound, or extent <= 2). Open mesh lines are excluded —
+        callers sizing ring-dependent axes (sp/ep) must not land on them;
+        use max(dims) directly for span-tolerant axes."""
+        return max((self.dims[i] for i in self.ring_dims()), default=1)
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceType:
+    """A named, schedulable TPU slice (the unit TpuJob gangs are placed on)."""
+
+    name: str                        # e.g. "v5e-16"
+    generation: TpuGeneration
+    topology: SliceTopology
+    chips_per_host: int              # chips on one TPU-VM host
+    # GKE node-selector values, the TPU analogue of the reference's
+    # nvidia.com/gpu limit + accelerator node selectors.
+    gke_accelerator: str = ""
+    gke_topology: str = ""           # e.g. "4x4"
+
+    @property
+    def num_chips(self) -> int:
+        return self.topology.num_chips
+
+    @property
+    def num_hosts(self) -> int:
+        return max(1, self.num_chips // self.chips_per_host)
+
+    @property
+    def hbm_gib_total(self) -> float:
+        return self.num_chips * self.generation.hbm_gib_per_chip
+
+    @property
+    def bf16_tflops_total(self) -> float:
+        return self.num_chips * self.generation.bf16_tflops_per_chip
+
+    def node_selectors(self) -> Dict[str, str]:
+        """K8s node selectors for ICI-topology-aware placement — replaces the
+        reference's GPU vendor selectors (SURVEY.md §2.5 gang-scheduling row)."""
+        return {
+            "cloud.google.com/gke-tpu-accelerator": self.gke_accelerator,
+            "cloud.google.com/gke-tpu-topology": self.gke_topology,
+        }
+
+    def resource_name(self) -> str:
+        """K8s extended-resource name requested per pod (chips per host)."""
+        return "google.com/tpu"
+
+
+_REGISTRY: Dict[str, SliceType] = {}
+
+
+def register_slice(s: SliceType) -> SliceType:
+    if s.name in _REGISTRY:
+        raise ValueError(f"slice {s.name!r} already registered")
+    _REGISTRY[s.name] = s
+    return s
+
+
+def get_slice(name: str) -> SliceType:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown slice type {name!r}; known: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def list_slices() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def _mk2d(x: int, y: int, wrap: bool = False) -> SliceTopology:
+    return SliceTopology(dims=(x, y), wrap=(wrap, wrap))
+
+
+def _mk3d(x: int, y: int, z: int, wrap: Tuple[bool, bool, bool]) -> SliceTopology:
+    return SliceTopology(dims=(x, y, z), wrap=wrap)
+
+
+def _register_defaults() -> None:
+    v5e = "tpu-v5-lite-podslice"
+    # v5e: 2D mesh; single-host slices up to 8 chips, multi-host 4 chips/host.
+    for name, (x, y), cph in [
+        ("v5e-1", (1, 1), 1),
+        ("v5e-4", (2, 2), 4),
+        ("v5e-8", (2, 4), 8),
+        ("v5e-16", (4, 4), 4),
+        ("v5e-32", (4, 8), 4),
+        ("v5e-64", (8, 8), 4),
+        ("v5e-128", (8, 16), 4),
+        ("v5e-256", (16, 16), 4),
+    ]:
+        wrap = x >= 16 and y >= 16
+        register_slice(
+            SliceType(
+                name=name,
+                generation=TpuGeneration.V5E,
+                topology=_mk2d(x, y, wrap),
+                chips_per_host=cph,
+                gke_accelerator=v5e,
+                gke_topology=f"{x}x{y}",
+            )
+        )
+
+    v6e = "tpu-v6e-slice"
+    for name, (x, y), cph in [
+        ("v6e-1", (1, 1), 1),
+        ("v6e-4", (2, 2), 4),
+        ("v6e-8", (2, 4), 8),
+        ("v6e-16", (4, 4), 4),
+        ("v6e-64", (8, 8), 4),
+        ("v6e-256", (16, 16), 4),
+    ]:
+        wrap = x >= 16 and y >= 16
+        register_slice(
+            SliceType(
+                name=name,
+                generation=TpuGeneration.V6E,
+                topology=_mk2d(x, y, wrap),
+                chips_per_host=cph,
+                gke_accelerator=v6e,
+                gke_topology=f"{x}x{y}",
+            )
+        )
+
+    # v4 / v5p: 3D; wraparound when a dimension reaches the full cube extent
+    # (public rule of thumb: dims that are a multiple of 4 on full-cube slices
+    # get torus links; we wrap dims >= 4 when the slice is a full cube).
+    for gen, accel, cases in [
+        (
+            TpuGeneration.V4,
+            "tpu-v4-podslice",
+            [
+                ("v4-8", (2, 2, 1)),
+                ("v4-16", (2, 2, 2)),
+                ("v4-32", (2, 2, 4)),
+                ("v4-64", (2, 4, 4)),
+                ("v4-128", (4, 4, 4)),
+                ("v4-256", (4, 4, 8)),
+                ("v4-512", (4, 8, 8)),
+            ],
+        ),
+        (
+            TpuGeneration.V5P,
+            "tpu-v5p-slice",
+            [
+                ("v5p-8", (2, 2, 1)),
+                ("v5p-16", (2, 2, 2)),
+                ("v5p-32", (2, 2, 4)),
+                ("v5p-64", (2, 4, 4)),
+                ("v5p-128", (4, 4, 4)),
+                ("v5p-256", (4, 4, 8)),
+            ],
+        ),
+    ]:
+        for name, (x, y, z) in cases:
+            cube = x == y == z
+            wrap = tuple(cube and d >= 4 for d in (x, y, z))
+            register_slice(
+                SliceType(
+                    name=name,
+                    generation=gen,
+                    topology=_mk3d(x, y, z, wrap),  # type: ignore[arg-type]
+                    chips_per_host=4,
+                    gke_accelerator=accel,
+                    gke_topology=f"{x}x{y}x{z}",
+                )
+            )
+
+
+_register_defaults()

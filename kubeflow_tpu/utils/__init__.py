@@ -1,0 +1,17 @@
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.monitoring import (
+    Counter,
+    Gauge,
+    Heartbeat,
+    MetricsRegistry,
+    global_registry,
+)
+
+__all__ = [
+    "get_logger",
+    "Counter",
+    "Gauge",
+    "Heartbeat",
+    "MetricsRegistry",
+    "global_registry",
+]

@@ -1,0 +1,56 @@
+"""Structured logging for controllers and training.
+
+The reference uses logr/zap in Go controllers (components/notebook-controller/
+main.go) and a `create_logger` helper in Python (components/jupyter-web-app/
+backend/kubeflow_jupyter/common/utils.py:34). We provide one structured
+logger factory with key=value context, shared by the control plane and the
+training runtime.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Any
+
+
+class _KVAdapter(logging.LoggerAdapter):
+    def process(self, msg: str, kwargs: Any):
+        extra = kwargs.pop("kv", None) or {}
+        bound = self.extra or {}
+        merged = {**bound, **extra}
+        if merged:
+            kv = " ".join(f"{k}={v}" for k, v in merged.items())
+            msg = f"{msg} {kv}"
+        return msg, kwargs
+
+    def bind(self, **kv: Any) -> "_KVAdapter":
+        return _KVAdapter(self.logger, {**(self.extra or {}), **kv})
+
+
+_configured = False
+
+
+def _configure_root() -> None:
+    global _configured
+    if _configured:
+        return
+    level = os.environ.get("KFTPU_LOG_LEVEL", "INFO").strip().upper()
+    if level not in ("CRITICAL", "ERROR", "WARNING", "INFO", "DEBUG"):
+        level = "INFO"
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s %(levelname).1s %(name)s %(message)s")
+    )
+    root = logging.getLogger("kubeflow_tpu")
+    root.setLevel(level)
+    if not root.handlers:
+        root.addHandler(handler)
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(name: str, **kv: Any) -> _KVAdapter:
+    _configure_root()
+    return _KVAdapter(logging.getLogger(f"kubeflow_tpu.{name}"), kv)

@@ -1,0 +1,180 @@
+"""Prometheus-style metrics primitives for controllers and services.
+
+Mirrors the reference's per-controller monitoring pattern — counters with
+severity labels plus a heartbeat (reference: components/profile-controller/
+controllers/monitoring.go:24-78, components/notebook-controller/pkg/metrics/
+metrics.go:13-21, components/access-management/kfam/monitoring.go) — without
+requiring a prometheus client at runtime: the registry renders the standard
+text exposition format itself, so any scraper can consume it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+LabelKV = Tuple[Tuple[str, str], ...]
+
+
+def _escape_label_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(labels: LabelKV) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label_value(str(v))}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, label_names: Tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._values: Dict[LabelKV, float] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> LabelKV:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"counter {self.name} expects labels {self.label_names}, "
+                f"got {tuple(sorted(labels))}"
+            )
+        return tuple(sorted(labels.items()))
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        key = self._key(labels)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.label_names:
+            out.append(f"{self.name} 0")
+        for labels, v in items:
+            out.append(f"{self.name}{_fmt_labels(labels)} {v:g}")
+        return out
+
+
+class Gauge:
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        fn: Optional[Callable[[], float]] = None,
+    ):
+        self.name = name
+        self.help = help_
+        self._fn = fn
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        if self._fn is not None:
+            raise ValueError(f"gauge {self.name} is callback-backed; set() invalid")
+        with self._lock:
+            self._value = v
+
+    def value(self) -> float:
+        if self._fn is not None:
+            return float(self._fn())
+        with self._lock:
+            return self._value
+
+    def render(self) -> List[str]:
+        return [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} gauge",
+            f"{self.name} {self.value():g}",
+        ]
+
+
+class Heartbeat:
+    """A gauge recording the unix time of the last explicit beat() — so a
+    wedged reconcile loop shows up as a stale heartbeat even while the
+    metrics endpoint keeps serving (the point of the reference's heartbeat
+    goroutine, profile-controller/controllers/monitoring.go:62-78)."""
+
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._last = 0.0
+        self._lock = threading.Lock()
+
+    def beat(self) -> None:
+        with self._lock:
+            self._last = time.time()
+
+    def last(self) -> float:
+        with self._lock:
+            return self._last
+
+    def render(self) -> List[str]:
+        return [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} gauge",
+            f"{self.name} {self.last():g}",
+        ]
+
+
+class MetricsRegistry:
+    """Holds metrics and renders the text exposition format. Metric names are
+    unique per registry; registering an existing name returns the existing
+    instance (so two controllers sharing the global registry don't produce a
+    duplicate-TYPE scrape that Prometheus rejects)."""
+
+    def __init__(self) -> None:
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, name: str, factory: Callable[[], object]):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                return existing
+            m = factory()
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help_: str, labels: Tuple[str, ...] = ()) -> Counter:
+        m = self._register(name, lambda: Counter(name, help_, labels))
+        if not isinstance(m, Counter):
+            raise ValueError(f"metric {name} already registered as {type(m).__name__}")
+        return m
+
+    def gauge(
+        self, name: str, help_: str, fn: Optional[Callable[[], float]] = None
+    ) -> Gauge:
+        m = self._register(name, lambda: Gauge(name, help_, fn))
+        if not isinstance(m, Gauge):
+            raise ValueError(f"metric {name} already registered as {type(m).__name__}")
+        return m
+
+    def heartbeat(self, component: str) -> Heartbeat:
+        name = f"kftpu_{component}_heartbeat"
+        m = self._register(
+            name, lambda: Heartbeat(name, f"Unix time of last {component} heartbeat")
+        )
+        if not isinstance(m, Heartbeat):
+            raise ValueError(f"metric {name} already registered as {type(m).__name__}")
+        return m
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.render())  # type: ignore[attr-defined]
+        return "\n".join(lines) + "\n"
+
+
+global_registry = MetricsRegistry()

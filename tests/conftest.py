@@ -1,0 +1,36 @@
+"""Test harness configuration.
+
+All tests run on a virtual 8-device CPU backend
+(``xla_force_host_platform_device_count``) so multi-chip sharding is
+exercised without TPU hardware — the analogue of the reference's envtest
+(in-memory etcd+apiserver, reference: components/profile-controller/
+controllers/suite_test.go:50-72): a fake backend with real semantics.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere. Force CPU even if the shell
+# has a TPU platform configured — tests never touch real hardware.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# Some environments register a TPU PJRT plugin via sitecustomize and make it
+# the default regardless of JAX_PLATFORMS; the config update wins either way.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,57 @@
+import pytest
+
+from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+
+class TestMetricsRegistry:
+    def test_counter_labels(self):
+        reg = MetricsRegistry()
+        c = reg.counter("kftpu_test_total", "test", labels=("severity",))
+        c.inc(severity="error")
+        c.inc(2, severity="error")
+        assert c.value(severity="error") == 3
+        assert c.value(severity="warn") == 0
+
+    def test_counter_label_typo_raises(self):
+        reg = MetricsRegistry()
+        c = reg.counter("kftpu_test_total", "test", labels=("severity",))
+        with pytest.raises(ValueError):
+            c.inc(serverity="error")
+
+    def test_label_value_escaping(self):
+        reg = MetricsRegistry()
+        c = reg.counter("kftpu_err_total", "t", labels=("reason",))
+        c.inc(reason='got "EOF"\nunexpected\\')
+        out = reg.render()
+        assert 'reason="got \\"EOF\\"\\nunexpected\\\\"' in out
+        assert "\n# TYPE" in out  # no raw newline inside a sample line
+
+    def test_duplicate_name_dedup(self):
+        reg = MetricsRegistry()
+        a = reg.counter("kftpu_x_total", "t")
+        b = reg.counter("kftpu_x_total", "t")
+        assert a is b
+        assert reg.render().count("# TYPE kftpu_x_total") == 1
+
+    def test_duplicate_name_kind_conflict(self):
+        reg = MetricsRegistry()
+        reg.counter("kftpu_x", "t")
+        with pytest.raises(ValueError):
+            reg.gauge("kftpu_x", "t")
+
+    def test_heartbeat_staleness_detectable(self):
+        reg = MetricsRegistry()
+        hb = reg.heartbeat("testctl")
+        assert hb.last() == 0.0  # never beat → stale is visible
+        hb.beat()
+        t1 = hb.last()
+        assert t1 > 0
+        # A scrape without an intervening beat returns the same stamp.
+        assert hb.last() == t1
+
+    def test_callback_gauge_set_rejected(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("kftpu_now", "t", fn=lambda: 42.0)
+        assert g.value() == 42.0
+        with pytest.raises(ValueError):
+            g.set(1.0)

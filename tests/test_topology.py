@@ -1,0 +1,105 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.topology import (
+    AxisSpec,
+    get_slice,
+    list_slices,
+    make_mesh,
+    plan_mesh,
+)
+from kubeflow_tpu.topology.slices import TpuGeneration
+
+
+class TestSliceCatalogue:
+    def test_v5e_16_shape(self):
+        s = get_slice("v5e-16")
+        assert s.num_chips == 16
+        assert s.topology.dims == (4, 4)
+        assert s.num_hosts == 4
+        assert s.chips_per_host == 4
+        assert s.gke_topology == "4x4"
+
+    def test_v5e_single_host(self):
+        s = get_slice("v5e-8")
+        assert s.num_hosts == 1
+        assert s.chips_per_host == 8
+
+    def test_v4_is_3d_torus_naming(self):
+        s = get_slice("v4-128")
+        assert s.topology.dims == (4, 4, 4)
+        assert all(s.topology.wrap)  # full cube → torus
+        assert s.generation.is_3d
+
+    def test_node_selectors(self):
+        sel = get_slice("v5e-64").node_selectors()
+        assert sel["cloud.google.com/gke-tpu-topology"] == "8x8"
+        assert "tpu" in sel["cloud.google.com/gke-tpu-accelerator"]
+
+    def test_unknown_slice_raises(self):
+        with pytest.raises(KeyError):
+            get_slice("v99-3")
+
+    def test_catalogue_nonempty(self):
+        assert "v5e-16" in list_slices()
+        assert "v5p-128" in list_slices()
+
+    def test_hbm_and_flops(self):
+        s = get_slice("v5e-16")
+        assert s.hbm_gib_total == 16 * 16.0
+        assert s.bf16_tflops_total == pytest.approx(16 * 197.0)
+        assert TpuGeneration.V5P.hbm_gib_per_chip > TpuGeneration.V5E.hbm_gib_per_chip
+
+
+class TestAxisSpec:
+    def test_resolve_wildcard(self):
+        a = AxisSpec(dp=-1, tp=4).resolve(16)
+        assert a.dp == 4 and a.tp == 4
+
+    def test_resolve_exact(self):
+        a = AxisSpec(dp=2, fsdp=4, tp=2).resolve(16)
+        assert a.as_dict() == {"dp": 2, "ep": 1, "fsdp": 4, "sp": 1, "tp": 2}
+
+    def test_resolve_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            AxisSpec(dp=3).resolve(16)
+
+    def test_two_wildcards_raise(self):
+        with pytest.raises(ValueError):
+            AxisSpec(dp=-1, tp=-1).resolve(16)
+
+
+class TestMeshPlan:
+    def test_plan_v5e16_tp4(self):
+        plan = plan_mesh("v5e-16", AxisSpec(dp=-1, tp=4))
+        assert plan.num_chips == 16
+        assert plan.axes.tp == 4
+        assert plan.axes.dp == 4
+        # tp should consume a whole ICI dimension
+        assert "ici" in plan.ici_assignment["tp"]
+
+    def test_plan_sp_prefers_ring(self):
+        plan = plan_mesh("v5e-256", AxisSpec(dp=-1, sp=16))
+        # v5e-256 is a 16x16 torus → sp should land on a wrapped dim
+        assert plan.ici_assignment["sp"].startswith("ici")
+
+    def test_plan_overflow_raises(self):
+        with pytest.raises(ValueError):
+            plan_mesh("v5e-4", AxisSpec(tp=8))
+
+    def test_make_mesh_on_cpu(self, devices8):
+        plan = plan_mesh("v5e-8", AxisSpec(dp=2, fsdp=2, tp=2))
+        mesh = make_mesh(plan, devices=devices8)
+        assert mesh.shape["dp"] == 2
+        assert mesh.shape["fsdp"] == 2
+        assert mesh.shape["tp"] == 2
+        assert mesh.shape["ep"] == 1
+
+        # The mesh is usable: shard an array and reduce over it.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        x = jnp.arange(16.0).reshape(8, 2)
+        xs = jax.device_put(x, NamedSharding(mesh, P(("dp", "fsdp"), "tp")))
+        assert float(xs.sum()) == float(np.arange(16.0).sum())
