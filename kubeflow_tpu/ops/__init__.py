@@ -1,0 +1,24 @@
+"""TPU compute ops: reference implementations + pallas kernels.
+
+The reference platform has no compute ops of its own (the math lives inside
+scheduled container images, reference: tf-controller-examples/tf-cnn/); here
+the ops are first-class framework code so the models and the parallelism
+library share one audited implementation.
+"""
+
+from kubeflow_tpu.ops.attention import (
+    mha_reference,
+    causal_mask,
+    segment_mask,
+)
+from kubeflow_tpu.ops.norms import rms_norm
+from kubeflow_tpu.ops.rope import apply_rope, rope_frequencies
+
+__all__ = [
+    "mha_reference",
+    "causal_mask",
+    "segment_mask",
+    "rms_norm",
+    "apply_rope",
+    "rope_frequencies",
+]

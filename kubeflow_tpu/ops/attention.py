@@ -1,0 +1,68 @@
+"""Reference multi-head attention (full-softmax, O(S^2) memory).
+
+This is the semantic ground truth that the parallel implementations
+(ring attention over the ``sp`` ICI ring, Ulysses all-to-all) and the
+pallas flash kernel are tested against. bf16-friendly: softmax statistics
+are computed in f32 regardless of input dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_mask(q_len: int, kv_len: int, *, q_offset: int = 0) -> jax.Array:
+    """[q_len, kv_len] bool mask, True = attend. ``q_offset`` is the absolute
+    position of the first query row (used by ring attention, where each
+    device's query block starts mid-sequence)."""
+    q_pos = jnp.arange(q_len)[:, None] + q_offset
+    kv_pos = jnp.arange(kv_len)[None, :]
+    return q_pos >= kv_pos
+
+
+def segment_mask(q_seg: jax.Array, kv_seg: jax.Array) -> jax.Array:
+    """True where query and key belong to the same packed segment.
+    q_seg: [B, Sq], kv_seg: [B, Skv] -> [B, 1, Sq, Skv]."""
+    return (q_seg[:, :, None] == kv_seg[:, None, :])[:, None, :, :]
+
+
+def mha_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """q: [B, Sq, H, D]; k, v: [B, Skv, Hkv, D] with H % Hkv == 0 (GQA/MQA
+    via head repetition). Returns [B, Sq, H, D] in q.dtype."""
+    B, Sq, H, D = q.shape
+    _, Skv, Hkv, _ = k.shape
+    if H % Hkv != 0:
+        raise ValueError(f"query heads {H} not a multiple of kv heads {Hkv}")
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = (D ** -0.5) if scale is None else scale
+
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        cm = causal_mask(Sq, Skv, q_offset=Skv - Sq)
+        logits = jnp.where(cm[None, None, :, :], logits, -jnp.inf)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -jnp.inf)
+    # Fully-masked rows (possible with segment masks) would yield NaN; guard.
+    weights = jax.nn.softmax(logits, axis=-1)
+    weights = jnp.where(jnp.isnan(weights), 0.0, weights)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", weights.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)

@@ -1,0 +1,33 @@
+"""Rotary position embeddings (split-half convention, Llama-style)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(
+    head_dim: int, max_len: int, *, theta: float = 10000.0
+) -> tuple[jax.Array, jax.Array]:
+    """Precompute (cos, sin), each [max_len, head_dim // 2], in f32."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(
+    x: jax.Array, cos: jax.Array, sin: jax.Array, *, positions: jax.Array | None = None
+) -> jax.Array:
+    """x: [B, S, H, D]. positions: [B, S] absolute positions (defaults to
+    arange — ring attention passes each shard's global offsets)."""
+    B, S, H, D = x.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    c = cos[positions][:, :, None, :]  # [B, S, 1, D/2]
+    s = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,37 @@
+"""Parallelism library: sharding rules, sequence parallelism, expert dispatch.
+
+The genuinely new tier relative to the reference, which has no tensor/
+pipeline/sequence/expert parallelism anywhere (SURVEY.md §2.5: deepest
+parallelism API is replica counts in job specs, reference:
+tf-controller-examples/tf-cnn/create_job_specs.py:96-180). Here parallelism
+is expressed as logical-axis sharding rules resolved against a MeshPlan, and
+the heavy collectives (ring ppermute for context parallelism, all-to-all for
+Ulysses and expert dispatch) are explicit, testable ops.
+"""
+
+from kubeflow_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    Rules,
+    logical_spec,
+    logical_sharding,
+    constrain,
+    param_shardings,
+    merge_rules,
+)
+from kubeflow_tpu.parallel.ring_attention import ring_attention
+from kubeflow_tpu.parallel.ulysses import ulysses_attention
+from kubeflow_tpu.parallel.moe import moe_dispatch, Top2GateConfig
+
+__all__ = [
+    "DEFAULT_RULES",
+    "Rules",
+    "logical_spec",
+    "logical_sharding",
+    "constrain",
+    "param_shardings",
+    "merge_rules",
+    "ring_attention",
+    "ulysses_attention",
+    "moe_dispatch",
+    "Top2GateConfig",
+]

@@ -1,0 +1,127 @@
+"""Expert parallelism: GShard-style top-2 gating with static capacity.
+
+TPU-first design choices: everything is static-shaped (capacity-based
+dispatch, not ragged routing), dispatch/combine are einsums that land on
+the MXU, and the expert dimension is sharded on the ``ep`` mesh axis so
+XLA emits the all-to-all between token-sharded and expert-sharded layouts
+(SURVEY.md §2.5 — the reference's only "expert" story was generic MPI
+replica counts; Mixtral/BASELINE config 3 is the target here).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Top2GateConfig:
+    num_experts: int
+    capacity_factor: float = 1.25
+    min_capacity: int = 4
+    # Multiply router logits noise during training (0 disables).
+    jitter_eps: float = 0.0
+
+    def capacity(self, num_tokens: int) -> int:
+        cap = int(self.capacity_factor * num_tokens * 2 / self.num_experts)
+        cap = max(cap, self.min_capacity)
+        # Round up to a multiple of 4 to keep dispatch einsums tile-friendly.
+        return -(-cap // 4) * 4
+
+
+def top2_gating(
+    logits: jax.Array,
+    cfg: Top2GateConfig,
+    *,
+    rng: jax.Array | None = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """logits: [T, E] router outputs (f32).
+
+    Returns (combine [T, E, C], dispatch bool [T, E, C], aux_loss scalar).
+    Tokens overflowing an expert's capacity C are dropped (standard GShard
+    semantics); combine weights renormalised over the surviving experts.
+
+    If ``cfg.jitter_eps > 0`` and ``rng`` is given, router logits are
+    multiplied by uniform noise in [1-eps, 1+eps] (training-time exploration,
+    GShard §2.2); inference callers simply omit ``rng``.
+    """
+    T, E = logits.shape
+    C = cfg.capacity(T)
+    logits = logits.astype(jnp.float32)
+    if cfg.jitter_eps > 0.0 and rng is not None:
+        noise = jax.random.uniform(
+            rng, logits.shape, jnp.float32,
+            minval=1.0 - cfg.jitter_eps, maxval=1.0 + cfg.jitter_eps,
+        )
+        logits = logits * noise
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = jax.nn.one_hot(idx1, E, dtype=jnp.float32)
+    gates_no1 = gates * (1.0 - mask1)
+    idx2 = jnp.argmax(gates_no1, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, E, dtype=jnp.float32)
+
+    # Load-balancing auxiliary loss (GShard eq. 4): fraction of router prob
+    # vs fraction of tokens dispatched (top-1), scaled by E.
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    aux_loss = jnp.sum(me * ce) * E
+
+    # Position of each token within its expert's buffer; second choices queue
+    # behind all first choices.
+    pos1 = jnp.cumsum(mask1, axis=0) - mask1
+    pos2 = jnp.cumsum(mask2, axis=0) - mask2 + jnp.sum(mask1, axis=0, keepdims=True)
+    mask1 = mask1 * (pos1 < C)
+    mask2 = mask2 * (pos2 < C)
+
+    g1 = jnp.sum(gates * mask1, axis=-1)
+    g2 = jnp.sum(gates * mask2, axis=-1)
+    denom = g1 + g2
+    denom = jnp.where(denom > 0, denom, 1.0)
+    g1, g2 = g1 / denom, g2 / denom
+
+    p1 = jnp.sum(pos1 * mask1, axis=-1).astype(jnp.int32)  # [T]
+    p2 = jnp.sum(pos2 * mask2, axis=-1).astype(jnp.int32)
+    oh1 = jax.nn.one_hot(p1, C, dtype=jnp.float32) * jnp.sum(mask1, -1, keepdims=True)
+    oh2 = jax.nn.one_hot(p2, C, dtype=jnp.float32) * jnp.sum(mask2, -1, keepdims=True)
+    combine = (
+        g1[:, None, None] * mask1[:, :, None] * oh1[:, None, :]
+        + g2[:, None, None] * mask2[:, :, None] * oh2[:, None, :]
+    )
+    dispatch = combine > 0.0
+    return combine, dispatch, aux_loss
+
+
+def moe_dispatch(
+    x: jax.Array,
+    router_logits: jax.Array,
+    expert_fn: Callable[[jax.Array], jax.Array],
+    cfg: Top2GateConfig,
+    *,
+    rng: jax.Array | None = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Route tokens through experts.
+
+    x: [T, M] tokens; router_logits: [T, E]; expert_fn maps [E, C, M] ->
+    [E, C, M] (vmapped expert MLP whose params carry the leading E dim,
+    sharded on the ``ep`` axis by the caller's param shardings).
+
+    Returns ([T, M] outputs, aux_loss). The token->expert reshard (and back)
+    is emitted by XLA as all-to-all under pjit when T is dp-sharded and E is
+    ep-sharded.
+    """
+    combine, dispatch, aux = top2_gating(router_logits, cfg, rng=rng)
+    expert_in = jnp.einsum(
+        "tec,tm->ecm", dispatch.astype(x.dtype), x,
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    expert_out = expert_fn(expert_in)
+    out = jnp.einsum(
+        "tec,ecm->tm", combine.astype(expert_out.dtype), expert_out,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(x.dtype), aux

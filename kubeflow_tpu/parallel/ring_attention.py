@@ -1,0 +1,169 @@
+"""Ring attention: context parallelism over an ICI ring.
+
+Each device in the ``sp`` mesh axis holds one block of the sequence
+(q, k, v all sharded on the sequence dim). K/V blocks rotate around the
+ring with ``lax.ppermute`` while each device accumulates attention of its
+local queries against every block using the online-softmax (flash) update,
+so peak memory stays O(S/P) per device and communication is pure
+neighbour exchange — exactly what ICI rings are built for (SURVEY.md §5
+"Long-context / sequence parallelism": absent from the reference, a
+first-class axis here).
+
+Semantics are tested against ops.attention.mha_reference. Compute is done
+in f32 accumulators regardless of input dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG = -1e30  # finite stand-in for -inf: keeps exp() NaN-free when a whole
+              # block is masked (see online-softmax update below)
+
+
+def _block_attn_update(
+    carry: Tuple[jax.Array, jax.Array, jax.Array],
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_offset: jax.Array,
+    kv_offset: jax.Array,
+    *,
+    causal: bool,
+    scale: float,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One online-softmax accumulation step of local q against one kv block.
+
+    carry: (o [B,Sq,H,D] f32 accumulator, m [B,H,Sq] running max,
+            l [B,H,Sq] running denominator).
+
+    GQA: k/v may carry Hkv < H heads; the repeat happens inside the einsum
+    via head grouping so the rotated ring payload stays [B,Skv,Hkv,D]
+    (repeating before the loop would multiply ppermute traffic by H/Hkv).
+    """
+    o, m, l = carry
+    B, Sq, H, D = q.shape
+    Skv, Hkv = k.shape[1], k.shape[2]
+    if Hkv != H:
+        rep = H // Hkv
+        qg = q.reshape(B, Sq, Hkv, rep, D)
+        s = jnp.einsum(
+            "bqgrd,bkgd->bgrqk", qg, k, preferred_element_type=jnp.float32
+        ).reshape(B, H, Sq, Skv)
+    else:
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        )
+    s = s * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        kv_pos = kv_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        s = jnp.where(mask[None, None, :, :], s, _NEG)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # exp of masked entries may be 1.0 when the whole block is masked
+    # (s == m_new == _NEG); multiplying by the mask again is unnecessary
+    # because alpha-correction keeps l consistent only if we zero them:
+    p = jnp.exp(s - m_new[..., None])
+    if causal:
+        p = jnp.where(mask[None, None, :, :], p, 0.0)
+    alpha = jnp.exp(m - m_new)
+    l = l * alpha + jnp.sum(p, axis=-1)
+    if Hkv != H:
+        rep = H // Hkv
+        pg = p.reshape(B, Hkv, rep, Sq, Skv)
+        pv = jnp.einsum(
+            "bgrqk,bkgd->bqgrd", pg.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        ).reshape(B, Sq, H, D)
+    else:
+        pv = jnp.einsum(
+            "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
+    o = o * alpha.transpose(0, 2, 1)[..., None] + pv
+    return o, m_new, l
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Ring attention body — call INSIDE shard_map with q/k/v sequence-sharded
+    over ``axis_name``. Shapes per device: q [B, Sq, H, D], k/v [B, Skv, Hkv, D].
+
+    GQA: kv heads are repeated locally to match q heads (cheap: Hkv small).
+    """
+    B, Sq, H, D = q.shape
+    _, Skv, Hkv, _ = k.shape
+    if H % Hkv != 0:
+        raise ValueError(f"query heads {H} not a multiple of kv heads {Hkv}")
+    scale_ = (D ** -0.5) if scale is None else scale
+
+    P_ = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    q_offset = idx * Sq
+
+    o0 = jnp.zeros((B, Sq, H, D), jnp.float32)
+    m0 = jnp.full((B, H, Sq), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+
+    # Send-to-next / receive-from-previous: after j rotations this device
+    # holds the block originally owned by (idx - j) mod P.
+    perm = [(i, (i + 1) % P_) for i in range(P_)]
+
+    def body(j, state):
+        o, m, l, kj, vj = state
+        kv_offset = ((idx - j) % P_) * Skv
+        o, m, l = _block_attn_update(
+            (o, m, l), q, kj, vj, q_offset, kv_offset,
+            causal=causal, scale=scale_,
+        )
+        # Rotate for the next step (the final rotation is wasted but keeps
+        # the loop body uniform; XLA overlaps the permute with compute).
+        kj = lax.ppermute(kj, axis_name, perm)
+        vj = lax.ppermute(vj, axis_name, perm)
+        return o, m, l, kj, vj
+
+    o, m, l, _, _ = lax.fori_loop(0, P_, body, (o0, m0, l0, k, v))
+    l_t = l.transpose(0, 2, 1)[..., None]  # [B,Sq,H,1]
+    out = jnp.where(l_t > 0, o / jnp.maximum(l_t, 1e-30), 0.0)
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    batch_axes: Sequence[str] = ("dp", "fsdp"),
+    head_axis: Optional[str] = "tp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """shard_map wrapper: q/k/v are global [B, S, H, D] arrays; the sequence
+    dim is sharded over ``axis_name`` and rotated via ppermute."""
+    spec = P(tuple(batch_axes), axis_name, head_axis, None)
+    fn = functools.partial(
+        ring_attention, axis_name=axis_name, causal=causal, scale=scale
+    )
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

@@ -1,0 +1,120 @@
+"""Logical-axis sharding rules (t5x/MaxText-style) resolved per MeshPlan.
+
+Models annotate tensors with *logical* axis names ("act_batch", "embed",
+"mlp", ...); a rule table maps logical names to mesh axes ("dp", "fsdp",
+"tp", "sp", "ep" — the canonical AXIS_ORDER of kubeflow_tpu.topology.mesh).
+Changing the parallelism strategy means changing the rule table, not the
+model.
+
+Two namespaces by convention:
+- ``act_*``  — activation dims (constrained via ``constrain`` inside apply)
+- bare names — parameter dims (annotated via flax ``nn.with_logical_partitioning``)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from flax import linen as nn
+from flax.linen import spmd as flax_spmd
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+Rules = Tuple[Tuple[str, MeshAxes], ...]
+
+DEFAULT_RULES: Rules = (
+    # activations
+    ("act_batch", ("dp", "fsdp")),
+    ("act_seq", "sp"),
+    ("act_heads", "tp"),
+    ("act_kv", None),
+    ("act_embed", None),
+    ("act_mlp", "tp"),
+    ("act_vocab", "tp"),
+    ("act_expert", "ep"),
+    # params
+    ("embed", "fsdp"),
+    ("heads", "tp"),
+    ("kv_heads", "tp"),
+    ("head_dim", None),
+    ("mlp", "tp"),
+    ("vocab", "tp"),
+    ("expert", "ep"),
+    ("norm", None),
+    # conv params (ResNet): shard output channels over tp, none over spatial
+    ("conv_hw", None),
+    ("conv_in", None),
+    ("conv_out", "tp"),
+)
+
+
+def merge_rules(base: Rules, overrides: Dict[str, MeshAxes]) -> Rules:
+    d = dict(base)
+    d.update(overrides)
+    return tuple(d.items())
+
+
+def _lookup(rules: Rules) -> Dict[str, MeshAxes]:
+    return dict(rules)
+
+
+def logical_spec(
+    logical_axes: Sequence[Optional[str]], rules: Rules = DEFAULT_RULES
+) -> PartitionSpec:
+    """Map a tuple of logical axis names (None = replicated dim) to a
+    PartitionSpec via the rule table. Unknown names are an error — silent
+    replication hides typos."""
+    table = _lookup(rules)
+    out = []
+    for name in logical_axes:
+        if name is None:
+            out.append(None)
+            continue
+        if name not in table:
+            raise KeyError(
+                f"logical axis {name!r} has no sharding rule; known: "
+                f"{sorted(table)}"
+            )
+        out.append(table[name])
+    return PartitionSpec(*out)
+
+
+def logical_sharding(
+    mesh: Mesh, logical_axes: Sequence[Optional[str]], rules: Rules = DEFAULT_RULES
+) -> NamedSharding:
+    return NamedSharding(mesh, logical_spec(logical_axes, rules))
+
+
+def constrain(
+    x: jax.Array,
+    logical_axes: Sequence[Optional[str]],
+    rules: Rules = DEFAULT_RULES,
+) -> jax.Array:
+    """with_sharding_constraint by logical names. Must run under a mesh
+    context (pjit/jit with shardings, or tests' explicit Mesh)."""
+    spec = logical_spec(logical_axes, rules)
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def param_shardings(
+    mesh: Mesh,
+    abstract_variables: Any,
+    rules: Rules = DEFAULT_RULES,
+) -> Any:
+    """Resolve flax ``nn.with_logical_partitioning`` metadata into a pytree
+    of NamedShardings (for jit in_shardings / device_put).
+
+    abstract_variables: output of ``jax.eval_shape(model.init, ...)``.
+    """
+    logical_specs = nn.get_partition_spec(abstract_variables)
+    mesh_specs = flax_spmd.logical_to_mesh(logical_specs, tuple(rules))
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        mesh_specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

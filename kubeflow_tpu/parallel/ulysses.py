@@ -1,0 +1,88 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism: head-scatter all-to-all.
+
+Alternative to ring attention for short ``sp`` extents: instead of rotating
+K/V blocks P-1 times, do one all-to-all that re-shards tensors from
+sequence-sharded to head-sharded, run *local* full attention over the whole
+sequence, and all-to-all back. Two collectives total, but requires
+num_heads % sp == 0 and holds the full sequence per device during attention
+(memory O(S) vs ring's O(S/P)). The mesh planner maps ``sp`` onto an ICI
+dimension either way (kubeflow_tpu.topology.mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubeflow_tpu.ops.attention import mha_reference
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Ulysses body — call INSIDE shard_map with q/k/v sequence-sharded over
+    ``axis_name``. Per-device shapes: q [B, S/P, H, D], k/v [B, S/P, Hkv, D].
+    Requires H % P == 0 (and Hkv repeated up to P if needed).
+    """
+    P_ = lax.axis_size(axis_name)
+    B, Sq, H, D = q.shape
+    _, _, Hkv, _ = k.shape
+    if H % P_ != 0:
+        raise ValueError(f"query heads {H} not divisible by sp={P_}")
+    if Hkv % P_ != 0:
+        # Repeat kv heads up to lcm(Hkv, P) so the head dim splits evenly
+        # over the sp extent (MQA/GQA with few kv heads).
+        import math
+
+        rep = math.lcm(Hkv, P_) // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+        Hkv = k.shape[2]
+
+    # seq-sharded -> head-sharded: [B, S/P, H, D] -> [B, S, H/P, D]
+    a2a = functools.partial(
+        lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,
+        tiled=True,
+    )
+    qg, kg, vg = a2a(q), a2a(k), a2a(v)
+    out = mha_reference(qg, kg, vg, causal=causal, scale=scale)
+    # head-sharded -> seq-sharded
+    return lax.all_to_all(
+        out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def ulysses_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    batch_axes: Sequence[str] = ("dp", "fsdp"),
+    head_axis: Optional[str] = "tp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    spec = P(tuple(batch_axes), axis_name, head_axis, None)
+    fn = functools.partial(
+        ulysses_attention, axis_name=axis_name, causal=causal, scale=scale
+    )
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

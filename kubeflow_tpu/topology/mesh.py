@@ -87,9 +87,12 @@ class MeshPlan:
 
     slice_name: str
     axes: AxisSpec
-    axis_names: Tuple[str, ...]          # in AXIS_ORDER, only extents > 1 kept... plus dp always
+    axis_names: Tuple[str, ...]          # always all of AXIS_ORDER (size-1 axes kept)
     axis_sizes: Tuple[int, ...]
-    # Human-readable account of which ICI dims back each logical axis.
+    # Heuristic, human-readable account of which ICI dims *should* back each
+    # logical axis. Diagnostics and scheduler hints only: make_mesh delegates
+    # the actual device arrangement to mesh_utils.create_device_mesh, whose
+    # placement may differ. Do not treat as the runtime mapping.
     ici_assignment: Dict[str, str]
 
     @property

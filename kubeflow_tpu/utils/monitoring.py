@@ -17,6 +17,15 @@ from typing import Callable, Dict, List, Optional, Tuple
 LabelKV = Tuple[Tuple[str, str], ...]
 
 
+def _fmt_value(v: float) -> str:
+    """Full-precision float rendering (repr round-trips); '%g' would truncate
+    unix timestamps to ~1000 s resolution and corrupt large counters."""
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
 def _escape_label_value(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
@@ -61,7 +70,7 @@ class Counter:
         if not items and not self.label_names:
             out.append(f"{self.name} 0")
         for labels, v in items:
-            out.append(f"{self.name}{_fmt_labels(labels)} {v:g}")
+            out.append(f"{self.name}{_fmt_labels(labels)} {_fmt_value(v)}")
         return out
 
 
@@ -94,7 +103,7 @@ class Gauge:
         return [
             f"# HELP {self.name} {self.help}",
             f"# TYPE {self.name} gauge",
-            f"{self.name} {self.value():g}",
+            f"{self.name} {_fmt_value(self.value())}",
         ]
 
 
@@ -122,7 +131,7 @@ class Heartbeat:
         return [
             f"# HELP {self.name} {self.help}",
             f"# TYPE {self.name} gauge",
-            f"{self.name} {self.last():g}",
+            f"{self.name} {_fmt_value(self.last())}",
         ]
 
 

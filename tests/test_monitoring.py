@@ -55,3 +55,12 @@ class TestMetricsRegistry:
         assert g.value() == 42.0
         with pytest.raises(ValueError):
             g.set(1.0)
+
+
+class TestValueFormatting:
+    def test_timestamp_full_precision(self):
+        from kubeflow_tpu.utils.monitoring import _fmt_value
+
+        assert _fmt_value(1774000000.5) == "1774000000.5"
+        assert _fmt_value(1234567.0) == "1234567"
+        assert _fmt_value(0.25) == "0.25"

@@ -1,0 +1,45 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubeflow_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    constrain,
+    logical_spec,
+    merge_rules,
+)
+
+
+class TestLogicalSpec:
+    def test_default_mapping(self):
+        spec = logical_spec(("act_batch", "act_seq", "act_embed"))
+        assert spec == P(("dp", "fsdp"), "sp", None)
+
+    def test_param_mapping(self):
+        assert logical_spec(("embed", "mlp")) == P("fsdp", "tp")
+
+    def test_unknown_name_raises(self):
+        with pytest.raises(KeyError):
+            logical_spec(("act_batch", "bogus_axis"))
+
+    def test_none_dim(self):
+        assert logical_spec((None, "heads")) == P(None, "tp")
+
+    def test_merge_rules_override(self):
+        rules = merge_rules(DEFAULT_RULES, {"act_seq": None})
+        assert logical_spec(("act_seq",), rules) == P(None)
+
+    def test_constrain_under_mesh(self, devices8):
+        mesh = Mesh(np.asarray(devices8).reshape(2, 2, 2), ("dp", "fsdp", "tp"))
+        rules = merge_rules(DEFAULT_RULES, {})
+
+        @jax.jit
+        def f(x):
+            with mesh:
+                return constrain(x * 2, ("act_batch", None), rules)
+
+        x = jnp.ones((8, 4))
+        out = f(x)
+        np.testing.assert_allclose(np.asarray(out), 2.0)
