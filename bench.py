@@ -1,0 +1,92 @@
+"""Benchmark: flagship Llama training throughput, tokens/sec/chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md: `"published": {}`); the
+baseline below is the first measurement recorded by this framework at round
+1 on a single TPU v5e chip, so vs_baseline tracks our own progress —
+BASELINE.md's "to be established, not matched" contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+# Round-1 reference point (tokens/sec/chip, Llama ~700M, bs8 x seq2048,
+# bf16, single v5e chip). Updated when the bench config changes.
+BASELINE_TOKENS_PER_SEC = 14500.0
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=2048)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import Llama, LlamaConfig
+    from kubeflow_tpu.topology import AxisSpec, make_host_local_mesh
+    from kubeflow_tpu.train import TrainConfig, Trainer
+    from kubeflow_tpu.train.data import SyntheticTextConfig, synthetic_text
+
+    # ~700M-param Llama: big enough that the MXU dominates, small enough
+    # for one v5e chip (16G HBM) with f32 Adam state + grads + activations.
+    cfg = LlamaConfig(
+        vocab_size=32000, embed_dim=2048, num_layers=12, num_heads=16,
+        num_kv_heads=8, head_dim=128, mlp_dim=5632,
+        max_seq_len=args.seq_len, scan_layers=True, remat=True,
+    )
+    model = Llama(cfg)
+    ndev = len(jax.devices())
+    mesh = make_host_local_mesh(AxisSpec(dp=-1))
+    trainer = Trainer(
+        model,
+        TrainConfig(task="lm", warmup_steps=10, total_steps=1000),
+        mesh,
+    )
+    it = synthetic_text(
+        SyntheticTextConfig(
+            batch_size=args.batch_size * ndev,
+            seq_len=args.seq_len,
+            vocab_size=cfg.vocab_size,
+        )
+    )
+    batch = trainer.shard_batch({k: jnp.asarray(v) for k, v in next(it).items()})
+    state = trainer.init_state(jax.random.PRNGKey(0), batch)
+
+    for _ in range(args.warmup):
+        state, metrics = trainer.step(state, batch)
+    # Host fetch, not block_until_ready: remote-relay TPU platforms treat
+    # block_until_ready as a no-op, so only a device->host transfer is a
+    # reliable synchronisation point.
+    float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, metrics = trainer.step(state, batch)
+    final_loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    assert final_loss == final_loss, "loss is NaN"
+
+    tokens = args.batch_size * ndev * args.seq_len * args.steps
+    tps_chip = tokens / dt / ndev
+    print(
+        json.dumps(
+            {
+                "metric": "llama_700m_train_tokens_per_sec_per_chip",
+                "value": round(tps_chip, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(tps_chip / BASELINE_TOKENS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""Model zoo: the training/serving payloads of the platform.
+
+The reference's "model zoo" is a single containerised tf_cnn_benchmarks
+ResNet-50 TFJob payload (reference: tf-controller-examples/tf-cnn/
+create_job_specs.py:96-180); here the models are first-class framework code,
+written once with logical-axis sharding and reused by training, serving and
+HPO (BASELINE.md configs 1-5).
+"""
+
+from kubeflow_tpu.models.llama import Llama, LlamaConfig
+from kubeflow_tpu.models.mixtral import Mixtral, MixtralConfig
+from kubeflow_tpu.models.resnet import ResNet, ResNetConfig
+from kubeflow_tpu.models.vit import ViT, ViTConfig
+from kubeflow_tpu.models.registry import get_model, list_models, register_model
+
+__all__ = [
+    "Llama",
+    "LlamaConfig",
+    "Mixtral",
+    "MixtralConfig",
+    "ResNet",
+    "ResNetConfig",
+    "ViT",
+    "ViTConfig",
+    "get_model",
+    "list_models",
+    "register_model",
+]

@@ -1,0 +1,313 @@
+"""Llama-family decoder-only transformer (flagship model, BASELINE config 2).
+
+TPU-first choices:
+- bf16 activations / f32 params by default; all softmax/norm statistics f32.
+- Logical-axis annotations on every param and activation so one model serves
+  dp/fsdp/tp/sp layouts by swapping rule tables (kubeflow_tpu.parallel).
+- ``lax.scan`` over layers (config.scan_layers) for O(1) compile scaling.
+- Attention dispatches through the ambient ParallelContext: "full" reference
+  softmax, "ring" (ppermute context parallelism), or "ulysses" (all-to-all).
+- Autoregressive decode cache (flax "cache" collection) for the serving
+  engine's continuous batching.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from kubeflow_tpu.ops.attention import mha_reference
+from kubeflow_tpu.ops.norms import rms_norm
+from kubeflow_tpu.ops.rope import apply_rope, rope_frequencies
+from kubeflow_tpu.parallel.context import constrain, get_context
+from kubeflow_tpu.parallel.ring_attention import ring_attention_sharded
+from kubeflow_tpu.parallel.ulysses import ulysses_attention_sharded
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    embed_dim: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    mlp_dim: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    scan_layers: bool = True
+    remat: bool = True
+    tie_embeddings: bool = False
+    logits_softcap: float = 0.0
+
+    @classmethod
+    def llama3_8b(cls, **kw) -> "LlamaConfig":
+        return cls(
+            vocab_size=128256, embed_dim=4096, num_layers=32, num_heads=32,
+            num_kv_heads=8, head_dim=128, mlp_dim=14336, rope_theta=500000.0,
+            **kw,
+        )
+
+    @classmethod
+    def llama3_70b(cls, **kw) -> "LlamaConfig":
+        return cls(
+            vocab_size=128256, embed_dim=8192, num_layers=80, num_heads=64,
+            num_kv_heads=8, head_dim=128, mlp_dim=28672, rope_theta=500000.0,
+            **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        """Test/dryrun config: real architecture, toy widths."""
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("embed_dim", 64)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("num_kv_heads", 2)
+        kw.setdefault("head_dim", 16)
+        kw.setdefault("mlp_dim", 128)
+        kw.setdefault("max_seq_len", 128)
+        kw.setdefault("scan_layers", False)
+        kw.setdefault("remat", False)
+        return cls(**kw)
+
+
+def _dense(
+    features, kernel_axes, cfg: LlamaConfig, name: str, axis=-1
+) -> nn.DenseGeneral:
+    return nn.DenseGeneral(
+        features=features,
+        axis=axis,
+        use_bias=False,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(stddev=0.02), kernel_axes
+        ),
+        name=name,
+    )
+
+
+class RMSNorm(nn.Module):
+    cfg: LlamaConfig
+    def setup(self) -> None:
+        self.weight = self.param(
+            "weight",
+            nn.with_logical_partitioning(nn.initializers.ones, ("norm",)),
+            (self.cfg.embed_dim,),
+            self.cfg.param_dtype,
+        )
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        return rms_norm(x, self.weight, eps=self.cfg.norm_eps)
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        positions: jax.Array,
+        *,
+        decode: bool = False,
+    ) -> jax.Array:
+        cfg = self.cfg
+        H, Hkv, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        q = _dense((H, Dh), ("embed", "heads", "head_dim"), cfg, "q_proj")(x)
+        k = _dense((Hkv, Dh), ("embed", "kv_heads", "head_dim"), cfg, "k_proj")(x)
+        v = _dense((Hkv, Dh), ("embed", "kv_heads", "head_dim"), cfg, "v_proj")(x)
+        q = constrain(q, ("act_batch", "act_seq", "act_heads", "act_kv"))
+        k = constrain(k, ("act_batch", "act_seq", "act_heads", "act_kv"))
+        v = constrain(v, ("act_batch", "act_seq", "act_heads", "act_kv"))
+
+        cos, sin = rope_frequencies(
+            Dh, cfg.max_seq_len, theta=cfg.rope_theta
+        )
+        q = apply_rope(q, cos, sin, positions=positions)
+        k = apply_rope(k, cos, sin, positions=positions)
+
+        if decode:
+            out = self._decode_attention(q, k, v)
+        else:
+            out = self._train_attention(q, k, v)
+        out = constrain(out, ("act_batch", "act_seq", "act_heads", "act_kv"))
+        out = _dense(
+            cfg.embed_dim, ("heads", "head_dim", "embed"), cfg, "o_proj",
+            axis=(-2, -1),
+        )(out)
+        return constrain(out, ("act_batch", "act_seq", "act_embed"))
+
+    def _train_attention(self, q, k, v) -> jax.Array:
+        ctx = get_context()
+        if ctx.attn_impl == "ring" and ctx.sp_size > 1:
+            return ring_attention_sharded(
+                q, k, v, ctx.mesh, causal=True
+            )
+        if ctx.attn_impl == "ulysses" and ctx.sp_size > 1:
+            return ulysses_attention_sharded(
+                q, k, v, ctx.mesh, causal=True
+            )
+        return mha_reference(q, k, v, causal=True)
+
+    def _decode_attention(self, q, k, v) -> jax.Array:
+        """Single-step (or prefill) attention against a mutable KV cache.
+        Cache layout: [B, max_len, Hkv, Dh]; cache_index scalar int32."""
+        cfg = self.cfg
+        B = q.shape[0]
+        is_init = not self.has_variable("cache", "cached_key")
+        cached_key = self.variable(
+            "cache", "cached_key",
+            jnp.zeros, (B, cfg.max_seq_len, cfg.num_kv_heads, cfg.head_dim),
+            cfg.dtype,
+        )
+        cached_value = self.variable(
+            "cache", "cached_value",
+            jnp.zeros, (B, cfg.max_seq_len, cfg.num_kv_heads, cfg.head_dim),
+            cfg.dtype,
+        )
+        cache_index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        if not is_init:
+            idx = cache_index.value
+            S_new = q.shape[1]
+            ck = jax.lax.dynamic_update_slice(
+                cached_key.value, k.astype(cfg.dtype), (0, idx, 0, 0)
+            )
+            cv = jax.lax.dynamic_update_slice(
+                cached_value.value, v.astype(cfg.dtype), (0, idx, 0, 0)
+            )
+            cached_key.value = ck
+            cached_value.value = cv
+            cache_index.value = idx + S_new
+            # Causal mask offset to the filled prefix (also masks the
+            # not-yet-written cache tail, since those slots are > q_pos).
+            from kubeflow_tpu.ops.attention import causal_mask
+
+            mask = causal_mask(S_new, cfg.max_seq_len, q_offset=idx)
+            return mha_reference(q, ck, cv, mask=mask[None, None, :, :])
+        return mha_reference(q, k, v, causal=True)
+
+
+class Mlp(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        gate = _dense(cfg.mlp_dim, ("embed", "mlp"), cfg, "gate_proj")(x)
+        up = _dense(cfg.mlp_dim, ("embed", "mlp"), cfg, "up_proj")(x)
+        h = nn.silu(gate) * up
+        h = constrain(h, ("act_batch", "act_seq", "act_mlp"))
+        out = _dense(cfg.embed_dim, ("mlp", "embed"), cfg, "down_proj")(h)
+        return constrain(out, ("act_batch", "act_seq", "act_embed"))
+
+
+class DecoderLayer(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(
+        self, x: jax.Array, positions: jax.Array, decode: bool = False
+    ) -> jax.Array:
+        cfg = self.cfg
+        h = RMSNorm(cfg, name="input_norm")(x)
+        h = Attention(cfg, name="attn")(h, positions, decode=decode)
+        x = x + h
+        h = RMSNorm(cfg, name="post_attn_norm")(x)
+        h = Mlp(cfg, name="mlp")(h)
+        return x + h
+
+
+class Llama(nn.Module):
+    """Decoder-only LM. __call__ returns logits [B, S, vocab]."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jax.Array,
+        *,
+        positions: Optional[jax.Array] = None,
+        decode: bool = False,
+    ) -> jax.Array:
+        cfg = self.cfg
+        B, S = tokens.shape
+        if positions is None:
+            # Decode callers pass absolute positions explicitly (the serving
+            # engine tracks per-sequence offsets); default is prefill order.
+            positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+
+        embed = self.param(
+            "embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("vocab", "embed")
+            ),
+            (cfg.vocab_size, cfg.embed_dim),
+            cfg.param_dtype,
+        )
+        x = jnp.take(embed, tokens, axis=0).astype(cfg.dtype)
+        x = constrain(x, ("act_batch", "act_seq", "act_embed"))
+
+        layer_cls = DecoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(
+                DecoderLayer,
+                prevent_cse=not cfg.scan_layers,
+                static_argnums=(3,),  # decode flag (self is argnum 0)
+            )
+
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (mdl(carry, positions, decode), None),
+                variable_axes={"params": 0, "cache": 0},
+                split_rngs={"params": True},
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(layer_cls(cfg, name="layers"), x, None)
+        else:
+            for i in range(cfg.num_layers):
+                x = layer_cls(cfg, name=f"layer_{i}")(x, positions, decode)
+
+        x = RMSNorm(cfg, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum(
+                "bse,ve->bsv", x, embed.astype(cfg.dtype),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            logits = _dense(
+                cfg.vocab_size, ("embed", "vocab"), cfg, "lm_head"
+            )(x).astype(jnp.float32)
+        if cfg.logits_softcap > 0:
+            logits = jnp.tanh(logits / cfg.logits_softcap) * cfg.logits_softcap
+        return constrain(logits, ("act_batch", "act_seq", "act_vocab"))
+
+    def num_params(self) -> int:
+        cfg = self.cfg
+        per_layer = (
+            cfg.embed_dim * cfg.num_heads * cfg.head_dim
+            + 2 * cfg.embed_dim * cfg.num_kv_heads * cfg.head_dim
+            + cfg.num_heads * cfg.head_dim * cfg.embed_dim
+            + 3 * cfg.embed_dim * cfg.mlp_dim
+            + 2 * cfg.embed_dim
+        )
+        head = 0 if cfg.tie_embeddings else cfg.vocab_size * cfg.embed_dim
+        return (
+            cfg.vocab_size * cfg.embed_dim
+            + cfg.num_layers * per_layer
+            + cfg.embed_dim
+            + head
+        )

@@ -1,0 +1,192 @@
+"""Mixtral-style MoE transformer (BASELINE config 3: expert-parallel
+all-to-all over ICI).
+
+Reuses the Llama decoder wholesale; the dense MLP is replaced with a
+top-2-routed expert bank whose leading expert dim is sharded on the ``ep``
+mesh axis. Dispatch/combine are the static-capacity einsums from
+kubeflow_tpu.parallel.moe, so XLA emits the token<->expert all-to-all when
+tokens are dp-sharded and experts ep-sharded (SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from kubeflow_tpu.models import llama as llama_mod
+from kubeflow_tpu.models.llama import (
+    Attention,
+    LlamaConfig,
+    RMSNorm,
+    _dense,
+)
+from kubeflow_tpu.parallel.context import constrain
+from kubeflow_tpu.parallel.moe import Top2GateConfig, moe_dispatch
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig(LlamaConfig):
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    router_jitter: float = 0.0
+    aux_loss_weight: float = 0.02
+
+    @classmethod
+    def mixtral_8x7b(cls, **kw) -> "MixtralConfig":
+        return cls(
+            vocab_size=32000, embed_dim=4096, num_layers=32, num_heads=32,
+            num_kv_heads=8, head_dim=128, mlp_dim=14336, rope_theta=1e6,
+            num_experts=8, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw) -> "MixtralConfig":
+        kw.setdefault("num_experts", 4)
+        kw.setdefault("capacity_factor", 2.0)
+        base = LlamaConfig.tiny()
+        for f in dataclasses.fields(LlamaConfig):
+            kw.setdefault(f.name, getattr(base, f.name))
+        return cls(**kw)
+
+
+class MoeMlp(nn.Module):
+    """Expert bank: stacked SwiGLU experts [E, ...] + top-2 router."""
+
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        B, S, M = x.shape
+        E = cfg.num_experts
+
+        router = _dense(E, ("embed", None), cfg, "router")
+        logits = router(x).astype(jnp.float32)  # [B, S, E]
+
+        def pinit(key, shape, dtype):
+            return nn.initializers.normal(stddev=0.02)(key, shape, dtype)
+
+        w_gate = self.param(
+            "w_gate",
+            nn.with_logical_partitioning(pinit, ("expert", "embed", "mlp")),
+            (E, M, cfg.mlp_dim), cfg.param_dtype,
+        )
+        w_up = self.param(
+            "w_up",
+            nn.with_logical_partitioning(pinit, ("expert", "embed", "mlp")),
+            (E, M, cfg.mlp_dim), cfg.param_dtype,
+        )
+        w_down = self.param(
+            "w_down",
+            nn.with_logical_partitioning(pinit, ("expert", "mlp", "embed")),
+            (E, cfg.mlp_dim, M), cfg.param_dtype,
+        )
+
+        def expert_fn(e_in: jax.Array) -> jax.Array:
+            # e_in: [E, C, M] (ep-sharded on E under pjit)
+            e_in = constrain(e_in, ("act_expert", None, "act_embed"))
+            gate = jnp.einsum(
+                "ecm,emh->ech", e_in, w_gate.astype(e_in.dtype),
+                preferred_element_type=jnp.float32,
+            ).astype(e_in.dtype)
+            up = jnp.einsum(
+                "ecm,emh->ech", e_in, w_up.astype(e_in.dtype),
+                preferred_element_type=jnp.float32,
+            ).astype(e_in.dtype)
+            h = nn.silu(gate) * up
+            out = jnp.einsum(
+                "ech,ehm->ecm", h, w_down.astype(h.dtype),
+                preferred_element_type=jnp.float32,
+            ).astype(e_in.dtype)
+            return constrain(out, ("act_expert", None, "act_embed"))
+
+        gate_cfg = Top2GateConfig(
+            num_experts=E,
+            capacity_factor=cfg.capacity_factor,
+            jitter_eps=cfg.router_jitter,
+        )
+        rng = None
+        if cfg.router_jitter > 0 and self.has_rng("router"):
+            rng = self.make_rng("router")
+        out_flat, aux = moe_dispatch(
+            x.reshape(B * S, M), logits.reshape(B * S, E), expert_fn,
+            gate_cfg, rng=rng,
+        )
+        self.sow("losses", "moe_aux_loss", aux)
+        out = out_flat.reshape(B, S, M)
+        return constrain(out, ("act_batch", "act_seq", "act_embed"))
+
+
+class MixtralLayer(nn.Module):
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(
+        self, x: jax.Array, positions: jax.Array, decode: bool = False
+    ) -> jax.Array:
+        cfg = self.cfg
+        h = RMSNorm(cfg, name="input_norm")(x)
+        h = Attention(cfg, name="attn")(h, positions, decode=decode)
+        x = x + h
+        h = RMSNorm(cfg, name="post_attn_norm")(x)
+        h = MoeMlp(cfg, name="moe")(h)
+        return x + h
+
+
+class Mixtral(nn.Module):
+    """Mixtral LM: Llama skeleton with MoE layers. Aux losses are sowed into
+    the "losses" collection; the train step adds cfg.aux_loss_weight * sum."""
+
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jax.Array,
+        *,
+        positions: Optional[jax.Array] = None,
+        decode: bool = False,
+    ) -> jax.Array:
+        cfg = self.cfg
+        B, S = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+
+        embed = self.param(
+            "embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("vocab", "embed")
+            ),
+            (cfg.vocab_size, cfg.embed_dim),
+            cfg.param_dtype,
+        )
+        x = jnp.take(embed, tokens, axis=0).astype(cfg.dtype)
+        x = constrain(x, ("act_batch", "act_seq", "act_embed"))
+
+        layer_cls = MixtralLayer
+        if cfg.remat:
+            layer_cls = nn.remat(
+                MixtralLayer, prevent_cse=not cfg.scan_layers, static_argnums=(3,)
+            )
+
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (mdl(carry, positions, decode), None),
+                variable_axes={"params": 0, "cache": 0, "losses": 0},
+                split_rngs={"params": True, "router": True},
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(layer_cls(cfg, name="layers"), x, None)
+        else:
+            for i in range(cfg.num_layers):
+                x = layer_cls(cfg, name=f"layer_{i}")(x, positions, decode)
+
+        x = RMSNorm(cfg, name="final_norm")(x)
+        logits = _dense(cfg.vocab_size, ("embed", "vocab"), cfg, "lm_head")(
+            x
+        ).astype(jnp.float32)
+        return constrain(logits, ("act_batch", "act_seq", "act_vocab"))

@@ -1,0 +1,57 @@
+"""Model registry: name -> (module class, config factory).
+
+The platform's job specs reference models by name (the analogue of the
+reference's image+flags payload contract, tf-controller-examples/tf-cnn/
+create_job_specs.py:96-117); the registry is how the TpuJob runtime, the
+serving engine and HPO trials resolve them.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+_REGISTRY: Dict[str, Tuple[type, Callable[..., object]]] = {}
+
+
+def register_model(name: str, module_cls: type, config_factory) -> None:
+    if name in _REGISTRY:
+        raise ValueError(f"model {name!r} already registered")
+    _REGISTRY[name] = (module_cls, config_factory)
+
+
+def get_model(name: str, **config_kw):
+    """Returns (flax module instance, config)."""
+    try:
+        module_cls, factory = _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown model {name!r}; known: {sorted(_REGISTRY)}"
+        ) from None
+    cfg = factory(**config_kw)
+    return module_cls(cfg), cfg
+
+
+def list_models():
+    return sorted(_REGISTRY)
+
+
+def _register_defaults() -> None:
+    from kubeflow_tpu.models.llama import Llama, LlamaConfig
+    from kubeflow_tpu.models.mixtral import Mixtral, MixtralConfig
+    from kubeflow_tpu.models.resnet import ResNet, ResNetConfig
+    from kubeflow_tpu.models.vit import ViT, ViTConfig
+
+    register_model("llama3-8b", Llama, LlamaConfig.llama3_8b)
+    register_model("llama3-70b", Llama, LlamaConfig.llama3_70b)
+    register_model("llama-tiny", Llama, LlamaConfig.tiny)
+    register_model("mixtral-8x7b", Mixtral, MixtralConfig.mixtral_8x7b)
+    register_model("mixtral-tiny", Mixtral, MixtralConfig.tiny)
+    register_model("resnet50", ResNet, ResNetConfig.resnet50)
+    register_model("resnet101", ResNet, ResNetConfig.resnet101)
+    register_model("resnet-tiny", ResNet, ResNetConfig.tiny)
+    register_model("vit-l16", ViT, ViTConfig.vit_l16)
+    register_model("vit-b16", ViT, ViTConfig.vit_b16)
+    register_model("vit-tiny", ViT, ViTConfig.tiny)
+
+
+_register_defaults()

@@ -1,0 +1,147 @@
+"""ResNet (v1.5) for image classification — BASELINE config 1.
+
+The reference's entire training payload is tf_cnn_benchmarks ResNet-50 under
+parameter-server TFJobs (reference: tf-controller-examples/tf-cnn/
+create_job_specs.py:96-180, launcher.py:59-93). Here it is a first-class
+flax model trained data-parallel with XLA allreduce instead of PS gRPC.
+
+TPU notes: NHWC layout (XLA's native conv layout on TPU), bf16 activations,
+f32 batch-norm statistics. Under pjit the batch axis is sharded on
+("dp","fsdp") and BN reductions become global automatically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from kubeflow_tpu.parallel.context import constrain
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)   # ResNet-50
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @classmethod
+    def resnet50(cls, **kw) -> "ResNetConfig":
+        return cls(stage_sizes=(3, 4, 6, 3), **kw)
+
+    @classmethod
+    def resnet101(cls, **kw) -> "ResNetConfig":
+        return cls(stage_sizes=(3, 4, 23, 3), **kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "ResNetConfig":
+        kw.setdefault("stage_sizes", (1, 1))
+        kw.setdefault("width", 8)
+        kw.setdefault("num_classes", 10)
+        return cls(**kw)
+
+
+def _conv(features: int, kernel: Tuple[int, int], strides: int, cfg, name: str):
+    return nn.Conv(
+        features,
+        kernel,
+        strides=(strides, strides),
+        padding=[(k // 2, k // 2) for k in kernel],
+        use_bias=False,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+            ("conv_h", "conv_w", "conv_in", "conv_out"),
+        ),
+        name=name,
+    )
+
+
+def _bn(cfg, name: str):
+    return nn.BatchNorm(
+        use_running_average=None,  # passed at call time
+        momentum=0.9,
+        epsilon=1e-5,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("norm",)),
+        bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("norm",)),
+        name=name,
+    )
+
+
+class BottleneckBlock(nn.Module):
+    cfg: ResNetConfig
+    features: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        cfg = self.cfg
+        residual = x
+        y = _conv(self.features, (1, 1), 1, cfg, "conv1")(x)
+        y = _bn(cfg, "bn1")(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = _conv(self.features, (3, 3), self.strides, cfg, "conv2")(y)
+        y = _bn(cfg, "bn2")(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = _conv(self.features * 4, (1, 1), 1, cfg, "conv3")(y)
+        bn3 = _bn(cfg, "bn3")
+        y = bn3(y, use_running_average=not train)
+        if residual.shape != y.shape:
+            residual = _conv(
+                self.features * 4, (1, 1), self.strides, cfg, "conv_proj"
+            )(residual)
+            residual = _bn(cfg, "bn_proj")(
+                residual, use_running_average=not train
+            )
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, images: jax.Array, *, train: bool = True) -> jax.Array:
+        """images: [B, H, W, 3] NHWC. Returns logits [B, num_classes]."""
+        cfg = self.cfg
+        x = images.astype(cfg.dtype)
+        x = nn.Conv(
+            cfg.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+            use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+                ("conv_h", "conv_w", "conv_in", "conv_out"),
+            ),
+            name="conv_init",
+        )(x)
+        x = _bn(cfg, "bn_init")(x, use_running_average=not train)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, n_blocks in enumerate(cfg.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BottleneckBlock(
+                    cfg, cfg.width * 2 ** i, strides, name=f"stage{i}_block{j}"
+                )(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = constrain(x, ("act_batch", "act_embed"))
+        logits = nn.Dense(
+            cfg.num_classes,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("embed", "vocab")
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("vocab",)
+            ),
+            name="head",
+        )(x)
+        return logits.astype(jnp.float32)

@@ -1,0 +1,154 @@
+"""Vision Transformer — the HPO trial workload (BASELINE config 4:
+Katib-equivalent sweeps run ViT-L/16 trial workers on TPU).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from kubeflow_tpu.ops.attention import mha_reference
+from kubeflow_tpu.ops.norms import rms_norm
+from kubeflow_tpu.parallel.context import constrain
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    embed_dim: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    mlp_dim: int = 4096
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @classmethod
+    def vit_l16(cls, **kw) -> "ViTConfig":
+        return cls(**kw)
+
+    @classmethod
+    def vit_b16(cls, **kw) -> "ViTConfig":
+        return cls(embed_dim=768, num_layers=12, num_heads=12, mlp_dim=3072, **kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "ViTConfig":
+        kw.setdefault("image_size", 32)
+        kw.setdefault("patch_size", 8)
+        kw.setdefault("num_classes", 10)
+        kw.setdefault("embed_dim", 64)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("mlp_dim", 128)
+        return cls(**kw)
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+def _dense(features, kernel_axes, cfg, name, axis=-1):
+    return nn.DenseGeneral(
+        features=features,
+        axis=axis,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.xavier_uniform(), kernel_axes
+        ),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros, kernel_axes[-1:]
+        ),
+        name=name,
+    )
+
+
+class EncoderBlock(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
+        cfg = self.cfg
+        H = cfg.num_heads
+        Dh = cfg.embed_dim // H
+        h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         name="ln1")(x)
+        q = _dense((H, Dh), ("embed", "heads", "head_dim"), cfg, "q")(h)
+        k = _dense((H, Dh), ("embed", "heads", "head_dim"), cfg, "k")(h)
+        v = _dense((H, Dh), ("embed", "heads", "head_dim"), cfg, "v")(h)
+        attn = mha_reference(q, k, v, causal=False)
+        attn = _dense(
+            cfg.embed_dim, ("heads", "head_dim", "embed"), cfg, "out",
+            axis=(-2, -1),
+        )(attn)
+        x = x + attn
+        h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         name="ln2")(x)
+        h = _dense(cfg.mlp_dim, ("embed", "mlp"), cfg, "mlp_in")(h)
+        h = nn.gelu(h)
+        h = constrain(h, ("act_batch", "act_seq", "act_mlp"))
+        h = _dense(cfg.embed_dim, ("mlp", "embed"), cfg, "mlp_out")(h)
+        return x + h
+
+
+class ViT(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images: jax.Array, *, train: bool = False) -> jax.Array:
+        """images: [B, H, W, 3]. Returns logits [B, num_classes]."""
+        cfg = self.cfg
+        B = images.shape[0]
+        p = cfg.patch_size
+        x = nn.Conv(
+            cfg.embed_dim, (p, p), strides=(p, p), padding="VALID",
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.xavier_uniform(),
+                ("conv_h", "conv_w", "conv_in", "embed"),
+            ),
+            name="patch_embed",
+        )(images.astype(cfg.dtype))
+        x = x.reshape(B, -1, cfg.embed_dim)  # [B, N, E]
+
+        cls_tok = self.param(
+            "cls",
+            nn.with_logical_partitioning(nn.initializers.zeros, (None, None, "embed")),
+            (1, 1, cfg.embed_dim), cfg.param_dtype,
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls_tok, (B, 1, cfg.embed_dim)).astype(cfg.dtype), x],
+            axis=1,
+        )
+        pos = self.param(
+            "pos_embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), (None, None, "embed")
+            ),
+            (1, cfg.num_patches + 1, cfg.embed_dim), cfg.param_dtype,
+        )
+        x = x + pos.astype(cfg.dtype)
+        x = constrain(x, ("act_batch", "act_seq", "act_embed"))
+
+        for i in range(cfg.num_layers):
+            x = EncoderBlock(cfg, name=f"block_{i}")(x, deterministic=not train)
+
+        x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         name="ln_final")(x)
+        logits = nn.Dense(
+            cfg.num_classes, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("embed", "vocab")
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("vocab",)
+            ),
+            name="head",
+        )(x[:, 0])
+        return logits.astype(jnp.float32)

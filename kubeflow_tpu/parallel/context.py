@@ -1,0 +1,69 @@
+"""Ambient parallelism context: mesh + sharding rules + attention impl.
+
+Models reference *logical* axes only; the trainer (or serving engine)
+establishes a ParallelContext around ``model.apply`` and the ops resolve
+logical names through it. With no context active, constraints become no-ops
+and attention falls back to the full-softmax reference — so single-device
+unit tests and CPU debugging need no mesh plumbing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+from typing import Iterator, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+from kubeflow_tpu.parallel.sharding import DEFAULT_RULES, Rules, logical_spec
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelContext:
+    mesh: Optional[Mesh] = None
+    rules: Rules = DEFAULT_RULES
+    # "full" | "ring" | "ulysses" — how attention handles the sequence axis.
+    attn_impl: str = "full"
+
+    @property
+    def sp_size(self) -> int:
+        if self.mesh is None:
+            return 1
+        return self.mesh.shape.get("sp", 1)
+
+
+_ctx: contextvars.ContextVar[ParallelContext] = contextvars.ContextVar(
+    "kftpu_parallel_context", default=ParallelContext()
+)
+
+
+def get_context() -> ParallelContext:
+    return _ctx.get()
+
+
+@contextlib.contextmanager
+def parallel_context(
+    mesh: Optional[Mesh] = None,
+    rules: Rules = DEFAULT_RULES,
+    attn_impl: str = "full",
+) -> Iterator[ParallelContext]:
+    if attn_impl not in ("full", "ring", "ulysses"):
+        raise ValueError(f"unknown attn_impl {attn_impl!r}")
+    ctx = ParallelContext(mesh=mesh, rules=rules, attn_impl=attn_impl)
+    token = _ctx.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _ctx.reset(token)
+
+
+def constrain(x: jax.Array, logical_axes: Sequence[Optional[str]]) -> jax.Array:
+    """Sharding constraint by logical names via the ambient context.
+    No-op when no mesh is active (pure single-device execution)."""
+    ctx = get_context()
+    if ctx.mesh is None:
+        return x
+    spec = logical_spec(logical_axes, ctx.rules)
+    return jax.lax.with_sharding_constraint(x, spec)

@@ -43,7 +43,8 @@ DEFAULT_RULES: Rules = (
     ("expert", "ep"),
     ("norm", None),
     # conv params (ResNet): shard output channels over tp, none over spatial
-    ("conv_hw", None),
+    ("conv_h", None),
+    ("conv_w", None),
     ("conv_in", None),
     ("conv_out", "tp"),
 )

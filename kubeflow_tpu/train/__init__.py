@@ -1,0 +1,22 @@
+"""Training runtime: sharded train steps, optimizer, checkpoint service.
+
+The reference delegates all of this to opaque container payloads and keeps
+only gang lifecycle (reference: tf-controller-examples/tf-cnn/launcher.py,
+components/openmpi-controller/controller/controller.py); checkpointing is
+"whatever the container does" (SURVEY.md §5 Checkpoint/resume). Here the
+train loop and the orbax-backed checkpoint service are framework services
+that the TpuJob controller relies on for preemption recovery.
+"""
+
+from kubeflow_tpu.train.losses import cross_entropy_loss, softmax_accuracy
+from kubeflow_tpu.train.trainer import TrainConfig, Trainer, TrainState
+from kubeflow_tpu.train.checkpoint import CheckpointService
+
+__all__ = [
+    "cross_entropy_loss",
+    "softmax_accuracy",
+    "TrainConfig",
+    "Trainer",
+    "TrainState",
+    "CheckpointService",
+]

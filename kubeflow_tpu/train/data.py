@@ -1,0 +1,67 @@
+"""Data pipelines.
+
+Round-1 scope: deterministic synthetic pipelines (token streams and labelled
+images) so training, benchmarking and HPO are self-contained and
+reproducible — the analogue of tf_cnn_benchmarks' --data_name=synthetic
+default, which the reference's TFJob example also relies on (reference:
+tf-controller-examples/tf-cnn/create_job_specs.py:100-117: no dataset
+mounts, synthetic input).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class SyntheticTextConfig:
+    batch_size: int = 8
+    seq_len: int = 1024
+    vocab_size: int = 32000
+    seed: int = 0
+
+
+def synthetic_text(cfg: SyntheticTextConfig) -> Iterator[Dict[str, np.ndarray]]:
+    """Markov-ish synthetic token stream: learnable (not uniform noise) so
+    loss curves are meaningful in smoke tests and benchmarks."""
+    rng = np.random.default_rng(cfg.seed)
+    # Low-rank transition structure → next token predictable from current.
+    proj = rng.integers(0, cfg.vocab_size, size=cfg.vocab_size, dtype=np.int64)
+    while True:
+        start = rng.integers(0, cfg.vocab_size, size=(cfg.batch_size, 1))
+        toks = [start]
+        cur = start
+        for _ in range(cfg.seq_len):
+            nxt = proj[cur] ^ (cur % 7)
+            nxt = (nxt + rng.integers(0, 3, size=cur.shape)) % cfg.vocab_size
+            toks.append(nxt)
+            cur = nxt
+        batch = np.concatenate(toks, axis=1).astype(np.int32)
+        yield {"inputs": batch[:, : cfg.seq_len + 1]}
+
+
+@dataclasses.dataclass(frozen=True)
+class SyntheticImageConfig:
+    batch_size: int = 32
+    image_size: int = 224
+    num_classes: int = 1000
+    seed: int = 0
+
+
+def synthetic_images(cfg: SyntheticImageConfig) -> Iterator[Dict[str, np.ndarray]]:
+    rng = np.random.default_rng(cfg.seed)
+    while True:
+        labels = rng.integers(0, cfg.num_classes, size=cfg.batch_size)
+        # Class-dependent mean → learnable signal.
+        base = (labels[:, None, None, None] % 16) / 16.0 - 0.5
+        imgs = base + rng.normal(
+            0, 0.5, size=(cfg.batch_size, cfg.image_size, cfg.image_size, 3)
+        )
+        yield {
+            "inputs": imgs.astype(np.float32),
+            "labels": labels.astype(np.int32),
+        }

@@ -1,0 +1,52 @@
+"""Loss functions (f32 statistics, optional z-loss for bf16 stability)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_loss(
+    logits: jax.Array,
+    labels: jax.Array,
+    *,
+    mask: Optional[jax.Array] = None,
+    z_loss_weight: float = 0.0,
+    label_smoothing: float = 0.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """logits [..., V] (any float dtype; promoted to f32), labels [...] int.
+
+    Returns (mean loss over unmasked positions, total unmasked count).
+    z-loss (PaLM §B.4) regularises the log-partition toward 0, which keeps
+    bf16 logits from drifting — cheap insurance on TPU.
+    """
+    logits = logits.astype(jnp.float32)
+    V = logits.shape[-1]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(
+        logits, labels[..., None], axis=-1
+    )[..., 0]
+    nll = logz - label_logits
+    if label_smoothing > 0.0:
+        smooth = -(jnp.sum(jax.nn.log_softmax(logits), axis=-1) / V)
+        nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
+    if z_loss_weight > 0.0:
+        nll = nll + z_loss_weight * jnp.square(logz)
+    if mask is not None:
+        mask = mask.astype(jnp.float32)
+        count = jnp.maximum(mask.sum(), 1.0)
+        return (nll * mask).sum() / count, count
+    count = jnp.asarray(nll.size, jnp.float32)
+    return nll.mean(), count
+
+
+def softmax_accuracy(
+    logits: jax.Array, labels: jax.Array, *, mask: Optional[jax.Array] = None
+) -> jax.Array:
+    hit = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+    if mask is not None:
+        mask = mask.astype(jnp.float32)
+        return (hit * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return hit.mean()

@@ -19,8 +19,14 @@ LabelKV = Tuple[Tuple[str, str], ...]
 
 def _fmt_value(v: float) -> str:
     """Full-precision float rendering (repr round-trips); '%g' would truncate
-    unix timestamps to ~1000 s resolution and corrupt large counters."""
+    unix timestamps to ~1000 s resolution and corrupt large counters.
+    Non-finite values render in Prometheus spelling instead of crashing the
+    whole scrape."""
     f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
     if f == int(f) and abs(f) < 1e15:
         return str(int(f))
     return repr(f)

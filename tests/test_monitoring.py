@@ -64,3 +64,13 @@ class TestValueFormatting:
         assert _fmt_value(1774000000.5) == "1774000000.5"
         assert _fmt_value(1234567.0) == "1234567"
         assert _fmt_value(0.25) == "0.25"
+
+    def test_nonfinite_values_render(self):
+        from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+        reg = MetricsRegistry()
+        g = reg.gauge("kftpu_bad", "t")
+        g.set(float("inf"))
+        assert "kftpu_bad +Inf" in reg.render()
+        g.set(float("nan"))
+        assert "kftpu_bad NaN" in reg.render()
