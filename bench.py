@@ -60,12 +60,15 @@ def main() -> None:
     batch = trainer.shard_batch({k: jnp.asarray(v) for k, v in next(it).items()})
     state = trainer.init_state(jax.random.PRNGKey(0), batch)
 
+    if args.steps < 1:
+        raise SystemExit("--steps must be >= 1")
     for _ in range(args.warmup):
         state, metrics = trainer.step(state, batch)
     # Host fetch, not block_until_ready: remote-relay TPU platforms treat
     # block_until_ready as a no-op, so only a device->host transfer is a
     # reliable synchronisation point.
-    float(metrics["loss"])
+    if args.warmup > 0:
+        float(metrics["loss"])
 
     t0 = time.perf_counter()
     for _ in range(args.steps):

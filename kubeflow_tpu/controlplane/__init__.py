@@ -1,0 +1,12 @@
+"""Control plane: CRD types, controllers, API runtime, access management.
+
+The TPU-native rebuild of the reference's platform kernel (SURVEY.md §1
+L1-L2): typed resources in group ``kubeflow.org``-equivalent
+(``tpu.kubeflow.org``), an in-memory API server with watch/finalizer/
+ownerRef semantics (the envtest analogue the reference gets from
+sigs.k8s.io/controller-runtime envtest, reference: components/
+profile-controller/controllers/suite_test.go:50-72), a reconciler kernel
+(reference: components/common/reconcilehelper/util.go), and the
+controllers: TpuJob (gang scheduling on slices), Notebook (+culler),
+Profile (multi-tenancy), PodDefault (admission mutation), Tensorboard.
+"""

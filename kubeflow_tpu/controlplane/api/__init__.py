@@ -1,0 +1,52 @@
+from kubeflow_tpu.controlplane.api.meta import (
+    ObjectMeta,
+    Condition,
+    OwnerReference,
+    new_meta,
+)
+from kubeflow_tpu.controlplane.api.serde import to_dict, from_dict
+from kubeflow_tpu.controlplane.api.core import (
+    AuthorizationPolicy,
+    Container,
+    EnvVar,
+    Namespace,
+    Pod,
+    PodSpec,
+    PodStatus,
+    ResourceQuota,
+    RoleBinding,
+    Service,
+    ServiceAccount,
+    VirtualService,
+    VolumeMount,
+    Volume,
+)
+from kubeflow_tpu.controlplane.api.types import (
+    GROUP,
+    Notebook,
+    NotebookSpec,
+    PlatformConfig,
+    PodDefault,
+    PodDefaultSpec,
+    Profile,
+    ProfileSpec,
+    Tensorboard,
+    TensorboardSpec,
+    TpuJob,
+    TpuJobSpec,
+    KIND_REGISTRY,
+    object_from_dict,
+)
+
+__all__ = [
+    "ObjectMeta", "Condition", "OwnerReference", "new_meta",
+    "to_dict", "from_dict",
+    "AuthorizationPolicy",
+    "Container", "EnvVar", "Namespace", "Pod", "PodSpec", "PodStatus",
+    "ResourceQuota", "RoleBinding", "Service", "ServiceAccount",
+    "VirtualService", "VolumeMount", "Volume",
+    "GROUP", "Notebook", "NotebookSpec", "PlatformConfig",
+    "PodDefault", "PodDefaultSpec", "Profile", "ProfileSpec",
+    "Tensorboard", "TensorboardSpec", "TpuJob", "TpuJobSpec",
+    "KIND_REGISTRY", "object_from_dict",
+]

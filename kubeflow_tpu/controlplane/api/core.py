@@ -1,0 +1,200 @@
+"""Core (non-CRD) resources the controllers emit: Pods, Services, RBAC,
+routing. Light typed mirrors of the K8s objects the reference's controllers
+create (StatefulSet/Service/VirtualService in notebook_controller.go:278-435,
+Namespace/SA/RoleBinding in profile_controller.go:121-239)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from kubeflow_tpu.controlplane.api.meta import Condition, ObjectMeta
+
+
+@dataclasses.dataclass
+class EnvVar:
+    name: str = ""
+    value: str = ""
+
+
+@dataclasses.dataclass
+class VolumeMount:
+    name: str = ""
+    mount_path: str = ""
+    read_only: bool = False
+
+
+@dataclasses.dataclass
+class Volume:
+    name: str = ""
+    # one of:
+    empty_dir: Optional[dict] = None
+    pvc: Optional[str] = None
+    config_map: Optional[str] = None
+    secret: Optional[str] = None
+
+
+@dataclasses.dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    command: List[str] = dataclasses.field(default_factory=list)
+    args: List[str] = dataclasses.field(default_factory=list)
+    env: List[EnvVar] = dataclasses.field(default_factory=list)
+    env_from: List[str] = dataclasses.field(default_factory=list)
+    volume_mounts: List[VolumeMount] = dataclasses.field(default_factory=list)
+    ports: List[int] = dataclasses.field(default_factory=list)
+    # resource requests/limits, e.g. {"google.com/tpu": "4", "cpu": "8"}
+    resources: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class PodSpec:
+    containers: List[Container] = dataclasses.field(default_factory=list)
+    volumes: List[Volume] = dataclasses.field(default_factory=list)
+    node_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    service_account: str = ""
+    restart_policy: str = "Always"
+    # TPU gang placement
+    subdomain: str = ""
+    hostname: str = ""
+    scheduler_hints: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class PodStatus:
+    phase: str = "Pending"   # Pending|Running|Succeeded|Failed
+    conditions: List[Condition] = dataclasses.field(default_factory=list)
+    pod_ip: str = ""
+    host_ip: str = ""
+    node_name: str = ""
+    message: str = ""
+
+
+@dataclasses.dataclass
+class Pod:
+    api_version: str = "v1"
+    kind: str = "Pod"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: PodSpec = dataclasses.field(default_factory=PodSpec)
+    status: PodStatus = dataclasses.field(default_factory=PodStatus)
+
+
+@dataclasses.dataclass
+class ServicePort:
+    name: str = ""
+    port: int = 0
+    target_port: int = 0
+
+
+@dataclasses.dataclass
+class ServiceSpec:
+    selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    ports: List[ServicePort] = dataclasses.field(default_factory=list)
+    cluster_ip: str = ""      # "None" => headless (gang DNS)
+    type: str = "ClusterIP"
+
+
+@dataclasses.dataclass
+class Service:
+    api_version: str = "v1"
+    kind: str = "Service"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: ServiceSpec = dataclasses.field(default_factory=ServiceSpec)
+
+
+@dataclasses.dataclass
+class Namespace:
+    api_version: str = "v1"
+    kind: str = "Namespace"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    status: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ServiceAccount:
+    api_version: str = "v1"
+    kind: str = "ServiceAccount"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+
+
+@dataclasses.dataclass
+class Subject:
+    kind: str = "User"
+    name: str = ""
+
+
+@dataclasses.dataclass
+class RoleRef:
+    kind: str = "ClusterRole"
+    name: str = ""
+
+
+@dataclasses.dataclass
+class RoleBinding:
+    api_version: str = "rbac.authorization.k8s.io/v1"
+    kind: str = "RoleBinding"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    subjects: List[Subject] = dataclasses.field(default_factory=list)
+    role_ref: RoleRef = dataclasses.field(default_factory=RoleRef)
+
+
+@dataclasses.dataclass
+class ResourceQuota:
+    api_version: str = "v1"
+    kind: str = "ResourceQuota"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    # e.g. {"google.com/tpu": "16"} — TPU chips instead of the reference's
+    # generic hard limits (profile_controller.go:240-256)
+    hard: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class HttpRoute:
+    prefix: str = ""
+    rewrite: str = ""
+    destination_host: str = ""
+    destination_port: int = 0
+
+
+@dataclasses.dataclass
+class VirtualService:
+    """Istio-style route emitted for notebooks/tensorboards
+    (notebook_controller.go:378-435)."""
+
+    api_version: str = "networking.istio.io/v1beta1"
+    kind: str = "VirtualService"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    gateways: List[str] = dataclasses.field(default_factory=list)
+    hosts: List[str] = dataclasses.field(default_factory=list)
+    http: List[HttpRoute] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Event:
+    api_version: str = "v1"
+    kind: str = "Event"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    involved_kind: str = ""
+    involved_name: str = ""
+    involved_namespace: str = ""
+    type: str = "Normal"     # Normal | Warning
+    reason: str = ""
+    message: str = ""
+    count: int = 1
+
+
+@dataclasses.dataclass
+class AuthorizationPolicy:
+    """Modern Istio AuthorizationPolicy (replacing the reference's
+    deprecated v1alpha3 ServiceRole/ServiceRoleBinding RBAC,
+    profile_controller.go:188-194 / access-management/kfam/bindings.go:100-127;
+    SURVEY.md §7 hardest-parts item 4)."""
+
+    api_version: str = "security.istio.io/v1"
+    kind: str = "AuthorizationPolicy"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    action: str = "ALLOW"
+    # principals allowed (request.headers[<userid-header>] values)
+    principals: List[str] = dataclasses.field(default_factory=list)
+    user_id_header: str = "x-goog-authenticated-user-email"

@@ -1,0 +1,80 @@
+"""Object metadata: the identity/ownership/lifecycle envelope every resource
+carries (the analogue of k8s ObjectMeta as used throughout the reference's
+CRD types, e.g. components/profile-controller/api/v1/profile_types.go:38-68).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class OwnerReference:
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = True
+
+
+@dataclasses.dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    uid: str = ""
+    resource_version: int = 0
+    generation: int = 0
+    creation_timestamp: float = 0.0
+    deletion_timestamp: Optional[float] = None
+    owner_references: List[OwnerReference] = dataclasses.field(
+        default_factory=list
+    )
+    finalizers: List[str] = dataclasses.field(default_factory=list)
+
+
+def new_meta(name: str, namespace: str = "", **kw) -> ObjectMeta:
+    return ObjectMeta(name=name, namespace=namespace, **kw)
+
+
+def fresh_identity(meta: ObjectMeta) -> None:
+    meta.uid = uuid.uuid4().hex
+    meta.creation_timestamp = time.time()
+
+
+@dataclasses.dataclass
+class Condition:
+    """Typed status condition (mirrors the reference's use of pod/CR
+    conditions, notebook_controller.go:196-227)."""
+
+    type: str = ""
+    status: str = "Unknown"          # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+
+
+def set_condition(conditions: List[Condition], new: Condition) -> List[Condition]:
+    """Upsert by type; bump transition time only when status changes."""
+    out = []
+    found = False
+    for c in conditions:
+        if c.type == new.type:
+            found = True
+            if c.status != new.status:
+                new.last_transition_time = time.time()
+            else:
+                new.last_transition_time = c.last_transition_time
+                c.reason, c.message = new.reason, new.message
+                out.append(dataclasses.replace(c))
+                continue
+            out.append(new)
+        else:
+            out.append(c)
+    if not found:
+        new.last_transition_time = time.time()
+        out.append(new)
+    return out

@@ -1,0 +1,85 @@
+"""Dataclass <-> dict round-trip with K8s-style camelCase keys.
+
+Keeps the Python API snake_case while manifests/YAML stay camelCase, the
+same convention the reference's Go types get from JSON struct tags
+(e.g. components/notebook-controller/api/v1beta1/notebook_types.go:27-84).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Dict, Optional, Type, TypeVar, get_args, get_origin
+
+T = TypeVar("T")
+
+
+def _camel(s: str) -> str:
+    parts = s.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+def _snake(s: str) -> str:
+    out = []
+    for ch in s:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def to_dict(obj: Any) -> Any:
+    """Recursively convert dataclasses to camelCase dicts, dropping None and
+    empty containers (K8s-manifest style: absent, not null)."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            v = to_dict(getattr(obj, f.name))
+            if v is None or v == {} or v == []:
+                continue
+            out[_camel(f.name)] = v
+        return out
+    if isinstance(obj, dict):
+        return {k: to_dict(v) for k, v in obj.items() if v is not None}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v) for v in obj]
+    return obj
+
+
+def _resolve_type(tp: Any) -> Any:
+    origin = get_origin(tp)
+    if origin is typing.Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        return args[0] if len(args) == 1 else None
+    return tp
+
+
+def from_dict(cls: Type[T], data: Optional[Dict[str, Any]]) -> T:
+    """Recursively build a dataclass from a camelCase dict. Unknown keys are
+    ignored (forward compatibility); missing keys fall back to defaults."""
+    if data is None:
+        data = {}
+    if not dataclasses.is_dataclass(cls):
+        return data  # type: ignore[return-value]
+    hints = typing.get_type_hints(cls)
+    kwargs: Dict[str, Any] = {}
+    field_names = {f.name for f in dataclasses.fields(cls)}
+    for key, value in data.items():
+        name = _snake(key)
+        if name not in field_names:
+            continue
+        tp = _resolve_type(hints.get(name))
+        origin = get_origin(tp)
+        if dataclasses.is_dataclass(tp) and isinstance(value, dict):
+            kwargs[name] = from_dict(tp, value)
+        elif origin in (list, tuple) and value is not None:
+            (elem,) = get_args(tp) or (Any,)
+            if dataclasses.is_dataclass(elem):
+                kwargs[name] = [from_dict(elem, v) for v in value]
+            else:
+                kwargs[name] = list(value)
+        else:
+            kwargs[name] = value
+    return cls(**kwargs)  # type: ignore[call-arg]

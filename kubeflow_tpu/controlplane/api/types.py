@@ -1,0 +1,281 @@
+"""The platform CRDs (group ``tpu.kubeflow.org``).
+
+TPU-native rebuilds of the reference's CRs:
+- TpuJob       — replaces TFJob + openmpi packaging (gang of workers on a
+                 TPU slice; reference contract: TF_CONFIG wiring in
+                 tf-controller-examples/tf-cnn/launcher.py:68-80 and the MPI
+                 sidecar lifecycle, components/openmpi-controller/)
+- Notebook     — components/notebook-controller/api/v1beta1/notebook_types.go:27-84
+- Profile      — components/profile-controller/api/v1/profile_types.go:38-68
+- PodDefault   — components/admission-webhook/pkg/apis/settings/v1alpha1/poddefault_types.go:27-87
+- Tensorboard  — components/tensorboard-controller/api/v1alpha1/tensorboard_types.go:26-56
+- PlatformConfig — the KfDef v1beta1 equivalent (bootstrap/cmd/bootstrap/
+                 app/kfctlServer.go:23-27)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from kubeflow_tpu.controlplane.api.core import Container, EnvVar, Volume, VolumeMount
+from kubeflow_tpu.controlplane.api.meta import Condition, ObjectMeta
+from kubeflow_tpu.controlplane.api.serde import from_dict
+
+GROUP = "tpu.kubeflow.org"
+VERSION = "v1alpha1"
+API_VERSION = f"{GROUP}/{VERSION}"
+
+
+# --------------------------------------------------------------------------
+# TpuJob
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class MeshAxesSpec:
+    """Logical parallelism request; validated against the slice topology by
+    the controller via kubeflow_tpu.topology.plan_mesh."""
+
+    dp: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+
+@dataclasses.dataclass
+class TpuJobSpec:
+    slice_type: str = "v5e-16"
+    num_slices: int = 1                 # >1 => multislice over DCN
+    mesh: MeshAxesSpec = dataclasses.field(default_factory=MeshAxesSpec)
+    attn_impl: str = "full"             # full | ring | ulysses
+    # Workload: either a registry model (framework-run) or a custom image.
+    model: str = ""                     # kubeflow_tpu.models registry name
+    image: str = ""
+    command: List[str] = dataclasses.field(default_factory=list)
+    args: List[str] = dataclasses.field(default_factory=list)
+    env: List[EnvVar] = dataclasses.field(default_factory=list)
+    # Checkpoint/resume contract (auto-resume on gang restart).
+    checkpoint_dir: str = ""
+    # Failure policy
+    max_restarts: int = 3
+    backoff_seconds: float = 10.0
+    # Scheduling
+    priority: int = 0
+    preemptible: bool = True
+
+
+@dataclasses.dataclass
+class TpuJobStatus:
+    phase: str = "Pending"  # Pending|Scheduling|Starting|Running|Restarting|Succeeded|Failed
+    conditions: List[Condition] = dataclasses.field(default_factory=list)
+    restarts: int = 0
+    # worker name -> pod phase
+    worker_states: Dict[str, str] = dataclasses.field(default_factory=dict)
+    coordinator_address: str = ""
+    slice_assignment: str = ""
+    start_time: float = 0.0
+    completion_time: float = 0.0
+    resumed_from_step: int = -1
+
+
+@dataclasses.dataclass
+class TpuJob:
+    api_version: str = API_VERSION
+    kind: str = "TpuJob"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: TpuJobSpec = dataclasses.field(default_factory=TpuJobSpec)
+    status: TpuJobStatus = dataclasses.field(default_factory=TpuJobStatus)
+
+
+# --------------------------------------------------------------------------
+# Notebook
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class NotebookSpec:
+    image: str = "kubeflow-tpu/jupyter:latest"
+    cpu: str = "2"
+    memory: str = "4Gi"
+    # Single-host TPU attachment (e.g. "v5e-8"); empty = CPU-only notebook.
+    tpu_slice: str = ""
+    env: List[EnvVar] = dataclasses.field(default_factory=list)
+    volumes: List[Volume] = dataclasses.field(default_factory=list)
+    volume_mounts: List[VolumeMount] = dataclasses.field(default_factory=list)
+    # PodDefault labels to match (spawner "configurations",
+    # jupyter-web-app .../utils.py:338-530)
+    pod_defaults: List[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class NotebookStatus:
+    conditions: List[Condition] = dataclasses.field(default_factory=list)
+    ready_replicas: int = 0
+    container_state: str = ""
+    last_activity: float = 0.0
+
+
+@dataclasses.dataclass
+class Notebook:
+    api_version: str = API_VERSION
+    kind: str = "Notebook"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: NotebookSpec = dataclasses.field(default_factory=NotebookSpec)
+    status: NotebookStatus = dataclasses.field(default_factory=NotebookStatus)
+
+
+# --------------------------------------------------------------------------
+# Profile
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ProfileSpec:
+    owner: str = ""                      # user email
+    # TPU-chip quota (reference used generic ResourceQuotaSpec,
+    # profile_controller.go:240-256)
+    tpu_chip_quota: int = 0
+    resource_quota: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ProfileStatus:
+    phase: str = ""
+    conditions: List[Condition] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Profile:
+    api_version: str = API_VERSION
+    kind: str = "Profile"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: ProfileSpec = dataclasses.field(default_factory=ProfileSpec)
+    status: ProfileStatus = dataclasses.field(default_factory=ProfileStatus)
+
+
+# --------------------------------------------------------------------------
+# PodDefault
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class PodDefaultSpec:
+    # Pods whose labels match ALL of selector are mutated
+    # (admission-webhook/main.go:69-95).
+    selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    desc: str = ""
+    env: List[EnvVar] = dataclasses.field(default_factory=list)
+    volumes: List[Volume] = dataclasses.field(default_factory=list)
+    volume_mounts: List[VolumeMount] = dataclasses.field(default_factory=list)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class PodDefault:
+    api_version: str = API_VERSION
+    kind: str = "PodDefault"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: PodDefaultSpec = dataclasses.field(default_factory=PodDefaultSpec)
+
+
+# --------------------------------------------------------------------------
+# Tensorboard
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class TensorboardSpec:
+    logspath: str = ""
+    # Surfacing JAX profiler traces (SURVEY.md §5 Tracing: absent in the
+    # reference, first-class here).
+    trace_dir: str = ""
+
+
+@dataclasses.dataclass
+class TensorboardStatus:
+    conditions: List[Condition] = dataclasses.field(default_factory=list)
+    ready: bool = False
+
+
+@dataclasses.dataclass
+class Tensorboard:
+    api_version: str = API_VERSION
+    kind: str = "Tensorboard"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: TensorboardSpec = dataclasses.field(default_factory=TensorboardSpec)
+    status: TensorboardStatus = dataclasses.field(
+        default_factory=TensorboardStatus
+    )
+
+
+# --------------------------------------------------------------------------
+# PlatformConfig (KfDef equivalent)
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ComponentConfig:
+    name: str = ""
+    enabled: bool = True
+    params: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class PlatformConfigSpec:
+    # Which controllers/services to run.
+    components: List[ComponentConfig] = dataclasses.field(default_factory=list)
+    # Default TPU topology section (SURVEY.md §5 Config: replaces GPU pickers).
+    default_slice_type: str = "v5e-16"
+    user_id_header: str = "x-goog-authenticated-user-email"
+    istio_gateway: str = "kubeflow/kubeflow-gateway"
+    cluster_domain: str = "cluster.local"
+
+
+@dataclasses.dataclass
+class PlatformConfigStatus:
+    phase: str = ""
+    applied_components: List[str] = dataclasses.field(default_factory=list)
+    conditions: List[Condition] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class PlatformConfig:
+    api_version: str = API_VERSION
+    kind: str = "PlatformConfig"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: PlatformConfigSpec = dataclasses.field(
+        default_factory=PlatformConfigSpec
+    )
+    status: PlatformConfigStatus = dataclasses.field(
+        default_factory=PlatformConfigStatus
+    )
+
+
+# --------------------------------------------------------------------------
+# Kind registry (for the API server and tpuctl YAML loading)
+# --------------------------------------------------------------------------
+
+from kubeflow_tpu.controlplane.api import core as _core  # noqa: E402
+
+KIND_REGISTRY: Dict[str, type] = {
+    "TpuJob": TpuJob,
+    "Notebook": Notebook,
+    "Profile": Profile,
+    "PodDefault": PodDefault,
+    "Tensorboard": Tensorboard,
+    "PlatformConfig": PlatformConfig,
+    "Pod": _core.Pod,
+    "Service": _core.Service,
+    "Namespace": _core.Namespace,
+    "ServiceAccount": _core.ServiceAccount,
+    "RoleBinding": _core.RoleBinding,
+    "ResourceQuota": _core.ResourceQuota,
+    "VirtualService": _core.VirtualService,
+    "AuthorizationPolicy": _core.AuthorizationPolicy,
+    "Event": _core.Event,
+}
+
+
+def object_from_dict(data: Dict[str, Any]):
+    kind = data.get("kind", "")
+    cls = KIND_REGISTRY.get(kind)
+    if cls is None:
+        raise ValueError(f"unknown kind {kind!r}; known: {sorted(KIND_REGISTRY)}")
+    return from_dict(cls, data)

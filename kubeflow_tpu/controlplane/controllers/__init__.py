@@ -1,0 +1,19 @@
+from kubeflow_tpu.controlplane.controllers.tpujob import TpuJobController
+from kubeflow_tpu.controlplane.controllers.notebook import NotebookController
+from kubeflow_tpu.controlplane.controllers.profile import ProfileController
+from kubeflow_tpu.controlplane.controllers.tensorboard import TensorboardController
+from kubeflow_tpu.controlplane.controllers.podrunner import FakeKubelet
+from kubeflow_tpu.controlplane.webhook.poddefault import (
+    PodDefaultMutator,
+    mutate_pod,
+)
+
+__all__ = [
+    "TpuJobController",
+    "NotebookController",
+    "ProfileController",
+    "TensorboardController",
+    "FakeKubelet",
+    "PodDefaultMutator",
+    "mutate_pod",
+]

@@ -1,0 +1,65 @@
+"""FakeKubelet: the compute-side test double (SURVEY.md §7.8).
+
+The reference has NO fake backend for compute — multi-node behaviour is
+only tested on real GKE clusters (SURVEY.md §4 point 3). This closes that
+gap: a controller that plays kubelet+scheduler for tests and local dev,
+moving pods Pending -> Running (honouring TPU capacity per node selector)
+and optionally completing/failing them per a script.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from kubeflow_tpu.controlplane.runtime import (
+    Controller,
+    InMemoryApiServer,
+    Result,
+)
+from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
+
+
+class FakeKubelet(Controller):
+    NAME = "fake-kubelet"
+    WATCH_KINDS = ("Pod",)
+
+    def __init__(
+        self,
+        api: InMemoryApiServer,
+        registry: MetricsRegistry = global_registry,
+        *,
+        # pod name predicate -> terminal phase ("Succeeded"/"Failed");
+        # pods not matched stay Running.
+        outcome: Optional[Callable[[str], Optional[str]]] = None,
+        auto_run: bool = True,
+    ):
+        super().__init__(api, registry)
+        self.outcome = outcome
+        self.auto_run = auto_run
+
+    def map_to_primary(self, obj):
+        return (obj.metadata.namespace, obj.metadata.name)
+
+    def tick(self) -> None:
+        """Simulate a kubelet status-sync pass: re-reconcile every pod (the
+        outcome script may have changed). Tests call this, then drain the
+        manager to propagate the resulting watch events."""
+        for pod in self.api.list("Pod"):
+            self.reconcile(pod.metadata.namespace, pod.metadata.name)
+
+    def reconcile(self, namespace: str, name: str) -> Result:
+        pod = self.api.try_get("Pod", name, namespace)
+        if pod is None:
+            return Result()
+        if pod.status.phase == "Pending" and self.auto_run:
+            pod.status.phase = "Running"
+            pod.status.pod_ip = f"10.0.0.{abs(hash(name)) % 250 + 1}"
+            pod.status.node_name = f"node-{abs(hash(name)) % 16}"
+            self.api.update_status(pod)
+            return Result()
+        if pod.status.phase == "Running" and self.outcome is not None:
+            term = self.outcome(name)
+            if term in ("Succeeded", "Failed"):
+                pod.status.phase = term
+                self.api.update_status(pod)
+        return Result()

@@ -1,0 +1,150 @@
+"""Profile controller: per-user namespace + RBAC + quota.
+
+Mirrors components/profile-controller/controllers/profile_controller.go:100-310:
+namespace with owner annotation + istio-injection label (:121-186),
+ServiceAccounts default-editor/default-viewer bound to kubeflow-edit/
+kubeflow-view (:196-212), owner admin RoleBinding (:216-239), ResourceQuota
+(:240-256), plus a modern AuthorizationPolicy instead of the deprecated
+ServiceRole pair (:188-194; SURVEY.md §7 hardest-parts item 4).
+
+TPU twist: Profile.spec.tpu_chip_quota emits a google.com/tpu ResourceQuota
+that the TpuJob controller's gang admission enforces.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.controlplane.api.core import (
+    AuthorizationPolicy,
+    Namespace,
+    ResourceQuota,
+    RoleBinding,
+    RoleRef,
+    ServiceAccount,
+    Subject,
+)
+from kubeflow_tpu.controlplane.api.meta import (
+    Condition,
+    ObjectMeta,
+    OwnerReference,
+    set_condition,
+)
+from kubeflow_tpu.controlplane.runtime import (
+    Controller,
+    InMemoryApiServer,
+    Result,
+    create_or_update,
+)
+from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
+
+OWNER_ANNOTATION = "owner"
+ADMIN_CLUSTER_ROLE = "kubeflow-admin"
+EDIT_CLUSTER_ROLE = "kubeflow-edit"
+VIEW_CLUSTER_ROLE = "kubeflow-view"
+
+
+class ProfileController(Controller):
+    NAME = "profile"
+    WATCH_KINDS = ("Profile", "Namespace", "RoleBinding")
+
+    def __init__(self, api: InMemoryApiServer,
+                 registry: MetricsRegistry = global_registry,
+                 *, user_id_header: str = "x-goog-authenticated-user-email"):
+        super().__init__(api, registry)
+        self.user_id_header = user_id_header
+
+    def map_to_primary(self, obj):
+        # Namespaces/RoleBindings created for a profile carry its name.
+        if obj.kind == "Namespace":
+            return ("", obj.metadata.name)
+        return super().map_to_primary(obj) or (
+            ("", obj.metadata.namespace) if obj.kind == "RoleBinding" else None
+        )
+
+    def reconcile(self, namespace: str, name: str) -> Result:
+        profile = self.api.try_get("Profile", name)
+        if profile is None or profile.metadata.deletion_timestamp is not None:
+            return Result()
+        owner = OwnerReference(kind="Profile", name=name,
+                               uid=profile.metadata.uid)
+
+        ns = Namespace(
+            metadata=ObjectMeta(
+                name=name,
+                annotations={OWNER_ANNOTATION: profile.spec.owner},
+                labels={"istio-injection": "enabled",
+                        "app.kubernetes.io/part-of": "kubeflow-tpu-profile"},
+                owner_references=[owner],
+            ),
+        )
+        create_or_update(self.api, ns, copy_fields=self._ns_copy)
+
+        for sa_name in ("default-editor", "default-viewer"):
+            create_or_update(self.api, ServiceAccount(
+                metadata=ObjectMeta(name=sa_name, namespace=name,
+                                    owner_references=[owner]),
+            ))
+        create_or_update(self.api, RoleBinding(
+            metadata=ObjectMeta(name="default-editor", namespace=name,
+                                owner_references=[owner]),
+            subjects=[Subject(kind="ServiceAccount", name="default-editor")],
+            role_ref=RoleRef(name=EDIT_CLUSTER_ROLE),
+        ))
+        create_or_update(self.api, RoleBinding(
+            metadata=ObjectMeta(name="default-viewer", namespace=name,
+                                owner_references=[owner]),
+            subjects=[Subject(kind="ServiceAccount", name="default-viewer")],
+            role_ref=RoleRef(name=VIEW_CLUSTER_ROLE),
+        ))
+        # Owner becomes namespace admin (reference :216-239).
+        create_or_update(self.api, RoleBinding(
+            metadata=ObjectMeta(name="namespaceAdmin", namespace=name,
+                                owner_references=[owner]),
+            subjects=[Subject(kind="User", name=profile.spec.owner)],
+            role_ref=RoleRef(name=ADMIN_CLUSTER_ROLE),
+        ))
+        # Istio-level access for the owner.
+        create_or_update(self.api, AuthorizationPolicy(
+            metadata=ObjectMeta(name=f"ns-owner-access-istio",
+                                namespace=name, owner_references=[owner]),
+            principals=[profile.spec.owner],
+            user_id_header=self.user_id_header,
+        ))
+
+        hard = dict(profile.spec.resource_quota)
+        if profile.spec.tpu_chip_quota > 0:
+            hard["google.com/tpu"] = str(profile.spec.tpu_chip_quota)
+        if hard:
+            create_or_update(self.api, ResourceQuota(
+                metadata=ObjectMeta(name="kf-resource-quota", namespace=name,
+                                    owner_references=[owner]),
+                hard=hard,
+            ), copy_fields=self._quota_copy)
+
+        if profile.status.phase != "Ready":
+            profile.status.phase = "Ready"
+            profile.status.conditions = set_condition(
+                profile.status.conditions,
+                Condition(type="Ready", status="True", reason="Reconciled",
+                          message=f"namespace {name} provisioned"),
+            )
+            self.api.update_status(profile)
+        return Result()
+
+    @staticmethod
+    def _ns_copy(live: Namespace, want: Namespace) -> bool:
+        changed = False
+        for field in ("labels", "annotations"):
+            want_map = getattr(want.metadata, field)
+            live_map = getattr(live.metadata, field)
+            merged = {**live_map, **want_map}
+            if merged != live_map:
+                setattr(live.metadata, field, merged)
+                changed = True
+        return changed
+
+    @staticmethod
+    def _quota_copy(live: ResourceQuota, want: ResourceQuota) -> bool:
+        if live.hard != want.hard:
+            live.hard = want.hard
+            return True
+        return False

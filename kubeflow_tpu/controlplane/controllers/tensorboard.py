@@ -1,0 +1,105 @@
+"""Tensorboard controller: Tensorboard CR -> Pod + Service + VirtualService
+at /tensorboard/<ns>/<name>/.
+
+Mirrors components/tensorboard-controller/controllers/
+tensorboard_controller.go:54-277. TPU twist (SURVEY.md §5 Tracing): the CR
+carries ``trace_dir`` so a board can serve JAX profiler traces captured by
+TpuJob workers — the tracing surface the reference lacks entirely.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.controlplane.api.core import (
+    Container,
+    EnvVar,
+    HttpRoute,
+    Pod,
+    PodSpec,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    VirtualService,
+)
+from kubeflow_tpu.controlplane.api.meta import (
+    Condition,
+    ObjectMeta,
+    OwnerReference,
+    set_condition,
+)
+from kubeflow_tpu.controlplane.runtime import (
+    Controller,
+    InMemoryApiServer,
+    Result,
+    create_or_update,
+)
+
+TB_PORT = 6006
+
+
+class TensorboardController(Controller):
+    NAME = "tensorboard"
+    WATCH_KINDS = ("Tensorboard", "Pod")
+
+    def __init__(self, api: InMemoryApiServer, registry=None, *,
+                 istio_gateway: str = "kubeflow/kubeflow-gateway"):
+        from kubeflow_tpu.utils.monitoring import global_registry
+
+        super().__init__(api, registry or global_registry)
+        self.istio_gateway = istio_gateway
+
+    def reconcile(self, namespace: str, name: str) -> Result:
+        tb = self.api.try_get("Tensorboard", name, namespace)
+        if tb is None or tb.metadata.deletion_timestamp is not None:
+            return Result()
+        owner = OwnerReference(kind="Tensorboard", name=name, uid=tb.metadata.uid)
+
+        logdir = tb.spec.logspath
+        args = [f"--logdir={logdir}", f"--path_prefix=/tensorboard/{namespace}/{name}/"]
+        if tb.spec.trace_dir:
+            args.append(f"--load_fast=false")
+        pod = Pod(
+            metadata=ObjectMeta(
+                name=f"{name}-tb", namespace=namespace,
+                labels={"app": "tensorboard", "tb-name": name},
+                owner_references=[owner],
+            ),
+            spec=PodSpec(containers=[Container(
+                name="tensorboard",
+                image="kubeflow-tpu/tensorboard:latest",
+                command=["tensorboard"],
+                args=args,
+                env=[EnvVar("KFTPU_TRACE_DIR", tb.spec.trace_dir)],
+                ports=[TB_PORT],
+                resources={"cpu": "1", "memory": "2Gi"},
+            )]),
+        )
+        create_or_update(self.api, pod, copy_fields=lambda a, b: False)
+        create_or_update(self.api, Service(
+            metadata=ObjectMeta(name=f"{name}-tb", namespace=namespace,
+                                owner_references=[owner]),
+            spec=ServiceSpec(selector={"tb-name": name},
+                             ports=[ServicePort(name="http", port=80,
+                                                target_port=TB_PORT)]),
+        ))
+        create_or_update(self.api, VirtualService(
+            metadata=ObjectMeta(name=f"tensorboard-{name}", namespace=namespace,
+                                owner_references=[owner]),
+            gateways=[self.istio_gateway],
+            hosts=["*"],
+            http=[HttpRoute(prefix=f"/tensorboard/{namespace}/{name}/",
+                            rewrite="/",
+                            destination_host=f"{name}-tb.{namespace}.svc.cluster.local",
+                            destination_port=80)],
+        ))
+
+        live_pod = self.api.try_get("Pod", f"{name}-tb", namespace)
+        ready = live_pod is not None and live_pod.status.phase == "Running"
+        if tb.status.ready != ready:
+            tb.status.ready = ready
+            tb.status.conditions = set_condition(
+                tb.status.conditions,
+                Condition(type="Ready", status="True" if ready else "False",
+                          reason=live_pod.status.phase if live_pod else "NoPod"),
+            )
+            self.api.update_status(tb)
+        return Result()

@@ -1,0 +1,386 @@
+"""TpuJob controller: gang-schedules a training job onto a TPU slice.
+
+The platform's core new CRD (SURVEY.md §7.3), replacing the reference's
+TFJob+openmpi pair. Differences by design:
+
+- The unit of scheduling is a *slice* (ICI domain), not N interchangeable
+  GPU pods. One worker pod per TPU-VM host, all-or-nothing.
+- Worker wiring is the JAX distributed contract (coordinator address +
+  process id + process count env) instead of TF_CONFIG's cluster JSON
+  (reference: tf-controller-examples/tf-cnn/launcher.py:68-80) or the MPI
+  sidecar's file signals (components/openmpi-controller/controller/
+  controller.py:9-14).
+- Placement is expressed as GKE TPU node selectors derived from the typed
+  slice catalogue — replacing nvidia.com/gpu limits
+  (jupyter-web-app .../utils.py:390-443).
+- Failure policy is gang-level: any worker failing restarts the whole gang
+  from the latest checkpoint (auto-resume contract of
+  kubeflow_tpu.train.CheckpointService), up to max_restarts — the
+  preemption story TPU pods require (SURVEY.md §5 Failure detection).
+- Multislice (num_slices > 1) adds the DCN/megascale env so XLA routes
+  inter-slice collectives over DCN.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+from kubeflow_tpu.controlplane.api.core import (
+    Container,
+    EnvVar,
+    Pod,
+    PodSpec,
+    Service,
+    ServicePort,
+    ServiceSpec,
+)
+from kubeflow_tpu.controlplane.api.meta import (
+    Condition,
+    ObjectMeta,
+    OwnerReference,
+    set_condition,
+)
+from kubeflow_tpu.controlplane.api.types import TpuJob
+from kubeflow_tpu.controlplane.runtime import (
+    Controller,
+    EventRecorder,
+    InMemoryApiServer,
+    Result,
+    create_or_update,
+)
+from kubeflow_tpu.topology import AxisSpec, get_slice, plan_mesh
+from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
+
+COORDINATOR_PORT = 8476
+JOB_LABEL = "tpu.kubeflow.org/job-name"
+REPLICA_LABEL = "tpu.kubeflow.org/replica-index"
+
+
+class TpuJobController(Controller):
+    NAME = "tpujob"
+    WATCH_KINDS = ("TpuJob", "Pod")
+
+    def __init__(
+        self,
+        api: InMemoryApiServer,
+        registry: MetricsRegistry = global_registry,
+        *,
+        # Schedulable capacity: slice_type -> number of concurrently
+        # allocatable slices. None = unbounded (tests / single-tenant).
+        capacity: Optional[Dict[str, int]] = None,
+    ):
+        super().__init__(api, registry)
+        self.capacity = capacity
+        self.recorder = EventRecorder(api, self.NAME)
+        self.metrics_restarts = registry.counter(
+            "kftpu_tpujob_gang_restarts_total", "Gang restarts", ("reason",)
+        )
+
+    # ------------- naming -------------
+
+    @staticmethod
+    def worker_name(job: str, i: int) -> str:
+        return f"{job}-worker-{i}"
+
+    @staticmethod
+    def service_name(job: str) -> str:
+        return f"{job}-workers"
+
+    # ------------- reconcile -------------
+
+    def reconcile(self, namespace: str, name: str) -> Result:
+        job = self.api.try_get("TpuJob", name, namespace)
+        if job is None:
+            return Result()  # cascade GC removed dependents
+        if job.metadata.deletion_timestamp is not None:
+            return Result()
+        if job.status.phase in ("Succeeded", "Failed"):
+            return Result()
+
+        # 1. Validate the topology request.
+        try:
+            st = get_slice(job.spec.slice_type)
+            m = job.spec.mesh
+            plan = plan_mesh(
+                st,
+                AxisSpec(dp=m.dp, fsdp=m.fsdp, tp=m.tp, sp=m.sp, ep=m.ep),
+            )
+        except (KeyError, ValueError) as e:
+            return self._fail_invalid(job, str(e))
+
+        # 2. Quota + capacity gates (gang admission: all or nothing).
+        blocked = self._admission_blocked(job, st)
+        if blocked:
+            import copy
+
+            prev = copy.deepcopy(job.status)
+            job.status.phase = "Pending"
+            job.status.conditions = set_condition(
+                job.status.conditions,
+                Condition(type="Admitted", status="False", reason=blocked[0],
+                          message=blocked[1]),
+            )
+            if job.status != prev:
+                self.api.update_status(job)
+            return Result(requeue_after=5.0)
+
+        n_hosts = st.num_hosts * job.spec.num_slices
+
+        # 3. Headless service for gang DNS (worker-0 is the coordinator;
+        # the reference used one headless service per TFJob replica).
+        svc = Service(
+            metadata=ObjectMeta(
+                name=self.service_name(name), namespace=namespace,
+                labels={JOB_LABEL: name},
+                owner_references=[self._owner_ref(job)],
+            ),
+            spec=ServiceSpec(
+                selector={JOB_LABEL: name},
+                cluster_ip="None",
+                ports=[ServicePort(name="coordinator",
+                                   port=COORDINATOR_PORT,
+                                   target_port=COORDINATOR_PORT)],
+            ),
+        )
+        create_or_update(self.api, svc)
+
+        coordinator = (
+            f"{self.worker_name(name, 0)}.{self.service_name(name)}"
+            f".{namespace}:{COORDINATOR_PORT}"
+        )
+
+        # 4. Gang pods: one per TPU-VM host.
+        for i in range(n_hosts):
+            pod = self._worker_pod(job, st, plan, i, n_hosts, coordinator)
+            create_or_update(self.api, pod, copy_fields=self._pod_copy)
+
+        # 5. Aggregate status.
+        return self._update_status(job, n_hosts, coordinator)
+
+    # ------------- admission -------------
+
+    def _admission_blocked(self, job: TpuJob, st) -> Optional[tuple]:
+        chips = st.num_chips * job.spec.num_slices
+        # Per-namespace TPU chip quota from ResourceQuota (emitted by the
+        # profile controller from Profile.spec.tpu_chip_quota).
+        for rq in self.api.list("ResourceQuota", namespace=job.metadata.namespace):
+            hard = int(rq.hard.get("google.com/tpu", "0") or 0)
+            if hard <= 0:
+                continue
+            used = 0
+            for other in self.api.list("TpuJob", namespace=job.metadata.namespace):
+                if other.metadata.name == job.metadata.name:
+                    continue
+                if other.status.phase in (
+                    "Scheduling", "Starting", "Running", "Restarting"
+                ):
+                    try:
+                        used += (
+                            get_slice(other.spec.slice_type).num_chips
+                            * other.spec.num_slices
+                        )
+                    except KeyError:
+                        pass
+            if used + chips > hard:
+                return (
+                    "QuotaExceeded",
+                    f"needs {chips} chips, {hard - used} available in quota",
+                )
+        # Cluster slice capacity.
+        if self.capacity is not None:
+            cap = self.capacity.get(job.spec.slice_type, 0)
+            in_use = sum(
+                o.spec.num_slices
+                for o in self.api.list("TpuJob")
+                if o.metadata.uid != job.metadata.uid
+                and o.spec.slice_type == job.spec.slice_type
+                and o.status.phase in (
+                    "Scheduling", "Starting", "Running", "Restarting"
+                )
+            )
+            if in_use + job.spec.num_slices > cap:
+                return (
+                    "InsufficientCapacity",
+                    f"{in_use}/{cap} {job.spec.slice_type} slices in use",
+                )
+        return None
+
+    # ------------- pod template -------------
+
+    def _owner_ref(self, job: TpuJob) -> OwnerReference:
+        return OwnerReference(
+            kind="TpuJob", name=job.metadata.name, uid=job.metadata.uid
+        )
+
+    def _worker_pod(
+        self, job: TpuJob, st, plan, index: int, n_hosts: int, coordinator: str
+    ) -> Pod:
+        name = job.metadata.name
+        mesh_json = json.dumps(plan.axes.as_dict())
+        slice_id = index // st.num_hosts
+        env = [
+            EnvVar("KFTPU_COORDINATOR_ADDRESS", coordinator),
+            EnvVar("KFTPU_NUM_PROCESSES", str(n_hosts)),
+            EnvVar("KFTPU_PROCESS_ID", str(index)),
+            EnvVar("KFTPU_SLICE_TYPE", st.name),
+            EnvVar("KFTPU_MESH", mesh_json),
+            EnvVar("KFTPU_ATTN_IMPL", job.spec.attn_impl),
+            EnvVar("KFTPU_MODEL", job.spec.model),
+            EnvVar("KFTPU_CHECKPOINT_DIR", job.spec.checkpoint_dir),
+            EnvVar("KFTPU_RESTART_COUNT", str(job.status.restarts)),
+        ]
+        if job.spec.num_slices > 1:
+            # Multislice: DCN-routed inter-slice collectives (megascale).
+            env += [
+                EnvVar("MEGASCALE_NUM_SLICES", str(job.spec.num_slices)),
+                EnvVar("MEGASCALE_SLICE_ID", str(slice_id)),
+                EnvVar("MEGASCALE_COORDINATOR_ADDRESS", coordinator),
+            ]
+        env += list(job.spec.env)
+
+        container = Container(
+            name="worker",
+            image=job.spec.image or "kubeflow-tpu/runtime:latest",
+            command=list(job.spec.command)
+            or ["python", "-m", "kubeflow_tpu.train.runner"],
+            args=list(job.spec.args),
+            env=env,
+            ports=[COORDINATOR_PORT],
+            resources={
+                st.resource_name(): str(st.chips_per_host),
+                "memory": "64Gi",
+            },
+        )
+        return Pod(
+            metadata=ObjectMeta(
+                name=self.worker_name(name, index),
+                namespace=job.metadata.namespace,
+                labels={
+                    JOB_LABEL: name,
+                    REPLICA_LABEL: str(index),
+                    "restart-generation": str(job.status.restarts),
+                },
+                owner_references=[self._owner_ref(job)],
+            ),
+            spec=PodSpec(
+                containers=[container],
+                node_selector=st.node_selectors(),
+                restart_policy="Never",
+                subdomain=self.service_name(name),
+                hostname=self.worker_name(name, index),
+                scheduler_hints={
+                    "slice-group": f"{name}-{slice_id}",
+                    "gang-size": str(n_hosts),
+                },
+            ),
+        )
+
+    @staticmethod
+    def _pod_copy(live: Pod, want: Pod) -> bool:
+        """Pods are mostly immutable; only re-label (restart-generation is
+        how a gang restart invalidates old pods)."""
+        changed = False
+        if live.metadata.labels != want.metadata.labels:
+            live.metadata.labels = want.metadata.labels
+            changed = True
+        return changed
+
+    # ------------- status -------------
+
+    def _update_status(self, job: TpuJob, n_hosts: int, coordinator: str) -> Result:
+        import copy
+
+        pods = self.api.list(
+            "Pod", namespace=job.metadata.namespace,
+            label_selector={JOB_LABEL: job.metadata.name},
+        )
+        states = {p.metadata.name: p.status.phase for p in pods}
+        prev_status = copy.deepcopy(job.status)
+        job.status.worker_states = states
+        job.status.coordinator_address = coordinator
+        job.status.slice_assignment = (
+            f"{job.spec.slice_type}x{job.spec.num_slices}"
+        )
+
+        phases = list(states.values())
+        n_running = sum(1 for p in phases if p == "Running")
+        n_failed = sum(1 for p in phases if p == "Failed")
+        n_succeeded = sum(1 for p in phases if p == "Succeeded")
+
+        requeue: Optional[float] = None
+        if n_failed > 0:
+            if job.status.restarts < job.spec.max_restarts:
+                # Gang restart: tear down every worker; next reconcile
+                # recreates them with a bumped restart-generation. Workers
+                # auto-resume from spec.checkpoint_dir (train.CheckpointService
+                # restore-latest contract).
+                job.status.restarts += 1
+                job.status.phase = "Restarting"
+                self.metrics_restarts.inc(reason="worker-failed")
+                self.recorder.event(
+                    job, "Warning", "GangRestart",
+                    f"worker failure; restart {job.status.restarts}/"
+                    f"{job.spec.max_restarts}, resuming from "
+                    f"{job.spec.checkpoint_dir or 'scratch'}",
+                )
+                for p in pods:
+                    self.api.delete("Pod", p.metadata.name, p.metadata.namespace)
+                requeue = job.spec.backoff_seconds
+            else:
+                job.status.phase = "Failed"
+                job.status.completion_time = time.time()
+                self.recorder.event(
+                    job, "Warning", "JobFailed",
+                    f"exceeded max_restarts={job.spec.max_restarts}",
+                )
+        elif len(phases) == n_hosts and n_succeeded == n_hosts:
+            job.status.phase = "Succeeded"
+            job.status.completion_time = time.time()
+            self.recorder.event(job, "Normal", "JobSucceeded", "all workers done")
+        elif len(phases) == n_hosts and n_running == n_hosts:
+            job.status.phase = "Running"
+            if job.status.start_time == 0.0:
+                job.status.start_time = time.time()
+                self.recorder.event(
+                    job, "Normal", "GangRunning",
+                    f"{n_hosts} workers on {job.status.slice_assignment}",
+                )
+        elif job.status.phase == "Restarting" and len(phases) < n_hosts:
+            requeue = 0.5  # pods still terminating; recreate next pass
+        else:
+            job.status.phase = "Starting"
+
+        job.status.conditions = set_condition(
+            job.status.conditions,
+            Condition(
+                type="Admitted", status="True", reason="Scheduled",
+                message=job.status.slice_assignment,
+            ),
+        )
+        job.status.conditions = set_condition(
+            job.status.conditions,
+            Condition(
+                type="Running",
+                status="True" if job.status.phase == "Running" else "False",
+                reason=job.status.phase,
+                message=f"{n_running}/{n_hosts} workers running",
+            ),
+        )
+        # Write only on real change: an unconditional status write would emit
+        # MODIFIED on every reconcile and livelock the watch loop.
+        if job.status != prev_status:
+            self.api.update_status(job)
+        return Result(requeue_after=requeue)
+
+    def _fail_invalid(self, job: TpuJob, msg: str) -> Result:
+        job.status.phase = "Failed"
+        job.status.conditions = set_condition(
+            job.status.conditions,
+            Condition(type="Admitted", status="False",
+                      reason="InvalidTopology", message=msg),
+        )
+        self.api.update_status(job)
+        self.recorder.event(job, "Warning", "InvalidTopology", msg)
+        return Result()

@@ -1,0 +1,27 @@
+from kubeflow_tpu.controlplane.runtime.apiserver import (
+    ApiError,
+    ConflictError,
+    InMemoryApiServer,
+    NotFoundError,
+    WatchEvent,
+)
+from kubeflow_tpu.controlplane.runtime.reconciler import (
+    Controller,
+    ControllerManager,
+    Result,
+    create_or_update,
+)
+from kubeflow_tpu.controlplane.runtime.events import EventRecorder
+
+__all__ = [
+    "ApiError",
+    "ConflictError",
+    "InMemoryApiServer",
+    "NotFoundError",
+    "WatchEvent",
+    "Controller",
+    "ControllerManager",
+    "Result",
+    "create_or_update",
+    "EventRecorder",
+]

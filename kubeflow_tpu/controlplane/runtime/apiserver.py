@@ -1,0 +1,258 @@
+"""In-memory API server: typed object store with watch, optimistic
+concurrency, finalizers and owner-reference garbage collection.
+
+This is the platform's envtest analogue — the reference tests controllers
+against a real etcd+apiserver spun up per suite (components/
+profile-controller/controllers/suite_test.go:50-72); we provide the same
+semantics in-process so every controller test runs in milliseconds, and the
+store's interface is the seam where a real K8s client is substituted in a
+cluster deployment.
+
+Semantics implemented (the subset the reference's controllers rely on):
+- resourceVersion bump on every write; update with a stale version raises
+  ConflictError (optimistic concurrency, the retry-on-conflict loops in
+  profile_controller.go:150-154).
+- delete with finalizers present only sets deletionTimestamp; the object
+  goes away when the last finalizer is removed (plugin teardown,
+  profile_controller.go Reconcile finalizer path).
+- ownerReferences cascade: deleting an owner deletes its dependents
+  (how STS->pods and job->pods cleanup behaves for the reference).
+- label-selector list; namespaced and cluster-scoped kinds.
+- watch: per-subscriber queues receiving ADDED/MODIFIED/DELETED events.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from kubeflow_tpu.controlplane.api.meta import fresh_identity
+
+CLUSTER_SCOPED = {"Namespace", "Profile", "PlatformConfig"}
+
+
+class ApiError(Exception):
+    pass
+
+
+class NotFoundError(ApiError):
+    pass
+
+
+class AlreadyExistsError(ApiError):
+    pass
+
+
+class ConflictError(ApiError):
+    pass
+
+
+@dataclasses.dataclass
+class WatchEvent:
+    type: str          # ADDED | MODIFIED | DELETED
+    object: Any
+
+
+Key = Tuple[str, str, str]  # (kind, namespace, name)
+
+
+def _key(obj: Any) -> Key:
+    kind = obj.kind
+    ns = "" if kind in CLUSTER_SCOPED else obj.metadata.namespace
+    return (kind, ns, obj.metadata.name)
+
+
+class InMemoryApiServer:
+    def __init__(self) -> None:
+        self._objects: Dict[Key, Any] = {}
+        self._rv = 0
+        self._lock = threading.RLock()
+        self._watchers: List[Tuple[Optional[str], "queue.Queue[WatchEvent]"]] = []
+        # Admission mutators run on create (the PodDefault webhook seam,
+        # admission-webhook/main.go:389-470).
+        self._mutators: List[Callable[[Any], Any]] = []
+
+    # ----------------- helpers -----------------
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    def _notify(self, event: WatchEvent) -> None:
+        for kind, q in list(self._watchers):
+            if kind is None or kind == event.object.kind:
+                q.put(event)
+
+    def register_mutator(self, fn: Callable[[Any], Any]) -> None:
+        with self._lock:
+            self._mutators.append(fn)
+
+    # ----------------- CRUD -----------------
+
+    def create(self, obj: Any) -> Any:
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            if not obj.metadata.name:
+                raise ApiError(f"{obj.kind}: metadata.name required")
+            if obj.kind not in CLUSTER_SCOPED and not obj.metadata.namespace:
+                raise ApiError(f"{obj.kind}/{obj.metadata.name}: namespace required")
+            key = _key(obj)
+            if key in self._objects:
+                raise AlreadyExistsError(f"{key} already exists")
+            for m in self._mutators:
+                out = m(obj)
+                if out is not None:
+                    obj = out
+            fresh_identity(obj.metadata)
+            obj.metadata.resource_version = self._next_rv()
+            obj.metadata.generation = 1
+            self._objects[key] = obj
+            out = copy.deepcopy(obj)
+        self._notify(WatchEvent("ADDED", copy.deepcopy(obj)))
+        return out
+
+    def get(self, kind: str, name: str, namespace: str = "") -> Any:
+        with self._lock:
+            ns = "" if kind in CLUSTER_SCOPED else namespace
+            obj = self._objects.get((kind, ns, name))
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def try_get(self, kind: str, name: str, namespace: str = "") -> Optional[Any]:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFoundError:
+            return None
+
+    def update(self, obj: Any) -> Any:
+        with self._lock:
+            key = _key(obj)
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFoundError(f"{key} not found")
+            if obj.metadata.resource_version != cur.metadata.resource_version:
+                raise ConflictError(
+                    f"{key}: resourceVersion {obj.metadata.resource_version} "
+                    f"!= {cur.metadata.resource_version}"
+                )
+            obj = copy.deepcopy(obj)
+            # Identity fields are server-owned.
+            obj.metadata.uid = cur.metadata.uid
+            obj.metadata.creation_timestamp = cur.metadata.creation_timestamp
+            obj.metadata.resource_version = self._next_rv()
+            if self._spec_changed(cur, obj):
+                obj.metadata.generation = cur.metadata.generation + 1
+            self._objects[key] = obj
+
+            if (
+                obj.metadata.deletion_timestamp is not None
+                and not obj.metadata.finalizers
+            ):
+                del self._objects[key]
+                out = copy.deepcopy(obj)
+                self._notify(WatchEvent("DELETED", copy.deepcopy(obj)))
+                self._cascade_delete(obj)
+                return out
+            out = copy.deepcopy(obj)
+        self._notify(WatchEvent("MODIFIED", copy.deepcopy(obj)))
+        return out
+
+    @staticmethod
+    def _spec_changed(a: Any, b: Any) -> bool:
+        sa = getattr(a, "spec", None)
+        sb = getattr(b, "spec", None)
+        return sa != sb
+
+    def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        with self._lock:
+            ns = "" if kind in CLUSTER_SCOPED else namespace
+            key = (kind, ns, name)
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFoundError(f"{key} not found")
+            if cur.metadata.finalizers:
+                if cur.metadata.deletion_timestamp is None:
+                    cur = copy.deepcopy(cur)
+                    cur.metadata.deletion_timestamp = time.time()
+                    cur.metadata.resource_version = self._next_rv()
+                    self._objects[key] = cur
+                    self._notify(WatchEvent("MODIFIED", copy.deepcopy(cur)))
+                return
+            del self._objects[key]
+            obj = cur
+        self._notify(WatchEvent("DELETED", copy.deepcopy(obj)))
+        self._cascade_delete(obj)
+
+    def _cascade_delete(self, owner: Any) -> None:
+        """Delete dependents referencing the owner's uid."""
+        uid = owner.metadata.uid
+        with self._lock:
+            dependents = [
+                o for o in self._objects.values()
+                if any(r.uid == uid for r in o.metadata.owner_references)
+            ]
+        for dep in dependents:
+            try:
+                self.delete(dep.kind, dep.metadata.name, dep.metadata.namespace)
+            except NotFoundError:
+                pass
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Any]:
+        with self._lock:
+            out = []
+            for (k, ns, _), obj in self._objects.items():
+                if k != kind:
+                    continue
+                if namespace is not None and kind not in CLUSTER_SCOPED \
+                        and ns != namespace:
+                    continue
+                if label_selector and not all(
+                    obj.metadata.labels.get(lk) == lv
+                    for lk, lv in label_selector.items()
+                ):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return sorted(out, key=lambda o: (o.metadata.namespace, o.metadata.name))
+
+    # ----------------- status + finalizer conveniences -----------------
+
+    def update_status(self, obj: Any) -> Any:
+        """Update ONLY the status subresource (concurrent spec writes win)."""
+        with self._lock:
+            key = _key(obj)
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFoundError(f"{key} not found")
+            new = copy.deepcopy(cur)
+            new.status = copy.deepcopy(obj.status)
+            new.metadata.resource_version = self._next_rv()
+            self._objects[key] = new
+            out = copy.deepcopy(new)
+        self._notify(WatchEvent("MODIFIED", copy.deepcopy(new)))
+        return out
+
+    # ----------------- watch -----------------
+
+    def watch(self, kind: Optional[str] = None) -> "queue.Queue[WatchEvent]":
+        q: "queue.Queue[WatchEvent]" = queue.Queue()
+        with self._lock:
+            # Replay current state so late watchers converge (informer-style).
+            for obj in self._objects.values():
+                if kind is None or obj.kind == kind:
+                    q.put(WatchEvent("ADDED", copy.deepcopy(obj)))
+            self._watchers.append((kind, q))
+        return q
+
+    def stop_watch(self, q: "queue.Queue[WatchEvent]") -> None:
+        with self._lock:
+            self._watchers = [(k, w) for (k, w) in self._watchers if w is not q]

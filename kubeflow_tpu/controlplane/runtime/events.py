@@ -1,0 +1,43 @@
+"""Event recorder: CR-attached events as UX, the reference's pattern of
+re-emitting pod events onto owning CRs (notebook_controller.go:86-105) and
+JWA folding events into status (jupyter .../utils.py:262-335)."""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any
+
+from kubeflow_tpu.controlplane.api.core import Event
+from kubeflow_tpu.controlplane.api.meta import ObjectMeta
+from kubeflow_tpu.controlplane.runtime.apiserver import InMemoryApiServer
+
+
+class EventRecorder:
+    def __init__(self, api: InMemoryApiServer, component: str):
+        self.api = api
+        self.component = component
+
+    def event(
+        self, obj: Any, type_: str, reason: str, message: str
+    ) -> Event:
+        ns = obj.metadata.namespace or "default"
+        ev = Event(
+            metadata=ObjectMeta(
+                name=f"{obj.metadata.name}.{uuid.uuid4().hex[:10]}",
+                namespace=ns,
+                labels={"component": self.component},
+            ),
+            involved_kind=obj.kind,
+            involved_name=obj.metadata.name,
+            involved_namespace=obj.metadata.namespace,
+            type=type_,
+            reason=reason,
+            message=message,
+        )
+        return self.api.create(ev)
+
+    def events_for(self, obj: Any):
+        return [
+            e for e in self.api.list("Event", namespace=obj.metadata.namespace)
+            if e.involved_kind == obj.kind and e.involved_name == obj.metadata.name
+        ]

@@ -1,0 +1,255 @@
+"""Reconciler kernel: work queue, watch wiring, create-or-update helpers.
+
+The controller harness every platform controller runs on, mirroring what
+the reference gets from controller-runtime plus its shared reconcilehelper
+(components/common/reconcilehelper/util.go: idempotent create-or-update with
+field-copy diffing) and the monitoring pattern every controller repeats
+(profile-controller/controllers/monitoring.go:24-78) — here the kernel
+provides metrics and heartbeat for free (SURVEY.md §7.2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kubeflow_tpu.controlplane.runtime.apiserver import (
+    ConflictError,
+    InMemoryApiServer,
+    NotFoundError,
+)
+from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
+
+
+@dataclasses.dataclass
+class Result:
+    requeue_after: Optional[float] = None   # seconds
+
+
+class Controller:
+    """Base class: subclasses set WATCH_KINDS and implement reconcile(key).
+
+    ``key`` is (namespace, name) of the primary kind (WATCH_KINDS[0]);
+    events on secondary kinds are mapped back to the primary via
+    ``map_to_primary`` (the reference's Watches+handler.EnqueueRequestsFrom
+    MapFunc wiring, notebook_controller.go:512-609).
+    """
+
+    NAME = "controller"
+    WATCH_KINDS: Tuple[str, ...] = ()
+
+    def __init__(self, api: InMemoryApiServer, registry: MetricsRegistry = global_registry):
+        self.api = api
+        self.log = get_logger(self.NAME)
+        self.metrics_reconcile = registry.counter(
+            f"kftpu_{self.NAME}_reconcile_total",
+            f"Reconcile outcomes for {self.NAME}",
+            labels=("result",),
+        )
+        self.heartbeat = registry.heartbeat(self.NAME)
+
+    # -- override points --
+
+    def reconcile(self, namespace: str, name: str) -> Result:
+        raise NotImplementedError
+
+    def map_to_primary(self, obj: Any) -> Optional[Tuple[str, str]]:
+        """Map a secondary-kind object to the primary key. Default: follow
+        the controller ownerReference (by name) or the job/notebook label."""
+        for ref in obj.metadata.owner_references:
+            if ref.kind == self.WATCH_KINDS[0]:
+                return (obj.metadata.namespace, ref.name)
+        return None
+
+
+class ControllerManager:
+    """Runs a set of controllers against one API server.
+
+    Two modes:
+    - ``run_until_idle()``: deterministic synchronous draining for tests and
+      tpuctl --wait (process events → reconcile → repeat until no work,
+      honouring due requeues). The analogue of envtest's eventually-
+      consistent assertions but without sleeps.
+    - ``start()/stop()``: background thread pumping the same loop, for
+      long-running services.
+    """
+
+    def __init__(self, api: InMemoryApiServer):
+        self.api = api
+        self.controllers: List[Controller] = []
+        self._queues: List[Any] = []
+        self._pending: List[Tuple[Controller, Tuple[str, str]]] = []
+        self._timers: List[Tuple[float, int, Controller, Tuple[str, str]]] = []
+        self._timer_seq = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self.log = get_logger("manager")
+
+    def register(self, ctl: Controller) -> None:
+        self.controllers.append(ctl)
+        for i, kind in enumerate(ctl.WATCH_KINDS):
+            q = self.api.watch(kind)
+            self._queues.append((ctl, i == 0, q))
+
+    # ------------- queue pumping -------------
+
+    def _drain_watches(self) -> int:
+        n = 0
+        for ctl, primary, q in self._queues:
+            while not q.empty():
+                ev = q.get()
+                n += 1
+                if primary:
+                    key = (ev.object.metadata.namespace, ev.object.metadata.name)
+                else:
+                    key = ctl.map_to_primary(ev.object)
+                if key is not None:
+                    self._enqueue(ctl, key)
+        return n
+
+    def _enqueue(self, ctl: Controller, key: Tuple[str, str]) -> None:
+        with self._lock:
+            if (ctl, key) not in self._pending:
+                self._pending.append((ctl, key))
+
+    def _due_timers(self) -> None:
+        now = time.time()
+        with self._lock:
+            while self._timers and self._timers[0][0] <= now:
+                _, _, ctl, key = heapq.heappop(self._timers)
+                if (ctl, key) not in self._pending:
+                    self._pending.append((ctl, key))
+
+    def _schedule(self, ctl: Controller, key: Tuple[str, str], after: float) -> None:
+        with self._lock:
+            self._timer_seq += 1
+            heapq.heappush(
+                self._timers, (time.time() + after, self._timer_seq, ctl, key)
+            )
+
+    def _process_one(self) -> bool:
+        with self._lock:
+            if not self._pending:
+                return False
+            ctl, key = self._pending.pop(0)
+        try:
+            res = ctl.reconcile(*key) or Result()
+            ctl.metrics_reconcile.inc(result="ok")
+            if res.requeue_after is not None:
+                self._schedule(ctl, key, res.requeue_after)
+        except ConflictError:
+            # Stale read: immediate requeue, the standard informer dance.
+            ctl.metrics_reconcile.inc(result="conflict")
+            self._enqueue(ctl, key)
+        except NotFoundError:
+            ctl.metrics_reconcile.inc(result="gone")
+        except Exception:
+            ctl.metrics_reconcile.inc(result="error")
+            ctl.log.error(
+                f"reconcile {key} failed:\n{traceback.format_exc()}"
+            )
+            self._schedule(ctl, key, 1.0)
+        ctl.heartbeat.beat()
+        return True
+
+    def run_until_idle(self, max_iterations: int = 10000, include_timers_within: float = 0.0) -> int:
+        """Drain watches + queue until no immediate work remains. Returns the
+        number of reconciles executed. Timers due within
+        ``include_timers_within`` seconds are fast-forwarded (lets tests
+        exercise requeue-after logic without sleeping)."""
+        done = 0
+        for _ in range(max_iterations):
+            self._drain_watches()
+            self._due_timers()
+            if include_timers_within > 0:
+                with self._lock:
+                    while self._timers and (
+                        self._timers[0][0] - time.time() <= include_timers_within
+                    ):
+                        _, _, ctl, key = heapq.heappop(self._timers)
+                        if (ctl, key) not in self._pending:
+                            self._pending.append((ctl, key))
+            if not self._process_one():
+                if self._drain_watches() == 0:
+                    return done
+                continue
+            done += 1
+        raise RuntimeError(
+            f"run_until_idle did not converge in {max_iterations} iterations "
+            "(reconcile livelock — controllers keep producing events)"
+        )
+
+    # ------------- background mode -------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                self._drain_watches()
+                self._due_timers()
+                if not self._process_one():
+                    time.sleep(0.01)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._thread = None
+
+
+# --------------------------------------------------------------------------
+# create_or_update: the reconcilehelper equivalent
+# --------------------------------------------------------------------------
+
+def create_or_update(
+    api: InMemoryApiServer,
+    desired: Any,
+    *,
+    copy_fields: Optional[Callable[[Any, Any], bool]] = None,
+) -> Any:
+    """Idempotently ensure ``desired`` exists; if present, copy the mutable
+    fields onto the live object and update only when something changed
+    (components/common/reconcilehelper/util.go:18-107's Deployment/Service/
+    VirtualService helpers generalised).
+
+    ``copy_fields(live, desired) -> changed`` defaults to comparing+copying
+    ``spec`` plus labels/annotations — the same field set the reference's
+    Copy*Fields functions sync.
+    """
+    live = api.try_get(
+        desired.kind, desired.metadata.name, desired.metadata.namespace
+    )
+    if live is None:
+        return api.create(desired)
+
+    def default_copy(live_obj: Any, want: Any) -> bool:
+        changed = False
+        if getattr(want, "spec", None) is not None and live_obj.spec != want.spec:
+            live_obj.spec = want.spec
+            changed = True
+        for field in ("labels", "annotations"):
+            want_map = getattr(want.metadata, field)
+            live_map = getattr(live_obj.metadata, field)
+            merged = {**live_map, **want_map}
+            if merged != live_map:
+                setattr(live_obj.metadata, field, merged)
+                changed = True
+        return changed
+
+    fn = copy_fields or default_copy
+    if fn(live, desired):
+        return api.update(live)
+    return live

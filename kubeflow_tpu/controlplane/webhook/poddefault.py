@@ -1,0 +1,108 @@
+"""PodDefault admission mutation.
+
+Mirrors components/admission-webhook/main.go:
+- select PodDefaults whose selector matches the pod's labels (:69-95)
+- conflict detection before applying anything (:98: safeToApplyPodDefaultsOnPod)
+- inject env / volumes / volumeMounts / annotations / labels (:321-470)
+
+Registered as an InMemoryApiServer mutator, the in-process seam equivalent
+to the mutating-webhook HTTPS endpoint (:492-553).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from kubeflow_tpu.controlplane.api.core import Pod
+from kubeflow_tpu.controlplane.api.types import PodDefault
+from kubeflow_tpu.controlplane.runtime.apiserver import InMemoryApiServer
+from kubeflow_tpu.utils import get_logger
+
+log = get_logger("poddefault-webhook")
+
+APPLIED_ANNOTATION = "poddefaults.tpu.kubeflow.org/applied"
+
+
+class PodDefaultConflictError(Exception):
+    pass
+
+
+def _matches(pd: PodDefault, pod: Pod) -> bool:
+    sel = pd.spec.selector
+    if not sel:
+        return False
+    return all(pod.metadata.labels.get(k) == v for k, v in sel.items())
+
+
+def _check_conflicts(pod: Pod, defaults: List[PodDefault]) -> None:
+    """Reject when two sources define the same key differently
+    (reference safeToApplyPodDefaultsOnPod/mergeEnv semantics)."""
+    env_sources = {}
+    for c in pod.spec.containers:
+        for e in c.env:
+            env_sources[e.name] = e.value
+    for pd in defaults:
+        for e in pd.spec.env:
+            if e.name in env_sources and env_sources[e.name] != e.value:
+                raise PodDefaultConflictError(
+                    f"env {e.name} conflicts (pod/{pd.metadata.name})"
+                )
+            env_sources[e.name] = e.value
+    vol_sources = {v.name: v for v in pod.spec.volumes}
+    for pd in defaults:
+        for v in pd.spec.volumes:
+            if v.name in vol_sources and vol_sources[v.name] != v:
+                raise PodDefaultConflictError(
+                    f"volume {v.name} conflicts (pod/{pd.metadata.name})"
+                )
+            vol_sources[v.name] = v
+
+
+def mutate_pod(pod: Pod, defaults: List[PodDefault]) -> Pod:
+    matched = [pd for pd in defaults if _matches(pd, pod)]
+    if not matched:
+        return pod
+    _check_conflicts(pod, matched)
+    for pd in matched:
+        existing_env = {
+            e.name for c in pod.spec.containers for e in c.env
+        }
+        for c in pod.spec.containers:
+            c.env.extend(
+                e for e in pd.spec.env if e.name not in existing_env
+            )
+            existing_mounts = {m.name for m in c.volume_mounts}
+            c.volume_mounts.extend(
+                m for m in pd.spec.volume_mounts
+                if m.name not in existing_mounts
+            )
+        existing_vols = {v.name for v in pod.spec.volumes}
+        pod.spec.volumes.extend(
+            v for v in pd.spec.volumes if v.name not in existing_vols
+        )
+        for k, v in pd.spec.annotations.items():
+            pod.metadata.annotations.setdefault(k, v)
+        for k, v in pd.spec.labels.items():
+            pod.metadata.labels.setdefault(k, v)
+    pod.metadata.annotations[APPLIED_ANNOTATION] = ",".join(
+        sorted(pd.metadata.name for pd in matched)
+    )
+    return pod
+
+
+class PodDefaultMutator:
+    """API-server admission hook: looks up PodDefaults in the pod's namespace
+    at create time."""
+
+    def __init__(self, api: InMemoryApiServer):
+        self.api = api
+
+    def __call__(self, obj):
+        if getattr(obj, "kind", "") != "Pod":
+            return obj
+        defaults = self.api.list("PodDefault", namespace=obj.metadata.namespace)
+        try:
+            return mutate_pod(obj, defaults)
+        except PodDefaultConflictError as e:
+            # Admission rejection surfaces as a create error.
+            raise

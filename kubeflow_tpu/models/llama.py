@@ -231,9 +231,23 @@ class DecoderLayer(nn.Module):
 
 
 class Llama(nn.Module):
-    """Decoder-only LM. __call__ returns logits [B, S, vocab]."""
+    """Decoder-only LM. __call__ returns logits [B, S, vocab].
+
+    Subclass hook points (Mixtral overrides these; everything else —
+    embedding, scan/remat plumbing, final norm, lm head, tied embeddings,
+    logit softcap — is shared backbone):
+    - ``LAYER_CLS``: the per-layer module
+    - ``SCAN_COLLECTIONS`` / ``SCAN_RNGS``: extra variable collections /
+      rng streams threaded through nn.scan
+    """
 
     cfg: LlamaConfig
+
+    # Deliberately un-annotated: annotations would make these flax dataclass
+    # fields, whose parent defaults shadow subclass overrides.
+    LAYER_CLS = DecoderLayer
+    SCAN_COLLECTIONS = ("params", "cache")
+    SCAN_RNGS = ("params",)
 
     @nn.compact
     def __call__(
@@ -261,10 +275,10 @@ class Llama(nn.Module):
         x = jnp.take(embed, tokens, axis=0).astype(cfg.dtype)
         x = constrain(x, ("act_batch", "act_seq", "act_embed"))
 
-        layer_cls = DecoderLayer
+        layer_cls = type(self).LAYER_CLS
         if cfg.remat:
             layer_cls = nn.remat(
-                DecoderLayer,
+                layer_cls,
                 prevent_cse=not cfg.scan_layers,
                 static_argnums=(3,),  # decode flag (self is argnum 0)
             )
@@ -272,8 +286,8 @@ class Llama(nn.Module):
         if cfg.scan_layers:
             x, _ = nn.scan(
                 lambda mdl, carry, _: (mdl(carry, positions, decode), None),
-                variable_axes={"params": 0, "cache": 0},
-                split_rngs={"params": True},
+                variable_axes={c: 0 for c in self.SCAN_COLLECTIONS},
+                split_rngs={r: True for r in self.SCAN_RNGS},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(layer_cls(cfg, name="layers"), x, None)

@@ -17,9 +17,9 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from kubeflow_tpu.models import llama as llama_mod
 from kubeflow_tpu.models.llama import (
     Attention,
+    Llama,
     LlamaConfig,
     RMSNorm,
     _dense,
@@ -137,56 +137,14 @@ class MixtralLayer(nn.Module):
         return x + h
 
 
-class Mixtral(nn.Module):
-    """Mixtral LM: Llama skeleton with MoE layers. Aux losses are sowed into
-    the "losses" collection; the train step adds cfg.aux_loss_weight * sum."""
+class Mixtral(Llama):
+    """Mixtral LM: the Llama backbone with MoE layers (see Llama's subclass
+    hook points — tie_embeddings, logits_softcap, scan/remat all shared).
+    Aux losses are sowed into the "losses" collection; the train step adds
+    aux_loss_weight * mean."""
 
     cfg: MixtralConfig
 
-    @nn.compact
-    def __call__(
-        self,
-        tokens: jax.Array,
-        *,
-        positions: Optional[jax.Array] = None,
-        decode: bool = False,
-    ) -> jax.Array:
-        cfg = self.cfg
-        B, S = tokens.shape
-        if positions is None:
-            positions = jnp.broadcast_to(jnp.arange(S), (B, S))
-
-        embed = self.param(
-            "embed",
-            nn.with_logical_partitioning(
-                nn.initializers.normal(stddev=0.02), ("vocab", "embed")
-            ),
-            (cfg.vocab_size, cfg.embed_dim),
-            cfg.param_dtype,
-        )
-        x = jnp.take(embed, tokens, axis=0).astype(cfg.dtype)
-        x = constrain(x, ("act_batch", "act_seq", "act_embed"))
-
-        layer_cls = MixtralLayer
-        if cfg.remat:
-            layer_cls = nn.remat(
-                MixtralLayer, prevent_cse=not cfg.scan_layers, static_argnums=(3,)
-            )
-
-        if cfg.scan_layers:
-            x, _ = nn.scan(
-                lambda mdl, carry, _: (mdl(carry, positions, decode), None),
-                variable_axes={"params": 0, "cache": 0, "losses": 0},
-                split_rngs={"params": True, "router": True},
-                length=cfg.num_layers,
-                metadata_params={nn.PARTITION_NAME: "layers"},
-            )(layer_cls(cfg, name="layers"), x, None)
-        else:
-            for i in range(cfg.num_layers):
-                x = layer_cls(cfg, name=f"layer_{i}")(x, positions, decode)
-
-        x = RMSNorm(cfg, name="final_norm")(x)
-        logits = _dense(cfg.vocab_size, ("embed", "vocab"), cfg, "lm_head")(
-            x
-        ).astype(jnp.float32)
-        return constrain(logits, ("act_batch", "act_seq", "act_vocab"))
+    LAYER_CLS = MixtralLayer
+    SCAN_COLLECTIONS = ("params", "cache", "losses")
+    SCAN_RNGS = ("params", "router")

@@ -87,6 +87,9 @@ class EncoderBlock(nn.Module):
             cfg.embed_dim, ("heads", "head_dim", "embed"), cfg, "out",
             axis=(-2, -1),
         )(attn)
+        attn = nn.Dropout(cfg.dropout, name="drop_attn")(
+            attn, deterministic=deterministic
+        )
         x = x + attn
         h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                          name="ln2")(x)
@@ -94,6 +97,9 @@ class EncoderBlock(nn.Module):
         h = nn.gelu(h)
         h = constrain(h, ("act_batch", "act_seq", "act_mlp"))
         h = _dense(cfg.embed_dim, ("mlp", "embed"), cfg, "mlp_out")(h)
+        h = nn.Dropout(cfg.dropout, name="drop_mlp")(
+            h, deterministic=deterministic
+        )
         return x + h
 
 

@@ -88,6 +88,12 @@ class Trainer:
         self.cfg = train_cfg
         self.mesh = mesh
         self.rules = rules
+        # MoE aux weighting: an explicit TrainConfig value wins; otherwise
+        # inherit the model config's (MixtralConfig.aux_loss_weight), so a
+        # default TrainConfig doesn't silently drop the load-balancing loss.
+        self.aux_loss_weight = train_cfg.aux_loss_weight or float(
+            getattr(getattr(model, "cfg", None), "aux_loss_weight", 0.0) or 0.0
+        )
         self.optimizer = train_cfg.make_optimizer()
         self._jit_step: Optional[Callable] = None
         self._jit_init: Optional[Callable] = None
@@ -181,7 +187,7 @@ class Trainer:
             logits, labels, mask=mask, z_loss_weight=self.cfg.z_loss_weight
         )
         aux_total = jnp.zeros((), jnp.float32)
-        if self.cfg.aux_loss_weight > 0 and "losses" in mut:
+        if self.aux_loss_weight > 0 and "losses" in mut:
             aux = jax.tree.leaves(mut["losses"])
             if aux:
                 # Mean over per-layer scalars. Normalise by total element
@@ -190,7 +196,7 @@ class Trainer:
                 # leaves — the effective weight must not depend on that.
                 n = sum(a.size for a in aux)
                 aux_total = sum(jnp.sum(a) for a in aux) / n
-                loss = loss + self.cfg.aux_loss_weight * aux_total
+                loss = loss + self.aux_loss_weight * aux_total
         metrics = {
             "accuracy": softmax_accuracy(logits, labels, mask=mask),
             "aux_loss": aux_total,

@@ -1,0 +1,190 @@
+import pytest
+
+from kubeflow_tpu.controlplane.api import (
+    Namespace,
+    Notebook,
+    NotebookSpec,
+    ObjectMeta,
+    Pod,
+    TpuJob,
+    TpuJobSpec,
+    from_dict,
+    object_from_dict,
+    to_dict,
+)
+from kubeflow_tpu.controlplane.api.meta import OwnerReference
+from kubeflow_tpu.controlplane.runtime import (
+    ConflictError,
+    InMemoryApiServer,
+    NotFoundError,
+)
+from kubeflow_tpu.controlplane.runtime.apiserver import AlreadyExistsError
+
+
+def _job(name="train", ns="user1"):
+    return TpuJob(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=TpuJobSpec(slice_type="v5e-16", model="llama-tiny"),
+    )
+
+
+class TestSerde:
+    def test_roundtrip_camel_case(self):
+        job = _job()
+        d = to_dict(job)
+        assert d["apiVersion"] == "tpu.kubeflow.org/v1alpha1"
+        assert d["spec"]["sliceType"] == "v5e-16"
+        assert d["spec"]["maxRestarts"] == 3
+        back = from_dict(TpuJob, d)
+        assert back.spec.slice_type == "v5e-16"
+        assert back.metadata.name == "train"
+
+    def test_object_from_dict_dispatch(self):
+        nb = object_from_dict(
+            {"kind": "Notebook", "metadata": {"name": "n", "namespace": "u"},
+             "spec": {"tpuSlice": "v5e-8"}}
+        )
+        assert isinstance(nb, Notebook)
+        assert nb.spec.tpu_slice == "v5e-8"
+
+    def test_unknown_kind_raises(self):
+        with pytest.raises(ValueError):
+            object_from_dict({"kind": "Widget"})
+
+    def test_unknown_keys_ignored(self):
+        j = from_dict(TpuJob, {"spec": {"sliceType": "v5e-4", "bogus": 1}})
+        assert j.spec.slice_type == "v5e-4"
+
+
+class TestApiServerCrud:
+    def test_create_get_list(self):
+        api = InMemoryApiServer()
+        api.create(_job("a"))
+        api.create(_job("b"))
+        api.create(_job("a", ns="user2"))
+        assert api.get("TpuJob", "a", "user1").metadata.uid
+        assert len(api.list("TpuJob", namespace="user1")) == 2
+        assert len(api.list("TpuJob")) == 3
+
+    def test_create_requires_namespace(self):
+        api = InMemoryApiServer()
+        with pytest.raises(Exception):
+            api.create(_job("x", ns=""))
+
+    def test_duplicate_create_raises(self):
+        api = InMemoryApiServer()
+        api.create(_job())
+        with pytest.raises(AlreadyExistsError):
+            api.create(_job())
+
+    def test_optimistic_concurrency(self):
+        api = InMemoryApiServer()
+        api.create(_job())
+        a = api.get("TpuJob", "train", "user1")
+        b = api.get("TpuJob", "train", "user1")
+        a.spec.max_restarts = 5
+        api.update(a)
+        b.spec.max_restarts = 7
+        with pytest.raises(ConflictError):
+            api.update(b)
+
+    def test_generation_bumps_on_spec_change_only(self):
+        api = InMemoryApiServer()
+        api.create(_job())
+        j = api.get("TpuJob", "train", "user1")
+        j.status.phase = "Running"
+        j = api.update(j)
+        assert j.metadata.generation == 1
+        j.spec.max_restarts = 9
+        j = api.update(j)
+        assert j.metadata.generation == 2
+
+    def test_update_status_does_not_clobber_spec(self):
+        api = InMemoryApiServer()
+        api.create(_job())
+        stale = api.get("TpuJob", "train", "user1")
+        fresh = api.get("TpuJob", "train", "user1")
+        fresh.spec.max_restarts = 11
+        api.update(fresh)
+        stale.status.phase = "Running"
+        out = api.update_status(stale)
+        assert out.spec.max_restarts == 11
+        assert out.status.phase == "Running"
+
+    def test_label_selector(self):
+        api = InMemoryApiServer()
+        j = _job("a")
+        j.metadata.labels = {"team": "x"}
+        api.create(j)
+        api.create(_job("b"))
+        assert [o.metadata.name for o in
+                api.list("TpuJob", label_selector={"team": "x"})] == ["a"]
+
+    def test_store_isolation(self):
+        """Mutating a returned object must not corrupt the store."""
+        api = InMemoryApiServer()
+        api.create(_job())
+        j = api.get("TpuJob", "train", "user1")
+        j.spec.slice_type = "HACKED"
+        assert api.get("TpuJob", "train", "user1").spec.slice_type == "v5e-16"
+
+
+class TestLifecycle:
+    def test_finalizer_blocks_deletion(self):
+        api = InMemoryApiServer()
+        j = _job()
+        j.metadata.finalizers = ["tpu.kubeflow.org/teardown"]
+        api.create(j)
+        api.delete("TpuJob", "train", "user1")
+        live = api.get("TpuJob", "train", "user1")
+        assert live.metadata.deletion_timestamp is not None
+        live.metadata.finalizers = []
+        api.update(live)
+        with pytest.raises(NotFoundError):
+            api.get("TpuJob", "train", "user1")
+
+    def test_owner_cascade(self):
+        api = InMemoryApiServer()
+        job = api.create(_job())
+        pod = Pod(metadata=ObjectMeta(
+            name="train-worker-0", namespace="user1",
+            owner_references=[OwnerReference(
+                kind="TpuJob", name="train", uid=job.metadata.uid)],
+        ))
+        api.create(pod)
+        api.delete("TpuJob", "train", "user1")
+        with pytest.raises(NotFoundError):
+            api.get("Pod", "train-worker-0", "user1")
+
+    def test_watch_sees_lifecycle(self):
+        api = InMemoryApiServer()
+        q = api.watch("TpuJob")
+        api.create(_job())
+        j = api.get("TpuJob", "train", "user1")
+        j.status.phase = "Running"
+        api.update(j)
+        api.delete("TpuJob", "train", "user1")
+        events = []
+        while not q.empty():
+            events.append(q.get().type)
+        assert events == ["ADDED", "MODIFIED", "DELETED"]
+
+    def test_watch_replays_existing(self):
+        api = InMemoryApiServer()
+        api.create(_job())
+        q = api.watch("TpuJob")
+        assert q.get_nowait().type == "ADDED"
+
+    def test_admission_mutator_runs_on_create(self):
+        api = InMemoryApiServer()
+
+        def add_label(obj):
+            if obj.kind == "Pod":
+                obj.metadata.labels["mutated"] = "yes"
+            return obj
+
+        api.register_mutator(add_label)
+        api.create(Pod(metadata=ObjectMeta(name="p", namespace="u")))
+        assert api.get("Pod", "p", "u").metadata.labels["mutated"] == "yes"
+        api.create(_job())
+        assert "mutated" not in api.get("TpuJob", "train", "user1").metadata.labels

@@ -211,3 +211,42 @@ class TestRegistry:
     def test_llama3_8b_param_count(self):
         model, cfg = get_model("llama3-8b")
         assert 7.9e9 < model.num_params() < 8.2e9
+
+
+class TestMixtralSharesBackbone:
+    def test_tie_embeddings_and_softcap_honored(self):
+        cfg = MixtralConfig.tiny(num_layers=1, tie_embeddings=True,
+                                 logits_softcap=5.0)
+        model = Mixtral(cfg)
+        tokens = jnp.ones((1, 8), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), tokens)
+        # Tied: no separate lm_head param.
+        assert "lm_head" not in variables["params"]
+        logits = model.apply({"params": variables["params"]}, tokens)
+        assert float(jnp.abs(logits).max()) <= 5.0
+
+
+class TestViTDropout:
+    def test_dropout_active_in_train_mode(self):
+        cfg = ViTConfig.tiny(dropout=0.5)
+        model = ViT(cfg)
+        imgs = jnp.ones((2, 32, 32, 3))
+        params = model.init(jax.random.PRNGKey(0), imgs)
+        # The head kernel is zero-initialised → logits are 0 regardless of
+        # features; give it weight so dropout noise reaches the output.
+        from flax import linen as nn
+        import flax
+
+        params = nn.meta.unbox(params)
+        flat = flax.traverse_util.flatten_dict(params["params"])
+        flat[("head", "kernel")] = jnp.ones_like(flat[("head", "kernel")])
+        params = {"params": flax.traverse_util.unflatten_dict(flat)}
+        a = model.apply(params, imgs, train=True,
+                        rngs={"dropout": jax.random.PRNGKey(1)})
+        b = model.apply(params, imgs, train=True,
+                        rngs={"dropout": jax.random.PRNGKey(2)})
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+        # Eval mode is deterministic and needs no rng.
+        c = model.apply(params, imgs, train=False)
+        d = model.apply(params, imgs, train=False)
+        np.testing.assert_allclose(np.asarray(c), np.asarray(d))

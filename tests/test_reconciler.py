@@ -1,0 +1,157 @@
+import pytest
+
+from kubeflow_tpu.controlplane.api import (
+    ObjectMeta,
+    Pod,
+    Service,
+    TpuJob,
+    TpuJobSpec,
+)
+from kubeflow_tpu.controlplane.api.core import ServicePort, ServiceSpec
+from kubeflow_tpu.controlplane.api.meta import OwnerReference
+from kubeflow_tpu.controlplane.runtime import (
+    Controller,
+    ControllerManager,
+    InMemoryApiServer,
+    Result,
+    create_or_update,
+)
+from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+
+class EchoServiceController(Controller):
+    """Toy controller: every TpuJob gets a Service named <job>-svc."""
+
+    NAME = "echo"
+    WATCH_KINDS = ("TpuJob", "Service")
+
+    def reconcile(self, namespace, name):
+        job = self.api.try_get("TpuJob", name, namespace)
+        if job is None:
+            return Result()
+        svc = Service(
+            metadata=ObjectMeta(
+                name=f"{name}-svc", namespace=namespace,
+                owner_references=[OwnerReference(
+                    kind="TpuJob", name=name, uid=job.metadata.uid)],
+            ),
+            spec=ServiceSpec(
+                selector={"job": name},
+                ports=[ServicePort(name="http", port=80, target_port=8888)],
+            ),
+        )
+        create_or_update(self.api, svc)
+        return Result()
+
+
+def _mk(api=None):
+    api = api or InMemoryApiServer()
+    mgr = ControllerManager(api)
+    ctl = EchoServiceController(api, registry=MetricsRegistry())
+    mgr.register(ctl)
+    return api, mgr, ctl
+
+
+def _job(name="j1", ns="u"):
+    return TpuJob(metadata=ObjectMeta(name=name, namespace=ns),
+                  spec=TpuJobSpec())
+
+
+class TestReconcilerKernel:
+    def test_creates_dependent(self):
+        api, mgr, _ = _mk()
+        api.create(_job())
+        mgr.run_until_idle()
+        assert api.get("Service", "j1-svc", "u").spec.selector == {"job": "j1"}
+
+    def test_idempotent_second_pass(self):
+        """The second-apply contract (testing/kfctl/kfctl_second_apply.py):
+        reconciling an unchanged world must not produce new writes."""
+        api, mgr, _ = _mk()
+        api.create(_job())
+        mgr.run_until_idle()
+        rv = api.get("Service", "j1-svc", "u").metadata.resource_version
+        mgr.run_until_idle()
+        api_rv = api.get("Service", "j1-svc", "u").metadata.resource_version
+        assert api_rv == rv
+
+    def test_dependent_repair(self):
+        """Deleting the dependent triggers re-creation via the secondary
+        watch + map_to_primary (drift repair)."""
+        api, mgr, _ = _mk()
+        api.create(_job())
+        mgr.run_until_idle()
+        api.delete("Service", "j1-svc", "u")
+        mgr.run_until_idle()
+        assert api.try_get("Service", "j1-svc", "u") is not None
+
+    def test_spec_drift_correction(self):
+        api, mgr, _ = _mk()
+        api.create(_job())
+        mgr.run_until_idle()
+        svc = api.get("Service", "j1-svc", "u")
+        svc.spec.selector = {"job": "tampered"}
+        api.update(svc)
+        mgr.run_until_idle()
+        assert api.get("Service", "j1-svc", "u").spec.selector == {"job": "j1"}
+
+    def test_error_requeues_and_metrics(self):
+        api = InMemoryApiServer()
+        mgr = ControllerManager(api)
+
+        class Flaky(EchoServiceController):
+            NAME = "flaky"
+            fails = 2
+
+            def reconcile(self, namespace, name):
+                if Flaky.fails > 0:
+                    Flaky.fails -= 1
+                    raise RuntimeError("boom")
+                return super().reconcile(namespace, name)
+
+        ctl = Flaky(api, registry=MetricsRegistry())
+        mgr.register(ctl)
+        api.create(_job())
+        mgr.run_until_idle(include_timers_within=2.0)
+        assert ctl.metrics_reconcile.value(result="error") == 2
+        assert api.try_get("Service", "j1-svc", "u") is not None
+
+    def test_requeue_after(self):
+        api = InMemoryApiServer()
+        mgr = ControllerManager(api)
+        seen = []
+
+        class Periodic(Controller):
+            NAME = "periodic"
+            WATCH_KINDS = ("TpuJob",)
+
+            def reconcile(self, namespace, name):
+                seen.append(name)
+                if len(seen) < 3:
+                    return Result(requeue_after=0.01)
+                return Result()
+
+        mgr.register(Periodic(api, registry=MetricsRegistry()))
+        api.create(_job())
+        mgr.run_until_idle(include_timers_within=1.0)
+        assert len(seen) == 3
+
+    def test_livelock_detection(self):
+        api = InMemoryApiServer()
+        mgr = ControllerManager(api)
+
+        class Hot(Controller):
+            NAME = "hot"
+            WATCH_KINDS = ("TpuJob",)
+
+            def reconcile(self, namespace, name):
+                # Unconditional write → generates MODIFIED → reconciles again.
+                job = self.api.get("TpuJob", name, namespace)
+                job.spec.max_restarts += 1
+                self.api.update(job)
+                return Result()
+
+        mgr.register(Hot(api, registry=MetricsRegistry()))
+        api.create(_job())
+        with pytest.raises(RuntimeError, match="livelock"):
+            mgr.run_until_idle(max_iterations=50)

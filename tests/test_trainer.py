@@ -180,3 +180,18 @@ class TestAuxLossNormalisation:
         # must be the same scale (a num_layers-factor bug would give 2x).
         ratio = outs[True] / outs[False]
         assert 0.6 < ratio < 1.67, outs
+
+
+class TestAuxWeightInheritance:
+    def test_model_config_aux_weight_used_by_default(self, devices8):
+        from kubeflow_tpu.topology import AxisSpec, make_host_local_mesh
+
+        mesh = make_host_local_mesh(AxisSpec(dp=-1))
+        cfg = MixtralConfig.tiny(num_layers=1)
+        assert cfg.aux_loss_weight > 0
+        trainer = Trainer(Mixtral(cfg), TrainConfig(task="lm"), mesh)
+        assert trainer.aux_loss_weight == cfg.aux_loss_weight
+        # Explicit TrainConfig value wins.
+        t2 = Trainer(Mixtral(cfg), TrainConfig(task="lm", aux_loss_weight=0.5),
+                     mesh)
+        assert t2.aux_loss_weight == 0.5
