@@ -1,0 +1,4 @@
+from kubeflow_tpu.controlplane.kfam.service import AccessManagement, KfamHttpServer
+from kubeflow_tpu.controlplane.kfam.authz import SubjectAccessReviewer
+
+__all__ = ["AccessManagement", "KfamHttpServer", "SubjectAccessReviewer"]

@@ -1,0 +1,49 @@
+"""SubjectAccessReview-style authorization.
+
+The reference authorizes every request by minting a SubjectAccessReview for
+the trusted USERID_HEADER identity (jupyter-web-app backend,
+kubeflow_jupyter/common/auth.py:21-60 ``needs_authorization`` decorator);
+kfam handlers do the same via client-go. Here the reviewer evaluates the
+same question — can ``user`` ``verb`` resources in ``namespace``? — against
+the RoleBindings the profile controller and kfam itself create.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from kubeflow_tpu.controlplane.runtime.apiserver import InMemoryApiServer
+
+ROLE_VERBS = {
+    "kubeflow-admin": {"get", "list", "create", "update", "delete", "admin"},
+    "kubeflow-edit": {"get", "list", "create", "update", "delete"},
+    "kubeflow-view": {"get", "list"},
+}
+
+
+class SubjectAccessReviewer:
+    def __init__(self, api: InMemoryApiServer):
+        self.api = api
+
+    def roles_for(self, user: str, namespace: str) -> List[str]:
+        roles = []
+        for rb in self.api.list("RoleBinding", namespace=namespace):
+            if any(s.kind == "User" and s.name == user for s in rb.subjects):
+                roles.append(rb.role_ref.name)
+        return roles
+
+    def can(self, user: str, verb: str, namespace: str) -> bool:
+        for role in self.roles_for(user, namespace):
+            if verb in ROLE_VERBS.get(role, set()):
+                return True
+        return False
+
+    def is_cluster_admin(self, user: str) -> bool:
+        # Cluster admins are recorded as a label on their Profile.
+        for p in self.api.list("Profile"):
+            if (
+                p.spec.owner == user
+                and p.metadata.labels.get("cluster-admin") == "true"
+            ):
+                return True
+        return False

@@ -1,0 +1,324 @@
+"""kfam: profile + contributor access management.
+
+Rebuild of components/access-management (reference routes:
+kfam/routers.go:31-101 — POST/DELETE/GET /kfam/v1/bindings,
+POST/DELETE /kfam/v1/profiles, GET /kfam/v1/role-clusteradmin,
+readiness probe). Contributor grant = paired {RoleBinding,
+AuthorizationPolicy principal} (reference bindings.go:76-127 created
+RoleBinding + Istio ServiceRoleBinding; we use the modern
+AuthorizationPolicy). Identity arrives via the trusted user-id header
+injected by the auth proxy (gatekeeper / IAP).
+
+Two layers:
+- ``AccessManagement``: the operations, callable in-process (used by the
+  dashboard API and tests).
+- ``KfamHttpServer``: a stdlib HTTP wrapper exposing the same REST routes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from kubeflow_tpu.controlplane.api.core import (
+    RoleBinding,
+    RoleRef,
+    Subject,
+)
+from kubeflow_tpu.controlplane.api.meta import ObjectMeta
+from kubeflow_tpu.controlplane.api.types import Profile, ProfileSpec
+from kubeflow_tpu.controlplane.kfam.authz import SubjectAccessReviewer
+from kubeflow_tpu.controlplane.runtime.apiserver import (
+    AlreadyExistsError,
+    InMemoryApiServer,
+    NotFoundError,
+)
+from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
+
+log = get_logger("kfam")
+
+ROLE_MAP = {"admin": "kubeflow-admin", "edit": "kubeflow-edit",
+            "view": "kubeflow-view"}
+
+
+class KfamError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+@dataclasses.dataclass
+class Binding:
+    user: str
+    namespace: str
+    role: str          # admin | edit | view
+
+
+class AccessManagement:
+    def __init__(
+        self,
+        api: InMemoryApiServer,
+        registry: MetricsRegistry = global_registry,
+        *,
+        user_id_header: str = "x-goog-authenticated-user-email",
+    ):
+        self.api = api
+        self.sar = SubjectAccessReviewer(api)
+        self.user_id_header = user_id_header
+        self.requests = registry.counter(
+            "kftpu_kfam_requests_total", "kfam ops", ("op", "result")
+        )
+        self.heartbeat = registry.heartbeat("kfam")
+
+    # ------------- authz helpers -------------
+
+    def _require_ns_admin(self, caller: str, namespace: str) -> None:
+        if self.sar.is_cluster_admin(caller):
+            return
+        if not self.sar.can(caller, "admin", namespace):
+            raise KfamError(
+                403, f"{caller} is not an admin of namespace {namespace}"
+            )
+
+    # ------------- profiles -------------
+
+    def create_profile(self, caller: str, name: str, owner: str = "",
+                       tpu_chip_quota: int = 0) -> Profile:
+        self.heartbeat.beat()
+        owner = owner or caller
+        if owner != caller and not self.sar.is_cluster_admin(caller):
+            raise KfamError(403, "only cluster admins create profiles for others")
+        try:
+            p = self.api.create(Profile(
+                metadata=ObjectMeta(name=name),
+                spec=ProfileSpec(owner=owner, tpu_chip_quota=tpu_chip_quota),
+            ))
+            self.requests.inc(op="create-profile", result="ok")
+            return p
+        except AlreadyExistsError:
+            self.requests.inc(op="create-profile", result="conflict")
+            raise KfamError(409, f"profile {name} exists")
+
+    def delete_profile(self, caller: str, name: str) -> None:
+        self.heartbeat.beat()
+        p = self.api.try_get("Profile", name)
+        if p is None:
+            raise KfamError(404, f"profile {name} not found")
+        if p.spec.owner != caller and not self.sar.is_cluster_admin(caller):
+            raise KfamError(403, "only the owner or cluster admin may delete")
+        self.api.delete("Profile", name)
+        self.requests.inc(op="delete-profile", result="ok")
+
+    def profile_exists(self, user: str) -> bool:
+        return any(p.spec.owner == user for p in self.api.list("Profile"))
+
+    # ------------- contributor bindings -------------
+
+    @staticmethod
+    def _binding_name(user: str, role: str) -> str:
+        safe = user.replace("@", "-").replace(".", "-")
+        return f"user-{safe}-clusterrole-{ROLE_MAP[role]}"
+
+    def create_binding(self, caller: str, b: Binding) -> None:
+        self.heartbeat.beat()
+        if b.role not in ROLE_MAP:
+            raise KfamError(400, f"unknown role {b.role!r}")
+        self._require_ns_admin(caller, b.namespace)
+        rb = RoleBinding(
+            metadata=ObjectMeta(
+                name=self._binding_name(b.user, b.role),
+                namespace=b.namespace,
+                annotations={"user": b.user, "role": b.role},
+            ),
+            subjects=[Subject(kind="User", name=b.user)],
+            role_ref=RoleRef(name=ROLE_MAP[b.role]),
+        )
+        try:
+            self.api.create(rb)
+        except AlreadyExistsError:
+            raise KfamError(409, "binding exists")
+        # Pair with Istio-level access (reference bindings.go:100-127).
+        ap = self.api.try_get(
+            "AuthorizationPolicy", "ns-owner-access-istio", b.namespace
+        )
+        if ap is not None and b.user not in ap.principals:
+            ap.principals.append(b.user)
+            self.api.update(ap)
+        self.requests.inc(op="create-binding", result="ok")
+
+    def delete_binding(self, caller: str, b: Binding) -> None:
+        self.heartbeat.beat()
+        self._require_ns_admin(caller, b.namespace)
+        try:
+            self.api.delete(
+                "RoleBinding", self._binding_name(b.user, b.role), b.namespace
+            )
+        except NotFoundError:
+            raise KfamError(404, "binding not found")
+        ap = self.api.try_get(
+            "AuthorizationPolicy", "ns-owner-access-istio", b.namespace
+        )
+        if ap is not None and b.user in ap.principals:
+            owner = ""
+            prof = self.api.try_get("Profile", b.namespace)
+            if prof is not None:
+                owner = prof.spec.owner
+            if b.user != owner:
+                ap.principals.remove(b.user)
+                self.api.update(ap)
+        self.requests.inc(op="delete-binding", result="ok")
+
+    def list_bindings(
+        self,
+        user: Optional[str] = None,
+        namespace: Optional[str] = None,
+        role: Optional[str] = None,
+    ) -> List[Binding]:
+        self.heartbeat.beat()
+        out = []
+        for rb in self.api.list("RoleBinding", namespace=namespace):
+            u = rb.metadata.annotations.get("user")
+            r = rb.metadata.annotations.get("role")
+            if not u or not r:
+                continue  # infra bindings (default-editor etc.)
+            if user is not None and u != user:
+                continue
+            if role is not None and r != role:
+                continue
+            out.append(Binding(user=u, namespace=rb.metadata.namespace, role=r))
+        # Owners are implicit admins of their profile namespaces.
+        for p in self.api.list("Profile"):
+            if user is not None and p.spec.owner != user:
+                continue
+            if namespace is not None and p.metadata.name != namespace:
+                continue
+            if role is not None and role != "admin":
+                continue
+            out.append(Binding(user=p.spec.owner, namespace=p.metadata.name,
+                               role="admin"))
+        return out
+
+
+class KfamHttpServer:
+    """REST wrapper, same route shapes as the reference router
+    (kfam/routers.go:31-101)."""
+
+    def __init__(self, am: AccessManagement, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.am = am
+        am_ref = am
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _caller(self) -> str:
+                return self.headers.get(am_ref.user_id_header, "")
+
+            def _send(self, status: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> Dict:
+                n = int(self.headers.get("Content-Length", "0") or 0)
+                if n == 0:
+                    return {}
+                return json.loads(self.rfile.read(n))
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                q = {k: v[0] for k, v in parse_qs(url.query).items()}
+                try:
+                    if url.path == "/kfam/v1/bindings":
+                        bs = am_ref.list_bindings(
+                            user=q.get("user"), namespace=q.get("namespace"),
+                            role=q.get("role"),
+                        )
+                        self._send(200, {"bindings": [dataclasses.asdict(b)
+                                                      for b in bs]})
+                    elif url.path == "/kfam/v1/role-clusteradmin":
+                        self._send(200, am_ref.sar.is_cluster_admin(
+                            self._caller()))
+                    elif url.path == "/metrics":
+                        self._send(200, {"note": "see registry"})
+                    elif url.path == "/kfam/v1/health":
+                        self._send(200, {"status": "ok"})
+                    else:
+                        self._send(404, {"error": "not found"})
+                except KfamError as e:
+                    self._send(e.status, {"error": str(e)})
+
+            def do_POST(self):
+                url = urlparse(self.path)
+                caller = self._caller()
+                if not caller:
+                    self._send(401, {"error": "missing identity header"})
+                    return
+                try:
+                    body = self._body()
+                    if url.path == "/kfam/v1/profiles":
+                        p = am_ref.create_profile(
+                            caller, body["name"], body.get("owner", ""),
+                            int(body.get("tpuChipQuota", 0)),
+                        )
+                        self._send(200, {"name": p.metadata.name})
+                    elif url.path == "/kfam/v1/bindings":
+                        am_ref.create_binding(caller, Binding(
+                            user=body["user"], namespace=body["namespace"],
+                            role=body.get("role", "edit"),
+                        ))
+                        self._send(200, {"status": "created"})
+                    else:
+                        self._send(404, {"error": "not found"})
+                except KfamError as e:
+                    self._send(e.status, {"error": str(e)})
+                except (KeyError, json.JSONDecodeError) as e:
+                    self._send(400, {"error": f"bad request: {e}"})
+
+            def do_DELETE(self):
+                url = urlparse(self.path)
+                q = {k: v[0] for k, v in parse_qs(urlparse(self.path).query).items()}
+                caller = self._caller()
+                if not caller:
+                    self._send(401, {"error": "missing identity header"})
+                    return
+                try:
+                    if url.path == "/kfam/v1/profiles":
+                        am_ref.delete_profile(caller, q["name"])
+                        self._send(200, {"status": "deleted"})
+                    elif url.path == "/kfam/v1/bindings":
+                        am_ref.delete_binding(caller, Binding(
+                            user=q["user"], namespace=q["namespace"],
+                            role=q.get("role", "edit"),
+                        ))
+                        self._send(200, {"status": "deleted"})
+                    else:
+                        self._send(404, {"error": "not found"})
+                except KfamError as e:
+                    self._send(e.status, {"error": str(e)})
+                except KeyError as e:
+                    self._send(400, {"error": f"missing param {e}"})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,196 @@
+"""Platform assembly: one object wiring API server + controllers + services.
+
+The deployment plane's engine (SURVEY.md §7.4): what the reference reaches
+through the vendored kfctl coordinator (bootstrap/cmd/bootstrap/app/
+kfctlServer.go:105-312 — load KfDef, Apply(PLATFORM), Apply(K8S) with
+retries) becomes an explicit, testable object: apply a PlatformConfig,
+components come up; apply again, nothing changes (the second-apply
+idempotency contract, reference testing/kfctl/kfctl_second_apply.py:12-24).
+
+State is persisted as a YAML resource dump so ``tpuctl`` invocations
+compose across processes without a running cluster.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import yaml
+
+from kubeflow_tpu.controlplane.api import object_from_dict, to_dict
+from kubeflow_tpu.controlplane.api.types import PlatformConfig
+from kubeflow_tpu.controlplane.controllers import (
+    FakeKubelet,
+    NotebookController,
+    PodDefaultMutator,
+    ProfileController,
+    TensorboardController,
+    TpuJobController,
+)
+from kubeflow_tpu.controlplane.kfam import AccessManagement
+from kubeflow_tpu.controlplane.runtime import (
+    ControllerManager,
+    InMemoryApiServer,
+)
+from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+log = get_logger("platform")
+
+DEFAULT_COMPONENTS = (
+    "tpujob-controller",
+    "notebook-controller",
+    "profile-controller",
+    "tensorboard-controller",
+    "poddefault-webhook",
+    "kfam",
+    "fake-kubelet",          # local/dev compute double; real clusters disable
+)
+
+
+class Platform:
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.api = InMemoryApiServer()
+        self.registry = registry or MetricsRegistry()
+        self.manager = ControllerManager(self.api)
+        self.kfam: Optional[AccessManagement] = None
+        self.components: List[str] = []
+        self._config: Optional[PlatformConfig] = None
+
+    # ------------- component wiring -------------
+
+    def apply_config(self, cfg: PlatformConfig) -> List[str]:
+        """Bring up the components the config enables. Idempotent: already-
+        running components are left alone."""
+        self._config = cfg
+        wanted = [
+            c.name for c in cfg.spec.components if c.enabled
+        ] or list(DEFAULT_COMPONENTS)
+        params: Dict[str, Dict[str, str]] = {
+            c.name: dict(c.params) for c in cfg.spec.components
+        }
+        started = []
+        for name in wanted:
+            if name in self.components:
+                continue
+            self._start_component(name, cfg, params.get(name, {}))
+            self.components.append(name)
+            started.append(name)
+        cfg.status.phase = "Ready"
+        cfg.status.applied_components = list(self.components)
+        existing = self.api.try_get("PlatformConfig", cfg.metadata.name)
+        if existing is None:
+            self.api.create(cfg)
+        else:
+            existing.spec = cfg.spec
+            existing.status = cfg.status
+            self.api.update(existing)
+        return started
+
+    def _start_component(self, name: str, cfg: PlatformConfig,
+                         params: Dict[str, str]) -> None:
+        reg = self.registry
+        if name == "tpujob-controller":
+            capacity = None
+            if "capacity" in params:
+                capacity = {
+                    k: int(v) for k, v in (
+                        kv.split("=") for kv in params["capacity"].split(",")
+                    )
+                }
+            self.manager.register(TpuJobController(self.api, reg,
+                                                   capacity=capacity))
+        elif name == "notebook-controller":
+            self.manager.register(NotebookController(
+                self.api, reg,
+                enable_culling=params.get("enableCulling", "") == "true",
+                idle_seconds=float(params.get("idleSeconds", 1440 * 60)),
+                istio_gateway=cfg.spec.istio_gateway,
+            ))
+        elif name == "profile-controller":
+            self.manager.register(ProfileController(
+                self.api, reg, user_id_header=cfg.spec.user_id_header,
+            ))
+        elif name == "tensorboard-controller":
+            self.manager.register(TensorboardController(
+                self.api, reg, istio_gateway=cfg.spec.istio_gateway,
+            ))
+        elif name == "poddefault-webhook":
+            self.api.register_mutator(PodDefaultMutator(self.api))
+        elif name == "kfam":
+            self.kfam = AccessManagement(
+                self.api, reg, user_id_header=cfg.spec.user_id_header,
+            )
+        elif name == "fake-kubelet":
+            self.manager.register(FakeKubelet(self.api, reg))
+        else:
+            raise ValueError(f"unknown component {name!r}")
+        log.info("component started", kv={"component": name})
+
+    # ------------- resource apply -------------
+
+    def apply_resource(self, data: dict):
+        """kubectl-apply semantics for one manifest dict."""
+        obj = object_from_dict(data)
+        if obj.kind == "PlatformConfig":
+            self.apply_config(obj)
+            return obj
+        existing = self.api.try_get(
+            obj.kind, obj.metadata.name, obj.metadata.namespace
+        )
+        if existing is None:
+            return self.api.create(obj)
+        if getattr(obj, "spec", None) is not None and existing.spec != obj.spec:
+            existing.spec = obj.spec
+            return self.api.update(existing)
+        return existing
+
+    def reconcile(self) -> int:
+        return self.manager.run_until_idle(include_timers_within=0.2)
+
+    # ------------- persistence -------------
+
+    def save(self, state_dir: str) -> str:
+        os.makedirs(state_dir, exist_ok=True)
+        path = os.path.join(state_dir, "state.yaml")
+        docs = []
+        for key in sorted(self.api._objects):
+            docs.append(to_dict(self.api._objects[key]))
+        meta = {
+            "kind": "PlatformState",
+            "components": self.components,
+            "resourceVersionCounter": self.api._rv,
+        }
+        with open(path, "w") as f:
+            yaml.safe_dump_all([meta] + docs, f, sort_keys=False)
+        return path
+
+    @classmethod
+    def load(cls, state_dir: str) -> "Platform":
+        path = os.path.join(state_dir, "state.yaml")
+        platform = cls()
+        if not os.path.exists(path):
+            return platform
+        with open(path) as f:
+            docs = list(yaml.safe_load_all(f))
+        if not docs:
+            return platform
+        meta, resources = docs[0], docs[1:]
+        # Restore resources first (no mutators registered yet: stored pods
+        # were already mutated at original create time).
+        from kubeflow_tpu.controlplane.api.serde import from_dict as _fd
+
+        for data in resources:
+            obj = object_from_dict(data)
+            key = (obj.kind,
+                   "" if obj.kind in ("Namespace", "Profile", "PlatformConfig")
+                   else obj.metadata.namespace,
+                   obj.metadata.name)
+            platform.api._objects[key] = obj
+        platform.api._rv = int(meta.get("resourceVersionCounter", 0))
+        # Re-start components per stored PlatformConfig.
+        pcs = platform.api.list("PlatformConfig")
+        if pcs:
+            platform.apply_config(pcs[0])
+        return platform

@@ -162,7 +162,11 @@ class Attention(nn.Module):
 
     def _decode_attention(self, q, k, v) -> jax.Array:
         """Single-step (or prefill) attention against a mutable KV cache.
-        Cache layout: [B, max_len, Hkv, Dh]; cache_index scalar int32."""
+
+        Cache layout: [B, max_len, Hkv, Dh]; cache_index is **per-slot**
+        ([B] int32) so the serving engine's continuous batching can hold
+        sequences at different positions in one batch (each slot admits,
+        prefills and decodes independently)."""
         cfg = self.cfg
         B = q.shape[0]
         is_init = not self.has_variable("cache", "cached_key")
@@ -177,26 +181,28 @@ class Attention(nn.Module):
             cfg.dtype,
         )
         cache_index = self.variable(
-            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            "cache", "cache_index", lambda: jnp.zeros((B,), jnp.int32)
         )
         if not is_init:
-            idx = cache_index.value
+            idx = cache_index.value           # [B]
             S_new = q.shape[1]
-            ck = jax.lax.dynamic_update_slice(
-                cached_key.value, k.astype(cfg.dtype), (0, idx, 0, 0)
-            )
-            cv = jax.lax.dynamic_update_slice(
-                cached_value.value, v.astype(cfg.dtype), (0, idx, 0, 0)
-            )
+
+            def upd(cache_row, new_row, i):
+                return jax.lax.dynamic_update_slice(
+                    cache_row, new_row, (i, 0, 0)
+                )
+
+            ck = jax.vmap(upd)(cached_key.value, k.astype(cfg.dtype), idx)
+            cv = jax.vmap(upd)(cached_value.value, v.astype(cfg.dtype), idx)
             cached_key.value = ck
             cached_value.value = cv
             cache_index.value = idx + S_new
-            # Causal mask offset to the filled prefix (also masks the
-            # not-yet-written cache tail, since those slots are > q_pos).
-            from kubeflow_tpu.ops.attention import causal_mask
-
-            mask = causal_mask(S_new, cfg.max_seq_len, q_offset=idx)
-            return mha_reference(q, ck, cv, mask=mask[None, None, :, :])
+            # Per-slot causal mask offset to each slot's filled prefix (the
+            # not-yet-written tail is masked too: tail positions > q_pos).
+            q_pos = idx[:, None] + jnp.arange(S_new)[None, :]      # [B,S]
+            kv_pos = jnp.arange(cfg.max_seq_len)[None, None, :]
+            mask = kv_pos <= q_pos[:, :, None]                      # [B,S,L]
+            return mha_reference(q, ck, cv, mask=mask[:, None, :, :])
         return mha_reference(q, k, v, causal=True)
 
 

@@ -1,0 +1,22 @@
+"""TPU serving: continuous-batching inference engine (BASELINE config 5).
+
+The reference serves models by deploying TF-Serving containers and testing
+gRPC Predict round-trips (reference: testing/test_tf_serving.py:60-156);
+batching strategy was TF-Serving's problem. Here the engine is framework
+code designed for TPU decode: one compiled decode step over a fixed slot
+batch, per-slot KV-cache indices, bucketed prefill compiles.
+"""
+
+from kubeflow_tpu.serving.engine import (
+    GenerationRequest,
+    GenerationResult,
+    ServingConfig,
+    ServingEngine,
+)
+
+__all__ = [
+    "GenerationRequest",
+    "GenerationResult",
+    "ServingConfig",
+    "ServingEngine",
+]

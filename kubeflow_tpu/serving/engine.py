@@ -1,0 +1,277 @@
+"""Continuous-batching generation engine.
+
+TPU-first design:
+- A fixed slot batch [B, 1] decode step, compiled once; sequences join and
+  leave slots without recompilation (static shapes).
+- Prefill runs per-slot at bucketed lengths (powers of two), compiled once
+  per bucket, writing K/V rows into the slot's cache region.
+- Per-slot cache indices (models.llama decode cache) let every slot sit at
+  a different position — the core of continuous batching.
+- Sampling (greedy / temperature) happens on-device inside the compiled
+  step; only generated token ids cross to host each step.
+
+Replaces the reference's serving story (external TF-Serving images probed
+by testing/test_tf_serving.py) with an engine the Serving deployment and
+the bench harness share.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import time
+from typing import Deque, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from kubeflow_tpu.utils import get_logger
+
+log = get_logger("serving")
+
+
+@dataclasses.dataclass
+class GenerationRequest:
+    prompt: List[int]
+    max_new_tokens: int = 32
+    temperature: float = 0.0          # 0 => greedy
+    eos_token: Optional[int] = None
+    request_id: int = 0
+    submitted_at: float = 0.0
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    request_id: int
+    tokens: List[int]
+    prompt_len: int
+    finished_reason: str = "length"   # "length" | "eos"
+    latency_s: float = 0.0
+    ttft_s: float = 0.0               # time to first token
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    max_batch: int = 8
+    max_len: int = 1024
+    prefill_buckets: tuple = (32, 64, 128, 256, 512)
+
+
+class _Slot:
+    __slots__ = ("req", "generated", "pos", "started_at", "first_token_at")
+
+    def __init__(self, req: GenerationRequest):
+        self.req = req
+        self.generated: List[int] = []
+        self.pos = len(req.prompt)
+        self.started_at = time.time()
+        self.first_token_at: Optional[float] = None
+
+
+class ServingEngine:
+    def __init__(self, model: nn.Module, params, cfg: ServingConfig):
+        if model.cfg.max_seq_len < cfg.max_len:
+            raise ValueError(
+                f"model max_seq_len {model.cfg.max_seq_len} < engine max_len "
+                f"{cfg.max_len}"
+            )
+        self.model = model
+        self.params = params
+        self.cfg = cfg
+        self._queue: Deque[GenerationRequest] = collections.deque()
+        self._slots: List[Optional[_Slot]] = [None] * cfg.max_batch
+        self._results: Dict[int, GenerationResult] = {}
+        self._req_ids = itertools.count()
+        self._rng = jax.random.PRNGKey(0)
+
+        # Batched cache, allocated once.
+        self._cache = self.model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((cfg.max_batch, 1), jnp.int32),
+            decode=True,
+        )["cache"]
+        self._decode_fn = jax.jit(self._decode_step)
+        self._prefill_fns: Dict[int, object] = {}
+        self.tokens_generated = 0
+
+    # ------------- public API -------------
+
+    def submit(self, prompt: List[int], **kw) -> int:
+        rid = next(self._req_ids)
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) >= self.cfg.max_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} >= max_len {self.cfg.max_len}"
+            )
+        self._queue.append(GenerationRequest(
+            prompt=list(prompt), request_id=rid, submitted_at=time.time(), **kw
+        ))
+        return rid
+
+    def step(self) -> int:
+        """One engine iteration: admit waiting requests into free slots
+        (prefill), then decode one token for every active slot. Returns the
+        number of active slots."""
+        self._admit()
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        if not active:
+            return 0
+        self._decode_once()
+        return len(active)
+
+    def run(self) -> List[GenerationResult]:
+        """Process until queue and slots drain; returns results in
+        completion order."""
+        order: List[int] = []
+        known = set()
+        while self._queue or any(s is not None for s in self._slots):
+            self.step()
+            for rid in self._results:
+                if rid not in known:
+                    known.add(rid)
+                    order.append(rid)
+        return [self._results[r] for r in order]
+
+    def result(self, rid: int) -> Optional[GenerationResult]:
+        return self._results.get(rid)
+
+    # ------------- internals -------------
+
+    def _bucket(self, n: int) -> int:
+        for b in self.cfg.prefill_buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"prompt length {n} exceeds largest prefill bucket "
+            f"{self.cfg.prefill_buckets[-1]}"
+        )
+
+    def _admit(self) -> None:
+        for i, slot in enumerate(self._slots):
+            if slot is not None or not self._queue:
+                continue
+            req = self._queue.popleft()
+            self._slots[i] = _Slot(req)
+            self._prefill(i, req)
+
+    def _prefill_step(self, params, cache_row, tokens, length):
+        """Single-slot prefill on a [1, bucket] padded prompt. Pad tokens
+        beyond ``length`` do reach the cache (static shapes), but the slot's
+        cache_index is reset to ``length`` afterwards, so the junk K/V rows
+        sit beyond the index, get overwritten by subsequent decodes, and stay
+        causally masked until then."""
+        variables = {"params": params["params"], "cache": cache_row}
+        positions = jnp.arange(tokens.shape[1])[None, :]
+        logits, mut = self.model.apply(
+            variables, tokens, positions=positions, decode=True,
+            mutable=["cache"],
+        )
+        # cache_index leaves are the only int32 entries in the collection.
+        new_cache = jax.tree.map(
+            lambda x: jnp.full_like(x, length) if x.dtype == jnp.int32 else x,
+            mut["cache"],
+        )
+        last_logits = logits[0, length - 1]
+        return last_logits, new_cache
+
+    def _prefill(self, slot_idx: int, req: GenerationRequest) -> None:
+        bucket = self._bucket(len(req.prompt))
+        if bucket not in self._prefill_fns:
+            self._prefill_fns[bucket] = jax.jit(self._prefill_step)
+        fn = self._prefill_fns[bucket]
+
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, : len(req.prompt)] = req.prompt
+        fresh_row = self.model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32), decode=True
+        )["cache"]
+        last_logits, row_cache = fn(
+            self.params, fresh_row, jnp.asarray(tokens),
+            jnp.asarray(len(req.prompt), jnp.int32),
+        )
+        # Install the row into the batched cache at slot_idx. Leaf layouts:
+        # unscanned K/V [B,S,H,D], scanned [L,B,S,H,D]; index [B] or [L,B] —
+        # the batch axis is always ndim-4 for K/V and last for indices.
+        def install(batch_leaf, row_leaf):
+            if batch_leaf.dtype == jnp.int32:
+                return batch_leaf.at[..., slot_idx].set(row_leaf[..., 0])
+            return batch_leaf.at[..., slot_idx, :, :, :].set(
+                row_leaf[..., 0, :, :, :]
+            )
+
+        self._cache = jax.tree.map(install, self._cache, row_cache)
+        # First generated token comes from the prefill's last logits.
+        tok = self._sample_host(last_logits, req.temperature)
+        self._record_token(slot_idx, int(tok))
+
+    def _decode_step(self, params, cache, tokens, positions, rng, temps):
+        variables = {"params": params["params"], "cache": cache}
+        logits, mut = self.model.apply(
+            variables, tokens, positions=positions, decode=True,
+            mutable=["cache"],
+        )
+        logits = logits[:, 0]                      # [B, V]
+        greedy = jnp.argmax(logits, axis=-1)
+        gumbel = jax.random.gumbel(rng, logits.shape)
+        temps_safe = jnp.maximum(temps, 1e-6)[:, None]
+        sampled = jnp.argmax(logits / temps_safe + gumbel, axis=-1)
+        toks = jnp.where(temps > 0, sampled, greedy)
+        return toks.astype(jnp.int32), mut["cache"]
+
+    def _decode_once(self) -> None:
+        B = self.cfg.max_batch
+        tokens = np.zeros((B, 1), np.int32)
+        positions = np.zeros((B, 1), np.int32)
+        temps = np.zeros((B,), np.float32)
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            last = (slot.generated or slot.req.prompt)[-1]
+            tokens[i, 0] = last
+            positions[i, 0] = slot.pos
+            temps[i] = slot.req.temperature
+        self._rng, sub = jax.random.split(self._rng)
+        toks, self._cache = self._decode_fn(
+            self.params, self._cache, jnp.asarray(tokens),
+            jnp.asarray(positions), sub, jnp.asarray(temps),
+        )
+        toks = np.asarray(toks)
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            self._record_token(i, int(toks[i]))
+
+    def _sample_host(self, logits: jax.Array, temperature: float) -> int:
+        if temperature <= 0:
+            return int(jnp.argmax(logits))
+        self._rng, sub = jax.random.split(self._rng)
+        g = jax.random.gumbel(sub, logits.shape)
+        return int(jnp.argmax(logits / temperature + g))
+
+    def _record_token(self, slot_idx: int, token: int) -> None:
+        slot = self._slots[slot_idx]
+        assert slot is not None
+        if slot.first_token_at is None:
+            slot.first_token_at = time.time()
+        slot.generated.append(token)
+        slot.pos += 1
+        self.tokens_generated += 1
+        req = slot.req
+        done_eos = req.eos_token is not None and token == req.eos_token
+        done_len = len(slot.generated) >= req.max_new_tokens
+        done_cap = slot.pos >= self.cfg.max_len - 1
+        if done_eos or done_len or done_cap:
+            now = time.time()
+            self._results[req.request_id] = GenerationResult(
+                request_id=req.request_id,
+                tokens=list(slot.generated),
+                prompt_len=len(req.prompt),
+                finished_reason="eos" if done_eos else "length",
+                latency_s=now - req.submitted_at,
+                ttft_s=(slot.first_token_at or now) - req.submitted_at,
+            )
+            self._slots[slot_idx] = None

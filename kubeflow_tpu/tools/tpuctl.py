@@ -1,0 +1,166 @@
+"""tpuctl — the deployment CLI (kfctl equivalent).
+
+Rebuild of the reference's deployment plane entry point: where kfctl loads
+a KfDef and applies platform + k8s layers (bootstrap/cmd/bootstrap/app/
+kfctlServer.go:105-312, CI usage testing/kfctl/kfctl_go_test.py:38-41),
+tpuctl loads a PlatformConfig (+ any resource manifests), brings up the
+components, reconciles to convergence, and persists state. Contracts kept
+from the reference's CI:
+- second apply is a no-op (testing/kfctl/kfctl_second_apply.py:12-24)
+- delete leaves nothing behind (kfctl_delete_test.py:44-71)
+
+Usage:
+  tpuctl apply  -f platform.yaml [-f job.yaml ...] --state-dir .tpuctl
+  tpuctl get    <kind> [-n NAMESPACE] --state-dir .tpuctl
+  tpuctl status --state-dir .tpuctl
+  tpuctl delete -f job.yaml | --kind TpuJob --name x -n ns  --state-dir .tpuctl
+  tpuctl metrics --state-dir .tpuctl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+import yaml
+
+from kubeflow_tpu.controlplane.api import to_dict
+from kubeflow_tpu.controlplane.platform import Platform
+
+
+def _load_docs(paths: List[str]) -> List[dict]:
+    docs = []
+    for p in paths:
+        with open(p) as f:
+            for d in yaml.safe_load_all(f):
+                if d:
+                    docs.append(d)
+    return docs
+
+
+def cmd_apply(args) -> int:
+    platform = Platform.load(args.state_dir)
+    docs = _load_docs(args.filename)
+    # PlatformConfigs first (components must exist before CRs reconcile).
+    docs.sort(key=lambda d: 0 if d.get("kind") == "PlatformConfig" else 1)
+    applied = []
+    for d in docs:
+        obj = platform.apply_resource(d)
+        applied.append(f"{obj.kind}/{obj.metadata.name}")
+    n = platform.reconcile()
+    platform.save(args.state_dir)
+    for a in applied:
+        print(f"applied {a}")
+    print(f"reconciled ({n} passes)")
+    return 0
+
+
+def cmd_get(args) -> int:
+    platform = Platform.load(args.state_dir)
+    objs = platform.api.list(args.kind, namespace=args.namespace)
+    if args.output == "yaml":
+        yaml.safe_dump_all([to_dict(o) for o in objs], sys.stdout,
+                           sort_keys=False)
+        return 0
+    for o in objs:
+        phase = ""
+        status = getattr(o, "status", None)
+        if status is not None:
+            phase = getattr(status, "phase", "") or getattr(
+                status, "container_state", "")
+        ns = o.metadata.namespace or "-"
+        print(f"{ns}\t{o.metadata.name}\t{phase}")
+    return 0
+
+
+def cmd_status(args) -> int:
+    platform = Platform.load(args.state_dir)
+    out = {
+        "components": platform.components,
+        "resources": {},
+    }
+    for kind in ("TpuJob", "Notebook", "Profile", "Pod", "Tensorboard"):
+        objs = platform.api.list(kind)
+        if objs:
+            out["resources"][kind] = {
+                f"{o.metadata.namespace or '-'}/{o.metadata.name}":
+                getattr(getattr(o, "status", None), "phase", "")
+                for o in objs
+            }
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_delete(args) -> int:
+    platform = Platform.load(args.state_dir)
+    targets = []
+    if args.filename:
+        for d in _load_docs(args.filename):
+            meta = d.get("metadata", {})
+            targets.append((d["kind"], meta.get("name", ""),
+                            meta.get("namespace", "")))
+    elif args.kind and args.name:
+        targets.append((args.kind, args.name, args.namespace or ""))
+    else:
+        print("delete needs -f or --kind/--name", file=sys.stderr)
+        return 2
+    for kind, name, ns in targets:
+        try:
+            platform.api.delete(kind, name, ns)
+            print(f"deleted {kind}/{name}")
+        except Exception as e:
+            print(f"error deleting {kind}/{name}: {e}", file=sys.stderr)
+            return 1
+    platform.reconcile()
+    platform.save(args.state_dir)
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    platform = Platform.load(args.state_dir)
+    platform.reconcile()
+    sys.stdout.write(platform.registry.render())
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpuctl",
+                                description="TPU-native Kubeflow control CLI")
+    p.add_argument("--state-dir", default=".tpuctl")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    ap = sub.add_parser("apply", help="apply platform config / manifests")
+    ap.add_argument("-f", "--filename", action="append", required=True)
+    ap.set_defaults(fn=cmd_apply)
+
+    gp = sub.add_parser("get", help="list resources of a kind")
+    gp.add_argument("kind")
+    gp.add_argument("-n", "--namespace", default=None)
+    gp.add_argument("-o", "--output", choices=("table", "yaml"),
+                    default="table")
+    gp.set_defaults(fn=cmd_get)
+
+    st = sub.add_parser("status", help="platform summary")
+    st.set_defaults(fn=cmd_status)
+
+    dp = sub.add_parser("delete", help="delete resources")
+    dp.add_argument("-f", "--filename", action="append")
+    dp.add_argument("--kind")
+    dp.add_argument("--name")
+    dp.add_argument("-n", "--namespace", default=None)
+    dp.set_defaults(fn=cmd_delete)
+
+    mp = sub.add_parser("metrics", help="dump platform metrics")
+    mp.set_defaults(fn=cmd_metrics)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,142 @@
+"""TpuJob worker entrypoint: the L0 payload contract.
+
+The analogue of the reference's launcher (tf-controller-examples/tf-cnn/
+launcher.py:59-93, which parsed TF_CONFIG into --job_name/--ps_hosts/...),
+but consuming the TpuJob controller's env contract instead:
+
+  KFTPU_COORDINATOR_ADDRESS   worker-0 headless-DNS:port
+  KFTPU_NUM_PROCESSES         gang size (one process per TPU-VM host)
+  KFTPU_PROCESS_ID            this pod's ordinal
+  KFTPU_SLICE_TYPE            e.g. v5e-16
+  KFTPU_MESH                  JSON {dp, fsdp, tp, sp, ep}
+  KFTPU_ATTN_IMPL             full | ring | ulysses
+  KFTPU_MODEL                 registry model name
+  KFTPU_CHECKPOINT_DIR        durable dir; auto-resume on restart
+  KFTPU_RESTART_COUNT         gang restart generation (informational)
+
+Instead of mpirun/PS gRPC, the gang joins one JAX distributed runtime
+(jax.distributed.initialize) and every collective is an XLA op over ICI
+(DCN across slices when MEGASCALE_* is set by the controller).
+
+Succeeding workers exit 0; the reference's "sleep forever on success"
+(launcher.py:90-93) is unnecessary because the TpuJob controller uses
+restartPolicy=Never and gang-level failure policy.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from kubeflow_tpu.utils import get_logger
+
+log = get_logger("runner")
+
+
+def env_config() -> dict:
+    mesh = json.loads(os.environ.get("KFTPU_MESH", "{}") or "{}")
+    return {
+        "coordinator": os.environ.get("KFTPU_COORDINATOR_ADDRESS", ""),
+        "num_processes": int(os.environ.get("KFTPU_NUM_PROCESSES", "1")),
+        "process_id": int(os.environ.get("KFTPU_PROCESS_ID", "0")),
+        "slice_type": os.environ.get("KFTPU_SLICE_TYPE", ""),
+        "mesh": mesh,
+        "attn_impl": os.environ.get("KFTPU_ATTN_IMPL", "full"),
+        "model": os.environ.get("KFTPU_MODEL", "llama-tiny"),
+        "checkpoint_dir": os.environ.get("KFTPU_CHECKPOINT_DIR", ""),
+        "restart_count": int(os.environ.get("KFTPU_RESTART_COUNT", "0")),
+        "steps": int(os.environ.get("KFTPU_TRAIN_STEPS", "100")),
+        "batch_per_host": int(os.environ.get("KFTPU_BATCH_PER_HOST", "8")),
+        "seq_len": int(os.environ.get("KFTPU_SEQ_LEN", "1024")),
+        "checkpoint_every": int(os.environ.get("KFTPU_CHECKPOINT_EVERY", "50")),
+    }
+
+
+def run(cfg: dict) -> int:
+    import jax
+
+    if cfg["num_processes"] > 1:
+        jax.distributed.initialize(
+            coordinator_address=cfg["coordinator"],
+            num_processes=cfg["num_processes"],
+            process_id=cfg["process_id"],
+        )
+    log.info(
+        "worker up",
+        kv={"pid": cfg["process_id"], "n": cfg["num_processes"],
+            "devices": len(jax.devices()), "restart": cfg["restart_count"]},
+    )
+
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import get_model
+    from kubeflow_tpu.topology import AxisSpec, make_host_local_mesh, plan_mesh, make_mesh
+    from kubeflow_tpu.train import CheckpointService, TrainConfig, Trainer
+    from kubeflow_tpu.train.data import SyntheticTextConfig, synthetic_text
+
+    model, model_cfg = get_model(cfg["model"])
+    axes = AxisSpec(**{k: int(v) for k, v in cfg["mesh"].items()}) \
+        if cfg["mesh"] else AxisSpec(dp=-1)
+    if cfg["slice_type"]:
+        plan = plan_mesh(cfg["slice_type"], axes)
+        mesh = make_mesh(plan)
+    else:
+        mesh = make_host_local_mesh(axes)
+
+    aux_w = float(getattr(model_cfg, "aux_loss_weight", 0.0) or 0.0)
+    trainer = Trainer(
+        model,
+        TrainConfig(task="lm", attn_impl=cfg["attn_impl"],
+                    total_steps=cfg["steps"], aux_loss_weight=aux_w),
+        mesh,
+    )
+    it = synthetic_text(SyntheticTextConfig(
+        batch_size=cfg["batch_per_host"] * cfg["num_processes"],
+        seq_len=cfg["seq_len"],
+        vocab_size=model_cfg.vocab_size,
+    ))
+    batch = trainer.shard_batch(
+        {k: jnp.asarray(v) for k, v in next(it).items()}
+    )
+    state = trainer.init_state(jax.random.PRNGKey(0), batch)
+
+    ckpt = None
+    if cfg["checkpoint_dir"]:
+        ckpt = CheckpointService(cfg["checkpoint_dir"])
+        restored = ckpt.restore_latest(jax.eval_shape(lambda: state))
+        if restored is not None:
+            state = restored
+            log.info("auto-resumed", kv={"step": int(state.step)})
+
+    start_step = int(state.step)
+    t0 = time.time()
+    for i in range(start_step, cfg["steps"]):
+        batch = trainer.shard_batch(
+            {k: jnp.asarray(v) for k, v in next(it).items()}
+        )
+        state, metrics = trainer.step(state, batch)
+        if ckpt is not None and (i + 1) % cfg["checkpoint_every"] == 0:
+            ckpt.save(int(state.step), state)
+        if (i + 1) % 10 == 0:
+            loss = float(metrics["loss"])
+            tps = (
+                cfg["batch_per_host"] * cfg["num_processes"] * cfg["seq_len"]
+                * (i + 1 - start_step) / max(time.time() - t0, 1e-9)
+            )
+            log.info("step", kv={"step": i + 1, "loss": f"{loss:.4f}",
+                                 "tokens_per_sec": f"{tps:.0f}"})
+    if ckpt is not None:
+        ckpt.save(int(state.step), state)
+        ckpt.close()
+    log.info("training complete", kv={"steps": cfg["steps"]})
+    return 0
+
+
+def main() -> int:
+    return run(env_config())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

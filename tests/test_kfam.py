@@ -1,0 +1,151 @@
+import json
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.controlplane.api import ObjectMeta, Profile, ProfileSpec
+from kubeflow_tpu.controlplane.controllers import ProfileController
+from kubeflow_tpu.controlplane.kfam import AccessManagement, KfamHttpServer
+from kubeflow_tpu.controlplane.kfam.service import Binding, KfamError
+from kubeflow_tpu.controlplane.runtime import ControllerManager, InMemoryApiServer
+from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+ADMIN = "root@corp.com"
+ALICE = "alice@corp.com"
+BOB = "bob@corp.com"
+
+
+@pytest.fixture()
+def world():
+    api = InMemoryApiServer()
+    reg = MetricsRegistry()
+    mgr = ControllerManager(api)
+    mgr.register(ProfileController(api, reg))
+    am = AccessManagement(api, reg)
+    # Bootstrap a cluster admin.
+    api.create(Profile(
+        metadata=ObjectMeta(name="admin-ns", labels={"cluster-admin": "true"}),
+        spec=ProfileSpec(owner=ADMIN),
+    ))
+    mgr.run_until_idle()
+    return api, mgr, am
+
+
+class TestAccessManagement:
+    def test_self_service_profile(self, world):
+        api, mgr, am = world
+        am.create_profile(ALICE, "alice-ns")
+        mgr.run_until_idle()
+        assert api.get("Namespace", "alice-ns").metadata.annotations["owner"] == ALICE
+        # Owner is implicit admin binding.
+        bindings = am.list_bindings(user=ALICE)
+        assert any(b.namespace == "alice-ns" and b.role == "admin"
+                   for b in bindings)
+
+    def test_cannot_create_profile_for_other_unless_admin(self, world):
+        _, _, am = world
+        with pytest.raises(KfamError) as e:
+            am.create_profile(ALICE, "bob-ns", owner=BOB)
+        assert e.value.status == 403
+        am.create_profile(ADMIN, "bob-ns", owner=BOB)  # admin may
+
+    def test_contributor_flow(self, world):
+        api, mgr, am = world
+        am.create_profile(ALICE, "alice-ns")
+        mgr.run_until_idle()
+        # Bob can't self-invite.
+        with pytest.raises(KfamError):
+            am.create_binding(BOB, Binding(user=BOB, namespace="alice-ns",
+                                           role="edit"))
+        # Alice grants Bob edit.
+        am.create_binding(ALICE, Binding(user=BOB, namespace="alice-ns",
+                                         role="edit"))
+        assert am.sar.can(BOB, "create", "alice-ns")
+        assert not am.sar.can(BOB, "admin", "alice-ns")
+        ap = api.get("AuthorizationPolicy", "ns-owner-access-istio", "alice-ns")
+        assert BOB in ap.principals
+        # Revoke.
+        am.delete_binding(ALICE, Binding(user=BOB, namespace="alice-ns",
+                                         role="edit"))
+        assert not am.sar.can(BOB, "get", "alice-ns")
+        ap = api.get("AuthorizationPolicy", "ns-owner-access-istio", "alice-ns")
+        assert BOB not in ap.principals
+        assert ALICE in ap.principals  # owner never removed
+
+    def test_duplicate_binding_conflicts(self, world):
+        _, mgr, am = world
+        am.create_profile(ALICE, "alice-ns")
+        mgr.run_until_idle()
+        b = Binding(user=BOB, namespace="alice-ns", role="view")
+        am.create_binding(ALICE, b)
+        with pytest.raises(KfamError) as e:
+            am.create_binding(ALICE, b)
+        assert e.value.status == 409
+
+    def test_delete_profile_authz(self, world):
+        _, mgr, am = world
+        am.create_profile(ALICE, "alice-ns")
+        mgr.run_until_idle()
+        with pytest.raises(KfamError):
+            am.delete_profile(BOB, "alice-ns")
+        am.delete_profile(ADMIN, "alice-ns")  # cluster admin may
+
+
+class TestKfamHttp:
+    def _req(self, port, method, path, caller=None, body=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+        )
+        if caller:
+            req.add_header("x-goog-authenticated-user-email", caller)
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_rest_roundtrip(self, world):
+        api, mgr, am = world
+        srv = KfamHttpServer(am)
+        srv.start()
+        try:
+            port = srv.port
+            s, _ = self._req(port, "POST", "/kfam/v1/profiles",
+                             caller=ALICE, body={"name": "alice-ns"})
+            assert s == 200
+            mgr.run_until_idle()
+            s, body = self._req(
+                port, "GET", f"/kfam/v1/bindings?user={ALICE}")
+            assert s == 200
+            assert any(b["namespace"] == "alice-ns"
+                       for b in body["bindings"])
+            s, body = self._req(port, "POST", "/kfam/v1/bindings",
+                                caller=ALICE,
+                                body={"user": BOB, "namespace": "alice-ns",
+                                      "role": "view"})
+            assert s == 200
+            s, body = self._req(
+                port, "GET", f"/kfam/v1/bindings?namespace=alice-ns&user={BOB}")
+            assert body["bindings"][0]["role"] == "view"
+            # Unauthenticated writes rejected.
+            s, _ = self._req(port, "POST", "/kfam/v1/profiles",
+                             body={"name": "x"})
+            assert s == 401
+            # Authz failure surfaces as 403.
+            s, _ = self._req(port, "POST", "/kfam/v1/bindings", caller=BOB,
+                             body={"user": BOB, "namespace": "alice-ns",
+                                   "role": "admin"})
+            assert s == 403
+            s, _ = self._req(
+                port, "DELETE",
+                f"/kfam/v1/bindings?user={BOB}&namespace=alice-ns&role=view",
+                caller=ALICE)
+            assert s == 200
+            s, ok = self._req(port, "GET", "/kfam/v1/role-clusteradmin",
+                              caller=ADMIN)
+            assert ok is True
+        finally:
+            srv.stop()

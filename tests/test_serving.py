@@ -1,0 +1,130 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import Llama, LlamaConfig
+from kubeflow_tpu.serving import ServingConfig, ServingEngine
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = LlamaConfig.tiny(max_seq_len=128)
+    model = Llama(cfg)
+    params = {
+        "params": model.init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32)
+        )["params"]
+    }
+    return model, params
+
+
+def greedy_reference(model, params, prompt, n_new):
+    """Generate by full re-forward each step — the semantic ground truth."""
+    toks = list(prompt)
+    for _ in range(n_new):
+        logits = model.apply(params, jnp.asarray([toks]))
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+class TestServingEngine:
+    def test_greedy_matches_full_reforward(self, model_and_params):
+        model, params = model_and_params
+        engine = ServingEngine(model, params,
+                               ServingConfig(max_batch=2, max_len=128))
+        prompt = [3, 14, 15, 92, 65]
+        rid = engine.submit(prompt, max_new_tokens=8)
+        results = engine.run()
+        assert len(results) == 1
+        ref = greedy_reference(model, params, prompt, 8)
+        assert results[0].tokens == ref
+        assert results[0].prompt_len == len(prompt)
+
+    def test_continuous_batching_isolation(self, model_and_params):
+        """Requests sharing a batch must produce the same tokens as when
+        run alone — slots must not leak into each other."""
+        model, params = model_and_params
+        prompts = [[1, 2, 3], [50, 60, 70, 80, 90, 100, 7], [9] * 20]
+        solo = []
+        for p in prompts:
+            eng = ServingEngine(model, params,
+                                ServingConfig(max_batch=1, max_len=128))
+            eng.submit(p, max_new_tokens=6)
+            solo.append(eng.run()[0].tokens)
+
+        eng = ServingEngine(model, params,
+                            ServingConfig(max_batch=3, max_len=128))
+        rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        batched = {r.request_id: r.tokens for r in eng.run()}
+        for rid, expect in zip(rids, solo):
+            assert batched[rid] == expect
+
+    def test_staggered_admission(self, model_and_params):
+        """More requests than slots: later requests admit as slots free."""
+        model, params = model_and_params
+        eng = ServingEngine(model, params,
+                            ServingConfig(max_batch=2, max_len=128))
+        rids = [eng.submit([i + 1, i + 2], max_new_tokens=3 + i)
+                for i in range(5)]
+        results = eng.run()
+        assert len(results) == 5
+        for i, rid in enumerate(rids):
+            assert len(eng.result(rid).tokens) == 3 + i
+
+    def test_eos_stops_early(self, model_and_params):
+        model, params = model_and_params
+        ref = greedy_reference(model, params, [5, 6, 7], 8)
+        eos = ref[2]  # force stop at third token
+        eng = ServingEngine(model, params,
+                            ServingConfig(max_batch=1, max_len=128))
+        eng.submit([5, 6, 7], max_new_tokens=8, eos_token=eos)
+        res = eng.run()[0]
+        assert res.finished_reason == "eos"
+        assert res.tokens == ref[:3]
+
+    def test_temperature_sampling_varies(self, model_and_params):
+        model, params = model_and_params
+        eng = ServingEngine(model, params,
+                            ServingConfig(max_batch=2, max_len=128))
+        a = eng.submit([1, 2, 3], max_new_tokens=12, temperature=2.0)
+        b = eng.submit([1, 2, 3], max_new_tokens=12, temperature=2.0)
+        eng.run()
+        assert eng.result(a).tokens != eng.result(b).tokens
+
+    def test_rejects_oversized_prompt(self, model_and_params):
+        model, params = model_and_params
+        eng = ServingEngine(model, params,
+                            ServingConfig(max_batch=1, max_len=64))
+        with pytest.raises(ValueError):
+            eng.submit(list(range(64)))
+        with pytest.raises(ValueError):
+            eng.submit([])
+
+    def test_latency_metrics_recorded(self, model_and_params):
+        model, params = model_and_params
+        eng = ServingEngine(model, params,
+                            ServingConfig(max_batch=1, max_len=128))
+        eng.submit([4, 5], max_new_tokens=4)
+        res = eng.run()[0]
+        assert res.latency_s > 0
+        assert 0 < res.ttft_s <= res.latency_s
+        assert eng.tokens_generated == 4
+
+
+class TestServingScannedModel:
+    def test_scanned_layers_cache_layout(self):
+        cfg = LlamaConfig.tiny(max_seq_len=128, scan_layers=True, num_layers=2)
+        model = Llama(cfg)
+        params = {
+            "params": model.init(
+                jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32)
+            )["params"]
+        }
+        prompt = [3, 14, 15]
+        eng = ServingEngine(model, params,
+                            ServingConfig(max_batch=2, max_len=128))
+        eng.submit(prompt, max_new_tokens=5)
+        out = eng.run()[0].tokens
+        ref = greedy_reference(model, params, prompt, 5)
+        assert out == ref

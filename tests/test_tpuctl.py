@@ -1,0 +1,147 @@
+"""tpuctl CLI contract tests, mirroring the reference's kfctl CI contracts:
+apply -> ready, second apply idempotent (kfctl_second_apply.py:12-24),
+delete leaves nothing (kfctl_delete_test.py:44-71)."""
+
+import io
+import json
+import os
+import sys
+
+import pytest
+import yaml
+
+from kubeflow_tpu.controlplane.platform import Platform
+from kubeflow_tpu.tools.tpuctl import main
+
+PLATFORM_YAML = """
+apiVersion: tpu.kubeflow.org/v1alpha1
+kind: PlatformConfig
+metadata:
+  name: kubeflow-tpu
+spec:
+  defaultSliceType: v5e-16
+"""
+
+JOB_YAML = """
+apiVersion: tpu.kubeflow.org/v1alpha1
+kind: TpuJob
+metadata:
+  name: train1
+  namespace: ml
+spec:
+  sliceType: v5e-16
+  model: llama-tiny
+"""
+
+PROFILE_YAML = """
+apiVersion: tpu.kubeflow.org/v1alpha1
+kind: Profile
+metadata:
+  name: ml
+spec:
+  owner: alice@corp.com
+  tpuChipQuota: 64
+"""
+
+
+def _write(tmp_path, name, content):
+    p = tmp_path / name
+    p.write_text(content)
+    return str(p)
+
+
+def _run(argv, capsys):
+    rc = main(argv)
+    out = capsys.readouterr().out
+    return rc, out
+
+
+class TestTpuctl:
+    def test_apply_get_status(self, tmp_path, capsys):
+        state = str(tmp_path / "state")
+        pf = _write(tmp_path, "platform.yaml", PLATFORM_YAML)
+        prof = _write(tmp_path, "profile.yaml", PROFILE_YAML)
+        job = _write(tmp_path, "job.yaml", JOB_YAML)
+
+        rc, out = _run(["--state-dir", state, "apply", "-f", pf, "-f", prof,
+                        "-f", job], capsys)
+        assert rc == 0
+        assert "applied PlatformConfig/kubeflow-tpu" in out
+        assert "applied TpuJob/train1" in out
+
+        rc, out = _run(["--state-dir", state, "get", "TpuJob"], capsys)
+        assert rc == 0
+        assert "train1" in out and "Running" in out
+
+        rc, out = _run(["--state-dir", state, "get", "Pod", "-n", "ml"],
+                       capsys)
+        assert out.count("train1-worker") == 4
+
+        rc, out = _run(["--state-dir", state, "status"], capsys)
+        data = json.loads(out)
+        assert "tpujob-controller" in data["components"]
+        assert data["resources"]["TpuJob"]["ml/train1"] == "Running"
+
+    def test_second_apply_idempotent(self, tmp_path, capsys):
+        state = str(tmp_path / "state")
+        pf = _write(tmp_path, "platform.yaml", PLATFORM_YAML)
+        prof = _write(tmp_path, "profile.yaml", PROFILE_YAML)
+        job = _write(tmp_path, "job.yaml", JOB_YAML)
+        _run(["--state-dir", state, "apply", "-f", pf, "-f", prof, "-f", job],
+             capsys)
+        before = yaml.safe_load_all(
+            open(os.path.join(state, "state.yaml"))
+        )
+        rv_before = {
+            (d.get("kind"), d.get("metadata", {}).get("name")):
+            d.get("metadata", {}).get("resourceVersion")
+            for d in before if d and d.get("kind") != "PlatformState"
+        }
+        rc, _ = _run(["--state-dir", state, "apply", "-f", pf, "-f", prof,
+                      "-f", job], capsys)
+        assert rc == 0
+        after = yaml.safe_load_all(open(os.path.join(state, "state.yaml")))
+        rv_after = {
+            (d.get("kind"), d.get("metadata", {}).get("name")):
+            d.get("metadata", {}).get("resourceVersion")
+            for d in after if d and d.get("kind") != "PlatformState"
+        }
+        changed = {
+            k for k in rv_before
+            if rv_after.get(k) != rv_before[k]
+        }
+        assert changed == set(), f"second apply mutated: {changed}"
+
+    def test_delete_leaves_nothing(self, tmp_path, capsys):
+        state = str(tmp_path / "state")
+        pf = _write(tmp_path, "platform.yaml", PLATFORM_YAML)
+        job = _write(tmp_path, "job.yaml", JOB_YAML)
+        prof = _write(tmp_path, "profile.yaml", PROFILE_YAML)
+        _run(["--state-dir", state, "apply", "-f", pf, "-f", prof, "-f", job],
+             capsys)
+        rc, out = _run(["--state-dir", state, "delete", "-f", job], capsys)
+        assert rc == 0
+        rc, out = _run(["--state-dir", state, "get", "Pod", "-n", "ml"],
+                       capsys)
+        assert "train1-worker" not in out
+        rc, out = _run(["--state-dir", state, "get", "TpuJob", "-n", "ml"],
+                       capsys)
+        assert "train1" not in out
+
+    def test_get_yaml_output(self, tmp_path, capsys):
+        state = str(tmp_path / "state")
+        pf = _write(tmp_path, "platform.yaml", PLATFORM_YAML)
+        prof = _write(tmp_path, "profile.yaml", PROFILE_YAML)
+        _run(["--state-dir", state, "apply", "-f", pf, "-f", prof], capsys)
+        rc, out = _run(["--state-dir", state, "get", "Profile", "-o", "yaml"],
+                       capsys)
+        docs = list(yaml.safe_load_all(out))
+        assert docs[0]["spec"]["owner"] == "alice@corp.com"
+
+    def test_metrics_endpoint(self, tmp_path, capsys):
+        state = str(tmp_path / "state")
+        pf = _write(tmp_path, "platform.yaml", PLATFORM_YAML)
+        _run(["--state-dir", state, "apply", "-f", pf], capsys)
+        rc, out = _run(["--state-dir", state, "metrics"], capsys)
+        assert rc == 0
+        assert "# TYPE kftpu_tpujob_reconcile_total counter" in out
