@@ -119,6 +119,11 @@ class ProfileController(Controller):
                                     owner_references=[owner]),
                 hard=hard,
             ), copy_fields=self._quota_copy)
+        elif self.api.try_get("ResourceQuota", "kf-resource-quota",
+                              name) is not None:
+            # Quota was cleared from the spec: a stale kf-resource-quota must
+            # not keep gating the namespace's TpuJobs.
+            self.api.delete("ResourceQuota", "kf-resource-quota", name)
 
         if profile.status.phase != "Ready":
             profile.status.phase = "Ready"

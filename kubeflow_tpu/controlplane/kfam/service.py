@@ -18,6 +18,7 @@ Two layers:
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -66,10 +67,12 @@ class AccessManagement:
         registry: MetricsRegistry = global_registry,
         *,
         user_id_header: str = "x-goog-authenticated-user-email",
+        default_chip_quota: int = 0,
     ):
         self.api = api
         self.sar = SubjectAccessReviewer(api)
         self.user_id_header = user_id_header
+        self.default_chip_quota = default_chip_quota
         self.requests = registry.counter(
             "kftpu_kfam_requests_total", "kfam ops", ("op", "result")
         )
@@ -88,11 +91,19 @@ class AccessManagement:
     # ------------- profiles -------------
 
     def create_profile(self, caller: str, name: str, owner: str = "",
-                       tpu_chip_quota: int = 0) -> Profile:
+                       tpu_chip_quota: Optional[int] = None) -> Profile:
         self.heartbeat.beat()
         owner = owner or caller
         if owner != caller and not self.sar.is_cluster_admin(caller):
             raise KfamError(403, "only cluster admins create profiles for others")
+        # Chip quota is an admin knob: self-service profiles always get the
+        # platform default; a caller-chosen quota (including 0 = unlimited)
+        # requires cluster admin.
+        if tpu_chip_quota is None:
+            tpu_chip_quota = self.default_chip_quota
+        elif (tpu_chip_quota != self.default_chip_quota
+              and not self.sar.is_cluster_admin(caller)):
+            raise KfamError(403, "only cluster admins may set tpu_chip_quota")
         try:
             p = self.api.create(Profile(
                 metadata=ObjectMeta(name=name),
@@ -121,14 +132,29 @@ class AccessManagement:
 
     @staticmethod
     def _binding_name(user: str, role: str) -> str:
+        # Sanitising '@'/'.' to '-' alone collides ('a.b@c' vs 'a-b@c');
+        # a digest of the raw user string keeps names unique per user.
         safe = user.replace("@", "-").replace(".", "-")
-        return f"user-{safe}-clusterrole-{ROLE_MAP[role]}"
+        digest = hashlib.sha256(user.encode()).hexdigest()[:8]
+        return f"user-{safe}-{digest}-clusterrole-{ROLE_MAP[role]}"
+
+    def _find_binding(self, b: Binding):
+        """Locate the RoleBinding for (user, role, namespace) by its
+        annotations, so grants created under older naming schemes stay
+        manageable after upgrades."""
+        for rb in self.api.list("RoleBinding", namespace=b.namespace):
+            if (rb.metadata.annotations.get("user") == b.user
+                    and rb.metadata.annotations.get("role") == b.role):
+                return rb
+        return None
 
     def create_binding(self, caller: str, b: Binding) -> None:
         self.heartbeat.beat()
         if b.role not in ROLE_MAP:
             raise KfamError(400, f"unknown role {b.role!r}")
         self._require_ns_admin(caller, b.namespace)
+        if self._find_binding(b) is not None:
+            raise KfamError(409, "binding exists")
         rb = RoleBinding(
             metadata=ObjectMeta(
                 name=self._binding_name(b.user, b.role),
@@ -154,12 +180,10 @@ class AccessManagement:
     def delete_binding(self, caller: str, b: Binding) -> None:
         self.heartbeat.beat()
         self._require_ns_admin(caller, b.namespace)
-        try:
-            self.api.delete(
-                "RoleBinding", self._binding_name(b.user, b.role), b.namespace
-            )
-        except NotFoundError:
+        rb = self._find_binding(b)
+        if rb is None:
             raise KfamError(404, "binding not found")
+        self.api.delete("RoleBinding", rb.metadata.name, b.namespace)
         ap = self.api.try_get(
             "AuthorizationPolicy", "ns-owner-access-istio", b.namespace
         )
@@ -266,9 +290,14 @@ class KfamHttpServer:
                 try:
                     body = self._body()
                     if url.path == "/kfam/v1/profiles":
+                        quota = body.get("tpuChipQuota")
+                        if quota is not None:
+                            try:
+                                quota = int(quota)
+                            except (ValueError, TypeError) as e:
+                                raise KfamError(400, f"bad tpuChipQuota: {e}")
                         p = am_ref.create_profile(
-                            caller, body["name"], body.get("owner", ""),
-                            int(body.get("tpuChipQuota", 0)),
+                            caller, body["name"], body.get("owner", ""), quota,
                         )
                         self._send(200, {"name": p.metadata.name})
                     elif url.path == "/kfam/v1/bindings":

@@ -82,7 +82,10 @@ class Platform:
         existing = self.api.try_get("PlatformConfig", cfg.metadata.name)
         if existing is None:
             self.api.create(cfg)
-        else:
+        elif existing.spec != cfg.spec or existing.status != cfg.status:
+            # Second-apply idempotency contract (reference
+            # testing/kfctl/kfctl_second_apply.py:12-24): an apply that
+            # changes nothing must not bump any resourceVersion.
             existing.spec = cfg.spec
             existing.status = cfg.status
             self.api.update(existing)
@@ -121,6 +124,7 @@ class Platform:
         elif name == "kfam":
             self.kfam = AccessManagement(
                 self.api, reg, user_id_header=cfg.spec.user_id_header,
+                default_chip_quota=int(params.get("defaultChipQuota", 0)),
             )
         elif name == "fake-kubelet":
             self.manager.register(FakeKubelet(self.api, reg))

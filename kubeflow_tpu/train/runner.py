@@ -105,7 +105,15 @@ def run(cfg: dict) -> int:
     ckpt = None
     if cfg["checkpoint_dir"]:
         ckpt = CheckpointService(cfg["checkpoint_dir"])
-        restored = ckpt.restore_latest(jax.eval_shape(lambda: state))
+        # Template carries the live mesh's shardings so orbax lands arrays
+        # directly in-layout (a bare eval_shape template would fall back to
+        # checkpoint-recorded shardings — wrong after a topology change).
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding),
+            state,
+        )
+        restored = ckpt.restore_latest(abstract)
         if restored is not None:
             state = restored
             log.info("auto-resumed", kv={"step": int(state.step)})

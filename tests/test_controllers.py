@@ -297,6 +297,21 @@ class TestProfile:
         assert ap.principals == ["bob@example.com"]
         assert api.get("Profile", "team-b").status.phase == "Ready"
 
+    def test_clearing_quota_deletes_resource_quota(self):
+        api, mgr, _ = make_world()
+        api.create(Profile(
+            metadata=ObjectMeta(name="team-q"),
+            spec=ProfileSpec(owner="q@example.com", tpu_chip_quota=16),
+        ))
+        mgr.run_until_idle()
+        assert api.get("ResourceQuota", "kf-resource-quota", "team-q")
+        p = api.get("Profile", "team-q")
+        p.spec.tpu_chip_quota = 0
+        api.update(p)
+        mgr.run_until_idle()
+        assert api.try_get("ResourceQuota", "kf-resource-quota",
+                           "team-q") is None
+
     def test_profile_delete_cascades(self):
         api, mgr, _ = make_world()
         api.create(Profile(metadata=ObjectMeta(name="team-c"),

@@ -82,6 +82,42 @@ class TestAccessManagement:
             am.create_binding(ALICE, b)
         assert e.value.status == 409
 
+    def test_chip_quota_is_admin_only(self, world):
+        api, mgr, am = world
+        am.default_chip_quota = 8
+        # Self-service gets the platform default, not a caller-chosen quota.
+        with pytest.raises(KfamError) as e:
+            am.create_profile(ALICE, "alice-ns", tpu_chip_quota=1024)
+        assert e.value.status == 403
+        with pytest.raises(KfamError) as e:
+            am.create_profile(ALICE, "alice-ns", tpu_chip_quota=0)  # no opt-out
+        assert e.value.status == 403
+        p = am.create_profile(ALICE, "alice-ns")
+        assert p.spec.tpu_chip_quota == 8
+        # Cluster admin may set any quota.
+        p = am.create_profile(ADMIN, "big-ns", owner=BOB, tpu_chip_quota=1024)
+        assert p.spec.tpu_chip_quota == 1024
+
+    def test_binding_names_do_not_collide(self, world):
+        _, mgr, am = world
+        am.create_profile(ALICE, "alice-ns")
+        mgr.run_until_idle()
+        # 'a.b@c' and 'a-b@c' sanitise to the same string; the digest suffix
+        # must keep their bindings distinct.
+        am.create_binding(ALICE, Binding(user="a.b@c", namespace="alice-ns",
+                                         role="view"))
+        am.create_binding(ALICE, Binding(user="a-b@c", namespace="alice-ns",
+                                         role="view"))
+        users = {b.user for b in am.list_bindings(namespace="alice-ns",
+                                                  role="view")}
+        assert {"a.b@c", "a-b@c"} <= users
+        # Deleting one must not remove the other.
+        am.delete_binding(ALICE, Binding(user="a.b@c", namespace="alice-ns",
+                                         role="view"))
+        users = {b.user for b in am.list_bindings(namespace="alice-ns",
+                                                  role="view")}
+        assert "a-b@c" in users and "a.b@c" not in users
+
     def test_delete_profile_authz(self, world):
         _, mgr, am = world
         am.create_profile(ALICE, "alice-ns")
