@@ -25,6 +25,8 @@ def main() -> None:
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--attn", default="flash",
+                   choices=["full", "flash", "ring", "ulysses"])
     args = p.parse_args()
 
     import jax
@@ -34,6 +36,10 @@ def main() -> None:
     from kubeflow_tpu.topology import AxisSpec, make_host_local_mesh
     from kubeflow_tpu.train import TrainConfig, Trainer
     from kubeflow_tpu.train.data import SyntheticTextConfig, synthetic_text
+    from kubeflow_tpu.train.flops import (
+        device_peak_tflops,
+        train_flops_per_token,
+    )
 
     # ~700M-param Llama: big enough that the MXU dominates, small enough
     # for one v5e chip (16G HBM) with f32 Adam state + grads + activations.
@@ -47,7 +53,8 @@ def main() -> None:
     mesh = make_host_local_mesh(AxisSpec(dp=-1))
     trainer = Trainer(
         model,
-        TrainConfig(task="lm", warmup_steps=10, total_steps=1000),
+        TrainConfig(task="lm", warmup_steps=10, total_steps=1000,
+                    attn_impl=args.attn),
         mesh,
     )
     it = synthetic_text(
@@ -79,6 +86,11 @@ def main() -> None:
 
     tokens = args.batch_size * ndev * args.seq_len * args.steps
     tps_chip = tokens / dt / ndev
+    flops_per_token = train_flops_per_token(cfg, args.seq_len)
+    peak = device_peak_tflops()
+    mfu = (
+        tps_chip * flops_per_token / (peak * 1e12) if peak > 0 else 0.0
+    )
     print(
         json.dumps(
             {
@@ -86,6 +98,11 @@ def main() -> None:
                 "value": round(tps_chip, 1),
                 "unit": "tokens/s/chip",
                 "vs_baseline": round(tps_chip / BASELINE_TOKENS_PER_SEC, 3),
+                "mfu": round(mfu, 4),
+                "model_tflops_per_chip": round(
+                    tps_chip * flops_per_token / 1e12, 2
+                ),
+                "attn": args.attn,
             }
         )
     )

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from kubeflow_tpu.ops.attention import mha_reference
+from kubeflow_tpu.ops.flash_attention import flash_attention
 from kubeflow_tpu.ops.norms import rms_norm
 from kubeflow_tpu.ops.rope import apply_rope, rope_frequencies
 from kubeflow_tpu.parallel.context import constrain, get_context
@@ -28,6 +29,20 @@ from kubeflow_tpu.parallel.ring_attention import ring_attention_sharded
 from kubeflow_tpu.parallel.ulysses import ulysses_attention_sharded
 
 Dtype = Any
+
+
+def _vocab_axis_sharded() -> bool:
+    """True when the ambient context shards the "vocab" logical axis over a
+    >1-sized mesh axis (the embedding lookup then switches to a one-hot
+    contraction; see Llama.__call__)."""
+    ctx = get_context()
+    if ctx.mesh is None:
+        return False
+    rule = dict(ctx.rules).get("vocab")
+    if rule is None:
+        return False
+    axes = (rule,) if isinstance(rule, str) else tuple(rule)
+    return any(ctx.mesh.shape.get(a, 1) > 1 for a in axes)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -158,6 +173,15 @@ class Attention(nn.Module):
             return ulysses_attention_sharded(
                 q, k, v, ctx.mesh, causal=True
             )
+        if ctx.attn_impl == "flash":
+            if ctx.sp_size > 1:
+                # Sequence-sharded activations: the pallas call can't be
+                # SPMD-partitioned on seq, so route through the ring (which
+                # itself uses the flash kernel per block when supported).
+                return ring_attention_sharded(q, k, v, ctx.mesh, causal=True)
+            # Fused pallas kernel (falls back to reference on un-blockable
+            # shapes).
+            return flash_attention(q, k, v, causal=True)
         return mha_reference(q, k, v, causal=True)
 
     def _decode_attention(self, q, k, v) -> jax.Array:
@@ -278,7 +302,20 @@ class Llama(nn.Module):
             (cfg.vocab_size, cfg.embed_dim),
             cfg.param_dtype,
         )
-        x = jnp.take(embed, tokens, axis=0).astype(cfg.dtype)
+        if _vocab_axis_sharded():
+            # One-hot matmul instead of gather: SPMD partitions a contraction
+            # over the tp-sharded vocab axis cleanly (psum over shards),
+            # whereas a gather whose indexed dim is sharded forces XLA into
+            # "involuntary full rematerialization" (replicate + repartition
+            # of [B,S,E] every step). XLA fuses the one-hot into the matmul,
+            # so it never materialises [B,S,V].
+            one_hot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=cfg.dtype)
+            x = jnp.einsum(
+                "bsv,ve->bse", one_hot, embed.astype(cfg.dtype),
+                preferred_element_type=jnp.float32,
+            ).astype(cfg.dtype)
+        else:
+            x = jnp.take(embed, tokens, axis=0).astype(cfg.dtype)
         x = constrain(x, ("act_batch", "act_seq", "act_embed"))
 
         layer_cls = type(self).LAYER_CLS

@@ -1,0 +1,506 @@
+"""Pallas TPU flash attention: fused blockwise softmax-attention.
+
+The reference platform has no attention math at all (it schedules
+containers; SURVEY.md §2.5 "TP/PP/SP/EP: absent"), so this kernel is pure
+TPU-first design: the O(S^2) score matrix never touches HBM. Q/K/V stream
+through VMEM in MXU-shaped blocks; softmax statistics (running max m and
+denominator l) live in VMEM scratch across the kv-block grid dimension,
+following the online-softmax recurrence. The backward pass recomputes
+p = exp(s - lse) blockwise from the saved logsumexp instead of storing
+attention weights (flash-attention-2 style):
+
+    fwd:  acc <- acc * exp(m - m') + exp(s - m') @ v,   o = acc / l
+    bwd:  ds  = p * (dp - delta),  dp = do @ v^T, delta = rowsum(do * o)
+
+GQA is folded into the grid: kv blocks are indexed by ``h // group`` in the
+forward/dq kernels, and the dk/dv kernel iterates (kv_head, group_member)
+so each kv head's gradient accumulates over its query group without ever
+materialising repeated k/v.
+
+The causal-mask offset (q position of row 0 minus kv position of col 0) is
+a *traced* scalar passed through SMEM, because ring attention computes it
+per device from ``lax.axis_index`` inside shard_map — a static offset could
+not express "each device's query block starts mid-sequence".
+
+Exposed as:
+- ``flash_attention(q, k, v, causal=...)``        -> o           (training)
+- ``flash_attention_lse(q, k, v, ...)``           -> (o, lse)    (ring
+  attention merges per-block normalized outputs across ppermute steps; the
+  custom VJP folds the lse cotangent into delta, see _bwd_impl)
+
+Layouts are model-native [B, S, H, D]; wrappers transpose to the kernel's
+[B, H, S, D]. Falls back to ops.attention.mha_reference when shapes don't
+block cleanly (tiny test configs). Interpret mode picks itself on CPU so
+the same tests run hardware-free (SURVEY.md §4: envtest-style fakes first).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # finite: exp(NEG_INF - NEG_INF) must not NaN on fully
+                 # masked rows (ring attention sees those every step)
+LANES = 128      # m/l scratch lane width (TPU vector lane count)
+STATS_LANES = 8  # minor dim of the lse/delta HBM arrays: TPU block specs
+                 # need the last dim to be 128-divisible or equal to the
+                 # array dim, so rank-3 [B,H,S] blocks are not loadable —
+                 # stats travel as [B,H,S,8] with identical lanes
+
+
+@dataclasses.dataclass(frozen=True)
+class _FlashConfig:
+    causal: bool
+    scale: float
+    block_q: int
+    block_kv: int
+    interpret: bool
+
+
+def _causal_mask_block(cfg: _FlashConfig, off, i, j, bq, bkv):
+    """Bool [bq, bkv] mask for q block i vs kv block j, True = attend.
+    ``off`` is the (traced) absolute position of q row 0 minus kv col 0."""
+    q_pos = i * cfg.block_q + off + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, bkv), 0
+    )
+    kv_pos = j * cfg.block_kv + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, bkv), 1
+    )
+    return q_pos >= kv_pos
+
+
+def _block_live(cfg: _FlashConfig, off, i, j):
+    """Whether kv block j contributes anything to q block i under the
+    causal mask (first kv position <= last q position)."""
+    last_q = i * cfg.block_q + cfg.block_q - 1 + off
+    return last_q >= j * cfg.block_kv
+
+
+# ----------------------------- forward -----------------------------------
+
+
+def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc, m_scr, l_scr, *, cfg: _FlashConfig):
+    i, j = pl.program_id(2), pl.program_id(3)
+    nj = pl.num_programs(3)
+    off = off_ref[0, 0]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc[:] = jnp.zeros_like(acc)
+
+    live = _block_live(cfg, off, i, j) if cfg.causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0]                               # [bq, D]
+        k = k_ref[0, 0]                               # [bkv, D]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * cfg.scale                                  # [bq, bkv]
+        if cfg.causal:
+            mask = _causal_mask_block(cfg, off, i, j, s.shape[0], s.shape[1])
+            s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_scr[:]                              # [bq, LANES]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)     # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)             # broadcast -> [bq, LANES]
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])                  # [bq, bkv]
+        if cfg.causal:
+            p = jnp.where(mask, p, 0.0)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                              # [bq, D]
+        acc[:] = acc[:] * alpha[:, :1] + pv
+        m_scr[:] = m_new
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        o_ref[0, 0] = jnp.where(
+            l > 0, acc[:] / jnp.maximum(l, 1e-30), 0.0
+        ).astype(o_ref.dtype)
+        m0 = m_scr[:, :STATS_LANES]
+        l0 = l_scr[:, :STATS_LANES]
+        lse_ref[0, 0] = jnp.where(
+            l0 > 0, m0 + jnp.log(jnp.maximum(l0, 1e-30)), NEG_INF
+        )
+
+
+def _smem_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _fwd_impl(cfg: _FlashConfig, off, q, k, v) -> Tuple[jax.Array, jax.Array]:
+    """q [B,H,Sq,D]; k,v [B,Hkv,Skv,D] -> o [B,H,Sq,D] and lse
+    [B,H,Sq,STATS_LANES] f32 (all lanes identical; see STATS_LANES)."""
+    B, H, Sq, D = q.shape
+    _, Hkv, Skv, _ = k.shape
+    G = H // Hkv
+    bq, bkv = cfg.block_q, cfg.block_kv
+    grid = (B, H, Sq // bq, Skv // bkv)
+
+    kv_spec = pl.BlockSpec(
+        (1, 1, bkv, D), lambda b, h, i, j: (b, h // G, j, 0)
+    )
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, cfg=cfg),
+        grid=grid,
+        in_specs=[
+            _smem_spec(),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, STATS_LANES),
+                         lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sq, STATS_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+        ],
+        interpret=cfg.interpret,
+    )(off.reshape(1, 1), q, k, v)
+    return o, lse
+
+
+# ----------------------------- backward -----------------------------------
+
+
+def _dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_acc, *, cfg: _FlashConfig):
+    i, j = pl.program_id(2), pl.program_id(3)
+    nj = pl.num_programs(3)
+    off = off_ref[0, 0]
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = _block_live(cfg, off, i, j) if cfg.causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]                     # [bq, 1]
+        delta = delta_ref[0, 0][:, :1]                 # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * cfg.scale
+        p = jnp.exp(s - lse)
+        if cfg.causal:
+            mask = _causal_mask_block(cfg, off, i, j, s.shape[0], s.shape[1])
+            p = jnp.where(mask, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                              # [bq, bkv]
+        ds = p * (dp - delta) * cfg.scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, cfg: _FlashConfig):
+    # grid: (B, Hkv, j, g, i) — q-block i innermost, then group member g,
+    # so dk/dv for kv head hkv accumulate over the whole query group.
+    j, g, i = pl.program_id(2), pl.program_id(3), pl.program_id(4)
+    ng, ni = pl.num_programs(3), pl.num_programs(4)
+    off = off_ref[0, 0]
+
+    @pl.when((g == 0) & (i == 0))
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = _block_live(cfg, off, i, j) if cfg.causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * cfg.scale
+        p = jnp.exp(s - lse)
+        if cfg.causal:
+            mask = _causal_mask_block(cfg, off, i, j, s.shape[0], s.shape[1])
+            p = jnp.where(mask, p, 0.0)
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                              # [bkv, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * cfg.scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when((g == ng - 1) & (i == ni - 1))
+    def _finish():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_impl(cfg: _FlashConfig, off, q, k, v, o, lse, do, dlse=None):
+    """Gradients for [B,H,S,D]-layout inputs. ``dlse`` (cotangent of the
+    lse output, used by ring-attention merging) folds into delta:
+    ds = p * (dp - delta + dlse)."""
+    B, H, Sq, D = q.shape
+    _, Hkv, Skv, _ = k.shape
+    G = H // Hkv
+    bq, bkv = cfg.block_q, cfg.block_kv
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        # lse lanes are copies, so the true lse cotangent is the lane sum.
+        delta = delta - jnp.sum(dlse, axis=-1)        # [B, H, Sq]
+    delta = jnp.broadcast_to(delta[..., None],
+                             (*delta.shape, STATS_LANES))
+
+    kv_spec = pl.BlockSpec(
+        (1, 1, bkv, D), lambda b, h, i, j: (b, h // G, j, 0)
+    )
+    q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
+    r_spec = pl.BlockSpec((1, 1, bq, STATS_LANES),
+                          lambda b, h, i, j: (b, h, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, cfg=cfg),
+        grid=(B, H, Sq // bq, Skv // bkv),
+        in_specs=[_smem_spec(), q_spec, kv_spec, kv_spec, q_spec,
+                  r_spec, r_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=cfg.interpret,
+    )(off.reshape(1, 1), q, k, v, do, lse, delta)
+
+    # dk/dv: kv-block-major grid, query group folded in.
+    qg_spec = pl.BlockSpec(
+        (1, 1, bq, D), lambda b, hkv, j, g, i: (b, hkv * G + g, i, 0)
+    )
+    rg_spec = pl.BlockSpec(
+        (1, 1, bq, STATS_LANES),
+        lambda b, hkv, j, g, i: (b, hkv * G + g, i, 0),
+    )
+    kvg_spec = pl.BlockSpec(
+        (1, 1, bkv, D), lambda b, hkv, j, g, i: (b, hkv, j, 0)
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, cfg=cfg),
+        grid=(B, Hkv, Skv // bkv, G, Sq // bq),
+        in_specs=[_smem_spec(), qg_spec, kvg_spec, kvg_spec, qg_spec,
+                  rg_spec, rg_spec],
+        out_specs=[kvg_spec, kvg_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hkv, Skv, D), k.dtype),
+            jax.ShapeDtypeStruct((B, Hkv, Skv, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bkv, D), jnp.float32),
+            pltpu.VMEM((bkv, D), jnp.float32),
+        ],
+        interpret=cfg.interpret,
+    )(off.reshape(1, 1), q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+def _int_cotangent():
+    # Cotangent for the int32 offset primal: float0 (no gradient exists).
+    return np.zeros((), dtype=jax.dtypes.float0)
+
+
+# ----------------------------- custom VJPs --------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash(cfg: _FlashConfig, off, q, k, v):
+    o, _ = _fwd_impl(cfg, off, q, k, v)
+    return o
+
+
+def _flash_fwd(cfg, off, q, k, v):
+    o, lse = _fwd_impl(cfg, off, q, k, v)
+    return o, (off, q, k, v, o, lse)
+
+
+def _flash_bwd(cfg, res, do):
+    off, q, k, v, o, lse = res
+    dq, dk, dv = _bwd_impl(cfg, off, q, k, v, o, lse, do)
+    return _int_cotangent(), dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash_lse(cfg: _FlashConfig, off, q, k, v):
+    return _fwd_impl(cfg, off, q, k, v)
+
+
+def _flash_lse_fwd(cfg, off, q, k, v):
+    o, lse = _fwd_impl(cfg, off, q, k, v)
+    return (o, lse), (off, q, k, v, o, lse)
+
+
+def _flash_lse_bwd(cfg, res, cots):
+    off, q, k, v, o, lse = res
+    do, dlse = cots
+    dq, dk, dv = _bwd_impl(cfg, off, q, k, v, o, lse, do, dlse=dlse)
+    return _int_cotangent(), dq, dk, dv
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+# ----------------------------- public wrappers ----------------------------
+
+
+def _supported(Sq: int, Skv: int, H: int, Hkv: int, bq: int, bkv: int) -> bool:
+    return (
+        H % Hkv == 0
+        and Sq % bq == 0
+        and Skv % bkv == 0
+        and bq % 8 == 0
+        and bkv % 128 == 0
+    )
+
+
+def _auto_interpret(interpret: Optional[bool]) -> bool:
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() == "cpu"
+
+
+IntLike = Union[int, jax.Array]
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 1024,
+    block_kv: int = 1024,
+    q_offset: IntLike = 0,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Model-layout entry: q [B, Sq, H, D]; k, v [B, Skv, Hkv, D] ->
+    [B, Sq, H, D]. ``q_offset`` follows mha_reference's convention of 0
+    meaning q starts at absolute position Skv - Sq (decode alignment).
+
+    Semantics match ops.attention.mha_reference (tested in
+    tests/test_flash_attention.py); falls back to it for shapes that don't
+    block cleanly."""
+    B, Sq, H, D = q.shape
+    _, Skv, Hkv, _ = k.shape
+    bq, bkv = min(block_q, Sq), min(block_kv, Skv)
+    if not _supported(Sq, Skv, H, Hkv, bq, bkv):
+        from kubeflow_tpu.ops.attention import causal_mask, mha_reference
+        if causal and not (isinstance(q_offset, int) and q_offset == 0):
+            # mha_reference's causal path assumes q starts at Skv - Sq; a
+            # shifted q block needs the mask built explicitly.
+            cm = causal_mask(Sq, Skv, q_offset=q_offset + (Skv - Sq))
+            return mha_reference(q, k, v, mask=cm[None, None], scale=scale)
+        return mha_reference(q, k, v, causal=causal, scale=scale)
+    cfg = _FlashConfig(
+        causal=causal,
+        scale=(D ** -0.5) if scale is None else scale,
+        block_q=bq,
+        block_kv=bkv,
+        interpret=_auto_interpret(interpret),
+    )
+    off = jnp.asarray(q_offset, jnp.int32) + (Skv - Sq)
+    o = _flash(cfg, off, q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+               v.transpose(0, 2, 1, 3))
+    return o.transpose(0, 2, 1, 3)
+
+
+def flash_attention_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 1024,
+    block_kv: int = 1024,
+    q_offset: IntLike = 0,
+    kv_offset: IntLike = 0,
+    interpret: Optional[bool] = None,
+) -> Optional[Tuple[jax.Array, jax.Array]]:
+    """(o, lse) variant for blockwise composition (ring attention): offsets
+    are *absolute* sequence positions of q[0] / k[0] and may be traced
+    scalars (lax.axis_index-derived). Returns None when the shapes aren't
+    kernel-supported (caller falls back). o is normalized per block; merge
+    blocks with ``merge_attention_blocks``."""
+    B, Sq, H, D = q.shape
+    _, Skv, Hkv, _ = k.shape
+    bq, bkv = min(block_q, Sq), min(block_kv, Skv)
+    if not _supported(Sq, Skv, H, Hkv, bq, bkv):
+        return None
+    cfg = _FlashConfig(
+        causal=causal,
+        scale=(D ** -0.5) if scale is None else scale,
+        block_q=bq,
+        block_kv=bkv,
+        interpret=_auto_interpret(interpret),
+    )
+    off = jnp.asarray(q_offset, jnp.int32) - jnp.asarray(kv_offset, jnp.int32)
+    o, lse = _flash_lse(cfg, off, q.transpose(0, 2, 1, 3),
+                        k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3))
+    return o.transpose(0, 2, 1, 3), lse[..., 0]
+
+
+def merge_attention_blocks(
+    o1: jax.Array, lse1: jax.Array, o2: jax.Array, lse2: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Combine two normalized partial attentions over disjoint kv blocks.
+    o: [B, S, H, D]; lse: [B, H, S]. Fully-masked blocks carry lse=NEG_INF
+    and zero o, so they drop out of the weighted sum."""
+    lse_new = jnp.logaddexp(lse1, lse2)
+    w1 = jnp.exp(lse1 - lse_new).transpose(0, 2, 1)[..., None]
+    w2 = jnp.exp(lse2 - lse_new).transpose(0, 2, 1)[..., None]
+    o = o1.astype(jnp.float32) * w1 + o2.astype(jnp.float32) * w2
+    return o.astype(o1.dtype), lse_new

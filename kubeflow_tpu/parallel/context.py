@@ -24,7 +24,8 @@ from kubeflow_tpu.parallel.sharding import DEFAULT_RULES, Rules, logical_spec
 class ParallelContext:
     mesh: Optional[Mesh] = None
     rules: Rules = DEFAULT_RULES
-    # "full" | "ring" | "ulysses" — how attention handles the sequence axis.
+    # "full" | "flash" | "ring" | "ulysses" — how attention handles the
+    # sequence axis ("flash": fused pallas kernel, sequence unsharded).
     attn_impl: str = "full"
 
     @property
@@ -49,7 +50,7 @@ def parallel_context(
     rules: Rules = DEFAULT_RULES,
     attn_impl: str = "full",
 ) -> Iterator[ParallelContext]:
-    if attn_impl not in ("full", "ring", "ulysses"):
+    if attn_impl not in ("full", "flash", "ring", "ulysses"):
         raise ValueError(f"unknown attn_impl {attn_impl!r}")
     ctx = ParallelContext(mesh=mesh, rules=rules, attn_impl=attn_impl)
     token = _ctx.set(ctx)

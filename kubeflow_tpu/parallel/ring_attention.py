@@ -91,6 +91,14 @@ def _block_attn_update(
     return o, m_new, l
 
 
+def _ring_flash_supported(q, k) -> bool:
+    # Mirrors the default block choice inside flash_attention_lse.
+    from kubeflow_tpu.ops.flash_attention import _supported
+    B, Sq, H, D = q.shape
+    _, Skv, Hkv, _ = k.shape
+    return _supported(Sq, Skv, H, Hkv, min(1024, Sq), min(1024, Skv))
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -99,11 +107,16 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = True,
     scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
 ) -> jax.Array:
     """Ring attention body — call INSIDE shard_map with q/k/v sequence-sharded
     over ``axis_name``. Shapes per device: q [B, Sq, H, D], k/v [B, Skv, Hkv, D].
 
-    GQA: kv heads are repeated locally to match q heads (cheap: Hkv small).
+    Per-block attention runs through the pallas flash kernel when the local
+    shapes block cleanly (``flash_attention_lse`` + logsumexp-weighted merge
+    across rotations); otherwise the jnp online-softmax update. Either way
+    the rotating payload stays [B, Skv, Hkv, D] (GQA heads are never
+    repeated over the wire).
     """
     B, Sq, H, D = q.shape
     _, Skv, Hkv, _ = k.shape
@@ -115,13 +128,49 @@ def ring_attention(
     idx = lax.axis_index(axis_name)
     q_offset = idx * Sq
 
-    o0 = jnp.zeros((B, Sq, H, D), jnp.float32)
-    m0 = jnp.full((B, H, Sq), _NEG, jnp.float32)
-    l0 = jnp.zeros((B, H, Sq), jnp.float32)
-
     # Send-to-next / receive-from-previous: after j rotations this device
     # holds the block originally owned by (idx - j) mod P.
     perm = [(i, (i + 1) % P_) for i in range(P_)]
+
+    supported = _ring_flash_supported(q, k)
+    # use_flash=True is a hint, not a forcing: unsupported shapes always take
+    # the jnp online-softmax path.
+    use_flash = supported if use_flash is None else (use_flash and supported)
+
+    if use_flash:
+        from kubeflow_tpu.ops.flash_attention import (
+            NEG_INF,
+            flash_attention_lse,
+            merge_attention_blocks,
+        )
+
+        o0 = jnp.zeros((B, Sq, H, D), jnp.float32)
+        lse0 = jnp.full((B, H, Sq), NEG_INF, jnp.float32)
+
+        def body(j, state):
+            o, lse, kj, vj = state
+            kv_offset = ((idx - j) % P_) * Skv
+            res = flash_attention_lse(
+                q, kj, vj, causal=causal, scale=scale_,
+                q_offset=q_offset, kv_offset=kv_offset,
+            )
+            if res is None:  # _ring_flash_supported drifted from the kernel
+                raise AssertionError(
+                    "ring flash path selected but kernel rejected shapes "
+                    f"q={q.shape} k={kj.shape}"
+                )
+            ob, lseb = res
+            o, lse = merge_attention_blocks(o, lse, ob, lseb)
+            kj = lax.ppermute(kj, axis_name, perm)
+            vj = lax.ppermute(vj, axis_name, perm)
+            return o, lse, kj, vj
+
+        o, _, _, _ = lax.fori_loop(0, P_, body, (o0, lse0, k, v))
+        return o.astype(q.dtype)
+
+    o0 = jnp.zeros((B, Sq, H, D), jnp.float32)
+    m0 = jnp.full((B, H, Sq), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
 
     def body(j, state):
         o, m, l, kj, vj = state
