@@ -45,8 +45,14 @@ DEFAULT_COMPONENTS = (
     "tensorboard-controller",
     "poddefault-webhook",
     "kfam",
+    "jupyter-web-app",       # L3 spawner REST backend
+    "centraldashboard",      # L3 workgroup API (requires kfam)
     "fake-kubelet",          # local/dev compute double; real clusters disable
 )
+
+# Start order: kfam before centraldashboard (the dashboard wraps it),
+# regardless of the order components appear in the config.
+_START_ORDER = {name: i for i, name in enumerate(DEFAULT_COMPONENTS)}
 
 
 class Platform:
@@ -55,6 +61,8 @@ class Platform:
         self.registry = registry or MetricsRegistry()
         self.manager = ControllerManager(self.api)
         self.kfam: Optional[AccessManagement] = None
+        self.jwa = None          # NotebookWebApp when enabled
+        self.dashboard = None    # DashboardApi when enabled
         self.components: List[str] = []
         self._config: Optional[PlatformConfig] = None
 
@@ -67,6 +75,7 @@ class Platform:
         wanted = [
             c.name for c in cfg.spec.components if c.enabled
         ] or list(DEFAULT_COMPONENTS)
+        wanted.sort(key=lambda n: _START_ORDER.get(n, len(_START_ORDER)))
         params: Dict[str, Dict[str, str]] = {
             c.name: dict(c.params) for c in cfg.spec.components
         }
@@ -126,6 +135,20 @@ class Platform:
                 self.api, reg, user_id_header=cfg.spec.user_id_header,
                 default_chip_quota=int(params.get("defaultChipQuota", 0)),
             )
+        elif name == "jupyter-web-app":
+            from kubeflow_tpu.webapps.jwa import NotebookWebApp
+
+            self.jwa = NotebookWebApp(
+                self.api, reg, user_id_header=cfg.spec.user_id_header,
+            )
+        elif name == "centraldashboard":
+            from kubeflow_tpu.webapps.dashboard import DashboardApi
+
+            if self.kfam is None:
+                raise ValueError(
+                    "centraldashboard requires the kfam component"
+                )
+            self.dashboard = DashboardApi(self.kfam)
         elif name == "fake-kubelet":
             self.manager.register(FakeKubelet(self.api, reg))
         else:

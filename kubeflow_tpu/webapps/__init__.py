@@ -1,0 +1,22 @@
+"""L3 web/REST plane: the HTTP apps behind the dashboard.
+
+The reference's L3 is a Flask backend per UI (jupyter-web-app,
+base_app.py:22-175) plus an Express dashboard server with the workgroup
+API (centraldashboard/app/server.ts:66-68, api_workgroup.ts:247-381). Here
+each app is a thin stdlib-HTTP wrapper over in-process services — the same
+split as kfam's AccessManagement / KfamHttpServer — so functional tests
+drive the full login-header -> SAR -> CR flow over real HTTP without
+Flask/Express.
+"""
+
+from kubeflow_tpu.webapps.router import JsonHttpServer, Request, RestError
+from kubeflow_tpu.webapps.jwa import NotebookWebApp
+from kubeflow_tpu.webapps.dashboard import DashboardApi
+
+__all__ = [
+    "JsonHttpServer",
+    "Request",
+    "RestError",
+    "NotebookWebApp",
+    "DashboardApi",
+]

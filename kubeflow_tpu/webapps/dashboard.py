@@ -1,0 +1,175 @@
+"""Central dashboard server API: workgroup onboarding + environment info.
+
+Rebuild of the reference centraldashboard Express backend: the namespaced
+workgroup API (app/api_workgroup.ts:247-381 — exists / create / env-info /
+nuke-self / get-all-namespaces / get-contributors / add- and
+remove-contributor) and the identity-attach middleware
+(app/attach_user_middleware.ts, trusted header). Profile/binding work is
+delegated to kfam (AccessManagement), exactly as the reference dashboard
+proxies /api/workgroup onto the kfam REST service (app/server.ts:25-38).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.controlplane.kfam.service import (
+    AccessManagement,
+    Binding,
+    KfamError,
+)
+from kubeflow_tpu.webapps.router import JsonHttpServer, Request, RestError, Router
+
+
+def _kfam_guard(fn):
+    def wrapped(*a, **kw):
+        try:
+            return fn(*a, **kw)
+        except KfamError as e:
+            raise RestError(e.status, str(e))
+    return wrapped
+
+
+class DashboardApi:
+    """Workgroup API over kfam + the platform config."""
+
+    def __init__(self, am: AccessManagement, *, platform_name: str = "tpu"):
+        self.am = am
+        self.api = am.api
+        self.platform_name = platform_name
+
+    # ---------------- operations ----------------
+
+    def exists(self, caller: str) -> Dict[str, Any]:
+        """api_workgroup.ts:247-271 — has the user onboarded?"""
+        if not caller:
+            return {"hasAuth": False, "user": "", "hasWorkgroup": False}
+        return {
+            "hasAuth": True,
+            "user": caller,
+            "hasWorkgroup": self.am.profile_exists(caller),
+        }
+
+    @_kfam_guard
+    def create_workgroup(self, caller: str, body: Dict[str, Any]) -> Dict:
+        if not caller:
+            raise RestError(401, "missing identity header")
+        namespace = body.get("namespace") or _default_namespace(caller)
+        self.am.create_profile(caller, namespace, owner=caller)
+        return {"message": f"Created namespace {namespace}"}
+
+    @_kfam_guard
+    def nuke_self(self, caller: str) -> Dict:
+        """nuke-self: delete the caller's own profile (cascade removes the
+        namespace; api_workgroup.ts:322-333)."""
+        if not caller:
+            raise RestError(401, "missing identity header")
+        for p in self.api.list("Profile"):
+            if p.spec.owner == caller:
+                self.am.delete_profile(caller, p.metadata.name)
+                return {"message": f"Removed namespace/profile {p.metadata.name}"}
+        raise RestError(404, f"no profile owned by {caller}")
+
+    def env_info(self, caller: str) -> Dict[str, Any]:
+        """env-info: the namespaces the user can act in + platform info."""
+        namespaces = [
+            {"namespace": b.namespace, "role": b.role}
+            for b in self.am.list_bindings(user=caller)
+        ] if caller else []
+        platform = {"kind": self.platform_name, "components": []}
+        pcs = self.api.list("PlatformConfig")
+        if pcs:
+            platform["components"] = list(pcs[0].status.applied_components)
+            platform["defaultSliceType"] = pcs[0].spec.default_slice_type
+        return {
+            "user": caller,
+            "isClusterAdmin": bool(caller)
+            and self.am.sar.is_cluster_admin(caller),
+            "namespaces": namespaces,
+            "platform": platform,
+        }
+
+    def all_namespaces(self, caller: str) -> List[List[str]]:
+        """get-all-namespaces: tabular [ns, owner, contributors] rows
+        (api_workgroup.ts:334-360)."""
+        if not caller:
+            raise RestError(401, "missing identity header")
+        table: Dict[str, Dict[str, Any]] = {}
+        for b in self.am.list_bindings():
+            row = table.setdefault(b.namespace,
+                                   {"owner": "", "contributors": []})
+            if b.role == "admin":
+                prof = self.api.try_get("Profile", b.namespace)
+                if prof is not None and prof.spec.owner == b.user:
+                    row["owner"] = b.user
+                    continue
+            row["contributors"].append(b.user)
+        return [
+            [ns, row["owner"], ", ".join(sorted(set(row["contributors"])))]
+            for ns, row in sorted(table.items())
+        ]
+
+    def contributors(self, caller: str, namespace: str) -> List[str]:
+        if not caller:
+            raise RestError(401, "missing identity header")
+        prof = self.api.try_get("Profile", namespace)
+        owner = prof.spec.owner if prof is not None else ""
+        return sorted({
+            b.user for b in self.am.list_bindings(namespace=namespace)
+            if b.user != owner
+        })
+
+    @_kfam_guard
+    def add_contributor(self, caller: str, namespace: str,
+                        body: Dict[str, Any]) -> List[str]:
+        self.am.create_binding(caller, Binding(
+            user=body["contributor"], namespace=namespace,
+            role=body.get("role", "edit"),
+        ))
+        return self.contributors(caller, namespace)
+
+    @_kfam_guard
+    def remove_contributor(self, caller: str, namespace: str,
+                           body: Dict[str, Any]) -> List[str]:
+        self.am.delete_binding(caller, Binding(
+            user=body["contributor"], namespace=namespace,
+            role=body.get("role", "edit"),
+        ))
+        return self.contributors(caller, namespace)
+
+    # ---------------- HTTP ----------------
+
+    def router(self) -> Router:
+        r = Router()
+        r.get("/api/workgroup/exists", lambda q: self.exists(q.caller))
+        r.post("/api/workgroup/create",
+               lambda q: self.create_workgroup(q.caller, q.body))
+        r.delete("/api/workgroup/nuke-self",
+                 lambda q: self.nuke_self(q.caller))
+        r.get("/api/workgroup/env-info", lambda q: self.env_info(q.caller))
+        r.get("/api/workgroup/get-all-namespaces",
+              lambda q: self.all_namespaces(q.caller))
+        r.get("/api/workgroup/get-contributors/<ns>",
+              lambda q: self.contributors(q.caller, q.params["ns"]))
+        r.post("/api/workgroup/add-contributor/<ns>",
+               lambda q: self.add_contributor(q.caller, q.params["ns"],
+                                              q.body))
+        r.delete("/api/workgroup/remove-contributor/<ns>",
+                 lambda q: self.remove_contributor(q.caller, q.params["ns"],
+                                                   q.body))
+        r.get("/healthz", lambda q: {"status": "ok"})
+        return r
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> JsonHttpServer:
+        return JsonHttpServer(
+            self.router(), host=host, port=port,
+            user_id_header=self.am.user_id_header,
+        ).start()
+
+
+def _default_namespace(user: str) -> str:
+    """Derive a namespace from the user identity the way the reference
+    defaults to the username (api_workgroup.ts:276)."""
+    base = user.split("@")[0].lower()
+    safe = "".join(c if c.isalnum() or c == "-" else "-" for c in base)
+    return safe.strip("-") or "workgroup"

@@ -1,0 +1,244 @@
+"""Notebook web app backend (JWA): spawner REST over the Notebook CRD.
+
+Rebuild of the reference jupyter-web-app backend
+(kubeflow_jupyter/common/base_app.py:22-175 routes, default/app.py:13-73
+POST form -> Notebook CR), with every request authorized by a
+SubjectAccessReview for the trusted user-id header
+(common/auth.py:21-60 ``needs_authorization``).
+
+TPU twist: the spawner's GPU vendor/limit pickers
+(common/utils.py:390-443) become a typed TPU slice picker driven by the
+topology catalogue; "configurations" are PodDefault labels, as upstream.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.controlplane.api.meta import ObjectMeta
+from kubeflow_tpu.controlplane.api.types import Notebook, NotebookSpec
+from kubeflow_tpu.controlplane.kfam.authz import SubjectAccessReviewer
+from kubeflow_tpu.controlplane.runtime.apiserver import (
+    AlreadyExistsError,
+    InMemoryApiServer,
+    NotFoundError,
+)
+from kubeflow_tpu.topology import get_slice, list_slices
+from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
+from kubeflow_tpu.webapps.router import JsonHttpServer, Request, RestError, Router
+
+DEFAULT_IMAGES = (
+    "kubeflow-tpu/jupyter:latest",
+    "kubeflow-tpu/jupyter-jax:latest",
+    "kubeflow-tpu/jupyter-pytorch-xla:latest",
+)
+
+# Single-host slices a notebook can attach (multi-host attachment is a
+# TpuJob concern, not an interactive-pod one).
+def _notebook_slices() -> List[str]:
+    return [s for s in list_slices() if get_slice(s).num_hosts == 1]
+
+
+class NotebookWebApp:
+    """In-process operations + route table. Serve with ``serve()``."""
+
+    def __init__(
+        self,
+        api: InMemoryApiServer,
+        registry: MetricsRegistry = global_registry,
+        *,
+        user_id_header: str = "x-goog-authenticated-user-email",
+        images: tuple = DEFAULT_IMAGES,
+    ):
+        self.api = api
+        self.sar = SubjectAccessReviewer(api)
+        self.user_id_header = user_id_header
+        self.images = list(images)
+        self.requests = registry.counter(
+            "kftpu_jwa_requests_total", "JWA ops", ("op", "result")
+        )
+        self.heartbeat = registry.heartbeat("jupyter-web-app")
+
+    # ---------------- authz (reference auth.py:21-60) ----------------
+
+    def _authorize(self, caller: str, verb: str, namespace: str) -> None:
+        if not caller:
+            raise RestError(401, "missing identity header")
+        if self.sar.is_cluster_admin(caller):
+            return
+        if not self.sar.can(caller, verb, namespace):
+            raise RestError(
+                403,
+                f"{caller} is not authorized to {verb} notebooks "
+                f"in namespace {namespace}",
+            )
+
+    # ---------------- operations ----------------
+
+    def spawner_config(self) -> Dict[str, Any]:
+        return {
+            "images": self.images,
+            "defaultImage": self.images[0],
+            "cpu": {"default": "2"},
+            "memory": {"default": "4Gi"},
+            "tpuSlices": _notebook_slices(),
+        }
+
+    def list_namespaces(self, caller: str) -> List[str]:
+        if not caller:
+            raise RestError(401, "missing identity header")
+        out = []
+        for ns in self.api.list("Namespace"):
+            if self.sar.is_cluster_admin(caller) or self.sar.can(
+                caller, "list", ns.metadata.name
+            ):
+                out.append(ns.metadata.name)
+        return sorted(out)
+
+    def list_notebooks(self, caller: str, namespace: str) -> List[Dict]:
+        self._authorize(caller, "list", namespace)
+        self.heartbeat.beat()
+        items = []
+        for nb in self.api.list("Notebook", namespace=namespace):
+            items.append(self._render(nb))
+        self.requests.inc(op="list", result="ok")
+        return items
+
+    def create_notebook(self, caller: str, namespace: str,
+                        form: Dict[str, Any]) -> Dict:
+        self._authorize(caller, "create", namespace)
+        self.heartbeat.beat()
+        name = form.get("name", "")
+        if not name:
+            raise RestError(400, "notebook name required")
+        tpu_slice = form.get("tpuSlice", "")
+        if tpu_slice:
+            try:
+                s = get_slice(tpu_slice)
+            except KeyError:
+                raise RestError(400, f"unknown TPU slice type {tpu_slice!r}")
+            if s.num_hosts != 1:
+                raise RestError(
+                    400,
+                    f"slice {tpu_slice} spans {s.num_hosts} hosts; notebooks "
+                    "attach single-host slices only (use a TpuJob)",
+                )
+        nb = Notebook(
+            metadata=ObjectMeta(
+                name=name,
+                namespace=namespace,
+                labels={"app.kubernetes.io/created-by": "jupyter-web-app"},
+                annotations={"owner": caller},
+            ),
+            spec=NotebookSpec(
+                image=form.get("image", self.images[0]),
+                cpu=str(form.get("cpu", "2")),
+                memory=str(form.get("memory", "4Gi")),
+                tpu_slice=tpu_slice,
+                pod_defaults=list(form.get("configurations", [])),
+            ),
+        )
+        try:
+            self.api.create(nb)
+        except AlreadyExistsError:
+            self.requests.inc(op="create", result="conflict")
+            raise RestError(409, f"notebook {namespace}/{name} exists")
+        self.requests.inc(op="create", result="ok")
+        return self._render(nb)
+
+    def delete_notebook(self, caller: str, namespace: str, name: str) -> None:
+        self._authorize(caller, "delete", namespace)
+        self.heartbeat.beat()
+        try:
+            self.api.delete("Notebook", name, namespace)
+        except NotFoundError:
+            self.requests.inc(op="delete", result="missing")
+            raise RestError(404, f"notebook {namespace}/{name} not found")
+        self.requests.inc(op="delete", result="ok")
+
+    def list_poddefaults(self, caller: str, namespace: str) -> List[Dict]:
+        self._authorize(caller, "list", namespace)
+        out = []
+        for pd in self.api.list("PodDefault", namespace=namespace):
+            labels = list(pd.spec.selector.keys())
+            out.append({
+                "label": labels[0] if labels else pd.metadata.name,
+                "desc": pd.spec.desc or pd.metadata.name,
+            })
+        return out
+
+    # ---------------- rendering (utils.process_resource analogue) -------
+
+    def _render(self, nb: Notebook) -> Dict[str, Any]:
+        # Status derivation: mirror the reference's event/condition folding
+        # (common/utils.py:262-335) from our controller's conditions.
+        phase, reason = "waiting", "Scheduling the notebook pod"
+        for c in nb.status.conditions:
+            if c.type == "Ready":
+                if c.status == "True":
+                    phase, reason = "running", "Notebook is ready"
+                else:
+                    phase, reason = "waiting", c.message or c.reason
+        if nb.metadata.annotations.get("kubeflow-resource-stopped"):
+            phase, reason = "stopped", "Notebook is culled/stopped"
+        events = [
+            {"reason": e.reason, "message": e.message, "type": e.type}
+            for e in self.api.list("Event", namespace=nb.metadata.namespace)
+            if e.involved_kind == "Notebook"
+            and e.involved_name == nb.metadata.name
+        ]
+        return {
+            "name": nb.metadata.name,
+            "namespace": nb.metadata.namespace,
+            "image": nb.spec.image,
+            "cpu": nb.spec.cpu,
+            "memory": nb.spec.memory,
+            "tpuSlice": nb.spec.tpu_slice,
+            "configurations": list(nb.spec.pod_defaults),
+            "owner": nb.metadata.annotations.get("owner", ""),
+            "status": {"phase": phase, "reason": reason},
+            "events": events,
+        }
+
+    # ---------------- HTTP ----------------
+
+    def router(self) -> Router:
+        r = Router()
+        r.get("/api/config",
+              lambda q: {"success": True, "config": self.spawner_config()})
+        r.get("/api/namespaces",
+              lambda q: {"success": True,
+                         "namespaces": self.list_namespaces(q.caller)})
+        r.get(
+            "/api/namespaces/<ns>/notebooks",
+            lambda q: {"success": True,
+                       "notebooks": self.list_notebooks(
+                           q.caller, q.params["ns"])},
+        )
+        r.post(
+            "/api/namespaces/<ns>/notebooks",
+            lambda q: {"success": True,
+                       "notebook": self.create_notebook(
+                           q.caller, q.params["ns"], q.body)},
+        )
+        r.delete(
+            "/api/namespaces/<ns>/notebooks/<nb>",
+            lambda q: (self.delete_notebook(q.caller, q.params["ns"],
+                                            q.params["nb"]),
+                       {"success": True})[1],
+        )
+        r.get(
+            "/api/namespaces/<ns>/poddefaults",
+            lambda q: {"success": True,
+                       "poddefaults": self.list_poddefaults(
+                           q.caller, q.params["ns"])},
+        )
+        r.get("/healthz/liveness", lambda q: "alive")
+        r.get("/healthz/readiness", lambda q: "ready")
+        return r
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> JsonHttpServer:
+        return JsonHttpServer(
+            self.router(), host=host, port=port,
+            user_id_header=self.user_id_header,
+        ).start()

@@ -1,0 +1,170 @@
+"""Minimal JSON-over-HTTP router for the L3 apps (stdlib only).
+
+Replaces Flask's Blueprint routing (reference jupyter-web-app
+base_app.py:22-175) and Express's Router (centraldashboard
+api_workgroup.ts:247) with one shared dispatcher: route patterns with
+``<name>`` path params, a trusted identity header (populated by the
+gatekeeper auth proxy / IAP, reference gatekeeper/auth/AuthServer.go:62),
+and JSON bodies both ways.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from kubeflow_tpu.utils import get_logger
+
+log = get_logger("webapps")
+
+
+class RestError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+@dataclasses.dataclass
+class Request:
+    method: str
+    path: str
+    params: Dict[str, str]          # path params from <name> segments
+    query: Dict[str, str]
+    body: Dict[str, Any]
+    caller: str                     # trusted identity header value ("" = anon)
+    headers: Dict[str, str]
+
+
+Handler = Callable[[Request], Any]
+
+
+def _compile(pattern: str) -> re.Pattern:
+    regex = re.sub(r"<([a-zA-Z_][a-zA-Z0-9_]*)>", r"(?P<\1>[^/]+)", pattern)
+    return re.compile(f"^{regex}$")
+
+
+class Router:
+    """Method+pattern table. Handlers return a JSON-able payload (status
+    200) or a (status, payload) tuple; raise RestError for error codes."""
+
+    def __init__(self) -> None:
+        self._routes: List[Tuple[str, re.Pattern, Handler]] = []
+
+    def add(self, method: str, pattern: str, handler: Handler) -> None:
+        self._routes.append((method.upper(), _compile(pattern), handler))
+
+    def get(self, pattern: str, handler: Handler) -> None:
+        self.add("GET", pattern, handler)
+
+    def post(self, pattern: str, handler: Handler) -> None:
+        self.add("POST", pattern, handler)
+
+    def delete(self, pattern: str, handler: Handler) -> None:
+        self.add("DELETE", pattern, handler)
+
+    def dispatch(self, req: Request) -> Tuple[int, Any]:
+        matched_path = False
+        for method, pattern, handler in self._routes:
+            m = pattern.match(req.path)
+            if m is None:
+                continue
+            matched_path = True
+            if method != req.method:
+                continue
+            req.params = m.groupdict()
+            out = handler(req)
+            if isinstance(out, tuple):
+                return out
+            return 200, out
+        if matched_path:
+            return 405, {"error": f"method {req.method} not allowed"}
+        return 404, {"error": f"no route for {req.path}"}
+
+
+class JsonHttpServer:
+    """ThreadingHTTPServer wrapper shared by JWA/dashboard (same lifecycle
+    as controlplane.kfam.KfamHttpServer)."""
+
+    def __init__(
+        self,
+        router: Router,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        user_id_header: str = "x-goog-authenticated-user-email",
+    ):
+        self.router = router
+        rt = router
+        hdr = user_id_header
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _serve(self, method: str) -> None:
+                url = urlparse(self.path)
+                n = int(self.headers.get("Content-Length", "0") or 0)
+                raw = self.rfile.read(n) if n else b""
+                try:
+                    body = json.loads(raw) if raw else {}
+                except json.JSONDecodeError as e:
+                    self._send(400, {"error": f"bad JSON body: {e}"})
+                    return
+                req = Request(
+                    method=method,
+                    path=url.path,
+                    params={},
+                    query={k: v[0] for k, v in parse_qs(url.query).items()},
+                    body=body if isinstance(body, dict) else {"_": body},
+                    caller=self.headers.get(hdr, ""),
+                    headers={k.lower(): v for k, v in self.headers.items()},
+                )
+                try:
+                    status, payload = rt.dispatch(req)
+                except RestError as e:
+                    status, payload = e.status, {"error": str(e)}
+                except KeyError as e:
+                    status, payload = 400, {"error": f"missing field {e}"}
+                except Exception as e:  # surface, don't kill the thread
+                    log.error("handler error", kv={"path": url.path,
+                                                   "err": repr(e)})
+                    status, payload = 500, {"error": "internal error"}
+                self._send(status, payload)
+
+            def _send(self, status: int, payload: Any) -> None:
+                data = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._serve("GET")
+
+            def do_POST(self):
+                self._serve("POST")
+
+            def do_DELETE(self):
+                self._serve("DELETE")
+
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "JsonHttpServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
