@@ -69,6 +69,9 @@ class PodStatus:
     host_ip: str = ""
     node_name: str = ""
     message: str = ""
+    # Container termination message (K8s terminationMessagePath channel);
+    # workers write final metrics JSON here, surfaced by the kubelet.
+    termination_message: str = ""
 
 
 @dataclasses.dataclass

@@ -70,6 +70,10 @@ class TpuJobStatus:
     phase: str = "Pending"  # Pending|Scheduling|Starting|Running|Restarting|Succeeded|Failed
     conditions: List[Condition] = dataclasses.field(default_factory=list)
     restarts: int = 0
+    # Final metrics reported by worker-0 via its termination message
+    # (the K8s terminationMessagePath channel; consumed by the StudyJob
+    # controller as the trial objective).
+    metrics: Dict[str, float] = dataclasses.field(default_factory=dict)
     # worker name -> pod phase
     worker_states: Dict[str, str] = dataclasses.field(default_factory=dict)
     coordinator_address: str = ""
@@ -207,6 +211,68 @@ class Tensorboard:
 
 
 # --------------------------------------------------------------------------
+# StudyJob (HPO — the Katib equivalent)
+# --------------------------------------------------------------------------
+
+from kubeflow_tpu.hpo.space import ParameterSpec  # noqa: E402
+
+
+@dataclasses.dataclass
+class StudyJobSpec:
+    """Katib StudyJob v1alpha1 surface (driven by the reference's
+    testing/katib_studyjob_test.py:39-216), TPU-native: trials are TpuJobs,
+    suggestions are deterministic pure functions (no vizier-core service),
+    and metrics flow back through pod termination messages (no
+    metrics-collector sidecar)."""
+
+    objective: str = "loss"
+    direction: str = "minimize"      # minimize | maximize
+    algorithm: str = "random"        # kubeflow_tpu.hpo.ALGORITHMS
+    max_trials: int = 10
+    parallel_trials: int = 2
+    seed: int = 0
+    parameters: List[ParameterSpec] = dataclasses.field(default_factory=list)
+    # Template cloned per trial; the suggestion lands in the worker env as
+    # KFTPU_HPARAMS (JSON), consumed by train.runner's TrainConfig overrides.
+    trial: TpuJobSpec = dataclasses.field(default_factory=TpuJobSpec)
+
+
+@dataclasses.dataclass
+class TrialRef:
+    name: str = ""
+    index: int = 0
+    parameters: Dict[str, str] = dataclasses.field(default_factory=dict)
+    phase: str = ""
+    objective_value: Optional[float] = None
+
+
+@dataclasses.dataclass
+class StudyJobStatus:
+    # Most-recent condition, katib-style (the reference test polls
+    # status.condition for "Running"): Created|Running|Completed|Failed.
+    condition: str = "Created"
+    conditions: List[Condition] = dataclasses.field(default_factory=list)
+    trials: List[TrialRef] = dataclasses.field(default_factory=list)
+    trials_running: int = 0
+    trials_completed: int = 0
+    trials_failed: int = 0
+    best_trial: str = ""
+    best_parameters: Dict[str, str] = dataclasses.field(default_factory=dict)
+    best_objective: Optional[float] = None
+    start_time: float = 0.0
+    completion_time: float = 0.0
+
+
+@dataclasses.dataclass
+class StudyJob:
+    api_version: str = API_VERSION
+    kind: str = "StudyJob"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: StudyJobSpec = dataclasses.field(default_factory=StudyJobSpec)
+    status: StudyJobStatus = dataclasses.field(default_factory=StudyJobStatus)
+
+
+# --------------------------------------------------------------------------
 # PlatformConfig (KfDef equivalent)
 # --------------------------------------------------------------------------
 
@@ -260,6 +326,7 @@ KIND_REGISTRY: Dict[str, type] = {
     "Profile": Profile,
     "PodDefault": PodDefault,
     "Tensorboard": Tensorboard,
+    "StudyJob": StudyJob,
     "PlatformConfig": PlatformConfig,
     "Pod": _core.Pod,
     "Service": _core.Service,

@@ -3,6 +3,7 @@ from kubeflow_tpu.controlplane.controllers.notebook import NotebookController
 from kubeflow_tpu.controlplane.controllers.profile import ProfileController
 from kubeflow_tpu.controlplane.controllers.tensorboard import TensorboardController
 from kubeflow_tpu.controlplane.controllers.podrunner import FakeKubelet
+from kubeflow_tpu.controlplane.controllers.studyjob import StudyJobController
 from kubeflow_tpu.controlplane.webhook.poddefault import (
     PodDefaultMutator,
     mutate_pod,
@@ -14,6 +15,7 @@ __all__ = [
     "ProfileController",
     "TensorboardController",
     "FakeKubelet",
+    "StudyJobController",
     "PodDefaultMutator",
     "mutate_pod",
 ]

@@ -9,7 +9,7 @@ and optionally completing/failing them per a script.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 from kubeflow_tpu.controlplane.runtime import (
     Controller,
@@ -31,10 +31,16 @@ class FakeKubelet(Controller):
         # pod name predicate -> terminal phase ("Succeeded"/"Failed");
         # pods not matched stay Running.
         outcome: Optional[Callable[[str], Optional[str]]] = None,
+        # called with the Pod when it goes terminal; returns the container
+        # termination message (the terminationMessagePath channel a real
+        # kubelet surfaces — lets tests "run" a workload deterministically,
+        # e.g. compute a loss from the pod's KFTPU_HPARAMS env).
+        termination: Optional[Callable[[Any], str]] = None,
         auto_run: bool = True,
     ):
         super().__init__(api, registry)
         self.outcome = outcome
+        self.termination = termination
         self.auto_run = auto_run
 
     def map_to_primary(self, obj):
@@ -61,5 +67,7 @@ class FakeKubelet(Controller):
             term = self.outcome(name)
             if term in ("Succeeded", "Failed"):
                 pod.status.phase = term
+                if self.termination is not None:
+                    pod.status.termination_message = self.termination(pod)
                 self.api.update_status(pod)
         return Result()

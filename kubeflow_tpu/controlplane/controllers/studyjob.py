@@ -1,0 +1,230 @@
+"""StudyJob controller: hyperparameter studies as gangs of TpuJob trials.
+
+The Katib axis of the platform (reference surface:
+testing/katib_studyjob_test.py:39-216 — create a StudyJob, poll
+status.condition until "Running"; katib's runtime was studyjob-controller
++ vizier-core suggestion gRPC + metrics-collector sidecars). TPU-native
+redesign:
+
+- No suggestion service: trial i's parameters are a pure function of
+  (spec, i) (kubeflow_tpu.hpo.suggest), so reconcile can replay any
+  trial's assignment from the spec — idempotent and restart-safe with
+  zero suggestion state.
+- No metrics-collector sidecar: workers report final metrics through the
+  pod termination message (K8s terminationMessagePath), the TpuJob
+  controller lifts worker-0's report into TpuJobStatus.metrics, and this
+  controller reads the objective from there.
+- Trials inherit all platform gates for free: TpuJob quota/capacity
+  admission, gang restart, checkpoint auto-resume.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import time
+from typing import List, Optional
+
+from kubeflow_tpu.controlplane.api.core import EnvVar
+from kubeflow_tpu.controlplane.api.meta import (
+    Condition,
+    ObjectMeta,
+    OwnerReference,
+    set_condition,
+)
+from kubeflow_tpu.controlplane.api.types import StudyJob, TpuJob, TrialRef
+from kubeflow_tpu.controlplane.runtime import (
+    Controller,
+    EventRecorder,
+    InMemoryApiServer,
+    Result,
+)
+from kubeflow_tpu.hpo.space import encode, validate_space
+from kubeflow_tpu.hpo.suggest import budget, suggest
+from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
+
+STUDY_LABEL = "tpu.kubeflow.org/study-name"
+TRIAL_INDEX_LABEL = "tpu.kubeflow.org/trial-index"
+
+_ACTIVE = ("Pending", "Scheduling", "Starting", "Running", "Restarting")
+
+
+class StudyJobController(Controller):
+    NAME = "studyjob"
+    WATCH_KINDS = ("StudyJob", "TpuJob")
+
+    def __init__(self, api: InMemoryApiServer,
+                 registry: MetricsRegistry = global_registry):
+        super().__init__(api, registry)
+        self.recorder = EventRecorder(api, self.NAME)
+        self.metrics_trials = registry.counter(
+            "kftpu_study_trials_total", "Trial outcomes", ("outcome",)
+        )
+
+    @staticmethod
+    def trial_name(study: str, index: int) -> str:
+        return f"{study}-trial-{index}"
+
+    # ------------- reconcile -------------
+
+    def reconcile(self, namespace: str, name: str) -> Result:
+        study = self.api.try_get("StudyJob", name, namespace)
+        if study is None or study.metadata.deletion_timestamp is not None:
+            return Result()
+        if study.status.condition in ("Completed", "Failed"):
+            return Result()
+
+        try:
+            validate_space(study.spec.parameters)
+            n_budget = budget(study.spec.parameters, study.spec.algorithm,
+                              study.spec.max_trials)
+        except (ValueError, IndexError) as e:
+            return self._fail(study, "InvalidSpace", str(e))
+
+        jobs = {
+            j.metadata.labels.get(TRIAL_INDEX_LABEL, ""): j
+            for j in self.api.list(
+                "TpuJob", namespace=namespace,
+                label_selector={STUDY_LABEL: name},
+            )
+        }
+
+        prev_status = copy.deepcopy(study.status)
+        trials: List[TrialRef] = []
+        sign = -1.0 if study.spec.direction == "maximize" else 1.0
+        history = []
+        n_active = n_done = n_failed = 0
+        for i in range(n_budget):
+            job = jobs.get(str(i))
+            if job is None:
+                continue
+            obj = job.status.metrics.get(study.spec.objective)
+            ref = TrialRef(
+                name=job.metadata.name, index=i,
+                parameters=self._trial_params(study, i, job),
+                phase=job.status.phase,
+                objective_value=obj,
+            )
+            trials.append(ref)
+            if job.status.phase == "Succeeded":
+                n_done += 1
+                history.append({
+                    "parameters": dict(ref.parameters),
+                    "objective": None if obj is None else sign * obj,
+                })
+            elif job.status.phase == "Failed":
+                n_failed += 1
+            else:
+                n_active += 1
+
+        # Spawn until the parallelism window is full or the budget is spent.
+        next_index = max((t.index for t in trials), default=-1) + 1
+        while (n_active < study.spec.parallel_trials
+               and next_index < n_budget):
+            self._spawn_trial(study, next_index, history)
+            self.metrics_trials.inc(outcome="spawned")
+            next_index += 1
+            n_active += 1
+
+        # ---- status aggregation (katib-style single condition) ----
+        st = study.status
+        st.trials_running = n_active
+        st.trials_completed = n_done
+        st.trials_failed = n_failed
+        st.trials = trials
+        scored = [t for t in trials if t.objective_value is not None
+                  and t.phase == "Succeeded"]
+        if scored:
+            best = min(scored, key=lambda t: sign * t.objective_value)
+            st.best_trial = best.name
+            st.best_parameters = dict(best.parameters)
+            st.best_objective = best.objective_value
+        finished = n_done + n_failed
+        if finished >= n_budget:
+            st.condition = "Failed" if n_done == 0 else "Completed"
+            if st.completion_time == 0.0:
+                st.completion_time = time.time()
+                self.recorder.event(
+                    study, "Normal", f"Study{st.condition}",
+                    f"{n_done}/{n_budget} trials succeeded; best="
+                    f"{st.best_trial or 'n/a'}",
+                )
+        elif n_active > 0:
+            st.condition = "Running"
+            if st.start_time == 0.0:
+                st.start_time = time.time()
+        st.conditions = set_condition(
+            st.conditions,
+            Condition(
+                type="Running",
+                status="True" if st.condition == "Running" else "False",
+                reason=st.condition,
+                message=(f"{n_done} done, {n_failed} failed, "
+                         f"{n_active} active of {n_budget}"),
+            ),
+        )
+        if st != prev_status:
+            self.api.update_status(study)
+        return Result()
+
+    # ------------- trial spawning -------------
+
+    def _trial_params(self, study: StudyJob, index: int,
+                      job: Optional[TpuJob] = None) -> dict:
+        # The assignment pinned in the job env at spawn time is
+        # authoritative (history-steered algorithms can't be replayed);
+        # fall back to recomputation only for algorithm-deterministic cases.
+        if job is not None:
+            for ev in job.spec.env:
+                if ev.name == "KFTPU_HPARAMS":
+                    return {k: str(v)
+                            for k, v in json.loads(ev.value).items()}
+        return encode(suggest(study.spec.parameters, study.spec.algorithm,
+                              study.spec.seed, index))
+
+    def _spawn_trial(self, study: StudyJob, index: int,
+                     history: List[dict]) -> None:
+        assignment = suggest(
+            study.spec.parameters, study.spec.algorithm,
+            study.spec.seed, index, history,
+        )
+        spec = copy.deepcopy(study.spec.trial)
+        spec.env = list(spec.env) + [
+            EnvVar("KFTPU_HPARAMS", json.dumps(assignment)),
+            EnvVar("KFTPU_TRIAL_INDEX", str(index)),
+        ]
+        if spec.checkpoint_dir:
+            spec.checkpoint_dir = f"{spec.checkpoint_dir}/trial-{index}"
+        name = self.trial_name(study.metadata.name, index)
+        job = TpuJob(
+            metadata=ObjectMeta(
+                name=name,
+                namespace=study.metadata.namespace,
+                labels={
+                    STUDY_LABEL: study.metadata.name,
+                    TRIAL_INDEX_LABEL: str(index),
+                },
+                owner_references=[OwnerReference(
+                    kind="StudyJob", name=study.metadata.name,
+                    uid=study.metadata.uid,
+                )],
+            ),
+            spec=spec,
+        )
+        if self.api.try_get("TpuJob", name, study.metadata.namespace) is None:
+            self.api.create(job)
+            self.recorder.event(
+                study, "Normal", "TrialCreated",
+                f"trial {index}: {encode(assignment)}",
+            )
+
+    def _fail(self, study: StudyJob, reason: str, msg: str) -> Result:
+        study.status.condition = "Failed"
+        study.status.conditions = set_condition(
+            study.status.conditions,
+            Condition(type="Running", status="False",
+                      reason=reason, message=msg),
+        )
+        self.api.update_status(study)
+        self.recorder.event(study, "Warning", reason, msg)
+        return Result()

@@ -299,6 +299,20 @@ class TpuJobController(Controller):
         states = {p.metadata.name: p.status.phase for p in pods}
         prev_status = copy.deepcopy(job.status)
         job.status.worker_states = states
+        # Lift worker-0's termination report (K8s terminationMessagePath
+        # channel, written by train.runner) into job metrics — consumed by
+        # the StudyJob controller as the trial objective.
+        w0 = self.worker_name(job.metadata.name, 0)
+        for p in pods:
+            if p.metadata.name == w0 and p.status.termination_message:
+                try:
+                    msg = json.loads(p.status.termination_message)
+                    job.status.metrics = {
+                        k: float(v) for k, v in msg.items()
+                        if isinstance(v, (int, float))
+                    }
+                except (ValueError, AttributeError):
+                    pass
         job.status.coordinator_address = coordinator
         job.status.slice_assignment = (
             f"{job.spec.slice_type}x{job.spec.num_slices}"

@@ -25,6 +25,7 @@ from kubeflow_tpu.controlplane.controllers import (
     NotebookController,
     PodDefaultMutator,
     ProfileController,
+    StudyJobController,
     TensorboardController,
     TpuJobController,
 )
@@ -40,6 +41,7 @@ log = get_logger("platform")
 
 DEFAULT_COMPONENTS = (
     "tpujob-controller",
+    "studyjob-controller",   # HPO (katib equivalent); trials are TpuJobs
     "notebook-controller",
     "profile-controller",
     "tensorboard-controller",
@@ -113,6 +115,8 @@ class Platform:
                 }
             self.manager.register(TpuJobController(self.api, reg,
                                                    capacity=capacity))
+        elif name == "studyjob-controller":
+            self.manager.register(StudyJobController(self.api, reg))
         elif name == "notebook-controller":
             self.manager.register(NotebookController(
                 self.api, reg,

@@ -1,0 +1,34 @@
+"""Hyperparameter optimization (the Katib/StudyJob axis of the platform).
+
+Layers:
+- space/suggest — stateless, deterministic search-space + algorithms
+- sweep         — in-process study execution (compute path, bench)
+- controlplane.controllers.studyjob — StudyJob CRD controller spawning
+  TpuJob trials under quota (platform path)
+"""
+
+from kubeflow_tpu.hpo.space import (
+    Assignment,
+    ParameterSpec,
+    encode,
+    grid,
+    sample,
+    validate_space,
+)
+from kubeflow_tpu.hpo.suggest import ALGORITHMS, budget, suggest
+from kubeflow_tpu.hpo.sweep import StudyResult, TrialResult, run_study
+
+__all__ = [
+    "ALGORITHMS",
+    "Assignment",
+    "ParameterSpec",
+    "StudyResult",
+    "TrialResult",
+    "budget",
+    "encode",
+    "grid",
+    "run_study",
+    "sample",
+    "suggest",
+    "validate_space",
+]

@@ -1,0 +1,94 @@
+"""Suggestion algorithms for StudyJob trials.
+
+Katib v1alpha1's suggestion services (random / grid / hyperband behind a
+gRPC vizier-core, driven from testing/katib_studyjob_test.py) redesigned as
+pure functions: trial ``index``'s assignment is computed from
+(space, algorithm, seed, index [, history]) with no suggestion server and
+no stored state — the controller can replay any trial's parameters from
+the spec alone, which is what makes reconcile idempotent and restart-safe.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence
+
+from kubeflow_tpu.hpo.space import (
+    Assignment,
+    ParameterSpec,
+    grid,
+    sample,
+    validate_space,
+)
+
+ALGORITHMS = ("random", "grid", "successive-halving")
+
+
+def budget(params: List[ParameterSpec], algorithm: str,
+           max_trials: int) -> int:
+    """How many trials the study will actually run: grid is capped by the
+    grid size; random/successive-halving run exactly max_trials."""
+    if algorithm == "grid":
+        n = len(grid(params))
+        return min(n, max_trials) if max_trials > 0 else n
+    return max_trials
+
+
+def suggest(
+    params: List[ParameterSpec],
+    algorithm: str,
+    seed: int,
+    index: int,
+    history: Optional[Sequence[Dict[str, Any]]] = None,
+) -> Assignment:
+    """Assignment for trial ``index``.
+
+    history — completed trials as {"parameters": Assignment,
+    "objective": float or None} with objective normalised so LOWER is
+    better (callers negate when maximizing); used by adaptive algorithms
+    (successive-halving exploits it, random/grid ignore it).
+    """
+    validate_space(params)
+    if algorithm == "random":
+        return sample(params, seed, index)
+    if algorithm == "grid":
+        g = grid(params)
+        if index >= len(g):
+            raise IndexError(f"grid exhausted: {index} >= {len(g)}")
+        return g[index]
+    if algorithm == "successive-halving":
+        return _successive_halving(params, seed, index, history or [])
+    raise ValueError(f"unknown algorithm {algorithm!r}; "
+                     f"known: {ALGORITHMS}")
+
+
+def _successive_halving(
+    params: List[ParameterSpec], seed: int, index: int,
+    history: Sequence[Dict[str, Any]],
+) -> Assignment:
+    """Hyperband-lite: explore randomly for a bracket, then resample around
+    the best-so-far half (numeric dims shrink toward the incumbent;
+    categorical dims lock to the incumbent's choice). Bracket size 4.
+    Deterministic given (seed, index, history)."""
+    bracket = 4
+    if index < bracket or not history:
+        return sample(params, seed, index)
+    scored = [h for h in history if h.get("objective") is not None]
+    if not scored:
+        return sample(params, seed, index)
+    best = min(scored, key=lambda h: h["objective"])["parameters"]
+    base = sample(params, seed, index)
+    out: Assignment = {}
+    for p in params:
+        b, s = best.get(p.name), base[p.name]
+        if p.type == "categorical" or b is None:
+            out[p.name] = b if b is not None else s
+        elif p.log_scale:
+            out[p.name] = math.exp(
+                0.5 * (math.log(float(b)) + math.log(float(s))))
+            if p.type == "int":
+                out[p.name] = int(round(out[p.name]))
+        else:
+            v = 0.5 * (float(b) + float(s))
+            out[p.name] = int(round(v)) if p.type == "int" else v
+    return out

@@ -1,0 +1,98 @@
+"""In-process hyperparameter sweep — the compute-side HPO path.
+
+The platform path (StudyJob CRD + controller spawning TpuJob trials,
+kubeflow_tpu.controlplane.controllers.studyjob) orchestrates trials as
+cluster workloads; this module is the single-host engine those trials —
+and bench.py's trials/hour measurement — run on: a deterministic loop over
+suggestions calling a user train function. No services, no state: the
+TPU-native answer to katib's vizier-core + metrics-collector pair.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from kubeflow_tpu.hpo.space import Assignment, ParameterSpec
+from kubeflow_tpu.hpo.suggest import budget, suggest
+from kubeflow_tpu.utils import get_logger
+
+log = get_logger("hpo.sweep")
+
+
+@dataclasses.dataclass
+class TrialResult:
+    index: int
+    parameters: Assignment
+    metrics: Dict[str, float]
+    objective: Optional[float]       # None => trial failed
+    wall_seconds: float = 0.0
+    error: str = ""
+
+
+@dataclasses.dataclass
+class StudyResult:
+    trials: List[TrialResult]
+    best: Optional[TrialResult]
+    objective: str
+    direction: str
+    wall_seconds: float = 0.0
+
+    @property
+    def trials_per_hour(self) -> float:
+        done = [t for t in self.trials if t.objective is not None]
+        if self.wall_seconds <= 0:
+            return 0.0
+        return len(done) * 3600.0 / self.wall_seconds
+
+
+def run_study(
+    parameters: List[ParameterSpec],
+    trial_fn: Callable[[Assignment], Dict[str, float]],
+    *,
+    objective: str = "loss",
+    direction: str = "minimize",
+    algorithm: str = "random",
+    max_trials: int = 8,
+    seed: int = 0,
+) -> StudyResult:
+    """Run a study to completion in-process.
+
+    trial_fn receives one assignment and returns a metrics dict that must
+    contain ``objective``. Exceptions fail the trial (recorded, study
+    continues) — the same per-trial isolation the StudyJob controller gets
+    from gang failure policy.
+    """
+    sign = -1.0 if direction == "maximize" else 1.0
+    n = budget(parameters, algorithm, max_trials)
+    trials: List[TrialResult] = []
+    t_study = time.time()
+    for i in range(n):
+        history = [
+            {"parameters": t.parameters,
+             "objective": None if t.objective is None else sign * t.objective}
+            for t in trials
+        ]
+        assignment = suggest(parameters, algorithm, seed, i, history)
+        t0 = time.time()
+        try:
+            metrics = trial_fn(dict(assignment))
+            obj = float(metrics[objective])
+            trials.append(TrialResult(
+                index=i, parameters=assignment, metrics=dict(metrics),
+                objective=obj, wall_seconds=time.time() - t0,
+            ))
+            log.info("trial done", kv={"trial": i, objective: f"{obj:.5g}"})
+        except Exception as e:  # noqa: BLE001 — trial isolation
+            trials.append(TrialResult(
+                index=i, parameters=assignment, metrics={}, objective=None,
+                wall_seconds=time.time() - t0, error=str(e),
+            ))
+            log.info("trial failed", kv={"trial": i, "error": str(e)})
+    done = [t for t in trials if t.objective is not None]
+    best = min(done, key=lambda t: sign * t.objective) if done else None
+    return StudyResult(
+        trials=trials, best=best, objective=objective, direction=direction,
+        wall_seconds=time.time() - t_study,
+    )

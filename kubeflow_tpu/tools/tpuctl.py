@@ -68,8 +68,9 @@ def cmd_get(args) -> int:
         phase = ""
         status = getattr(o, "status", None)
         if status is not None:
-            phase = getattr(status, "phase", "") or getattr(
-                status, "container_state", "")
+            phase = (getattr(status, "phase", "")
+                     or getattr(status, "condition", "")
+                     or getattr(status, "container_state", ""))
         ns = o.metadata.namespace or "-"
         print(f"{ns}\t{o.metadata.name}\t{phase}")
     return 0

@@ -37,7 +37,15 @@ log = get_logger("runner")
 
 def env_config() -> dict:
     mesh = json.loads(os.environ.get("KFTPU_MESH", "{}") or "{}")
+    # HPO: the StudyJob controller injects the trial's assignment as
+    # KFTPU_HPARAMS (JSON); keys matching TrainConfig fields override them.
+    hparams = json.loads(os.environ.get("KFTPU_HPARAMS", "{}") or "{}")
     return {
+        "hparams": hparams,
+        # Termination report path (K8s terminationMessagePath): final
+        # metrics written here surface in pod status -> TpuJobStatus.metrics.
+        "termination_log": os.environ.get(
+            "KFTPU_TERMINATION_LOG", "/dev/termination-log"),
         "coordinator": os.environ.get("KFTPU_COORDINATOR_ADDRESS", ""),
         "num_processes": int(os.environ.get("KFTPU_NUM_PROCESSES", "1")),
         "process_id": int(os.environ.get("KFTPU_PROCESS_ID", "0")),
@@ -86,12 +94,13 @@ def run(cfg: dict) -> int:
         mesh = make_host_local_mesh(axes)
 
     aux_w = float(getattr(model_cfg, "aux_loss_weight", 0.0) or 0.0)
-    trainer = Trainer(
-        model,
-        TrainConfig(task="lm", attn_impl=cfg["attn_impl"],
-                    total_steps=cfg["steps"], aux_loss_weight=aux_w),
-        mesh,
-    )
+    tc = TrainConfig(task="lm", attn_impl=cfg["attn_impl"],
+                     total_steps=cfg["steps"], aux_loss_weight=aux_w)
+    for k, v in cfg.get("hparams", {}).items():
+        if hasattr(tc, k):
+            cur = getattr(tc, k)
+            setattr(tc, k, type(cur)(v) if cur is not None else v)
+    trainer = Trainer(model, tc, mesh)
     it = synthetic_text(SyntheticTextConfig(
         batch_size=cfg["batch_per_host"] * cfg["num_processes"],
         seq_len=cfg["seq_len"],
@@ -138,8 +147,31 @@ def run(cfg: dict) -> int:
     if ckpt is not None:
         ckpt.save(int(state.step), state)
         ckpt.close()
-    log.info("training complete", kv={"steps": cfg["steps"]})
+    final_loss = float(metrics["loss"]) if cfg["steps"] > start_step else -1.0
+    tokens_per_sec = (
+        cfg["batch_per_host"] * cfg["num_processes"] * cfg["seq_len"]
+        * (cfg["steps"] - start_step) / max(time.time() - t0, 1e-9)
+    )
+    if cfg["process_id"] == 0:
+        _report_termination(cfg["termination_log"], {
+            "loss": final_loss,
+            "tokens_per_sec": tokens_per_sec,
+            "steps": cfg["steps"],
+        })
+    log.info("training complete", kv={"steps": cfg["steps"],
+                                      "final_loss": f"{final_loss:.4f}"})
     return 0
+
+
+def _report_termination(path: str, metrics: dict) -> None:
+    """Write the final-metrics report to the termination-message path.
+    Best-effort: a missing /dev/termination-log (non-container runs) is
+    not an error."""
+    try:
+        with open(path, "w") as f:
+            json.dump(metrics, f)
+    except OSError:
+        log.info("termination log unavailable", kv={"path": path})
 
 
 def main() -> int:
