@@ -37,6 +37,7 @@ class MeshAxesSpec:
     the controller via kubeflow_tpu.topology.plan_mesh."""
 
     dp: int = -1
+    pp: int = 1
     fsdp: int = 1
     tp: int = 1
     sp: int = 1

@@ -46,8 +46,6 @@ from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
 STUDY_LABEL = "tpu.kubeflow.org/study-name"
 TRIAL_INDEX_LABEL = "tpu.kubeflow.org/trial-index"
 
-_ACTIVE = ("Pending", "Scheduling", "Starting", "Running", "Restarting")
-
 
 class StudyJobController(Controller):
     NAME = "studyjob"
@@ -80,6 +78,12 @@ class StudyJobController(Controller):
                               study.spec.max_trials)
         except (ValueError, IndexError) as e:
             return self._fail(study, "InvalidSpace", str(e))
+        if study.spec.parallel_trials < 1:
+            return self._fail(
+                study, "InvalidSpec",
+                f"parallel_trials must be >= 1, got "
+                f"{study.spec.parallel_trials}",
+            )
 
         jobs = {
             j.metadata.labels.get(TRIAL_INDEX_LABEL, ""): j
@@ -118,12 +122,24 @@ class StudyJobController(Controller):
                 n_active += 1
 
         # Spawn until the parallelism window is full or the budget is spent.
-        next_index = max((t.index for t in trials), default=-1) + 1
-        while (n_active < study.spec.parallel_trials
-               and next_index < n_budget):
-            self._spawn_trial(study, next_index, history)
+        # Iterate every unspawned index (not just past the max): a deleted
+        # trial leaves a hole that must be respawned or the study would
+        # never reach its budget and hang in Running forever.
+        for i in range(n_budget):
+            if n_active >= study.spec.parallel_trials:
+                break
+            if str(i) in jobs:
+                continue
+            if not self._spawn_trial(study, i, history):
+                # Trial name squatted by a TpuJob this study doesn't own:
+                # retrying every reconcile would hang the study in Running
+                # forever with phantom trials. Fail loudly instead.
+                return self._fail(
+                    study, "TrialNameConflict",
+                    f"TpuJob {self.trial_name(study.metadata.name, i)!r} "
+                    f"exists and is not owned by this study",
+                )
             self.metrics_trials.inc(outcome="spawned")
-            next_index += 1
             n_active += 1
 
         # ---- status aggregation (katib-style single condition) ----
@@ -183,7 +199,9 @@ class StudyJobController(Controller):
                               study.spec.seed, index))
 
     def _spawn_trial(self, study: StudyJob, index: int,
-                     history: List[dict]) -> None:
+                     history: List[dict]) -> bool:
+        """Create trial ``index``'s TpuJob. Returns False when the name is
+        taken by a job that does not belong to this study."""
         assignment = suggest(
             study.spec.parameters, study.spec.algorithm,
             study.spec.seed, index, history,
@@ -211,12 +229,16 @@ class StudyJobController(Controller):
             ),
             spec=spec,
         )
-        if self.api.try_get("TpuJob", name, study.metadata.namespace) is None:
-            self.api.create(job)
-            self.recorder.event(
-                study, "Normal", "TrialCreated",
-                f"trial {index}: {encode(assignment)}",
-            )
+        existing = self.api.try_get("TpuJob", name, study.metadata.namespace)
+        if existing is not None:
+            return (existing.metadata.labels.get(STUDY_LABEL)
+                    == study.metadata.name)
+        self.api.create(job)
+        self.recorder.event(
+            study, "Normal", "TrialCreated",
+            f"trial {index}: {encode(assignment)}",
+        )
+        return True
 
     def _fail(self, study: StudyJob, reason: str, msg: str) -> Result:
         study.status.condition = "Failed"

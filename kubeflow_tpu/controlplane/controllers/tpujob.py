@@ -105,7 +105,8 @@ class TpuJobController(Controller):
             m = job.spec.mesh
             plan = plan_mesh(
                 st,
-                AxisSpec(dp=m.dp, fsdp=m.fsdp, tp=m.tp, sp=m.sp, ep=m.ep),
+                AxisSpec(dp=m.dp, pp=m.pp, fsdp=m.fsdp, tp=m.tp, sp=m.sp,
+                         ep=m.ep),
             )
         except (KeyError, ValueError) as e:
             return self._fail_invalid(job, str(e))

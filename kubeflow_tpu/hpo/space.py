@@ -125,6 +125,31 @@ def grid(params: List[ParameterSpec]) -> List[Assignment]:
     return [dict(zip(names, combo)) for combo in itertools.product(*axes)]
 
 
+def grid_size(params: List[ParameterSpec]) -> int:
+    """Cardinality of ``grid(params)`` without materialising it (the
+    controller sizes budgets on every reconcile)."""
+    validate_space(params)
+    return math.prod(len(_grid_values(p)) for p in params)
+
+
+def grid_at(params: List[ParameterSpec], index: int) -> Assignment:
+    """``grid(params)[index]`` by mixed-radix decomposition — O(#params)
+    instead of materialising the cartesian product (trial spawning indexes
+    one combo per reconcile; a 10^6-point grid must not be built for it)."""
+    validate_space(params)
+    axes = [_grid_values(p) for p in params]
+    total = math.prod(len(a) for a in axes)
+    if not 0 <= index < total:
+        raise IndexError(f"grid exhausted: {index} >= {total}")
+    out: Assignment = {}
+    rem = index
+    # Row-major (first parameter slowest), matching grid()'s product order.
+    for p, vals in zip(reversed(params), reversed(axes)):
+        rem, digit = divmod(rem, len(vals))
+        out[p.name] = vals[digit]
+    return {p.name: out[p.name] for p in params}
+
+
 def encode(assignment: Assignment) -> Dict[str, str]:
     """String-encode an assignment for env-var injection
     (KFTPU_HPARAMS carries the JSON of this)."""

@@ -16,7 +16,8 @@ from typing import Any, Dict, List, Optional, Sequence
 from kubeflow_tpu.hpo.space import (
     Assignment,
     ParameterSpec,
-    grid,
+    grid_at,
+    grid_size,
     sample,
     validate_space,
 )
@@ -29,7 +30,7 @@ def budget(params: List[ParameterSpec], algorithm: str,
     """How many trials the study will actually run: grid is capped by the
     grid size; random/successive-halving run exactly max_trials."""
     if algorithm == "grid":
-        n = len(grid(params))
+        n = grid_size(params)
         return min(n, max_trials) if max_trials > 0 else n
     return max_trials
 
@@ -52,10 +53,7 @@ def suggest(
     if algorithm == "random":
         return sample(params, seed, index)
     if algorithm == "grid":
-        g = grid(params)
-        if index >= len(g):
-            raise IndexError(f"grid exhausted: {index} >= {len(g)}")
-        return g[index]
+        return grid_at(params, index)
     if algorithm == "successive-halving":
         return _successive_halving(params, seed, index, history or [])
     raise ValueError(f"unknown algorithm {algorithm!r}; "

@@ -25,6 +25,7 @@ from kubeflow_tpu.ops.flash_attention import flash_attention
 from kubeflow_tpu.ops.norms import rms_norm
 from kubeflow_tpu.ops.rope import apply_rope, rope_frequencies
 from kubeflow_tpu.parallel.context import constrain, get_context
+from kubeflow_tpu.parallel.pipeline import PipelinedLayers
 from kubeflow_tpu.parallel.ring_attention import ring_attention_sharded
 from kubeflow_tpu.parallel.ulysses import ulysses_attention_sharded
 
@@ -63,6 +64,11 @@ class LlamaConfig:
     remat: bool = True
     tie_embeddings: bool = False
     logits_softcap: float = 0.0
+    # >1 switches the layer stack to the GPipe SPMD pipeline layout
+    # (params stacked [stages, layers/stage, ...] on the "pp" mesh axis;
+    # see parallel/pipeline.py). Training layout only — decode keeps tp/sp.
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 0      # 0 => defaults to pipeline_stages
 
     @classmethod
     def llama3_8b(cls, **kw) -> "LlamaConfig":
@@ -322,11 +328,27 @@ class Llama(nn.Module):
         if cfg.remat:
             layer_cls = nn.remat(
                 layer_cls,
-                prevent_cse=not cfg.scan_layers,
+                # Inside any scan (layer scan or pipeline stage scan) XLA's
+                # loop structure already prevents the CSE remat defends against.
+                prevent_cse=not (cfg.scan_layers or cfg.pipeline_stages > 1),
                 static_argnums=(3,),  # decode flag (self is argnum 0)
             )
 
-        if cfg.scan_layers:
+        if cfg.pipeline_stages > 1:
+            if decode:
+                raise ValueError(
+                    "pipeline_stages>1 is a training layout; decode/serving "
+                    "uses tp/sp (a one-token step is all pipeline bubble)"
+                )
+            x = PipelinedLayers(
+                cfg,
+                layer_cls=layer_cls,
+                num_stages=cfg.pipeline_stages,
+                num_microbatches=cfg.pipeline_microbatches
+                or cfg.pipeline_stages,
+                name="pipeline",
+            )(x, positions)
+        elif cfg.scan_layers:
             x, _ = nn.scan(
                 lambda mdl, carry, _: (mdl(carry, positions, decode), None),
                 variable_axes={c: 0 for c in self.SCAN_COLLECTIONS},

@@ -21,8 +21,10 @@ from kubeflow_tpu.parallel.sharding import (
 from kubeflow_tpu.parallel.ring_attention import ring_attention
 from kubeflow_tpu.parallel.ulysses import ulysses_attention
 from kubeflow_tpu.parallel.moe import moe_dispatch, Top2GateConfig
+from kubeflow_tpu.parallel.pipeline import PipelinedLayers
 
 __all__ = [
+    "PipelinedLayers",
     "DEFAULT_RULES",
     "Rules",
     "logical_spec",

@@ -1,0 +1,153 @@
+"""GPipe-style SPMD pipeline parallelism over a decoder-layer stack.
+
+Closes the one SURVEY §2.5 axis (TP/**PP**/SP/EP/CP) the reference leaves
+to opaque per-container runtimes (its deepest parallelism wiring is replica
+counts + hostnames, reference: tf-controller-examples/tf-cnn/
+create_job_specs.py:96-180); on TPU the schedule itself is the framework's
+job and is expressed to XLA, not hand-run by workers.
+
+Design — pure SPMD, no shard_map, no per-stage programs:
+- Layer parameters are stacked ``[num_stages, layers_per_stage, ...]``;
+  the stage dim carries flax partition name ``"stage"`` which the rule
+  table maps to the ``pp`` mesh axis, so each pp group holds only its own
+  stage's weights.
+- One jit-traced *time loop* (``nn.scan`` with broadcast params) runs
+  ``M + S - 1`` ticks over ``M`` microbatches. Every tick, a single
+  ``nn.vmap``-over-stages application computes all stages at once; because
+  the stage dim of both weights and the activation buffer is sharded on
+  ``pp``, XLA partitions that vmap so each pp group executes exactly its
+  stage — stage parallelism falls out of SPMD partitioning.
+- The inter-stage hop is ``jnp.roll`` of the stage-sharded buffer, which
+  XLA lowers to a neighbour ``CollectivePermute`` on the pp axis (one
+  microbatch activation per tick — the classic GPipe wire pattern).
+- Autodiff through the whole loop gives the backward pipeline for free;
+  rematerialisation of each layer (``nn.remat`` upstream) keeps the
+  M-deep activation buffer affordable.
+
+Bubble fraction is the GPipe (S-1)/(M+S-1); choose num_microbatches ≳ 4×
+stages to amortise. This is a *training* layout: decode/serving paths keep
+tp/sp layouts (a decode step is one token — pipelining it is all bubble).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Type
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from kubeflow_tpu.parallel.context import constrain
+
+
+class _Stage(nn.Module):
+    """One pipeline stage: a sequential scan over its share of layers.
+
+    ``layer_cls`` must have signature ``__call__(x, positions, decode)``
+    (the DecoderLayer contract shared by the dense model zoo).
+    """
+
+    cfg: Any
+    layer_cls: Type[nn.Module]
+    n_layers: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+        x, _ = nn.scan(
+            lambda mdl, carry, _: (mdl(carry, positions, False), None),
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            length=self.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(self.layer_cls(self.cfg, name="layers"), x, None)
+        return x
+
+
+class PipelinedLayers(nn.Module):
+    """Run ``cfg.num_layers`` decoder layers as ``num_stages`` pipeline
+    stages over ``num_microbatches`` microbatches (batch-dim split).
+
+    Constraints (checked):
+    - ``cfg.num_layers % num_stages == 0``
+    - ``batch % num_microbatches == 0``
+
+    Positions ride the pipeline alongside activations (each stage sees the
+    positions of the microbatch it currently holds), so packed sequences /
+    per-row offsets are handled correctly.
+    """
+
+    cfg: Any
+    layer_cls: Type[nn.Module]
+    num_stages: int
+    num_microbatches: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+        S, M = self.num_stages, self.num_microbatches
+        L = self.cfg.num_layers
+        if L % S != 0:
+            raise ValueError(f"num_layers {L} not divisible by stages {S}")
+        B = x.shape[0]
+        if B % M != 0:
+            raise ValueError(f"batch {B} not divisible by microbatches {M}")
+        mb = B // M
+        seq = x.shape[1]
+
+        x_mb = x.reshape((M, mb) + x.shape[1:])
+        pos_mb = positions.reshape((M, mb) + positions.shape[1:])
+
+        stack = nn.vmap(
+            _Stage,
+            in_axes=(0, 0),
+            out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            metadata_params={nn.PARTITION_NAME: "stage"},
+        )(self.cfg, self.layer_cls, L // S, name="stages")
+
+        buf0 = jnp.zeros((S, mb) + x.shape[1:], x.dtype)
+        pbuf0 = jnp.zeros((S, mb) + positions.shape[1:], positions.dtype)
+        out0 = jnp.zeros_like(x_mb)
+
+        def tick(mdl, carry, t):
+            buf, pbuf, outputs = carry
+            # Inject microbatch t into stage 0 (garbage recirculates in the
+            # drain phase t >= M but is never collected). Positions ride
+            # along so every stage applies its current microbatch's rope.
+            midx = jnp.clip(t, 0, M - 1)
+            inj = jax.lax.dynamic_index_in_dim(
+                x_mb, midx, axis=0, keepdims=False
+            )
+            pinj = jax.lax.dynamic_index_in_dim(
+                pos_mb, midx, axis=0, keepdims=False
+            )
+            buf = buf.at[0].set(jnp.where(t < M, inj, buf[0]))
+            pbuf = pbuf.at[0].set(jnp.where(t < M, pinj, pbuf[0]))
+            buf = constrain(
+                buf, ("act_stage", "act_batch", "act_seq", "act_embed")
+            )
+            out = mdl(buf, pbuf)  # [S, mb, seq, E], stage i holds mb t-i
+            # Collect the last stage's finished microbatch t-(S-1).
+            oidx = jnp.clip(t - (S - 1), 0, M - 1)
+            old = jax.lax.dynamic_index_in_dim(
+                outputs, oidx, axis=0, keepdims=False
+            )
+            val = jnp.where(t >= S - 1, out[-1], old)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, val, oidx, axis=0
+            )
+            # Stage hop: roll on the pp-sharded dim = CollectivePermute.
+            buf = jnp.roll(out, 1, axis=0)
+            pbuf = jnp.roll(pbuf, 1, axis=0)
+            return (buf, pbuf, outputs), None
+
+        loop = nn.scan(
+            tick,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+        )
+        (_, _, outputs), _ = loop(
+            stack, (buf0, pbuf0, out0), jnp.arange(M + S - 1)
+        )
+        out = outputs.reshape((B, seq) + x.shape[2:])
+        return constrain(out, ("act_batch", "act_seq", "act_embed"))

@@ -33,7 +33,9 @@ DEFAULT_RULES: Rules = (
     ("act_mlp", "tp"),
     ("act_vocab", "tp"),
     ("act_expert", "ep"),
+    ("act_stage", "pp"),
     # params
+    ("stage", "pp"),
     ("embed", "fsdp"),
     ("heads", "tp"),
     ("kv_heads", "tp"),

@@ -37,7 +37,7 @@ from kubeflow_tpu.topology.slices import SliceType, get_slice
 # Canonical logical axis order: outermost (cheapest collectives / DCN-ok)
 # first, innermost (latency-critical) last. This is also the mesh-axis order
 # used by every sharding rule in kubeflow_tpu.parallel.
-AXIS_ORDER: Tuple[str, ...] = ("dp", "ep", "fsdp", "sp", "tp")
+AXIS_ORDER: Tuple[str, ...] = ("dp", "pp", "ep", "fsdp", "sp", "tp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -46,6 +46,7 @@ class AxisSpec:
     remaining chips' (mirrors jnp reshape convention)."""
 
     dp: int = 1
+    pp: int = 1
     ep: int = 1
     fsdp: int = 1
     sp: int = 1
@@ -157,6 +158,9 @@ def plan_mesh(slice_type: str | SliceType, axes: AxisSpec) -> MeshPlan:
     consume("sp", d["sp"], by_ring_then_large)
     consume("fsdp", d["fsdp"], by_large)
     consume("ep", d["ep"], by_ring_then_large)
+    # pp's one-hop-per-tick CollectivePermute tolerates long spans (even
+    # DCN between slices), so it consumes after the bandwidth-bound axes.
+    consume("pp", d["pp"], by_ring_then_large)
     consume("dp", d["dp"], by_large)
 
     names = tuple(AXIS_ORDER)

@@ -8,7 +8,7 @@ but consuming the TpuJob controller's env contract instead:
   KFTPU_NUM_PROCESSES         gang size (one process per TPU-VM host)
   KFTPU_PROCESS_ID            this pod's ordinal
   KFTPU_SLICE_TYPE            e.g. v5e-16
-  KFTPU_MESH                  JSON {dp, fsdp, tp, sp, ep}
+  KFTPU_MESH                  JSON {dp, pp, fsdp, tp, sp, ep}
   KFTPU_ATTN_IMPL             full | ring | ulysses
   KFTPU_MODEL                 registry model name
   KFTPU_CHECKPOINT_DIR        durable dir; auto-resume on restart
@@ -87,6 +87,21 @@ def run(cfg: dict) -> int:
     model, model_cfg = get_model(cfg["model"])
     axes = AxisSpec(**{k: int(v) for k, v in cfg["mesh"].items()}) \
         if cfg["mesh"] else AxisSpec(dp=-1)
+    pp = axes.pp
+    if pp > 1:
+        # Wire the mesh's pp extent into the model's pipeline layout — a pp
+        # axis with an unpipelined model would silently replicate the whole
+        # computation across it.
+        if not hasattr(model_cfg, "pipeline_stages") or \
+                "losses" in getattr(type(model), "SCAN_COLLECTIONS", ()):
+            raise ValueError(
+                f"model {cfg['model']!r} does not support pipeline "
+                f"parallelism (requested mesh pp={pp})"
+            )
+        import dataclasses as _dc
+
+        model_cfg = _dc.replace(model_cfg, pipeline_stages=pp)
+        model = type(model)(model_cfg)
     if cfg["slice_type"]:
         plan = plan_mesh(cfg["slice_type"], axes)
         mesh = make_mesh(plan)
@@ -96,10 +111,20 @@ def run(cfg: dict) -> int:
     aux_w = float(getattr(model_cfg, "aux_loss_weight", 0.0) or 0.0)
     tc = TrainConfig(task="lm", attn_impl=cfg["attn_impl"],
                      total_steps=cfg["steps"], aux_loss_weight=aux_w)
+    # HPO overrides (TrainConfig is frozen — rebuild, don't setattr). A
+    # swept total_steps must change the steps actually run, not just the
+    # decay schedule, or the sweep would be measuring a fiction.
+    overrides = {}
     for k, v in cfg.get("hparams", {}).items():
         if hasattr(tc, k):
             cur = getattr(tc, k)
-            setattr(tc, k, type(cur)(v) if cur is not None else v)
+            overrides[k] = type(cur)(v) if cur is not None else v
+    if overrides:
+        import dataclasses as _dc
+
+        tc = _dc.replace(tc, **overrides)
+        if "total_steps" in overrides:
+            cfg["steps"] = tc.total_steps
     trainer = Trainer(model, tc, mesh)
     it = synthetic_text(SyntheticTextConfig(
         batch_size=cfg["batch_per_host"] * cfg["num_processes"],
@@ -147,19 +172,25 @@ def run(cfg: dict) -> int:
     if ckpt is not None:
         ckpt.save(int(state.step), state)
         ckpt.close()
-    final_loss = float(metrics["loss"]) if cfg["steps"] > start_step else -1.0
+    ran_steps = cfg["steps"] > start_step
     tokens_per_sec = (
         cfg["batch_per_host"] * cfg["num_processes"] * cfg["seq_len"]
         * (cfg["steps"] - start_step) / max(time.time() - t0, 1e-9)
     )
     if cfg["process_id"] == 0:
-        _report_termination(cfg["termination_log"], {
-            "loss": final_loss,
-            "tokens_per_sec": tokens_per_sec,
-            "steps": cfg["steps"],
-        })
-    log.info("training complete", kv={"steps": cfg["steps"],
-                                      "final_loss": f"{final_loss:.4f}"})
+        # A resume at/past the final step runs zero steps and has no loss to
+        # report; omitting the key (rather than a sentinel) keeps the HPO
+        # controller from reading a fake objective into the study.
+        report = {"tokens_per_sec": tokens_per_sec, "steps": cfg["steps"]}
+        if ran_steps:
+            report["loss"] = float(metrics["loss"])
+        _report_termination(cfg["termination_log"], report)
+    log.info(
+        "training complete",
+        kv={"steps": cfg["steps"],
+            "final_loss": f"{float(metrics['loss']):.4f}" if ran_steps
+            else "n/a (resumed past final step)"},
+    )
     return 0
 
 

@@ -115,6 +115,17 @@ class TestSuggest:
         assert budget(params, "grid", max_trials=3) == 3
         assert budget(params, "random", max_trials=7) == 7
 
+    def test_grid_size_matches_grid_without_materialising(self):
+        from kubeflow_tpu.hpo.space import grid, grid_size
+        params = [
+            ParameterSpec(name="lr", min=1e-4, max=1e-1, grid_points=5,
+                          log_scale=True),
+            ParameterSpec(name="wd", min=0.0, max=0.2, grid_points=3),
+            ParameterSpec(name="attn", type="categorical",
+                          values=["full", "ring", "flash"]),
+        ]
+        assert grid_size(params) == len(grid(params)) == 45
+
     def test_grid_exhaustion_raises(self):
         params = [ParameterSpec(name="lr", min=0.1, max=0.2, step=0.1)]
         with pytest.raises(IndexError):
@@ -245,6 +256,55 @@ class TestStudyJobController:
         # 2 x 2 grid => exactly 4 trials despite max_trials=100.
         assert study.status.trials_completed == 4
         assert len(api.list("TpuJob", namespace="team-a")) == 4
+
+    def test_deleted_trial_is_respawned(self):
+        """A trial deleted out from under the study leaves an index hole;
+        the spawn loop must refill it or the study can never reach its
+        budget (it would hang in Running forever)."""
+        api, mgr, kubelet = make_hpo_world(outcome=lambda name: "Succeeded")
+        api.create(_study(max_trials=4, parallel_trials=4))
+        mgr.run_until_idle()
+        victim = StudyJobController.trial_name("study", 1)
+        api.delete("TpuJob", victim, "team-a")
+        mgr.run_until_idle()
+        for _ in range(30):
+            mgr.run_until_idle(include_timers_within=30.0)
+            kubelet.tick()
+            mgr.run_until_idle(include_timers_within=30.0)
+            study = api.get("StudyJob", "study", "team-a")
+            if study.status.condition in ("Completed", "Failed"):
+                break
+        assert study.status.condition == "Completed"
+        assert study.status.trials_completed == 4
+        assert {t.index for t in study.status.trials} == {0, 1, 2, 3}
+
+    def test_foreign_job_name_conflict_fails_study(self):
+        """A TpuJob squatting a trial name (without the study label) must
+        fail the study, not leave it Running with phantom trials."""
+        from kubeflow_tpu.controlplane.api.types import TpuJob, TpuJobSpec
+
+        api, mgr, _ = make_hpo_world(outcome=None)
+        api.create(TpuJob(
+            metadata=ObjectMeta(
+                name=StudyJobController.trial_name("study", 0),
+                namespace="team-a",
+            ),
+            spec=TpuJobSpec(slice_type="v5e-8", model="vit-tiny"),
+        ))
+        api.create(_study(max_trials=2, parallel_trials=2))
+        mgr.run_until_idle()
+        study = api.get("StudyJob", "study", "team-a")
+        assert study.status.condition == "Failed"
+        reasons = [c.reason for c in study.status.conditions]
+        assert "TrialNameConflict" in reasons
+
+    def test_zero_parallelism_fails_study(self):
+        api, mgr, _ = make_hpo_world(outcome=None)
+        api.create(_study(max_trials=2, parallel_trials=0))
+        mgr.run_until_idle()
+        study = api.get("StudyJob", "study", "team-a")
+        assert study.status.condition == "Failed"
+        assert api.list("TpuJob", namespace="team-a") == []
 
     def test_trial_jobs_carry_hparams_and_owner(self):
         api, mgr, _ = make_hpo_world(outcome=None)

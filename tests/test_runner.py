@@ -1,0 +1,74 @@
+"""Worker entrypoint: env contract -> real (single-process) training runs.
+
+The multi-process jax.distributed path is exercised by tests/e2e; here the
+contract pieces that burned before are pinned: hparam overrides against the
+frozen TrainConfig, swept total_steps changing the steps actually run, the
+termination report's loss key, and pp wiring into the model's pipeline.
+"""
+
+import json
+import os
+
+import pytest
+
+from kubeflow_tpu.train import runner
+
+
+def _env(tmp_path, **over):
+    env = {
+        "KFTPU_MODEL": "llama-tiny",
+        "KFTPU_TRAIN_STEPS": "2",
+        "KFTPU_BATCH_PER_HOST": "8",  # divisible by dp=8 (virtual devices)
+        "KFTPU_SEQ_LEN": "16",
+        "KFTPU_MESH": json.dumps({"dp": -1}),
+        "KFTPU_TERMINATION_LOG": str(tmp_path / "term.json"),
+    }
+    env.update(over)
+    return env
+
+
+def _run(monkeypatch, tmp_path, **over):
+    for k in list(os.environ):
+        if k.startswith("KFTPU_"):
+            monkeypatch.delenv(k)
+    for k, v in _env(tmp_path, **over).items():
+        monkeypatch.setenv(k, v)
+    cfg = runner.env_config()
+    assert runner.run(cfg) == 0
+    return json.loads((tmp_path / "term.json").read_text())
+
+
+class TestRunnerContract:
+    def test_basic_run_reports_loss(self, monkeypatch, tmp_path):
+        report = _run(monkeypatch, tmp_path)
+        assert report["steps"] == 2
+        assert report["loss"] > 0
+        assert report["tokens_per_sec"] > 0
+
+    def test_hparam_overrides_frozen_trainconfig(self, monkeypatch, tmp_path):
+        """KFTPU_HPARAMS must survive TrainConfig being frozen, and a swept
+        total_steps must change the number of steps actually run."""
+        report = _run(
+            monkeypatch, tmp_path,
+            KFTPU_HPARAMS=json.dumps(
+                {"learning_rate": "0.01", "total_steps": "3"}
+            ),
+        )
+        assert report["steps"] == 3
+
+    def test_pp_mesh_requires_pipeline_support(self, monkeypatch, tmp_path):
+        with pytest.raises(ValueError, match="pipeline"):
+            _run(
+                monkeypatch, tmp_path,
+                KFTPU_MODEL="mixtral-tiny",
+                KFTPU_MESH=json.dumps({"dp": -1, "pp": 2}),
+            )
+
+    def test_pp_mesh_pipelines_dense_model(self, monkeypatch, tmp_path):
+        # batch 8 = 2 microbatches x mb 4, mb divisible by dp=4 (8 devs / pp 2).
+        report = _run(
+            monkeypatch, tmp_path,
+            KFTPU_BATCH_PER_HOST="8",
+            KFTPU_MESH=json.dumps({"dp": -1, "pp": 2}),
+        )
+        assert report["loss"] > 0

@@ -60,7 +60,9 @@ class TestAxisSpec:
 
     def test_resolve_exact(self):
         a = AxisSpec(dp=2, fsdp=4, tp=2).resolve(16)
-        assert a.as_dict() == {"dp": 2, "ep": 1, "fsdp": 4, "sp": 1, "tp": 2}
+        assert a.as_dict() == {
+            "dp": 2, "pp": 1, "ep": 1, "fsdp": 4, "sp": 1, "tp": 2,
+        }
 
     def test_resolve_mismatch_raises(self):
         with pytest.raises(ValueError):
