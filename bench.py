@@ -1,11 +1,19 @@
-"""Benchmark: flagship Llama training throughput, tokens/sec/chip.
+"""Benchmarks for the BASELINE.md configs. Default (no subcommand) is the
+flagship Llama training-throughput bench the driver runs every round.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+  python bench.py              # config 2: Llama train tokens/s/chip (+MFU)
+  python bench.py serving      # config 5: tokens/s/chip, p50/p99 TTFT+latency
+  python bench.py resnet       # config 1: ResNet-50 images/s/chip
+  python bench.py mixtral      # config 3: MoE train tokens/s/chip
+  python bench.py hpo          # config 4: in-process sweep trials/hour
+
+Each invocation prints ONE JSON line:
+{"metric", "value", "unit", "vs_baseline", ...extras}.
 
 The reference publishes no numbers (BASELINE.md: `"published": {}`); the
-baseline below is the first measurement recorded by this framework at round
-1 on a single TPU v5e chip, so vs_baseline tracks our own progress —
-BASELINE.md's "to be established, not matched" contract.
+baselines below are this framework's own first measurements, so
+vs_baseline tracks our progress — BASELINE.md's "to be established, not
+matched" contract.
 """
 
 from __future__ import annotations
@@ -14,21 +22,40 @@ import argparse
 import json
 import time
 
-# Round-1 reference point (tokens/sec/chip, Llama ~700M, bs8 x seq2048,
-# bf16, single v5e chip). Updated when the bench config changes.
-BASELINE_TOKENS_PER_SEC = 14500.0
+# Round-1/3 reference points on a single TPU v5e chip. Updated when a bench
+# config changes; 0.0 means "first measurement pending" (vs_baseline: 1.0).
+BASELINES = {
+    "train": 14500.0,      # tokens/s/chip, Llama ~700M bs8 x seq2048 (r1)
+    "serving": 0.0,        # tokens/s/chip generated
+    "resnet": 0.0,         # images/s/chip
+    "mixtral": 0.0,        # tokens/s/chip
+    "hpo": 0.0,            # trials/hour
+}
 
 
-def main() -> None:
-    p = argparse.ArgumentParser()
-    p.add_argument("--steps", type=int, default=10)
-    p.add_argument("--warmup", type=int, default=2)
-    p.add_argument("--batch-size", type=int, default=8)
-    p.add_argument("--seq-len", type=int, default=2048)
-    p.add_argument("--attn", default="flash",
-                   choices=["full", "flash", "ring", "ulysses"])
-    args = p.parse_args()
+def _emit(metric: str, value: float, unit: str, baseline: float, **extra):
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 1),
+        "unit": unit,
+        "vs_baseline": round(value / baseline, 3) if baseline > 0 else 1.0,
+        **extra,
+    }))
 
+
+def _sync(metrics):
+    """Host fetch, not block_until_ready: remote-relay TPU platforms treat
+    block_until_ready as a no-op, so only a device->host transfer is a
+    reliable synchronisation point."""
+    import jax
+
+    return float(jax.tree.leaves(metrics)[0])
+
+
+# ---------------------------------------------------------------- config 2
+
+
+def bench_train(args) -> None:
     import jax
     import jax.numpy as jnp
 
@@ -67,15 +94,10 @@ def main() -> None:
     batch = trainer.shard_batch({k: jnp.asarray(v) for k, v in next(it).items()})
     state = trainer.init_state(jax.random.PRNGKey(0), batch)
 
-    if args.steps < 1:
-        raise SystemExit("--steps must be >= 1")
     for _ in range(args.warmup):
         state, metrics = trainer.step(state, batch)
-    # Host fetch, not block_until_ready: remote-relay TPU platforms treat
-    # block_until_ready as a no-op, so only a device->host transfer is a
-    # reliable synchronisation point.
     if args.warmup > 0:
-        float(metrics["loss"])
+        _sync(metrics["loss"])
 
     t0 = time.perf_counter()
     for _ in range(args.steps):
@@ -88,24 +110,255 @@ def main() -> None:
     tps_chip = tokens / dt / ndev
     flops_per_token = train_flops_per_token(cfg, args.seq_len)
     peak = device_peak_tflops()
-    mfu = (
-        tps_chip * flops_per_token / (peak * 1e12) if peak > 0 else 0.0
+    mfu = tps_chip * flops_per_token / (peak * 1e12) if peak > 0 else 0.0
+    _emit(
+        "llama_700m_train_tokens_per_sec_per_chip", tps_chip, "tokens/s/chip",
+        BASELINES["train"],
+        mfu=round(mfu, 4),
+        model_tflops_per_chip=round(tps_chip * flops_per_token / 1e12, 2),
+        attn=args.attn,
     )
-    print(
-        json.dumps(
-            {
-                "metric": "llama_700m_train_tokens_per_sec_per_chip",
-                "value": round(tps_chip, 1),
-                "unit": "tokens/s/chip",
-                "vs_baseline": round(tps_chip / BASELINE_TOKENS_PER_SEC, 3),
-                "mfu": round(mfu, 4),
-                "model_tflops_per_chip": round(
-                    tps_chip * flops_per_token / 1e12, 2
-                ),
-                "attn": args.attn,
-            }
+
+
+# ---------------------------------------------------------------- config 5
+
+
+def bench_serving(args) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.models import Llama, LlamaConfig
+    from kubeflow_tpu.serving import ServingConfig, ServingEngine
+
+    cfg = LlamaConfig(
+        vocab_size=32000, embed_dim=2048, num_layers=12, num_heads=16,
+        num_kv_heads=8, head_dim=128, mlp_dim=5632,
+        max_seq_len=1024, scan_layers=True, remat=False,
+    )
+    model = Llama(cfg)
+    params = {"params": model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32)
+    )["params"]}
+    engine = ServingEngine(
+        model, params,
+        ServingConfig(max_batch=args.batch_size, max_len=1024,
+                      decode_chunk=args.decode_chunk),
+    )
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=args.prompt_len).tolist()
+        for _ in range(args.requests)
+    ]
+    # Warmup: compile the real prompt bucket's prefill + the decode chunk.
+    engine.submit(prompts[0], max_new_tokens=args.decode_chunk + 1)
+    engine.run()
+
+    t0 = time.perf_counter()
+    rids = [engine.submit(p, max_new_tokens=args.gen_len) for p in prompts]
+    engine.run()
+    dt = time.perf_counter() - t0
+    res = [engine.result(r) for r in rids]
+    gen_tokens = sum(len(r.tokens) for r in res)
+    ndev = len(jax.devices())
+    ttfts = sorted(r.ttft_s for r in res)
+    lats = sorted(r.latency_s for r in res)
+
+    def pct(xs, p):
+        return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+    _emit(
+        "llama_700m_serving_tokens_per_sec_per_chip",
+        gen_tokens / dt / ndev, "tokens/s/chip", BASELINES["serving"],
+        p50_ttft_s=round(pct(ttfts, 0.50), 4),
+        p99_ttft_s=round(pct(ttfts, 0.99), 4),
+        p50_latency_s=round(pct(lats, 0.50), 4),
+        p99_latency_s=round(pct(lats, 0.99), 4),
+        requests=args.requests, batch=args.batch_size,
+        prompt_len=args.prompt_len, gen_len=args.gen_len,
+        decode_chunk=args.decode_chunk,
+    )
+
+
+# ---------------------------------------------------------------- config 1
+
+
+def bench_resnet(args) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import get_model
+    from kubeflow_tpu.topology import AxisSpec, make_host_local_mesh
+    from kubeflow_tpu.train import TrainConfig, Trainer
+    from kubeflow_tpu.train.data import SyntheticImageConfig, synthetic_images
+
+    model, _ = get_model("resnet50")
+    ndev = len(jax.devices())
+    mesh = make_host_local_mesh(AxisSpec(dp=-1))
+    trainer = Trainer(
+        model, TrainConfig(task="image", warmup_steps=10, total_steps=1000),
+        mesh,
+    )
+    bs = args.batch_size * ndev
+    it = synthetic_images(SyntheticImageConfig(batch_size=bs, image_size=224))
+    batch = trainer.shard_batch({k: jnp.asarray(v) for k, v in next(it).items()})
+    state = trainer.init_state(jax.random.PRNGKey(0), batch)
+    for _ in range(args.warmup):
+        state, metrics = trainer.step(state, batch)
+    if args.warmup > 0:
+        _sync(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, metrics = trainer.step(state, batch)
+    _sync(metrics["loss"])
+    dt = time.perf_counter() - t0
+    _emit(
+        "resnet50_train_images_per_sec_per_chip",
+        bs * args.steps / dt / ndev, "images/s/chip", BASELINES["resnet"],
+        batch=bs,
+    )
+
+
+# ---------------------------------------------------------------- config 3
+
+
+def bench_mixtral(args) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import Mixtral, MixtralConfig
+    from kubeflow_tpu.topology import AxisSpec, make_host_local_mesh
+    from kubeflow_tpu.train import TrainConfig, Trainer
+    from kubeflow_tpu.train.data import SyntheticTextConfig, synthetic_text
+    from kubeflow_tpu.train.flops import (
+        device_peak_tflops,
+        train_flops_per_token,
+    )
+
+    # MoE sized for one v5e chip: 8 experts, ~350M params, top-2 routing.
+    cfg = MixtralConfig(
+        vocab_size=32000, embed_dim=1024, num_layers=6, num_heads=16,
+        num_kv_heads=8, head_dim=64, mlp_dim=2048, num_experts=8,
+        max_seq_len=args.seq_len, scan_layers=True, remat=True,
+    )
+    model = Mixtral(cfg)
+    ndev = len(jax.devices())
+    # ep shards experts when devices allow (8 virtual / multi-chip); one
+    # real chip runs ep=1 with the same dispatch path.
+    ep = 8 if ndev % 8 == 0 else (2 if ndev % 2 == 0 else 1)
+    mesh = make_host_local_mesh(AxisSpec(dp=-1, ep=ep))
+    trainer = Trainer(
+        model,
+        TrainConfig(task="lm", warmup_steps=10, total_steps=1000,
+                    aux_loss_weight=0.02),
+        mesh,
+    )
+    it = synthetic_text(SyntheticTextConfig(
+        batch_size=args.batch_size * ndev, seq_len=args.seq_len,
+        vocab_size=cfg.vocab_size,
+    ))
+    batch = trainer.shard_batch({k: jnp.asarray(v) for k, v in next(it).items()})
+    state = trainer.init_state(jax.random.PRNGKey(0), batch)
+    rng = jax.random.PRNGKey(1)
+    for _ in range(args.warmup):
+        state, metrics = trainer.step(state, batch, rng=rng)
+    if args.warmup > 0:
+        _sync(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, metrics = trainer.step(state, batch, rng=rng)
+    _sync(metrics["loss"])
+    dt = time.perf_counter() - t0
+    tokens = args.batch_size * ndev * args.seq_len * args.steps
+    tps_chip = tokens / dt / ndev
+    flops_per_token = train_flops_per_token(cfg, args.seq_len)
+    peak = device_peak_tflops()
+    _emit(
+        "mixtral_moe_train_tokens_per_sec_per_chip", tps_chip,
+        "tokens/s/chip", BASELINES["mixtral"],
+        ep=ep,
+        mfu=round(tps_chip * flops_per_token / (peak * 1e12), 4)
+        if peak > 0 else 0.0,
+    )
+
+
+# ---------------------------------------------------------------- config 4
+
+
+def bench_hpo(args) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.hpo.space import ParameterSpec
+    from kubeflow_tpu.hpo.sweep import run_study
+    from kubeflow_tpu.models import get_model
+    from kubeflow_tpu.topology import AxisSpec, make_host_local_mesh
+    from kubeflow_tpu.train import TrainConfig, Trainer
+    from kubeflow_tpu.train.data import SyntheticImageConfig, synthetic_images
+
+    model, mcfg = get_model("vit-tiny")
+    mesh = make_host_local_mesh(AxisSpec(dp=-1))
+    it = synthetic_images(SyntheticImageConfig(
+        batch_size=args.batch_size, image_size=mcfg.image_size,
+        num_classes=mcfg.num_classes,
+    ))
+    batch_np = next(it)
+
+    def trial_fn(hp):
+        tc = TrainConfig(
+            task="image", total_steps=args.steps, warmup_steps=1,
+            learning_rate=float(hp["learning_rate"]),
+            weight_decay=float(hp["weight_decay"]),
         )
+        trainer = Trainer(model, tc, mesh)
+        batch = trainer.shard_batch(
+            {k: jnp.asarray(v) for k, v in batch_np.items()}
+        )
+        state = trainer.init_state(jax.random.PRNGKey(0), batch)
+        for _ in range(args.steps):
+            state, metrics = trainer.step(state, batch)
+        return {"loss": float(metrics["loss"])}
+
+    res = run_study(
+        [
+            ParameterSpec(name="learning_rate", min=1e-4, max=1e-2,
+                          log_scale=True),
+            ParameterSpec(name="weight_decay", min=0.0, max=0.2),
+        ],
+        trial_fn, algorithm="random", max_trials=args.requests, seed=0,
     )
+    _emit(
+        "hpo_vit_tiny_trials_per_hour", res.trials_per_hour, "trials/hour",
+        BASELINES["hpo"],
+        trials=len(res.trials), steps_per_trial=args.steps,
+        best_loss=round(res.best.objective, 4) if res.best else None,
+    )
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("which", nargs="?", default="train",
+                   choices=["train", "serving", "resnet", "mixtral", "hpo"])
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--attn", default="flash",
+                   choices=["full", "flash", "ring", "ulysses"])
+    p.add_argument("--requests", type=int, default=16)    # serving / hpo trials
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--gen-len", type=int, default=128)
+    p.add_argument("--decode-chunk", type=int, default=16)
+    args = p.parse_args()
+    if args.steps < 1:
+        raise SystemExit("--steps must be >= 1")
+    {
+        "train": bench_train,
+        "serving": bench_serving,
+        "resnet": bench_resnet,
+        "mixtral": bench_mixtral,
+        "hpo": bench_hpo,
+    }[args.which](args)
 
 
 if __name__ == "__main__":

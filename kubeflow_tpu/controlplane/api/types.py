@@ -212,6 +212,47 @@ class Tensorboard:
 
 
 # --------------------------------------------------------------------------
+# Serving (model inference as a platform workload)
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ServingSpec:
+    """Inference deployment surface (reference: TF-Serving deployments
+    probed by testing/test_tf_serving.py:60-156). The pod runs
+    kubeflow_tpu.serving.server against the KFTPU_SERVING_* env this
+    controller injects; the engine shards over the requested mesh."""
+
+    model: str = ""                     # kubeflow_tpu.models registry name
+    slice_type: str = "v5e-8"
+    # Engine sharding: slots (continuous-batch rows) over dp, heads over tp.
+    mesh: MeshAxesSpec = dataclasses.field(
+        default_factory=lambda: MeshAxesSpec(dp=-1)
+    )
+    max_batch: int = 8
+    max_len: int = 1024
+    decode_chunk: int = 8               # tokens per device dispatch
+    port: int = 8000
+    image: str = "kubeflow-tpu/serving:latest"
+
+
+@dataclasses.dataclass
+class ServingStatus:
+    ready: bool = False
+    phase: str = "Pending"
+    endpoint: str = ""                  # VirtualService prefix once routed
+    conditions: List[Condition] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Serving:
+    api_version: str = API_VERSION
+    kind: str = "Serving"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: ServingSpec = dataclasses.field(default_factory=ServingSpec)
+    status: ServingStatus = dataclasses.field(default_factory=ServingStatus)
+
+
+# --------------------------------------------------------------------------
 # StudyJob (HPO — the Katib equivalent)
 # --------------------------------------------------------------------------
 
@@ -327,6 +368,7 @@ KIND_REGISTRY: Dict[str, type] = {
     "Profile": Profile,
     "PodDefault": PodDefault,
     "Tensorboard": Tensorboard,
+    "Serving": Serving,
     "StudyJob": StudyJob,
     "PlatformConfig": PlatformConfig,
     "Pod": _core.Pod,

@@ -4,6 +4,7 @@ from kubeflow_tpu.controlplane.controllers.profile import ProfileController
 from kubeflow_tpu.controlplane.controllers.tensorboard import TensorboardController
 from kubeflow_tpu.controlplane.controllers.podrunner import FakeKubelet
 from kubeflow_tpu.controlplane.controllers.studyjob import StudyJobController
+from kubeflow_tpu.controlplane.controllers.serving import ServingController
 from kubeflow_tpu.controlplane.webhook.poddefault import (
     PodDefaultMutator,
     mutate_pod,
@@ -16,6 +17,7 @@ __all__ = [
     "TensorboardController",
     "FakeKubelet",
     "StudyJobController",
+    "ServingController",
     "PodDefaultMutator",
     "mutate_pod",
 ]

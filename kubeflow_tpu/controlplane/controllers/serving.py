@@ -1,0 +1,199 @@
+"""Serving controller: Serving CR -> Pod + Service + VirtualService.
+
+Model inference as a first-class platform workload — the reference reaches
+it with hand-applied TF-Serving Deployments that its CI probes over
+REST/gRPC (testing/test_tf_serving.py:60-156, deploy -> wait ready ->
+query -> assert). Here the same lifecycle is a CRD:
+
+- The pod runs ``python -m kubeflow_tpu.serving.server`` against the
+  KFTPU_SERVING_* env injected below (model, mesh, engine limits, port) —
+  the serving analogue of the TpuJob controller's KFTPU_* train contract.
+- ClusterIP service + VirtualService route ``/serving/<ns>/<name>/`` (the
+  notebook controller's routing pattern, notebook_controller.go:378-435).
+- Pod phase mirrors into status.ready/conditions; status.endpoint carries
+  the routed prefix the dashboard and availability prober poll.
+
+Single-host slices only for now: multi-host sharded serving is a gang
+concern (TpuJob's machinery) and the engine's mesh is per-process.
+"""
+
+from __future__ import annotations
+
+import json
+
+from kubeflow_tpu.controlplane.api.core import (
+    Container,
+    EnvVar,
+    HttpRoute,
+    Pod,
+    PodSpec,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    VirtualService,
+)
+from kubeflow_tpu.controlplane.api.meta import (
+    Condition,
+    ObjectMeta,
+    OwnerReference,
+    set_condition,
+)
+from kubeflow_tpu.controlplane.api.types import Serving
+from kubeflow_tpu.controlplane.runtime import (
+    Controller,
+    EventRecorder,
+    InMemoryApiServer,
+    Result,
+    create_or_update,
+)
+from kubeflow_tpu.models import list_models
+from kubeflow_tpu.topology import get_slice
+from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
+
+
+class ServingController(Controller):
+    NAME = "serving"
+    WATCH_KINDS = ("Serving", "Pod")
+
+    def __init__(
+        self,
+        api: InMemoryApiServer,
+        registry: MetricsRegistry = global_registry,
+        *,
+        istio_gateway: str = "kubeflow/kubeflow-gateway",
+    ):
+        super().__init__(api, registry)
+        self.istio_gateway = istio_gateway
+        self.recorder = EventRecorder(api, self.NAME)
+        self.metrics_ready = registry.gauge(
+            "kftpu_serving_ready", "Ready serving deployments"
+        )
+
+    def reconcile(self, namespace: str, name: str) -> Result:
+        sv = self.api.try_get("Serving", name, namespace)
+        if sv is None or sv.metadata.deletion_timestamp is not None:
+            return Result()
+
+        err = self._validate(sv)
+        if err:
+            sv.status.phase = "Failed"
+            sv.status.ready = False
+            sv.status.conditions = set_condition(
+                sv.status.conditions,
+                Condition(type="Ready", status="False",
+                          reason="InvalidSpec", message=err),
+            )
+            self._sync_status(sv)
+            self.recorder.event(sv, "Warning", "InvalidSpec", err)
+            return Result()
+
+        pod_name = f"{name}-serving-0"
+        live_pod = self.api.try_get("Pod", pod_name, namespace)
+        if live_pod is None:
+            self.api.create(self._pod(sv, pod_name))
+            self.recorder.event(sv, "Normal", "Created", f"pod {pod_name}")
+            live_pod = self.api.get("Pod", pod_name, namespace)
+        create_or_update(self.api, self._service(sv))
+        create_or_update(self.api, self._virtual_service(sv))
+
+        phase = live_pod.status.phase
+        ready = phase == "Running"
+        sv.status.phase = "Ready" if ready else phase
+        sv.status.ready = ready
+        sv.status.endpoint = (
+            f"/serving/{namespace}/{name}/" if ready else ""
+        )
+        sv.status.conditions = set_condition(
+            sv.status.conditions,
+            Condition(type="Ready", status="True" if ready else "False",
+                      reason=phase, message=live_pod.status.message),
+        )
+        self._sync_status(sv)
+        self.metrics_ready.set(float(sum(
+            1 for s in self.api.list("Serving") if s.status.ready
+        )))
+        return Result()
+
+    def _validate(self, sv: Serving) -> str:
+        if sv.spec.model not in list_models():
+            return (f"unknown model {sv.spec.model!r}; known: "
+                    f"{sorted(list_models())}")
+        try:
+            st = get_slice(sv.spec.slice_type)
+        except (KeyError, ValueError) as e:
+            return f"unknown slice_type {sv.spec.slice_type!r}: {e}"
+        if st.num_hosts != 1:
+            return (f"serving slice must be single-host, {st.name} has "
+                    f"{st.num_hosts} hosts")
+        return ""
+
+    def _sync_status(self, sv) -> None:
+        live = self.api.try_get("Serving", sv.metadata.name,
+                                sv.metadata.namespace)
+        if live is not None and live.status != sv.status:
+            live.status = sv.status
+            self.api.update_status(live)
+
+    # ------------- emitted objects -------------
+
+    def _owner(self, sv) -> OwnerReference:
+        return OwnerReference(kind="Serving", name=sv.metadata.name,
+                              uid=sv.metadata.uid)
+
+    def _pod(self, sv: Serving, pod_name: str) -> Pod:
+        ns, name = sv.metadata.namespace, sv.metadata.name
+        st = get_slice(sv.spec.slice_type)
+        mesh = {a: v for a, v in vars(sv.spec.mesh).items() if v != 1}
+        env = [
+            EnvVar("KFTPU_SERVING_MODEL", sv.spec.model),
+            EnvVar("KFTPU_SERVING_MESH", json.dumps(mesh)),
+            EnvVar("KFTPU_SERVING_PORT", str(sv.spec.port)),
+            EnvVar("KFTPU_SERVING_MAX_BATCH", str(sv.spec.max_batch)),
+            EnvVar("KFTPU_SERVING_MAX_LEN", str(sv.spec.max_len)),
+            EnvVar("KFTPU_SERVING_DECODE_CHUNK", str(sv.spec.decode_chunk)),
+        ]
+        return Pod(
+            metadata=ObjectMeta(
+                name=pod_name, namespace=ns,
+                # Controller-owned selector label wins over user labels —
+                # a user-set "serving-name" must not break Service routing.
+                labels={**sv.metadata.labels, "serving-name": name},
+                owner_references=[self._owner(sv)],
+            ),
+            spec=PodSpec(
+                containers=[Container(
+                    name="serving", image=sv.spec.image, env=env,
+                    command=["python", "-m", "kubeflow_tpu.serving.server"],
+                    ports=[sv.spec.port],
+                    resources={st.resource_name(): str(st.chips_per_host)},
+                )],
+                node_selector=st.node_selectors(),
+                service_account="default-editor",
+            ),
+        )
+
+    def _service(self, sv: Serving) -> Service:
+        name, ns = sv.metadata.name, sv.metadata.namespace
+        return Service(
+            metadata=ObjectMeta(name=f"{name}-serving", namespace=ns,
+                                owner_references=[self._owner(sv)]),
+            spec=ServiceSpec(
+                selector={"serving-name": name},
+                ports=[ServicePort(name="http", port=80,
+                                   target_port=sv.spec.port)],
+            ),
+        )
+
+    def _virtual_service(self, sv: Serving) -> VirtualService:
+        name, ns = sv.metadata.name, sv.metadata.namespace
+        return VirtualService(
+            metadata=ObjectMeta(name=f"serving-{name}", namespace=ns,
+                                owner_references=[self._owner(sv)]),
+            gateways=[self.istio_gateway],
+            hosts=["*"],
+            http=[HttpRoute(
+                prefix=f"/serving/{ns}/{name}/", rewrite="/",
+                destination_host=f"{name}-serving.{ns}.svc.cluster.local",
+                destination_port=80,
+            )],
+        )

@@ -25,6 +25,7 @@ from kubeflow_tpu.controlplane.controllers import (
     NotebookController,
     PodDefaultMutator,
     ProfileController,
+    ServingController,
     StudyJobController,
     TensorboardController,
     TpuJobController,
@@ -45,6 +46,7 @@ DEFAULT_COMPONENTS = (
     "notebook-controller",
     "profile-controller",
     "tensorboard-controller",
+    "serving-controller",    # inference deployments (TF-Serving equivalent)
     "poddefault-webhook",
     "kfam",
     "jupyter-web-app",       # L3 spawner REST backend
@@ -130,6 +132,10 @@ class Platform:
             ))
         elif name == "tensorboard-controller":
             self.manager.register(TensorboardController(
+                self.api, reg, istio_gateway=cfg.spec.istio_gateway,
+            ))
+        elif name == "serving-controller":
+            self.manager.register(ServingController(
                 self.api, reg, istio_gateway=cfg.spec.istio_gateway,
             ))
         elif name == "poddefault-webhook":

@@ -13,10 +13,12 @@ from kubeflow_tpu.serving.engine import (
     ServingConfig,
     ServingEngine,
 )
+from kubeflow_tpu.serving.server import ServingServer
 
 __all__ = [
     "GenerationRequest",
     "GenerationResult",
     "ServingConfig",
     "ServingEngine",
+    "ServingServer",
 ]

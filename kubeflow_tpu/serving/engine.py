@@ -1,23 +1,30 @@
-"""Continuous-batching generation engine.
+"""Continuous-batching generation engine, shardable over a device mesh.
 
 TPU-first design:
 - A fixed slot batch [B, 1] decode step, compiled once; sequences join and
   leave slots without recompilation (static shapes).
 - Prefill runs per-slot at bucketed lengths (powers of two), compiled once
-  per bucket, writing K/V rows into the slot's cache region.
+  per bucket; the whole path — fresh cache row, forward, cache install at
+  the slot — is one jitted program with the batched cache donated, so no
+  host-side cache surgery and no per-request ``model.init``.
 - Per-slot cache indices (models.llama decode cache) let every slot sit at
   a different position — the core of continuous batching.
 - Sampling (greedy / temperature) happens on-device inside the compiled
   step; only generated token ids cross to host each step.
+- With a ``mesh``, params are device_put into their logical shardings and
+  the KV cache is laid out sharded: slot (batch) dim over dp/fsdp, KV-head
+  dim over tp — decode attention and the MLPs partition the same way the
+  training step does, scaling serving across chips (BASELINE config 5).
 
 Replaces the reference's serving story (external TF-Serving images probed
-by testing/test_tf_serving.py) with an engine the Serving deployment and
+by testing/test_tf_serving.py) with an engine the Serving controller and
 the bench harness share.
 """
 
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import itertools
 import time
@@ -27,7 +34,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from kubeflow_tpu.parallel.context import parallel_context
+from kubeflow_tpu.parallel.sharding import DEFAULT_RULES, Rules, param_shardings
 from kubeflow_tpu.utils import get_logger
 
 log = get_logger("serving")
@@ -58,6 +68,14 @@ class ServingConfig:
     max_batch: int = 8
     max_len: int = 1024
     prefill_buckets: tuple = (32, 64, 128, 256, 512)
+    # Cast float params to bf16 at engine start (decode is HBM-bound; half
+    # the bytes is nearly half the step time). "" keeps the given dtype.
+    param_dtype: str = "bfloat16"
+    # Tokens decoded per device dispatch (lax.scan on device). >1 amortises
+    # host->device dispatch latency — the dominant cost per step on remote/
+    # tunneled TPUs — at the price of admission/EOS checks every chunk
+    # (up to chunk-1 wasted speculative tokens per finished sequence).
+    decode_chunk: int = 1
 
 
 class _Slot:
@@ -72,30 +90,122 @@ class _Slot:
 
 
 class ServingEngine:
-    def __init__(self, model: nn.Module, params, cfg: ServingConfig):
+    def __init__(
+        self,
+        model: nn.Module,
+        params,
+        cfg: ServingConfig,
+        *,
+        mesh: Optional[Mesh] = None,
+        rules: Rules = DEFAULT_RULES,
+    ):
         if model.cfg.max_seq_len < cfg.max_len:
             raise ValueError(
                 f"model max_seq_len {model.cfg.max_seq_len} < engine max_len "
                 f"{cfg.max_len}"
             )
         self.model = model
-        self.params = params
         self.cfg = cfg
+        self.mesh = mesh
+        self.rules = rules
         self._queue: Deque[GenerationRequest] = collections.deque()
         self._slots: List[Optional[_Slot]] = [None] * cfg.max_batch
         self._results: Dict[int, GenerationResult] = {}
         self._req_ids = itertools.count()
         self._rng = jax.random.PRNGKey(0)
 
-        # Batched cache, allocated once.
-        self._cache = self.model.init(
-            jax.random.PRNGKey(0),
-            jnp.zeros((cfg.max_batch, 1), jnp.int32),
-            decode=True,
-        )["cache"]
-        self._decode_fn = jax.jit(self._decode_step)
+        # Accept params straight from model.init (boxed with flax logical-
+        # partitioning metadata) or already-unboxed trees.
+        params = nn.meta.unbox(params)
+        if cfg.param_dtype:
+            dt = jnp.dtype(cfg.param_dtype)
+            params = jax.tree.map(
+                lambda x: x.astype(dt)
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+                params,
+            )
+        self.params = self._place_params(params)
+        self._cache = self._init_cache()
+        self._decode_fn = jax.jit(self._decode_step, donate_argnums=(1,))
         self._prefill_fns: Dict[int, object] = {}
         self.tokens_generated = 0
+
+    # ------------- sharding -------------
+
+    def _pctx(self):
+        if self.mesh is None:
+            return contextlib.nullcontext()
+        return parallel_context(mesh=self.mesh, rules=self.rules,
+                                attn_impl="full")
+
+    def _mesh_ctx(self):
+        """Mesh context for invoking jitted fns (with_sharding_constraint
+        inside them resolves PartitionSpecs against the ambient mesh)."""
+        return self.mesh if self.mesh is not None else contextlib.nullcontext()
+
+    def _place_params(self, params):
+        """device_put params into their logical shardings (no-op layout on a
+        single device; the point is multi-chip tp/fsdp serving)."""
+        if self.mesh is None:
+            return params
+        abstract = jax.eval_shape(
+            lambda: self.model.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, 1), jnp.int32), decode=True,
+            )
+        )
+        shardings = param_shardings(self.mesh, abstract, self.rules)
+        shardings = {"params": nn.meta.unbox(shardings)["params"]}
+        return jax.device_put(params, shardings)
+
+    def _cache_sharding_tree(self, abstract_cache):
+        """KV leaves are [B,S,Hkv,D] (or [L,...] scanned): slot dim over the
+        batch axes, KV-head dim over tp when it divides. Index leaves
+        ([B] / [L,B]) follow the slot sharding."""
+        table = dict(self.rules)
+        batch_rule = table.get("act_batch")
+        tp_rule = table.get("act_heads")
+
+        def axis_size(rule) -> int:
+            if rule is None or self.mesh is None:
+                return 1
+            axes = (rule,) if isinstance(rule, str) else tuple(rule)
+            n = 1
+            for a in axes:
+                n *= self.mesh.shape.get(a, 1)
+            return n
+
+        kv_heads = int(getattr(self.model.cfg, "num_kv_heads", 0) or 0)
+        shard_heads = kv_heads > 0 and kv_heads % max(axis_size(tp_rule), 1) == 0
+        shard_slots = self.cfg.max_batch % max(axis_size(batch_rule), 1) == 0
+
+        def leaf_spec(leaf):
+            spec = [None] * len(leaf.shape)
+            if leaf.dtype == jnp.int32:          # cache_index [.., B]
+                if shard_slots:
+                    spec[-1] = batch_rule
+            else:                                 # K/V [.., B, S, H, D]
+                if shard_slots:
+                    spec[-4] = batch_rule
+                if shard_heads:
+                    spec[-2] = tp_rule
+            return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+        return jax.tree.map(leaf_spec, abstract_cache)
+
+    def _init_cache(self):
+        def mk():
+            return self.model.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((self.cfg.max_batch, 1), jnp.int32),
+                decode=True,
+            )["cache"]
+
+        if self.mesh is None:
+            return jax.jit(mk)()
+        out_shardings = self._cache_sharding_tree(jax.eval_shape(mk))
+        with self._mesh_ctx():
+            return jax.jit(mk, out_shardings=out_shardings)()
 
     # ------------- public API -------------
 
@@ -103,9 +213,14 @@ class ServingEngine:
         rid = next(self._req_ids)
         if not prompt:
             raise ValueError("empty prompt")
-        if len(prompt) >= self.cfg.max_len:
+        # Validate against BOTH limits here: _bucket raising later would
+        # poison the engine loop with an already-admitted slot.
+        limit = min(self.cfg.max_len - 1, self.cfg.prefill_buckets[-1])
+        if len(prompt) > limit:
             raise ValueError(
-                f"prompt length {len(prompt)} >= max_len {self.cfg.max_len}"
+                f"prompt length {len(prompt)} > limit {limit} "
+                f"(max_len {self.cfg.max_len}, largest prefill bucket "
+                f"{self.cfg.prefill_buckets[-1]})"
             )
         self._queue.append(GenerationRequest(
             prompt=list(prompt), request_id=rid, submitted_at=time.time(), **kw
@@ -139,6 +254,14 @@ class ServingEngine:
     def result(self, rid: int) -> Optional[GenerationResult]:
         return self._results.get(rid)
 
+    @property
+    def active_slots(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    @property
+    def queued(self) -> int:
+        return len(self._queue)
+
     # ------------- internals -------------
 
     def _bucket(self, n: int) -> int:
@@ -158,69 +281,98 @@ class ServingEngine:
             self._slots[i] = _Slot(req)
             self._prefill(i, req)
 
-    def _prefill_step(self, params, cache_row, tokens, length):
-        """Single-slot prefill on a [1, bucket] padded prompt. Pad tokens
-        beyond ``length`` do reach the cache (static shapes), but the slot's
-        cache_index is reset to ``length`` afterwards, so the junk K/V rows
-        sit beyond the index, get overwritten by subsequent decodes, and stay
-        causally masked until then."""
-        variables = {"params": params["params"], "cache": cache_row}
+    def _prefill_step(self, params, cache, tokens, length, slot_idx):
+        """Whole prefill as one program: run the [1, bucket] padded prompt
+        against a fresh zero cache row, then install the row into the donated
+        batched cache at ``slot_idx``. Pad tokens beyond ``length`` do reach
+        the row (static shapes), but its cache_index is set to ``length``, so
+        the junk K/V rows sit beyond the index, get overwritten by subsequent
+        decodes, and stay causally masked until then."""
+
+        def fresh_row(leaf):
+            if leaf.dtype == jnp.int32:           # [.., B] index
+                return jnp.zeros(leaf.shape[:-1] + (1,), jnp.int32)
+            return jnp.zeros(                      # [.., B, S, H, D]
+                leaf.shape[:-4] + (1,) + leaf.shape[-3:], leaf.dtype
+            )
+
+        row = jax.tree.map(fresh_row, cache)
         positions = jnp.arange(tokens.shape[1])[None, :]
-        logits, mut = self.model.apply(
-            variables, tokens, positions=positions, decode=True,
-            mutable=["cache"],
-        )
-        # cache_index leaves are the only int32 entries in the collection.
-        new_cache = jax.tree.map(
+        with self._pctx():
+            logits, mut = self.model.apply(
+                {"params": params["params"], "cache": row}, tokens,
+                positions=positions, decode=True, mutable=["cache"],
+            )
+        new_row = jax.tree.map(
             lambda x: jnp.full_like(x, length) if x.dtype == jnp.int32 else x,
             mut["cache"],
         )
+
+        def install(batch_leaf, row_leaf):
+            if batch_leaf.dtype == jnp.int32:
+                return jax.lax.dynamic_update_index_in_dim(
+                    batch_leaf, row_leaf[..., 0], slot_idx,
+                    axis=batch_leaf.ndim - 1,
+                )
+            return jax.lax.dynamic_update_slice_in_dim(
+                batch_leaf, row_leaf, slot_idx, axis=batch_leaf.ndim - 4
+            )
+
+        cache = jax.tree.map(install, cache, new_row)
         last_logits = logits[0, length - 1]
-        return last_logits, new_cache
+        return last_logits, cache
 
     def _prefill(self, slot_idx: int, req: GenerationRequest) -> None:
         bucket = self._bucket(len(req.prompt))
         if bucket not in self._prefill_fns:
-            self._prefill_fns[bucket] = jax.jit(self._prefill_step)
+            self._prefill_fns[bucket] = jax.jit(
+                self._prefill_step, donate_argnums=(1,)
+            )
         fn = self._prefill_fns[bucket]
 
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, : len(req.prompt)] = req.prompt
-        fresh_row = self.model.init(
-            jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32), decode=True
-        )["cache"]
-        last_logits, row_cache = fn(
-            self.params, fresh_row, jnp.asarray(tokens),
-            jnp.asarray(len(req.prompt), jnp.int32),
-        )
-        # Install the row into the batched cache at slot_idx. Leaf layouts:
-        # unscanned K/V [B,S,H,D], scanned [L,B,S,H,D]; index [B] or [L,B] —
-        # the batch axis is always ndim-4 for K/V and last for indices.
-        def install(batch_leaf, row_leaf):
-            if batch_leaf.dtype == jnp.int32:
-                return batch_leaf.at[..., slot_idx].set(row_leaf[..., 0])
-            return batch_leaf.at[..., slot_idx, :, :, :].set(
-                row_leaf[..., 0, :, :, :]
+        with self._mesh_ctx():
+            last_logits, self._cache = fn(
+                self.params, self._cache, jnp.asarray(tokens),
+                jnp.asarray(len(req.prompt), jnp.int32),
+                jnp.asarray(slot_idx, jnp.int32),
             )
-
-        self._cache = jax.tree.map(install, self._cache, row_cache)
         # First generated token comes from the prefill's last logits.
         tok = self._sample_host(last_logits, req.temperature)
         self._record_token(slot_idx, int(tok))
 
-    def _decode_step(self, params, cache, tokens, positions, rng, temps):
-        variables = {"params": params["params"], "cache": cache}
-        logits, mut = self.model.apply(
-            variables, tokens, positions=positions, decode=True,
-            mutable=["cache"],
-        )
-        logits = logits[:, 0]                      # [B, V]
+    def _sample_logits(self, logits, rng, temps):
         greedy = jnp.argmax(logits, axis=-1)
         gumbel = jax.random.gumbel(rng, logits.shape)
         temps_safe = jnp.maximum(temps, 1e-6)[:, None]
         sampled = jnp.argmax(logits / temps_safe + gumbel, axis=-1)
-        toks = jnp.where(temps > 0, sampled, greedy)
-        return toks.astype(jnp.int32), mut["cache"]
+        return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
+    def _decode_step(self, params, cache, tokens, positions, rng, temps):
+        """Decode ``decode_chunk`` tokens in one device program: a lax.scan
+        whose carry is (last token, position, cache) — one dispatch per
+        chunk instead of per token."""
+
+        def body(carry, rng_k):
+            toks, pos, cache_c = carry
+            with self._pctx():
+                logits, mut = self.model.apply(
+                    {"params": params["params"], "cache": cache_c}, toks,
+                    positions=pos, decode=True, mutable=["cache"],
+                )
+            nxt = self._sample_logits(logits[:, 0], rng_k, temps)
+            return (nxt[:, None], pos + 1, mut["cache"]), nxt
+
+        K = self.cfg.decode_chunk
+        if K <= 1:
+            (toks, _, cache), out = body((tokens, positions, cache), rng)
+            return out[:, None], cache
+        rngs = jax.random.split(rng, K)
+        (_, _, cache), out = jax.lax.scan(
+            body, (tokens, positions, cache), rngs
+        )
+        return out.T, cache                        # [B, K]
 
     def _decode_once(self) -> None:
         B = self.cfg.max_batch
@@ -235,15 +387,19 @@ class ServingEngine:
             positions[i, 0] = slot.pos
             temps[i] = slot.req.temperature
         self._rng, sub = jax.random.split(self._rng)
-        toks, self._cache = self._decode_fn(
-            self.params, self._cache, jnp.asarray(tokens),
-            jnp.asarray(positions), sub, jnp.asarray(temps),
-        )
-        toks = np.asarray(toks)
-        for i, slot in enumerate(self._slots):
-            if slot is None:
-                continue
-            self._record_token(i, int(toks[i]))
+        with self._mesh_ctx():
+            toks, self._cache = self._decode_fn(
+                self.params, self._cache, jnp.asarray(tokens),
+                jnp.asarray(positions), sub, jnp.asarray(temps),
+            )
+        toks = np.asarray(toks)                    # [B, K]
+        for k in range(toks.shape[1]):
+            for i, slot in enumerate(self._slots):
+                if slot is None:
+                    continue
+                # A slot freed earlier in this chunk ignores its speculative
+                # tail; the row is re-prefilled at next admission.
+                self._record_token(i, int(toks[i, k]))
 
     def _sample_host(self, logits: jax.Array, temperature: float) -> int:
         if temperature <= 0:

@@ -1,0 +1,238 @@
+"""Serving HTTP front-end: the engine as a queryable platform workload.
+
+The reference serves models via external TF-Serving deployments and its
+test drives them over REST/gRPC (deploy, wait ready, query, assert —
+testing/test_tf_serving.py:60-156). Here the front door is a thin stdlib
+HTTP app over the continuous-batching engine:
+
+  POST /v1/generate   {"tokens": [...], "max_new_tokens": N,
+                       "temperature": t, "eos_token": id}
+                      -> {"tokens": [...], "ttft_s": ..., "latency_s": ...}
+  GET  /v1/models     -> model + engine config
+  GET  /healthz       -> readiness probe (the controller's and the
+                         availability prober's poll target)
+
+A single driver thread owns the engine (JAX dispatch is not re-entrant);
+HTTP handlers enqueue requests and block on per-request events, so many
+concurrent clients batch into the same decode step — continuous batching
+over HTTP, not just in-process.
+
+The pod entrypoint (``python -m kubeflow_tpu.serving.server``) consumes the
+Serving controller's KFTPU_SERVING_* env contract, mirroring how TpuJob
+pods consume KFTPU_* via train.runner.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from kubeflow_tpu.serving.engine import ServingConfig, ServingEngine
+from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.webapps.router import JsonHttpServer, Request, RestError, Router
+
+log = get_logger("serving")
+
+
+class ServingServer:
+    """HTTP app + engine driver thread. ``start()`` returns once the engine
+    is compiled and the socket is listening (readiness == queryable)."""
+
+    def __init__(
+        self,
+        engine: ServingEngine,
+        *,
+        model_name: str = "",
+        host: str = "127.0.0.1",
+        port: int = 0,
+        request_timeout_s: float = 120.0,
+    ):
+        self.engine = engine
+        self.model_name = model_name
+        self.request_timeout_s = request_timeout_s
+        self.error = ""                  # set when the engine loop degrades
+        self._submissions: "queue.Queue[tuple]" = queue.Queue()
+        self._events: Dict[int, threading.Event] = {}
+        self._stop = threading.Event()
+        self._driver: Optional[threading.Thread] = None
+
+        router = Router()
+        router.post("/v1/generate", self._generate)
+        router.get("/v1/models", self._models)
+        router.get("/healthz", self._healthz)
+        self._http = JsonHttpServer(router, host=host, port=port)
+        self.port = self._http.port
+
+    # ------------- lifecycle -------------
+
+    def start(self) -> "ServingServer":
+        self._driver = threading.Thread(target=self._drive, daemon=True)
+        self._driver.start()
+        self._http.start()
+        log.info("serving up", kv={"port": self.port,
+                                   "model": self.model_name or "?"})
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._http.stop()
+        if self._driver:
+            self._driver.join(timeout=10)
+
+    # ------------- engine driver (single thread owns the engine) -------------
+
+    def _drive(self) -> None:
+        while not self._stop.is_set():
+            moved = False
+            try:
+                while True:
+                    prompt, kw, holder, ev = self._submissions.get_nowait()
+                    try:
+                        rid = self.engine.submit(prompt, **kw)
+                        holder["rid"] = rid
+                        self._events[rid] = ev
+                    except ValueError as e:
+                        holder["error"] = str(e)
+                        ev.set()
+                    moved = True
+            except queue.Empty:
+                pass
+            try:
+                if self.engine.step() > 0:
+                    moved = True
+            except Exception as e:  # noqa: BLE001 — driver must survive
+                # An engine failure must not silently kill the driver (every
+                # request would then 504 while /healthz stays green). Mark
+                # degraded, fail all waiters, keep draining submissions.
+                self.error = f"engine step failed: {e!r}"
+                log.error("engine step failed", kv={"err": repr(e)})
+                for rid in list(self._events):
+                    self._events.pop(rid).set()
+            for rid in [r for r in self._events]:
+                res = self.engine.result(rid)
+                if res is not None:
+                    self._events.pop(rid).set()
+            if not moved:
+                time.sleep(0.002)
+
+    # ------------- handlers -------------
+
+    def _generate(self, req: Request) -> Any:
+        tokens = req.body.get("tokens")
+        if not isinstance(tokens, list) or not all(
+            isinstance(t, int) for t in tokens
+        ):
+            raise RestError(400, "body.tokens must be a list of ints")
+        kw: Dict[str, Any] = {}
+        if "max_new_tokens" in req.body:
+            kw["max_new_tokens"] = int(req.body["max_new_tokens"])
+        if "temperature" in req.body:
+            kw["temperature"] = float(req.body["temperature"])
+        if "eos_token" in req.body:
+            kw["eos_token"] = int(req.body["eos_token"])
+        holder: Dict[str, Any] = {}
+        ev = threading.Event()
+        self._submissions.put((tokens, kw, holder, ev))
+        if not ev.wait(self.request_timeout_s):
+            raise RestError(504, "generation timed out")
+        if "error" in holder:
+            raise RestError(400, holder["error"])
+        res = self.engine.result(holder["rid"])
+        if res is None:
+            raise RestError(500, self.error or "generation failed")
+        return {
+            "tokens": res.tokens,
+            "prompt_len": res.prompt_len,
+            "finished_reason": res.finished_reason,
+            "ttft_s": res.ttft_s,
+            "latency_s": res.latency_s,
+        }
+
+    def _models(self, req: Request) -> Any:
+        cfg = self.engine.model.cfg
+        return {
+            "models": [{
+                "name": self.model_name or type(self.engine.model).__name__,
+                "vocab_size": cfg.vocab_size,
+                "max_len": self.engine.cfg.max_len,
+                "max_batch": self.engine.cfg.max_batch,
+            }]
+        }
+
+    def _healthz(self, req: Request) -> Any:
+        payload = {
+            "ok": not self.error,
+            "active": self.engine.active_slots,
+            "queued": self.engine.queued,
+            "tokens_generated": self.engine.tokens_generated,
+        }
+        if self.error:
+            payload["error"] = self.error
+            return 503, payload
+        return payload
+
+
+# ---------------------------------------------------------------- entrypoint
+
+
+def env_config() -> dict:
+    """KFTPU_SERVING_* env contract injected by the Serving controller."""
+    mesh = json.loads(os.environ.get("KFTPU_SERVING_MESH", "{}") or "{}")
+    return {
+        "model": os.environ.get("KFTPU_SERVING_MODEL", "llama-tiny"),
+        "mesh": mesh,
+        "port": int(os.environ.get("KFTPU_SERVING_PORT", "8000")),
+        "host": os.environ.get("KFTPU_SERVING_HOST", "0.0.0.0"),
+        "max_batch": int(os.environ.get("KFTPU_SERVING_MAX_BATCH", "8")),
+        "max_len": int(os.environ.get("KFTPU_SERVING_MAX_LEN", "1024")),
+        "decode_chunk": int(
+            os.environ.get("KFTPU_SERVING_DECODE_CHUNK", "8")),
+    }
+
+
+def build_server(cfg: dict) -> ServingServer:
+    import jax
+
+    from kubeflow_tpu.models import get_model
+    from kubeflow_tpu.topology import AxisSpec, make_host_local_mesh
+
+    model, _ = get_model(cfg["model"])
+    mesh = None
+    if cfg["mesh"]:
+        mesh = make_host_local_mesh(
+            AxisSpec(**{k: int(v) for k, v in cfg["mesh"].items()})
+        )
+    params = model.init(
+        jax.random.PRNGKey(0),
+        jax.numpy.zeros((1, 1), jax.numpy.int32), decode=True,
+    )
+    params = {"params": params["params"]}
+    engine = ServingEngine(
+        model, params,
+        ServingConfig(max_batch=cfg["max_batch"], max_len=cfg["max_len"],
+                      decode_chunk=cfg["decode_chunk"]),
+        mesh=mesh,
+    )
+    return ServingServer(
+        engine, model_name=cfg["model"], host=cfg["host"], port=cfg["port"],
+    )
+
+
+def main() -> int:
+    server = build_server(env_config()).start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -15,6 +15,12 @@ Usage:
   tpuctl status --state-dir .tpuctl
   tpuctl delete -f job.yaml | --kind TpuJob --name x -n ns  --state-dir .tpuctl
   tpuctl metrics --state-dir .tpuctl
+
+Backends (--backend):
+  state    (default) the embedded Platform: in-memory apiserver + local
+           controllers, state persisted under --state-dir.
+  kubectl  a real cluster through the kubectl adapter (controllers are
+           expected to run in-cluster; apply/get/delete only).
 """
 
 from __future__ import annotations
@@ -26,7 +32,7 @@ from typing import List
 
 import yaml
 
-from kubeflow_tpu.controlplane.api import to_dict
+from kubeflow_tpu.controlplane.api import object_from_dict, to_dict
 from kubeflow_tpu.controlplane.platform import Platform
 
 
@@ -40,11 +46,31 @@ def _load_docs(paths: List[str]) -> List[dict]:
     return docs
 
 
+def _kubectl_api(args):
+    from kubeflow_tpu.controlplane.runtime.kubectl import KubectlApiServer
+
+    return KubectlApiServer(kubectl=args.kubectl_bin, context=args.context)
+
+
 def cmd_apply(args) -> int:
-    platform = Platform.load(args.state_dir)
     docs = _load_docs(args.filename)
     # PlatformConfigs first (components must exist before CRs reconcile).
     docs.sort(key=lambda d: 0 if d.get("kind") == "PlatformConfig" else 1)
+    if args.backend == "kubectl":
+        api = _kubectl_api(args)
+        for d in docs:
+            obj = object_from_dict(d)
+            live = api.try_get(obj.kind, obj.metadata.name,
+                               obj.metadata.namespace)
+            if live is None:
+                api.create(obj)
+            elif getattr(obj, "spec", None) is not None \
+                    and live.spec != obj.spec:
+                live.spec = obj.spec
+                api.update(live)
+            print(f"applied {obj.kind}/{obj.metadata.name}")
+        return 0
+    platform = Platform.load(args.state_dir)
     applied = []
     for d in docs:
         obj = platform.apply_resource(d)
@@ -58,8 +84,11 @@ def cmd_apply(args) -> int:
 
 
 def cmd_get(args) -> int:
-    platform = Platform.load(args.state_dir)
-    objs = platform.api.list(args.kind, namespace=args.namespace)
+    if args.backend == "kubectl":
+        objs = _kubectl_api(args).list(args.kind, namespace=args.namespace)
+    else:
+        platform = Platform.load(args.state_dir)
+        objs = platform.api.list(args.kind, namespace=args.namespace)
     if args.output == "yaml":
         yaml.safe_dump_all([to_dict(o) for o in objs], sys.stdout,
                            sort_keys=False)
@@ -77,6 +106,10 @@ def cmd_get(args) -> int:
 
 
 def cmd_status(args) -> int:
+    if args.backend == "kubectl":
+        print("status is a state-backend command (in-cluster controllers "
+              "own platform state)", file=sys.stderr)
+        return 2
     platform = Platform.load(args.state_dir)
     out = {
         "components": platform.components,
@@ -95,7 +128,6 @@ def cmd_status(args) -> int:
 
 
 def cmd_delete(args) -> int:
-    platform = Platform.load(args.state_dir)
     targets = []
     if args.filename:
         for d in _load_docs(args.filename):
@@ -107,6 +139,17 @@ def cmd_delete(args) -> int:
     else:
         print("delete needs -f or --kind/--name", file=sys.stderr)
         return 2
+    if args.backend == "kubectl":
+        api = _kubectl_api(args)
+        for kind, name, ns in targets:
+            try:
+                api.delete(kind, name, ns)
+                print(f"deleted {kind}/{name}")
+            except Exception as e:
+                print(f"error deleting {kind}/{name}: {e}", file=sys.stderr)
+                return 1
+        return 0
+    platform = Platform.load(args.state_dir)
     for kind, name, ns in targets:
         try:
             platform.api.delete(kind, name, ns)
@@ -120,6 +163,9 @@ def cmd_delete(args) -> int:
 
 
 def cmd_metrics(args) -> int:
+    if args.backend == "kubectl":
+        print("metrics is a state-backend command", file=sys.stderr)
+        return 2
     platform = Platform.load(args.state_dir)
     platform.reconcile()
     sys.stdout.write(platform.registry.render())
@@ -130,6 +176,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpuctl",
                                 description="TPU-native Kubeflow control CLI")
     p.add_argument("--state-dir", default=".tpuctl")
+    p.add_argument("--backend", choices=("state", "kubectl"), default="state")
+    p.add_argument("--kubectl-bin", default="kubectl")
+    p.add_argument("--context", default="")
     sub = p.add_subparsers(dest="command", required=True)
 
     ap = sub.add_parser("apply", help="apply platform config / manifests")

@@ -1,0 +1,203 @@
+#!/usr/bin/env python3
+"""A kubectl test double for KubectlApiServer integration tests.
+
+Speaks the exact slice of the kubectl CLI the adapter uses (create/get/
+replace/delete with -o json, -n/-l/--all-namespaces, --subresource status)
+against a JSON-file store in $FAKE_KUBECTL_DIR — the process-boundary
+analogue of the reference's envtest: real exec + serialization semantics,
+no cluster. Implements apiserver behaviours the adapter's error mapping
+relies on: AlreadyExists/NotFound/Conflict(resourceVersion), and
+ownerReference cascade on delete.
+"""
+
+import json
+import os
+import sys
+import time
+import uuid
+from pathlib import Path
+
+STORE = Path(os.environ.get("FAKE_KUBECTL_DIR", "/tmp/fake-kubectl"))
+CLUSTER_SCOPED = {"Namespace", "Profile", "PlatformConfig"}
+
+
+def fail(msg, code=1):
+    print(msg, file=sys.stderr)
+    sys.exit(code)
+
+
+def kind_from_resource(res):
+    base = res.split(".")[0].rstrip()
+    # tpujobs -> TpuJob etc: match against the store's known kinds plus a
+    # static map for core/foreign kinds.
+    known = {
+        "pods": "Pod", "services": "Service", "namespaces": "Namespace",
+        "serviceaccounts": "ServiceAccount", "resourcequotas": "ResourceQuota",
+        "events": "Event", "rolebindings": "RoleBinding",
+        "virtualservices": "VirtualService",
+        "authorizationpolicies": "AuthorizationPolicy",
+        "tpujobs": "TpuJob", "notebooks": "Notebook", "profiles": "Profile",
+        "poddefaults": "PodDefault", "tensorboards": "Tensorboard",
+        "servings": "Serving", "studyjobs": "StudyJob",
+        "platformconfigs": "PlatformConfig",
+    }
+    if base not in known:
+        fail(f"error: the server doesn't have a resource type {base!r}")
+    return known[base]
+
+
+def path_for(kind, ns, name):
+    ns = "" if kind in CLUSTER_SCOPED else ns
+    return STORE / kind / (f"{ns}__{name}.json")
+
+
+def load_all(kind):
+    d = STORE / kind
+    if not d.is_dir():
+        return []
+    return [json.loads(p.read_text()) for p in sorted(d.glob("*.json"))]
+
+
+def next_rv():
+    p = STORE / "_rv"
+    rv = int(p.read_text()) if p.exists() else 0
+    rv += 1
+    p.write_text(str(rv))
+    return rv
+
+
+def save(obj):
+    kind = obj["kind"]
+    meta = obj["metadata"]
+    p = path_for(kind, meta.get("namespace", ""), meta["name"])
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps(obj))
+
+
+def parse_flags(argv):
+    flags = {"ns": "", "all_ns": False, "selector": "", "output": "",
+             "subresource": "", "positional": []}
+    it = iter(argv)
+    for a in it:
+        if a in ("-n", "--namespace"):
+            flags["ns"] = next(it)
+        elif a == "--all-namespaces":
+            flags["all_ns"] = True
+        elif a == "-l":
+            flags["selector"] = next(it)
+        elif a == "-o":
+            flags["output"] = next(it)
+        elif a == "--subresource":
+            flags["subresource"] = next(it)
+        elif a == "-f":
+            next(it)  # always "-" (stdin)
+        elif a.startswith("--wait"):
+            pass
+        elif a == "--context":
+            next(it)
+        else:
+            flags["positional"].append(a)
+    return flags
+
+
+def cmd_create(flags):
+    obj = json.load(sys.stdin)
+    kind, meta = obj["kind"], obj["metadata"]
+    p = path_for(kind, meta.get("namespace", ""), meta["name"])
+    if p.exists():
+        fail(f'Error from server (AlreadyExists): {kind.lower()}s '
+             f'"{meta["name"]}" already exists')
+    meta["uid"] = str(uuid.uuid4())
+    meta["resourceVersion"] = str(next_rv())
+    meta["generation"] = 1
+    meta["creationTimestamp"] = time.time()
+    save(obj)
+    print(json.dumps(obj))
+
+
+def cmd_get(flags):
+    pos = flags["positional"]
+    kind = kind_from_resource(pos[0])
+    if len(pos) > 1:                        # single object
+        p = path_for(kind, flags["ns"], pos[1])
+        if not p.exists():
+            fail(f'Error from server (NotFound): {pos[0]} "{pos[1]}" not found')
+        print(p.read_text())
+        return
+    items = load_all(kind)
+    if not flags["all_ns"] and flags["ns"] and kind not in CLUSTER_SCOPED:
+        items = [o for o in items
+                 if o["metadata"].get("namespace") == flags["ns"]]
+    if flags["selector"]:
+        want = dict(kv.split("=", 1) for kv in flags["selector"].split(","))
+        items = [o for o in items
+                 if all(o["metadata"].get("labels", {}).get(k) == v
+                        for k, v in want.items())]
+    print(json.dumps({"kind": f"{kind}List", "items": items}))
+
+
+def cmd_replace(flags):
+    obj = json.load(sys.stdin)
+    kind, meta = obj["kind"], obj["metadata"]
+    p = path_for(kind, meta.get("namespace", ""), meta["name"])
+    if not p.exists():
+        fail(f'Error from server (NotFound): {kind.lower()}s '
+             f'"{meta["name"]}" not found')
+    cur = json.loads(p.read_text())
+    if str(meta.get("resourceVersion", "")) != str(
+            cur["metadata"]["resourceVersion"]):
+        fail(f'Error from server (Conflict): Operation cannot be fulfilled: '
+             f'the object has been modified')
+    if flags["subresource"] == "status":
+        cur["status"] = obj.get("status", {})
+        cur["metadata"]["resourceVersion"] = str(next_rv())
+        save(cur)
+        print(json.dumps(cur))
+        return
+    # Server-owned identity survives replace.
+    meta["uid"] = cur["metadata"]["uid"]
+    meta["creationTimestamp"] = cur["metadata"].get("creationTimestamp")
+    meta["resourceVersion"] = str(next_rv())
+    gen = cur["metadata"].get("generation", 1)
+    meta["generation"] = gen + (1 if obj.get("spec") != cur.get("spec") else 0)
+    save(obj)
+    print(json.dumps(obj))
+
+
+def cmd_delete(flags):
+    pos = flags["positional"]
+    kind = kind_from_resource(pos[0])
+    p = path_for(kind, flags["ns"], pos[1])
+    if not p.exists():
+        fail(f'Error from server (NotFound): {pos[0]} "{pos[1]}" not found')
+    obj = json.loads(p.read_text())
+    p.unlink()
+    # ownerReference cascade (real clusters: garbage collector controller).
+    uid = obj["metadata"]["uid"]
+    for d in STORE.iterdir():
+        if not d.is_dir():
+            continue
+        for f in list(d.glob("*.json")):
+            dep = json.loads(f.read_text())
+            refs = dep["metadata"].get("ownerReferences", [])
+            if any(r.get("uid") == uid for r in refs):
+                f.unlink()
+    print(f'{pos[0]} "{pos[1]}" deleted')
+
+
+def main():
+    STORE.mkdir(parents=True, exist_ok=True)
+    argv = sys.argv[1:]
+    if not argv:
+        fail("usage: fake_kubectl <verb> ...")
+    verb, rest = argv[0], parse_flags(argv[1:])
+    {
+        "create": cmd_create,
+        "get": cmd_get,
+        "replace": cmd_replace,
+        "delete": cmd_delete,
+    }.get(verb, lambda f: fail(f"unknown verb {verb}"))(rest)
+
+
+if __name__ == "__main__":
+    main()

@@ -395,3 +395,84 @@ class TestTensorboard:
         assert vs.http[0].prefix == "/tensorboard/team-a/tb1/"
         tb = api.get("Tensorboard", "tb1", "team-a")
         assert tb.status.ready is True
+
+
+class TestServing:
+    def _world(self):
+        from kubeflow_tpu.controlplane.controllers import ServingController
+
+        api = InMemoryApiServer()
+        reg = MetricsRegistry()
+        mgr = ControllerManager(api)
+        mgr.register(ServingController(api, reg))
+        kubelet = FakeKubelet(api, reg)
+        mgr.register(kubelet)
+        return api, mgr, kubelet
+
+    def _serving(self, name="llm", ns="team-a", **kw):
+        from kubeflow_tpu.controlplane.api import Serving, ServingSpec
+
+        kw.setdefault("model", "llama-tiny")
+        kw.setdefault("slice_type", "v5e-8")
+        return Serving(metadata=ObjectMeta(name=name, namespace=ns),
+                       spec=ServingSpec(**kw))
+
+    def test_deploy_wait_ready_contract(self):
+        """The reference's serving lifecycle (test_tf_serving.py:60-156):
+        deploy, readiness gate flips when the pod runs, endpoint routed."""
+        api, mgr, kubelet = self._world()
+        api.create(self._serving(max_batch=4, port=9000))
+        mgr.run_until_idle()
+
+        pod = api.get("Pod", "llm-serving-0", "team-a")
+        env = {e.name: e.value for e in pod.spec.containers[0].env}
+        assert env["KFTPU_SERVING_MODEL"] == "llama-tiny"
+        assert env["KFTPU_SERVING_PORT"] == "9000"
+        assert env["KFTPU_SERVING_MAX_BATCH"] == "4"
+        assert pod.spec.containers[0].command[-1] == \
+            "kubeflow_tpu.serving.server"
+        assert "google.com/tpu" in str(pod.spec.containers[0].resources)
+
+        kubelet.tick()
+        mgr.run_until_idle()
+        sv = api.get("Serving", "llm", "team-a")
+        assert sv.status.ready is True
+        assert sv.status.phase == "Ready"
+        assert sv.status.endpoint == "/serving/team-a/llm/"
+        svc = api.get("Service", "llm-serving", "team-a")
+        assert svc.spec.ports[0].target_port == 9000
+        vs = api.get("VirtualService", "serving-llm", "team-a")
+        assert vs.http[0].prefix == "/serving/team-a/llm/"
+
+    def test_invalid_model_fails(self):
+        api, mgr, _ = self._world()
+        api.create(self._serving(name="bad", model="no-such-model"))
+        mgr.run_until_idle()
+        sv = api.get("Serving", "bad", "team-a")
+        assert sv.status.phase == "Failed"
+        assert sv.status.ready is False
+        assert api.try_get("Pod", "bad-serving-0", "team-a") is None
+
+    def test_multihost_slice_rejected(self):
+        api, mgr, _ = self._world()
+        api.create(self._serving(name="big", slice_type="v5e-16"))
+        mgr.run_until_idle()
+        sv = api.get("Serving", "big", "team-a")
+        assert sv.status.phase == "Failed"
+
+    def test_unknown_slice_type_fails_not_crashes(self):
+        api, mgr, _ = self._world()
+        api.create(self._serving(name="typo", slice_type="v5e-7"))
+        mgr.run_until_idle()
+        sv = api.get("Serving", "typo", "team-a")
+        assert sv.status.phase == "Failed"
+        assert "slice_type" in sv.status.conditions[-1].message
+
+    def test_user_label_cannot_break_selector(self):
+        api, mgr, kubelet = self._world()
+        sv = self._serving(name="lbl")
+        sv.metadata.labels["serving-name"] = "sabotage"
+        api.create(sv)
+        mgr.run_until_idle()
+        pod = api.get("Pod", "lbl-serving-0", "team-a")
+        assert pod.metadata.labels["serving-name"] == "lbl"

@@ -1,0 +1,244 @@
+"""KubectlApiServer integration: controllers run UNMODIFIED against a
+kubectl backend (here the fake_kubectl test double — real exec + JSON
+serialization + apiserver error semantics at a process boundary).
+
+This is the acceptance for the real-backend seam: the substitution claim
+in runtime/apiserver.py is code, not a comment.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from kubeflow_tpu.controlplane.api import (
+    Notebook,
+    NotebookSpec,
+    ObjectMeta,
+    TpuJob,
+    TpuJobSpec,
+)
+from kubeflow_tpu.controlplane.runtime.apiserver import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+)
+from kubeflow_tpu.controlplane.runtime.kubectl import (
+    KubectlApiServer,
+    resource_for,
+)
+from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+FAKE = Path(__file__).parent / "fake_kubectl.py"
+
+
+@pytest.fixture()
+def api(tmp_path, monkeypatch):
+    monkeypatch.setenv("FAKE_KUBECTL_DIR", str(tmp_path / "store"))
+    # Invoke the double through the same interpreter (no +x / shebang needs).
+    wrapper = tmp_path / "kubectl"
+    wrapper.write_text(
+        f"#!/bin/sh\nexec {sys.executable} {FAKE} \"$@\"\n"
+    )
+    wrapper.chmod(0o755)
+    return KubectlApiServer(kubectl=str(wrapper))
+
+
+def _job(name="train", ns="team-a"):
+    return TpuJob(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=TpuJobSpec(slice_type="v5e-16", model="llama-tiny"),
+    )
+
+
+class TestKubectlCrud:
+    def test_create_get_roundtrip(self, api):
+        created = api.create(_job())
+        assert created.metadata.uid
+        assert created.metadata.resource_version > 0
+        got = api.get("TpuJob", "train", "team-a")
+        assert got.spec.model == "llama-tiny"
+        assert got.metadata.uid == created.metadata.uid
+
+    def test_already_exists_and_not_found(self, api):
+        api.create(_job())
+        with pytest.raises(AlreadyExistsError):
+            api.create(_job())
+        with pytest.raises(NotFoundError):
+            api.get("TpuJob", "nope", "team-a")
+        assert api.try_get("TpuJob", "nope", "team-a") is None
+
+    def test_update_conflict_on_stale_rv(self, api):
+        api.create(_job())
+        a = api.get("TpuJob", "train", "team-a")
+        b = api.get("TpuJob", "train", "team-a")
+        a.spec.max_restarts = 7
+        api.update(a)
+        b.spec.max_restarts = 9
+        with pytest.raises(ConflictError):
+            api.update(b)
+
+    def test_update_status_preserves_live_spec(self, api):
+        api.create(_job())
+        stale = api.get("TpuJob", "train", "team-a")
+        live = api.get("TpuJob", "train", "team-a")
+        live.spec.max_restarts = 5
+        api.update(live)
+        stale.status.phase = "Running"
+        api.update_status(stale)
+        got = api.get("TpuJob", "train", "team-a")
+        assert got.status.phase == "Running"
+        assert got.spec.max_restarts == 5      # concurrent spec write won
+
+    def test_list_with_selector_and_namespace(self, api):
+        j1 = _job("a", "team-a")
+        j1.metadata.labels["tier"] = "prod"
+        j2 = _job("b", "team-a")
+        j3 = _job("c", "team-b")
+        for j in (j1, j2, j3):
+            api.create(j)
+        assert {j.metadata.name for j in api.list("TpuJob")} == {"a", "b", "c"}
+        assert [j.metadata.name
+                for j in api.list("TpuJob", namespace="team-b")] == ["c"]
+        assert [j.metadata.name
+                for j in api.list("TpuJob", namespace="team-a",
+                                  label_selector={"tier": "prod"})] == ["a"]
+
+    def test_delete_cascades_owner_references(self, api):
+        from kubeflow_tpu.controlplane.api.meta import OwnerReference
+        from kubeflow_tpu.controlplane.api import Pod
+        from kubeflow_tpu.controlplane.api.core import PodSpec
+
+        owner = api.create(_job())
+        pod = Pod(metadata=ObjectMeta(
+            name="train-w0", namespace="team-a",
+            owner_references=[OwnerReference(
+                kind="TpuJob", name="train", uid=owner.metadata.uid)],
+        ), spec=PodSpec())
+        api.create(pod)
+        api.delete("TpuJob", "train", "team-a")
+        assert api.try_get("Pod", "train-w0", "team-a") is None
+
+    def test_resource_names(self):
+        assert resource_for("TpuJob") == "tpujobs.tpu.kubeflow.org"
+        assert resource_for("Pod") == "pods"
+        assert resource_for("VirtualService") == \
+            "virtualservices.networking.istio.io"
+
+
+class TestKubectlWatch:
+    def test_poll_diffs_into_events(self, api):
+        q = api.watch("TpuJob")
+        api.create(_job())
+        assert api.poll_now() >= 1
+        ev = q.get_nowait()
+        assert ev.type == "ADDED" and ev.object.metadata.name == "train"
+
+        live = api.get("TpuJob", "train", "team-a")
+        live.spec.max_restarts = 2
+        api.update(live)
+        api.poll_now()
+        assert q.get_nowait().type == "MODIFIED"
+
+        api.delete("TpuJob", "train", "team-a")
+        api.poll_now()
+        ev = q.get_nowait()
+        assert ev.type == "DELETED" and ev.object.metadata.name == "train"
+
+
+class TestControllersOnKubectl:
+    def test_notebook_controller_unmodified(self, api):
+        """The seam's point: NotebookController (written against the
+        in-memory store) reconciles through kubectl untouched."""
+        from kubeflow_tpu.controlplane.controllers import NotebookController
+        from kubeflow_tpu.controlplane.runtime import ControllerManager
+
+        reg = MetricsRegistry()
+        mgr = ControllerManager(api)
+        mgr.register(NotebookController(api, reg))
+
+        api.create(Notebook(
+            metadata=ObjectMeta(name="nb", namespace="team-a"),
+            spec=NotebookSpec(image="jupyter:latest"),
+        ))
+        api.poll_now()
+        mgr.run_until_idle()
+
+        pod = api.get("Pod", "nb-0", "team-a")
+        assert pod.spec.containers[0].image == "jupyter:latest"
+        svc = api.get("Service", "nb", "team-a")
+        assert svc.spec.ports[0].target_port == 8888
+        vs = api.get("VirtualService", "notebook-nb", "team-a")
+        assert vs.http[0].prefix == "/notebook/team-a/nb/"
+
+        # Pod phase flip -> status mirrored on next poll+drain, exactly as
+        # on the in-memory backend.
+        pod.status.phase = "Running"
+        api.update(pod)
+        api.poll_now()
+        mgr.run_until_idle()
+        nb = api.get("Notebook", "nb", "team-a")
+        assert nb.status.ready_replicas == 1
+        assert nb.status.container_state == "Running"
+
+
+class TestKubectlWatchReplay:
+    def test_late_subscriber_gets_existing_objects(self, api):
+        """A watch registered after the kind was already polled must replay
+        current state as ADDED (the informer contract controllers rely on)."""
+        q1 = api.watch("TpuJob")
+        api.create(_job("a"))
+        api.poll_now()
+        q1.get_nowait()                      # q1 saw the ADDED
+
+        q2 = api.watch("TpuJob")             # late subscriber
+        ev = q2.get_nowait()
+        assert ev.type == "ADDED" and ev.object.metadata.name == "a"
+        # And the replay must not duplicate into the next poll for q2
+        # beyond at most one benign MODIFIED.
+        api.poll_now()
+        assert q2.qsize() <= 1
+
+    def test_unscoped_watch_rejected(self, api):
+        from kubeflow_tpu.controlplane.runtime.apiserver import ApiError
+
+        with pytest.raises(ApiError, match="kind-scoped"):
+            api.watch(None)
+
+
+class TestTpuctlKubectlBackend:
+    def test_apply_get_delete_against_cluster(self, api, tmp_path):
+        """tpuctl --backend kubectl targets the (fake) cluster: apply is
+        create-or-update, get lists live objects, delete removes them."""
+        from kubeflow_tpu.tools.tpuctl import main as tpuctl
+
+        manifest = tmp_path / "job.yaml"
+        manifest.write_text(
+            "kind: TpuJob\n"
+            "metadata: {name: train, namespace: team-a}\n"
+            "spec: {sliceType: v5e-16, model: llama-tiny}\n"
+        )
+        flags = ["--backend", "kubectl", "--kubectl-bin", api.kubectl]
+        assert tpuctl(flags + ["apply", "-f", str(manifest)]) == 0
+        got = api.get("TpuJob", "train", "team-a")
+        assert got.spec.model == "llama-tiny"
+
+        # Second apply with identical spec: no-op (resourceVersion stable).
+        rv1 = got.metadata.resource_version
+        assert tpuctl(flags + ["apply", "-f", str(manifest)]) == 0
+        assert api.get("TpuJob", "train", "team-a"
+                       ).metadata.resource_version == rv1
+
+        # Spec change: update flows through.
+        manifest.write_text(
+            "kind: TpuJob\n"
+            "metadata: {name: train, namespace: team-a}\n"
+            "spec: {sliceType: v5e-16, model: llama-tiny, maxRestarts: 9}\n"
+        )
+        assert tpuctl(flags + ["apply", "-f", str(manifest)]) == 0
+        assert api.get("TpuJob", "train", "team-a").spec.max_restarts == 9
+
+        assert tpuctl(flags + ["delete", "--kind", "TpuJob",
+                               "--name", "train", "-n", "team-a"]) == 0
+        assert api.try_get("TpuJob", "train", "team-a") is None

@@ -1,10 +1,15 @@
+import json
+import urllib.error
+import urllib.request
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from kubeflow_tpu.models import Llama, LlamaConfig
-from kubeflow_tpu.serving import ServingConfig, ServingEngine
+from kubeflow_tpu.serving import ServingConfig, ServingEngine, ServingServer
+from kubeflow_tpu.topology.mesh import AxisSpec, make_host_local_mesh
 
 
 @pytest.fixture(scope="module")
@@ -110,6 +115,186 @@ class TestServingEngine:
         assert res.latency_s > 0
         assert 0 < res.ttft_s <= res.latency_s
         assert eng.tokens_generated == 4
+
+
+class TestChunkedDecode:
+    def test_chunked_matches_single_step(self, model_and_params):
+        """decode_chunk>1 (lax.scan on device) is a dispatch optimisation,
+        not a semantic change: greedy output identical to chunk=1."""
+        model, params = model_and_params
+        prompts = [[3, 14, 15, 92], [7, 8, 9]]
+        want, got = [], []
+        for chunk in (1, 4):
+            eng = ServingEngine(
+                model, params,
+                ServingConfig(max_batch=2, max_len=128, decode_chunk=chunk),
+            )
+            rids = [eng.submit(p, max_new_tokens=7) for p in prompts]
+            eng.run()
+            (want if chunk == 1 else got).extend(
+                eng.result(r).tokens for r in rids
+            )
+        assert got == want
+
+    def test_eos_mid_chunk_trims(self, model_and_params):
+        model, params = model_and_params
+        ref = greedy_reference(model, params, [5, 6, 7], 8)
+        eos = ref[2]
+        eng = ServingEngine(
+            model, params,
+            ServingConfig(max_batch=1, max_len=128, decode_chunk=4),
+        )
+        eng.submit([5, 6, 7], max_new_tokens=8, eos_token=eos)
+        res = eng.run()[0]
+        assert res.finished_reason == "eos"
+        assert res.tokens == ref[:3]
+
+    def test_admission_after_chunk_completion(self, model_and_params):
+        """Slots freed mid-chunk must re-admit cleanly (cache row reset by
+        prefill) — more requests than slots with chunked decode."""
+        model, params = model_and_params
+        eng = ServingEngine(
+            model, params,
+            ServingConfig(max_batch=2, max_len=128, decode_chunk=4),
+        )
+        prompts = [[1, 2, 3], [4, 5], [6, 7, 8], [9, 10]]
+        solo = []
+        for p in prompts:
+            ref = ServingEngine(model, params,
+                                ServingConfig(max_batch=1, max_len=128))
+            ref.submit(p, max_new_tokens=5)
+            solo.append(ref.run()[0].tokens)
+        rids = [eng.submit(p, max_new_tokens=5) for p in prompts]
+        eng.run()
+        assert [eng.result(r).tokens for r in rids] == solo
+
+
+class TestShardedServing:
+    def test_sharded_engine_matches_unsharded(self, model_and_params,
+                                              devices8):
+        """tp-sharded KV heads + dp-sharded slots must be a pure relayout:
+        greedy tokens identical to the single-device engine."""
+        model, params = model_and_params
+        mesh = make_host_local_mesh(AxisSpec(dp=4, tp=2))
+        prompts = [[3, 14, 15, 92], [7, 8], [100] * 11]
+
+        plain = ServingEngine(model, params,
+                              ServingConfig(max_batch=4, max_len=128))
+        rids = [plain.submit(p, max_new_tokens=6) for p in prompts]
+        plain.run()
+        want = [plain.result(r).tokens for r in rids]
+
+        sharded = ServingEngine(
+            model, params, ServingConfig(max_batch=4, max_len=128), mesh=mesh
+        )
+        rids = [sharded.submit(p, max_new_tokens=6) for p in prompts]
+        sharded.run()
+        got = [sharded.result(r).tokens for r in rids]
+        assert got == want
+
+        # The layout is real: KV cache heads sharded over tp, slots over dp.
+        kv = [l for l in jax.tree.leaves(sharded._cache)
+              if l.dtype != jnp.int32][0]
+        spec = kv.sharding.spec
+        assert spec[kv.ndim - 2] == "tp"
+
+    def test_params_land_in_logical_shardings(self, model_and_params,
+                                              devices8):
+        model, params = model_and_params
+        mesh = make_host_local_mesh(AxisSpec(dp=4, tp=2))
+        eng = ServingEngine(
+            model, params, ServingConfig(max_batch=4, max_len=128), mesh=mesh
+        )
+        # q_proj kernel is ("embed","heads","head_dim"): heads on tp.
+        k = eng.params["params"]["layer_0"]["attn"]["q_proj"]["kernel"]
+        assert k.sharding.spec[1] == "tp", k.sharding.spec
+
+
+class TestServingServer:
+    def test_http_generate_roundtrip(self, model_and_params):
+        """Mirror of the reference serving probe (test_tf_serving.py:60-156):
+        start the server, wait healthy, query generate over HTTP, assert the
+        tokens match the engine's ground truth."""
+        model, params = model_and_params
+        engine = ServingEngine(model, params,
+                               ServingConfig(max_batch=2, max_len=128))
+        server = ServingServer(engine, model_name="llama-test").start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            health = json.load(urllib.request.urlopen(f"{base}/healthz"))
+            assert health["ok"] is True
+
+            models = json.load(urllib.request.urlopen(f"{base}/v1/models"))
+            assert models["models"][0]["name"] == "llama-test"
+
+            prompt = [3, 14, 15, 92, 65]
+            req = urllib.request.Request(
+                f"{base}/v1/generate",
+                data=json.dumps(
+                    {"tokens": prompt, "max_new_tokens": 6}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            out = json.load(urllib.request.urlopen(req))
+            ref = greedy_reference(model, params, prompt, 6)
+            assert out["tokens"] == ref
+            assert out["prompt_len"] == len(prompt)
+            assert out["latency_s"] >= out["ttft_s"] > 0
+        finally:
+            server.stop()
+
+    def test_oversized_prompt_rejected_not_fatal(self, model_and_params):
+        """A prompt beyond the largest prefill bucket must 400 — and must
+        NOT kill the engine driver (the server stays serviceable)."""
+        model, params = model_and_params
+        engine = ServingEngine(
+            model, params,
+            ServingConfig(max_batch=2, max_len=128,
+                          prefill_buckets=(16, 32)),
+        )
+        server = ServingServer(engine).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            req = urllib.request.Request(
+                f"{base}/v1/generate",
+                data=json.dumps({"tokens": list(range(1, 60))}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req)
+            assert e.value.code == 400
+
+            # Server still healthy and serving after the bad request.
+            ok = urllib.request.Request(
+                f"{base}/v1/generate",
+                data=json.dumps(
+                    {"tokens": [1, 2, 3], "max_new_tokens": 2}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            out = json.load(urllib.request.urlopen(ok))
+            assert len(out["tokens"]) == 2
+            health = json.load(urllib.request.urlopen(f"{base}/healthz"))
+            assert health["ok"] is True
+        finally:
+            server.stop()
+
+    def test_http_bad_request(self, model_and_params):
+        model, params = model_and_params
+        engine = ServingEngine(model, params,
+                               ServingConfig(max_batch=1, max_len=64))
+        server = ServingServer(engine).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/v1/generate",
+                data=json.dumps({"tokens": "nope"}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req)
+            assert e.value.code == 400
+        finally:
+            server.stop()
 
 
 class TestServingScannedModel:
