@@ -81,6 +81,7 @@ class TpuJobStatus:
     slice_assignment: str = ""
     start_time: float = 0.0
     completion_time: float = 0.0
+    last_restart_time: float = 0.0      # gates gang recreation by backoff
     resumed_from_step: int = -1
 
 

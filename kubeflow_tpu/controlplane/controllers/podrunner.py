@@ -1,22 +1,39 @@
-"""FakeKubelet: the compute-side test double (SURVEY.md §7.8).
+"""Local compute backends: fake and real-process kubelets (SURVEY.md §7.8).
 
 The reference has NO fake backend for compute — multi-node behaviour is
 only tested on real GKE clusters (SURVEY.md §4 point 3). This closes that
-gap: a controller that plays kubelet+scheduler for tests and local dev,
-moving pods Pending -> Running (honouring TPU capacity per node selector)
-and optionally completing/failing them per a script.
+gap twice over:
+
+- ``FakeKubelet``: plays kubelet+scheduler for unit tests, moving pods
+  Pending -> Running and completing/failing them per a script.
+- ``ProcessKubelet``: EXECUTES pods as real local subprocesses — worker
+  gangs become actual ``train.runner`` processes doing
+  ``jax.distributed.initialize`` against the controller-injected env, pod
+  deletion kills the process, exit codes become pod phases, and the
+  termination-message file round-trips worker metrics. The E2E tier
+  (tests/e2e) runs the platform's whole failure loop on it: kill a worker
+  mid-run, watch gang restart + checkpoint auto-resume — what the
+  reference could only attempt on a live GKE cluster
+  (testing/kfctl/kf_is_ready_test.py + Argo workflows).
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Any, Callable, Dict, List, Optional
 
 from kubeflow_tpu.controlplane.runtime import (
     Controller,
     InMemoryApiServer,
     Result,
 )
+from kubeflow_tpu.utils import get_logger
 from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
+
+log = get_logger("podrunner")
 
 
 class FakeKubelet(Controller):
@@ -71,3 +88,131 @@ class FakeKubelet(Controller):
                     pod.status.termination_message = self.termination(pod)
                 self.api.update_status(pod)
         return Result()
+
+
+class ProcessKubelet(Controller):
+    """Kubelet that runs pods as local subprocesses.
+
+    - Pending pod -> spawn ``containers[0].command`` (a leading "python"
+      maps to sys.executable) with the pod's env on top of the parent env
+      plus ``base_env`` and per-pod ``env_overrides(pod)``; phase Running.
+    - ``sync()`` harvests exits: rc 0 -> Succeeded, else Failed; the
+      termination-message file (KFTPU_TERMINATION_LOG, injected per pod)
+      lands in pod.status.termination_message exactly as a kubelet lifts
+      terminationMessagePath.
+    - Pod deleted -> process killed (gang teardown on restart).
+    - stdout/stderr stream into ``log_dir/<pod>.log`` for debugging.
+    """
+
+    NAME = "process-kubelet"
+    WATCH_KINDS = ("Pod",)
+
+    def __init__(
+        self,
+        api: InMemoryApiServer,
+        registry: MetricsRegistry = global_registry,
+        *,
+        base_env: Optional[Dict[str, str]] = None,
+        env_overrides: Optional[Callable[[Any], Dict[str, str]]] = None,
+        log_dir: Optional[str] = None,
+    ):
+        super().__init__(api, registry)
+        self.base_env = dict(base_env or {})
+        self.env_overrides = env_overrides
+        self.log_dir = log_dir or tempfile.mkdtemp(prefix="kftpu-pods-")
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._procs: Dict[str, subprocess.Popen] = {}   # "ns/name" -> proc
+        self._termfiles: Dict[str, str] = {}
+        self._logfiles: Dict[str, Any] = {}
+
+    def map_to_primary(self, obj):
+        return (obj.metadata.namespace, obj.metadata.name)
+
+    # ------------- lifecycle -------------
+
+    def _spawn(self, pod) -> None:
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        c = pod.spec.containers[0]
+        cmd = list(c.command) + list(c.args)
+        if not cmd:
+            cmd = ["python", "-m", "kubeflow_tpu.train.runner"]
+        if cmd[0] == "python":
+            cmd[0] = sys.executable
+        # Namespace-qualified files: same-named pods in different namespaces
+        # must not share termination/log channels.
+        stem = f"{pod.metadata.namespace}__{pod.metadata.name}"
+        term = os.path.join(self.log_dir, f"{stem}.term")
+        env = dict(os.environ)
+        env.update(self.base_env)
+        env.update({e.name: e.value for e in c.env})
+        env["KFTPU_TERMINATION_LOG"] = term
+        if self.env_overrides is not None:
+            env.update(self.env_overrides(pod))
+        logf = open(os.path.join(self.log_dir, f"{stem}.log"), "ab")
+        self._procs[key] = subprocess.Popen(
+            cmd, env=env, stdout=logf, stderr=subprocess.STDOUT,
+        )
+        self._termfiles[key] = term
+        self._logfiles[key] = logf
+        log.info("spawned pod process",
+                 kv={"pod": key, "pid": self._procs[key].pid})
+
+    def _kill(self, key: str) -> None:
+        proc = self._procs.pop(key, None)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        f = self._logfiles.pop(key, None)
+        if f is not None:
+            f.close()
+        self._termfiles.pop(key, None)
+
+    def kill_pod(self, name: str, namespace: str) -> bool:
+        """Test hook: hard-kill a worker process (SIGKILL), simulating a
+        node/worker crash. The next sync() surfaces the failure."""
+        proc = self._procs.get(f"{namespace}/{name}")
+        if proc is None or proc.poll() is not None:
+            return False
+        proc.kill()
+        return True
+
+    def reconcile(self, namespace: str, name: str) -> Result:
+        key = f"{namespace}/{name}"
+        pod = self.api.try_get("Pod", name, namespace)
+        if pod is None or pod.metadata.deletion_timestamp is not None:
+            self._kill(key)
+            return Result()
+        if pod.status.phase == "Pending" and key not in self._procs:
+            self._spawn(pod)
+            pod.status.phase = "Running"
+            pod.status.pod_ip = "127.0.0.1"
+            pod.status.node_name = "local"
+            self.api.update_status(pod)
+        return Result()
+
+    def sync(self) -> int:
+        """Harvest exited processes into pod phases. Returns the number of
+        pods transitioned (callers loop: sync + drain manager)."""
+        moved = 0
+        for key, proc in list(self._procs.items()):
+            rc = proc.poll()
+            if rc is None:
+                continue
+            ns, name = key.split("/", 1)
+            pod = self.api.try_get("Pod", name, ns)
+            self._logfiles[key].flush()
+            if pod is not None and pod.status.phase == "Running":
+                pod.status.phase = "Succeeded" if rc == 0 else "Failed"
+                pod.status.message = f"exit code {rc}"
+                termfile = self._termfiles.get(key, "")
+                if termfile and os.path.exists(termfile):
+                    with open(termfile) as f:
+                        pod.status.termination_message = f.read()
+                self.api.update_status(pod)
+                moved += 1
+            self._kill(key)
+        return moved
+
+    def shutdown(self) -> None:
+        for key in list(self._procs):
+            self._kill(key)

@@ -89,8 +89,25 @@ class ServingController(Controller):
 
         pod_name = f"{name}-serving-0"
         live_pod = self.api.try_get("Pod", pod_name, namespace)
+        desired_pod = self._pod(sv, pod_name)
+        if live_pod is not None and (
+            live_pod.spec.containers[0].env
+            != desired_pod.spec.containers[0].env
+            or live_pod.spec.containers[0].image
+            != desired_pod.spec.containers[0].image
+            or live_pod.spec.containers[0].ports
+            != desired_pod.spec.containers[0].ports
+        ):
+            # Spec drift (port/model/engine limits): the env contract is
+            # baked into the process, so the pod must be replaced — leaving
+            # it would keep routing pointed at a stale server while status
+            # reports Ready.
+            self.api.delete("Pod", pod_name, namespace)
+            self.recorder.event(sv, "Normal", "Recreated",
+                                f"pod {pod_name}: spec changed")
+            live_pod = None
         if live_pod is None:
-            self.api.create(self._pod(sv, pod_name))
+            self.api.create(desired_pod)
             self.recorder.event(sv, "Normal", "Created", f"pod {pod_name}")
             live_pod = self.api.get("Pod", pod_name, namespace)
         create_or_update(self.api, self._service(sv))

@@ -152,7 +152,18 @@ class TpuJobController(Controller):
             f".{namespace}:{COORDINATOR_PORT}"
         )
 
-        # 4. Gang pods: one per TPU-VM host.
+        # 4. Gang pods: one per TPU-VM host. After a gang restart, hold the
+        # backoff BEFORE recreating — watch events from the teardown would
+        # otherwise re-enter reconcile and respawn the gang instantly (real
+        # worker processes then race the dying generation for the
+        # coordinator port).
+        if job.status.phase == "Restarting":
+            remaining = (
+                job.status.last_restart_time + job.spec.backoff_seconds
+                - time.time()
+            )
+            if remaining > 0:
+                return Result(requeue_after=remaining)
         for i in range(n_hosts):
             pod = self._worker_pod(job, st, plan, i, n_hosts, coordinator)
             create_or_update(self.api, pod, copy_fields=self._pod_copy)
@@ -333,6 +344,7 @@ class TpuJobController(Controller):
                 # restore-latest contract).
                 job.status.restarts += 1
                 job.status.phase = "Restarting"
+                job.status.last_restart_time = time.time()
                 self.metrics_restarts.inc(reason="worker-failed")
                 self.recorder.event(
                     job, "Warning", "GangRestart",

@@ -89,8 +89,10 @@ class KubectlApiServer:
         self.context = context
         self.poll_interval = poll_interval
         self._watchers: List[Tuple[Optional[str], "queue.Queue[WatchEvent]"]] = []
-        # kind -> {(ns, name): (uid, resource_version)} snapshot for diffing.
-        self._snapshots: Dict[Optional[str], Dict[Tuple[str, str], Tuple[str, int]]] = {}
+        # kind -> {(ns, name): (uid, resource_version, last_seen_object)}.
+        # The object is kept so DELETED events can carry the full last-seen
+        # state (controllers resolve owners from tombstones).
+        self._snapshots: Dict[str, Dict[Tuple[str, str], Tuple[str, int, Any]]] = {}
         self._lock = threading.Lock()
         self._poller: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -240,7 +242,7 @@ class KubectlApiServer:
             for o in existing:
                 snap.setdefault(
                     (o.metadata.namespace, o.metadata.name),
-                    (o.metadata.uid, o.metadata.resource_version),
+                    (o.metadata.uid, o.metadata.resource_version, o),
                 )
             self._watchers.append((kind, q))
         return q
@@ -266,24 +268,20 @@ class KubectlApiServer:
                 continue
             with self._lock:
                 prev = self._snapshots.get(kind, {})
-                cur: Dict[Tuple[str, str], Tuple[str, int]] = {}
+                cur: Dict[Tuple[str, str], Tuple[str, int, Any]] = {}
                 events: List[WatchEvent] = []
                 for o in objs:
                     k = (o.metadata.namespace, o.metadata.name)
-                    ident = (o.metadata.uid, o.metadata.resource_version)
-                    cur[k] = ident
+                    cur[k] = (o.metadata.uid, o.metadata.resource_version, o)
                     if k not in prev:
                         events.append(WatchEvent("ADDED", o))
-                    elif prev[k] != ident:
+                    elif prev[k][:2] != cur[k][:2]:
                         events.append(WatchEvent("MODIFIED", o))
-                gone = set(prev) - set(cur)
-                for o_key in gone:
-                    # Synthesise a tombstone carrying just identity.
-                    cls = KIND_REGISTRY[kind]
-                    tomb = cls()
-                    tomb.metadata.namespace = o_key[0]
-                    tomb.metadata.name = o_key[1]
-                    events.append(WatchEvent("DELETED", tomb))
+                for o_key in set(prev) - set(cur):
+                    # Tombstone carries the full last-seen object, matching
+                    # the in-memory backend (controllers resolve the owning
+                    # primary from owner_references on DELETED events).
+                    events.append(WatchEvent("DELETED", prev[o_key][2]))
                 self._snapshots[kind] = cur
                 for ev in events:
                     for wk, q in watchers:

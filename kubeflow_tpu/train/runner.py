@@ -65,6 +65,13 @@ def env_config() -> dict:
 def run(cfg: dict) -> int:
     import jax
 
+    # Local/e2e gangs force a backend (environments that register a TPU
+    # plugin via sitecustomize override JAX_PLATFORMS; the config update
+    # wins). Production pods leave this unset and take the TPU.
+    plat = os.environ.get("KFTPU_PLATFORM", "")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
     if cfg["num_processes"] > 1:
         jax.distributed.initialize(
             coordinator_address=cfg["coordinator"],
@@ -102,9 +109,26 @@ def run(cfg: dict) -> int:
 
         model_cfg = _dc.replace(model_cfg, pipeline_stages=pp)
         model = type(model)(model_cfg)
+    ndev = len(jax.devices())
     if cfg["slice_type"]:
-        plan = plan_mesh(cfg["slice_type"], axes)
-        mesh = make_mesh(plan)
+        from kubeflow_tpu.topology import get_slice
+
+        if get_slice(cfg["slice_type"]).num_chips == ndev:
+            plan = plan_mesh(cfg["slice_type"], axes)
+            mesh = make_mesh(plan)
+        else:
+            # Virtual/e2e backends expose fewer devices than the slice
+            # (forced host-platform devices); resolve against what exists.
+            # The controller already resolved dp=-1 against the slice, so
+            # re-wildcard dp to absorb the actual device count.
+            log.info("device count != slice chips; using host-local mesh",
+                     kv={"devices": ndev, "slice": cfg["slice_type"]})
+            try:
+                mesh = make_host_local_mesh(axes)
+            except ValueError:
+                import dataclasses as _dc
+
+                mesh = make_host_local_mesh(_dc.replace(axes, dp=-1))
     else:
         mesh = make_host_local_mesh(axes)
 

@@ -476,3 +476,19 @@ class TestServing:
         mgr.run_until_idle()
         pod = api.get("Pod", "lbl-serving-0", "team-a")
         assert pod.metadata.labels["serving-name"] == "lbl"
+
+    def test_spec_change_recreates_pod(self):
+        api, mgr, kubelet = self._world()
+        api.create(self._serving(name="llm2", port=8000))
+        mgr.run_until_idle()
+        kubelet.tick()
+        mgr.run_until_idle()
+        sv = api.get("Serving", "llm2", "team-a")
+        sv.spec.port = 9100
+        api.update(sv)
+        mgr.run_until_idle()
+        pod = api.get("Pod", "llm2-serving-0", "team-a")
+        env = {e.name: e.value for e in pod.spec.containers[0].env}
+        assert env["KFTPU_SERVING_PORT"] == "9100"
+        svc = api.get("Service", "llm2-serving", "team-a")
+        assert svc.spec.ports[0].target_port == 9100

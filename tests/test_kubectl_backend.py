@@ -242,3 +242,25 @@ class TestTpuctlKubectlBackend:
         assert tpuctl(flags + ["delete", "--kind", "TpuJob",
                                "--name", "train", "-n", "team-a"]) == 0
         assert api.try_get("TpuJob", "train", "team-a") is None
+
+    def test_deleted_tombstone_carries_owner_refs(self, api):
+        """DELETED events must carry the full last-seen object so
+        secondary-kind deletions map back to the owning primary."""
+        from kubeflow_tpu.controlplane.api import Pod
+        from kubeflow_tpu.controlplane.api.core import PodSpec
+        from kubeflow_tpu.controlplane.api.meta import OwnerReference
+
+        owner = api.create(_job())
+        q = api.watch("Pod")
+        api.create(Pod(metadata=ObjectMeta(
+            name="train-w0", namespace="team-a",
+            owner_references=[OwnerReference(
+                kind="TpuJob", name="train", uid=owner.metadata.uid)],
+        ), spec=PodSpec()))
+        api.poll_now()
+        assert q.get_nowait().type == "ADDED"
+        api.delete("Pod", "train-w0", "team-a")
+        api.poll_now()
+        ev = q.get_nowait()
+        assert ev.type == "DELETED"
+        assert ev.object.metadata.owner_references[0].name == "train"
