@@ -99,11 +99,15 @@ def bench_train(args) -> None:
     if args.warmup > 0:
         _sync(metrics["loss"])
 
+    if args.trace_dir:
+        jax.profiler.start_trace(args.trace_dir)
     t0 = time.perf_counter()
     for _ in range(args.steps):
         state, metrics = trainer.step(state, batch)
     final_loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
+    if args.trace_dir:
+        jax.profiler.stop_trace()
     assert final_loss == final_loss, "loss is NaN"
 
     tokens = args.batch_size * ndev * args.seq_len * args.steps
@@ -349,6 +353,8 @@ def main() -> None:
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--gen-len", type=int, default=128)
     p.add_argument("--decode-chunk", type=int, default=16)
+    p.add_argument("--trace-dir", default="",
+                   help="write a jax.profiler trace of the timed steps")
     args = p.parse_args()
     if args.steps < 1:
         raise SystemExit("--steps must be >= 1")

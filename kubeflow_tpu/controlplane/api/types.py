@@ -58,6 +58,9 @@ class TpuJobSpec:
     env: List[EnvVar] = dataclasses.field(default_factory=list)
     # Checkpoint/resume contract (auto-resume on gang restart).
     checkpoint_dir: str = ""
+    # Profiling: workers write jax.profiler traces here (surfaced by a
+    # Tensorboard CR whose spec.trace_dir points at the same path).
+    trace_dir: str = ""
     # Failure policy
     max_restarts: int = 3
     backoff_seconds: float = 10.0

@@ -87,6 +87,40 @@ class NotebookController(Controller):
         v = pod.metadata.annotations.get(LAST_ACTIVITY_ANNOTATION)
         return float(v) if v else None
 
+    @staticmethod
+    def http_activity_probe(port: int = NOTEBOOK_PORT,
+                            timeout: float = 5.0):
+        """Production probe: poll Jupyter's /api/status on the pod IP and
+        parse ``last_activity`` (the reference culler's exact mechanism,
+        pkg/culler/culler.go:138-206). Returns a probe fn for the
+        ``activity_probe`` constructor arg."""
+        import json as _json
+        import urllib.request
+        from datetime import datetime, timezone
+
+        def probe(pod: Pod) -> Optional[float]:
+            if not pod.status.pod_ip:
+                return None
+            url = f"http://{pod.status.pod_ip}:{port}/api/status"
+            try:
+                with urllib.request.urlopen(url, timeout=timeout) as resp:
+                    data = _json.loads(resp.read())
+            except (OSError, ValueError):
+                return None
+            if not isinstance(data, dict):
+                return None
+            raw = data.get("last_activity")
+            if not isinstance(raw, str):        # null / absent / wrong type
+                return None
+            try:
+                # Jupyter emits ISO-8601 UTC, e.g. 2026-07-30T01:00:00.000000Z
+                dt = datetime.fromisoformat(raw.replace("Z", "+00:00"))
+                return dt.astimezone(timezone.utc).timestamp()
+            except ValueError:
+                return None
+
+        return probe
+
     def reconcile(self, namespace: str, name: str) -> Result:
         nb = self.api.try_get("Notebook", name, namespace)
         if nb is None or nb.metadata.deletion_timestamp is not None:

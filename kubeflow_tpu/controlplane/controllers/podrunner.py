@@ -122,6 +122,7 @@ class ProcessKubelet(Controller):
         self.log_dir = log_dir or tempfile.mkdtemp(prefix="kftpu-pods-")
         os.makedirs(self.log_dir, exist_ok=True)
         self._procs: Dict[str, subprocess.Popen] = {}   # "ns/name" -> proc
+        self._uids: Dict[str, str] = {}                 # pod uid at spawn
         self._termfiles: Dict[str, str] = {}
         self._logfiles: Dict[str, Any] = {}
 
@@ -152,6 +153,7 @@ class ProcessKubelet(Controller):
         self._procs[key] = subprocess.Popen(
             cmd, env=env, stdout=logf, stderr=subprocess.STDOUT,
         )
+        self._uids[key] = pod.metadata.uid
         self._termfiles[key] = term
         self._logfiles[key] = logf
         log.info("spawned pod process",
@@ -166,6 +168,7 @@ class ProcessKubelet(Controller):
         if f is not None:
             f.close()
         self._termfiles.pop(key, None)
+        self._uids.pop(key, None)
 
     def kill_pod(self, name: str, namespace: str) -> bool:
         """Test hook: hard-kill a worker process (SIGKILL), simulating a
@@ -182,6 +185,12 @@ class ProcessKubelet(Controller):
         if pod is None or pod.metadata.deletion_timestamp is not None:
             self._kill(key)
             return Result()
+        if (key in self._procs and pod.metadata.uid
+                and self._uids.get(key) not in ("", pod.metadata.uid)):
+            # Same-named pod recreated before we saw the deletion (gang
+            # restart with elapsed backoff): the tracked process belongs to
+            # the OLD generation — kill it so the new pod can spawn.
+            self._kill(key)
         if pod.status.phase == "Pending" and key not in self._procs:
             self._spawn(pod)
             pod.status.phase = "Running"

@@ -90,14 +90,17 @@ class ServingController(Controller):
         pod_name = f"{name}-serving-0"
         live_pod = self.api.try_get("Pod", pod_name, namespace)
         desired_pod = self._pod(sv, pod_name)
-        if live_pod is not None and (
-            live_pod.spec.containers[0].env
-            != desired_pod.spec.containers[0].env
-            or live_pod.spec.containers[0].image
-            != desired_pod.spec.containers[0].image
-            or live_pod.spec.containers[0].ports
-            != desired_pod.spec.containers[0].ports
-        ):
+
+        def contract(pod):
+            """Only the controller-owned slice of the container: admission
+            mutators (PodDefault) may append env — that must not read as
+            drift or the pod would delete/recreate forever."""
+            c = pod.spec.containers[0]
+            own = {e.name: e.value for e in c.env
+                   if e.name.startswith("KFTPU_SERVING_")}
+            return (own, c.image, tuple(c.ports))
+
+        if live_pod is not None and contract(live_pod) != contract(desired_pod):
             # Spec drift (port/model/engine limits): the env contract is
             # baked into the process, so the pod must be replaced — leaving
             # it would keep routing pointed at a stale server while status

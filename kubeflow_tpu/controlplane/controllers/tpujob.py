@@ -243,6 +243,8 @@ class TpuJobController(Controller):
             EnvVar("KFTPU_CHECKPOINT_DIR", job.spec.checkpoint_dir),
             EnvVar("KFTPU_RESTART_COUNT", str(job.status.restarts)),
         ]
+        if job.spec.trace_dir:
+            env.append(EnvVar("KFTPU_TRACE_DIR", job.spec.trace_dir))
         if job.spec.num_slices > 1:
             # Multislice: DCN-routed inter-slice collectives (megascale).
             env += [

@@ -52,6 +52,7 @@ DEFAULT_COMPONENTS = (
     "jupyter-web-app",       # L3 spawner REST backend
     "centraldashboard",      # L3 workgroup API (requires kfam)
     "fake-kubelet",          # local/dev compute double; real clusters disable
+    "availability-prober",   # platform SLO gauge (metric-collector equiv)
 )
 
 # Start order: kfam before centraldashboard (the dashboard wraps it),
@@ -67,6 +68,7 @@ class Platform:
         self.kfam: Optional[AccessManagement] = None
         self.jwa = None          # NotebookWebApp when enabled
         self.dashboard = None    # DashboardApi when enabled
+        self.prober = None       # AvailabilityProber when enabled
         self.components: List[str] = []
         self._config: Optional[PlatformConfig] = None
 
@@ -120,11 +122,15 @@ class Platform:
         elif name == "studyjob-controller":
             self.manager.register(StudyJobController(self.api, reg))
         elif name == "notebook-controller":
+            probe = None
+            if params.get("activityProbe", "") == "http":
+                probe = NotebookController.http_activity_probe()
             self.manager.register(NotebookController(
                 self.api, reg,
                 enable_culling=params.get("enableCulling", "") == "true",
                 idle_seconds=float(params.get("idleSeconds", 1440 * 60)),
                 istio_gateway=cfg.spec.istio_gateway,
+                activity_probe=probe,
             ))
         elif name == "profile-controller":
             self.manager.register(ProfileController(
@@ -161,6 +167,40 @@ class Platform:
             self.dashboard = DashboardApi(self.kfam)
         elif name == "fake-kubelet":
             self.manager.register(FakeKubelet(self.api, reg))
+        elif name == "availability-prober":
+            from kubeflow_tpu.controlplane.prober import (
+                AvailabilityProber,
+                controller_target,
+                http_target,
+            )
+
+            # Started last (component order). Controller targets are real
+            # liveness checks (fresh heartbeat OR idle manager — a stale
+            # heartbeat with work queued = wedged loop); in-process services
+            # probe presence; params["urls"] adds HTTP /healthz routes.
+            max_age = float(params.get("heartbeatMaxAgeSeconds", 120))
+            targets = {
+                ctl.NAME: controller_target(self.manager, ctl, max_age)
+                for ctl in self.manager.controllers
+            }
+            for svc_name, getter in (
+                ("kfam", lambda: self.kfam),
+                ("jupyter-web-app", lambda: self.jwa),
+                ("centraldashboard", lambda: self.dashboard),
+            ):
+                if getter() is not None:
+                    targets[svc_name] = (
+                        lambda g=getter: g() is not None
+                    )
+            for url in filter(None, params.get("urls", "").split(",")):
+                targets[url.split("//")[-1].replace("/", "_")] = (
+                    http_target(url.strip())
+                )
+            self.prober = AvailabilityProber(
+                targets, reg,
+                interval_s=float(params.get("intervalSeconds", 30)),
+            )
+            self.prober.probe()
         else:
             raise ValueError(f"unknown component {name!r}")
         log.info("component started", kv={"component": name})
@@ -184,7 +224,10 @@ class Platform:
         return existing
 
     def reconcile(self) -> int:
-        return self.manager.run_until_idle(include_timers_within=0.2)
+        n = self.manager.run_until_idle(include_timers_within=0.2)
+        if self.prober is not None:
+            self.prober.maybe_probe()
+        return n
 
     # ------------- persistence -------------
 

@@ -1,0 +1,154 @@
+"""Availability prober: the platform-up SLO metric.
+
+Rebuild of the reference's metric-collector (metric-collector/
+service-readiness/kubeflow-readiness.py:20-37 — poll the deployment's
+endpoint, export a 0/1 ``kubeflow_availability`` Prometheus gauge). Here
+the prober is a platform component with pluggable probe targets:
+
+- HTTP targets (``http_target``): GET an endpoint, healthy on 2xx — the
+  reference's exact probe, pointed at kfam/JWA/serving ``/healthz``-style
+  routes.
+- Callable targets: any ``() -> bool``, e.g. in-process component checks
+  or heartbeat freshness (``heartbeat_target``) so a wedged reconcile loop
+  flips the platform unhealthy even while HTTP keeps answering.
+
+Exports per-target ``kftpu_component_up{...}``-style gauges plus the
+overall ``kftpu_availability`` 0/1 the reference's dashboards alerted on.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, Optional
+
+from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.utils.monitoring import (
+    Heartbeat,
+    MetricsRegistry,
+    global_registry,
+)
+
+log = get_logger("prober")
+
+ProbeFn = Callable[[], bool]
+
+
+def http_target(url: str, timeout: float = 5.0) -> ProbeFn:
+    """Healthy when the endpoint answers 2xx (kubeflow-readiness.py:20-28)."""
+
+    def probe() -> bool:
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                return 200 <= resp.status < 300
+        except (urllib.error.URLError, OSError, ValueError):
+            return False
+
+    return probe
+
+
+def heartbeat_target(hb: Heartbeat, max_age_s: float = 120.0) -> ProbeFn:
+    """Healthy while the heartbeat is fresh — catches wedged loops."""
+
+    def probe() -> bool:
+        last = hb.last()
+        return last > 0 and (time.time() - last) <= max_age_s
+
+    return probe
+
+
+def controller_target(manager, controller,
+                      max_age_s: float = 120.0) -> ProbeFn:
+    """Controller liveness: healthy when its heartbeat is fresh OR the
+    manager has no work waiting (an idle controller legitimately never
+    beats). A stale heartbeat WITH pending work = a wedged loop -> down.
+    This is the non-tautological component probe the platform wires up."""
+
+    def probe() -> bool:
+        last = controller.heartbeat.last()
+        if last > 0 and (time.time() - last) <= max_age_s:
+            return True
+        return manager.is_idle()
+
+    return probe
+
+
+class AvailabilityProber:
+    def __init__(
+        self,
+        targets: Dict[str, ProbeFn],
+        registry: MetricsRegistry = global_registry,
+        *,
+        interval_s: float = 30.0,
+    ):
+        self.targets = dict(targets)
+        self.interval_s = interval_s
+        self._gauges = {
+            name: registry.gauge(
+                f"kftpu_component_up_{name.replace('-', '_')}",
+                f"1 when the {name} probe passes",
+            )
+            for name in self.targets
+        }
+        self.availability = registry.gauge(
+            "kftpu_availability",
+            "1 when every availability probe passes (the platform SLO "
+            "gauge, reference kubeflow-readiness.py:29-37)",
+        )
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._last_probe = 0.0
+
+    def add_target(self, name: str, probe: ProbeFn,
+                   registry: MetricsRegistry = global_registry) -> None:
+        self.targets[name] = probe
+        self._gauges[name] = registry.gauge(
+            f"kftpu_component_up_{name.replace('-', '_')}",
+            f"1 when the {name} probe passes",
+        )
+
+    def probe(self) -> bool:
+        """One probe pass over every target. Returns overall availability."""
+        ok = True
+        for name, fn in self.targets.items():
+            try:
+                up = bool(fn())
+            except Exception as e:  # noqa: BLE001 — a probe must not kill the loop
+                log.error("probe raised", kv={"target": name, "err": repr(e)})
+                up = False
+            self._gauges[name].set(1.0 if up else 0.0)
+            if not up:
+                ok = False
+        self.availability.set(1.0 if ok else 0.0)
+        self._last_probe = time.time()
+        return ok
+
+    def maybe_probe(self) -> None:
+        """Rate-limited probe for callers on a hot path (Platform.reconcile):
+        runs at most once per interval_s so slow HTTP targets don't tax
+        every reconcile pass."""
+        if time.time() - self._last_probe >= self.interval_s:
+            self.probe()
+
+    def start(self) -> "AvailabilityProber":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                self.probe()
+                self._stop.wait(self.interval_s)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._thread = None

@@ -158,6 +158,15 @@ class ControllerManager:
         ctl.heartbeat.beat()
         return True
 
+    def is_idle(self) -> bool:
+        """No queued reconciles and no undrained watch events — used by the
+        availability prober: a stale heartbeat is only a wedge when there is
+        work waiting."""
+        with self._lock:
+            if self._pending:
+                return False
+        return all(q.empty() for _, _, q in self._queues)
+
     def run_until_idle(self, max_iterations: int = 10000, include_timers_within: float = 0.0) -> int:
         """Drain watches + queue until no immediate work remains. Returns the
         number of reconciles executed. Timers due within

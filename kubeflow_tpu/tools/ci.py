@@ -1,0 +1,161 @@
+"""CI gate: deploy-assert-bench, the release pipeline's decision point.
+
+Rebuild of the reference's CI backbone as one command instead of an Argo
+DAG + bash zoo (testing/workflows/components/workflows.libsonnet:98-165,
+py/kubeflow/ci, testing/kfctl/kf_is_ready_test.py:76-185):
+
+  python -m kubeflow_tpu.tools.ci gate [--bench-json BENCH.json
+      --min-vs-baseline 0.9] [--skip-smoke]
+
+Stages (any failure exits non-zero — the merge gate contract):
+1. **apply**: bring the platform up from a default PlatformConfig.
+2. **ready**: assert the readiness list — every expected component
+   applied, availability gauge 1 (kf_is_ready_test.py:98-114 analogue).
+3. **second-apply**: re-apply and assert zero resourceVersion churn
+   (testing/kfctl/kfctl_second_apply.py:12-24).
+4. **smoke**: run a TpuJob through the FakeKubelet to completion — the
+   in-process analogue of the reference's tf-cnn smoke job.
+5. **bench-gate**: if --bench-json is given, require
+   ``vs_baseline >= --min-vs-baseline`` for every record — the perf
+   regression gate SURVEY §7.8 prescribes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+from kubeflow_tpu.controlplane.api.meta import ObjectMeta
+from kubeflow_tpu.controlplane.api.types import (
+    MeshAxesSpec,
+    PlatformConfig,
+    TpuJob,
+    TpuJobSpec,
+)
+from kubeflow_tpu.controlplane.platform import DEFAULT_COMPONENTS, Platform
+
+
+class GateFailure(Exception):
+    pass
+
+
+def _stage(name: str):
+    print(f"[ci] {name} ...", flush=True)
+
+
+def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
+             skip_smoke: bool = False) -> List[str]:
+    """Run all stages; returns the list of passed stages, raises
+    GateFailure on the first failing one."""
+    passed: List[str] = []
+
+    _stage("apply")
+    platform = Platform()
+    cfg = PlatformConfig(metadata=ObjectMeta(name="kubeflow-tpu"))
+    platform.apply_config(cfg)
+    platform.reconcile()
+    passed.append("apply")
+
+    _stage("ready")
+    pc = platform.api.get("PlatformConfig", "kubeflow-tpu")
+    missing = [c for c in DEFAULT_COMPONENTS
+               if c not in pc.status.applied_components]
+    if pc.status.phase != "Ready" or missing:
+        raise GateFailure(f"platform not ready: phase={pc.status.phase} "
+                          f"missing={missing}")
+    if platform.prober is not None and not platform.prober.probe():
+        raise GateFailure("availability probe failed")
+    passed.append("ready")
+
+    _stage("second-apply")
+    before = {
+        k: o.metadata.resource_version
+        for k, o in platform.api._objects.items()
+    }
+    platform.apply_config(
+        PlatformConfig(metadata=ObjectMeta(name="kubeflow-tpu"))
+    )
+    platform.reconcile()
+    after = {
+        k: o.metadata.resource_version
+        for k, o in platform.api._objects.items()
+    }
+    churned = {k for k in before if after.get(k) != before[k]}
+    if churned:
+        raise GateFailure(f"second apply mutated: {churned}")
+    passed.append("second-apply")
+
+    if not skip_smoke:
+        _stage("smoke")
+        platform.api.create(TpuJob(
+            metadata=ObjectMeta(name="ci-smoke", namespace="kubeflow-ci"),
+            spec=TpuJobSpec(slice_type="v5e-16", model="llama-tiny",
+                            mesh=MeshAxesSpec(dp=-1)),
+        ))
+        # Drive: kubelet ticks pods Running -> Succeeded via outcome hook.
+        kubelet = next(
+            c for c in platform.manager.controllers
+            if c.NAME == "fake-kubelet"
+        )
+        kubelet.outcome = lambda name: (
+            "Succeeded" if name.startswith("ci-smoke-") else None
+        )
+        for _ in range(10):
+            platform.reconcile()
+            kubelet.tick()
+            platform.reconcile()
+            job = platform.api.get("TpuJob", "ci-smoke", "kubeflow-ci")
+            if job.status.phase in ("Succeeded", "Failed"):
+                break
+        if job.status.phase != "Succeeded":
+            raise GateFailure(f"smoke job: {job.status.phase} "
+                              f"({job.status.worker_states})")
+        passed.append("smoke")
+
+    if bench_json:
+        _stage("bench-gate")
+        with open(bench_json) as f:
+            records = [json.loads(line) for line in f if line.strip()]
+        if not records:
+            raise GateFailure(f"{bench_json}: no bench records")
+        bad = [
+            r for r in records
+            if float(r.get("vs_baseline", 0)) < min_vs_baseline
+        ]
+        if bad:
+            raise GateFailure(
+                "bench regression: " + ", ".join(
+                    f"{r['metric']}={r['vs_baseline']}" for r in bad
+                )
+            )
+        passed.append("bench-gate")
+
+    return passed
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kftpu-ci")
+    sub = p.add_subparsers(dest="command", required=True)
+    g = sub.add_parser("gate", help="run the CI gate stages")
+    g.add_argument("--bench-json", default="",
+                   help="JSONL of bench records to gate on vs_baseline")
+    g.add_argument("--min-vs-baseline", type=float, default=0.9)
+    g.add_argument("--skip-smoke", action="store_true")
+    args = p.parse_args(argv)
+    try:
+        passed = run_gate(
+            bench_json=args.bench_json,
+            min_vs_baseline=args.min_vs_baseline,
+            skip_smoke=args.skip_smoke,
+        )
+    except GateFailure as e:
+        print(f"[ci] FAIL: {e}", file=sys.stderr)
+        return 1
+    print(f"[ci] PASS: {', '.join(passed)}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,95 @@
+"""Release tooling: version bump + image/release manifest generation.
+
+Rebuild of the reference's release plumbing (releasing/, image-releaser/,
+scripts/hack — image tag-and-push loops driven from a version file) as a
+deterministic manifest generator:
+
+  python -m kubeflow_tpu.tools.release manifest [--tag vX.Y.Z]
+  python -m kubeflow_tpu.tools.release bump --level patch|minor|major
+
+``manifest`` emits the YAML map a deployment pipeline consumes: every
+platform component image pinned to one tag, plus the PlatformConfig
+skeleton referencing them. ``bump`` rewrites kubeflow_tpu/version.py —
+the single version source the tag derives from.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+import yaml
+
+from kubeflow_tpu.version import __version__
+
+# Component -> image repository. One image per deployable tier, mirroring
+# the reference's image-per-component releases (image-releaser config).
+IMAGES = {
+    "runtime": "kubeflow-tpu/runtime",          # TpuJob workers (train.runner)
+    "serving": "kubeflow-tpu/serving",          # serving.server pods
+    "controlplane": "kubeflow-tpu/controlplane",  # controllers + webapps
+    "jupyter": "kubeflow-tpu/jupyter",          # notebook default image
+}
+
+
+def build_manifest(tag: str = "") -> dict:
+    tag = tag or f"v{__version__}"
+    return {
+        "apiVersion": "tpu.kubeflow.org/v1alpha1",
+        "kind": "ReleaseManifest",
+        "version": tag,
+        "images": {name: f"{repo}:{tag}" for name, repo in IMAGES.items()},
+        "platformConfig": {
+            "kind": "PlatformConfig",
+            "metadata": {"name": "kubeflow-tpu"},
+            "spec": {"components": []},
+        },
+    }
+
+
+def bump_version(level: str, path: str = "") -> str:
+    path = path or os.path.join(os.path.dirname(__file__), "..",
+                                "version.py")
+    with open(path) as f:
+        src = f.read()
+    m = re.search(r'__version__ = "(\d+)\.(\d+)\.(\d+)"', src)
+    if not m:
+        raise ValueError(f"no semver in {path}")
+    major, minor, patch = map(int, m.groups())
+    if level == "major":
+        major, minor, patch = major + 1, 0, 0
+    elif level == "minor":
+        minor, patch = minor + 1, 0
+    elif level == "patch":
+        patch += 1
+    else:
+        raise ValueError(f"unknown level {level!r}")
+    new = f"{major}.{minor}.{patch}"
+    with open(path, "w") as f:
+        f.write(f'__version__ = "{new}"\n')
+    return new
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kftpu-release")
+    sub = p.add_subparsers(dest="command", required=True)
+    mp = sub.add_parser("manifest")
+    mp.add_argument("--tag", default="")
+    bp = sub.add_parser("bump")
+    bp.add_argument("--level", choices=("major", "minor", "patch"),
+                    required=True)
+    bp.add_argument("--version-file", default="")
+    args = p.parse_args(argv)
+    if args.command == "manifest":
+        yaml.safe_dump(build_manifest(args.tag), sys.stdout,
+                       sort_keys=False)
+        return 0
+    new = bump_version(args.level, args.version_file)
+    print(new)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

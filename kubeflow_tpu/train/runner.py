@@ -13,6 +13,8 @@ but consuming the TpuJob controller's env contract instead:
   KFTPU_MODEL                 registry model name
   KFTPU_CHECKPOINT_DIR        durable dir; auto-resume on restart
   KFTPU_RESTART_COUNT         gang restart generation (informational)
+  KFTPU_TRACE_DIR             jax.profiler trace output (Tensorboard CR)
+  KFTPU_TRACE_STEPS           steps per capture window (default 5)
 
 Instead of mpirun/PS gRPC, the gang joins one JAX distributed runtime
 (jax.distributed.initialize) and every collective is an XLA op over ICI
@@ -59,6 +61,11 @@ def env_config() -> dict:
         "batch_per_host": int(os.environ.get("KFTPU_BATCH_PER_HOST", "8")),
         "seq_len": int(os.environ.get("KFTPU_SEQ_LEN", "1024")),
         "checkpoint_every": int(os.environ.get("KFTPU_CHECKPOINT_EVERY", "50")),
+        # Profiling: worker-0 captures a jax.profiler trace of trace_steps
+        # steps into trace_dir (the Tensorboard CR's spec.trace_dir serves
+        # it; SURVEY §5 Tracing).
+        "trace_dir": os.environ.get("KFTPU_TRACE_DIR", ""),
+        "trace_steps": int(os.environ.get("KFTPU_TRACE_STEPS", "5")),
     }
 
 
@@ -113,7 +120,8 @@ def run(cfg: dict) -> int:
     if cfg["slice_type"]:
         from kubeflow_tpu.topology import get_slice
 
-        if get_slice(cfg["slice_type"]).num_chips == ndev:
+        num_slices = int(os.environ.get("MEGASCALE_NUM_SLICES", "1") or 1)
+        if get_slice(cfg["slice_type"]).num_chips * num_slices == ndev:
             plan = plan_mesh(cfg["slice_type"], axes)
             mesh = make_mesh(plan)
         else:
@@ -178,11 +186,26 @@ def run(cfg: dict) -> int:
 
     start_step = int(state.step)
     t0 = time.time()
+    # Trace a window of steps after warm-up (step 2) so the capture shows
+    # steady-state device work, not compilation.
+    trace_active = False
+    trace_from = start_step + min(2, max(cfg["steps"] - start_step - 1, 0))
+    tracing = bool(cfg["trace_dir"]) and cfg["process_id"] == 0
     for i in range(start_step, cfg["steps"]):
+        if tracing and not trace_active and i == trace_from:
+            jax.profiler.start_trace(cfg["trace_dir"])
+            trace_active = True
+            log.info("trace started", kv={"dir": cfg["trace_dir"],
+                                          "step": i})
         batch = trainer.shard_batch(
             {k: jnp.asarray(v) for k, v in next(it).items()}
         )
         state, metrics = trainer.step(state, batch)
+        if trace_active and i + 1 >= trace_from + cfg["trace_steps"]:
+            float(metrics["loss"])          # sync before closing the trace
+            jax.profiler.stop_trace()
+            trace_active = False
+            log.info("trace written", kv={"dir": cfg["trace_dir"]})
         if ckpt is not None and (i + 1) % cfg["checkpoint_every"] == 0:
             ckpt.save(int(state.step), state)
         if (i + 1) % 10 == 0:
@@ -193,6 +216,8 @@ def run(cfg: dict) -> int:
             )
             log.info("step", kv={"step": i + 1, "loss": f"{loss:.4f}",
                                  "tokens_per_sec": f"{tps:.0f}"})
+    if trace_active:
+        jax.profiler.stop_trace()
     if ckpt is not None:
         ckpt.save(int(state.step), state)
         ckpt.close()

@@ -137,34 +137,51 @@ class Trainer:
         return state
 
     def _state_shardings(self, abstract_state, param_shard_tree):
-        """Derive shardings for the full TrainState: optimizer moments mirror
-        the param shardings; scalars replicated."""
+        """Derive shardings for the full TrainState.
+
+        Optimizer leaves are matched to params BY PATH SUFFIX: optax states
+        embed params-shaped subtrees under arbitrary wrappers (adam mu/nu,
+        masked weight decay's inner_state, multi_transform branches), so a
+        leaf whose trailing path + shape matches a parameter inherits that
+        parameter's sharding; everything else (step counts, schedule state,
+        factored moments) replicates. This is robust where whole-treedef
+        equality was not: any wrapper that preserves the params subtree
+        paths still matches."""
         unboxed_params = nn.meta.unbox(param_shard_tree)["params"]
         replicated = NamedSharding(self.mesh, P())
-        flat_params, ptree = jax.tree.flatten(unboxed_params)
 
-        def rec(node):
-            # Optimizer states embed pytrees congruent to params (adam mu/nu,
-            # weight-decay masks); those inherit the param shardings. Anything
-            # else (step counts, schedule state) replicates.
-            try:
-                if jax.tree.structure(node) == ptree:
-                    return jax.tree.unflatten(ptree, flat_params)
-            except Exception:
-                pass
-            if hasattr(node, "_fields"):  # NamedTuple optax states
-                return type(node)(*(rec(getattr(node, f)) for f in node._fields))
-            if isinstance(node, tuple):
-                return tuple(rec(n) for n in node)
-            return jax.tree.map(lambda _: replicated, node)
+        param_entries = []   # (path keys tuple, shape, sharding)
+        for path, sh in jax.tree_util.tree_flatten_with_path(unboxed_params)[0]:
+            param_entries.append((tuple(str(k) for k in path), sh))
+        abstract_params = abstract_state.params
+        param_shapes = {
+            tuple(str(k) for k in path): leaf.shape
+            for path, leaf in
+            jax.tree_util.tree_flatten_with_path(abstract_params)[0]
+        }
+        by_path = {p: (param_shapes[p], sh) for p, sh in param_entries}
 
-        opt_shardings = rec(abstract_state.opt_state)
+        def match(path, leaf):
+            keys = tuple(str(k) for k in path)
+            shape = getattr(leaf, "shape", None)
+            for i in range(len(keys)):
+                hit = by_path.get(keys[i:])
+                if hit is not None and hit[0] == shape:
+                    return hit[1]
+            return replicated
+
+        opt_shardings = jax.tree_util.tree_map_with_path(
+            match, abstract_state.opt_state
+        )
         extra_shardings = jax.tree.map(
             lambda _: replicated, abstract_state.extra_vars
         )
         return TrainState(
             step=replicated,
-            params=jax.tree.unflatten(ptree, flat_params),
+            params=jax.tree_util.tree_map_with_path(
+                lambda p, _: by_path[tuple(str(k) for k in p)][1],
+                abstract_params,
+            ),
             opt_state=opt_shardings,
             extra_vars=extra_shardings,
         )

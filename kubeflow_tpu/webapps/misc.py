@@ -1,0 +1,74 @@
+"""Small platform web utilities: echo, https-redirect, static config.
+
+The reference carries three single-purpose services this module rebuilds
+on the shared Router:
+
+- echo-server (components/echo-server — the IAP smoke-test app): reflects
+  request identity/headers so auth-path tests can see what reached the
+  backend through the gatekeeper/IAP hop.
+- https-redirect (components/https-redirect): 301 every http request to
+  the https origin.
+- static-config-server (bootstrap static config serving): serve a config
+  document at a fixed route; platform config UIs read it at startup.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from kubeflow_tpu.webapps.router import JsonHttpServer, Request, Router
+
+
+def echo_app() -> Router:
+    """Reflect the request: the IAP/gatekeeper smoke target. The caller
+    field shows which trusted identity survived the proxy hop."""
+    router = Router()
+
+    def echo(req: Request) -> Any:
+        return {
+            "method": req.method,
+            "path": req.path,
+            "query": req.query,
+            "caller": req.caller,
+            "headers": {
+                k: v for k, v in req.headers.items()
+                if k.startswith("x-") or k in ("host", "user-agent")
+            },
+        }
+
+    router.get("/.*", echo)
+    router.post("/.*", echo)
+    return router
+
+
+def https_redirect_app(https_host: str = "") -> Router:
+    """301 everything to https://<host><path> (components/https-redirect).
+    With no explicit host, the request's Host header is reused."""
+    router = Router()
+
+    def redirect(req: Request):
+        host = https_host or req.headers.get("host", "localhost")
+        return 301, {"location": f"https://{host}{req.path}"}
+
+    router.get("/.*", redirect)
+    router.post("/.*", redirect)
+    return router
+
+
+def static_config_app(config: Dict[str, Any]) -> Router:
+    """Serve one config document at /config (and /) — the static-config-
+    server the deployment UIs poll."""
+    router = Router()
+    doc = dict(config)
+
+    def get_config(req: Request) -> Any:
+        return doc
+
+    router.get("/config", get_config)
+    router.get("/", get_config)
+    return router
+
+
+def serve(router: Router, *, host: str = "127.0.0.1",
+          port: int = 0) -> JsonHttpServer:
+    return JsonHttpServer(router, host=host, port=port).start()

@@ -139,6 +139,9 @@ class JsonHttpServer:
             def _send(self, status: int, payload: Any) -> None:
                 data = json.dumps(payload).encode()
                 self.send_response(status)
+                if (300 <= status < 400 and isinstance(payload, dict)
+                        and "location" in payload):
+                    self.send_header("Location", payload["location"])
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()

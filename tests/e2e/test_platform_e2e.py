@@ -49,7 +49,7 @@ class TestPlatformReadiness:
         "tpujob-controller", "studyjob-controller", "notebook-controller",
         "profile-controller", "tensorboard-controller", "serving-controller",
         "poddefault-webhook", "kfam", "jupyter-web-app", "centraldashboard",
-        "fake-kubelet",
+        "fake-kubelet", "availability-prober",
     ]
 
     def test_apply_then_ready_list(self, tmp_path):

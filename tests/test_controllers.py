@@ -492,3 +492,36 @@ class TestServing:
         assert env["KFTPU_SERVING_PORT"] == "9100"
         svc = api.get("Service", "llm2-serving", "team-a")
         assert svc.spec.ports[0].target_port == 9100
+
+
+class TestAdmissionRaceSafety:
+    def test_capacity_gate_under_background_manager(self):
+        """Admission must stay all-or-nothing when the manager runs in
+        background mode with API writers racing it: with capacity 1, at no
+        point may two jobs hold the slice (VERDICT weak #6 — pins the
+        serialized-reconcile semantics the gate relies on)."""
+        api, mgr, kubelet = make_world(capacity={"v5e-16": 1})
+        mgr.start()
+        try:
+            running_ish = ("Scheduling", "Starting", "Running", "Restarting")
+            violations = []
+            for i in range(5):
+                api.create(_job(f"race-{i}"))
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                kubelet.tick()
+                jobs = api.list("TpuJob", namespace="team-a")
+                admitted = [j.metadata.name for j in jobs
+                            if j.status.phase in running_ish]
+                if len(admitted) > 1:
+                    violations.append(admitted)
+                if any(j.status.phase == "Running" for j in jobs):
+                    break
+                time.sleep(0.05)
+            assert not violations, f"double admission observed: {violations}"
+            jobs = api.list("TpuJob", namespace="team-a")
+            phases = sorted(j.status.phase for j in jobs)
+            assert phases.count("Running") == 1
+            assert phases.count("Pending") == 4
+        finally:
+            mgr.stop()

@@ -1,0 +1,180 @@
+"""Trivial platform services (echo / https-redirect / static-config), the
+HTTP culling probe, and the CI gate."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubeflow_tpu.webapps.misc import (
+    echo_app,
+    https_redirect_app,
+    serve,
+    static_config_app,
+)
+
+
+class TestMiscApps:
+    def test_echo_reflects_identity(self):
+        srv = serve(echo_app())
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/some/path?x=1",
+                headers={"x-goog-authenticated-user-email": "alice@corp"},
+            )
+            out = json.load(urllib.request.urlopen(req))
+            assert out["path"] == "/some/path"
+            assert out["query"] == {"x": "1"}
+            assert out["caller"] == "alice@corp"
+        finally:
+            srv.stop()
+
+    def test_https_redirect_sets_location(self):
+        srv = serve(https_redirect_app())
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/notebook/ns/x",
+                headers={"Host": "kubeflow.example.com"},
+            )
+            # urllib follows redirects; https to a fake host will fail, so
+            # inspect the raw 301 instead.
+            class NoRedirect(urllib.request.HTTPRedirectHandler):
+                def redirect_request(self, *a, **k):
+                    return None
+
+            opener = urllib.request.build_opener(NoRedirect)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                opener.open(req)
+            assert e.value.code == 301
+            assert e.value.headers["Location"] == \
+                "https://kubeflow.example.com/notebook/ns/x"
+        finally:
+            srv.stop()
+
+    def test_static_config(self):
+        srv = serve(static_config_app({"defaultSliceType": "v5e-16"}))
+        try:
+            out = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/config"
+            ))
+            assert out == {"defaultSliceType": "v5e-16"}
+        finally:
+            srv.stop()
+
+
+class TestHttpActivityProbe:
+    def _jupyter(self, last_activity):
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path != "/api/status":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = json.dumps(
+                    {"last_activity": last_activity, "kernels": 1}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+
+    def test_parses_jupyter_last_activity(self):
+        from kubeflow_tpu.controlplane.api.core import Pod
+        from kubeflow_tpu.controlplane.controllers import NotebookController
+
+        srv = self._jupyter("2026-07-30T01:02:03.000000Z")
+        try:
+            probe = NotebookController.http_activity_probe(
+                port=srv.server_address[1]
+            )
+            pod = Pod()
+            pod.status.pod_ip = "127.0.0.1"
+            ts = probe(pod)
+            assert ts is not None
+            # 2026-07-30T01:02:03Z as a unix timestamp.
+            import datetime
+
+            want = datetime.datetime(
+                2026, 7, 30, 1, 2, 3, tzinfo=datetime.timezone.utc
+            ).timestamp()
+            assert ts == pytest.approx(want)
+        finally:
+            srv.shutdown()
+
+    def test_unreachable_pod_returns_none(self):
+        from kubeflow_tpu.controlplane.api.core import Pod
+        from kubeflow_tpu.controlplane.controllers import NotebookController
+
+        probe = NotebookController.http_activity_probe(port=1, timeout=0.2)
+        pod = Pod()
+        pod.status.pod_ip = "127.0.0.1"
+        assert probe(pod) is None
+        assert probe(Pod()) is None       # no IP yet
+
+    def test_null_last_activity_returns_none(self):
+        from kubeflow_tpu.controlplane.api.core import Pod
+        from kubeflow_tpu.controlplane.controllers import NotebookController
+
+        srv = self._jupyter(None)
+        try:
+            probe = NotebookController.http_activity_probe(
+                port=srv.server_address[1]
+            )
+            pod = Pod()
+            pod.status.pod_ip = "127.0.0.1"
+            assert probe(pod) is None
+        finally:
+            srv.shutdown()
+
+
+class TestCiGate:
+    def test_gate_passes_end_to_end(self, tmp_path):
+        from kubeflow_tpu.tools.ci import main as ci
+
+        bench = tmp_path / "bench.jsonl"
+        bench.write_text(json.dumps(
+            {"metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.05}
+        ) + "\n")
+        assert ci(["gate", "--bench-json", str(bench)]) == 0
+
+    def test_gate_fails_on_bench_regression(self, tmp_path):
+        from kubeflow_tpu.tools.ci import main as ci
+
+        bench = tmp_path / "bench.jsonl"
+        bench.write_text(json.dumps(
+            {"metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 0.5}
+        ) + "\n")
+        assert ci(["gate", "--skip-smoke",
+                   "--bench-json", str(bench)]) == 1
+
+
+class TestRelease:
+    def test_manifest_pins_all_images_to_one_tag(self):
+        from kubeflow_tpu.tools.release import build_manifest
+
+        m = build_manifest("v1.2.3")
+        assert m["version"] == "v1.2.3"
+        assert all(img.endswith(":v1.2.3") for img in m["images"].values())
+        assert {"runtime", "serving", "controlplane", "jupyter"} <= set(
+            m["images"]
+        )
+
+    def test_bump_levels(self, tmp_path):
+        from kubeflow_tpu.tools.release import bump_version
+
+        vf = tmp_path / "version.py"
+        vf.write_text('__version__ = "1.2.3"\n')
+        assert bump_version("patch", str(vf)) == "1.2.4"
+        assert bump_version("minor", str(vf)) == "1.3.0"
+        assert bump_version("major", str(vf)) == "2.0.0"
+        assert vf.read_text() == '__version__ = "2.0.0"\n'

@@ -64,6 +64,20 @@ class TestRunnerContract:
                 KFTPU_MESH=json.dumps({"dp": -1, "pp": 2}),
             )
 
+    def test_trace_dir_writes_profile(self, monkeypatch, tmp_path):
+        """KFTPU_TRACE_DIR must produce an actual jax.profiler capture
+        (SURVEY §5 Tracing: something has to *produce* the trace the
+        Tensorboard CR serves)."""
+        trace = tmp_path / "traces"
+        _run(
+            monkeypatch, tmp_path,
+            KFTPU_TRAIN_STEPS="4",
+            KFTPU_TRACE_DIR=str(trace),
+            KFTPU_TRACE_STEPS="1",
+        )
+        profiles = list(trace.rglob("*.xplane.pb"))
+        assert profiles, f"no trace written under {trace}"
+
     def test_pp_mesh_pipelines_dense_model(self, monkeypatch, tmp_path):
         # batch 8 = 2 microbatches x mb 4, mb divisible by dp=4 (8 devs / pp 2).
         report = _run(

@@ -195,3 +195,44 @@ class TestAuxWeightInheritance:
         t2 = Trainer(Mixtral(cfg), TrainConfig(task="lm", aux_loss_weight=0.5),
                      mesh)
         assert t2.aux_loss_weight == 0.5
+
+
+class TestOptimizerShardingByPath:
+    def test_masked_wrapper_states_inherit_param_shardings(self, mesh8):
+        """Optax states that wrap params-shaped subtrees (masked weight
+        decay, multi_transform) must still land their moments in the param
+        shardings — matching is by path suffix, not whole-tree equality."""
+        import optax
+
+        model = Llama(LlamaConfig.tiny())
+        trainer = Trainer(model, TrainConfig(task="lm"), mesh8)
+        # Replace the optimizer with a masked chain whose state treedef
+        # does NOT equal the params treedef.
+        trainer.optimizer = optax.chain(
+            optax.clip_by_global_norm(1.0),
+            optax.masked(
+                optax.adamw(1e-3),
+                lambda params: jax.tree.map(lambda _: True, params),
+            ),
+        )
+        batch = trainer.shard_batch(_lm_batch())
+        state = trainer.init_state(jax.random.PRNGKey(0), batch)
+
+        # Find an adam mu leaf for a tp-sharded kernel and compare with the
+        # corresponding param's sharding.
+        p = state.params["layer_0"]["attn"]["q_proj"]["kernel"]
+        flat = jax.tree_util.tree_flatten_with_path(state.opt_state)[0]
+        mu_leaves = [
+            (path, leaf) for path, leaf in flat
+            if "q_proj" in "".join(str(k) for k in path)
+            and ".mu" in "".join(str(k) for k in path)
+        ]
+        assert mu_leaves, "no mu leaf found for q_proj"
+        for _, leaf in mu_leaves:
+            assert leaf.sharding == p.sharding, (
+                f"mu sharded {leaf.sharding}, param {p.sharding}"
+            )
+
+        # And a train step still runs.
+        state2, metrics = trainer.step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
