@@ -138,18 +138,35 @@ class Notebook:
 # --------------------------------------------------------------------------
 
 @dataclasses.dataclass
+class ProfilePluginSpec:
+    """Cloud-integration plugin request (reference Plugin interface,
+    profile_controller.go:74-80; e.g. workload identity
+    plugin_workload_identity.go:44-166). Teardown is finalizer-guarded."""
+
+    kind: str = ""                       # registered plugin name
+    params: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
 class ProfileSpec:
     owner: str = ""                      # user email
     # TPU-chip quota (reference used generic ResourceQuotaSpec,
     # profile_controller.go:240-256)
     tpu_chip_quota: int = 0
     resource_quota: Dict[str, str] = dataclasses.field(default_factory=dict)
+    plugins: List[ProfilePluginSpec] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
 class ProfileStatus:
     phase: str = ""
     conditions: List[Condition] = dataclasses.field(default_factory=list)
+    # Plugins whose cloud-side grants are currently applied — the revoke
+    # ledger: spec edits diff against this, so changing/removing a plugin
+    # revokes the OLD grant instead of leaking it.
+    applied_plugins: List[ProfilePluginSpec] = dataclasses.field(
+        default_factory=list
+    )
 
 
 @dataclasses.dataclass

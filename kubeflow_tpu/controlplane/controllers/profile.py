@@ -40,6 +40,45 @@ OWNER_ANNOTATION = "owner"
 ADMIN_CLUSTER_ROLE = "kubeflow-admin"
 EDIT_CLUSTER_ROLE = "kubeflow-edit"
 VIEW_CLUSTER_ROLE = "kubeflow-view"
+PLUGIN_FINALIZER = "profile-plugins.tpu.kubeflow.org"
+WI_ANNOTATION = "iam.gke.io/gcp-service-account"
+
+
+class WorkloadIdentityPlugin:
+    """Workload-identity plugin (plugin_workload_identity.go:44-166): binds
+    the namespace's default-editor KSA to a GCP service account — the KSA
+    annotation is the real mechanism; the IAM policy mutation (a cloud API
+    call) goes through the injectable ``iam`` store so tests (and clusters
+    without GCP) run against a fake while the seam stays production-shaped.
+    """
+
+    KIND = "WorkloadIdentity"
+
+    def __init__(self, iam=None):
+        # gsa -> set of "serviceAccount:<ns>/<ksa>" members; a real impl
+        # replaces this with google.golang.org/api/iam-style policy calls.
+        self.iam = iam if iam is not None else {}
+
+    def apply(self, api, profile, params) -> None:
+        gsa = params.get("gcpServiceAccount", "")
+        if not gsa:
+            raise ValueError("WorkloadIdentity needs params.gcpServiceAccount")
+        ns = profile.metadata.name
+        sa = api.get("ServiceAccount", "default-editor", ns)
+        if sa.metadata.annotations.get(WI_ANNOTATION) != gsa:
+            sa.metadata.annotations[WI_ANNOTATION] = gsa
+            api.update(sa)
+        self.iam.setdefault(gsa, set()).add(f"serviceAccount:{ns}/default-editor")
+
+    def revoke(self, api, profile, params) -> None:
+        gsa = params.get("gcpServiceAccount", "")
+        ns = profile.metadata.name
+        sa = api.try_get("ServiceAccount", "default-editor", ns)
+        if sa is not None and WI_ANNOTATION in sa.metadata.annotations:
+            del sa.metadata.annotations[WI_ANNOTATION]
+            api.update(sa)
+        if gsa in self.iam:
+            self.iam[gsa].discard(f"serviceAccount:{ns}/default-editor")
 
 
 class ProfileController(Controller):
@@ -48,9 +87,14 @@ class ProfileController(Controller):
 
     def __init__(self, api: InMemoryApiServer,
                  registry: MetricsRegistry = global_registry,
-                 *, user_id_header: str = "x-goog-authenticated-user-email"):
+                 *, user_id_header: str = "x-goog-authenticated-user-email",
+                 plugins=None):
         super().__init__(api, registry)
         self.user_id_header = user_id_header
+        default = WorkloadIdentityPlugin()
+        self.plugins = plugins if plugins is not None else {
+            default.KIND: default,
+        }
 
     def map_to_primary(self, obj):
         # Namespaces/RoleBindings created for a profile carry its name.
@@ -62,10 +106,28 @@ class ProfileController(Controller):
 
     def reconcile(self, namespace: str, name: str) -> Result:
         profile = self.api.try_get("Profile", name)
-        if profile is None or profile.metadata.deletion_timestamp is not None:
+        if profile is None:
+            return Result()
+        if profile.metadata.deletion_timestamp is not None:
+            # Finalizer path (reference profile_controller.go finalizer
+            # handling): revoke whatever is RECORDED as applied (not the
+            # spec — the spec may have been edited after grants were made).
+            if PLUGIN_FINALIZER in profile.metadata.finalizers:
+                for p in profile.status.applied_plugins or profile.spec.plugins:
+                    impl = self.plugins.get(p.kind)
+                    if impl is not None:
+                        impl.revoke(self.api, profile, p.params)
+                profile.metadata.finalizers.remove(PLUGIN_FINALIZER)
+                self.api.update(profile)
             return Result()
         owner = OwnerReference(kind="Profile", name=name,
                                uid=profile.metadata.uid)
+
+        if profile.spec.plugins and \
+                PLUGIN_FINALIZER not in profile.metadata.finalizers:
+            # Guard teardown BEFORE applying anything cloud-side.
+            profile.metadata.finalizers.append(PLUGIN_FINALIZER)
+            profile = self.api.update(profile)
 
         ns = Namespace(
             metadata=ObjectMeta(
@@ -125,7 +187,48 @@ class ProfileController(Controller):
             # not keep gating the namespace's TpuJobs.
             self.api.delete("ResourceQuota", "kf-resource-quota", name)
 
-        if profile.status.phase != "Ready":
+        # Revoke grants whose spec entry vanished or changed (diff against
+        # the applied ledger, or an edited gcpServiceAccount leaks the old
+        # binding forever).
+        desired = {(p.kind, tuple(sorted(p.params.items())))
+                   for p in profile.spec.plugins}
+        still_applied = []
+        for p in profile.status.applied_plugins:
+            key = (p.kind, tuple(sorted(p.params.items())))
+            if key in desired:
+                still_applied.append(p)
+                continue
+            impl = self.plugins.get(p.kind)
+            if impl is not None:
+                impl.revoke(self.api, profile, p.params)
+        applied_changed = still_applied != profile.status.applied_plugins
+        profile.status.applied_plugins = still_applied
+
+        for p in profile.spec.plugins:
+            impl = self.plugins.get(p.kind)
+            try:
+                if impl is None:
+                    raise ValueError(f"no plugin {p.kind!r} registered")
+                impl.apply(self.api, profile, p.params)
+            except ValueError as e:
+                # Config errors are permanent: surface Failed instead of
+                # hot-requeueing forever with no visible signal.
+                if profile.status.phase != "Failed" or applied_changed:
+                    profile.status.phase = "Failed"
+                    profile.status.conditions = set_condition(
+                        profile.status.conditions,
+                        Condition(type="Ready", status="False",
+                                  reason="PluginError", message=str(e)),
+                    )
+                    self.api.update_status(profile)
+                return Result()
+            if all((q.kind, tuple(sorted(q.params.items())))
+                   != (p.kind, tuple(sorted(p.params.items())))
+                   for q in profile.status.applied_plugins):
+                profile.status.applied_plugins.append(p)
+                applied_changed = True
+
+        if profile.status.phase != "Ready" or applied_changed:
             profile.status.phase = "Ready"
             profile.status.conditions = set_condition(
                 profile.status.conditions,

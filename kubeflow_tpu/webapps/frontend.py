@@ -1,0 +1,227 @@
+"""Central hub: the dashboard + spawner UI served from one process.
+
+The reference ships ~12k lines of Polymer/Angular/React across
+centraldashboard (public/components/*), jupyter-web-app frontend and
+kflogin. A TPU-native rebuild does not need a JS build chain for the same
+capability: these are dependency-free HTML/vanilla-JS pages rendered over
+the SAME REST surface the reference frontends call —
+
+- hub page "/" (dashboard-view + namespace-selector equivalents):
+  workgroup env-info, namespace switcher, live tables of Notebooks /
+  TpuJobs / Servings / StudyJobs with phases, contributor management
+  (manage-users-view).
+- "/spawner" (jupyter-web-app frontend): the spawn form driven by
+  /api/config (images + TPU slice picker instead of GPU vendor limits),
+  notebook list with connect/delete.
+
+``central_hub`` mounts the pages, the workgroup API (DashboardApi), the
+spawner API (NotebookWebApp) and a resources listing endpoint behind one
+router, which a gatekeeper AuthProxy fronts in production.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from kubeflow_tpu.controlplane.kfam.authz import SubjectAccessReviewer
+from kubeflow_tpu.webapps.router import (
+    Html,
+    JsonHttpServer,
+    Request,
+    RestError,
+    Router,
+)
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>{title}</title>
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 2rem; }}
+ nav a {{ margin-right: 1rem; }}
+ table {{ border-collapse: collapse; margin: 1rem 0; min-width: 30rem; }}
+ td, th {{ border: 1px solid #ccc; padding: .3rem .6rem; text-align: left; }}
+ .phase-Running, .phase-Ready, .phase-Succeeded {{ color: #0a7d32; }}
+ .phase-Failed {{ color: #b3261e; }}
+ form * {{ margin: .2rem; }}
+</style></head>
+<body>
+<nav><a href="/">Dashboard</a><a href="/spawner">Notebooks</a></nav>
+<h1>{title}</h1>
+{body}
+<script>
+const H = {{'content-type': 'application/json'}};
+// All API data is escaped before hitting innerHTML: resource names are
+// user-controlled (stored-XSS surface otherwise).
+function esc(s) {{
+  return String(s).replace(/[&<>"']/g, c => ({{'&': '&amp;', '<': '&lt;',
+    '>': '&gt;', '"': '&quot;', "'": '&#39;'}})[c]);
+}}
+async function api(path, opts) {{
+  const r = await fetch(path, opts);
+  const data = await r.json();
+  if (!r.ok) throw new Error(data.error || r.statusText);
+  return data;
+}}
+function needsWorkgroup(el) {{
+  el.innerHTML = '<p>No workgroup yet.</p>' +
+    '<button id="mkwg">Create my workgroup</button>';
+  document.getElementById('mkwg').onclick = async () => {{
+    await api('/api/workgroup/create', {{method: 'POST', headers: H,
+      body: JSON.stringify({{}})}});
+    location.reload();
+  }};
+}}
+{script}
+</script></body></html>"""
+
+_HUB_BODY = """
+<div id="whoami"></div>
+<label>Namespace: <select id="ns"></select></label>
+<h2>Resources</h2><div id="resources"></div>
+<h2>Contributors</h2><div id="contributors"></div>
+<form id="addc"><input id="cemail" placeholder="user@example.com">
+<button>Add contributor</button></form>
+"""
+
+_HUB_SCRIPT = """
+async function loadNs() {
+  const info = await api('/api/workgroup/env-info');
+  document.getElementById('whoami').textContent = 'Signed in as ' + info.user;
+  if (!info.namespaces.length) {
+    needsWorkgroup(document.getElementById('resources'));
+    return;
+  }
+  const sel = document.getElementById('ns');
+  sel.innerHTML = info.namespaces.map(
+    n => `<option value="${esc(n.namespace)}">${esc(n.namespace)}` +
+         ` (${esc(n.role)})</option>`
+  ).join('');
+  sel.onchange = refresh; refresh();
+}
+async function refresh() {
+  const ns = document.getElementById('ns').value;
+  const res = await api(`/api/resources/${encodeURIComponent(ns)}`);
+  document.getElementById('resources').innerHTML =
+    Object.entries(res.resources).map(([kind, items]) =>
+      `<h3>${esc(kind)}</h3><table><tr><th>name</th><th>phase</th></tr>` +
+      items.map(i => `<tr><td>${esc(i.name)}</td>` +
+        `<td class="phase-${esc(i.phase)}">${esc(i.phase)}</td></tr>`
+      ).join('') + '</table>').join('');
+  const c = await api(
+    `/api/workgroup/get-contributors/${encodeURIComponent(ns)}`);
+  document.getElementById('contributors').textContent =
+    (Array.isArray(c) ? c : []).join(', ') || 'none';
+}
+document.getElementById('addc').onsubmit = async (e) => {
+  e.preventDefault();
+  const ns = document.getElementById('ns').value;
+  await api(`/api/workgroup/add-contributor/${encodeURIComponent(ns)}`,
+    {method: 'POST', headers: H, body: JSON.stringify(
+      {contributor: document.getElementById('cemail').value})});
+  refresh();
+};
+loadNs();
+"""
+
+_SPAWNER_BODY = """
+<form id="spawn">
+ <input id="name" placeholder="notebook name" required>
+ <select id="image"></select>
+ <select id="slice"></select>
+ <button>Spawn</button>
+</form>
+<h2>Notebooks</h2><div id="list"></div>
+"""
+
+_SPAWNER_SCRIPT = """
+let NS = '';
+async function init() {
+  const info = await api('/api/workgroup/env-info');
+  if (!info.namespaces.length) {
+    needsWorkgroup(document.getElementById('list'));
+    return;
+  }
+  NS = info.namespaces[0].namespace;
+  const cfg = (await api('/api/config')).config;
+  document.getElementById('image').innerHTML =
+    cfg.images.map(i => `<option>${esc(i)}</option>`).join('');
+  document.getElementById('slice').innerHTML =
+    '<option value="">no TPU</option>' +
+    cfg.tpuSlices.map(s => `<option>${esc(s)}</option>`).join('');
+  refresh();
+}
+async function refresh() {
+  const out = await api(
+    `/api/namespaces/${encodeURIComponent(NS)}/notebooks`);
+  const list = document.getElementById('list');
+  list.innerHTML =
+    '<table><tr><th>name</th><th>image</th><th>status</th><th></th></tr>' +
+    out.notebooks.map(n =>
+      `<tr><td><a href="/notebook/${encodeURIComponent(NS)}/` +
+      `${encodeURIComponent(n.name)}/">${esc(n.name)}</a></td>` +
+      `<td>${esc(n.image)}</td>` +
+      `<td class="phase-${esc(n.status.phase)}">${esc(n.status.phase)}` +
+      `</td><td><button class="del" data-name="${esc(n.name)}">delete` +
+      `</button></td></tr>`).join('') + '</table>';
+  // Event delegation, no inline JS-string interpolation (XSS).
+  list.querySelectorAll('button.del').forEach(b => b.onclick = async () => {
+    await api(`/api/namespaces/${encodeURIComponent(NS)}/notebooks/` +
+      encodeURIComponent(b.dataset.name), {method: 'DELETE'});
+    refresh();
+  });
+}
+document.getElementById('spawn').onsubmit = async (e) => {
+  e.preventDefault();
+  await api(`/api/namespaces/${encodeURIComponent(NS)}/notebooks`,
+    {method: 'POST', headers: H, body: JSON.stringify({
+      name: document.getElementById('name').value,
+      image: document.getElementById('image').value,
+      tpuSlice: document.getElementById('slice').value,
+    })});
+  refresh();
+};
+init();
+"""
+
+
+def central_hub(api, dashboard, jwa) -> Router:
+    """One router serving pages + the dashboard/spawner REST surface."""
+    r = Router()
+    r.get("/", lambda q: Html(_PAGE.format(
+        title="Kubeflow TPU", body=_HUB_BODY, script=_HUB_SCRIPT)))
+    r.get("/spawner", lambda q: Html(_PAGE.format(
+        title="Notebook Spawner", body=_SPAWNER_BODY,
+        script=_SPAWNER_SCRIPT)))
+
+    sar = SubjectAccessReviewer(api)
+
+    def resources(q: Request) -> Any:
+        ns = q.params["ns"]
+        if not q.caller:
+            raise RestError(401, "identity header required")
+        if not (sar.is_cluster_admin(q.caller)
+                or sar.can(q.caller, "list", ns)):
+            raise RestError(403, f"{q.caller} cannot list in {ns}")
+        out = {}
+        for kind in ("Notebook", "TpuJob", "Serving", "StudyJob"):
+            items = []
+            for o in api.list(kind, namespace=ns):
+                st = getattr(o, "status", None)
+                phase = (getattr(st, "phase", "")
+                         or getattr(st, "condition", "")
+                         or getattr(st, "container_state", "")) or "Unknown"
+                items.append({"name": o.metadata.name, "phase": phase})
+            out[kind] = items
+        return {"resources": out}
+
+    r.get("/api/resources/<ns>", resources)
+    r.include(dashboard.router())
+    r.include(jwa.router())
+    return r
+
+
+def serve_hub(api, dashboard, jwa, *, host: str = "127.0.0.1",
+              port: int = 0, user_id_header: str) -> JsonHttpServer:
+    return JsonHttpServer(
+        central_hub(api, dashboard, jwa), host=host, port=port,
+        user_id_header=user_id_header,
+    ).start()

@@ -13,6 +13,7 @@ topology catalogue; "configurations" are PodDefault labels, as upstream.
 
 from __future__ import annotations
 
+import re
 from typing import Any, Dict, List
 
 from kubeflow_tpu.controlplane.api.meta import ObjectMeta
@@ -26,6 +27,8 @@ from kubeflow_tpu.controlplane.runtime.apiserver import (
 from kubeflow_tpu.topology import get_slice, list_slices
 from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
 from kubeflow_tpu.webapps.router import JsonHttpServer, Request, RestError, Router
+
+_DNS1123 = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")
 
 DEFAULT_IMAGES = (
     "kubeflow-tpu/jupyter:latest",
@@ -111,6 +114,14 @@ class NotebookWebApp:
         name = form.get("name", "")
         if not name:
             raise RestError(400, "notebook name required")
+        if not _DNS1123.match(name):
+            # K8s object-name rules; also keeps stored markup out of every
+            # UI that renders names.
+            raise RestError(
+                400,
+                f"invalid notebook name {name!r}: must be DNS-1123 "
+                "(lowercase alphanumerics and '-', max 63 chars)",
+            )
         tpu_slice = form.get("tpuSlice", "")
         if tpu_slice:
             try:

@@ -29,6 +29,11 @@ class RestError(Exception):
         self.status = status
 
 
+class Html(str):
+    """Handler return type for text/html responses (the minimal frontend
+    pages); everything else stays JSON."""
+
+
 @dataclasses.dataclass
 class Request:
     method: str
@@ -57,6 +62,10 @@ class Router:
 
     def add(self, method: str, pattern: str, handler: Handler) -> None:
         self._routes.append((method.upper(), _compile(pattern), handler))
+
+    def include(self, other: "Router") -> None:
+        """Mount another router's routes (earlier routes win)."""
+        self._routes.extend(other._routes)
 
     def get(self, pattern: str, handler: Handler) -> None:
         self.add("GET", pattern, handler)
@@ -137,12 +146,15 @@ class JsonHttpServer:
                 self._send(status, payload)
 
             def _send(self, status: int, payload: Any) -> None:
-                data = json.dumps(payload).encode()
+                if isinstance(payload, Html):
+                    ctype, data = "text/html; charset=utf-8", payload.encode()
+                else:
+                    ctype, data = "application/json", json.dumps(payload).encode()
                 self.send_response(status)
                 if (300 <= status < 400 and isinstance(payload, dict)
                         and "location" in payload):
                     self.send_header("Location", payload["location"])
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)

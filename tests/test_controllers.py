@@ -525,3 +525,117 @@ class TestAdmissionRaceSafety:
             assert phases.count("Pending") == 4
         finally:
             mgr.stop()
+
+
+class TestProfilePlugins:
+    def _world(self):
+        from kubeflow_tpu.controlplane.controllers.profile import (
+            ProfileController,
+            WorkloadIdentityPlugin,
+        )
+
+        api = InMemoryApiServer()
+        reg = MetricsRegistry()
+        mgr = ControllerManager(api)
+        wi = WorkloadIdentityPlugin()
+        mgr.register(ProfileController(api, reg, plugins={wi.KIND: wi}))
+        return api, mgr, wi
+
+    def _profile(self, name="team-wi", gsa="robot@proj.iam.gserviceaccount.com"):
+        from kubeflow_tpu.controlplane.api.types import ProfilePluginSpec
+
+        return Profile(
+            metadata=ObjectMeta(name=name),
+            spec=ProfileSpec(
+                owner="alice@corp",
+                plugins=[ProfilePluginSpec(
+                    kind="WorkloadIdentity",
+                    params={"gcpServiceAccount": gsa},
+                )],
+            ),
+        )
+
+    def test_plugin_applies_and_finalizer_guards(self):
+        from kubeflow_tpu.controlplane.controllers.profile import (
+            PLUGIN_FINALIZER,
+            WI_ANNOTATION,
+        )
+
+        api, mgr, wi = self._world()
+        api.create(self._profile())
+        mgr.run_until_idle()
+
+        prof = api.get("Profile", "team-wi")
+        assert PLUGIN_FINALIZER in prof.metadata.finalizers
+        sa = api.get("ServiceAccount", "default-editor", "team-wi")
+        assert sa.metadata.annotations[WI_ANNOTATION] == \
+            "robot@proj.iam.gserviceaccount.com"
+        assert wi.iam["robot@proj.iam.gserviceaccount.com"] == {
+            "serviceAccount:team-wi/default-editor"
+        }
+
+        # Delete: revoke runs, finalizer releases, profile goes away.
+        api.delete("Profile", "team-wi")
+        mgr.run_until_idle()
+        assert api.try_get("Profile", "team-wi") is None
+        assert wi.iam["robot@proj.iam.gserviceaccount.com"] == set()
+
+    def test_unknown_plugin_fails_profile(self):
+        from kubeflow_tpu.controlplane.api.types import ProfilePluginSpec
+
+        api, mgr, _ = self._world()
+        api.create(Profile(
+            metadata=ObjectMeta(name="bad"),
+            spec=ProfileSpec(owner="bob@corp", plugins=[
+                ProfilePluginSpec(kind="NoSuchCloud"),
+            ]),
+        ))
+        mgr.run_until_idle()
+        prof = api.get("Profile", "bad")
+        assert prof.status.phase == "Failed"
+
+    def test_param_change_revokes_old_grant(self):
+        from kubeflow_tpu.controlplane.api.types import ProfilePluginSpec
+
+        api, mgr, wi = self._world()
+        api.create(self._profile(gsa="old@proj.iam.gserviceaccount.com"))
+        mgr.run_until_idle()
+        assert wi.iam["old@proj.iam.gserviceaccount.com"]
+
+        prof = api.get("Profile", "team-wi")
+        prof.spec.plugins = [ProfilePluginSpec(
+            kind="WorkloadIdentity",
+            params={"gcpServiceAccount": "new@proj.iam.gserviceaccount.com"},
+        )]
+        api.update(prof)
+        mgr.run_until_idle()
+        # Old grant revoked, new one applied — no privilege leak.
+        assert wi.iam["old@proj.iam.gserviceaccount.com"] == set()
+        assert wi.iam["new@proj.iam.gserviceaccount.com"] == {
+            "serviceAccount:team-wi/default-editor"
+        }
+
+    def test_plugin_removal_revokes(self):
+        api, mgr, wi = self._world()
+        api.create(self._profile(gsa="g@proj.iam.gserviceaccount.com"))
+        mgr.run_until_idle()
+        prof = api.get("Profile", "team-wi")
+        prof.spec.plugins = []
+        api.update(prof)
+        mgr.run_until_idle()
+        assert wi.iam["g@proj.iam.gserviceaccount.com"] == set()
+
+    def test_misconfigured_plugin_fails_not_hotloops(self):
+        from kubeflow_tpu.controlplane.api.types import ProfilePluginSpec
+
+        api, mgr, _ = self._world()
+        api.create(Profile(
+            metadata=ObjectMeta(name="noparams"),
+            spec=ProfileSpec(owner="c@corp", plugins=[
+                ProfilePluginSpec(kind="WorkloadIdentity", params={}),
+            ]),
+        ))
+        mgr.run_until_idle()          # must converge, not livelock
+        prof = api.get("Profile", "noparams")
+        assert prof.status.phase == "Failed"
+        assert "gcpServiceAccount" in prof.status.conditions[-1].message
