@@ -69,6 +69,11 @@ class LlamaConfig:
     # see parallel/pipeline.py). Training layout only — decode keeps tp/sp.
     pipeline_stages: int = 1
     pipeline_microbatches: int = 0      # 0 => defaults to pipeline_stages
+    # Emit logits in activation dtype instead of f32: halves the [B,S,V]
+    # HBM traffic; the loss upcasts to f32 for its softmax statistics
+    # either way (losses.cross_entropy_loss), so accuracy is preserved to
+    # bf16 logit precision (z-loss keeps logits small).
+    logits_f32: bool = True
 
     @classmethod
     def llama3_8b(cls, **kw) -> "LlamaConfig":
@@ -361,15 +366,16 @@ class Llama(nn.Module):
                 x = layer_cls(cfg, name=f"layer_{i}")(x, positions, decode)
 
         x = RMSNorm(cfg, name="final_norm")(x)
+        out_dtype = jnp.float32 if cfg.logits_f32 else cfg.dtype
         if cfg.tie_embeddings:
             logits = jnp.einsum(
                 "bse,ve->bsv", x, embed.astype(cfg.dtype),
                 preferred_element_type=jnp.float32,
-            )
+            ).astype(out_dtype)
         else:
             logits = _dense(
                 cfg.vocab_size, ("embed", "vocab"), cfg, "lm_head"
-            )(x).astype(jnp.float32)
+            )(x).astype(out_dtype)
         if cfg.logits_softcap > 0:
             logits = jnp.tanh(logits / cfg.logits_softcap) * cfg.logits_softcap
         return constrain(logits, ("act_batch", "act_seq", "act_vocab"))

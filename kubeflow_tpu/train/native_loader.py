@@ -1,0 +1,152 @@
+"""ctypes binding for the native (C++) data loader.
+
+The compute path is JAX/XLA; the input pipeline around it is native, as in
+the reference (TF's C++ tf.data tier inside the training images): worker
+threads + a bounded ring buffer produce int32 token batches — synthetic
+(deterministic splitmix64 stream) or random crops of a memory-mapped
+binary token file — and ``dl_next`` copies straight into a numpy buffer
+with the GIL released, so a training step never waits on Python-side data
+generation.
+
+The shared library builds on first use with g++ (cached beside the
+source, keyed by source hash); environments without a toolchain raise
+``NativeLoaderUnavailable`` and callers fall back to
+``train.data.synthetic_text``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from kubeflow_tpu.utils import get_logger
+
+log = get_logger("native_loader")
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native",
+                    "dataloader.cpp")
+_BUILD_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+
+
+class NativeLoaderUnavailable(RuntimeError):
+    pass
+
+
+def _build() -> str:
+    src = os.path.abspath(_SRC)
+    if not os.path.exists(src):
+        raise NativeLoaderUnavailable(f"source missing: {src}")
+    with open(src, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = os.environ.get(
+        "KFTPU_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "kubeflow-tpu"),
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    out = os.path.join(cache_dir, f"dataloader-{tag}.so")
+    if os.path.exists(out):
+        return out
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           src, "-o", out + ".tmp"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise NativeLoaderUnavailable(f"g++ unavailable: {e}")
+    if proc.returncode != 0:
+        raise NativeLoaderUnavailable(f"build failed:\n{proc.stderr}")
+    os.replace(out + ".tmp", out)
+    log.info("native loader built", kv={"lib": out})
+    return out
+
+
+def _lib() -> ctypes.CDLL:
+    global _LIB
+    with _BUILD_LOCK:
+        if _LIB is None:
+            lib = ctypes.CDLL(_build())
+            lib.dl_create.restype = ctypes.c_void_p
+            lib.dl_create.argtypes = [
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_uint64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_char_p,
+            ]
+            lib.dl_error.argtypes = [ctypes.c_void_p]
+            lib.dl_error.restype = ctypes.c_int
+            lib.dl_next.argtypes = [
+                ctypes.c_void_p,
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ]
+            lib.dl_next.restype = ctypes.c_int
+            lib.dl_produced.argtypes = [ctypes.c_void_p]
+            lib.dl_produced.restype = ctypes.c_uint64
+            lib.dl_destroy.argtypes = [ctypes.c_void_p]
+            _LIB = lib
+    return _LIB
+
+
+class NativeTokenLoader:
+    """Batch iterator backed by the C++ ring buffer.
+
+    token_file: path to a raw little-endian int32 token dump (the
+    tokenised-corpus format); empty means the synthetic stream.
+    """
+
+    def __init__(
+        self,
+        *,
+        batch_size: int,
+        seq_len: int,
+        vocab_size: int = 32000,
+        seed: int = 0,
+        num_threads: int = 2,
+        queue_depth: int = 4,
+        token_file: str = "",
+    ):
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        lib = _lib()
+        self._lib = lib
+        self._handle = lib.dl_create(
+            batch_size, seq_len, vocab_size, seed, num_threads,
+            queue_depth, token_file.encode(),
+        )
+        err = lib.dl_error(self._handle)
+        if err:
+            lib.dl_destroy(self._handle)
+            self._handle = None
+            raise NativeLoaderUnavailable(
+                f"token file unusable (code {err}): {token_file!r}"
+            )
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        return self
+
+    def __next__(self) -> Dict[str, np.ndarray]:
+        out = np.empty((self.batch_size, self.seq_len), np.int32)
+        rc = self._lib.dl_next(self._handle, out)
+        if rc != 0:
+            raise StopIteration
+        return {"inputs": out}
+
+    @property
+    def batches_produced(self) -> int:
+        return int(self._lib.dl_produced(self._handle))
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.dl_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -66,6 +66,11 @@ def env_config() -> dict:
         # it; SURVEY §5 Tracing).
         "trace_dir": os.environ.get("KFTPU_TRACE_DIR", ""),
         "trace_steps": int(os.environ.get("KFTPU_TRACE_STEPS", "5")),
+        # Input pipeline: "native" uses the C++ ring-buffer loader
+        # (train.native_loader); data_path points it at a tokenised corpus
+        # (raw int32 dump). Default stays the in-process synthetic stream.
+        "loader": os.environ.get("KFTPU_LOADER", ""),
+        "data_path": os.environ.get("KFTPU_DATA_PATH", ""),
     }
 
 
@@ -158,11 +163,37 @@ def run(cfg: dict) -> int:
         if "total_steps" in overrides:
             cfg["steps"] = tc.total_steps
     trainer = Trainer(model, tc, mesh)
-    it = synthetic_text(SyntheticTextConfig(
-        batch_size=cfg["batch_per_host"] * cfg["num_processes"],
-        seq_len=cfg["seq_len"],
-        vocab_size=model_cfg.vocab_size,
-    ))
+    batch_size = cfg["batch_per_host"] * cfg["num_processes"]
+    it = None
+    if cfg["loader"] == "native" or cfg["data_path"]:
+        from kubeflow_tpu.train.native_loader import (
+            NativeLoaderUnavailable,
+            NativeTokenLoader,
+        )
+
+        try:
+            # seq_len + 1: the trainer's LM step shifts inputs/labels
+            # (tokens[:, :-1] vs [:, 1:]), so rows must carry one extra
+            # token to train at the full seq_len (same contract as
+            # synthetic_text).
+            it = NativeTokenLoader(
+                batch_size=batch_size, seq_len=cfg["seq_len"] + 1,
+                vocab_size=model_cfg.vocab_size,
+                token_file=cfg["data_path"],
+            )
+            log.info("native loader active",
+                     kv={"data": cfg["data_path"] or "synthetic"})
+        except NativeLoaderUnavailable as e:
+            if cfg["data_path"]:
+                raise  # a requested corpus must not silently degrade
+            log.info("native loader unavailable; synthetic fallback",
+                     kv={"err": str(e)})
+    if it is None:
+        it = synthetic_text(SyntheticTextConfig(
+            batch_size=batch_size,
+            seq_len=cfg["seq_len"],
+            vocab_size=model_cfg.vocab_size,
+        ))
     batch = trainer.shard_batch(
         {k: jnp.asarray(v) for k, v in next(it).items()}
     )

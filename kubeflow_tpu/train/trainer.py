@@ -56,6 +56,10 @@ class TrainConfig:
     # MoE aux loss weight (applied when the model sows "losses").
     aux_loss_weight: float = 0.0
     attn_impl: str = "full"
+    # Adam first-moment dtype ("bfloat16" halves mu's HBM; "" keeps f32).
+    # The variance stays f32 — bf16 nu loses too much precision near
+    # convergence, bf16 mu is the standard safe half.
+    mu_dtype: str = ""
 
     def make_optimizer(self) -> optax.GradientTransformation:
         schedule = optax.warmup_cosine_decay_schedule(
@@ -70,6 +74,7 @@ class TrainConfig:
             optax.adamw(
                 schedule, b1=self.b1, b2=self.b2,
                 weight_decay=self.weight_decay,
+                mu_dtype=self.mu_dtype or None,
             ),
         )
 

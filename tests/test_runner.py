@@ -86,3 +86,41 @@ class TestRunnerContract:
             KFTPU_MESH=json.dumps({"dp": -1, "pp": 2}),
         )
         assert report["loss"] > 0
+
+    @staticmethod
+    def _require_toolchain():
+        from kubeflow_tpu.train.native_loader import (
+            NativeLoaderUnavailable,
+            NativeTokenLoader,
+        )
+
+        try:
+            NativeTokenLoader(batch_size=1, seq_len=4).close()
+        except NativeLoaderUnavailable as e:
+            pytest.skip(f"native toolchain unavailable: {e}")
+
+    def test_native_loader_with_corpus(self, monkeypatch, tmp_path):
+        """KFTPU_DATA_PATH drives training from a real tokenised corpus
+        through the C++ loader."""
+        import numpy as np
+
+        self._require_toolchain()
+        corpus = (np.arange(50000, dtype=np.int32) % 256)
+        path = tmp_path / "corpus.bin"
+        corpus.tofile(path)
+        report = _run(
+            monkeypatch, tmp_path,
+            KFTPU_LOADER="native",
+            KFTPU_DATA_PATH=str(path),
+        )
+        assert report["loss"] > 0
+
+    def test_native_loader_missing_corpus_fails(self, monkeypatch, tmp_path):
+        from kubeflow_tpu.train.native_loader import NativeLoaderUnavailable
+
+        self._require_toolchain()
+        with pytest.raises(NativeLoaderUnavailable):
+            _run(
+                monkeypatch, tmp_path,
+                KFTPU_DATA_PATH=str(tmp_path / "missing.bin"),
+            )
