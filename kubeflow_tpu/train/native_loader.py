@@ -53,8 +53,12 @@ def _build() -> str:
     out = os.path.join(cache_dir, f"dataloader-{tag}.so")
     if os.path.exists(out):
         return out
+    # Per-process temp name: concurrent workers on one host (e2e gangs)
+    # race a cold cache; os.replace of a complete file is atomic, a shared
+    # .tmp path is not.
+    tmp = f"{out}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           src, "-o", out + ".tmp"]
+           src, "-o", tmp]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=120)
@@ -62,7 +66,7 @@ def _build() -> str:
         raise NativeLoaderUnavailable(f"g++ unavailable: {e}")
     if proc.returncode != 0:
         raise NativeLoaderUnavailable(f"build failed:\n{proc.stderr}")
-    os.replace(out + ".tmp", out)
+    os.replace(tmp, out)
     log.info("native loader built", kv={"lib": out})
     return out
 
@@ -76,7 +80,7 @@ def _lib() -> ctypes.CDLL:
             lib.dl_create.argtypes = [
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
                 ctypes.c_uint64, ctypes.c_int64, ctypes.c_int64,
-                ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_int32,
             ]
             lib.dl_error.argtypes = [ctypes.c_void_p]
             lib.dl_error.restype = ctypes.c_int
@@ -114,9 +118,10 @@ class NativeTokenLoader:
         self.seq_len = seq_len
         lib = _lib()
         self._lib = lib
+        validate, marker = self._validation_marker(token_file, vocab_size)
         self._handle = lib.dl_create(
             batch_size, seq_len, vocab_size, seed, num_threads,
-            queue_depth, token_file.encode(),
+            queue_depth, token_file.encode(), 1 if validate else 0,
         )
         err = lib.dl_error(self._handle)
         if err:
@@ -125,11 +130,43 @@ class NativeTokenLoader:
             raise NativeLoaderUnavailable(
                 f"token file unusable (code {err}): {token_file!r}"
             )
+        if validate and marker:
+            with open(marker, "w") as f:
+                f.write("ok\n")
+
+    @staticmethod
+    def _validation_marker(token_file: str, vocab_size: int):
+        """Corpus vocab validation pages the whole mmap; cache the verdict
+        per (path, size, mtime, vocab) so one host validates once, not
+        once per worker per gang restart. Returns (validate?, marker)."""
+        if not token_file:
+            return False, ""
+        try:
+            st = os.stat(token_file)
+        except OSError:
+            return True, ""           # let the C side report the open error
+        key = hashlib.sha256(
+            f"{os.path.realpath(token_file)}|{st.st_size}|{st.st_mtime_ns}"
+            f"|{vocab_size}".encode()
+        ).hexdigest()[:24]
+        d = os.path.join(
+            os.environ.get(
+                "KFTPU_NATIVE_CACHE",
+                os.path.join(os.path.expanduser("~"), ".cache",
+                             "kubeflow-tpu"),
+            ),
+            "validated",
+        )
+        os.makedirs(d, exist_ok=True)
+        marker = os.path.join(d, key)
+        return (not os.path.exists(marker)), marker
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         return self
 
     def __next__(self) -> Dict[str, np.ndarray]:
+        if self._handle is None:
+            raise StopIteration  # closed; NULL into the C ABI segfaults
         out = np.empty((self.batch_size, self.seq_len), np.int32)
         rc = self._lib.dl_next(self._handle, out)
         if rc != 0:
@@ -138,6 +175,8 @@ class NativeTokenLoader:
 
     @property
     def batches_produced(self) -> int:
+        if self._handle is None:
+            return 0
         return int(self._lib.dl_produced(self._handle))
 
     def close(self) -> None:

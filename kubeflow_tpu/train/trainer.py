@@ -57,8 +57,9 @@ class TrainConfig:
     aux_loss_weight: float = 0.0
     attn_impl: str = "full"
     # Adam first-moment dtype ("bfloat16" halves mu's HBM; "" keeps f32).
-    # The variance stays f32 — bf16 nu loses too much precision near
-    # convergence, bf16 mu is the standard safe half.
+    # The variance ALWAYS stays f32 (see _f32_moments) — optax would
+    # otherwise create nu in the params dtype, and bf16 nu underflows:
+    # (1-b2)*g^2 increments vanish below bf16's 8-bit mantissa.
     mu_dtype: str = ""
 
     def make_optimizer(self) -> optax.GradientTransformation:
@@ -69,14 +70,41 @@ class TrainConfig:
             decay_steps=max(self.total_steps, self.warmup_steps + 1),
             end_value=self.learning_rate * 0.1,
         )
-        return optax.chain(
+        return _f32_moments(optax.chain(
             optax.clip_by_global_norm(self.grad_clip_norm),
             optax.adamw(
                 schedule, b1=self.b1, b2=self.b2,
                 weight_decay=self.weight_decay,
                 mu_dtype=self.mu_dtype or None,
             ),
+        ))
+
+
+def _f32_moments(inner: optax.GradientTransformation) -> optax.GradientTransformation:
+    """Run the optimizer in f32 regardless of param/grad dtype.
+
+    With bf16 params, optax inits states from the params tree, so nu (and
+    update arithmetic) would silently be bf16. Casting the trees the inner
+    transform sees keeps all moments/statistics f32 — the mixed-precision
+    contract (bf16 params, f32 optimizer) — while apply_updates casts the
+    final update back to the param dtype. No-op for f32 params."""
+
+    def cast32(tree):
+        if tree is None:
+            return None
+        return jax.tree.map(
+            lambda x: x.astype(jnp.float32)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+            tree,
         )
+
+    def init_fn(params):
+        return inner.init(cast32(params))
+
+    def update_fn(updates, state, params=None):
+        return inner.update(cast32(updates), state, cast32(params))
+
+    return optax.GradientTransformation(init_fn, update_fn)
 
 
 class Trainer:

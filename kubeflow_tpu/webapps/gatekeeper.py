@@ -40,6 +40,30 @@ LOGIN_PATH = "/kflogin"
 WHOAMI_PATH = "/whoami"
 SESSION_TTL = 12 * 3600  # reference: 12h cookie expiry (AuthServer.go:185)
 
+LOGIN_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>Sign in</title>
+<style>body{font-family:system-ui,sans-serif;display:flex;height:100vh;
+align-items:center;justify-content:center}form{display:flex;
+flex-direction:column;gap:.5rem;min-width:16rem}input,button{padding:.5rem}
+#err{color:#b3261e;min-height:1.2em}</style></head>
+<body><form id="f"><h1>Kubeflow TPU</h1>
+<input id="u" placeholder="username" autocomplete="username">
+<input id="p" type="password" placeholder="password"
+ autocomplete="current-password">
+<button>Sign in</button><div id="err"></div></form>
+<script>
+document.getElementById('f').onsubmit = async (e) => {
+  e.preventDefault();
+  const r = await fetch('/kflogin', {method: 'POST',
+    headers: {'content-type': 'application/json'},
+    body: JSON.stringify({username: document.getElementById('u').value,
+                          password: document.getElementById('p').value})});
+  if (r.ok) { location.href = '/'; return; }
+  const d = await r.json().catch(() => ({}));
+  document.getElementById('err').textContent = d.error || r.statusText;
+};
+</script></body></html>"""
+
 
 class SessionSigner:
     def __init__(self, secret: Optional[bytes] = None,
@@ -148,11 +172,12 @@ class AuthProxy:
             def log_message(self, *a):
                 pass
 
-            def _send(self, status: int, payload, extra_headers=()):
+            def _send(self, status: int, payload, extra_headers=(),
+                      content_type: str = "application/json"):
                 data = (json.dumps(payload).encode()
                         if not isinstance(payload, bytes) else payload)
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
                 for k, v in extra_headers:
                     self.send_header(k, v)
@@ -191,6 +216,13 @@ class AuthProxy:
 
             def _login(self, method: str) -> None:
                 if method != "POST":
+                    # Browsers get the login page (the kflogin React app's
+                    # equivalent, components/kflogin/src/login.js); API
+                    # clients keep the JSON usage hint.
+                    if "text/html" in self.headers.get("Accept", ""):
+                        self._send(200, LOGIN_PAGE.encode(),
+                                   content_type="text/html; charset=utf-8")
+                        return
                     self._send(200, {"login": "POST {username, password}"})
                     return
                 body = self._body()

@@ -49,7 +49,7 @@ inline uint64_t splitmix64(uint64_t x) {
 
 class Loader {
  public:
-  Loader(Config cfg, const char* path)
+  Loader(Config cfg, const char* path, bool validate)
       : cfg_(cfg), stop_(false), produced_(0) {
     if (path != nullptr && path[0] != '\0') {
       int fd = ::open(path, O_RDONLY);
@@ -73,10 +73,13 @@ class Loader {
         error_ = 2;
         return;
       }
-      if (cfg_.vocab_size > 0) {
+      if (validate && cfg_.vocab_size > 0) {
         // Whole-corpus range check at open: an out-of-vocab or corrupt
         // token file must fail loudly, not train on clamped garbage
-        // (jnp.take clamps out-of-range indices on TPU).
+        // (jnp.take clamps out-of-range indices on TPU). The Python
+        // binding caches the verdict per (file, size, mtime, vocab) so a
+        // multi-GB corpus is paged through once per host, not once per
+        // worker per restart.
         for (uint64_t i = 0; i < n_tokens_; ++i) {
           if (tokens_[i] < 0 || tokens_[i] >= cfg_.vocab_size) {
             error_ = 3;
@@ -215,11 +218,11 @@ extern "C" {
 
 void* dl_create(int64_t batch_size, int64_t seq_len, int64_t vocab_size,
                 uint64_t seed, int64_t num_threads, int64_t queue_depth,
-                const char* token_file) {
+                const char* token_file, int32_t validate) {
   Config cfg{batch_size, seq_len, vocab_size, seed,
              num_threads > 0 ? num_threads : 2,
              queue_depth > 0 ? queue_depth : 4};
-  return new Loader(cfg, token_file);
+  return new Loader(cfg, token_file, validate != 0);
 }
 
 int dl_error(void* h) { return static_cast<Loader*>(h)->error(); }

@@ -141,3 +141,33 @@ class TestAuthProxyFlow:
         code, out, _ = _req(port, "GET", "/whoami",
                             headers={"Authorization": f"Basic {basic}"})
         assert out["user"] == "alice@corp.com"
+
+
+class TestLoginPage:
+    def test_browser_gets_html_form_api_gets_json(self):
+        import json as _json
+        import urllib.request
+
+        from kubeflow_tpu.webapps.gatekeeper import AuthProxy, Gatekeeper
+        from kubeflow_tpu.webapps.router import JsonHttpServer, Router
+
+        upstream = JsonHttpServer(Router()).start()
+        gk = Gatekeeper(users={"alice": "s3cret"})
+        proxy = AuthProxy(gk, upstream.port)
+        proxy.start()
+        try:
+            base = f"http://127.0.0.1:{proxy.port}"
+            req = urllib.request.Request(
+                f"{base}/kflogin", headers={"Accept": "text/html"}
+            )
+            with urllib.request.urlopen(req) as r:
+                assert r.headers["Content-Type"].startswith("text/html")
+                page = r.read().decode()
+            assert 'id="f"' in page and "password" in page
+
+            with urllib.request.urlopen(f"{base}/kflogin") as r:
+                assert r.headers["Content-Type"] == "application/json"
+                assert "login" in _json.loads(r.read())
+        finally:
+            proxy.stop()
+            upstream.stop()

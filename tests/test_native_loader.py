@@ -119,3 +119,10 @@ class TestNativeLoader:
             assert not np.array_equal(a, b)
         finally:
             ldr.close()
+
+    def test_closed_loader_raises_not_segfaults(self, loader_cls):
+        ldr = loader_cls(batch_size=1, seq_len=8, seed=0)
+        ldr.close()
+        with pytest.raises(StopIteration):
+            next(ldr)
+        assert ldr.batches_produced == 0

@@ -236,3 +236,32 @@ class TestOptimizerShardingByPath:
         # And a train step still runs.
         state2, metrics = trainer.step(state, batch)
         assert np.isfinite(float(metrics["loss"]))
+
+
+class TestMixedPrecisionOptState:
+    def test_bf16_params_keep_f32_moments(self, mesh8):
+        """bf16 params must NOT leak into optimizer state: optax inits
+        states from the params tree, so without the f32 wrapper nu would be
+        bf16 and underflow (bench/mixed-precision contract)."""
+        cfg = LlamaConfig.tiny(param_dtype=jnp.bfloat16)
+        trainer = Trainer(Llama(cfg), TrainConfig(task="lm"), mesh8)
+        batch = trainer.shard_batch(_lm_batch())
+        state = trainer.init_state(jax.random.PRNGKey(0), batch)
+        assert all(
+            p.dtype == jnp.bfloat16 for p in jax.tree.leaves(state.params)
+        )
+        float_moments = [
+            l for l in jax.tree.leaves(state.opt_state)
+            if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating)
+            and l.ndim > 0
+        ]
+        assert float_moments
+        assert all(l.dtype == jnp.float32 for l in float_moments), {
+            l.dtype for l in float_moments
+        }
+        # And the step still trains.
+        state2, metrics = trainer.step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+        assert all(
+            p.dtype == jnp.bfloat16 for p in jax.tree.leaves(state2.params)
+        )
