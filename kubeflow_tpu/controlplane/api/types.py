@@ -254,6 +254,9 @@ class ServingSpec:
     decode_chunk: int = 8               # tokens per device dispatch
     port: int = 8000
     image: str = "kubeflow-tpu/serving:latest"
+    # Train->serve handoff: restore params from this TpuJob checkpoint dir
+    # (empty = fresh init, dev/demo only).
+    checkpoint_dir: str = ""
 
 
 @dataclasses.dataclass

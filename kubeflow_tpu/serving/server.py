@@ -191,6 +191,10 @@ def env_config() -> dict:
         "max_len": int(os.environ.get("KFTPU_SERVING_MAX_LEN", "1024")),
         "decode_chunk": int(
             os.environ.get("KFTPU_SERVING_DECODE_CHUNK", "8")),
+        # Train->serve handoff: restore params from a TpuJob's checkpoint
+        # dir (the same orbax tree the trainer writes).
+        "checkpoint_dir": os.environ.get(
+            "KFTPU_SERVING_CHECKPOINT_DIR", ""),
     }
 
 
@@ -206,11 +210,27 @@ def build_server(cfg: dict) -> ServingServer:
         mesh = make_host_local_mesh(
             AxisSpec(**{k: int(v) for k, v in cfg["mesh"].items()})
         )
-    params = model.init(
-        jax.random.PRNGKey(0),
-        jax.numpy.zeros((1, 1), jax.numpy.int32), decode=True,
-    )
-    params = {"params": params["params"]}
+    params = None
+    if cfg["checkpoint_dir"]:
+        from kubeflow_tpu.train.checkpoint import CheckpointService
+
+        ckpt = CheckpointService(cfg["checkpoint_dir"])
+        state = ckpt.restore_params_latest()
+        ckpt.close()
+        if state is None:
+            raise RuntimeError(
+                f"no checkpoint found in {cfg['checkpoint_dir']!r} "
+                "(serving a trained model requires one)"
+            )
+        params = {"params": state["params"]}
+        log.info("serving from checkpoint",
+                 kv={"dir": cfg["checkpoint_dir"],
+                     "step": int(state["step"])})
+    if params is None:
+        params = {"params": model.init(
+            jax.random.PRNGKey(0),
+            jax.numpy.zeros((1, 1), jax.numpy.int32), decode=True,
+        )["params"]}
     engine = ServingEngine(
         model, params,
         ServingConfig(max_batch=cfg["max_batch"], max_len=cfg["max_len"],

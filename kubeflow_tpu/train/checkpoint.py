@@ -71,6 +71,60 @@ class CheckpointService:
         log.info("checkpoint restored", kv={"step": step})
         return restored
 
+    def restore_raw_latest(self) -> Optional[Any]:
+        """Restore the newest checkpoint with its SAVED structure/dtypes
+        (no template). For consumers that want a subtree without knowing
+        the writer's full state shape — e.g. serving loading ``params``
+        out of a trainer checkpoint."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        restored = self._mgr.restore(step, args=ocp.args.StandardRestore())
+        log.info("checkpoint restored (raw)", kv={"step": step})
+        return restored
+
+    def restore_params_latest(self) -> Optional[Any]:
+        """Restore ONLY the ``params`` subtree (+ step scalar) of a trainer
+        checkpoint. Serving must not materialise the f32 optimizer moments
+        — on an 8B model that is ~4x the params bytes for data it throws
+        away. Uses placeholder-based partial restore when orbax supports
+        it; otherwise falls back to a full raw restore."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        try:
+            # transforms={} + a template holding only the wanted keys is
+            # orbax's partial-restore contract: absent keys are skipped
+            # entirely (their arrays are never read). Metadata and restore
+            # both go through a direct PyTree checkpointer on the step's
+            # item directory (Standard's on-disk format IS the PyTree
+            # format; the manager's metadata is None on fresh opens).
+            path = os.path.join(self.directory, str(step), "default")
+            with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ck:
+                meta = ck.metadata(path)
+                meta = getattr(meta, "item_metadata", meta)
+                tree = dict(getattr(meta, "tree", meta))
+                template = {"params": tree["params"], "step": tree["step"]}
+                restore_args = jax.tree.map(
+                    lambda _: ocp.RestoreArgs(), template
+                )
+                restored = ck.restore(
+                    path,
+                    args=ocp.args.PyTreeRestore(
+                        item=template, transforms={},
+                        restore_args=restore_args,
+                    ),
+                )
+            log.info("checkpoint params restored", kv={"step": step})
+            return {"params": restored["params"], "step": restored["step"]}
+        except Exception as e:  # noqa: BLE001 — partial is best-effort
+            log.info("partial restore unavailable; full restore",
+                     kv={"err": repr(e)})
+        full = self.restore_raw_latest()
+        return None if full is None else {
+            "params": full["params"], "step": full["step"],
+        }
+
     def wait(self) -> None:
         self._mgr.wait_until_finished()
 

@@ -39,18 +39,27 @@ class NativeLoaderUnavailable(RuntimeError):
     pass
 
 
+def _cache_dir(*subdirs: str) -> str:
+    """Shared cache root for the built .so and validation markers
+    (KFTPU_NATIVE_CACHE overrides; tests point it at a tmp root)."""
+    d = os.path.join(
+        os.environ.get(
+            "KFTPU_NATIVE_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache", "kubeflow-tpu"),
+        ),
+        *subdirs,
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
 def _build() -> str:
     src = os.path.abspath(_SRC)
     if not os.path.exists(src):
         raise NativeLoaderUnavailable(f"source missing: {src}")
     with open(src, "rb") as f:
         tag = hashlib.sha256(f.read()).hexdigest()[:16]
-    cache_dir = os.environ.get(
-        "KFTPU_NATIVE_CACHE",
-        os.path.join(os.path.expanduser("~"), ".cache", "kubeflow-tpu"),
-    )
-    os.makedirs(cache_dir, exist_ok=True)
-    out = os.path.join(cache_dir, f"dataloader-{tag}.so")
+    out = os.path.join(_cache_dir(), f"dataloader-{tag}.so")
     if os.path.exists(out):
         return out
     # Per-process temp name: concurrent workers on one host (e2e gangs)
@@ -60,13 +69,17 @@ def _build() -> str:
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
            src, "-o", tmp]
     try:
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=120)
-    except (OSError, subprocess.TimeoutExpired) as e:
-        raise NativeLoaderUnavailable(f"g++ unavailable: {e}")
-    if proc.returncode != 0:
-        raise NativeLoaderUnavailable(f"build failed:\n{proc.stderr}")
-    os.replace(tmp, out)
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=120)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise NativeLoaderUnavailable(f"g++ unavailable: {e}")
+        if proc.returncode != 0:
+            raise NativeLoaderUnavailable(f"build failed:\n{proc.stderr}")
+        os.replace(tmp, out)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
     log.info("native loader built", kv={"lib": out})
     return out
 
@@ -149,16 +162,7 @@ class NativeTokenLoader:
             f"{os.path.realpath(token_file)}|{st.st_size}|{st.st_mtime_ns}"
             f"|{vocab_size}".encode()
         ).hexdigest()[:24]
-        d = os.path.join(
-            os.environ.get(
-                "KFTPU_NATIVE_CACHE",
-                os.path.join(os.path.expanduser("~"), ".cache",
-                             "kubeflow-tpu"),
-            ),
-            "validated",
-        )
-        os.makedirs(d, exist_ok=True)
-        marker = os.path.join(d, key)
+        marker = os.path.join(_cache_dir("validated"), key)
         return (not os.path.exists(marker)), marker
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:

@@ -12,6 +12,9 @@ import os
 # Must be set before jax is imported anywhere. Force CPU even if the shell
 # has a TPU platform configured — tests never touch real hardware.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Keep native-loader build artifacts + corpus-validation markers out of the
+# developer's ~/.cache (stable tmp path so the .so stays cached across runs).
+os.environ.setdefault("KFTPU_NATIVE_CACHE", "/tmp/kftpu-test-native-cache")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

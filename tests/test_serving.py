@@ -313,3 +313,75 @@ class TestServingScannedModel:
         out = eng.run()[0].tokens
         ref = greedy_reference(model, params, prompt, 5)
         assert out == ref
+
+
+class TestTrainServeHandoff:
+    def test_server_loads_trained_checkpoint(self, tmp_path, monkeypatch):
+        """The full platform loop: train a job (writes orbax checkpoints),
+        then stand up serving FROM that checkpoint and assert the served
+        params are the trained ones, not a fresh init."""
+        import os
+
+        from kubeflow_tpu.train import runner
+        from kubeflow_tpu.serving.server import build_server, env_config
+
+        ckpt = str(tmp_path / "ckpt")
+        for k in list(os.environ):
+            if k.startswith("KFTPU_"):
+                monkeypatch.delenv(k)
+        for k, v in {
+            "KFTPU_MODEL": "llama-tiny", "KFTPU_TRAIN_STEPS": "3",
+            "KFTPU_BATCH_PER_HOST": "8", "KFTPU_SEQ_LEN": "16",
+            "KFTPU_MESH": json.dumps({"dp": -1}),
+            "KFTPU_CHECKPOINT_DIR": ckpt,
+            "KFTPU_CHECKPOINT_EVERY": "1",
+            "KFTPU_TERMINATION_LOG": str(tmp_path / "t.json"),
+        }.items():
+            monkeypatch.setenv(k, v)
+        assert runner.run(runner.env_config()) == 0
+
+        monkeypatch.setenv("KFTPU_SERVING_MODEL", "llama-tiny")
+        monkeypatch.setenv("KFTPU_SERVING_CHECKPOINT_DIR", ckpt)
+        monkeypatch.setenv("KFTPU_SERVING_MAX_LEN", "64")
+        monkeypatch.setenv("KFTPU_SERVING_HOST", "127.0.0.1")
+        monkeypatch.setenv("KFTPU_SERVING_PORT", "0")
+        server = build_server(env_config())
+
+        # Params must match the checkpoint, not a fresh init.
+        from kubeflow_tpu.train.checkpoint import CheckpointService
+
+        svc = CheckpointService(ckpt)
+        saved = svc.restore_raw_latest()
+        svc.close()
+        leaf_saved = jax.tree.leaves(saved["params"])[0]
+        leaf_served = jax.tree.leaves(server.engine.params["params"])[0]
+        np.testing.assert_allclose(
+            np.asarray(leaf_served, np.float32),
+            np.asarray(leaf_saved, np.float32), rtol=1e-2, atol=1e-2,
+        )
+
+        # And it generates.
+        server.start()
+        try:
+            import urllib.request
+
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/v1/generate",
+                data=json.dumps(
+                    {"tokens": [3, 5, 7], "max_new_tokens": 4}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            out = json.load(urllib.request.urlopen(req))
+            assert len(out["tokens"]) == 4
+        finally:
+            server.stop()
+
+    def test_missing_checkpoint_fails_loudly(self, tmp_path, monkeypatch):
+        from kubeflow_tpu.serving.server import build_server, env_config
+
+        monkeypatch.setenv("KFTPU_SERVING_MODEL", "llama-tiny")
+        monkeypatch.setenv("KFTPU_SERVING_CHECKPOINT_DIR",
+                           str(tmp_path / "empty"))
+        with pytest.raises(RuntimeError, match="no checkpoint"):
+            build_server(env_config())
