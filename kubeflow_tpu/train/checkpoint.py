@@ -118,8 +118,11 @@ class CheckpointService:
             log.info("checkpoint params restored", kv={"step": step})
             return {"params": restored["params"], "step": restored["step"]}
         except Exception as e:  # noqa: BLE001 — partial is best-effort
-            log.info("partial restore unavailable; full restore",
-                     kv={"err": repr(e)})
+            log.warning(
+                "partial restore unavailable; falling back to FULL state "
+                "restore (materialises optimizer moments, ~4x params bytes)",
+                kv={"err": repr(e)},
+            )
         full = self.restore_raw_latest()
         return None if full is None else {
             "params": full["params"], "step": full["step"],

@@ -13,8 +13,17 @@ import os
 # has a TPU platform configured — tests never touch real hardware.
 os.environ["JAX_PLATFORMS"] = "cpu"
 # Keep native-loader build artifacts + corpus-validation markers out of the
-# developer's ~/.cache (stable tmp path so the .so stays cached across runs).
-os.environ.setdefault("KFTPU_NATIVE_CACHE", "/tmp/kftpu-test-native-cache")
+# developer's ~/.cache. Per-user path: a world-shared fixed /tmp dir would
+# collide across users on shared hosts (and let another local user pre-plant
+# a .so at the predictable cache key).
+import getpass  # noqa: E402
+import tempfile  # noqa: E402
+
+os.environ.setdefault(
+    "KFTPU_NATIVE_CACHE",
+    os.path.join(tempfile.gettempdir(),
+                 f"kftpu-test-native-cache-{getpass.getuser()}"),
+)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
