@@ -72,6 +72,9 @@ def bench_train(args) -> None:
     # for one v5e chip (16G HBM) with f32 Adam state + grads + activations.
     import jax.numpy as _jnp
 
+    # bs 12 saturates one v5e chip best (measured: 8 -> 49.5% MFU,
+    # 12 -> 53.4%, 16 spills).
+    bs = args.batch_size or 12
     cfg = LlamaConfig(
         vocab_size=32000, embed_dim=2048, num_layers=12, num_heads=16,
         num_kv_heads=8, head_dim=128, mlp_dim=5632,
@@ -90,7 +93,7 @@ def bench_train(args) -> None:
     )
     it = synthetic_text(
         SyntheticTextConfig(
-            batch_size=args.batch_size * ndev,
+            batch_size=bs * ndev,
             seq_len=args.seq_len,
             vocab_size=cfg.vocab_size,
         )
@@ -114,7 +117,7 @@ def bench_train(args) -> None:
         jax.profiler.stop_trace()
     assert final_loss == final_loss, "loss is NaN"
 
-    tokens = args.batch_size * ndev * args.seq_len * args.steps
+    tokens = bs * ndev * args.seq_len * args.steps
     tps_chip = tokens / dt / ndev
     flops_per_token = train_flops_per_token(cfg, args.seq_len)
     peak = device_peak_tflops()
@@ -148,9 +151,10 @@ def bench_serving(args) -> None:
     params = {"params": model.init(
         jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32)
     )["params"]}
+    bs = args.batch_size or 8
     engine = ServingEngine(
         model, params,
-        ServingConfig(max_batch=args.batch_size, max_len=1024,
+        ServingConfig(max_batch=bs, max_len=1024,
                       decode_chunk=args.decode_chunk),
     )
     rng = np.random.default_rng(0)
@@ -182,7 +186,7 @@ def bench_serving(args) -> None:
         p99_ttft_s=round(pct(ttfts, 0.99), 4),
         p50_latency_s=round(pct(lats, 0.50), 4),
         p99_latency_s=round(pct(lats, 0.99), 4),
-        requests=args.requests, batch=args.batch_size,
+        requests=args.requests, batch=bs,
         prompt_len=args.prompt_len, gen_len=args.gen_len,
         decode_chunk=args.decode_chunk,
     )
@@ -207,7 +211,9 @@ def bench_resnet(args) -> None:
         model, TrainConfig(task="image", warmup_steps=10, total_steps=1000),
         mesh,
     )
-    bs = args.batch_size * ndev
+    # Conv stacks want large batches (measured: bs32 1420 -> bs128 2392
+    # images/s on one v5e); explicit --batch-size always wins.
+    bs = (args.batch_size or 128) * ndev
     it = synthetic_images(SyntheticImageConfig(batch_size=bs, image_size=224))
     batch = trainer.shard_batch({k: jnp.asarray(v) for k, v in next(it).items()})
     state = trainer.init_state(jax.random.PRNGKey(0), batch)
@@ -261,8 +267,9 @@ def bench_mixtral(args) -> None:
                     aux_loss_weight=0.02, attn_impl=args.attn),
         mesh,
     )
+    bs = args.batch_size or 8
     it = synthetic_text(SyntheticTextConfig(
-        batch_size=args.batch_size * ndev, seq_len=args.seq_len,
+        batch_size=bs * ndev, seq_len=args.seq_len,
         vocab_size=cfg.vocab_size,
     ))
     batch = trainer.shard_batch({k: jnp.asarray(v) for k, v in next(it).items()})
@@ -277,7 +284,7 @@ def bench_mixtral(args) -> None:
         state, metrics = trainer.step(state, batch, rng=rng)
     _sync(metrics["loss"])
     dt = time.perf_counter() - t0
-    tokens = args.batch_size * ndev * args.seq_len * args.steps
+    tokens = bs * ndev * args.seq_len * args.steps
     tps_chip = tokens / dt / ndev
     flops_per_token = train_flops_per_token(cfg, args.seq_len)
     peak = device_peak_tflops()
@@ -294,46 +301,32 @@ def bench_mixtral(args) -> None:
 
 
 def bench_hpo(args) -> None:
-    import jax
     import jax.numpy as jnp
 
     from kubeflow_tpu.hpo.space import ParameterSpec
-    from kubeflow_tpu.hpo.sweep import run_study
+    from kubeflow_tpu.hpo.sweep import SharedCompileSweep, run_study
     from kubeflow_tpu.models import get_model
     from kubeflow_tpu.topology import AxisSpec, make_host_local_mesh
-    from kubeflow_tpu.train import TrainConfig, Trainer
     from kubeflow_tpu.train.data import SyntheticImageConfig, synthetic_images
 
     model, mcfg = get_model("vit-tiny")
     mesh = make_host_local_mesh(AxisSpec(dp=-1))
     it = synthetic_images(SyntheticImageConfig(
-        batch_size=args.batch_size, image_size=mcfg.image_size,
+        batch_size=args.batch_size or 8, image_size=mcfg.image_size,
         num_classes=mcfg.num_classes,
     ))
-    batch_np = next(it)
-
-    def trial_fn(hp):
-        tc = TrainConfig(
-            task="image", total_steps=args.steps, warmup_steps=1,
-            learning_rate=float(hp["learning_rate"]),
-            weight_decay=float(hp["weight_decay"]),
-        )
-        trainer = Trainer(model, tc, mesh)
-        batch = trainer.shard_batch(
-            {k: jnp.asarray(v) for k, v in batch_np.items()}
-        )
-        state = trainer.init_state(jax.random.PRNGKey(0), batch)
-        for _ in range(args.steps):
-            state, metrics = trainer.step(state, batch)
-        return {"loss": float(metrics["loss"])}
-
+    batch = {k: jnp.asarray(v) for k, v in next(it).items()}
+    # Shared-compile trials: hyperparams are traced optimizer-state inputs,
+    # so only the first trial pays XLA compile.
+    sweep = SharedCompileSweep(model, mesh, batch, steps=args.steps,
+                               task="image")
     res = run_study(
         [
             ParameterSpec(name="learning_rate", min=1e-4, max=1e-2,
                           log_scale=True),
             ParameterSpec(name="weight_decay", min=0.0, max=0.2),
         ],
-        trial_fn, algorithm="random", max_trials=args.requests, seed=0,
+        sweep.trial_fn, algorithm="random", max_trials=args.requests, seed=0,
     )
     _emit(
         "hpo_vit_tiny_trials_per_hour", res.trials_per_hour, "trials/hour",
@@ -349,9 +342,9 @@ def main() -> None:
                    choices=["train", "serving", "resnet", "mixtral", "hpo"])
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)
-    # bs 12 saturates one v5e chip best (measured: 8 -> 49.5% MFU,
-    # 12 -> 53.4%, 16 spills).
-    p.add_argument("--batch-size", type=int, default=12)
+    # Default is per-bench (train/serving 12/8, resnet 128, mixtral 8);
+    # an explicit value always wins.
+    p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--attn", default="flash",
                    choices=["full", "flash", "ring", "ulysses"])

@@ -41,14 +41,25 @@ class NativeLoaderUnavailable(RuntimeError):
 
 def _cache_dir(*subdirs: str) -> str:
     """Shared cache root for the built .so and validation markers
-    (KFTPU_NATIVE_CACHE overrides; tests point it at a tmp root)."""
-    d = os.path.join(
-        os.environ.get(
-            "KFTPU_NATIVE_CACHE",
-            os.path.join(os.path.expanduser("~"), ".cache", "kubeflow-tpu"),
-        ),
-        *subdirs,
+    (KFTPU_NATIVE_CACHE overrides; tests point it at a tmp root).
+
+    The root is created 0700 and must be OWNED by this uid: the .so cache
+    key is predictable (hash of public source), so a world-writable or
+    foreign-owned root would let another local user pre-plant a library
+    this process then dlopens."""
+    root = os.environ.get(
+        "KFTPU_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "kubeflow-tpu"),
     )
+    os.makedirs(root, mode=0o700, exist_ok=True)
+    st = os.stat(root)
+    if hasattr(os, "getuid") and st.st_uid != os.getuid():
+        raise NativeLoaderUnavailable(
+            f"native cache {root!r} is owned by uid {st.st_uid}, not "
+            f"{os.getuid()} — refusing to load code from it "
+            "(set KFTPU_NATIVE_CACHE to a directory you own)"
+        )
+    d = os.path.join(root, *subdirs)
     os.makedirs(d, exist_ok=True)
     return d
 

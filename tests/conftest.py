@@ -13,16 +13,16 @@ import os
 # has a TPU platform configured — tests never touch real hardware.
 os.environ["JAX_PLATFORMS"] = "cpu"
 # Keep native-loader build artifacts + corpus-validation markers out of the
-# developer's ~/.cache. Per-user path: a world-shared fixed /tmp dir would
-# collide across users on shared hosts (and let another local user pre-plant
-# a .so at the predictable cache key).
-import getpass  # noqa: E402
+# developer's ~/.cache. Per-uid path: a world-shared fixed /tmp dir would
+# collide across users on shared hosts; _cache_dir() additionally enforces
+# 0700 + ownership before anything is dlopened from it. getuid (not
+# getpass.getuser) so unmapped-UID containers don't KeyError at import.
 import tempfile  # noqa: E402
 
+_uid = os.getuid() if hasattr(os, "getuid") else "win"
 os.environ.setdefault(
     "KFTPU_NATIVE_CACHE",
-    os.path.join(tempfile.gettempdir(),
-                 f"kftpu-test-native-cache-{getpass.getuser()}"),
+    os.path.join(tempfile.gettempdir(), f"kftpu-test-native-cache-{_uid}"),
 )
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
