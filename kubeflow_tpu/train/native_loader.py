@@ -59,6 +59,14 @@ def _cache_dir(*subdirs: str) -> str:
             f"{os.getuid()} — refusing to load code from it "
             "(set KFTPU_NATIVE_CACHE to a directory you own)"
         )
+    if st.st_mode & 0o022:
+        # makedirs doesn't chmod pre-existing dirs: a root created earlier
+        # under a permissive umask would still be writable by others.
+        raise NativeLoaderUnavailable(
+            f"native cache {root!r} is group/world-writable "
+            f"(mode {oct(st.st_mode & 0o777)}) — refusing to load code "
+            "from it; chmod 700 it or set KFTPU_NATIVE_CACHE"
+        )
     d = os.path.join(root, *subdirs)
     os.makedirs(d, exist_ok=True)
     return d

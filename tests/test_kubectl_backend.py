@@ -264,3 +264,36 @@ class TestTpuctlKubectlBackend:
         ev = q.get_nowait()
         assert ev.type == "DELETED"
         assert ev.object.metadata.owner_references[0].name == "train"
+
+
+class TestControlPlaneMain:
+    def test_build_and_reconcile_against_kubectl(self, api):
+        """The in-cluster entrypoint wires every controller against the
+        kubectl backend; a Notebook reconciles through real exec."""
+        from kubeflow_tpu.controlplane.main import build, build_parser
+
+        args = build_parser().parse_args([
+            "--backend", "kubectl", "--kubectl-bin", api.kubectl,
+            "--metrics-port", "-1",
+        ])
+        k_api, manager, prober, registry = build(args)
+        assert len(manager.controllers) == 6
+
+        k_api.create(Notebook(
+            metadata=ObjectMeta(name="nb", namespace="team-a"),
+            spec=NotebookSpec(image="jupyter:latest"),
+        ))
+        k_api.poll_now()
+        manager.run_until_idle()
+        assert k_api.get("Pod", "nb-0", "team-a") is not None
+        assert prober.probe() is True
+        assert "kftpu_availability 1" in registry.render()
+
+    def test_unknown_component_exits(self, api):
+        from kubeflow_tpu.controlplane.main import build, build_parser
+
+        args = build_parser().parse_args([
+            "--backend", "memory", "--components", "tpujob,nope",
+        ])
+        with pytest.raises(SystemExit):
+            build(args)
