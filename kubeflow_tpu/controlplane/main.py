@@ -21,9 +21,6 @@ metrics, and reports its own availability.
 from __future__ import annotations
 
 import argparse
-import threading
-import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Tuple
 
 from kubeflow_tpu.controlplane.controllers import (
@@ -38,12 +35,14 @@ from kubeflow_tpu.controlplane.prober import (
     AvailabilityProber,
     controller_target,
 )
-from kubeflow_tpu.controlplane.runtime import (
-    ControllerManager,
-    InMemoryApiServer,
+from kubeflow_tpu.controlplane.runtime import ControllerManager
+from kubeflow_tpu.controlplane.runtime.backend import (
+    add_backend_args,
+    build_backend,
+    serve_forever,
 )
 from kubeflow_tpu.utils import get_logger
-from kubeflow_tpu.utils.monitoring import MetricsRegistry
+from kubeflow_tpu.utils.monitoring import MetricsHttpServer, MetricsRegistry
 
 log = get_logger("controlplane")
 
@@ -61,15 +60,7 @@ def build(args) -> Tuple[object, ControllerManager, AvailabilityProber,
                          MetricsRegistry]:
     """Wire the manager; separated from run() so tests can pump manually."""
     registry = MetricsRegistry()
-    if args.backend == "kubectl":
-        from kubeflow_tpu.controlplane.runtime.kubectl import KubectlApiServer
-
-        api = KubectlApiServer(
-            kubectl=args.kubectl_bin, context=args.context,
-            poll_interval=args.poll_interval,
-        )
-    else:
-        api = InMemoryApiServer()
+    api = build_backend(args)
     manager = ControllerManager(api)
     names = [c.strip() for c in args.components.split(",") if c.strip()]
     for name in names:
@@ -88,32 +79,6 @@ def build(args) -> Tuple[object, ControllerManager, AvailabilityProber,
     return api, manager, prober, registry
 
 
-class _MetricsServer:
-    def __init__(self, registry: MetricsRegistry, port: int):
-        reg = registry
-
-        class H(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def do_GET(self):
-                body = reg.render().encode()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-        self.httpd = ThreadingHTTPServer(("0.0.0.0", port), H)
-        self.port = self.httpd.server_address[1]
-        threading.Thread(target=self.httpd.serve_forever,
-                         daemon=True).start()
-
-    def stop(self) -> None:
-        self.httpd.shutdown()
-
-
 def run(args) -> int:
     api, manager, prober, registry = build(args)
     if hasattr(api, "start_polling"):
@@ -122,36 +87,26 @@ def run(args) -> int:
     prober.start()
     metrics = None
     if args.metrics_port >= 0:
-        metrics = _MetricsServer(registry, args.metrics_port)
+        metrics = MetricsHttpServer(registry, args.metrics_port)
         log.info("metrics serving", kv={"port": metrics.port})
     log.info("control plane up",
              kv={"backend": args.backend,
                  "controllers": len(manager.controllers)})
-    try:
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        pass
-    finally:
-        prober.stop()
-        manager.stop()
-        if hasattr(api, "stop_polling"):
-            api.stop_polling()
-        if metrics is not None:
-            metrics.stop()
+    serve_forever(
+        prober.stop,
+        manager.stop,
+        getattr(api, "stop_polling", lambda: None),
+        (metrics.stop if metrics is not None else (lambda: None)),
+    )
     return 0
 
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="kftpu-controlplane")
-    p.add_argument("--backend", choices=("memory", "kubectl"),
-                   default="kubectl")
-    p.add_argument("--kubectl-bin", default="kubectl")
-    p.add_argument("--context", default="")
+    add_backend_args(p)
     p.add_argument("--components",
                    default="tpujob,studyjob,notebook,profile,tensorboard,"
                            "serving")
-    p.add_argument("--poll-interval", type=float, default=2.0)
     p.add_argument("--probe-interval", type=float, default=30.0)
     p.add_argument("--metrics-port", type=int, default=9090,
                    help="-1 disables the metrics endpoint")

@@ -1,0 +1,50 @@
+"""Shared backend selection for long-lived platform processes.
+
+controlplane.main (controller manager) and webapps.frontend (hub) both
+run against either the in-memory dev apiserver or a real cluster through
+the kubectl adapter; the flag surface and construction live here once so
+new backend options don't drift between entrypoints.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from kubeflow_tpu.controlplane.runtime.apiserver import InMemoryApiServer
+
+
+def add_backend_args(p: argparse.ArgumentParser,
+                     *, default: str = "kubectl") -> None:
+    p.add_argument("--backend", choices=("memory", "kubectl"),
+                   default=default)
+    p.add_argument("--kubectl-bin", default="kubectl")
+    p.add_argument("--context", default="")
+    p.add_argument("--poll-interval", type=float, default=2.0)
+
+
+def build_backend(args):
+    if args.backend == "kubectl":
+        from kubeflow_tpu.controlplane.runtime.kubectl import KubectlApiServer
+
+        return KubectlApiServer(
+            kubectl=args.kubectl_bin, context=args.context,
+            poll_interval=getattr(args, "poll_interval", 2.0),
+        )
+    return InMemoryApiServer()
+
+
+def serve_forever(*cleanups) -> None:
+    """Block until interrupted, then run cleanups in order."""
+    import time
+
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        for fn in cleanups:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — best-effort shutdown
+                pass

@@ -49,6 +49,197 @@ def build_manifest(tag: str = "") -> dict:
     }
 
 
+def build_k8s_manifests(tag: str = "") -> list:
+    """Deployment manifests for the platform's own services (SURVEY §7.4:
+    the kfctl-equivalent emits manifests for all controllers).
+
+    Security shape:
+    - The hub is NEVER exposed directly: a gatekeeper AuthProxy sidecar
+      owns the Service port and injects the trusted identity header; the
+      hub container binds localhost (a directly-reachable hub would treat
+      any client-supplied header as authentication).
+    - Scoped RBAC, not cluster-admin: the controller SA gets CRUD on the
+      platform's own API group + the core kinds its controllers emit; the
+      hub gets its own lower-privilege SA.
+    """
+    tag = tag or f"v{__version__}"
+    ns = "kubeflow-tpu"
+    cp_image = f"{IMAGES['controlplane']}:{tag}"
+
+    def deployment(name, sa, containers, volumes=()):
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": name}},
+                "template": {
+                    "metadata": {"labels": {"app": name}},
+                    "spec": {
+                        "serviceAccountName": sa,
+                        "containers": containers,
+                        **({"volumes": list(volumes)} if volumes else {}),
+                    },
+                },
+            },
+        }
+
+    def service(name, app, port, target):
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "selector": {"app": app},
+                "ports": [{"port": port, "targetPort": target}],
+            },
+        }
+
+    def sa(name):
+        return {"apiVersion": "v1", "kind": "ServiceAccount",
+                "metadata": {"name": name, "namespace": ns}}
+
+    def cluster_role(name, rules):
+        return {"apiVersion": "rbac.authorization.k8s.io/v1",
+                "kind": "ClusterRole",
+                "metadata": {"name": name}, "rules": rules}
+
+    def binding(name, role, sa_name):
+        return {"apiVersion": "rbac.authorization.k8s.io/v1",
+                "kind": "ClusterRoleBinding",
+                "metadata": {"name": name},
+                "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                            "kind": "ClusterRole", "name": role},
+                "subjects": [{"kind": "ServiceAccount", "name": sa_name,
+                              "namespace": ns}]}
+
+    # CRDs for the platform's own API group: without these a fresh-cluster
+    # deploy has no resource types and every controller idles forever.
+    # Schemas are permissive (preserve-unknown-fields) — our serde owns
+    # validation; CRDs here gate existence + scope + status subresource.
+    crd_kinds = [
+        ("TpuJob", "tpujobs", "Namespaced"),
+        ("Notebook", "notebooks", "Namespaced"),
+        ("Profile", "profiles", "Cluster"),
+        ("PodDefault", "poddefaults", "Namespaced"),
+        ("Tensorboard", "tensorboards", "Namespaced"),
+        ("Serving", "servings", "Namespaced"),
+        ("StudyJob", "studyjobs", "Namespaced"),
+        ("PlatformConfig", "platformconfigs", "Cluster"),
+    ]
+
+    def crd(kind, plural, scope):
+        return {
+            "apiVersion": "apiextensions.k8s.io/v1",
+            "kind": "CustomResourceDefinition",
+            "metadata": {"name": f"{plural}.tpu.kubeflow.org"},
+            "spec": {
+                "group": "tpu.kubeflow.org",
+                "scope": scope,
+                "names": {"kind": kind, "plural": plural,
+                          "singular": kind.lower()},
+                "versions": [{
+                    "name": "v1alpha1",
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    "schema": {"openAPIV3Schema": {
+                        "type": "object",
+                        "x-kubernetes-preserve-unknown-fields": True,
+                    }},
+                }],
+            },
+        }
+
+    crd_resources = [plural for _, plural, _ in crd_kinds]
+    controlplane_rules = [
+        {"apiGroups": ["tpu.kubeflow.org"],
+         "resources": crd_resources + [f"{r}/status" for r in crd_resources],
+         "verbs": ["*"]},
+        {"apiGroups": [""],
+         "resources": ["pods", "services", "namespaces", "serviceaccounts",
+                       "resourcequotas", "events"],
+         "verbs": ["*"]},
+        {"apiGroups": ["rbac.authorization.k8s.io"],
+         "resources": ["rolebindings"], "verbs": ["*"]},
+        {"apiGroups": ["networking.istio.io", "security.istio.io"],
+         "resources": ["virtualservices", "authorizationpolicies"],
+         "verbs": ["*"]},
+    ]
+    hub_rules = [
+        {"apiGroups": ["tpu.kubeflow.org"],
+         "resources": ["notebooks", "profiles", "tpujobs", "servings",
+                       "studyjobs", "poddefaults",
+                       # dashboard env_info reads the platform config
+                       "platformconfigs"],
+         "verbs": ["get", "list", "create", "delete"]},
+        {"apiGroups": [""],
+         "resources": ["namespaces", "events"],
+         "verbs": ["get", "list"]},
+        {"apiGroups": ["rbac.authorization.k8s.io"],
+         "resources": ["rolebindings"],
+         "verbs": ["get", "list", "create", "delete"]},
+        # kfam contributor flows keep the namespace AuthorizationPolicy's
+        # principal list in sync with bindings.
+        {"apiGroups": ["security.istio.io"],
+         "resources": ["authorizationpolicies"],
+         "verbs": ["get", "list", "create", "update", "delete"]},
+    ]
+
+    gatekeeper_sidecar = {
+        "name": "gatekeeper",
+        "image": cp_image,
+        "command": ["python", "-m", "kubeflow_tpu.webapps.gatekeeper",
+                    "--users-file", "/etc/gatekeeper/users",
+                    "--upstream-port", "8082", "--port", "8081"],
+        "ports": [{"containerPort": 8081}],
+        "volumeMounts": [{"name": "gatekeeper-users",
+                          "mountPath": "/etc/gatekeeper",
+                          "readOnly": True}],
+    }
+    hub_container = {
+        "name": "hub",
+        "image": cp_image,
+        # localhost only: reachable solely through the sidecar, which
+        # strips client copies of the identity header and injects its own.
+        "command": ["python", "-m", "kubeflow_tpu.webapps.frontend",
+                    "--host", "127.0.0.1", "--port", "8082"],
+    }
+
+    return [
+        {"apiVersion": "v1", "kind": "Namespace",
+         "metadata": {"name": ns}},
+        *[crd(k, p, s) for k, p, s in crd_kinds],
+        sa("kubeflow-tpu-controlplane"),
+        sa("kubeflow-tpu-hub"),
+        cluster_role("kubeflow-tpu-controlplane", controlplane_rules),
+        cluster_role("kubeflow-tpu-hub", hub_rules),
+        binding("kubeflow-tpu-controlplane", "kubeflow-tpu-controlplane",
+                "kubeflow-tpu-controlplane"),
+        binding("kubeflow-tpu-hub", "kubeflow-tpu-hub", "kubeflow-tpu-hub"),
+        deployment(
+            "controlplane", "kubeflow-tpu-controlplane",
+            [{
+                "name": "controlplane",
+                "image": cp_image,
+                "command": ["python", "-m",
+                            "kubeflow_tpu.controlplane.main",
+                            "--backend", "kubectl"],
+                "ports": [{"containerPort": 9090}],
+            }],
+        ),
+        service("controlplane-metrics", "controlplane", 9090, 9090),
+        deployment(
+            "hub", "kubeflow-tpu-hub",
+            [gatekeeper_sidecar, hub_container],
+            volumes=[{"name": "gatekeeper-users",
+                      "secret": {"secretName": "gatekeeper-users"}}],
+        ),
+        service("hub", "hub", 80, 8081),
+    ]
+
+
 def bump_version(level: str, path: str = "") -> str:
     path = path or os.path.join(os.path.dirname(__file__), "..",
                                 "version.py")
@@ -77,14 +268,21 @@ def main(argv=None) -> int:
     sub = p.add_subparsers(dest="command", required=True)
     mp = sub.add_parser("manifest")
     mp.add_argument("--tag", default="")
+    mp.add_argument("--k8s", action="store_true",
+                    help="emit the platform's own Deployment/Service/RBAC "
+                         "manifests instead of the image map")
     bp = sub.add_parser("bump")
     bp.add_argument("--level", choices=("major", "minor", "patch"),
                     required=True)
     bp.add_argument("--version-file", default="")
     args = p.parse_args(argv)
     if args.command == "manifest":
-        yaml.safe_dump(build_manifest(args.tag), sys.stdout,
-                       sort_keys=False)
+        if args.k8s:
+            yaml.safe_dump_all(build_k8s_manifests(args.tag), sys.stdout,
+                               sort_keys=False)
+        else:
+            yaml.safe_dump(build_manifest(args.tag), sys.stdout,
+                           sort_keys=False)
         return 0
     new = bump_version(args.level, args.version_file)
     print(new)

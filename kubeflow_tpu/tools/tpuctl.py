@@ -47,9 +47,9 @@ def _load_docs(paths: List[str]) -> List[dict]:
 
 
 def _kubectl_api(args):
-    from kubeflow_tpu.controlplane.runtime.kubectl import KubectlApiServer
+    from kubeflow_tpu.controlplane.runtime.backend import build_backend
 
-    return KubectlApiServer(kubectl=args.kubectl_bin, context=args.context)
+    return build_backend(args)
 
 
 def cmd_apply(args) -> int:
@@ -180,6 +180,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", choices=("state", "kubectl"), default="state")
     p.add_argument("--kubectl-bin", default="kubectl")
     p.add_argument("--context", default="")
+    p.add_argument("--poll-interval", type=float, default=2.0)
     sub = p.add_subparsers(dest="command", required=True)
 
     ap = sub.add_parser("apply", help="apply platform config / manifests")

@@ -225,3 +225,52 @@ def serve_hub(api, dashboard, jwa, *, host: str = "127.0.0.1",
         central_hub(api, dashboard, jwa), host=host, port=port,
         user_id_header=user_id_header,
     ).start()
+
+
+def main(argv=None) -> int:
+    """Hub pod entrypoint: pages + workgroup + spawner APIs against a
+    cluster backend. The trusted identity header is only trustworthy when
+    a gatekeeper AuthProxy fronts this server (the emitted K8s manifests
+    run one as a sidecar; the hub itself binds localhost there)."""
+    import argparse
+
+    from kubeflow_tpu.controlplane.kfam import AccessManagement
+    from kubeflow_tpu.controlplane.runtime.backend import (
+        add_backend_args,
+        build_backend,
+        serve_forever,
+    )
+    from kubeflow_tpu.utils.monitoring import MetricsRegistry
+    from kubeflow_tpu.webapps.dashboard import DashboardApi
+    from kubeflow_tpu.webapps.jwa import NotebookWebApp
+
+    p = argparse.ArgumentParser(prog="kftpu-hub")
+    add_backend_args(p)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8082)
+    p.add_argument("--metrics-port", type=int, default=9091,
+                   help="-1 disables the metrics endpoint")
+    p.add_argument("--user-id-header",
+                   default="x-goog-authenticated-user-email")
+    args = p.parse_args(argv)
+
+    api = build_backend(args)
+    registry = MetricsRegistry()
+    am = AccessManagement(api, registry,
+                          user_id_header=args.user_id_header)
+    jwa = NotebookWebApp(api, registry, user_id_header=args.user_id_header)
+    dashboard = DashboardApi(am)
+    server = serve_hub(api, dashboard, jwa, host=args.host, port=args.port,
+                       user_id_header=args.user_id_header)
+    metrics = None
+    if args.metrics_port >= 0:
+        from kubeflow_tpu.utils.monitoring import MetricsHttpServer
+
+        metrics = MetricsHttpServer(registry, args.metrics_port)
+    serve_forever(server.stop,
+                  (metrics.stop if metrics is not None else (lambda: None)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

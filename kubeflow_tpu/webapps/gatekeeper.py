@@ -300,3 +300,64 @@ def _parse_cookies(header: str) -> Dict[str, str]:
         if name:
             out[name] = value
     return out
+
+
+def main(argv=None) -> int:
+    """Gatekeeper pod/sidecar entrypoint: authenticate, then forward to the
+    upstream app with the trusted identity header injected. Credentials
+    come from a mounted secret file of ``username:password`` lines
+    (--users-file) — the reference's flag/secret pair (AuthServer.go)."""
+    import argparse
+
+    from kubeflow_tpu.controlplane.runtime.backend import serve_forever
+
+    p = argparse.ArgumentParser(prog="kftpu-gatekeeper")
+    p.add_argument("--users-file", required=True)
+    p.add_argument("--session-secret-file", default="",
+                   help="HMAC key for session cookies; REQUIRED for "
+                        "multi-replica or restart-surviving sessions "
+                        "(without it each process mints a random key and "
+                        "other replicas/restarts reject its cookies)")
+    p.add_argument("--upstream-host", default="127.0.0.1")
+    p.add_argument("--upstream-port", type=int, required=True)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8081)
+    p.add_argument("--user-domain", default="")
+    p.add_argument("--user-id-header",
+                   default="x-goog-authenticated-user-email")
+    args = p.parse_args(argv)
+
+    users = {}
+    with open(args.users_file) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#") or ":" not in line:
+                continue
+            u, pw = line.split(":", 1)
+            users[u] = pw
+    if not users:
+        raise SystemExit(f"no credentials in {args.users_file!r}")
+    signer = None
+    if args.session_secret_file:
+        with open(args.session_secret_file, "rb") as f:
+            signer = SessionSigner(secret=f.read().strip())
+    else:
+        log.warning(
+            "no --session-secret-file: session cookies will not survive "
+            "restarts and cannot be shared across replicas"
+        )
+    gk = Gatekeeper(users, user_domain=args.user_domain, signer=signer)
+    proxy = AuthProxy(
+        gk, args.upstream_port, upstream_host=args.upstream_host,
+        user_id_header=args.user_id_header, host=args.host, port=args.port,
+    )
+    proxy.start()
+    log.info("gatekeeper up", kv={"port": proxy.port,
+                                  "upstream": args.upstream_port,
+                                  "users": len(users)})
+    serve_forever(proxy.stop)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -171,3 +171,76 @@ class TestLoginPage:
         finally:
             proxy.stop()
             upstream.stop()
+
+
+class TestGatekeeperMain:
+    def test_sidecar_entrypoint_full_flow(self, tmp_path):
+        """Users file -> proxy -> login -> authenticated upstream request
+        with the injected identity header (the manifest sidecar's exact
+        wiring)."""
+        import json as _json
+        import threading
+        import time
+        import urllib.request
+
+        from kubeflow_tpu.webapps.gatekeeper import main as gk_main
+        from kubeflow_tpu.webapps.router import JsonHttpServer, Router
+
+        upstream_router = Router()
+        upstream_router.get("/api/whoami-up",
+                            lambda q: {"caller": q.caller})
+        upstream = JsonHttpServer(upstream_router).start()
+
+        users = tmp_path / "users"
+        users.write_text("# comment\nalice:s3cret\n")
+        secret = tmp_path / "session.key"
+        secret.write_bytes(b"0" * 32)
+        import socket
+
+        s = socket.socket(); s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]; s.close()
+        t = threading.Thread(target=gk_main, args=([
+            "--users-file", str(users),
+            "--session-secret-file", str(secret),
+            "--upstream-port", str(upstream.port),
+            "--host", "127.0.0.1", "--port", str(port),
+            "--user-domain", "corp.example",
+        ],), daemon=True)
+        t.start()
+        base = f"http://127.0.0.1:{port}"
+        # Poll for readiness instead of a fixed sleep (loaded CI hosts).
+        deadline = time.time() + 15
+        while True:
+            try:
+                urllib.request.urlopen(f"{base}/kflogin", timeout=1)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.1)
+
+        # Unauthenticated: bounced to login, not forwarded.
+        class NoRedirect(urllib.request.HTTPRedirectHandler):
+            def redirect_request(self, *a, **k):
+                return None
+
+        opener = urllib.request.build_opener(NoRedirect)
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            opener.open(f"{base}/api/whoami-up")
+        assert e.value.code == 302
+
+        # Basic-auth flow reaches the upstream with identity injected.
+        import base64
+
+        req = urllib.request.Request(
+            f"{base}/api/whoami-up",
+            headers={"Authorization": "Basic "
+                     + base64.b64encode(b"alice:s3cret").decode(),
+                     # Forged client copy must be stripped.
+                     "x-goog-authenticated-user-email": "evil@corp"},
+        )
+        out = _json.load(urllib.request.urlopen(req))
+        assert out["caller"] == "alice@corp.example"
+        upstream.stop()

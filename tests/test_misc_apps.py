@@ -178,3 +178,63 @@ class TestRelease:
         assert bump_version("minor", str(vf)) == "1.3.0"
         assert bump_version("major", str(vf)) == "2.0.0"
         assert vf.read_text() == '__version__ = "2.0.0"\n'
+
+
+class TestK8sManifests:
+    def test_manifests_cover_controlplane_and_hub(self):
+        from kubeflow_tpu.tools.release import build_k8s_manifests
+
+        docs = build_k8s_manifests("v9.9.9")
+        kinds = [d["kind"] for d in docs]
+        assert kinds.count("Deployment") == 2
+        assert kinds.count("ServiceAccount") == 2
+        deps = {d["metadata"]["name"]: d for d in docs
+                if d["kind"] == "Deployment"}
+        cp = deps["controlplane"]["spec"]["template"]["spec"]["containers"][0]
+        assert cp["image"].endswith(":v9.9.9")
+        assert "kubeflow_tpu.controlplane.main" in cp["command"]
+
+    def test_hub_is_behind_gatekeeper_sidecar(self):
+        """The hub must not be reachable except through the auth proxy:
+        the Service targets the gatekeeper port, and the hub container
+        binds localhost (a direct hub Service would make the spoofable
+        identity header full authentication)."""
+        from kubeflow_tpu.tools.release import build_k8s_manifests
+
+        docs = build_k8s_manifests("v1.0.0")
+        hub = next(d for d in docs if d["kind"] == "Deployment"
+                   and d["metadata"]["name"] == "hub")
+        containers = {c["name"]: c
+                      for c in hub["spec"]["template"]["spec"]["containers"]}
+        assert set(containers) == {"gatekeeper", "hub"}
+        assert "127.0.0.1" in containers["hub"]["command"]
+        svc = next(d for d in docs if d["kind"] == "Service"
+                   and d["metadata"]["name"] == "hub")
+        assert svc["spec"]["ports"][0]["targetPort"] == 8081  # gatekeeper
+
+    def test_no_cluster_admin_and_scoped_roles(self):
+        from kubeflow_tpu.tools.release import build_k8s_manifests
+
+        docs = build_k8s_manifests("v1.0.0")
+        import json as _json
+
+        assert "cluster-admin" not in _json.dumps(docs)
+        roles = {d["metadata"]["name"]: d for d in docs
+                 if d["kind"] == "ClusterRole"}
+        assert set(roles) == {"kubeflow-tpu-controlplane",
+                              "kubeflow-tpu-hub"}
+        hub_verbs = {v for rule in roles["kubeflow-tpu-hub"]["rules"]
+                     for v in rule["verbs"]}
+        assert "*" not in hub_verbs
+        # Hub SA differs from controller SA.
+        deps = {d["metadata"]["name"]: d for d in docs
+                if d["kind"] == "Deployment"}
+        assert deps["hub"]["spec"]["template"]["spec"][
+            "serviceAccountName"] == "kubeflow-tpu-hub"
+
+    def test_cli_emits_yaml(self, capsys):
+        from kubeflow_tpu.tools.release import main as release
+
+        assert release(["manifest", "--k8s", "--tag", "v1.0.0"]) == 0
+        out = capsys.readouterr().out
+        assert "kind: Deployment" in out and ":v1.0.0" in out
