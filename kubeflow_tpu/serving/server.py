@@ -201,6 +201,12 @@ def env_config() -> dict:
 def build_server(cfg: dict) -> ServingServer:
     import jax
 
+    # Same contract as train.runner: local/e2e deployments force a backend
+    # (site-installed TPU plugins override JAX_PLATFORMS; config wins).
+    plat = os.environ.get("KFTPU_PLATFORM", "")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
     from kubeflow_tpu.models import get_model
     from kubeflow_tpu.topology import AxisSpec, make_host_local_mesh
 

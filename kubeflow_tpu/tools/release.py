@@ -153,6 +153,47 @@ def build_k8s_manifests(tag: str = "") -> list:
         }
 
     crd_resources = [plural for _, plural, _ in crd_kinds]
+
+    # The user-facing roles every Profile RoleBinding references
+    # (profile.py namespaceAdmin/default-editor/viewer, kfam ROLE_MAP).
+    # They must exist in the deploy or bindings dangle and grant nothing.
+    user_roles = {
+        "kubeflow-view": [
+            {"apiGroups": ["tpu.kubeflow.org"],
+             "resources": crd_resources,
+             "verbs": ["get", "list", "watch"]},
+            {"apiGroups": [""],
+             "resources": ["pods", "pods/log", "services", "events"],
+             "verbs": ["get", "list", "watch"]},
+        ],
+        "kubeflow-edit": [
+            {"apiGroups": ["tpu.kubeflow.org"],
+             "resources": crd_resources, "verbs": ["*"]},
+            {"apiGroups": [""],
+             "resources": ["pods", "pods/log", "services", "events"],
+             "verbs": ["get", "list", "watch"]},
+        ],
+        "kubeflow-admin": [
+            {"apiGroups": ["tpu.kubeflow.org"],
+             "resources": crd_resources, "verbs": ["*"]},
+            {"apiGroups": [""],
+             "resources": ["pods", "pods/log", "services", "events",
+                           "resourcequotas"],
+             "verbs": ["get", "list", "watch"]},
+            {"apiGroups": ["rbac.authorization.k8s.io"],
+             "resources": ["rolebindings"],
+             "verbs": ["get", "list", "watch"]},
+        ],
+    }
+    # RBAC escalation prevention: an SA may only create a RoleBinding to a
+    # role it could itself bind — grant the explicit `bind` verb on the
+    # user roles to the two SAs that create such bindings.
+    bind_user_roles_rule = {
+        "apiGroups": ["rbac.authorization.k8s.io"],
+        "resources": ["clusterroles"],
+        "verbs": ["bind"],
+        "resourceNames": sorted(user_roles),
+    }
     controlplane_rules = [
         {"apiGroups": ["tpu.kubeflow.org"],
          "resources": crd_resources + [f"{r}/status" for r in crd_resources],
@@ -185,13 +226,16 @@ def build_k8s_manifests(tag: str = "") -> list:
         {"apiGroups": ["security.istio.io"],
          "resources": ["authorizationpolicies"],
          "verbs": ["get", "list", "create", "update", "delete"]},
+        bind_user_roles_rule,
     ]
+    controlplane_rules.append(bind_user_roles_rule)
 
     gatekeeper_sidecar = {
         "name": "gatekeeper",
         "image": cp_image,
         "command": ["python", "-m", "kubeflow_tpu.webapps.gatekeeper",
                     "--users-file", "/etc/gatekeeper/users",
+                    "--session-secret-file", "/etc/gatekeeper/session-key",
                     "--upstream-port", "8082", "--port", "8081"],
         "ports": [{"containerPort": 8081}],
         "volumeMounts": [{"name": "gatekeeper-users",
@@ -213,8 +257,20 @@ def build_k8s_manifests(tag: str = "") -> list:
         *[crd(k, p, s) for k, p, s in crd_kinds],
         sa("kubeflow-tpu-controlplane"),
         sa("kubeflow-tpu-hub"),
+        *[cluster_role(name, rules)
+          for name, rules in sorted(user_roles.items())],
         cluster_role("kubeflow-tpu-controlplane", controlplane_rules),
         cluster_role("kubeflow-tpu-hub", hub_rules),
+        # Bootstrap credentials + session key the gatekeeper mounts. The
+        # password is a MUST-CHANGE placeholder: gatekeeper.main refuses
+        # to start while any password is 'changeme'.
+        {"apiVersion": "v1", "kind": "Secret",
+         "metadata": {"name": "gatekeeper-users", "namespace": ns},
+         "stringData": {
+             "users": "# username:password per line — CHANGE BEFORE USE\n"
+                      "admin:changeme\n",
+             "session-key": "CHANGE-ME-32-BYTE-RANDOM-SESSION-KEY",
+         }},
         binding("kubeflow-tpu-controlplane", "kubeflow-tpu-controlplane",
                 "kubeflow-tpu-controlplane"),
         binding("kubeflow-tpu-hub", "kubeflow-tpu-hub", "kubeflow-tpu-hub"),

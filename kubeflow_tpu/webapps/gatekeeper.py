@@ -337,6 +337,15 @@ def main(argv=None) -> int:
             users[u] = pw
     if not users:
         raise SystemExit(f"no credentials in {args.users_file!r}")
+    placeholders = [u for u, pw in users.items() if pw == "changeme"]
+    if placeholders:
+        # The shipped manifests carry a must-change bootstrap secret;
+        # refusing to serve with it beats running an "authenticated"
+        # platform whose password is public.
+        raise SystemExit(
+            f"placeholder password for {placeholders!r} in "
+            f"{args.users_file!r} — change it before starting the gatekeeper"
+        )
     signer = None
     if args.session_secret_file:
         with open(args.session_secret_file, "rb") as f:

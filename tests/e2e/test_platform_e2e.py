@@ -198,3 +198,137 @@ class TestGangE2E:
         assert "auto-resumed" in w0_log, w0_log[-2000:]
         assert job2.status.metrics.get("steps") == 12
         kubelet.shutdown()
+
+
+class TestHpoE2E:
+    """StudyJob whose trials are REAL single-process runner gangs: the full
+    HPO platform path (suggest -> TpuJob -> process -> termination metrics
+    -> objective aggregation) with actual training."""
+
+    def test_study_with_real_trials(self, tmp_path):
+        from kubeflow_tpu.controlplane.api.types import (
+            StudyJob,
+            StudyJobSpec,
+            TpuJobSpec,
+        )
+        from kubeflow_tpu.controlplane.controllers import StudyJobController
+        from kubeflow_tpu.hpo.space import ParameterSpec
+
+        api = InMemoryApiServer()
+        reg = MetricsRegistry()
+        mgr = ControllerManager(api)
+        mgr.register(TpuJobController(api, reg))
+        mgr.register(StudyJobController(api, reg))
+        kubelet = ProcessKubelet(
+            api, reg,
+            env_overrides=lambda pod: {
+                "KFTPU_PLATFORM": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                "JAX_PLATFORMS": "",
+            },
+            log_dir=str(tmp_path / "podlogs"),
+        )
+        mgr.register(kubelet)
+
+        api.create(StudyJob(
+            metadata=ObjectMeta(name="sweep", namespace="team-a"),
+            spec=StudyJobSpec(
+                objective="loss", direction="minimize",
+                algorithm="random", max_trials=2, parallel_trials=2,
+                parameters=[ParameterSpec(
+                    name="learning_rate", min=1e-4, max=1e-2,
+                    log_scale=True,
+                )],
+                trial=TpuJobSpec(
+                    slice_type="v5e-8",       # single host -> one process
+                    model="llama-tiny",
+                    mesh=MeshAxesSpec(dp=-1),
+                    max_restarts=0,
+                    env=[
+                        EnvVar("KFTPU_TRAIN_STEPS", "2"),
+                        EnvVar("KFTPU_BATCH_PER_HOST", "2"),
+                        EnvVar("KFTPU_SEQ_LEN", "16"),
+                    ],
+                ),
+            ),
+        ))
+
+        t0 = time.time()
+        while time.time() - t0 < E2E_TIMEOUT:
+            mgr.run_until_idle(include_timers_within=1.0)
+            kubelet.sync()
+            mgr.run_until_idle(include_timers_within=1.0)
+            study = api.get("StudyJob", "sweep", "team-a")
+            if study.status.condition in ("Completed", "Failed"):
+                break
+            time.sleep(0.3)
+        kubelet.shutdown()
+        assert study.status.condition == "Completed", study.status
+        assert study.status.trials_completed == 2
+        # Real losses flowed back as objectives.
+        assert study.status.best_objective is not None
+        assert study.status.best_objective > 0
+        assert "learning_rate" in study.status.best_parameters
+
+
+class TestServingE2E:
+    """Serving CR whose pod is a REAL serving.server process: deploy ->
+    wait ready -> query generate over HTTP -> delete (the reference's
+    test_tf_serving.py lifecycle with an actual server)."""
+
+    def test_deploy_query_real_server(self, tmp_path):
+        import urllib.request
+
+        from kubeflow_tpu.controlplane.api import Serving, ServingSpec
+        from kubeflow_tpu.controlplane.controllers import ServingController
+
+        api = InMemoryApiServer()
+        reg = MetricsRegistry()
+        mgr = ControllerManager(api)
+        mgr.register(ServingController(api, reg))
+        port = _free_port()
+        kubelet = ProcessKubelet(
+            api, reg,
+            env_overrides=lambda pod: {
+                "KFTPU_PLATFORM": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                "JAX_PLATFORMS": "",
+                "KFTPU_SERVING_HOST": "127.0.0.1",
+            },
+            log_dir=str(tmp_path / "podlogs"),
+        )
+        mgr.register(kubelet)
+
+        api.create(Serving(
+            metadata=ObjectMeta(name="llm", namespace="team-a"),
+            spec=ServingSpec(
+                model="llama-tiny", slice_type="v5e-8",
+                max_batch=2, max_len=64, decode_chunk=2, port=port,
+            ),
+        ))
+        mgr.run_until_idle()
+        sv = api.get("Serving", "llm", "team-a")
+        assert sv.status.ready  # pod Running (process spawned)
+
+        base = f"http://127.0.0.1:{port}"
+        deadline = time.time() + E2E_TIMEOUT
+        health = None
+        while time.time() < deadline:
+            kubelet.sync()
+            try:
+                health = json.load(urllib.request.urlopen(
+                    f"{base}/healthz", timeout=2))
+                break
+            except OSError:
+                time.sleep(0.5)
+        assert health and health["ok"], health
+
+        req = urllib.request.Request(
+            f"{base}/v1/generate",
+            data=json.dumps({"tokens": [3, 5, 7],
+                             "max_new_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        out = json.load(urllib.request.urlopen(req, timeout=60))
+        assert len(out["tokens"]) == 4
+        kubelet.shutdown()

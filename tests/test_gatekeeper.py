@@ -244,3 +244,11 @@ class TestGatekeeperMain:
         out = _json.load(urllib.request.urlopen(req))
         assert out["caller"] == "alice@corp.example"
         upstream.stop()
+
+    def test_placeholder_password_refused(self, tmp_path):
+        from kubeflow_tpu.webapps.gatekeeper import main as gk_main
+
+        users = tmp_path / "users"
+        users.write_text("admin:changeme\n")
+        with pytest.raises(SystemExit, match="placeholder"):
+            gk_main(["--users-file", str(users), "--upstream-port", "1"])

@@ -221,8 +221,8 @@ class TestK8sManifests:
         assert "cluster-admin" not in _json.dumps(docs)
         roles = {d["metadata"]["name"]: d for d in docs
                  if d["kind"] == "ClusterRole"}
-        assert set(roles) == {"kubeflow-tpu-controlplane",
-                              "kubeflow-tpu-hub"}
+        assert {"kubeflow-tpu-controlplane",
+                "kubeflow-tpu-hub"} <= set(roles)
         hub_verbs = {v for rule in roles["kubeflow-tpu-hub"]["rules"]
                      for v in rule["verbs"]}
         assert "*" not in hub_verbs
@@ -238,3 +238,31 @@ class TestK8sManifests:
         assert release(["manifest", "--k8s", "--tag", "v1.0.0"]) == 0
         out = capsys.readouterr().out
         assert "kind: Deployment" in out and ":v1.0.0" in out
+
+    def test_fresh_cluster_completeness(self):
+        """Everything a clean-cluster apply needs: CRDs for all kinds, the
+        user roles Profile bindings reference, the bind verb that RBAC
+        escalation prevention demands, and the gatekeeper secret (with a
+        session key and a refused-by-default placeholder password)."""
+        import json as _json
+
+        from kubeflow_tpu.tools.release import build_k8s_manifests
+
+        docs = build_k8s_manifests("v1.0.0")
+        crds = [d for d in docs if d["kind"] == "CustomResourceDefinition"]
+        assert len(crds) == 8
+        assert {c["spec"]["names"]["kind"] for c in crds} >= {
+            "TpuJob", "Profile", "Serving", "StudyJob"}
+        roles = {d["metadata"]["name"] for d in docs
+                 if d["kind"] == "ClusterRole"}
+        assert {"kubeflow-admin", "kubeflow-edit", "kubeflow-view"} <= roles
+        blob = _json.dumps(docs)
+        assert '"bind"' in blob
+        secrets = [d for d in docs if d["kind"] == "Secret"]
+        assert len(secrets) == 1
+        assert "session-key" in secrets[0]["stringData"]
+        hub = next(d for d in docs if d["kind"] == "Deployment"
+                   and d["metadata"]["name"] == "hub")
+        gk = next(c for c in hub["spec"]["template"]["spec"]["containers"]
+                  if c["name"] == "gatekeeper")
+        assert "--session-secret-file" in gk["command"]
