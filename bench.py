@@ -162,7 +162,9 @@ def bench_serving(args) -> None:
         rng.integers(1, cfg.vocab_size, size=args.prompt_len).tolist()
         for _ in range(args.requests)
     ]
-    # Warmup: compile the real prompt bucket's prefill + the decode chunk.
+    # Warmup: AOT-compile every prefill k-variant + the decode chunk, then
+    # one real round so device buffers exist.
+    engine.warmup(args.prompt_len)
     engine.submit(prompts[0], max_new_tokens=args.decode_chunk + 1)
     engine.run()
 

@@ -127,7 +127,7 @@ class ServingEngine:
         self.params = self._place_params(params)
         self._cache = self._init_cache()
         self._decode_fn = jax.jit(self._decode_step, donate_argnums=(1,))
-        self._prefill_fns: Dict[int, object] = {}
+        self._prefill_fns: Dict[tuple, object] = {}  # (bucket, k) -> jit
         self.tokens_generated = 0
 
     # ------------- sharding -------------
@@ -262,6 +262,51 @@ class ServingEngine:
     def queued(self) -> int:
         return len(self._queue)
 
+    def warmup(self, prompt_len: int) -> None:
+        """Ahead-of-time compile the decode step and every k-bucket prefill
+        variant for ``prompt_len``'s bucket. Without this, the first
+        admission burst of each size pays its XLA compile mid-serving
+        (multi-second TTFT spikes; dominated one whole bench run)."""
+        bucket = self._bucket(prompt_len)
+        pa = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding),
+            self.params,
+        )
+        ca = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding),
+            self._cache,
+        )
+        with self._mesh_ctx():
+            k = 1
+            ks = []
+            while k < self.cfg.max_batch:
+                ks.append(k)
+                k *= 2
+            ks.append(self.cfg.max_batch)   # the _k_pad cap (may be non-pow2)
+            for k in ks:
+                fn = self._prefill_fns.setdefault(
+                    (bucket, k),
+                    jax.jit(self._prefill_step, donate_argnums=(1,)),
+                )
+                fn.lower(
+                    pa, ca,
+                    jax.ShapeDtypeStruct((k, bucket), jnp.int32),
+                    jax.ShapeDtypeStruct((k,), jnp.int32),
+                    jax.ShapeDtypeStruct((k,), jnp.int32),
+                    jax.ShapeDtypeStruct(self._rng.shape, self._rng.dtype),
+                    jax.ShapeDtypeStruct((k,), jnp.float32),
+                ).compile()
+            B = self.cfg.max_batch
+            self._decode_fn.lower(
+                pa, ca,
+                jax.ShapeDtypeStruct((B, 1), jnp.int32),
+                jax.ShapeDtypeStruct((B, 1), jnp.int32),
+                jax.ShapeDtypeStruct(self._rng.shape, self._rng.dtype),
+                jax.ShapeDtypeStruct((B,), jnp.float32),
+            ).compile()
+
     # ------------- internals -------------
 
     def _bucket(self, n: int) -> int:
@@ -274,73 +319,123 @@ class ServingEngine:
         )
 
     def _admit(self) -> None:
+        # Gather every admissible request, group by prompt bucket, and
+        # prefill each group in ONE dispatch (k rows padded to a small set
+        # of k-buckets so compile count stays bounded). Under load this
+        # collapses up-to-max_batch host->device round trips into one —
+        # the dominant prefill cost through a remote/tunneled TPU.
+        admissions: List[tuple] = []   # (slot_idx, req)
         for i, slot in enumerate(self._slots):
             if slot is not None or not self._queue:
                 continue
             req = self._queue.popleft()
             self._slots[i] = _Slot(req)
-            self._prefill(i, req)
+            admissions.append((i, req))
+        by_bucket: Dict[int, List[tuple]] = {}
+        for i, req in admissions:
+            by_bucket.setdefault(self._bucket(len(req.prompt)), []).append(
+                (i, req)
+            )
+        for bucket, group in sorted(by_bucket.items()):
+            self._prefill_group(bucket, group)
 
-    def _prefill_step(self, params, cache, tokens, length, slot_idx):
-        """Whole prefill as one program: run the [1, bucket] padded prompt
-        against a fresh zero cache row, then install the row into the donated
-        batched cache at ``slot_idx``. Pad tokens beyond ``length`` do reach
-        the row (static shapes), but its cache_index is set to ``length``, so
-        the junk K/V rows sit beyond the index, get overwritten by subsequent
-        decodes, and stay causally masked until then."""
+    def _k_pad(self, n: int) -> int:
+        """Pad group size to a power of two (1,2,4,8,...), capped at
+        max_batch: bounded compiles (exactly the set warmup precompiles),
+        at most 2x wasted prefill rows."""
+        k = 1
+        while k < n:
+            k *= 2
+        return min(k, self.cfg.max_batch)
 
-        def fresh_row(leaf):
+    def _prefill_step(self, params, cache, tokens, lengths, slot_idxs,
+                      rng, temps):
+        """Whole group prefill as one program: run the [k, bucket] padded
+        prompts against fresh zero cache rows, then scatter the rows into
+        the donated batched cache at ``slot_idxs``. Pad tokens beyond each
+        row's length do reach the rows (static shapes), but cache_index is
+        set to the true length, so junk K/V sits beyond the index, gets
+        overwritten by later decodes, and stays causally masked until then.
+        Duplicate slot_idxs (k-padding repeats row 0) are safe: identical
+        rows scatter identical content."""
+        k = tokens.shape[0]
+
+        def fresh_rows(leaf):
             if leaf.dtype == jnp.int32:           # [.., B] index
-                return jnp.zeros(leaf.shape[:-1] + (1,), jnp.int32)
+                return jnp.zeros(leaf.shape[:-1] + (k,), jnp.int32)
             return jnp.zeros(                      # [.., B, S, H, D]
-                leaf.shape[:-4] + (1,) + leaf.shape[-3:], leaf.dtype
+                leaf.shape[:-4] + (k,) + leaf.shape[-3:], leaf.dtype
             )
 
-        row = jax.tree.map(fresh_row, cache)
-        positions = jnp.arange(tokens.shape[1])[None, :]
+        rows = jax.tree.map(fresh_rows, cache)
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1]), tokens.shape
+        )
         with self._pctx():
             logits, mut = self.model.apply(
-                {"params": params["params"], "cache": row}, tokens,
+                {"params": params["params"], "cache": rows}, tokens,
                 positions=positions, decode=True, mutable=["cache"],
             )
-        new_row = jax.tree.map(
-            lambda x: jnp.full_like(x, length) if x.dtype == jnp.int32 else x,
+        new_rows = jax.tree.map(
+            lambda x: jnp.broadcast_to(
+                lengths, x.shape
+            ).astype(jnp.int32) if x.dtype == jnp.int32 else x,
             mut["cache"],
         )
 
         def install(batch_leaf, row_leaf):
-            if batch_leaf.dtype == jnp.int32:
-                return jax.lax.dynamic_update_index_in_dim(
-                    batch_leaf, row_leaf[..., 0], slot_idx,
-                    axis=batch_leaf.ndim - 1,
+            if batch_leaf.dtype == jnp.int32:      # [.., B]
+                return batch_leaf.at[..., slot_idxs].set(
+                    row_leaf[..., jnp.arange(k)]
                 )
-            return jax.lax.dynamic_update_slice_in_dim(
-                batch_leaf, row_leaf, slot_idx, axis=batch_leaf.ndim - 4
-            )
+            # [.., B, S, H, D]: scatter rows along the batch axis in place
+            # (moveaxis round-trips would transpose the whole multi-100MB
+            # cache twice per prefill).
+            return batch_leaf.at[..., slot_idxs, :, :, :].set(row_leaf)
 
-        cache = jax.tree.map(install, cache, new_row)
-        last_logits = logits[0, length - 1]
-        return last_logits, cache
+        cache = jax.tree.map(install, cache, new_rows)
+        last_logits = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None], axis=1
+        )[:, 0]                                   # [k, V]
+        # Sample on device (same scheme as decode): ONE k-int transfer to
+        # host instead of per-row slice+argmax round trips.
+        toks = self._sample_logits(last_logits.astype(jnp.float32),
+                                   rng, temps)
+        return toks, cache
 
-    def _prefill(self, slot_idx: int, req: GenerationRequest) -> None:
-        bucket = self._bucket(len(req.prompt))
-        if bucket not in self._prefill_fns:
-            self._prefill_fns[bucket] = jax.jit(
+    def _prefill_group(self, bucket: int, group: List[tuple]) -> None:
+        k = self._k_pad(len(group))
+        if (bucket, k) not in self._prefill_fns:
+            self._prefill_fns[(bucket, k)] = jax.jit(
                 self._prefill_step, donate_argnums=(1,)
             )
-        fn = self._prefill_fns[bucket]
+        fn = self._prefill_fns[(bucket, k)]
 
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, : len(req.prompt)] = req.prompt
+        tokens = np.zeros((k, bucket), np.int32)
+        lengths = np.zeros((k,), np.int32)
+        slot_idxs = np.zeros((k,), np.int32)
+        temps = np.zeros((k,), np.float32)
+        for row, (i, req) in enumerate(group):
+            tokens[row, : len(req.prompt)] = req.prompt
+            lengths[row] = len(req.prompt)
+            slot_idxs[row] = i
+            temps[row] = req.temperature
+        for row in range(len(group), k):          # pad: repeat row 0
+            tokens[row] = tokens[0]
+            lengths[row] = lengths[0]
+            slot_idxs[row] = slot_idxs[0]
+            temps[row] = temps[0]
+        self._rng, sub = jax.random.split(self._rng)
         with self._mesh_ctx():
-            last_logits, self._cache = fn(
+            toks, self._cache = fn(
                 self.params, self._cache, jnp.asarray(tokens),
-                jnp.asarray(len(req.prompt), jnp.int32),
-                jnp.asarray(slot_idx, jnp.int32),
+                jnp.asarray(lengths), jnp.asarray(slot_idxs),
+                sub, jnp.asarray(temps),
             )
-        # First generated token comes from the prefill's last logits.
-        tok = self._sample_host(last_logits, req.temperature)
-        self._record_token(slot_idx, int(tok))
+        toks = np.asarray(toks)
+        # First generated token per request from its prefill logits.
+        for row, (i, req) in enumerate(group):
+            self._record_token(i, int(toks[row]))
 
     def _sample_logits(self, logits, rng, temps):
         greedy = jnp.argmax(logits, axis=-1)
@@ -400,13 +495,6 @@ class ServingEngine:
                 # A slot freed earlier in this chunk ignores its speculative
                 # tail; the row is re-prefilled at next admission.
                 self._record_token(i, int(toks[i, k]))
-
-    def _sample_host(self, logits: jax.Array, temperature: float) -> int:
-        if temperature <= 0:
-            return int(jnp.argmax(logits))
-        self._rng, sub = jax.random.split(self._rng)
-        g = jax.random.gumbel(sub, logits.shape)
-        return int(jnp.argmax(logits / temperature + g))
 
     def _record_token(self, slot_idx: int, token: int) -> None:
         slot = self._slots[slot_idx]

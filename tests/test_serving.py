@@ -385,3 +385,52 @@ class TestTrainServeHandoff:
                            str(tmp_path / "empty"))
         with pytest.raises(RuntimeError, match="no checkpoint"):
             build_server(env_config())
+
+
+class TestBatchedPrefill:
+    def test_group_admission_single_dispatch(self, model_and_params):
+        """Simultaneous same-bucket admissions prefill in one compiled call
+        (k-padded), and produce the same tokens as solo runs."""
+        model, params = model_and_params
+        prompts = [[1, 2, 3], [4, 5, 6, 7], [8, 9]]
+        solo = []
+        for p in prompts:
+            ref = ServingEngine(model, params,
+                                ServingConfig(max_batch=1, max_len=128))
+            ref.submit(p, max_new_tokens=5)
+            solo.append(ref.run()[0].tokens)
+
+        eng = ServingEngine(model, params,
+                            ServingConfig(max_batch=4, max_len=128))
+        rids = [eng.submit(p, max_new_tokens=5) for p in prompts]
+        eng.run()
+        assert [eng.result(r).tokens for r in rids] == solo
+        # 3 admissions pad to one k=4 group on the 32-token bucket: exactly
+        # one prefill program, compiled once.
+        assert set(eng._prefill_fns) == {(32, 4)}
+        assert eng._prefill_fns[(32, 4)]._cache_size() == 1
+
+    def test_mixed_buckets_group_separately(self, model_and_params):
+        model, params = model_and_params
+        eng = ServingEngine(model, params,
+                            ServingConfig(max_batch=4, max_len=128))
+        rids = [eng.submit([1] * 5, max_new_tokens=3),
+                eng.submit([2] * 40, max_new_tokens=3)]
+        eng.run()
+        assert {(32, 1), (64, 1)} == set(eng._prefill_fns)
+        assert all(len(eng.result(r).tokens) == 3 for r in rids)
+
+    def test_non_pow2_max_batch_k_capped(self, model_and_params):
+        """max_batch 6: a 6-admission burst must pad to k=6 (the warmup-
+        compiled cap), never to an uncompiled k=8 beyond the slot count."""
+        model, params = model_and_params
+        eng = ServingEngine(model, params,
+                            ServingConfig(max_batch=6, max_len=128))
+        eng.warmup(8)
+        assert {k for (_, k) in eng._prefill_fns} == {1, 2, 4, 6}
+        rids = [eng.submit([i + 1, i + 2], max_new_tokens=2)
+                for i in range(6)]
+        eng.run()
+        assert all(len(eng.result(r).tokens) == 2 for r in rids)
+        assert (32, 6) in eng._prefill_fns
+        assert not any(k > 6 for (_, k) in eng._prefill_fns)
