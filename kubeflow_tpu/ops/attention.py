@@ -44,11 +44,36 @@ def mha_reference(
     _, Skv, Hkv, _ = k.shape
     if H % Hkv != 0:
         raise ValueError(f"query heads {H} not a multiple of kv heads {Hkv}")
-    if Hkv != H:
-        rep = H // Hkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
     scale = (D ** -0.5) if scale is None else scale
+
+    if Hkv != H:
+        # Grouped GQA: fold the query group into the einsum instead of
+        # jnp.repeat-ing K/V — repetition would materialise the repeated
+        # cache every call (for a serving decode step that is GBs of HBM
+        # traffic per token; the cache must be read once, not copied).
+        G = H // Hkv
+        qg = q.reshape(B, Sq, Hkv, G, D)
+        logits = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            cm = causal_mask(Sq, Skv, q_offset=Skv - Sq)
+            logits = jnp.where(cm[None, None, None, :, :], logits, -jnp.inf)
+        if mask is not None:
+            if mask.ndim == 4 and mask.shape[1] == 1:
+                mg = mask[:, :, None]                  # [B,1,1,Sq,Skv]
+            elif mask.ndim == 4:
+                mg = mask.reshape(B, Hkv, G, *mask.shape[2:])
+            else:
+                mg = mask
+            logits = jnp.where(mg, logits, -jnp.inf)
+        weights = jax.nn.softmax(logits, axis=-1)
+        weights = jnp.where(jnp.isnan(weights), 0.0, weights)
+        out = jnp.einsum(
+            "bhgqk,bkhd->bqhgd", weights.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
+        return out.reshape(B, Sq, H, D).astype(q.dtype)
 
     logits = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32

@@ -39,6 +39,10 @@ DEFAULT_RULES: Rules = (
     ("embed", "fsdp"),
     ("heads", "tp"),
     ("kv_heads", "tp"),
+    # fused-projection inner dims (models/llama.py fused_qkv/fused_gate_up):
+    # tp lives on the kv_heads / mlp axis, the fused grouping dim replicates
+    ("qkv_group", None),
+    ("gate_up", None),
     ("head_dim", None),
     ("mlp", "tp"),
     ("vocab", "tp"),

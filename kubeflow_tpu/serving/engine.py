@@ -76,6 +76,22 @@ class ServingConfig:
     # tunneled TPUs — at the price of admission/EOS checks every chunk
     # (up to chunk-1 wasted speculative tokens per finished sequence).
     decode_chunk: int = 1
+    # Decode dispatches kept in flight by run(). At depth 2 the next chunk
+    # is dispatched BEFORE the previous chunk's tokens are fetched, chained
+    # off the device-resident carry (last-token output slice), so the
+    # host<->device round trip (~100ms through a tunnel) overlaps device
+    # compute instead of serialising with it. Costs up to
+    # (depth-1)*decode_chunk extra speculative tokens per finished
+    # sequence. 1 = fully synchronous.
+    pipeline_depth: int = 2
+
+
+@dataclasses.dataclass
+class _InFlight:
+    """One dispatched-but-undrained decode chunk."""
+    out: jax.Array                       # [B, K] device tokens (future)
+    positions: np.ndarray                # [B, 1] positions at dispatch
+    snapshot: list                       # slot objects active at dispatch
 
 
 class _Slot:
@@ -240,11 +256,34 @@ class ServingEngine:
 
     def run(self) -> List[GenerationResult]:
         """Process until queue and slots drain; returns results in
-        completion order."""
+        completion order. Keeps up to ``pipeline_depth`` decode dispatches
+        in flight (see ServingConfig.pipeline_depth)."""
         order: List[int] = []
         known = set()
-        while self._queue or any(s is not None for s in self._slots):
-            self.step()
+        pending: Deque[_InFlight] = collections.deque()
+        depth = max(1, self.cfg.pipeline_depth)
+        while self._queue or any(s is not None for s in self._slots) \
+                or pending:
+            # Admission is a pipeline flush point: a fresh dispatch takes
+            # its tokens/positions from host-side slot state, which lags by
+            # one chunk per undrained in-flight dispatch, and a chained
+            # dispatch would feed the new slot another request's token
+            # stream. Draining first keeps continuous batching: a slot
+            # freed by a drain is refilled on the next loop iteration, not
+            # after the whole batch finishes.
+            if self._queue and any(s is None for s in self._slots):
+                while pending:
+                    self._drain_decode(pending.popleft())
+                self._admit()
+            while (
+                len(pending) < depth
+                and any(s is not None for s in self._slots)
+            ):
+                pending.append(
+                    self._dispatch_decode(pending[-1] if pending else None)
+                )
+            if pending:
+                self._drain_decode(pending.popleft())
             for rid in self._results:
                 if rid not in known:
                     known.add(rid)
@@ -263,24 +302,20 @@ class ServingEngine:
         return len(self._queue)
 
     def warmup(self, prompt_len: int) -> None:
-        """Ahead-of-time compile the decode step and every k-bucket prefill
-        variant for ``prompt_len``'s bucket. Without this, the first
-        admission burst of each size pays its XLA compile mid-serving
-        (multi-second TTFT spikes; dominated one whole bench run)."""
+        """Compile-and-execute the decode step and every k-bucket prefill
+        variant for ``prompt_len``'s bucket, then reset the cache. Without
+        this, the first admission burst of each size pays its XLA compile
+        mid-serving (multi-second TTFT spikes; dominated one whole bench
+        run).
+
+        Executes the real jitted callables with dummy inputs rather than
+        ``fn.lower(...).compile()`` — an AOT-compiled executable does NOT
+        feed the jit call cache, so the lower/compile form burned compile
+        time and then recompiled everything again on first real use."""
         bucket = self._bucket(prompt_len)
-        pa = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
-                                           sharding=x.sharding),
-            self.params,
-        )
-        ca = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
-                                           sharding=x.sharding),
-            self._cache,
-        )
         with self._mesh_ctx():
-            k = 1
             ks = []
+            k = 1
             while k < self.cfg.max_batch:
                 ks.append(k)
                 k *= 2
@@ -290,22 +325,29 @@ class ServingEngine:
                     (bucket, k),
                     jax.jit(self._prefill_step, donate_argnums=(1,)),
                 )
-                fn.lower(
-                    pa, ca,
-                    jax.ShapeDtypeStruct((k, bucket), jnp.int32),
-                    jax.ShapeDtypeStruct((k,), jnp.int32),
-                    jax.ShapeDtypeStruct((k,), jnp.int32),
-                    jax.ShapeDtypeStruct(self._rng.shape, self._rng.dtype),
-                    jax.ShapeDtypeStruct((k,), jnp.float32),
-                ).compile()
+                self._rng, sub = jax.random.split(self._rng)
+                toks, self._cache = fn(
+                    self.params, self._cache,
+                    jnp.ones((k, bucket), jnp.int32),
+                    jnp.full((k,), bucket, jnp.int32),
+                    jnp.zeros((k,), jnp.int32),
+                    sub,
+                    jnp.zeros((k,), jnp.float32),
+                )
+                toks.block_until_ready()
             B = self.cfg.max_batch
-            self._decode_fn.lower(
-                pa, ca,
-                jax.ShapeDtypeStruct((B, 1), jnp.int32),
-                jax.ShapeDtypeStruct((B, 1), jnp.int32),
-                jax.ShapeDtypeStruct(self._rng.shape, self._rng.dtype),
-                jax.ShapeDtypeStruct((B,), jnp.float32),
-            ).compile()
+            self._rng, sub = jax.random.split(self._rng)
+            toks, self._cache = self._decode_fn(
+                self.params, self._cache,
+                jnp.zeros((B, 1), jnp.int32),
+                jnp.full((B, 1), bucket, jnp.int32),
+                sub,
+                jnp.zeros((B,), jnp.float32),
+            )
+            np.asarray(toks)      # host fetch = reliable sync on remote TPUs
+        # Dummy rows polluted the cache (junk K/V, advanced indices):
+        # rebuild it clean before real traffic.
+        self._cache = self._init_cache()
 
     # ------------- internals -------------
 
@@ -374,7 +416,7 @@ class ServingEngine:
         with self._pctx():
             logits, mut = self.model.apply(
                 {"params": params["params"], "cache": rows}, tokens,
-                positions=positions, decode=True, mutable=["cache"],
+                positions=positions, decode="prefill", mutable=["cache"],
             )
         new_rows = jax.tree.map(
             lambda x: jnp.broadcast_to(
@@ -469,32 +511,55 @@ class ServingEngine:
         )
         return out.T, cache                        # [B, K]
 
-    def _decode_once(self) -> None:
+    def _dispatch_decode(
+        self, chain: Optional["_InFlight"] = None
+    ) -> "_InFlight":
+        """Queue one decode chunk on the device and return the in-flight
+        handle WITHOUT fetching results. When ``chain`` is the previous
+        (undrained) dispatch, the input tokens are its device-resident
+        last-token slice and positions advance by its chunk length — no
+        host round trip between the two dispatches."""
         B = self.cfg.max_batch
-        tokens = np.zeros((B, 1), np.int32)
         positions = np.zeros((B, 1), np.int32)
         temps = np.zeros((B,), np.float32)
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
-            last = (slot.generated or slot.req.prompt)[-1]
-            tokens[i, 0] = last
-            positions[i, 0] = slot.pos
             temps[i] = slot.req.temperature
-        self._rng, sub = jax.random.split(self._rng)
-        with self._mesh_ctx():
-            toks, self._cache = self._decode_fn(
-                self.params, self._cache, jnp.asarray(tokens),
-                jnp.asarray(positions), sub, jnp.asarray(temps),
-            )
-        toks = np.asarray(toks)                    # [B, K]
-        for k in range(toks.shape[1]):
+        if chain is not None:
+            tokens_dev = chain.out[:, -1:]
+            positions = chain.positions + self.cfg.decode_chunk
+        else:
+            tokens = np.zeros((B, 1), np.int32)
             for i, slot in enumerate(self._slots):
                 if slot is None:
                     continue
-                # A slot freed earlier in this chunk ignores its speculative
-                # tail; the row is re-prefilled at next admission.
+                tokens[i, 0] = (slot.generated or slot.req.prompt)[-1]
+                positions[i, 0] = slot.pos
+            tokens_dev = jnp.asarray(tokens)
+        self._rng, sub = jax.random.split(self._rng)
+        with self._mesh_ctx():
+            toks, self._cache = self._decode_fn(
+                self.params, self._cache, tokens_dev,
+                jnp.asarray(positions), sub, jnp.asarray(temps),
+            )
+        return _InFlight(out=toks, positions=positions,
+                         snapshot=list(self._slots))
+
+    def _drain_decode(self, inflight: "_InFlight") -> None:
+        toks = np.asarray(inflight.out)            # [B, K] (blocks here)
+        for k in range(toks.shape[1]):
+            for i, slot in enumerate(self._slots):
+                # Record only for the slot objects that were active at
+                # dispatch time AND still occupy their slot: a slot freed
+                # (and possibly re-admitted) mid-pipeline must not receive
+                # another request's speculative tail.
+                if slot is None or slot is not inflight.snapshot[i]:
+                    continue
                 self._record_token(i, int(toks[i, k]))
+
+    def _decode_once(self) -> None:
+        self._drain_decode(self._dispatch_decode())
 
     def _record_token(self, slot_idx: int, token: int) -> None:
         slot = self._slots[slot_idx]

@@ -25,6 +25,7 @@ from kubeflow_tpu.topology.mesh import (
     plan_mesh,
     make_mesh,
     make_host_local_mesh,
+    make_multislice_mesh,
 )
 
 __all__ = [
@@ -39,4 +40,5 @@ __all__ = [
     "plan_mesh",
     "make_mesh",
     "make_host_local_mesh",
+    "make_multislice_mesh",
 ]

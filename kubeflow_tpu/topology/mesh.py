@@ -202,6 +202,68 @@ def make_mesh(
     return Mesh(dev_array, plan.axis_names)
 
 
+def make_multislice_mesh(
+    axes: AxisSpec,
+    num_slices: int,
+    *,
+    dcn_axis: str = "dp",
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Hybrid ICI+DCN mesh for multi-slice (megascale) jobs.
+
+    ``axes`` gives the GLOBAL extents (product == total devices across all
+    slices). ``dcn_axis`` — "dp" or "pp", the only axes whose collectives
+    tolerate DCN latency (one allreduce per step / one boundary hop per
+    microbatch tick) — takes ``num_slices`` as its *outer* factor, so its
+    inter-slice segment crosses DCN and every other axis stays inside a
+    slice's ICI. The reference's analogue was launching one MPI world per
+    cluster with no topology awareness at all (SURVEY §2.5); here the
+    slice boundary is explicit in the mesh.
+
+    On TPU the device order comes from
+    ``mesh_utils.create_hybrid_device_mesh`` (reads device.slice_index);
+    on CPU (tests/dryrun) contiguous device blocks emulate slices.
+    """
+    if dcn_axis not in ("dp", "pp"):
+        raise ValueError(
+            f"dcn_axis must be 'dp' or 'pp' (latency-tolerant collectives); "
+            f"got {dcn_axis!r}"
+        )
+    if devices is None:
+        devices = jax.devices()
+    ndev = len(devices)
+    if ndev % num_slices != 0:
+        raise ValueError(f"{ndev} devices not divisible into {num_slices} slices")
+    resolved = axes.resolve(ndev)
+    d = resolved.as_dict()
+    if d[dcn_axis] % num_slices != 0:
+        raise ValueError(
+            f"{dcn_axis}={d[dcn_axis]} not divisible by num_slices={num_slices}"
+        )
+    per_slice = dict(d)
+    per_slice[dcn_axis] //= num_slices
+    per_shape = tuple(per_slice[a] for a in AXIS_ORDER)
+    if math.prod(per_shape) * num_slices != ndev:
+        raise ValueError(
+            f"axes {d} x {num_slices} slices != {ndev} devices"
+        )
+    dcn_shape = tuple(
+        num_slices if a == dcn_axis else 1 for a in AXIS_ORDER
+    )
+    if devices[0].platform == "tpu":
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            per_shape, dcn_shape, devices=list(devices)
+        )
+    else:
+        idx = AXIS_ORDER.index(dcn_axis)
+        arr = np.asarray(list(devices)).reshape((num_slices,) + per_shape)
+        arr = np.moveaxis(arr, 0, idx)   # slice id becomes dcn_axis's outer factor
+        dev_array = arr.reshape(tuple(d[a] for a in AXIS_ORDER))
+    return Mesh(dev_array, AXIS_ORDER)
+
+
 def make_host_local_mesh(axes: AxisSpec) -> Mesh:
     """Convenience: build a mesh over whatever devices this process sees
     (single-host dev loop / unit tests)."""

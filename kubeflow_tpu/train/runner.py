@@ -127,8 +127,17 @@ def run(cfg: dict) -> int:
 
         num_slices = int(os.environ.get("MEGASCALE_NUM_SLICES", "1") or 1)
         if get_slice(cfg["slice_type"]).num_chips * num_slices == ndev:
-            plan = plan_mesh(cfg["slice_type"], axes)
-            mesh = make_mesh(plan)
+            if num_slices > 1:
+                # Multi-slice: dp's outer factor rides DCN, everything else
+                # stays on intra-slice ICI (topology.make_multislice_mesh).
+                from kubeflow_tpu.topology import make_multislice_mesh
+
+                mesh = make_multislice_mesh(
+                    axes.resolve(ndev), num_slices, dcn_axis="dp"
+                )
+            else:
+                plan = plan_mesh(cfg["slice_type"], axes)
+                mesh = make_mesh(plan)
         else:
             # Virtual/e2e backends expose fewer devices than the slice
             # (forced host-platform devices); resolve against what exists.

@@ -80,6 +80,7 @@ _HUB_BODY = """
 <h2>Contributors</h2><div id="contributors"></div>
 <form id="addc"><input id="cemail" placeholder="user@example.com">
 <button>Add contributor</button></form>
+<h2>Cluster metrics</h2><div id="metrics"></div>
 """
 
 _HUB_SCRIPT = """
@@ -119,7 +120,40 @@ document.getElementById('addc').onsubmit = async (e) => {
       {contributor: document.getElementById('cemail').value})});
   refresh();
 };
-loadNs();
+function spark(pts) {
+  if (!pts.length) return '';
+  const vals = pts.map(p => p.value);
+  const lo = Math.min(...vals), hi = Math.max(...vals);
+  const span = (hi - lo) || 1;
+  const step = 120 / Math.max(1, pts.length - 1);
+  const d = vals.map((v, i) =>
+    `${(i * step).toFixed(1)},${(24 - 22 * (v - lo) / span).toFixed(1)}`
+  ).join(' ');
+  return `<svg width="120" height="26"><polyline points="${d}"` +
+    ` fill="none" stroke="#1a73e8" stroke-width="1.5"/></svg>`;
+}
+async function loadMetrics() {
+  // The time-series plane is optional (mounted when a MetricsService is
+  // wired into the hub); a 404 just hides the panel.
+  let names;
+  try { names = (await api('/api/metrics')).series; }
+  catch (e) { return; }
+  const series = await Promise.all(names.slice(0, 12).map(n =>
+    api(`/api/metrics/${encodeURIComponent(n)}?window=3600`)));
+  const rows = [];
+  for (const s of series) {
+    if (!s.points.length) continue;
+    const last = s.points[s.points.length - 1].value;
+    rows.push(`<tr><td>${esc(s.series)}</td>` +
+      `<td>${esc(Number(last).toPrecision(4))}</td>` +
+      `<td>${spark(s.points)}</td></tr>`);
+  }
+  if (rows.length)
+    document.getElementById('metrics').innerHTML =
+      '<table><tr><th>series</th><th>latest</th><th>last hour</th></tr>' +
+      rows.join('') + '</table>';
+}
+loadNs(); loadMetrics(); setInterval(loadMetrics, 30000);
 """
 
 _SPAWNER_BODY = """
@@ -183,8 +217,9 @@ init();
 """
 
 
-def central_hub(api, dashboard, jwa) -> Router:
-    """One router serving pages + the dashboard/spawner REST surface."""
+def central_hub(api, dashboard, jwa, metrics_service=None) -> Router:
+    """One router serving pages + the dashboard/spawner REST surface (+ the
+    time-series metrics API when a MetricsService is wired in)."""
     r = Router()
     r.get("/", lambda q: Html(_PAGE.format(
         title="Kubeflow TPU", body=_HUB_BODY, script=_HUB_SCRIPT)))
@@ -216,13 +251,17 @@ def central_hub(api, dashboard, jwa) -> Router:
     r.get("/api/resources/<ns>", resources)
     r.include(dashboard.router())
     r.include(jwa.router())
+    if metrics_service is not None:
+        r.include(metrics_service.router())
     return r
 
 
 def serve_hub(api, dashboard, jwa, *, host: str = "127.0.0.1",
-              port: int = 0, user_id_header: str) -> JsonHttpServer:
+              port: int = 0, user_id_header: str,
+              metrics_service=None) -> JsonHttpServer:
     return JsonHttpServer(
-        central_hub(api, dashboard, jwa), host=host, port=port,
+        central_hub(api, dashboard, jwa, metrics_service),
+        host=host, port=port,
         user_id_header=user_id_header,
     ).start()
 
@@ -260,14 +299,25 @@ def main(argv=None) -> int:
                           user_id_header=args.user_id_header)
     jwa = NotebookWebApp(api, registry, user_id_header=args.user_id_header)
     dashboard = DashboardApi(am)
+    # Time-series plane: sample host/TPU/registry metrics into the store
+    # the /api/metrics routes read (reference MetricsService).
+    from kubeflow_tpu.webapps.metrics import (
+        MetricsCollector,
+        MetricsService,
+        TimeSeriesStore,
+    )
+
+    store = TimeSeriesStore()
+    collector = MetricsCollector(store, registry).start()
     server = serve_hub(api, dashboard, jwa, host=args.host, port=args.port,
-                       user_id_header=args.user_id_header)
+                       user_id_header=args.user_id_header,
+                       metrics_service=MetricsService(store))
     metrics = None
     if args.metrics_port >= 0:
         from kubeflow_tpu.utils.monitoring import MetricsHttpServer
 
         metrics = MetricsHttpServer(registry, args.metrics_port)
-    serve_forever(server.stop,
+    serve_forever(server.stop, collector.stop,
                   (metrics.stop if metrics is not None else (lambda: None)))
     return 0
 

@@ -1,0 +1,239 @@
+"""Dashboard time-series metrics plane.
+
+Rebuild of the reference centraldashboard's pluggable ``MetricsService``
+(app/metrics_service.ts:21-42: getNodeCpuUtilization / getPodCpuUtilization
+/ getPodMemoryUsage backed by a Stackdriver impl,
+app/stackdriver_metrics_service.ts:15-196). The TPU twist: there is no
+cloud-monitoring dependency — the platform samples its own sources into an
+in-memory ring of time series:
+
+- host CPU utilisation (/proc/stat deltas — the reference's "node CPU"),
+- TPU HBM usage per local device (jax device memory_stats; the reference's
+  GPU analogue simply didn't exist),
+- any gauge/counter in a ``MetricsRegistry`` (so controller metrics,
+  ``kftpu_availability``, and job tokens/sec series appear in the same
+  query surface the dashboard reads).
+
+Query surface: ``GET /api/metrics/<series>?window=600`` returning
+``{series, points: [{t, value, labels}]}``, mirroring the reference's
+``/api/metrics/:type((node|podcpu|podmem))`` route (app/api.ts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.utils.monitoring import Counter, Gauge, MetricsRegistry
+from kubeflow_tpu.webapps.router import Request, RestError, Router
+
+log = get_logger("metrics")
+
+LabelKV = Tuple[Tuple[str, str], ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Point:
+    t: float
+    value: float
+    labels: LabelKV = ()
+
+
+class TimeSeriesStore:
+    """Bounded in-memory series store: newest-last deques per series name,
+    pruned by age on write and on read."""
+
+    def __init__(self, retention_s: float = 3600.0, max_points: int = 4096):
+        self.retention_s = retention_s
+        self.max_points = max_points
+        self._series: Dict[str, Deque[Point]] = {}
+        self._lock = threading.Lock()
+
+    def record(self, series: str, value: float, *,
+               t: Optional[float] = None, labels: LabelKV = ()) -> None:
+        p = Point(t=time.time() if t is None else t, value=float(value),
+                  labels=labels)
+        with self._lock:
+            dq = self._series.setdefault(
+                series, deque(maxlen=self.max_points)
+            )
+            dq.append(p)
+            cutoff = p.t - self.retention_s
+            while dq and dq[0].t < cutoff:
+                dq.popleft()
+
+    def query(self, series: str, window_s: float = 600.0,
+              now: Optional[float] = None) -> List[Point]:
+        cutoff = (time.time() if now is None else now) - window_s
+        with self._lock:
+            dq = self._series.get(series)
+            if dq is None:
+                return []
+            return [p for p in dq if p.t >= cutoff]
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+
+def host_cpu_sampler() -> Callable[[], Optional[float]]:
+    """Returns a closure yielding CPU utilisation in [0, 1] from /proc/stat
+    deltas (None on the first call or on non-Linux hosts)."""
+    prev: Dict[str, float] = {}
+
+    def sample() -> Optional[float]:
+        try:
+            with open("/proc/stat") as f:
+                fields = f.readline().split()
+        except OSError:
+            return None
+        if not fields or fields[0] != "cpu":
+            return None
+        vals = [float(x) for x in fields[1:]]
+        idle = vals[3] + (vals[4] if len(vals) > 4 else 0.0)
+        total = sum(vals)
+        d_total = total - prev.get("total", 0.0)
+        d_idle = idle - prev.get("idle", 0.0)
+        first = not prev
+        prev["total"], prev["idle"] = total, idle
+        if first or d_total <= 0:
+            return None
+        return max(0.0, min(1.0, 1.0 - d_idle / d_total))
+
+    return sample
+
+
+def tpu_hbm_sampler() -> Callable[[], List[Tuple[str, float, float]]]:
+    """Returns a closure yielding [(device_id, bytes_in_use, bytes_limit)]
+    for local accelerator devices; empty on CPU-only hosts."""
+
+    def sample() -> List[Tuple[str, float, float]]:
+        try:
+            import jax
+
+            out = []
+            for d in jax.local_devices():
+                if d.platform == "cpu":
+                    continue
+                stats = getattr(d, "memory_stats", lambda: None)()
+                if not stats:
+                    continue
+                out.append((
+                    str(d.id),
+                    float(stats.get("bytes_in_use", 0)),
+                    float(stats.get("bytes_limit", 0)),
+                ))
+            return out
+        except Exception:
+            return []
+
+    return sample
+
+
+class MetricsCollector:
+    """Background sampler: every ``interval_s`` copies registry metrics and
+    host/TPU stats into the store. ``tick()`` is callable directly so tests
+    and single-threaded callers can sample deterministically."""
+
+    def __init__(
+        self,
+        store: TimeSeriesStore,
+        registry: Optional[MetricsRegistry] = None,
+        *,
+        interval_s: float = 15.0,
+        cpu_sample: Optional[Callable[[], Optional[float]]] = None,
+        hbm_sample: Optional[Callable[[], List[Tuple[str, float, float]]]] = None,
+    ):
+        self.store = store
+        self.registry = registry
+        self.interval_s = interval_s
+        self._cpu = cpu_sample if cpu_sample is not None else host_cpu_sampler()
+        self._hbm = hbm_sample if hbm_sample is not None else tpu_hbm_sampler()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def tick(self, now: Optional[float] = None) -> None:
+        t = time.time() if now is None else now
+        cpu = self._cpu()
+        if cpu is not None:
+            self.store.record("node_cpu_utilization", cpu, t=t)
+        for dev, used, limit in self._hbm():
+            labels = (("device", dev),)
+            self.store.record("tpu_hbm_bytes_in_use", used, t=t, labels=labels)
+            if limit > 0:
+                self.store.record(
+                    "tpu_hbm_utilization", used / limit, t=t, labels=labels
+                )
+        if self.registry is not None:
+            for name, metric in list(self.registry._metrics.items()):
+                if isinstance(metric, Gauge):
+                    self.store.record(name, metric.value(), t=t)
+                elif isinstance(metric, Counter):
+                    with metric._lock:
+                        items = list(metric._values.items())
+                    for labels, v in items:
+                        self.store.record(name, v, t=t, labels=labels)
+
+    def start(self) -> "MetricsCollector":
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.tick()
+                except Exception as e:   # sampling must never kill the app
+                    log.warning("metrics tick failed", kv={"err": repr(e)})
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class MetricsService:
+    """The dashboard-facing query API (the reference MetricsService
+    abstraction, metrics_service.ts:21-42)."""
+
+    def __init__(self, store: TimeSeriesStore):
+        self.store = store
+
+    # Named accessors mirroring the reference's interface --------------
+
+    def node_cpu_utilization(self, window_s: float = 600.0) -> List[Point]:
+        return self.store.query("node_cpu_utilization", window_s)
+
+    def tpu_hbm_utilization(self, window_s: float = 600.0) -> List[Point]:
+        return self.store.query("tpu_hbm_utilization", window_s)
+
+    def series(self, name: str, window_s: float = 600.0) -> List[Point]:
+        return self.store.query(name, window_s)
+
+    # HTTP --------------------------------------------------------------
+
+    def router(self) -> Router:
+        r = Router()
+
+        def _list(q: Request):
+            return {"series": self.store.names()}
+
+        def _query(q: Request):
+            try:
+                window = float(q.query.get("window", "600"))
+            except ValueError:
+                raise RestError(400, "window must be a number of seconds")
+            pts = self.series(q.params["name"], window)
+            return {
+                "series": q.params["name"],
+                "points": [
+                    {"t": p.t, "value": p.value, "labels": dict(p.labels)}
+                    for p in pts
+                ],
+            }
+
+        r.get("/api/metrics", _list)
+        r.get("/api/metrics/<name>", _query)
+        return r

@@ -1,0 +1,136 @@
+"""Dashboard time-series metrics plane (webapps/metrics.py).
+
+Mirrors the reference centraldashboard MetricsService surface
+(app/metrics_service.ts:21-42 + stackdriver impl) with platform-local
+sampling instead of a cloud monitoring API.
+"""
+
+import json
+import urllib.request
+
+from kubeflow_tpu.utils.monitoring import MetricsRegistry
+from kubeflow_tpu.webapps.metrics import (
+    MetricsCollector,
+    MetricsService,
+    Point,
+    TimeSeriesStore,
+    host_cpu_sampler,
+)
+from kubeflow_tpu.webapps.router import JsonHttpServer
+
+
+class TestTimeSeriesStore:
+    def test_record_query_window(self):
+        st = TimeSeriesStore()
+        st.record("a", 1.0, t=100.0)
+        st.record("a", 2.0, t=200.0)
+        st.record("a", 3.0, t=300.0)
+        pts = st.query("a", window_s=150.0, now=310.0)
+        assert [p.value for p in pts] == [2.0, 3.0]
+        assert st.query("missing", now=310.0) == []
+        assert st.names() == ["a"]
+
+    def test_max_points_bound(self):
+        st = TimeSeriesStore(max_points=3)
+        for i in range(10):
+            st.record("a", float(i), t=float(i))
+        pts = st.query("a", window_s=100.0, now=9.0)
+        assert [p.value for p in pts] == [7.0, 8.0, 9.0]
+
+
+class TestCollector:
+    def _collector(self, registry=None):
+        st = TimeSeriesStore()
+        hbm = [("0", 8e9, 16e9)]
+        col = MetricsCollector(
+            st, registry,
+            cpu_sample=lambda: 0.25,
+            hbm_sample=lambda: hbm,
+        )
+        return st, col
+
+    def test_tick_samples_cpu_and_hbm(self):
+        st, col = self._collector()
+        col.tick(now=50.0)
+        assert st.query("node_cpu_utilization", now=50.0)[0].value == 0.25
+        hbm = st.query("tpu_hbm_utilization", now=50.0)[0]
+        assert hbm.value == 0.5
+        assert dict(hbm.labels) == {"device": "0"}
+        assert st.query("tpu_hbm_bytes_in_use", now=50.0)[0].value == 8e9
+
+    def test_tick_copies_registry_metrics(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("kftpu_availability", "up")
+        g.set(1.0)
+        c = reg.counter("kftpu_reconciles_total", "n", ("kind",))
+        c.inc(kind="Notebook")
+        c.inc(kind="Notebook")
+        st, col = self._collector(reg)
+        col.tick(now=60.0)
+        assert st.query("kftpu_availability", now=60.0)[0].value == 1.0
+        pt = st.query("kftpu_reconciles_total", now=60.0)[0]
+        assert pt.value == 2.0
+        assert dict(pt.labels) == {"kind": "Notebook"}
+
+    def test_host_cpu_sampler_contract(self):
+        sample = host_cpu_sampler()
+        first = sample()
+        assert first is None  # no delta on the first reading
+        second = sample()
+        if second is not None:  # non-Linux hosts may keep returning None
+            assert 0.0 <= second <= 1.0
+
+
+class TestMetricsHttp:
+    def test_query_over_http(self):
+        st = TimeSeriesStore()
+        st.record("node_cpu_utilization", 0.5)
+        svc = MetricsService(st)
+        srv = JsonHttpServer(svc.router(), port=0).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with urllib.request.urlopen(f"{base}/api/metrics") as r:
+                assert json.load(r)["series"] == ["node_cpu_utilization"]
+            with urllib.request.urlopen(
+                f"{base}/api/metrics/node_cpu_utilization?window=60"
+            ) as r:
+                body = json.load(r)
+            assert body["series"] == "node_cpu_utilization"
+            assert len(body["points"]) == 1
+            assert body["points"][0]["value"] == 0.5
+            # bad window -> 400
+            try:
+                urllib.request.urlopen(
+                    f"{base}/api/metrics/node_cpu_utilization?window=x"
+                )
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            srv.stop()
+
+    def test_mounted_in_hub(self):
+        from kubeflow_tpu.controlplane.kfam import AccessManagement
+        from kubeflow_tpu.controlplane.runtime.apiserver import (
+            InMemoryApiServer,
+        )
+        from kubeflow_tpu.webapps.dashboard import DashboardApi
+        from kubeflow_tpu.webapps.frontend import central_hub
+        from kubeflow_tpu.webapps.jwa import NotebookWebApp
+        from kubeflow_tpu.webapps.router import Request
+
+        api = InMemoryApiServer()
+        reg = MetricsRegistry()
+        am = AccessManagement(api, reg)
+        st = TimeSeriesStore()
+        st.record("node_cpu_utilization", 0.1)
+        hub = central_hub(
+            api, DashboardApi(am), NotebookWebApp(api, reg),
+            MetricsService(st),
+        )
+        status, body = hub.dispatch(Request(
+            method="GET", path="/api/metrics", params={}, query={},
+            body={}, caller="", headers={},
+        ))
+        assert status == 200
+        assert body["series"] == ["node_cpu_utilization"]

@@ -169,6 +169,60 @@ class TestChunkedDecode:
         assert [eng.result(r).tokens for r in rids] == solo
 
 
+class TestPipelinedDecode:
+    """run()'s in-flight dispatch pipeline (ServingConfig.pipeline_depth)
+    is a latency optimisation, not a semantic change."""
+
+    def test_pipelined_matches_sync(self, model_and_params):
+        model, params = model_and_params
+        prompts = [[3, 14, 15, 92], [7, 8, 9], [1, 2], [4, 4, 4]]
+        outs = []
+        for depth in (1, 2, 3):
+            eng = ServingEngine(
+                model, params,
+                ServingConfig(max_batch=2, max_len=128, decode_chunk=3,
+                              pipeline_depth=depth),
+            )
+            rids = [eng.submit(p, max_new_tokens=7) for p in prompts]
+            eng.run()
+            outs.append([eng.result(r).tokens for r in rids])
+        assert outs[1] == outs[0]
+        assert outs[2] == outs[0]
+
+    def test_midbatch_admission_not_starved(self, model_and_params):
+        """A slot freed while another slot keeps decoding must be refilled
+        from the queue during the run, not after the whole batch ends
+        (continuous batching under pipelining)."""
+        model, params = model_and_params
+        eng = ServingEngine(
+            model, params,
+            ServingConfig(max_batch=2, max_len=128, decode_chunk=2,
+                          pipeline_depth=2),
+        )
+        admissions = []
+        orig = eng._prefill_group
+
+        def spy(bucket, group):
+            admissions.append([i for i, _ in group])
+            orig(bucket, group)
+
+        eng._prefill_group = spy
+        long = eng.submit([1, 2, 3], max_new_tokens=24)
+        short = eng.submit([4, 5], max_new_tokens=2)
+        queued = eng.submit([6, 7], max_new_tokens=2)
+        eng.run()
+        for rid, n in ((long, 24), (short, 2), (queued, 2)):
+            assert len(eng.result(rid).tokens) == n
+        # The queued request must have been admitted in its own later wave
+        # (slot freed by `short` mid-run), i.e. >= 2 admission events.
+        assert len(admissions) >= 2
+        # And the long request's stream stays correct despite the flush.
+        ref = ServingEngine(model, params,
+                            ServingConfig(max_batch=1, max_len=128))
+        ref.submit([1, 2, 3], max_new_tokens=24)
+        assert eng.result(long).tokens == ref.run()[0].tokens
+
+
 class TestShardedServing:
     def test_sharded_engine_matches_unsharded(self, model_and_params,
                                               devices8):

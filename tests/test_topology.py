@@ -105,3 +105,61 @@ class TestMeshPlan:
         x = jnp.arange(16.0).reshape(8, 2)
         xs = jax.device_put(x, NamedSharding(mesh, P(("dp", "fsdp"), "tp")))
         assert float(xs.sum()) == float(np.arange(16.0).sum())
+
+
+class TestMultisliceMesh:
+    """Hybrid ICI+DCN mesh (topology.make_multislice_mesh): the dcn axis
+    takes num_slices as its outer factor, other axes stay within a slice."""
+
+    def test_shape_and_slice_grouping(self, devices8):
+        from kubeflow_tpu.topology import make_multislice_mesh
+
+        mesh = make_multislice_mesh(
+            AxisSpec(dp=2, fsdp=2, tp=2), 2, dcn_axis="dp", devices=devices8
+        )
+        assert mesh.shape["dp"] == 2
+        assert mesh.shape["fsdp"] == 2
+        assert mesh.shape["tp"] == 2
+        # dp index 0 must hold exactly the first contiguous device block
+        # (the first virtual slice); dp index 1 the second.
+        dev = np.asarray(mesh.devices)
+        first = set(d.id for d in dev[0].ravel())
+        second = set(d.id for d in dev[1].ravel())
+        assert first == {d.id for d in devices8[:4]}
+        assert second == {d.id for d in devices8[4:]}
+
+    def test_trains_a_step(self, devices8):
+        from kubeflow_tpu.models import Llama, LlamaConfig
+        from kubeflow_tpu.topology import make_multislice_mesh
+        from kubeflow_tpu.train import TrainConfig, Trainer
+        from kubeflow_tpu.train.data import (
+            SyntheticTextConfig,
+            synthetic_text,
+        )
+
+        mesh = make_multislice_mesh(
+            AxisSpec(dp=2, fsdp=2, tp=2), 2, dcn_axis="dp", devices=devices8
+        )
+        model = Llama(LlamaConfig.tiny(scan_layers=True, remat=True))
+        tr = Trainer(model, TrainConfig(task="lm", warmup_steps=1), mesh)
+        it = synthetic_text(
+            SyntheticTextConfig(batch_size=4, seq_len=32, vocab_size=256)
+        )
+        batch = tr.shard_batch(
+            {k: jnp.asarray(v) for k, v in next(it).items()}
+        )
+        state = tr.init_state(jax.random.PRNGKey(0), batch)
+        state, metrics = tr.step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_rejects_bad_axis_and_divisibility(self, devices8):
+        from kubeflow_tpu.topology import make_multislice_mesh
+
+        with pytest.raises(ValueError, match="dcn_axis"):
+            make_multislice_mesh(
+                AxisSpec(dp=4, tp=2), 2, dcn_axis="tp", devices=devices8
+            )
+        with pytest.raises(ValueError, match="divisible"):
+            make_multislice_mesh(
+                AxisSpec(dp=2, fsdp=2, tp=2), 4, devices=devices8
+            )
