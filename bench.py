@@ -163,7 +163,8 @@ def bench_serving(args) -> None:
     engine = ServingEngine(
         model, params,
         ServingConfig(max_batch=bs, max_len=1024,
-                      decode_chunk=args.decode_chunk),
+                      decode_chunk=args.decode_chunk,
+                      quantize=args.quantize),
     )
     rng = np.random.default_rng(0)
     prompts = [
@@ -221,9 +222,10 @@ def bench_resnet(args) -> None:
         model, TrainConfig(task="image", warmup_steps=10, total_steps=1000),
         mesh,
     )
-    # Conv stacks want large batches (measured: bs32 1420 -> bs128 2392
-    # images/s on one v5e); explicit --batch-size always wins.
-    bs = (args.batch_size or 128) * ndev
+    # Conv stacks want large batches (measured: bs32 1420 -> bs128 ~2200
+    # -> bs256 ~2385 -> bs512 regresses, one v5e); explicit --batch-size
+    # always wins.
+    bs = (args.batch_size or 256) * ndev
     it = synthetic_images(SyntheticImageConfig(batch_size=bs, image_size=224))
     batch = trainer.shard_batch({k: jnp.asarray(v) for k, v in next(it).items()})
     state = trainer.init_state(jax.random.PRNGKey(0), batch)
@@ -264,6 +266,9 @@ def bench_mixtral(args) -> None:
         vocab_size=32000, embed_dim=1024, num_layers=6, num_heads=16,
         num_kv_heads=8, head_dim=64, mlp_dim=2048, num_experts=8,
         max_seq_len=args.seq_len, scan_layers=True, remat=True,
+        remat_policy=args.remat_policy,
+        logits_f32=not args.bf16_logits,
+        param_dtype=jnp.dtype(args.param_dtype),
     )
     model = Mixtral(cfg)
     ndev = len(jax.devices())
@@ -274,7 +279,8 @@ def bench_mixtral(args) -> None:
     trainer = Trainer(
         model,
         TrainConfig(task="lm", warmup_steps=10, total_steps=1000,
-                    aux_loss_weight=0.02, attn_impl=args.attn),
+                    aux_loss_weight=0.02, attn_impl=args.attn,
+                    mu_dtype=args.mu_dtype),
         mesh,
     )
     bs = args.batch_size or 8
@@ -364,6 +370,8 @@ def main() -> None:
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--gen-len", type=int, default=128)
     p.add_argument("--decode-chunk", type=int, default=32)
+    p.add_argument("--quantize", default="", choices=["", "int8"],
+                   help="serving weight-only quantization")
     p.add_argument("--trace-dir", default="",
                    help="write a jax.profiler trace of the timed steps")
     # Round-3 measured defaults (decisive same-session sweep, min-of-3):

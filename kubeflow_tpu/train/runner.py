@@ -130,10 +130,27 @@ def run(cfg: dict) -> int:
             if num_slices > 1:
                 # Multi-slice: dp's outer factor rides DCN, everything else
                 # stays on intra-slice ICI (topology.make_multislice_mesh).
+                # dp=1 configs fall back to pp (its one-hop-per-tick
+                # permute also tolerates DCN); neither divisible is a
+                # config error worth failing loudly on — any other axis
+                # crossing DCN would put a per-matmul collective on the
+                # slow path.
                 from kubeflow_tpu.topology import make_multislice_mesh
 
+                resolved = axes.resolve(ndev)
+                if resolved.dp % num_slices == 0:
+                    dcn_axis = "dp"
+                elif resolved.pp % num_slices == 0:
+                    dcn_axis = "pp"
+                else:
+                    raise ValueError(
+                        f"multi-slice job needs dp or pp divisible by "
+                        f"num_slices={num_slices}; got dp={resolved.dp} "
+                        f"pp={resolved.pp} (bandwidth-bound axes must not "
+                        f"cross DCN)"
+                    )
                 mesh = make_multislice_mesh(
-                    axes.resolve(ndev), num_slices, dcn_axis="dp"
+                    resolved, num_slices, dcn_axis=dcn_axis
                 )
             else:
                 plan = plan_mesh(cfg["slice_type"], axes)

@@ -191,6 +191,22 @@ class MetricsRegistry:
             lines.extend(m.render())  # type: ignore[attr-defined]
         return "\n".join(lines) + "\n"
 
+    def snapshot(self) -> List[Tuple[str, LabelKV, float]]:
+        """Point-in-time (name, labels, value) samples for every counter
+        and gauge — the stable read surface for samplers (the time-series
+        collector) that must not race concurrent registration."""
+        with self._lock:
+            metrics = list(self._metrics.items())
+        out: List[Tuple[str, LabelKV, float]] = []
+        for name, m in metrics:
+            if isinstance(m, Counter):
+                with m._lock:
+                    items = list(m._values.items())
+                out.extend((name, labels, v) for labels, v in items)
+            elif isinstance(m, Gauge):
+                out.append((name, (), m.value()))
+        return out
+
 
 class MetricsHttpServer:
     """Serve a registry's Prometheus text exposition over HTTP (the

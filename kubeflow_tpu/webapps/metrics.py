@@ -28,7 +28,7 @@ from collections import deque
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from kubeflow_tpu.utils import get_logger
-from kubeflow_tpu.utils.monitoring import Counter, Gauge, MetricsRegistry
+from kubeflow_tpu.utils.monitoring import MetricsRegistry
 from kubeflow_tpu.webapps.router import Request, RestError, Router
 
 log = get_logger("metrics")
@@ -169,14 +169,8 @@ class MetricsCollector:
                     "tpu_hbm_utilization", used / limit, t=t, labels=labels
                 )
         if self.registry is not None:
-            for name, metric in list(self.registry._metrics.items()):
-                if isinstance(metric, Gauge):
-                    self.store.record(name, metric.value(), t=t)
-                elif isinstance(metric, Counter):
-                    with metric._lock:
-                        items = list(metric._values.items())
-                    for labels, v in items:
-                        self.store.record(name, v, t=t, labels=labels)
+            for name, labels, v in self.registry.snapshot():
+                self.store.record(name, v, t=t, labels=labels)
 
     def start(self) -> "MetricsCollector":
         def loop():
