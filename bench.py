@@ -359,7 +359,7 @@ def main() -> None:
                    choices=["train", "serving", "resnet", "mixtral", "hpo"])
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)
-    # Default is per-bench (train/serving 12/8, resnet 128, mixtral 8);
+    # Default is per-bench (train 12, serving 16, resnet 256, mixtral 8);
     # an explicit value always wins.
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--seq-len", type=int, default=2048)

@@ -107,13 +107,18 @@ class _InFlight:
     snapshot: list                       # slot objects active at dispatch
 
 
-def _quantize_int8(params, min_size: int = 65536):
+def _quantize_int8(params, min_size: int = 65536, *,
+                   stacked_layers: bool = False):
     """Split a param tree into (int8-or-passthrough tree, per-leaf scale
     tree). Matmul-sized floating leaves (ndim >= 2, >= min_size elements)
-    get symmetric per-output-channel int8 (scale = amax/127 over the
-    leading contraction axis); embedding tables (any path component
-    containing "embed" — lookups and tied logits are quality-sensitive)
-    and everything small pass through with an empty scale marker."""
+    get symmetric per-output-channel int8: scale = amax/127 reduced over
+    the contraction axis — axis 0 for plain DenseGeneral kernels
+    [in, out...], axis 1 when ``stacked_layers`` (nn.scan stacks an extra
+    leading layer axis: [L, in, out...], so the per-layer granularity is
+    kept and scale tensors stay ~1/in of the leaf). Embedding tables (any
+    path component containing "embed" — lookups and tied logits are
+    quality-sensitive) and everything small pass through with an empty
+    scale marker."""
 
     def split(path, x):
         keys = tuple(str(k).strip("'[]. ") for k in path)
@@ -124,8 +129,9 @@ def _quantize_int8(params, min_size: int = 65536):
             and x.size >= min_size
             and not is_embed
         ):
+            contract = 1 if (stacked_layers and x.ndim >= 3) else 0
             xf = x.astype(jnp.float32)
-            amax = jnp.max(jnp.abs(xf), axis=0, keepdims=True)
+            amax = jnp.max(jnp.abs(xf), axis=contract, keepdims=True)
             scale = jnp.maximum(amax / 127.0, 1e-12)
             q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
             # bf16 scales: the dequantised weight must stay bf16 (an f32
@@ -193,7 +199,10 @@ class ServingEngine:
             if cfg.quantize != "int8":
                 raise ValueError(f"unsupported quantize={cfg.quantize!r}")
             params, self._scales = _quantize_int8(
-                params, cfg.quantize_min_size
+                params, cfg.quantize_min_size,
+                stacked_layers=bool(
+                    getattr(model.cfg, "scan_layers", False)
+                ),
             )
             self._qflags = jax.tree.map(
                 lambda s: bool(s.size > 0), self._scales

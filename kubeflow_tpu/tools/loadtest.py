@@ -1,0 +1,130 @@
+"""Control-plane load test: reconcile throughput under bulk load.
+
+The reference ships a manual loadtest dir for the notebook controller
+(components/notebook-controller/loadtest/ — locustfile + manifests against
+a live cluster) but never wired load numbers into CI. Here the same
+question — how many objects per second can the control plane reconcile to
+Ready, and does the answer collapse as the store grows? — runs in-process
+against the InMemoryApiServer with the FakeKubelet, so it is deterministic
+and cheap enough to pin in tests (tests/test_loadtest.py).
+
+Usage:
+  python -m kubeflow_tpu.tools.loadtest --notebooks 500 --jobs 100
+Prints one JSON line: objects, wall seconds, objects/sec, reconcile loops.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Dict
+
+from kubeflow_tpu.controlplane.api import (
+    Notebook,
+    NotebookSpec,
+    ObjectMeta,
+    Profile,
+    ProfileSpec,
+    TpuJob,
+    TpuJobSpec,
+)
+from kubeflow_tpu.controlplane.controllers import (
+    FakeKubelet,
+    NotebookController,
+    PodDefaultMutator,
+    ProfileController,
+    TensorboardController,
+    TpuJobController,
+)
+from kubeflow_tpu.controlplane.runtime import (
+    ControllerManager,
+    InMemoryApiServer,
+)
+from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+
+def build_world():
+    api = InMemoryApiServer()
+    api.register_mutator(PodDefaultMutator(api))
+    reg = MetricsRegistry()
+    mgr = ControllerManager(api)
+    mgr.register(TpuJobController(api, reg))
+    mgr.register(NotebookController(api, reg))
+    mgr.register(ProfileController(api, reg))
+    mgr.register(TensorboardController(api, reg))
+    mgr.register(FakeKubelet(api, reg))
+    return api, mgr
+
+
+def run_load(
+    *,
+    notebooks: int = 100,
+    jobs: int = 20,
+    profiles: int = 10,
+    max_iterations: int = 2_000_000,
+) -> Dict[str, float]:
+    """Create profiles/notebooks/jobs in bulk, drain to steady state, and
+    assert everything converged. Returns the summary dict."""
+    api, mgr = build_world()
+    t0 = time.perf_counter()
+    for p in range(profiles):
+        api.create(Profile(
+            metadata=ObjectMeta(name=f"team-{p}"),
+            spec=ProfileSpec(owner=f"owner-{p}@example.com"),
+        ))
+    mgr.run_until_idle(max_iterations=max_iterations)
+    for n in range(notebooks):
+        api.create(Notebook(
+            metadata=ObjectMeta(
+                name=f"nb-{n}", namespace=f"team-{n % profiles}"
+            ),
+            spec=NotebookSpec(image="jupyter:latest"),
+        ))
+    for j in range(jobs):
+        api.create(TpuJob(
+            metadata=ObjectMeta(
+                name=f"job-{j}", namespace=f"team-{j % profiles}"
+            ),
+            spec=TpuJobSpec(slice_type="v5e-8", model="llama-tiny"),
+        ))
+    loops = mgr.run_until_idle(max_iterations=max_iterations)
+    dt = time.perf_counter() - t0
+
+    not_ready = [
+        nb.metadata.name for nb in api.list("Notebook")
+        if nb.status.ready_replicas < 1
+    ]
+    unsched = [
+        job.metadata.name for job in api.list("TpuJob")
+        if job.status.phase not in ("Running", "Succeeded")
+    ]
+    total = profiles + notebooks + jobs
+    return {
+        "objects": total,
+        "notebooks": notebooks,
+        "jobs": jobs,
+        "profiles": profiles,
+        "seconds": round(dt, 3),
+        "objects_per_sec": round(total / dt, 1),
+        "reconcile_loops": loops,
+        "notebooks_not_ready": len(not_ready),
+        "jobs_not_running": len(unsched),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kftpu-loadtest")
+    p.add_argument("--notebooks", type=int, default=100)
+    p.add_argument("--jobs", type=int, default=20)
+    p.add_argument("--profiles", type=int, default=10)
+    args = p.parse_args(argv)
+    out = run_load(
+        notebooks=args.notebooks, jobs=args.jobs, profiles=args.profiles
+    )
+    print(json.dumps(out))
+    return 0 if out["notebooks_not_ready"] == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,30 @@
+"""Control-plane load test (tools/loadtest.py) — the reference's
+notebook-controller/loadtest/ run in-process and pinned in CI.
+
+Asserts convergence (every object reaches steady state under bulk load)
+and that reconcile work doesn't blow up super-linearly with store size —
+timing asserts are deliberately loose (CI machines vary); the load
+numbers themselves are reported by the tool, not pinned here.
+"""
+
+from kubeflow_tpu.tools.loadtest import run_load
+
+
+class TestControlPlaneLoad:
+    def test_bulk_load_converges(self):
+        out = run_load(notebooks=150, jobs=30, profiles=6)
+        assert out["notebooks_not_ready"] == 0
+        assert out["jobs_not_running"] == 0
+        assert out["objects"] == 186
+        # Floor, not a benchmark: catches accidental O(n^2) reconcile
+        # regressions (a livelocked drain would also trip max_iterations).
+        assert out["objects_per_sec"] > 20
+
+    def test_reconcile_loops_scale_linearly(self):
+        small = run_load(notebooks=50, jobs=10, profiles=5)
+        large = run_load(notebooks=200, jobs=40, profiles=5)
+        ratio = large["reconcile_loops"] / max(1, small["reconcile_loops"])
+        objects_ratio = large["objects"] / small["objects"]
+        # Loops per object must stay roughly constant: allow 3x headroom
+        # over linear before calling it a regression.
+        assert ratio < 3 * objects_ratio, (small, large)

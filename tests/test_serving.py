@@ -223,11 +223,33 @@ class TestPipelinedDecode:
         assert eng.result(long).tokens == ref.run()[0].tokens
 
 
-class TestQuantizedServing:
-    def test_int8_weights_are_int8_and_outputs_close(self, model_and_params):
-        import jax.numpy as jnp
-        import numpy as np
+class TestMoEServing:
+    def test_mixtral_generates(self):
+        """The engine is model-generic: the MoE family (top-2 routing,
+        per-layer losses collection) serves through the same cache/decode
+        path as dense Llama."""
+        from kubeflow_tpu.models import Mixtral, MixtralConfig
 
+        m = Mixtral(MixtralConfig.tiny(scan_layers=False))
+        params = {"params": m.init(
+            jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32)
+        )["params"]}
+        eng = ServingEngine(
+            m, params,
+            ServingConfig(max_batch=2, max_len=64, decode_chunk=4,
+                          prefill_buckets=(8,)),
+        )
+        eng.warmup(8)
+        rids = [eng.submit([1, 2, 3], max_new_tokens=5) for _ in range(3)]
+        eng.run()
+        outs = [eng.result(r).tokens for r in rids]
+        assert all(len(t) == 5 for t in outs)
+        # identical prompts, greedy -> identical continuations
+        assert outs[0] == outs[1] == outs[2]
+
+
+class TestQuantizedServing:
+    def test_int8_weights_quantized_and_logits_close(self, model_and_params):
         model, params = model_and_params
         eng = ServingEngine(
             model, params,
@@ -239,19 +261,24 @@ class TestQuantizedServing:
             if x.dtype == jnp.int8
         ]
         assert kernels, "no leaf was quantized"
-        ref = ServingEngine(model, params,
-                            ServingConfig(max_batch=1, max_len=128))
-        prompt = [3, 14, 15, 92]
-        q = eng.submit(prompt, max_new_tokens=8)
+        # Dequantised weights must reconstruct the original logits to
+        # int8 granularity: compare a forward pass through the
+        # dequantised tree against the pristine params (deterministic —
+        # unlike greedy token comparison on a random-init model).
+        deq = eng._materialize(eng.params)
+        tokens = jnp.asarray([[3, 14, 15, 92]], jnp.int32)
+        got = model.apply({"params": deq["params"]}, tokens)
+        want = model.apply(params, tokens)
+        g = np.asarray(got, np.float32)
+        w = np.asarray(want, np.float32)
+        denom = np.maximum(np.abs(w).max(), 1e-6)
+        assert np.abs(g - w).max() / denom < 0.05, (
+            np.abs(g - w).max(), denom
+        )
+        # And generation runs end-to-end on the quantized engine.
+        rid = eng.submit([3, 14, 15, 92], max_new_tokens=8)
         eng.run()
-        r = ref.submit(prompt, max_new_tokens=8)
-        ref.run()
-        got, want = eng.result(q).tokens, ref.result(r).tokens
-        # int8 weights perturb logits; greedy argmax on a random-init tiny
-        # model is chaotic, so pin only that generation runs end-to-end
-        # with the right shape, and that the first token (driven by the
-        # largest logit margins) usually survives quantization.
-        assert len(got) == len(want) == 8
+        assert len(eng.result(rid).tokens) == 8
 
     def test_rejects_unknown_scheme(self, model_and_params):
         model, params = model_and_params
