@@ -1,0 +1,251 @@
+"""Import HuggingFace Llama checkpoints into kubeflow_tpu param trees.
+
+The reference platform schedules opaque containers and has no notion of
+weight interop; a TPU-native framework needs one — users arrive with HF
+checkpoints. This converts ``LlamaForCausalLM`` state dicts (torch tensors
+or numpy arrays) into the exact flax tree `models.Llama` expects, for both
+the unrolled (``layer_{i}``) and ``nn.scan`` (stacked ``layers``) layouts.
+
+Conventions verified against the model code (tests/test_import_hf.py pins
+logit equality against the torch forward):
+- torch ``Linear.weight`` is [out, in]; our DenseGeneral kernels are
+  [in, *out], so weights transpose (and reshape per-head for q/k/v/o).
+- RoPE: both sides use the split-half (rotate_half) convention with the
+  same theta, so no head-dim permutation is needed.
+- ``tie_word_embeddings`` maps to LlamaConfig.tie_embeddings (no lm_head
+  kernel in the tree).
+
+Usage:
+  params, cfg = load_hf_llama("/path/to/hf-checkpoint-dir")
+  model = Llama(cfg)
+  logits = model.apply({"params": params}, tokens)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models import LlamaConfig
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor / numpy array -> numpy preserving the source dtype
+    (bf16 stays bf16 via ml_dtypes — an eager f32 upcast would double host
+    memory on checkpoints that are mostly bf16)."""
+    if isinstance(t, np.ndarray):
+        return t
+    try:
+        import torch
+
+        if isinstance(t, torch.Tensor):
+            t = t.detach().cpu()
+            if t.dtype == torch.bfloat16:
+                import ml_dtypes
+
+                return (
+                    t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+                )
+            return t.numpy()
+    except ImportError:
+        pass
+    return np.asarray(t)
+
+
+def config_from_hf(hf_cfg: Dict[str, Any], **overrides) -> LlamaConfig:
+    """Map an HF llama config dict to LlamaConfig. Raises on config
+    features the model does not implement — silently dropping them
+    (rope scaling, projection biases, a different activation) would
+    convert 'successfully' and produce wrong logits."""
+    unsupported = []
+    if hf_cfg.get("rope_scaling"):
+        unsupported.append(f"rope_scaling={hf_cfg['rope_scaling']!r}")
+    if hf_cfg.get("attention_bias"):
+        unsupported.append("attention_bias=True")
+    if hf_cfg.get("mlp_bias"):
+        unsupported.append("mlp_bias=True")
+    act = hf_cfg.get("hidden_act", "silu")
+    if act not in ("silu", "swish"):
+        unsupported.append(f"hidden_act={act!r}")
+    if unsupported:
+        raise ValueError(
+            "HF config uses features models.Llama does not implement: "
+            + ", ".join(unsupported)
+        )
+    heads = int(hf_cfg["num_attention_heads"])
+    head_dim = int(
+        hf_cfg.get("head_dim") or hf_cfg["hidden_size"] // heads
+    )
+    kw = dict(
+        vocab_size=int(hf_cfg["vocab_size"]),
+        embed_dim=int(hf_cfg["hidden_size"]),
+        num_layers=int(hf_cfg["num_hidden_layers"]),
+        num_heads=heads,
+        num_kv_heads=int(hf_cfg.get("num_key_value_heads") or heads),
+        head_dim=head_dim,
+        mlp_dim=int(hf_cfg["intermediate_size"]),
+        max_seq_len=int(hf_cfg.get("max_position_embeddings") or 2048),
+        rope_theta=float(hf_cfg.get("rope_theta") or 10000.0),
+        norm_eps=float(hf_cfg.get("rms_norm_eps") or 1e-5),
+        tie_embeddings=bool(hf_cfg.get("tie_word_embeddings", False)),
+    )
+    kw.update(overrides)
+    return LlamaConfig(**kw)
+
+
+def llama_params_from_state_dict(
+    sd: Dict[str, Any], cfg: LlamaConfig
+) -> Dict[str, Any]:
+    """Convert an HF LlamaForCausalLM state dict into the flax params tree
+    for ``Llama(cfg)`` (honours cfg.scan_layers and cfg.tie_embeddings)."""
+    E, H, Hkv, Dh = (
+        cfg.embed_dim, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
+    )
+
+    dt = cfg.param_dtype
+
+    def get(name: str) -> np.ndarray:
+        key = f"model.{name}"
+        if key not in sd and name in sd:
+            key = name
+        if key not in sd:
+            raise KeyError(f"state dict missing {key!r}")
+        # Pop as consumed and cast straight to the target dtype: the source
+        # tree is not needed again, and per-leaf casting keeps peak host
+        # memory at ~one model copy instead of several.
+        return np.asarray(_np(sd.pop(key)), dtype=dt)
+
+    def proj(name: str, heads: int) -> Dict[str, np.ndarray]:
+        w = get(name)                                  # [heads*Dh, E]
+        return {"kernel": np.ascontiguousarray(w.T).reshape(E, heads, Dh)}
+
+    def layer(i: int) -> Dict[str, Any]:
+        p = f"layers.{i}."
+        o_w = get(p + "self_attn.o_proj.weight")       # [E, H*Dh]
+        return {
+            "input_norm": {"weight": get(p + "input_layernorm.weight")},
+            "attn": {
+                "q_proj": proj(p + "self_attn.q_proj.weight", H),
+                "k_proj": proj(p + "self_attn.k_proj.weight", Hkv),
+                "v_proj": proj(p + "self_attn.v_proj.weight", Hkv),
+                "o_proj": {
+                    "kernel": np.ascontiguousarray(o_w.T)
+                    .reshape(H, Dh, E)
+                },
+            },
+            "post_attn_norm": {
+                "weight": get(p + "post_attention_layernorm.weight")
+            },
+            "mlp": {
+                "gate_proj": {
+                    "kernel": np.ascontiguousarray(
+                        get(p + "mlp.gate_proj.weight").T
+                    )
+                },
+                "up_proj": {
+                    "kernel": np.ascontiguousarray(
+                        get(p + "mlp.up_proj.weight").T
+                    )
+                },
+                "down_proj": {
+                    "kernel": np.ascontiguousarray(
+                        get(p + "mlp.down_proj.weight").T
+                    )
+                },
+            },
+        }
+
+    params: Dict[str, Any] = {
+        "embed": get("embed_tokens.weight"),
+        "final_norm": {"weight": get("norm.weight")},
+    }
+    layers = [layer(i) for i in range(cfg.num_layers)]
+    if cfg.scan_layers:
+        params["layers"] = jax.tree.map(
+            lambda *xs: np.stack(xs, axis=0), *layers
+        )
+    else:
+        for i, lp in enumerate(layers):
+            params[f"layer_{i}"] = lp
+    del layers
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {
+            "kernel": np.ascontiguousarray(get("lm_head.weight").T)
+        }
+    return jax.tree.map(lambda x: jnp.asarray(x, dt), params)
+
+
+def load_hf_llama(
+    path: str, *, scan_layers: bool = True, **cfg_overrides
+) -> Tuple[Dict[str, Any], LlamaConfig]:
+    """Load (params, cfg) from an HF checkpoint directory: reads
+    config.json plus *.safetensors (preferred) or pytorch_model*.bin."""
+    with open(os.path.join(path, "config.json")) as f:
+        cfg = config_from_hf(
+            json.load(f), scan_layers=scan_layers, **cfg_overrides
+        )
+    sd: Dict[str, Any] = {}
+    st_files = sorted(
+        f for f in os.listdir(path) if f.endswith(".safetensors")
+    )
+    if st_files:
+        from safetensors import safe_open
+
+        for fn in st_files:
+            with safe_open(os.path.join(path, fn), framework="np") as f:
+                for k in f.keys():
+                    sd[k] = f.get_tensor(k)
+    else:
+        import torch
+
+        bins = sorted(
+            f for f in os.listdir(path)
+            if f.startswith("pytorch_model") and f.endswith(".bin")
+        )
+        if not bins:
+            raise FileNotFoundError(
+                f"no *.safetensors or pytorch_model*.bin under {path}"
+            )
+        for fn in bins:
+            sd.update(torch.load(
+                os.path.join(path, fn), map_location="cpu",
+                weights_only=True,
+            ))
+    return llama_params_from_state_dict(sd, cfg), cfg
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="kftpu-import-hf")
+    p.add_argument("path", help="HF checkpoint directory")
+    p.add_argument("--out", required=True,
+                   help="orbax checkpoint dir to write")
+    p.add_argument("--no-scan-layers", action="store_true")
+    args = p.parse_args(argv)
+    params, cfg = load_hf_llama(
+        args.path, scan_layers=not args.no_scan_layers
+    )
+    # Write the trainer's CheckpointManager layout (step 0, tree with
+    # "params" + "step") — the format CheckpointService.restore_latest /
+    # restore_params_latest and therefore the serving handoff
+    # (Serving.spec.checkpoint_dir) actually consume.
+    from kubeflow_tpu.train.checkpoint import CheckpointService
+
+    svc = CheckpointService(args.out)
+    svc.save(0, {"params": params, "step": jnp.zeros((), jnp.int32)})
+    svc.close()
+    n = sum(x.size for x in jax.tree.leaves(params))
+    print(json.dumps({
+        "params": n, "layers": cfg.num_layers, "out": args.out,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
