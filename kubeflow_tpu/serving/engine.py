@@ -360,6 +360,18 @@ class ServingEngine:
     def result(self, rid: int) -> Optional[GenerationResult]:
         return self._results.get(rid)
 
+    def partial(self, rid: int) -> tuple:
+        """(tokens so far, finished) — the streaming front-end polls this
+        while the request is queued/decoding. Reads a live slot's token
+        list (safe under the GIL: the driver thread only appends)."""
+        res = self._results.get(rid)
+        if res is not None:
+            return list(res.tokens), True
+        for slot in self._slots:
+            if slot is not None and slot.req.request_id == rid:
+                return list(slot.generated), False
+        return [], False
+
     @property
     def active_slots(self) -> int:
         return sum(1 for s in self._slots if s is not None)

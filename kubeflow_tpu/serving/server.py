@@ -8,6 +8,8 @@ HTTP app over the continuous-batching engine:
   POST /v1/generate   {"tokens": [...], "max_new_tokens": N,
                        "temperature": t, "eos_token": id}
                       -> {"tokens": [...], "ttft_s": ..., "latency_s": ...}
+                      with "stream": true -> NDJSON chunks: {"tokens":
+                      [delta...]}* then {"done": true, ...metadata}
   GET  /v1/models     -> model + engine config
   GET  /healthz       -> readiness probe (the controller's and the
                          availability prober's poll target)
@@ -33,7 +35,13 @@ from typing import Any, Dict, Optional
 
 from kubeflow_tpu.serving.engine import ServingConfig, ServingEngine
 from kubeflow_tpu.utils import get_logger
-from kubeflow_tpu.webapps.router import JsonHttpServer, Request, RestError, Router
+from kubeflow_tpu.webapps.router import (
+    JsonHttpServer,
+    NdjsonStream,
+    Request,
+    RestError,
+    Router,
+)
 
 log = get_logger("serving")
 
@@ -98,6 +106,10 @@ class ServingServer:
                     except ValueError as e:
                         holder["error"] = str(e)
                         ev.set()
+                    finally:
+                        sub_ev = holder.get("submitted")
+                        if sub_ev is not None:
+                            sub_ev.set()
                     moved = True
             except queue.Empty:
                 pass
@@ -134,8 +146,21 @@ class ServingServer:
             kw["temperature"] = float(req.body["temperature"])
         if "eos_token" in req.body:
             kw["eos_token"] = int(req.body["eos_token"])
+        stream = bool(req.body.get("stream", False))
         holder: Dict[str, Any] = {}
         ev = threading.Event()
+        if stream:
+            # Wait for the driver to actually submit before committing a
+            # 200: validation failures (oversized prompt) must surface as
+            # the same 400 the non-stream path returns, not as an error
+            # chunk inside a successful stream.
+            holder["submitted"] = threading.Event()
+            self._submissions.put((tokens, kw, holder, ev))
+            if not holder["submitted"].wait(self.request_timeout_s):
+                raise RestError(504, "generation timed out")
+            if "error" in holder:
+                raise RestError(400, holder["error"])
+            return NdjsonStream(self._stream_chunks(holder["rid"], ev))
         self._submissions.put((tokens, kw, holder, ev))
         if not ev.wait(self.request_timeout_s):
             raise RestError(504, "generation timed out")
@@ -146,6 +171,38 @@ class ServingServer:
             raise RestError(500, self.error or "generation failed")
         return {
             "tokens": res.tokens,
+            "prompt_len": res.prompt_len,
+            "finished_reason": res.finished_reason,
+            "ttft_s": res.ttft_s,
+            "latency_s": res.latency_s,
+        }
+
+    def _stream_chunks(self, rid: int, ev: threading.Event):
+        """NDJSON token streaming: emits {"tokens": [...]} deltas as the
+        engine decodes (granularity = decode_chunk), then one final chunk
+        with the completion metadata. Mid-stream failures (engine death,
+        timeout) arrive as an {"error": ...} chunk — the 200 and headers
+        are already on the wire by then. ``ev`` fires on completion, so
+        the poll sleep doubles as the completion wait."""
+        deadline = time.time() + self.request_timeout_s
+        sent = 0
+        while True:
+            toks, finished = self.engine.partial(rid)
+            if len(toks) > sent:
+                yield {"tokens": toks[sent:]}
+                sent = len(toks)
+            if finished:
+                break
+            if time.time() > deadline:
+                yield {"error": "generation timed out"}
+                return
+            if self.error:
+                yield {"error": self.error}
+                return
+            ev.wait(0.005)
+        res = self.engine.result(rid)
+        yield {
+            "done": True,
             "prompt_len": res.prompt_len,
             "finished_reason": res.finished_reason,
             "ttft_s": res.ttft_s,

@@ -23,6 +23,7 @@ Usage:
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 from typing import Any, Dict, Optional, Tuple
@@ -102,23 +103,129 @@ def llama_params_from_state_dict(
     sd: Dict[str, Any], cfg: LlamaConfig
 ) -> Dict[str, Any]:
     """Convert an HF LlamaForCausalLM state dict into the flax params tree
-    for ``Llama(cfg)`` (honours cfg.scan_layers and cfg.tie_embeddings)."""
-    E, H, Hkv, Dh = (
-        cfg.embed_dim, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
-    )
+    for ``Llama(cfg)`` (honours cfg.scan_layers and cfg.tie_embeddings).
 
+    CONSUMES ``sd``: tensors are popped as they are converted so peak host
+    memory stays near one model copy — pass ``dict(sd)`` to keep yours."""
     dt = cfg.param_dtype
 
     def get(name: str) -> np.ndarray:
-        key = f"model.{name}"
-        if key not in sd and name in sd:
-            key = name
-        if key not in sd:
-            raise KeyError(f"state dict missing {key!r}")
-        # Pop as consumed and cast straight to the target dtype: the source
-        # tree is not needed again, and per-leaf casting keeps peak host
-        # memory at ~one model copy instead of several.
-        return np.asarray(_np(sd.pop(key)), dtype=dt)
+        return _take(sd, name, dt)
+
+    def mlp(i: int) -> Dict[str, Any]:
+        p = f"layers.{i}.mlp."
+        return {
+            "gate_proj": {
+                "kernel": np.ascontiguousarray(get(p + "gate_proj.weight").T)
+            },
+            "up_proj": {
+                "kernel": np.ascontiguousarray(get(p + "up_proj.weight").T)
+            },
+            "down_proj": {
+                "kernel": np.ascontiguousarray(get(p + "down_proj.weight").T)
+            },
+        }
+
+    params = _llama_attn_tree(sd, cfg)
+    _graft_per_layer(params, "mlp", [mlp(i) for i in range(cfg.num_layers)],
+                     cfg.scan_layers)
+    return jax.tree.map(lambda x: jnp.asarray(x, dt), params)
+
+
+def _take(sd: Dict[str, Any], name: str, dt) -> np.ndarray:
+    """Pop ``model.<name>`` (or bare ``<name>``) from the state dict and
+    cast to the target dtype — popping as consumed keeps peak host memory
+    near one model copy."""
+    key = f"model.{name}" if f"model.{name}" in sd else name
+    if key not in sd:
+        raise KeyError(f"state dict missing {key!r}")
+    return np.asarray(_np(sd.pop(key)), dtype=dt)
+
+
+def _graft_per_layer(params, key, blocks, scan_layers: bool) -> None:
+    """Attach per-layer subtree ``blocks`` under each layer (stacked when
+    scan_layers)."""
+    if scan_layers:
+        params["layers"][key] = jax.tree.map(
+            lambda *xs: np.stack(xs, axis=0), *blocks
+        )
+    else:
+        for i, b in enumerate(blocks):
+            params[f"layer_{i}"][key] = b
+
+
+def mixtral_config_from_hf(hf_cfg: Dict[str, Any], **overrides):
+    """Map an HF mixtral config dict to MixtralConfig (same checks as
+    the llama mapping plus the MoE fields)."""
+    from kubeflow_tpu.models import MixtralConfig
+
+    base = config_from_hf(hf_cfg)
+    kw = {
+        f.name: getattr(base, f.name)
+        for f in dataclasses.fields(LlamaConfig)
+        if f.name in {x.name for x in dataclasses.fields(MixtralConfig)}
+    }
+    kw.update(
+        num_experts=int(hf_cfg["num_local_experts"]),
+        aux_loss_weight=float(hf_cfg.get("router_aux_loss_coef") or 0.02),
+    )
+    if int(hf_cfg.get("num_experts_per_tok", 2)) != 2:
+        raise ValueError(
+            "models.Mixtral implements top-2 routing; "
+            f"num_experts_per_tok={hf_cfg['num_experts_per_tok']}"
+        )
+    kw.update(overrides)
+    return MixtralConfig(**kw)
+
+
+def mixtral_params_from_state_dict(
+    sd: Dict[str, Any], cfg
+) -> Dict[str, Any]:
+    """Convert an HF MixtralForCausalLM state dict (attention identical to
+    llama; block_sparse_moe: gate router + experts.{e}.{w1=gate, w3=up,
+    w2=down}) into the flax tree for ``Mixtral(cfg)``. CONSUMES ``sd``
+    like the llama converter."""
+    dt = cfg.param_dtype
+
+    def get(name: str) -> np.ndarray:
+        return _take(sd, name, dt)
+
+    def moe_block(i: int) -> Dict[str, Any]:
+        p = f"layers.{i}.block_sparse_moe."
+
+        def bank(w: str) -> np.ndarray:
+            return np.stack([
+                np.ascontiguousarray(get(p + f"experts.{e}.{w}.weight").T)
+                for e in range(cfg.num_experts)
+            ])
+
+        return {
+            "router": {
+                "kernel": np.ascontiguousarray(get(p + "gate.weight").T)
+            },
+            "w_gate": bank("w1"),        # [n_exp, E, M]
+            "w_up": bank("w3"),
+            "w_down": bank("w2"),        # [n_exp, M, E]
+        }
+
+    params = _llama_attn_tree(sd, cfg)
+    _graft_per_layer(
+        params, "moe", [moe_block(i) for i in range(cfg.num_layers)],
+        cfg.scan_layers,
+    )
+    return jax.tree.map(lambda x: jnp.asarray(x, dt), params)
+
+
+def _llama_attn_tree(sd: Dict[str, Any], cfg: LlamaConfig) -> Dict[str, Any]:
+    """The llama conversion minus the dense-MLP blocks (shared by the
+    mixtral path, whose MLP is the expert bank)."""
+    E, H, Hkv, Dh = (
+        cfg.embed_dim, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
+    )
+    dt = cfg.param_dtype
+
+    def get(name: str) -> np.ndarray:
+        return _take(sd, name, dt)
 
     def proj(name: str, heads: int) -> Dict[str, np.ndarray]:
         w = get(name)                                  # [heads*Dh, E]
@@ -126,7 +233,7 @@ def llama_params_from_state_dict(
 
     def layer(i: int) -> Dict[str, Any]:
         p = f"layers.{i}."
-        o_w = get(p + "self_attn.o_proj.weight")       # [E, H*Dh]
+        o_w = get(p + "self_attn.o_proj.weight")
         return {
             "input_norm": {"weight": get(p + "input_layernorm.weight")},
             "attn": {
@@ -140,23 +247,6 @@ def llama_params_from_state_dict(
             },
             "post_attn_norm": {
                 "weight": get(p + "post_attention_layernorm.weight")
-            },
-            "mlp": {
-                "gate_proj": {
-                    "kernel": np.ascontiguousarray(
-                        get(p + "mlp.gate_proj.weight").T
-                    )
-                },
-                "up_proj": {
-                    "kernel": np.ascontiguousarray(
-                        get(p + "mlp.up_proj.weight").T
-                    )
-                },
-                "down_proj": {
-                    "kernel": np.ascontiguousarray(
-                        get(p + "mlp.down_proj.weight").T
-                    )
-                },
             },
         }
 
@@ -172,23 +262,53 @@ def llama_params_from_state_dict(
     else:
         for i, lp in enumerate(layers):
             params[f"layer_{i}"] = lp
-    del layers
     if not cfg.tie_embeddings:
         params["lm_head"] = {
             "kernel": np.ascontiguousarray(get("lm_head.weight").T)
         }
-    return jax.tree.map(lambda x: jnp.asarray(x, dt), params)
+    return params
+
+
+def load_hf(
+    path: str, *, scan_layers: bool = True, **cfg_overrides
+) -> Tuple[Dict[str, Any], Any]:
+    """Load (params, cfg) from an HF checkpoint directory — dispatches on
+    config.json model_type ("llama" or "mixtral"); reads *.safetensors
+    (preferred) or pytorch_model*.bin."""
+    with open(os.path.join(path, "config.json")) as f:
+        hf_cfg = json.load(f)
+    family = hf_cfg.get("model_type", "llama")
+    if family == "mixtral":
+        cfg = mixtral_config_from_hf(
+            hf_cfg, scan_layers=scan_layers, **cfg_overrides
+        )
+        convert = mixtral_params_from_state_dict
+    elif family == "llama":
+        cfg = config_from_hf(
+            hf_cfg, scan_layers=scan_layers, **cfg_overrides
+        )
+        convert = llama_params_from_state_dict
+    else:
+        raise ValueError(f"unsupported model_type {family!r}")
+    sd = _load_state_dict(path)
+    return convert(sd, cfg), cfg
 
 
 def load_hf_llama(
     path: str, *, scan_layers: bool = True, **cfg_overrides
 ) -> Tuple[Dict[str, Any], LlamaConfig]:
-    """Load (params, cfg) from an HF checkpoint directory: reads
-    config.json plus *.safetensors (preferred) or pytorch_model*.bin."""
-    with open(os.path.join(path, "config.json")) as f:
-        cfg = config_from_hf(
-            json.load(f), scan_layers=scan_layers, **cfg_overrides
+    """Llama-only wrapper over ``load_hf`` (rejects other families)."""
+    params, cfg = load_hf(
+        path, scan_layers=scan_layers, **cfg_overrides
+    )
+    if not isinstance(cfg, LlamaConfig) or type(cfg) is not LlamaConfig:
+        raise ValueError(
+            f"{path!r} is not a llama checkpoint (got {type(cfg).__name__})"
         )
+    return params, cfg
+
+
+def _load_state_dict(path: str) -> Dict[str, Any]:
     sd: Dict[str, Any] = {}
     st_files = sorted(
         f for f in os.listdir(path) if f.endswith(".safetensors")
@@ -216,7 +336,7 @@ def load_hf_llama(
                 os.path.join(path, fn), map_location="cpu",
                 weights_only=True,
             ))
-    return llama_params_from_state_dict(sd, cfg), cfg
+    return sd
 
 
 def main(argv: Optional[list] = None) -> int:
@@ -228,7 +348,7 @@ def main(argv: Optional[list] = None) -> int:
                    help="orbax checkpoint dir to write")
     p.add_argument("--no-scan-layers", action="store_true")
     args = p.parse_args(argv)
-    params, cfg = load_hf_llama(
+    params, cfg = load_hf(
         args.path, scan_layers=not args.no_scan_layers
     )
     # Write the trainer's CheckpointManager layout (step 0, tree with

@@ -34,6 +34,15 @@ class Html(str):
     pages); everything else stays JSON."""
 
 
+class NdjsonStream:
+    """Handler return type for streaming responses: an iterator of
+    JSON-able payloads written as newline-delimited JSON with chunked
+    transfer encoding (the serving front-end's token streaming)."""
+
+    def __init__(self, chunks):
+        self.chunks = chunks
+
+
 @dataclasses.dataclass
 class Request:
     method: str
@@ -112,10 +121,34 @@ class JsonHttpServer:
         hdr = user_id_header
 
         class _Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1: chunked Transfer-Encoding (NdjsonStream) is not
+            # defined for the default HTTP/1.0; every non-stream response
+            # sends Content-Length, so keep-alive semantics stay correct.
+            protocol_version = "HTTP/1.1"
+            # Bound idle keep-alive connections: without a timeout each
+            # persistent connection pins a ThreadingHTTPServer thread
+            # forever in readline() (HTTP/1.0 used to close per response).
+            timeout = 65
+
             def log_message(self, *a):
                 pass
 
             def _serve(self, method: str) -> None:
+                if "chunked" in (
+                    self.headers.get("Transfer-Encoding") or ""
+                ).lower():
+                    # Body parsing is Content-Length-only; silently reading
+                    # an empty body would leave chunk framing on the wire
+                    # and desync the keep-alive connection.
+                    self._send(411, {
+                        "error": "chunked request bodies unsupported; "
+                                 "send Content-Length"
+                    })
+                    self.close_connection = True
+                    return
+                self._serve_inner(method)
+
+            def _serve_inner(self, method: str) -> None:
                 url = urlparse(self.path)
                 n = int(self.headers.get("Content-Length", "0") or 0)
                 raw = self.rfile.read(n) if n else b""
@@ -146,6 +179,29 @@ class JsonHttpServer:
                 self._send(status, payload)
 
             def _send(self, status: int, payload: Any) -> None:
+                if isinstance(payload, NdjsonStream):
+                    self.send_response(status)
+                    self.send_header("Content-Type", "application/x-ndjson")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    try:
+                        for chunk in payload.chunks:
+                            data = (json.dumps(chunk) + "\n").encode()
+                            self.wfile.write(
+                                f"{len(data):x}\r\n".encode() + data
+                                + b"\r\n"
+                            )
+                            self.wfile.flush()
+                        self.wfile.write(b"0\r\n\r\n")
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass             # client went away mid-stream
+                    except Exception as e:  # generator bug: end the
+                        log.error("stream error",   # stream, keep thread
+                                  kv={"err": repr(e)})
+                    # The chunk framing may be incomplete on any error
+                    # path above — never reuse this connection.
+                    self.close_connection = True
+                    return
                 if isinstance(payload, Html):
                     ctype, data = "text/html; charset=utf-8", payload.encode()
                 else:

@@ -111,3 +111,50 @@ def test_unsupported_features_raise():
         config_from_hf(dict(HF_CFG, attention_bias=True))
     with _pytest.raises(ValueError, match="hidden_act"):
         config_from_hf(dict(HF_CFG, hidden_act="gelu"))
+
+
+MIXTRAL_HF_CFG = dict(
+    vocab_size=128,
+    hidden_size=64,
+    intermediate_size=96,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    max_position_embeddings=128,
+    rms_norm_eps=1e-5,
+    rope_theta=10000.0,
+    tie_word_embeddings=False,
+    num_local_experts=4,
+    num_experts_per_tok=2,
+    router_aux_loss_coef=0.02,
+)
+
+
+@pytest.mark.parametrize("scan_layers", [False, True])
+def test_mixtral_logits_match_torch(scan_layers):
+    from kubeflow_tpu.models import Mixtral
+    from kubeflow_tpu.tools.import_hf import (
+        mixtral_config_from_hf,
+        mixtral_params_from_state_dict,
+    )
+
+    tcfg = transformers.MixtralConfig(**MIXTRAL_HF_CFG)
+    torch.manual_seed(0)
+    tm = transformers.MixtralForCausalLM(tcfg)
+    tm.eval()
+    # capacity_factor high enough that no token is dropped — HF has no
+    # capacity limit, so parity only holds drop-free.
+    cfg = mixtral_config_from_hf(
+        MIXTRAL_HF_CFG, scan_layers=scan_layers, remat=False,
+        capacity_factor=8.0,
+        param_dtype=jnp.float32, dtype=jnp.float32,
+    )
+    params = mixtral_params_from_state_dict(tm.state_dict(), cfg)
+    tokens = np.array([[3, 14, 15, 92, 65, 35], [8, 9, 7, 9, 3, 2]])
+    with torch.no_grad():
+        want = tm(torch.tensor(tokens)).logits.numpy()
+    got = np.asarray(
+        Mixtral(cfg).apply({"params": params}, jnp.asarray(tokens)),
+        np.float32,
+    )
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)

@@ -363,6 +363,63 @@ class TestServingServer:
         finally:
             server.stop()
 
+    def test_http_streaming_generate(self, model_and_params):
+        """stream=true returns NDJSON token deltas followed by a done
+        chunk; concatenated deltas equal the non-streaming result."""
+        model, params = model_and_params
+        engine = ServingEngine(
+            model, params,
+            ServingConfig(max_batch=2, max_len=128, decode_chunk=2),
+        )
+        server = ServingServer(engine, model_name="llama-test").start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            prompt = [3, 14, 15, 92, 65]
+            req = urllib.request.Request(
+                f"{base}/v1/generate",
+                data=json.dumps({
+                    "tokens": prompt, "max_new_tokens": 6, "stream": True,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            chunks = []
+            with urllib.request.urlopen(req) as r:
+                assert r.headers["Content-Type"] == "application/x-ndjson"
+                for line in r:
+                    chunks.append(json.loads(line))
+            toks = [t for c in chunks if "tokens" in c for t in c["tokens"]]
+            done = chunks[-1]
+            assert done.get("done") is True
+            assert done["prompt_len"] == len(prompt)
+            assert toks == greedy_reference(model, params, prompt, 6)
+            # at least one token delta preceded the done chunk (chunk
+            # COUNT is thread-scheduling dependent, so don't pin it)
+            assert sum(1 for c in chunks if "tokens" in c) >= 1
+        finally:
+            server.stop()
+
+    def test_streaming_submission_error_is_400(self, model_and_params):
+        """Validation failures must be the same HTTP 400 for stream=true —
+        not a 200 with an error chunk."""
+        model, params = model_and_params
+        engine = ServingEngine(model, params,
+                               ServingConfig(max_batch=1, max_len=32,
+                                             prefill_buckets=(8,)))
+        server = ServingServer(engine).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/v1/generate",
+                data=json.dumps({
+                    "tokens": list(range(50)), "stream": True,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req)
+            assert exc.value.code == 400
+        finally:
+            server.stop()
+
     def test_oversized_prompt_rejected_not_fatal(self, model_and_params):
         """A prompt beyond the largest prefill bucket must 400 — and must
         NOT kill the engine driver (the server stays serviceable)."""
