@@ -257,6 +257,9 @@ class ServingSpec:
     # Train->serve handoff: restore params from this TpuJob checkpoint dir
     # (empty = fresh init, dev/demo only).
     checkpoint_dir: str = ""
+    # Path to a tokenizer.json (or a dir holding one) mounted in the pod:
+    # enables the server's {"text": ...} request/response surface.
+    tokenizer: str = ""
 
 
 @dataclasses.dataclass

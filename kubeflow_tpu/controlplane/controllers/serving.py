@@ -172,6 +172,9 @@ class ServingController(Controller):
             EnvVar("KFTPU_SERVING_MAX_LEN", str(sv.spec.max_len)),
             EnvVar("KFTPU_SERVING_DECODE_CHUNK", str(sv.spec.decode_chunk)),
         ]
+        if getattr(sv.spec, "tokenizer", ""):
+            env.append(EnvVar("KFTPU_SERVING_TOKENIZER",
+                              sv.spec.tokenizer))
         if sv.spec.checkpoint_dir:
             env.append(EnvVar("KFTPU_SERVING_CHECKPOINT_DIR",
                               sv.spec.checkpoint_dir))

@@ -58,10 +58,16 @@ class ServingServer:
         host: str = "127.0.0.1",
         port: int = 0,
         request_timeout_s: float = 120.0,
+        tokenizer=None,
     ):
         self.engine = engine
         self.model_name = model_name
         self.request_timeout_s = request_timeout_s
+        # Optional ``tokenizers.Tokenizer``: lets /v1/generate accept
+        # {"text": ...} and return decoded text alongside token ids (the
+        # reference's TF-Serving analogue speaks raw tensors only; a text
+        # front door is table stakes for an LLM platform).
+        self.tokenizer = tokenizer
         self.error = ""                  # set when the engine loop degrades
         self._submissions: "queue.Queue[tuple]" = queue.Queue()
         self._events: Dict[int, threading.Event] = {}
@@ -135,6 +141,17 @@ class ServingServer:
 
     def _generate(self, req: Request) -> Any:
         tokens = req.body.get("tokens")
+        if tokens is None and "text" in req.body:
+            if self.tokenizer is None:
+                raise RestError(
+                    400, "body.text requires a server-side tokenizer "
+                         "(KFTPU_SERVING_TOKENIZER)"
+                )
+            if not isinstance(req.body["text"], str):
+                raise RestError(400, "body.text must be a string")
+            tokens = list(self.tokenizer.encode(req.body["text"]).ids)
+            if not tokens:
+                raise RestError(400, "body.text tokenised to nothing")
         if not isinstance(tokens, list) or not all(
             isinstance(t, int) for t in tokens
         ):
@@ -169,13 +186,16 @@ class ServingServer:
         res = self.engine.result(holder["rid"])
         if res is None:
             raise RestError(500, self.error or "generation failed")
-        return {
+        out = {
             "tokens": res.tokens,
             "prompt_len": res.prompt_len,
             "finished_reason": res.finished_reason,
             "ttft_s": res.ttft_s,
             "latency_s": res.latency_s,
         }
+        if self.tokenizer is not None:
+            out["text"] = self.tokenizer.decode(res.tokens)
+        return out
 
     def _stream_chunks(self, rid: int, ev: threading.Event):
         """NDJSON token streaming: emits {"tokens": [...]} deltas as the
@@ -201,13 +221,18 @@ class ServingServer:
                 return
             ev.wait(0.005)
         res = self.engine.result(rid)
-        yield {
+        done = {
             "done": True,
             "prompt_len": res.prompt_len,
             "finished_reason": res.finished_reason,
             "ttft_s": res.ttft_s,
             "latency_s": res.latency_s,
         }
+        if self.tokenizer is not None:
+            # Full-text decode only in the terminal chunk: decoding token
+            # deltas independently would split multi-token graphemes.
+            done["text"] = self.tokenizer.decode(res.tokens)
+        yield done
 
     def _models(self, req: Request) -> Any:
         cfg = self.engine.model.cfg
@@ -252,6 +277,9 @@ def env_config() -> dict:
         # dir (the same orbax tree the trainer writes).
         "checkpoint_dir": os.environ.get(
             "KFTPU_SERVING_CHECKPOINT_DIR", ""),
+        # Optional tokenizer.json (or a dir containing one): enables the
+        # {"text": ...} request/response surface.
+        "tokenizer": os.environ.get("KFTPU_SERVING_TOKENIZER", ""),
     }
 
 
@@ -300,8 +328,18 @@ def build_server(cfg: dict) -> ServingServer:
                       decode_chunk=cfg["decode_chunk"]),
         mesh=mesh,
     )
+    tokenizer = None
+    if cfg.get("tokenizer"):
+        from tokenizers import Tokenizer
+
+        tok_path = cfg["tokenizer"]
+        if os.path.isdir(tok_path):
+            tok_path = os.path.join(tok_path, "tokenizer.json")
+        tokenizer = Tokenizer.from_file(tok_path)
+        log.info("tokenizer loaded", kv={"path": tok_path})
     return ServingServer(
         engine, model_name=cfg["model"], host=cfg["host"], port=cfg["port"],
+        tokenizer=tokenizer,
     )
 
 

@@ -167,12 +167,20 @@ def mixtral_config_from_hf(hf_cfg: Dict[str, Any], **overrides):
     }
     kw.update(
         num_experts=int(hf_cfg["num_local_experts"]),
-        aux_loss_weight=float(hf_cfg.get("router_aux_loss_coef") or 0.02),
+        # .get with default, NOT `or`: an explicit 0.0 (aux loss disabled)
+        # must survive the import.
+        aux_loss_weight=float(hf_cfg.get("router_aux_loss_coef", 0.02)),
     )
     if int(hf_cfg.get("num_experts_per_tok", 2)) != 2:
         raise ValueError(
             "models.Mixtral implements top-2 routing; "
             f"num_experts_per_tok={hf_cfg['num_experts_per_tok']}"
+        )
+    if hf_cfg.get("sliding_window") is not None:
+        raise ValueError(
+            "models.Mixtral has no sliding-window attention; "
+            f"sliding_window={hf_cfg['sliding_window']} would silently "
+            "change what long sequences attend to"
         )
     kw.update(overrides)
     return MixtralConfig(**kw)
@@ -297,15 +305,15 @@ def load_hf(
 def load_hf_llama(
     path: str, *, scan_layers: bool = True, **cfg_overrides
 ) -> Tuple[Dict[str, Any], LlamaConfig]:
-    """Llama-only wrapper over ``load_hf`` (rejects other families)."""
-    params, cfg = load_hf(
-        path, scan_layers=scan_layers, **cfg_overrides
-    )
-    if not isinstance(cfg, LlamaConfig) or type(cfg) is not LlamaConfig:
+    """Llama-only wrapper over ``load_hf`` — rejects other families from
+    config.json BEFORE loading gigabytes of weights."""
+    with open(os.path.join(path, "config.json")) as f:
+        family = json.load(f).get("model_type", "llama")
+    if family != "llama":
         raise ValueError(
-            f"{path!r} is not a llama checkpoint (got {type(cfg).__name__})"
+            f"{path!r} is not a llama checkpoint (model_type={family!r})"
         )
-    return params, cfg
+    return load_hf(path, scan_layers=scan_layers, **cfg_overrides)
 
 
 def _load_state_dict(path: str) -> Dict[str, Any]:

@@ -180,19 +180,27 @@ class JsonHttpServer:
 
             def _send(self, status: int, payload: Any) -> None:
                 if isinstance(payload, NdjsonStream):
+                    # HTTP/1.0 clients cannot parse chunked transfer
+                    # coding: stream to them close-delimited (raw NDJSON,
+                    # end of body == connection close).
+                    chunked = self.request_version != "HTTP/1.0"
                     self.send_response(status)
                     self.send_header("Content-Type", "application/x-ndjson")
-                    self.send_header("Transfer-Encoding", "chunked")
+                    if chunked:
+                        self.send_header("Transfer-Encoding", "chunked")
                     self.end_headers()
                     try:
                         for chunk in payload.chunks:
                             data = (json.dumps(chunk) + "\n").encode()
-                            self.wfile.write(
-                                f"{len(data):x}\r\n".encode() + data
-                                + b"\r\n"
-                            )
+                            if chunked:
+                                data = (
+                                    f"{len(data):x}\r\n".encode() + data
+                                    + b"\r\n"
+                                )
+                            self.wfile.write(data)
                             self.wfile.flush()
-                        self.wfile.write(b"0\r\n\r\n")
+                        if chunked:
+                            self.wfile.write(b"0\r\n\r\n")
                     except (BrokenPipeError, ConnectionResetError):
                         pass             # client went away mid-stream
                     except Exception as e:  # generator bug: end the

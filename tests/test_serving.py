@@ -398,6 +398,60 @@ class TestServingServer:
         finally:
             server.stop()
 
+    def test_text_in_text_out_with_tokenizer(self, model_and_params, tmp_path):
+        """A server-side tokenizer enables the {"text": ...} surface: text
+        prompts encode, responses carry decoded text."""
+        from tokenizers import Tokenizer, models as tok_models
+        from tokenizers import pre_tokenizers
+
+        vocab = {"<unk>": 0, "hello": 1, "tpu": 2}
+        vocab.update({f"w{i}": 3 + i for i in range(60)})
+        tok = Tokenizer(tok_models.WordLevel(vocab, unk_token="<unk>"))
+        tok.pre_tokenizer = pre_tokenizers.Whitespace()
+        tok_file = tmp_path / "tokenizer.json"
+        tok.save(str(tok_file))
+
+        model, params = model_and_params
+        engine = ServingEngine(model, params,
+                               ServingConfig(max_batch=2, max_len=128))
+        server = ServingServer(
+            engine, tokenizer=Tokenizer.from_file(str(tok_file)),
+        ).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            req = urllib.request.Request(
+                f"{base}/v1/generate",
+                data=json.dumps(
+                    {"text": "hello tpu", "max_new_tokens": 4}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            out = json.load(urllib.request.urlopen(req))
+            assert out["prompt_len"] == 2          # "hello tpu" -> [1, 2]
+            assert out["tokens"] == greedy_reference(
+                model, params, [1, 2], 4
+            )
+            assert isinstance(out["text"], str)
+        finally:
+            server.stop()
+
+    def test_text_without_tokenizer_is_400(self, model_and_params):
+        model, params = model_and_params
+        engine = ServingEngine(model, params,
+                               ServingConfig(max_batch=1, max_len=64))
+        server = ServingServer(engine).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/v1/generate",
+                data=json.dumps({"text": "hi"}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req)
+            assert exc.value.code == 400
+        finally:
+            server.stop()
+
     def test_streaming_submission_error_is_400(self, model_and_params):
         """Validation failures must be the same HTTP 400 for stream=true —
         not a 200 with an error chunk."""
