@@ -106,6 +106,7 @@ class ProcessKubelet(Controller):
 
     NAME = "process-kubelet"
     WATCH_KINDS = ("Pod",)
+    LOG_PATH_ANNOTATION = "tpu.kubeflow.org/log-path"
 
     def __init__(
         self,
@@ -143,13 +144,14 @@ class ProcessKubelet(Controller):
         # must not share termination/log channels.
         stem = f"{pod.metadata.namespace}__{pod.metadata.name}"
         term = os.path.join(self.log_dir, f"{stem}.term")
+        logpath = self.log_path(pod.metadata.name, pod.metadata.namespace)
         env = dict(os.environ)
         env.update(self.base_env)
         env.update({e.name: e.value for e in c.env})
         env["KFTPU_TERMINATION_LOG"] = term
         if self.env_overrides is not None:
             env.update(self.env_overrides(pod))
-        logf = open(os.path.join(self.log_dir, f"{stem}.log"), "ab")
+        logf = open(logpath, "ab")
         self._procs[key] = subprocess.Popen(
             cmd, env=env, stdout=logf, stderr=subprocess.STDOUT,
         )
@@ -192,12 +194,22 @@ class ProcessKubelet(Controller):
             # the OLD generation — kill it so the new pod can spawn.
             self._kill(key)
         if pod.status.phase == "Pending" and key not in self._procs:
+            # Annotate the log path BEFORE spawning: an update conflict
+            # then simply requeues with nothing started, whereas failing
+            # between spawn and the Running write would strand a live
+            # process behind a forever-Pending pod.
+            pod.metadata.annotations[self.LOG_PATH_ANNOTATION] = \
+                self.log_path(name, namespace)
+            pod = self.api.update(pod)
             self._spawn(pod)
             pod.status.phase = "Running"
             pod.status.pod_ip = "127.0.0.1"
             pod.status.node_name = "local"
             self.api.update_status(pod)
         return Result()
+
+    def log_path(self, name: str, namespace: str) -> str:
+        return os.path.join(self.log_dir, f"{namespace}__{name}.log")
 
     def sync(self) -> int:
         """Harvest exited processes into pod phases. Returns the number of

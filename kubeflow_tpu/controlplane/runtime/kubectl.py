@@ -149,6 +149,10 @@ class KubectlApiServer:
 
     # ----------------- CRUD -----------------
 
+    def pod_logs(self, name: str, namespace: str = "default") -> str:
+        """Container logs via ``kubectl logs`` (tpuctl logs backend)."""
+        return self._run(["logs", name, "-n", namespace or "default"])
+
     def create(self, obj: Any) -> Any:
         out = self._run(["create", "-f", "-", "-o", "json"],
                         stdin=self._manifest(obj))

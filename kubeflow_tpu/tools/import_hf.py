@@ -167,9 +167,12 @@ def mixtral_config_from_hf(hf_cfg: Dict[str, Any], **overrides):
     }
     kw.update(
         num_experts=int(hf_cfg["num_local_experts"]),
-        # .get with default, NOT `or`: an explicit 0.0 (aux loss disabled)
-        # must survive the import.
-        aux_loss_weight=float(hf_cfg.get("router_aux_loss_coef", 0.02)),
+        # Explicit 0.0 (aux loss disabled) must survive; absent OR null
+        # falls back to the HF default.
+        aux_loss_weight=(
+            0.02 if hf_cfg.get("router_aux_loss_coef") is None
+            else float(hf_cfg["router_aux_loss_coef"])
+        ),
     )
     if int(hf_cfg.get("num_experts_per_tok", 2)) != 2:
         raise ValueError(

@@ -15,18 +15,20 @@ Usage:
   tpuctl status --state-dir .tpuctl
   tpuctl delete -f job.yaml | --kind TpuJob --name x -n ns  --state-dir .tpuctl
   tpuctl metrics --state-dir .tpuctl
+  tpuctl logs   <pod | tpujob> -n ns   (gang logs; kubectl logs passthrough)
 
 Backends (--backend):
   state    (default) the embedded Platform: in-memory apiserver + local
            controllers, state persisted under --state-dir.
   kubectl  a real cluster through the kubectl adapter (controllers are
-           expected to run in-cluster; apply/get/delete only).
+           expected to run in-cluster; apply/get/delete/logs).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import List
 
@@ -173,6 +175,70 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_logs(args) -> int:
+    """Worker logs for a pod or a whole TpuJob's gang.
+
+    kubectl backend: ``kubectl logs <pod>``, falling back to the gang's
+    label selector for a TpuJob name. State backend: pods executed by the
+    ProcessKubelet carry a log-path annotation (their captured
+    stdout/stderr file); fake-kubelet pods have no process, so their
+    phase + termination message is shown instead."""
+    from kubeflow_tpu.controlplane.controllers.podrunner import (
+        ProcessKubelet,
+    )
+    from kubeflow_tpu.controlplane.controllers.tpujob import JOB_LABEL
+
+    ns = args.namespace or "default"
+    if args.backend == "kubectl":
+        from kubeflow_tpu.controlplane.runtime.apiserver import ApiError
+
+        api = _kubectl_api(args)
+        try:
+            sys.stdout.write(api.pod_logs(args.name, namespace=ns))
+            return 0
+        except ApiError:
+            pods = api.list("Pod", namespace=ns,
+                            label_selector={JOB_LABEL: args.name})
+            if not pods:
+                print(f"no pod or TpuJob {args.name!r} in {ns}",
+                      file=sys.stderr)
+                return 1
+            for p in sorted(pods, key=lambda p: p.metadata.name):
+                print(f"==> {ns}/{p.metadata.name} <==")
+                sys.stdout.write(
+                    api.pod_logs(p.metadata.name, namespace=ns)
+                )
+            return 0
+    platform = Platform.load(args.state_dir)
+    pod = platform.api.try_get("Pod", args.name, ns)
+    if pod is not None:
+        pods = [pod]
+    else:
+        pods = platform.api.list(
+            "Pod", namespace=ns, label_selector={JOB_LABEL: args.name}
+        )
+        if not pods:
+            print(f"no pod or TpuJob {args.name!r} in {ns}",
+                  file=sys.stderr)
+            return 1
+    for p in sorted(pods, key=lambda p: p.metadata.name):
+        header = f"==> {p.metadata.namespace}/{p.metadata.name} " \
+                 f"[{p.status.phase}] <=="
+        print(header)
+        path = p.metadata.annotations.get(
+            ProcessKubelet.LOG_PATH_ANNOTATION, ""
+        )
+        if path and os.path.exists(path):
+            with open(path, errors="replace") as f:
+                sys.stdout.write(f.read())
+        elif p.status.termination_message:
+            print(f"(no log file; termination message) "
+                  f"{p.status.termination_message}")
+        else:
+            print("(no log file captured for this pod)")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpuctl",
                                 description="TPU-native Kubeflow control CLI")
@@ -206,6 +272,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     mp = sub.add_parser("metrics", help="dump platform metrics")
     mp.set_defaults(fn=cmd_metrics)
+
+    lp = sub.add_parser("logs", help="worker logs for a pod / TpuJob gang")
+    lp.add_argument("name")
+    lp.add_argument("-n", "--namespace", default=None)
+    lp.set_defaults(fn=cmd_logs)
     return p
 
 

@@ -145,3 +145,43 @@ class TestTpuctl:
         rc, out = _run(["--state-dir", state, "metrics"], capsys)
         assert rc == 0
         assert "# TYPE kftpu_tpujob_reconcile_total counter" in out
+
+
+class TestTpuctlLogs:
+    def test_logs_for_job_gang(self, tmp_path, capsys):
+        state = str(tmp_path / "state")
+        pf = _write(tmp_path, "platform.yaml", PLATFORM_YAML)
+        prof = _write(tmp_path, "profile.yaml", PROFILE_YAML)
+        job = _write(tmp_path, "job.yaml", JOB_YAML)
+        rc, _ = _run(["--state-dir", state, "apply", "-f", pf, "-f", prof,
+                      "-f", job], capsys)
+        assert rc == 0
+
+        # FakeKubelet pods have no process: the command reports phases and
+        # any termination message instead of file contents.
+        rc, out = _run(["--state-dir", state, "logs", "train1", "-n", "ml"],
+                       capsys)
+        assert rc == 0
+        assert out.count("==> ml/train1-worker") == 4
+        assert "no log file" in out
+
+        # A pod with the ProcessKubelet's log annotation streams the file.
+        logf = tmp_path / "w0.log"
+        logf.write_text("step 1 loss 5.0\nstep 2 loss 4.2\n")
+        platform = Platform.load(state)
+        pod = platform.api.get("Pod", "train1-worker-0", "ml")
+        pod.metadata.annotations["tpu.kubeflow.org/log-path"] = str(logf)
+        platform.api.update(pod)
+        platform.save(state)
+        rc, out = _run(["--state-dir", state, "logs", "train1-worker-0",
+                        "-n", "ml"], capsys)
+        assert rc == 0
+        assert "step 2 loss 4.2" in out
+
+    def test_logs_unknown_name_fails(self, tmp_path, capsys):
+        state = str(tmp_path / "state")
+        pf = _write(tmp_path, "platform.yaml", PLATFORM_YAML)
+        _run(["--state-dir", state, "apply", "-f", pf], capsys)
+        rc, _ = _run(["--state-dir", state, "logs", "nope", "-n", "ml"],
+                     capsys)
+        assert rc == 1
