@@ -380,3 +380,72 @@ def main(argv: Optional[list] = None) -> int:
 
 if __name__ == "__main__":
     raise SystemExit(main())
+
+
+# ------------------------------------------------------------------ export
+
+
+def llama_state_dict_from_params(
+    params: Dict[str, Any], cfg: LlamaConfig
+) -> Dict[str, np.ndarray]:
+    """Inverse of ``llama_params_from_state_dict``: flax tree (either
+    layout) -> HF LlamaForCausalLM state dict (numpy f32). Round-trip
+    tested; lets models trained here be published as HF checkpoints."""
+    import jax
+
+    def unstack(tree):
+        # scanned [L, ...] leaves -> per-layer trees
+        return [
+            jax.tree.map(lambda x: np.asarray(x[i]), tree)
+            for i in range(cfg.num_layers)
+        ]
+
+    if "layers" in params:
+        layers = unstack(params["layers"])
+    else:
+        layers = [params[f"layer_{i}"] for i in range(cfg.num_layers)]
+    E, H, Hkv, Dh = (
+        cfg.embed_dim, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
+    )
+
+    def f32(x) -> np.ndarray:
+        return np.asarray(x, np.float32)
+
+    sd: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": f32(params["embed"]),
+        "model.norm.weight": f32(params["final_norm"]["weight"]),
+    }
+    for i, lp in enumerate(layers):
+        p = f"model.layers.{i}."
+        a = lp["attn"]
+        sd[p + "input_layernorm.weight"] = f32(lp["input_norm"]["weight"])
+        sd[p + "post_attention_layernorm.weight"] = f32(
+            lp["post_attn_norm"]["weight"]
+        )
+        sd[p + "self_attn.q_proj.weight"] = np.ascontiguousarray(
+            f32(a["q_proj"]["kernel"]).reshape(E, H * Dh).T
+        )
+        sd[p + "self_attn.k_proj.weight"] = np.ascontiguousarray(
+            f32(a["k_proj"]["kernel"]).reshape(E, Hkv * Dh).T
+        )
+        sd[p + "self_attn.v_proj.weight"] = np.ascontiguousarray(
+            f32(a["v_proj"]["kernel"]).reshape(E, Hkv * Dh).T
+        )
+        sd[p + "self_attn.o_proj.weight"] = np.ascontiguousarray(
+            f32(a["o_proj"]["kernel"]).reshape(H * Dh, E).T
+        )
+        m = lp["mlp"]
+        sd[p + "mlp.gate_proj.weight"] = np.ascontiguousarray(
+            f32(m["gate_proj"]["kernel"]).T
+        )
+        sd[p + "mlp.up_proj.weight"] = np.ascontiguousarray(
+            f32(m["up_proj"]["kernel"]).T
+        )
+        sd[p + "mlp.down_proj.weight"] = np.ascontiguousarray(
+            f32(m["down_proj"]["kernel"]).T
+        )
+    if not cfg.tie_embeddings:
+        sd["lm_head.weight"] = np.ascontiguousarray(
+            f32(params["lm_head"]["kernel"]).T
+        )
+    return sd

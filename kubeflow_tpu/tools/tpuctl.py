@@ -190,25 +190,40 @@ def cmd_logs(args) -> int:
 
     ns = args.namespace or "default"
     if args.backend == "kubectl":
-        from kubeflow_tpu.controlplane.runtime.apiserver import ApiError
+        from kubeflow_tpu.controlplane.runtime.apiserver import (
+            ApiError,
+            NotFoundError,
+        )
 
         api = _kubectl_api(args)
         try:
             sys.stdout.write(api.pod_logs(args.name, namespace=ns))
             return 0
-        except ApiError:
-            pods = api.list("Pod", namespace=ns,
-                            label_selector={JOB_LABEL: args.name})
-            if not pods:
-                print(f"no pod or TpuJob {args.name!r} in {ns}",
-                      file=sys.stderr)
-                return 1
-            for p in sorted(pods, key=lambda p: p.metadata.name):
-                print(f"==> {ns}/{p.metadata.name} <==")
+        except NotFoundError:
+            pass            # not a pod name: try the TpuJob gang below
+        except ApiError as e:
+            # Pod exists but logs are unavailable (container starting,
+            # RBAC, connectivity): surface the real error, don't
+            # misclassify as a missing TpuJob.
+            print(f"kubectl logs {args.name}: {e}", file=sys.stderr)
+            return 1
+        pods = api.list("Pod", namespace=ns,
+                        label_selector={JOB_LABEL: args.name})
+        if not pods:
+            print(f"no pod or TpuJob {args.name!r} in {ns}",
+                  file=sys.stderr)
+            return 1
+        rc = 0
+        for p in sorted(pods, key=lambda p: p.metadata.name):
+            print(f"==> {ns}/{p.metadata.name} <==")
+            try:
                 sys.stdout.write(
                     api.pod_logs(p.metadata.name, namespace=ns)
                 )
-            return 0
+            except ApiError as e:       # keep printing the rest of the gang
+                print(f"(logs unavailable: {e})")
+                rc = 1
+        return rc
     platform = Platform.load(args.state_dir)
     pod = platform.api.try_get("Pod", args.name, ns)
     if pod is not None:

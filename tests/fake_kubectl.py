@@ -185,6 +185,17 @@ def cmd_delete(flags):
     print(f'{pos[0]} "{pos[1]}" deleted')
 
 
+def cmd_logs(flags):
+    # `kubectl logs <pod> -n ns`: emit canned logs for stored pods, the
+    # real CLI's NotFound wording otherwise.
+    name = flags["positional"][0]
+    import os
+    path = path_for("Pod", flags["ns"] or "default", name)
+    if not os.path.exists(path):
+        fail(f'Error from server (NotFound): pods "{name}" not found')
+    print(f"log line from {name}")
+
+
 def main():
     STORE.mkdir(parents=True, exist_ok=True)
     argv = sys.argv[1:]
@@ -196,6 +207,7 @@ def main():
         "get": cmd_get,
         "replace": cmd_replace,
         "delete": cmd_delete,
+        "logs": cmd_logs,
     }.get(verb, lambda f: fail(f"unknown verb {verb}"))(rest)
 
 

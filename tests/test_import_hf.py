@@ -158,3 +158,27 @@ def test_mixtral_logits_match_torch(scan_layers):
         np.float32,
     )
     np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)
+
+
+@pytest.mark.parametrize("scan_layers", [False, True])
+def test_export_round_trips(torch_model, scan_layers):
+    """params -> HF state dict -> params reproduces the original tree
+    exactly (and the exported dict loads into a torch model)."""
+    from kubeflow_tpu.tools.import_hf import llama_state_dict_from_params
+
+    cfg = config_from_hf(
+        HF_CFG, scan_layers=scan_layers, remat=False,
+        param_dtype=jnp.float32, dtype=jnp.float32,
+    )
+    params = llama_params_from_state_dict(torch_model.state_dict(), cfg)
+    sd = llama_state_dict_from_params(params, cfg)
+    # load exported dict into a fresh torch model: keys + shapes line up
+    m2 = _torch_model()
+    m2.load_state_dict({k: torch.tensor(v) for k, v in sd.items()})
+    params2 = llama_params_from_state_dict(sd, cfg)
+    for (p1, l1), (p2, l2) in zip(
+        jax.tree_util.tree_flatten_with_path(params)[0],
+        jax.tree_util.tree_flatten_with_path(params2)[0],
+    ):
+        assert p1 == p2
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))

@@ -297,3 +297,18 @@ class TestControlPlaneMain:
         ])
         with pytest.raises(SystemExit):
             build(args)
+
+
+class TestKubectlPodLogs:
+    def test_pod_logs_and_notfound(self, api):
+        from kubeflow_tpu.controlplane.api.core import Container, Pod, PodSpec
+        from kubeflow_tpu.controlplane.runtime.apiserver import NotFoundError
+
+        api.create(Pod(
+            metadata=ObjectMeta(name="w0", namespace="team-a"),
+            spec=PodSpec(containers=[Container(name="main")]),
+        ))
+        out = api.pod_logs("w0", namespace="team-a")
+        assert "log line from w0" in out
+        with pytest.raises(NotFoundError):
+            api.pod_logs("missing", namespace="team-a")
