@@ -353,10 +353,20 @@ def bench_hpo(args) -> None:
     )
 
 
+def bench_longctx(args) -> None:
+    """Long-context variant of config 2: seq 8192 on one chip (the
+    round-3 memory work fits it; beyond 16k the multi-chip path is
+    ring/Ulysses sequence parallelism)."""
+    args.seq_len = args.seq_len if args.seq_len != 2048 else 8192
+    args.batch_size = args.batch_size or 3
+    bench_train(args)
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("which", nargs="?", default="train",
-                   choices=["train", "serving", "resnet", "mixtral", "hpo"])
+                   choices=["train", "serving", "resnet", "mixtral", "hpo",
+                            "longctx"])
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)
     # Default is per-bench (train 12, serving 16, resnet 256, mixtral 8);
@@ -398,6 +408,7 @@ def main() -> None:
         "resnet": bench_resnet,
         "mixtral": bench_mixtral,
         "hpo": bench_hpo,
+        "longctx": bench_longctx,
     }[args.which](args)
 
 
