@@ -392,7 +392,8 @@ def main() -> None:
                             "mlp_only", "dots"])
     p.add_argument("--mu-dtype", default="bfloat16",
                    help="adam first-moment dtype ('' keeps f32)")
-    p.add_argument("--bf16-logits", action="store_true", default=True,
+    p.add_argument("--bf16-logits", dest="bf16_logits", default=True,
+                   action=argparse.BooleanOptionalAction,
                    help="emit logits in bf16 (loss still computes f32 stats)")
     p.add_argument("--f32-logits", dest="bf16_logits", action="store_false")
     # bf16 params + f32 Adam moments: the standard TPU mixed-precision

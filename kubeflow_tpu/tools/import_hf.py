@@ -45,7 +45,9 @@ def _np(t) -> np.ndarray:
         import torch
 
         if isinstance(t, torch.Tensor):
-            t = t.detach().cpu()
+            # contiguous(): torch.Tensor.view needs compatible strides, so
+            # sliced/transposed bf16 checkpoint tensors would raise without it.
+            t = t.detach().cpu().contiguous()
             if t.dtype == torch.bfloat16:
                 import ml_dtypes
 

@@ -142,11 +142,20 @@ async function loadMetrics() {
     api(`/api/metrics/${encodeURIComponent(n)}?window=3600`)));
   const rows = [];
   for (const s of series) {
-    if (!s.points.length) continue;
-    const last = s.points[s.points.length - 1].value;
-    rows.push(`<tr><td>${esc(s.series)}</td>` +
-      `<td>${esc(Number(last).toPrecision(4))}</td>` +
-      `<td>${spark(s.points)}</td></tr>`);
+    // One row per label set so per-device / per-label streams never
+    // interleave into a single misleading line.
+    const groups = (s.groups && s.groups.length)
+      ? s.groups : [{labels: {}, points: s.points}];
+    for (const g of groups) {
+      if (!g.points.length) continue;
+      const lbl = Object.entries(g.labels || {})
+        .map(([k, v]) => `${k}=${v}`).join(',');
+      const name = lbl ? `${s.series}{${lbl}}` : s.series;
+      const last = g.points[g.points.length - 1].value;
+      rows.push(`<tr><td>${esc(name)}</td>` +
+        `<td>${esc(Number(last).toPrecision(4))}</td>` +
+        `<td>${spark(g.points)}</td></tr>`);
+    }
   }
   if (rows.length)
     document.getElementById('metrics').innerHTML =

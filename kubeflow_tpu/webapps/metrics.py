@@ -44,13 +44,18 @@ class Point:
 
 
 class TimeSeriesStore:
-    """Bounded in-memory series store: newest-last deques per series name,
-    pruned by age on write and on read."""
+    """Bounded in-memory series store: newest-last deques keyed by
+    (series name, label set), pruned by age on write and on read.
+
+    Keying by label set keeps distinct streams (per-device tpu_hbm_*,
+    labeled registry counters) from interleaving into one sawtooth line;
+    ``query`` merges them time-ordered, ``query_groups`` returns each
+    stream separately for per-label-set rendering."""
 
     def __init__(self, retention_s: float = 3600.0, max_points: int = 4096):
         self.retention_s = retention_s
         self.max_points = max_points
-        self._series: Dict[str, Deque[Point]] = {}
+        self._series: Dict[Tuple[str, LabelKV], Deque[Point]] = {}
         self._lock = threading.Lock()
 
     def record(self, series: str, value: float, *,
@@ -59,7 +64,7 @@ class TimeSeriesStore:
                   labels=labels)
         with self._lock:
             dq = self._series.setdefault(
-                series, deque(maxlen=self.max_points)
+                (series, labels), deque(maxlen=self.max_points)
             )
             dq.append(p)
             cutoff = p.t - self.retention_s
@@ -70,14 +75,33 @@ class TimeSeriesStore:
               now: Optional[float] = None) -> List[Point]:
         cutoff = (time.time() if now is None else now) - window_s
         with self._lock:
-            dq = self._series.get(series)
-            if dq is None:
-                return []
-            return [p for p in dq if p.t >= cutoff]
+            pts = [
+                p
+                for (name, _labels), dq in self._series.items()
+                if name == series
+                for p in dq
+                if p.t >= cutoff
+            ]
+        pts.sort(key=lambda p: p.t)
+        return pts
+
+    def query_groups(
+        self, series: str, window_s: float = 600.0,
+        now: Optional[float] = None,
+    ) -> List[Tuple[LabelKV, List[Point]]]:
+        """Points for ``series`` split per label set (sorted by labels)."""
+        cutoff = (time.time() if now is None else now) - window_s
+        with self._lock:
+            groups = [
+                (labels, [p for p in dq if p.t >= cutoff])
+                for (name, labels), dq in self._series.items()
+                if name == series
+            ]
+        return sorted((g for g in groups if g[1]), key=lambda g: g[0])
 
     def names(self) -> List[str]:
         with self._lock:
-            return sorted(self._series)
+            return sorted({name for name, _labels in self._series})
 
 
 def host_cpu_sampler() -> Callable[[], Optional[float]]:
@@ -219,12 +243,25 @@ class MetricsService:
                 window = float(q.query.get("window", "600"))
             except ValueError:
                 raise RestError(400, "window must be a number of seconds")
-            pts = self.series(q.params["name"], window)
+            # Single store scan: the merged view is derived from the groups
+            # so the two views can't disagree at the window edge.
+            groups = self.store.query_groups(q.params["name"], window)
+            pts = sorted(
+                (p for _labels, gp in groups for p in gp),
+                key=lambda p: p.t,
+            )
             return {
                 "series": q.params["name"],
                 "points": [
                     {"t": p.t, "value": p.value, "labels": dict(p.labels)}
                     for p in pts
+                ],
+                "groups": [
+                    {
+                        "labels": dict(labels),
+                        "points": [{"t": p.t, "value": p.value} for p in gp],
+                    }
+                    for labels, gp in groups
                 ],
             }
 

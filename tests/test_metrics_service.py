@@ -30,6 +30,25 @@ class TestTimeSeriesStore:
         assert st.query("missing", now=310.0) == []
         assert st.names() == ["a"]
 
+    def test_label_sets_are_distinct_streams(self):
+        # Per-device points must not interleave into one sawtooth line:
+        # each label set keeps its own deque and its own query group.
+        st = TimeSeriesStore()
+        for i in range(3):
+            st.record("hbm", 10.0 + i, t=100.0 + i, labels=(("device", "0"),))
+            st.record("hbm", 20.0 + i, t=100.0 + i, labels=(("device", "1"),))
+        groups = st.query_groups("hbm", window_s=600.0, now=110.0)
+        assert [dict(labels) for labels, _ in groups] == [
+            {"device": "0"}, {"device": "1"},
+        ]
+        assert [p.value for p in groups[0][1]] == [10.0, 11.0, 12.0]
+        assert [p.value for p in groups[1][1]] == [20.0, 21.0, 22.0]
+        # merged view stays time-ordered and complete
+        merged = st.query("hbm", window_s=600.0, now=110.0)
+        assert [p.t for p in merged] == sorted(p.t for p in merged)
+        assert len(merged) == 6
+        assert st.names() == ["hbm"]
+
     def test_max_points_bound(self):
         st = TimeSeriesStore(max_points=3)
         for i in range(10):
