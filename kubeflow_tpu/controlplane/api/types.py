@@ -249,9 +249,20 @@ class ServingSpec:
     mesh: MeshAxesSpec = dataclasses.field(
         default_factory=lambda: MeshAxesSpec(dp=-1)
     )
+    # Horizontal scale-out: one engine pod per replica behind the Service
+    # (the reference's TF-Serving-as-Deployment semantics,
+    # testing/test_tf_serving.py:60-100). Scale-down drains: excess
+    # replicas leave status.endpoints first, then get deleted.
+    replicas: int = 1
     max_batch: int = 8
     max_len: int = 1024
     decode_chunk: int = 8               # tokens per device dispatch
+    # Engine compute/memory knobs (serving.engine.ServingConfig): int8
+    # weight-only quantization is what lets an 8B model fit a 16G chip.
+    quantize: str = ""                  # "" | "int8"
+    param_dtype: str = "bfloat16"       # cast float params at engine start
+    prefill_buckets: List[int] = dataclasses.field(default_factory=list)
+    pipeline_depth: int = 0             # 0 = engine default
     port: int = 8000
     image: str = "kubeflow-tpu/serving:latest"
     # Train->serve handoff: restore params from this TpuJob checkpoint dir
@@ -264,9 +275,15 @@ class ServingSpec:
 
 @dataclasses.dataclass
 class ServingStatus:
-    ready: bool = False
+    ready: bool = False                 # >= 1 replica serving
     phase: str = "Pending"
     endpoint: str = ""                  # VirtualService prefix once routed
+    replicas: int = 0                   # pods that exist (incl. draining)
+    ready_replicas: int = 0
+    # Per-replica backend addresses ("host:port") of READY, non-draining
+    # replicas — the load balancer's dispatch set. Draining replicas are
+    # removed from here before their pod is deleted.
+    endpoints: List[str] = dataclasses.field(default_factory=list)
     conditions: List[Condition] = dataclasses.field(default_factory=list)
 
 

@@ -81,6 +81,52 @@ class WorkloadIdentityPlugin:
             self.iam[gsa].discard(f"serviceAccount:{ns}/default-editor")
 
 
+class AwsIamForServiceAccountPlugin:
+    """AWS IRSA plugin (the reference's second cloud-IAM impl,
+    plugin_iam.go:32-283): annotates the namespace's default-editor KSA
+    with the IAM role ARN (``eks.amazonaws.com/role-arn`` — what the EKS
+    pod identity webhook consumes) and adds the service account to the
+    role's OIDC trust policy. The trust-policy mutation (an AWS STS/IAM
+    API call in the reference, UpdateAssumeRolePolicy) goes through the
+    injectable ``iam`` store, same seam shape as WorkloadIdentityPlugin —
+    proving the seam fits more than one cloud.
+    """
+
+    KIND = "AwsIamForServiceAccount"
+    ANNOTATION = "eks.amazonaws.com/role-arn"
+
+    def __init__(self, iam=None):
+        # role_arn -> set of "system:serviceaccount:<ns>:<ksa>" trust
+        # principals; a real impl issues UpdateAssumeRolePolicy calls.
+        self.iam = iam if iam is not None else {}
+
+    @staticmethod
+    def _principal(ns: str) -> str:
+        return f"system:serviceaccount:{ns}:default-editor"
+
+    def apply(self, api, profile, params) -> None:
+        role = params.get("awsIamRole", "")
+        if not role:
+            raise ValueError(
+                "AwsIamForServiceAccount needs params.awsIamRole")
+        ns = profile.metadata.name
+        sa = api.get("ServiceAccount", "default-editor", ns)
+        if sa.metadata.annotations.get(self.ANNOTATION) != role:
+            sa.metadata.annotations[self.ANNOTATION] = role
+            api.update(sa)
+        self.iam.setdefault(role, set()).add(self._principal(ns))
+
+    def revoke(self, api, profile, params) -> None:
+        role = params.get("awsIamRole", "")
+        ns = profile.metadata.name
+        sa = api.try_get("ServiceAccount", "default-editor", ns)
+        if sa is not None and self.ANNOTATION in sa.metadata.annotations:
+            del sa.metadata.annotations[self.ANNOTATION]
+            api.update(sa)
+        if role in self.iam:
+            self.iam[role].discard(self._principal(ns))
+
+
 class ProfileController(Controller):
     NAME = "profile"
     WATCH_KINDS = ("Profile", "Namespace", "RoleBinding")
@@ -91,10 +137,12 @@ class ProfileController(Controller):
                  plugins=None):
         super().__init__(api, registry)
         self.user_id_header = user_id_header
-        default = WorkloadIdentityPlugin()
-        self.plugins = plugins if plugins is not None else {
-            default.KIND: default,
-        }
+        if plugins is not None:
+            self.plugins = plugins
+        else:
+            defaults = (WorkloadIdentityPlugin(),
+                        AwsIamForServiceAccountPlugin())
+            self.plugins = {p.KIND: p for p in defaults}
 
     def map_to_primary(self, obj):
         # Namespaces/RoleBindings created for a profile carry its name.

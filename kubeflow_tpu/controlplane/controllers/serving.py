@@ -8,10 +8,16 @@ query -> assert). Here the same lifecycle is a CRD:
 - The pod runs ``python -m kubeflow_tpu.serving.server`` against the
   KFTPU_SERVING_* env injected below (model, mesh, engine limits, port) —
   the serving analogue of the TpuJob controller's KFTPU_* train contract.
+- ``spec.replicas`` engine pods (``<name>-serving-<i>``) behind one
+  Service — the reference's TF-Serving-as-a-Deployment shape
+  (testing/test_tf_serving.py:60-100). Each ready replica's address lands
+  in ``status.endpoints`` (the serving.lb dispatch set); scale-down is
+  graceful: the excess replica leaves ``status.endpoints`` first, then is
+  deleted after ``drain_grace_s`` so in-flight requests finish.
 - ClusterIP service + VirtualService route ``/serving/<ns>/<name>/`` (the
   notebook controller's routing pattern, notebook_controller.go:378-435).
-- Pod phase mirrors into status.ready/conditions; status.endpoint carries
-  the routed prefix the dashboard and availability prober poll.
+- Pod phases mirror into status.ready/ready_replicas/conditions; failed
+  replicas are recreated (serving pods must always run).
 
 Single-host slices only for now: multi-host sharded serving is a gang
 concern (TpuJob's machinery) and the engine's mesh is per-process.
@@ -20,6 +26,7 @@ concern (TpuJob's machinery) and the engine's mesh is per-process.
 from __future__ import annotations
 
 import json
+import time
 
 from kubeflow_tpu.controlplane.api.core import (
     Container,
@@ -55,15 +62,19 @@ class ServingController(Controller):
     NAME = "serving"
     WATCH_KINDS = ("Serving", "Pod")
 
+    DRAIN_ANNOTATION = "serving.kubeflow.org/drain-since"
+
     def __init__(
         self,
         api: InMemoryApiServer,
         registry: MetricsRegistry = global_registry,
         *,
         istio_gateway: str = "kubeflow/kubeflow-gateway",
+        drain_grace_s: float = 15.0,
     ):
         super().__init__(api, registry)
         self.istio_gateway = istio_gateway
+        self.drain_grace_s = drain_grace_s
         self.recorder = EventRecorder(api, self.NAME)
         self.metrics_ready = registry.gauge(
             "kftpu_serving_ready", "Ready serving deployments"
@@ -87,10 +98,6 @@ class ServingController(Controller):
             self.recorder.event(sv, "Warning", "InvalidSpec", err)
             return Result()
 
-        pod_name = f"{name}-serving-0"
-        live_pod = self.api.try_get("Pod", pod_name, namespace)
-        desired_pod = self._pod(sv, pod_name)
-
         def contract(pod):
             """Only the controller-owned slice of the container: admission
             mutators (PodDefault) may append env — that must not read as
@@ -100,39 +107,107 @@ class ServingController(Controller):
                    if e.name.startswith("KFTPU_SERVING_")}
             return (own, c.image, tuple(c.ports))
 
-        if live_pod is not None and contract(live_pod) != contract(desired_pod):
-            # Spec drift (port/model/engine limits): the env contract is
-            # baked into the process, so the pod must be replaced — leaving
-            # it would keep routing pointed at a stale server while status
-            # reports Ready.
-            self.api.delete("Pod", pod_name, namespace)
-            self.recorder.event(sv, "Normal", "Recreated",
-                                f"pod {pod_name}: spec changed")
-            live_pod = None
-        if live_pod is None:
-            self.api.create(desired_pod)
-            self.recorder.event(sv, "Normal", "Created", f"pod {pod_name}")
-            live_pod = self.api.get("Pod", pod_name, namespace)
+        desired = max(1, sv.spec.replicas)
+        live_pods = []
+        for i in range(desired):
+            pod_name = f"{name}-serving-{i}"
+            live_pod = self.api.try_get("Pod", pod_name, namespace)
+            desired_pod = self._pod(sv, pod_name, i)
+            if (live_pod is not None
+                    and contract(live_pod) != contract(desired_pod)):
+                # Spec drift (port/model/engine limits): the env contract
+                # is baked into the process, so the pod must be replaced —
+                # leaving it would keep routing pointed at a stale server
+                # while status reports Ready.
+                self.api.delete("Pod", pod_name, namespace)
+                self.recorder.event(sv, "Normal", "Recreated",
+                                    f"pod {pod_name}: spec changed")
+                live_pod = None
+            elif (live_pod is not None
+                    and live_pod.status.phase in ("Failed", "Succeeded")):
+                # A serving replica must always run: recreate on exit (the
+                # Deployment-controller restart semantics the reference
+                # relied on for TF-Serving pods).
+                self.api.delete("Pod", pod_name, namespace)
+                self.recorder.event(
+                    sv, "Warning", "Restarted",
+                    f"pod {pod_name}: {live_pod.status.phase} "
+                    f"({live_pod.status.message})")
+                live_pod = None
+            if live_pod is None:
+                self.api.create(desired_pod)
+                self.recorder.event(sv, "Normal", "Created",
+                                    f"pod {pod_name}")
+                live_pod = self.api.get("Pod", pod_name, namespace)
+            live_pods.append(live_pod)
+
+        # Scale-down drain: replicas beyond ``desired`` first disappear
+        # from status.endpoints (this reconcile), then are deleted once
+        # drain_grace_s has passed — in-flight requests on the LB finish.
+        requeue = None
+        now = time.time()
+        for pod in self.api.list("Pod", namespace):
+            owners = [o for o in pod.metadata.owner_references
+                      if o.kind == "Serving" and o.name == name]
+            if not owners or pod.metadata.deletion_timestamp is not None:
+                continue
+            prefix = f"{name}-serving-"
+            if not pod.metadata.name.startswith(prefix):
+                continue
+            try:
+                ordinal = int(pod.metadata.name[len(prefix):])
+            except ValueError:
+                continue
+            if ordinal < desired:
+                continue
+            since = pod.metadata.annotations.get(self.DRAIN_ANNOTATION)
+            if since is None:
+                pod.metadata.annotations[self.DRAIN_ANNOTATION] = str(now)
+                self.api.update(pod)
+                self.recorder.event(sv, "Normal", "Draining",
+                                    f"pod {pod.metadata.name}")
+                requeue = self.drain_grace_s
+            elif now - float(since) >= self.drain_grace_s:
+                self.api.delete("Pod", pod.metadata.name, namespace)
+                self.recorder.event(sv, "Normal", "ScaledDown",
+                                    f"pod {pod.metadata.name}")
+            else:
+                requeue = max(0.05, float(since) + self.drain_grace_s - now)
+
         create_or_update(self.api, self._service(sv))
         create_or_update(self.api, self._virtual_service(sv))
 
-        phase = live_pod.status.phase
-        ready = phase == "Running"
-        sv.status.phase = "Ready" if ready else phase
+        ready_pods = [p for p in live_pods if p.status.phase == "Running"]
+        ready = len(ready_pods) > 0
+        worst = next((p for p in live_pods if p.status.phase != "Running"),
+                     None)
+        sv.status.phase = "Ready" if ready else live_pods[0].status.phase
         sv.status.ready = ready
+        sv.status.replicas = len(live_pods)
+        sv.status.ready_replicas = len(ready_pods)
+        sv.status.endpoints = [
+            f"{p.status.pod_ip}:{self._replica_port(sv, i)}"
+            for i, p in enumerate(live_pods)
+            if p.status.phase == "Running" and p.status.pod_ip
+        ]
         sv.status.endpoint = (
             f"/serving/{namespace}/{name}/" if ready else ""
         )
         sv.status.conditions = set_condition(
             sv.status.conditions,
-            Condition(type="Ready", status="True" if ready else "False",
-                      reason=phase, message=live_pod.status.message),
+            Condition(
+                type="Ready", status="True" if ready else "False",
+                reason=("AllReplicasReady" if len(ready_pods) == desired
+                        else (worst.status.phase if worst else "Pending")),
+                message=(worst.status.message if worst else
+                         f"{len(ready_pods)}/{desired} replicas ready"),
+            ),
         )
         self._sync_status(sv)
         self.metrics_ready.set(float(sum(
             1 for s in self.api.list("Serving") if s.status.ready
         )))
-        return Result()
+        return Result(requeue_after=requeue)
 
     def _validate(self, sv: Serving) -> str:
         if sv.spec.model not in list_models():
@@ -145,6 +220,15 @@ class ServingController(Controller):
         if st.num_hosts != 1:
             return (f"serving slice must be single-host, {st.name} has "
                     f"{st.num_hosts} hosts")
+        if sv.spec.replicas < 1:
+            return f"replicas must be >= 1, got {sv.spec.replicas}"
+        if sv.spec.quantize not in ("", "int8"):
+            return (f"unknown quantize {sv.spec.quantize!r}; "
+                    "supported: '', 'int8'")
+        if sv.spec.pipeline_depth < 0:
+            return f"pipeline_depth must be >= 0, got {sv.spec.pipeline_depth}"
+        if any(b <= 0 for b in sv.spec.prefill_buckets):
+            return f"prefill_buckets must be positive: {sv.spec.prefill_buckets}"
         return ""
 
     def _sync_status(self, sv) -> None:
@@ -160,18 +244,41 @@ class ServingController(Controller):
         return OwnerReference(kind="Serving", name=sv.metadata.name,
                               uid=sv.metadata.uid)
 
-    def _pod(self, sv: Serving, pod_name: str) -> Pod:
+    def _replica_port(self, sv: Serving, ordinal: int) -> int:
+        """Per-replica port = spec.port + ordinal. On a real cluster every
+        pod would get its own IP and bind spec.port; the process-kubelet
+        substrate runs replicas on one flat host network, so the ordinal
+        offset keeps them from colliding — and the offset is harmless on
+        per-pod-IP networks too."""
+        return sv.spec.port + ordinal
+
+    def _pod(self, sv: Serving, pod_name: str, ordinal: int = 0) -> Pod:
         ns, name = sv.metadata.namespace, sv.metadata.name
         st = get_slice(sv.spec.slice_type)
         mesh = {a: v for a, v in vars(sv.spec.mesh).items() if v != 1}
+        port = self._replica_port(sv, ordinal)
         env = [
             EnvVar("KFTPU_SERVING_MODEL", sv.spec.model),
             EnvVar("KFTPU_SERVING_MESH", json.dumps(mesh)),
-            EnvVar("KFTPU_SERVING_PORT", str(sv.spec.port)),
+            EnvVar("KFTPU_SERVING_PORT", str(port)),
             EnvVar("KFTPU_SERVING_MAX_BATCH", str(sv.spec.max_batch)),
             EnvVar("KFTPU_SERVING_MAX_LEN", str(sv.spec.max_len)),
             EnvVar("KFTPU_SERVING_DECODE_CHUNK", str(sv.spec.decode_chunk)),
         ]
+        # Engine knobs ride the env contract only when set so existing
+        # pods (and their drift contract) are untouched by the defaults.
+        if sv.spec.quantize:
+            env.append(EnvVar("KFTPU_SERVING_QUANTIZE", sv.spec.quantize))
+        if sv.spec.param_dtype != "bfloat16":
+            env.append(EnvVar("KFTPU_SERVING_PARAM_DTYPE",
+                              sv.spec.param_dtype))
+        if sv.spec.prefill_buckets:
+            env.append(EnvVar(
+                "KFTPU_SERVING_PREFILL_BUCKETS",
+                ",".join(str(b) for b in sv.spec.prefill_buckets)))
+        if sv.spec.pipeline_depth:
+            env.append(EnvVar("KFTPU_SERVING_PIPELINE_DEPTH",
+                              str(sv.spec.pipeline_depth)))
         if getattr(sv.spec, "tokenizer", ""):
             env.append(EnvVar("KFTPU_SERVING_TOKENIZER",
                               sv.spec.tokenizer))
@@ -190,7 +297,7 @@ class ServingController(Controller):
                 containers=[Container(
                     name="serving", image=sv.spec.image, env=env,
                     command=["python", "-m", "kubeflow_tpu.serving.server"],
-                    ports=[sv.spec.port],
+                    ports=[port],
                     resources={st.resource_name(): str(st.chips_per_host)},
                 )],
                 node_selector=st.node_selectors(),

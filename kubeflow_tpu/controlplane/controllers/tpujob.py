@@ -51,9 +51,12 @@ from kubeflow_tpu.controlplane.runtime import (
     create_or_update,
 )
 from kubeflow_tpu.topology import AxisSpec, get_slice, plan_mesh
+from kubeflow_tpu.utils import get_logger
 from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
 
 COORDINATOR_PORT = 8476
+log = get_logger("tpujob")
+
 JOB_LABEL = "tpu.kubeflow.org/job-name"
 REPLICA_LABEL = "tpu.kubeflow.org/replica-index"
 
@@ -70,9 +73,16 @@ class TpuJobController(Controller):
         # Schedulable capacity: slice_type -> number of concurrently
         # allocatable slices. None = unbounded (tests / single-tenant).
         capacity: Optional[Dict[str, int]] = None,
+        # Per-chip HBM fit check at admission (topology/capacity.py).
+        hbm_check: bool = True,
     ):
         super().__init__(api, registry)
         self.capacity = capacity
+        self.hbm_check = hbm_check
+        # (model, slice, slices, mesh, batch, seq, mu, model_kw) -> verdict;
+        # reconcile re-enters constantly, eval_shape only needs to run once
+        # per distinct spec.
+        self._hbm_cache: Dict[tuple, Optional[str]] = {}
         self.recorder = EventRecorder(api, self.NAME)
         self.metrics_restarts = registry.counter(
             "kftpu_tpujob_gang_restarts_total", "Gang restarts", ("reason",)
@@ -110,6 +120,18 @@ class TpuJobController(Controller):
             )
         except (KeyError, ValueError) as e:
             return self._fail_invalid(job, str(e))
+
+        # 1b. HBM fit gate: a registry-model job whose state + activations
+        # can't fit the slice's per-chip HBM is rejected NOW (permanent
+        # failure), not discovered as an OOM mid-schedule. The reference's
+        # equivalent knob was a GPU limit string with no semantics
+        # (jupyter-web-app utils.py:390-443); XLA's static memory program
+        # lets admission do real accounting (topology/capacity.py).
+        if self.hbm_check and job.spec.model:
+            err = self._hbm_blocked(job, st)
+            if err:
+                return self._fail_invalid(job, err,
+                                          reason="CapacityExceeded")
 
         # 2. Quota + capacity gates (gang admission: all or nothing).
         blocked = self._admission_blocked(job, st)
@@ -403,13 +425,69 @@ class TpuJobController(Controller):
             self.api.update_status(job)
         return Result(requeue_after=requeue)
 
-    def _fail_invalid(self, job: TpuJob, msg: str) -> Result:
+    def _fail_invalid(self, job: TpuJob, msg: str,
+                      reason: str = "InvalidTopology") -> Result:
         job.status.phase = "Failed"
         job.status.conditions = set_condition(
             job.status.conditions,
             Condition(type="Admitted", status="False",
-                      reason="InvalidTopology", message=msg),
+                      reason=reason, message=msg),
         )
         self.api.update_status(job)
-        self.recorder.event(job, "Warning", "InvalidTopology", msg)
+        self.recorder.event(job, "Warning", reason, msg)
         return Result()
+
+    def _hbm_blocked(self, job: TpuJob, st) -> Optional[str]:
+        """Analytic per-chip HBM estimate for registry-model jobs; returns
+        a rejection message when the job cannot fit. Estimator failures
+        never block admission (fail open, loudly)."""
+        from kubeflow_tpu.topology.capacity import GiB, analytic_report
+
+        env = {e.name: e.value for e in job.spec.env}
+        n_hosts = st.num_hosts * job.spec.num_slices
+        m = job.spec.mesh
+        cache_key = (
+            job.spec.model, job.spec.slice_type, job.spec.num_slices,
+            (m.dp, m.pp, m.ep, m.fsdp, m.sp, m.tp),
+            env.get("KFTPU_BATCH_PER_HOST", "8"),
+            env.get("KFTPU_SEQ_LEN", "1024"),
+            env.get("KFTPU_HPARAMS", ""),
+            env.get("KFTPU_MODEL_KW", ""),
+        )
+        if cache_key in self._hbm_cache:
+            return self._hbm_cache[cache_key]
+        try:
+            rep = analytic_report(
+                job.spec.model, job.spec.slice_type,
+                AxisSpec(dp=m.dp, pp=m.pp, ep=m.ep, fsdp=m.fsdp,
+                         sp=m.sp, tp=m.tp),
+                num_slices=job.spec.num_slices,
+                global_batch=int(
+                    env.get("KFTPU_BATCH_PER_HOST", "8")) * n_hosts,
+                seq_len=int(env.get("KFTPU_SEQ_LEN", "1024")),
+                mu_dtype=str(json.loads(
+                    env.get("KFTPU_HPARAMS", "{}") or "{}"
+                ).get("mu_dtype", "")),
+                model_kw=json.loads(
+                    env.get("KFTPU_MODEL_KW", "{}") or "{}"),
+            )
+        except Exception as e:  # noqa: BLE001 — estimator must fail open
+            log.warning("hbm admission estimate failed",
+                        kv={"job": job.metadata.name, "err": repr(e)})
+            self._hbm_cache[cache_key] = None
+            return None
+        verdict = None
+        if not rep.fits():
+            verdict = (
+                f"model {job.spec.model} needs ~{rep.total / GiB:.1f} "
+                f"GiB/chip ({rep.params / GiB:.1f} params + "
+                f"{rep.grads / GiB:.1f} grads + "
+                f"{rep.opt_state / GiB:.1f} opt + "
+                f"{rep.activations / GiB:.1f} activations) but "
+                f"{job.spec.slice_type} has {rep.hbm_per_chip / GiB:.0f} "
+                f"GiB/chip; use a larger slice, more model sharding, or "
+                f"bf16 params/mu (KFTPU_MODEL_KW/KFTPU_HPARAMS). "
+                f"Verify with: tpuctl plan --aot"
+            )
+        self._hbm_cache[cache_key] = verdict
+        return verdict

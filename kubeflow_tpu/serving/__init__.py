@@ -13,6 +13,7 @@ from kubeflow_tpu.serving.engine import (
     ServingConfig,
     ServingEngine,
 )
+from kubeflow_tpu.serving.lb import ServingLBServer, ServingLoadBalancer
 from kubeflow_tpu.serving.server import ServingServer
 
 __all__ = [
@@ -20,5 +21,7 @@ __all__ = [
     "GenerationResult",
     "ServingConfig",
     "ServingEngine",
+    "ServingLBServer",
+    "ServingLoadBalancer",
     "ServingServer",
 ]

@@ -1,0 +1,320 @@
+"""Serving load balancer: one endpoint over N engine replicas.
+
+The reference ran TF-Serving as a K8s Deployment with replicas behind a
+ClusterIP Service and let kube-proxy spread connections
+(testing/test_tf_serving.py:60-156 waits on the deployment, then hits one
+endpoint). Connection-level round-robin is the wrong policy for LLM
+serving, where one request can hold a stream open for seconds while
+another finishes in milliseconds — so the platform ships an L7 balancer
+that dispatches on live per-replica load:
+
+- **Least-loaded dispatch**: each backend tracks in-flight requests; a new
+  request goes to the healthy, non-draining backend with the fewest.
+- **Health**: a failed dispatch marks the backend unhealthy immediately;
+  ``health_check()`` (called by the background loop and on demand) probes
+  ``/healthz`` to recover it. No healthy backend -> 503, the signal the
+  availability prober and clients retry on.
+- **Drain on scale-down**: ``set_backends`` never yanks a live backend —
+  a removed address stops receiving NEW requests and is dropped once its
+  in-flight count reaches zero. Pairs with the Serving controller, which
+  removes the replica from ``status.endpoints`` (feeding ``sync_from_api``)
+  one grace period before deleting the pod.
+- **Streaming passthrough**: NDJSON token streams are relayed
+  line-by-line; the slot is held (and counted as load) until the stream
+  closes. Failover only happens before the first upstream byte — once
+  chunks are on the wire the request belongs to that backend.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.webapps.router import (
+    JsonHttpServer,
+    NdjsonStream,
+    Request,
+    RestError,
+    Router,
+)
+
+log = get_logger("serving-lb")
+
+
+class Backend:
+    def __init__(self, addr: str):
+        self.addr = addr                    # "host:port"
+        self.in_flight = 0
+        self.healthy = True
+        self.draining = False
+        self.last_error = ""
+        self.requests_total = 0
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.addr}"
+
+    def snapshot(self) -> dict:
+        return {
+            "addr": self.addr,
+            "healthy": self.healthy,
+            "draining": self.draining,
+            "in_flight": self.in_flight,
+            "requests_total": self.requests_total,
+            "last_error": self.last_error,
+        }
+
+
+class ServingLoadBalancer:
+    """L7 balancer over serving.server replicas. Thread-safe: the router
+    handlers run on the HTTP server's thread pool."""
+
+    def __init__(
+        self,
+        backends: Optional[List[str]] = None,
+        *,
+        connect_timeout_s: float = 5.0,
+        request_timeout_s: float = 300.0,
+        health_timeout_s: float = 2.0,
+    ):
+        self.connect_timeout_s = connect_timeout_s
+        self.request_timeout_s = request_timeout_s
+        self.health_timeout_s = health_timeout_s
+        self._backends: Dict[str, Backend] = {}
+        self._lock = threading.Lock()
+        if backends:
+            self.set_backends(backends)
+
+    # ------------- backend set management -------------
+
+    def set_backends(self, addrs: List[str]) -> None:
+        """Reconcile the dispatch set. New addresses join healthy; existing
+        ones keep their state; removed ones drain (no new requests, dropped
+        at in_flight == 0)."""
+        want = list(dict.fromkeys(addrs))   # dedup, KEEP caller order:
+        with self._lock:                    # ties in the picker stay
+            for addr in want:               # deterministic (replica 0 first)
+                b = self._backends.get(addr)
+                if b is None:
+                    self._backends[addr] = Backend(addr)
+                elif b.draining:
+                    b.draining = False      # scale-down reverted
+            want_set = set(want)
+            for addr, b in list(self._backends.items()):
+                if addr not in want_set:
+                    if b.in_flight == 0:
+                        del self._backends[addr]
+                    elif not b.draining:
+                        b.draining = True
+                        log.info("draining backend", kv={"addr": addr})
+
+    def sync_from_api(self, api, namespace: str, name: str) -> None:
+        """Point the dispatch set at a Serving CR's ready replicas
+        (status.endpoints, maintained by the Serving controller)."""
+        sv = api.try_get("Serving", name, namespace)
+        self.set_backends(list(sv.status.endpoints) if sv is not None else [])
+
+    def backends(self) -> List[dict]:
+        with self._lock:
+            return [b.snapshot() for b in self._backends.values()]
+
+    # ------------- dispatch -------------
+
+    def _acquire(self) -> Backend:
+        with self._lock:
+            live = [b for b in self._backends.values()
+                    if b.healthy and not b.draining]
+            if not live:
+                raise RestError(503, "no healthy serving backend")
+            b = min(live, key=lambda b: b.in_flight)
+            b.in_flight += 1
+            b.requests_total += 1
+            return b
+
+    def _release(self, b: Backend) -> None:
+        with self._lock:
+            b.in_flight -= 1
+            if b.draining and b.in_flight == 0:
+                self._backends.pop(b.addr, None)
+                log.info("drained backend", kv={"addr": b.addr})
+
+    def _mark_unhealthy(self, b: Backend, err: str) -> None:
+        with self._lock:
+            b.healthy = False
+            b.last_error = err
+        log.warning("backend unhealthy", kv={"addr": b.addr, "err": err})
+
+    def health_check(self) -> int:
+        """Probe every backend's /healthz; flips healthy both ways.
+        Returns the number of healthy backends."""
+        with self._lock:
+            snapshot = list(self._backends.values())
+        n = 0
+        for b in snapshot:
+            try:
+                with urllib.request.urlopen(
+                    f"{b.url}/healthz", timeout=self.health_timeout_s
+                ) as r:
+                    ok = bool(json.load(r).get("ok"))
+            except Exception as e:  # noqa: BLE001 — any failure = unhealthy
+                with self._lock:
+                    b.healthy = False
+                    b.last_error = repr(e)
+                continue
+            with self._lock:
+                b.healthy = ok
+                if ok:
+                    b.last_error = ""
+            n += ok
+        return n
+
+    # ------------- handlers -------------
+
+    def _generate(self, req: Request):
+        body = json.dumps(req.body).encode()
+        stream = bool(req.body.get("stream", False))
+        # Failover: a backend that dies between health checks should cost
+        # the client nothing — retry the next-least-loaded until none left.
+        # Streams only fail over before the first upstream byte.
+        tried = 0
+        with self._lock:
+            max_tries = max(1, len(self._backends))
+        while True:
+            b = self._acquire()
+            tried += 1
+            upstream = urllib.request.Request(
+                f"{b.url}/v1/generate", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                resp = urllib.request.urlopen(
+                    upstream, timeout=self.request_timeout_s
+                )
+            except urllib.error.HTTPError as e:
+                # Upstream spoke HTTP: the backend is alive; relay the
+                # application error (400 bad prompt etc.) untouched.
+                payload = e.read()
+                self._release(b)
+                try:
+                    return e.code, json.loads(payload)
+                except json.JSONDecodeError:
+                    return e.code, {"error": payload.decode(errors="replace")}
+            except Exception as e:  # noqa: BLE001 — connect/transport error
+                self._mark_unhealthy(b, repr(e))
+                self._release(b)
+                if tried >= max_tries:
+                    raise RestError(502, f"all serving backends failed "
+                                         f"(last: {b.addr}: {e!r})")
+                continue
+            if stream:
+                return NdjsonStream(self._relay_stream(b, resp))
+            try:
+                out = json.load(resp)
+            except Exception as e:  # noqa: BLE001
+                self._mark_unhealthy(b, repr(e))
+                raise RestError(502, f"bad upstream response: {e!r}")
+            finally:
+                resp.close()
+                self._release(b)
+            return out
+
+    def _relay_stream(self, b: Backend, resp):
+        """Relay upstream NDJSON chunks; the backend slot is held until the
+        stream ends so streaming load is visible to the picker."""
+        try:
+            for line in resp:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError:
+                    yield {"error": "bad upstream chunk"}
+                    return
+        except Exception as e:  # noqa: BLE001 — upstream died mid-stream
+            self._mark_unhealthy(b, repr(e))
+            yield {"error": f"backend died mid-stream: {e!r}"}
+        finally:
+            resp.close()
+            self._release(b)
+
+    def _models(self, req: Request):
+        b = self._acquire()
+        try:
+            with urllib.request.urlopen(
+                f"{b.url}/v1/models", timeout=self.health_timeout_s
+            ) as r:
+                return json.load(r)
+        except Exception as e:  # noqa: BLE001
+            self._mark_unhealthy(b, repr(e))
+            raise RestError(502, f"backend {b.addr} failed: {e!r}")
+        finally:
+            self._release(b)
+
+    def _healthz(self, req: Request):
+        backs = self.backends()
+        ok = any(b["healthy"] and not b["draining"] for b in backs)
+        payload = {"ok": ok, "backends": backs}
+        return payload if ok else (503, payload)
+
+    def router(self) -> Router:
+        r = Router()
+        r.post("/v1/generate", self._generate)
+        r.get("/v1/models", self._models)
+        r.get("/healthz", self._healthz)
+        return r
+
+
+class ServingLBServer:
+    """The balancer as a process: HTTP front door + background loop that
+    health-checks and (when given an api + CR coordinates) follows the
+    Serving CR's ready endpoints."""
+
+    def __init__(
+        self,
+        lb: ServingLoadBalancer,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        sync_interval_s: float = 2.0,
+        api=None,
+        namespace: str = "",
+        name: str = "",
+    ):
+        self.lb = lb
+        self.sync_interval_s = sync_interval_s
+        self._api, self._ns, self._name = api, namespace, name
+        self._http = JsonHttpServer(lb.router(), host=host, port=port)
+        self.port = self._http.port
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def tick(self) -> None:
+        if self._api is not None:
+            self.lb.sync_from_api(self._api, self._ns, self._name)
+        self.lb.health_check()
+
+    def start(self) -> "ServingLBServer":
+        self._http.start()
+
+        def loop():
+            while not self._stop.wait(self.sync_interval_s):
+                try:
+                    self.tick()
+                except Exception as e:  # noqa: BLE001 — keep balancing
+                    log.warning("lb sync failed", kv={"err": repr(e)})
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._http.stop()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -273,6 +273,19 @@ def env_config() -> dict:
         "max_len": int(os.environ.get("KFTPU_SERVING_MAX_LEN", "1024")),
         "decode_chunk": int(
             os.environ.get("KFTPU_SERVING_DECODE_CHUNK", "8")),
+        # Engine compute/memory knobs (ServingConfig): int8 weight-only
+        # quantization is the 8B-on-a-16G-chip enabler; empty values fall
+        # through to the engine defaults.
+        "quantize": os.environ.get("KFTPU_SERVING_QUANTIZE", ""),
+        "param_dtype": os.environ.get("KFTPU_SERVING_PARAM_DTYPE", ""),
+        "prefill_buckets": [
+            int(b)
+            for b in os.environ.get(
+                "KFTPU_SERVING_PREFILL_BUCKETS", "").split(",")
+            if b.strip()
+        ],
+        "pipeline_depth": int(
+            os.environ.get("KFTPU_SERVING_PIPELINE_DEPTH", "0")),
         # Train->serve handoff: restore params from a TpuJob's checkpoint
         # dir (the same orbax tree the trainer writes).
         "checkpoint_dir": os.environ.get(
@@ -322,12 +335,17 @@ def build_server(cfg: dict) -> ServingServer:
             jax.random.PRNGKey(0),
             jax.numpy.zeros((1, 1), jax.numpy.int32), decode=True,
         )["params"]}
-    engine = ServingEngine(
-        model, params,
-        ServingConfig(max_batch=cfg["max_batch"], max_len=cfg["max_len"],
-                      decode_chunk=cfg["decode_chunk"]),
-        mesh=mesh,
-    )
+    scfg_kw = dict(max_batch=cfg["max_batch"], max_len=cfg["max_len"],
+                   decode_chunk=cfg["decode_chunk"])
+    if cfg.get("quantize"):
+        scfg_kw["quantize"] = cfg["quantize"]
+    if cfg.get("param_dtype"):
+        scfg_kw["param_dtype"] = cfg["param_dtype"]
+    if cfg.get("prefill_buckets"):
+        scfg_kw["prefill_buckets"] = tuple(cfg["prefill_buckets"])
+    if cfg.get("pipeline_depth"):
+        scfg_kw["pipeline_depth"] = cfg["pipeline_depth"]
+    engine = ServingEngine(model, params, ServingConfig(**scfg_kw), mesh=mesh)
     tokenizer = None
     if cfg.get("tokenizer"):
         from tokenizers import Tokenizer

@@ -85,6 +85,105 @@ def cmd_apply(args) -> int:
     return 0
 
 
+def cmd_plan(args) -> int:
+    """Capacity-plan TpuJob manifests before scheduling them.
+
+    Prints a per-chip HBM account (params/grads/optimizer/activations) for
+    every TpuJob doc and exits 2 if any doesn't fit its slice — the
+    admission-time answer to the reference's discover-OOM-at-runtime GPU
+    limit strings (reference: components/jupyter-web-app/backend/
+    kubeflow_jupyter/common/utils.py:390-443). ``--aot`` re-execs the
+    planner under a virtual device mesh of the slice's exact chip count
+    and reads XLA's own buffer assignment instead of the analytic model.
+    """
+    import subprocess
+
+    from kubeflow_tpu.topology.capacity import GiB, analytic_report
+    from kubeflow_tpu.topology.mesh import AxisSpec
+
+    docs = [d for d in _load_docs(args.filename)
+            if d.get("kind") == "TpuJob"]
+    if not docs:
+        print("no TpuJob documents in input", file=sys.stderr)
+        return 1
+    from kubeflow_tpu.topology import get_slice
+
+    all_fit = True
+    reports = []
+    for d in docs:
+        job = object_from_dict(d)
+        name = job.metadata.name
+        if not job.spec.model:
+            print(f"{name}: custom-image job (no registry model) — "
+                  "not planned")
+            continue
+        env = {e.name: e.value for e in job.spec.env}
+        st = get_slice(job.spec.slice_type)
+        n_hosts = st.num_hosts * job.spec.num_slices
+        global_batch = int(
+            env.get("KFTPU_BATCH_PER_HOST", "8")) * n_hosts
+        seq_len = int(env.get("KFTPU_SEQ_LEN", "1024"))
+        model_kw = json.loads(env.get("KFTPU_MODEL_KW", "{}") or "{}")
+        hparams = json.loads(env.get("KFTPU_HPARAMS", "{}") or "{}")
+        m = job.spec.mesh
+        axes = {a: int(getattr(m, a)) for a in
+                ("dp", "pp", "ep", "fsdp", "sp", "tp")}
+        if args.aot:
+            cmd = [
+                sys.executable, "-m", "kubeflow_tpu.topology.capacity",
+                "--aot", "--model", job.spec.model,
+                "--slice-type", job.spec.slice_type,
+                "--num-slices", str(job.spec.num_slices),
+                "--axes", json.dumps(axes),
+                "--global-batch", str(global_batch),
+                "--seq-len", str(seq_len),
+                "--model-kw", json.dumps(model_kw),
+                "--mu-dtype", str(hparams.get("mu_dtype", "")),
+            ]
+            chips = st.num_chips * job.spec.num_slices
+            sub_env = dict(os.environ)
+            sub_env["JAX_PLATFORMS"] = ""
+            sub_env["KFTPU_PLATFORM"] = "cpu"
+            sub_env["XLA_FLAGS"] = (
+                sub_env.get("XLA_FLAGS", "").replace(
+                    "--xla_force_host_platform_device_count=8", "").strip()
+                + f" --xla_force_host_platform_device_count={chips}"
+            ).strip()
+            out = subprocess.run(cmd, env=sub_env, capture_output=True,
+                                 text=True)
+            if out.returncode != 0:
+                print(f"{name}: AOT plan failed:\n{out.stderr[-2000:]}",
+                      file=sys.stderr)
+                return 1
+            rep = json.loads(out.stdout.strip().splitlines()[-1])
+        else:
+            rep = analytic_report(
+                job.spec.model, job.spec.slice_type,
+                AxisSpec(**axes),
+                num_slices=job.spec.num_slices,
+                global_batch=global_batch, seq_len=seq_len,
+                mu_dtype=str(hparams.get("mu_dtype", "")),
+                model_kw=model_kw,
+            ).to_dict()
+        reports.append(rep)
+        verdict = "FITS" if rep["fits"] else "DOES NOT FIT"
+        print(
+            f"{name}: {rep['model']} on {rep['slice_name']}"
+            f" x{rep['num_slices']} ({rep['num_chips']} chips, "
+            f"{rep['hbm_per_chip_gib']} GiB/chip) — {verdict}\n"
+            f"  per-chip: total {rep['total_gib']} GiB  "
+            f"params {rep['params']/GiB:.2f}  grads {rep['grads']/GiB:.2f}  "
+            f"opt {rep['opt_state']/GiB:.2f}  "
+            f"act/temp {rep['activations']/GiB:.2f}  [{rep['method']}]"
+        )
+        if rep.get("detail"):
+            print(f"  {rep['detail']}")
+        all_fit = all_fit and rep["fits"]
+    if args.output == "json":
+        print(json.dumps(reports))
+    return 0 if all_fit else 2
+
+
 def cmd_get(args) -> int:
     if args.backend == "kubectl":
         objs = _kubectl_api(args).list(args.kind, namespace=args.namespace)
@@ -267,6 +366,16 @@ def build_parser() -> argparse.ArgumentParser:
     ap = sub.add_parser("apply", help="apply platform config / manifests")
     ap.add_argument("-f", "--filename", action="append", required=True)
     ap.set_defaults(fn=cmd_apply)
+
+    pp = sub.add_parser(
+        "plan", help="per-chip HBM capacity plan for TpuJob manifests")
+    pp.add_argument("-f", "--filename", action="append", required=True)
+    pp.add_argument("--aot", action="store_true",
+                    help="AOT-compile on a virtual mesh and read XLA's "
+                         "buffer assignment (slower, exact)")
+    pp.add_argument("-o", "--output", choices=("table", "json"),
+                    default="table")
+    pp.set_defaults(fn=cmd_plan)
 
     gp = sub.add_parser("get", help="list resources of a kind")
     gp.add_argument("kind")

@@ -55,6 +55,12 @@ def env_config() -> dict:
         "mesh": mesh,
         "attn_impl": os.environ.get("KFTPU_ATTN_IMPL", "full"),
         "model": os.environ.get("KFTPU_MODEL", "llama-tiny"),
+        # Model config overrides (JSON kwargs for the registry factory):
+        # how a flagship job requests bf16 params / a remat policy. The
+        # admission-time HBM planner reads the same contract
+        # (controllers/tpujob.py _hbm_blocked, topology/capacity.py).
+        "model_kw": json.loads(
+            os.environ.get("KFTPU_MODEL_KW", "{}") or "{}"),
         "checkpoint_dir": os.environ.get("KFTPU_CHECKPOINT_DIR", ""),
         "restart_count": int(os.environ.get("KFTPU_RESTART_COUNT", "0")),
         "steps": int(os.environ.get("KFTPU_TRAIN_STEPS", "100")),
@@ -103,7 +109,7 @@ def run(cfg: dict) -> int:
     from kubeflow_tpu.train import CheckpointService, TrainConfig, Trainer
     from kubeflow_tpu.train.data import SyntheticTextConfig, synthetic_text
 
-    model, model_cfg = get_model(cfg["model"])
+    model, model_cfg = get_model(cfg["model"], **cfg.get("model_kw", {}))
     axes = AxisSpec(**{k: int(v) for k, v in cfg["mesh"].items()}) \
         if cfg["mesh"] else AxisSpec(dp=-1)
     pp = axes.pp

@@ -139,12 +139,7 @@ class Trainer:
             return self.model.init(rng, x, train=False)
         return self.model.init(rng, x[:, :-1] if x.shape[1] > 1 else x)
 
-    def init_state(self, rng: jax.Array, batch: Dict[str, jax.Array]) -> TrainState:
-        """Shard-aware init: params are created directly in their target
-        shardings (jit with out_shardings), never materialised replicated."""
-        abstract = jax.eval_shape(self._init_variables, rng, batch)
-        shardings = param_shardings(self.mesh, abstract, self.rules)
-
+    def _make_state_fn(self, batch) -> Callable:
         def make_state(rng):
             variables = nn.meta.unbox(self._init_variables(rng, batch))
             params = variables["params"]
@@ -160,10 +155,31 @@ class Trainer:
                 extra_vars=extra,
             )
 
+        return make_state
+
+    def abstract_state(self, rng, batch) -> Tuple[TrainState, TrainState]:
+        """(abstract TrainState, matching sharding tree) without touching a
+        single device buffer — ``batch`` may be ShapeDtypeStructs. The
+        capacity planner (topology/capacity.py aot_report) lowers the train
+        step against exactly this pair."""
+        abstract = jax.eval_shape(self._init_variables, rng, batch)
+        shardings = param_shardings(self.mesh, abstract, self.rules)
         with self.mesh:
-            abstract_state = jax.eval_shape(make_state, rng)
+            # batch rides through eval_shape as an argument (not a closure)
+            # so ShapeDtypeStruct batches trace like arrays.
+            abstract_state = jax.eval_shape(
+                lambda r, b: self._make_state_fn(b)(r), rng, batch
+            )
             state_shardings = self._state_shardings(abstract_state, shardings)
-            init_fn = jax.jit(make_state, out_shardings=state_shardings)
+        return abstract_state, state_shardings
+
+    def init_state(self, rng: jax.Array, batch: Dict[str, jax.Array]) -> TrainState:
+        """Shard-aware init: params are created directly in their target
+        shardings (jit with out_shardings), never materialised replicated."""
+        _, state_shardings = self.abstract_state(rng, batch)
+        with self.mesh:
+            init_fn = jax.jit(self._make_state_fn(batch),
+                              out_shardings=state_shardings)
             state = init_fn(rng)
         n = sum(x.size for x in jax.tree.leaves(state.params))
         log.info("initialised model", kv={"params": f"{n/1e6:.1f}M"})

@@ -244,6 +244,13 @@ class JsonHttpServer:
         return self
 
     def stop(self) -> None:
-        self.httpd.shutdown()
+        # shutdown() handshakes with serve_forever — calling it on a
+        # never-started server waits forever on the is_shut_down event.
+        if self._thread is not None:
+            self.httpd.shutdown()
+        # Release the listening socket: without server_close() the port
+        # keeps accepting connections into the backlog after stop(), so a
+        # "dead" server looks alive to health checks and failover logic.
+        self.httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)

@@ -332,3 +332,139 @@ class TestServingE2E:
         out = json.load(urllib.request.urlopen(req, timeout=60))
         assert len(out["tokens"]) == 4
         kubelet.shutdown()
+
+
+class TestServingReplicasE2E:
+    """Two REAL serving replicas behind one LB endpoint: least-loaded
+    dispatch, kill one replica mid-stream, the other absorbs new requests,
+    and the controller heals the gang back to 2 (the reference's
+    TF-Serving-Deployment-with-replicas semantics, test_tf_serving.py:60-100,
+    upgraded with L7 load awareness)."""
+
+    def test_two_replicas_kill_one_failover(self, tmp_path):
+        import urllib.request
+
+        from kubeflow_tpu.controlplane.api import Serving, ServingSpec
+        from kubeflow_tpu.controlplane.controllers import ServingController
+        from kubeflow_tpu.serving.lb import (
+            ServingLBServer,
+            ServingLoadBalancer,
+        )
+
+        api = InMemoryApiServer()
+        reg = MetricsRegistry()
+        mgr = ControllerManager(api)
+        mgr.register(ServingController(api, reg, drain_grace_s=0.2))
+        kubelet = ProcessKubelet(
+            api, reg,
+            env_overrides=lambda pod: {
+                "KFTPU_PLATFORM": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                "JAX_PLATFORMS": "",
+                "KFTPU_SERVING_HOST": "127.0.0.1",
+            },
+            log_dir=str(tmp_path / "podlogs"),
+        )
+        mgr.register(kubelet)
+
+        # Two consecutive free ports (ordinal offset on a flat host net).
+        base = None
+        for _ in range(50):
+            cand = _free_port()
+            try:
+                s = socket.socket()
+                s.bind(("127.0.0.1", cand + 1))
+                s.close()
+                base = cand
+                break
+            except OSError:
+                continue
+        assert base is not None
+
+        api.create(Serving(
+            metadata=ObjectMeta(name="llm", namespace="team-a"),
+            spec=ServingSpec(
+                model="llama-tiny", slice_type="v5e-8", replicas=2,
+                max_batch=2, max_len=128, decode_chunk=2, port=base,
+            ),
+        ))
+        mgr.run_until_idle()
+        sv = api.get("Serving", "llm", "team-a")
+        assert sv.status.replicas == 2
+
+        def wait_healthy(port, deadline):
+            while time.time() < deadline:
+                kubelet.sync()
+                mgr.run_until_idle()
+                try:
+                    h = json.load(urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=2))
+                    if h.get("ok"):
+                        return True
+                except OSError:
+                    time.sleep(0.5)
+            return False
+
+        deadline = time.time() + E2E_TIMEOUT
+        assert wait_healthy(base, deadline)
+        assert wait_healthy(base + 1, deadline)
+        mgr.run_until_idle()
+
+        lb = ServingLoadBalancer()
+        front = ServingLBServer(lb, api=api, namespace="team-a", name="llm")
+        front.tick()
+        assert len(lb.backends()) == 2
+        front.start()
+        lb_url = f"http://127.0.0.1:{front.port}/v1/generate"
+
+        try:
+            # open a stream through the LB
+            req = urllib.request.Request(
+                lb_url,
+                data=json.dumps({"tokens": [3, 5, 7], "stream": True,
+                                 "max_new_tokens": 512}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = urllib.request.urlopen(req, timeout=60)
+            first = json.loads(resp.readline())
+            assert first.get("tokens"), first
+
+            # find which replica holds the stream and SIGKILL it
+            busy = [b for b in lb.backends() if b["in_flight"] == 1]
+            assert len(busy) == 1
+            busy_port = int(busy[0]["addr"].rsplit(":", 1)[1])
+            ordinal = busy_port - base
+            assert kubelet.kill_pod(f"llm-serving-{ordinal}", "team-a")
+
+            # the stream dies (error chunk or truncation — never a hang)
+            tail = [json.loads(l) for l in resp if l.strip()]
+            assert not tail or "error" in tail[-1] or "done" not in tail[-1]
+
+            # new requests go to the surviving replica
+            out = json.load(_post_json(
+                lb_url, {"tokens": [3, 5, 7], "max_new_tokens": 4}))
+            assert len(out["tokens"]) == 4
+            snap = {b["addr"]: b for b in lb.backends()}
+            assert snap[busy[0]["addr"]]["healthy"] is False
+
+            # controller heals: Failed pod recreated, back to 2 ready
+            deadline = time.time() + E2E_TIMEOUT
+            assert wait_healthy(busy_port, deadline)
+            mgr.run_until_idle()
+            sv = api.get("Serving", "llm", "team-a")
+            assert sv.status.ready_replicas == 2
+            front.tick()
+            assert sum(b["healthy"] for b in lb.backends()) == 2
+        finally:
+            front.stop()
+            kubelet.shutdown()
+
+
+def _post_json(url, body, timeout=60):
+    import urllib.request
+
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    return urllib.request.urlopen(req, timeout=timeout)

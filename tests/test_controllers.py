@@ -477,6 +477,138 @@ class TestServing:
         pod = api.get("Pod", "lbl-serving-0", "team-a")
         assert pod.metadata.labels["serving-name"] == "lbl"
 
+    def test_engine_knobs_ride_env_contract(self):
+        """quantize/param_dtype/prefill_buckets/pipeline_depth reach the
+        pod env (the int8 path must be switchable from the CRD — it's what
+        fits an 8B model on a 16G chip)."""
+        api, mgr, kubelet = self._world()
+        api.create(self._serving(
+            name="q8", quantize="int8", param_dtype="float32",
+            prefill_buckets=[64, 256], pipeline_depth=3,
+        ))
+        mgr.run_until_idle()
+        pod = api.get("Pod", "q8-serving-0", "team-a")
+        env = {e.name: e.value for e in pod.spec.containers[0].env}
+        assert env["KFTPU_SERVING_QUANTIZE"] == "int8"
+        assert env["KFTPU_SERVING_PARAM_DTYPE"] == "float32"
+        assert env["KFTPU_SERVING_PREFILL_BUCKETS"] == "64,256"
+        assert env["KFTPU_SERVING_PIPELINE_DEPTH"] == "3"
+        # defaults stay off the env so existing pods see no spec drift
+        api.create(self._serving(name="plain"))
+        mgr.run_until_idle()
+        pod = api.get("Pod", "plain-serving-0", "team-a")
+        env = {e.name: e.value for e in pod.spec.containers[0].env}
+        for k in ("KFTPU_SERVING_QUANTIZE", "KFTPU_SERVING_PARAM_DTYPE",
+                  "KFTPU_SERVING_PREFILL_BUCKETS",
+                  "KFTPU_SERVING_PIPELINE_DEPTH"):
+            assert k not in env
+
+    def test_invalid_quantize_rejected(self):
+        api, mgr, _ = self._world()
+        api.create(self._serving(name="badq", quantize="fp4"))
+        mgr.run_until_idle()
+        sv = api.get("Serving", "badq", "team-a")
+        assert sv.status.phase == "Failed"
+        assert "quantize" in sv.status.conditions[-1].message
+
+    def _replica_world(self, drain_grace_s=0.0):
+        from kubeflow_tpu.controlplane.controllers import ServingController
+
+        api = InMemoryApiServer()
+        reg = MetricsRegistry()
+        mgr = ControllerManager(api)
+        mgr.register(ServingController(api, reg,
+                                       drain_grace_s=drain_grace_s))
+        kubelet = FakeKubelet(api, reg)
+        mgr.register(kubelet)
+        return api, mgr, kubelet
+
+    def test_replicas_scale_up(self):
+        api, mgr, kubelet = self._replica_world()
+        api.create(self._serving(name="llm", replicas=2, port=9000))
+        mgr.run_until_idle()
+        for i in range(2):
+            pod = api.get("Pod", f"llm-serving-{i}", "team-a")
+            env = {e.name: e.value for e in pod.spec.containers[0].env}
+            # ordinal port offset: replicas must not collide on the flat
+            # process-kubelet host network
+            assert env["KFTPU_SERVING_PORT"] == str(9000 + i)
+        kubelet.tick()
+        mgr.run_until_idle()
+        sv = api.get("Serving", "llm", "team-a")
+        assert sv.status.ready_replicas == 2
+        assert sv.status.replicas == 2
+        assert len(sv.status.endpoints) == 2
+        assert {e.split(":")[1] for e in sv.status.endpoints} == \
+            {"9000", "9001"}
+
+    def test_scale_down_drains_before_delete(self):
+        api, mgr, kubelet = self._replica_world(drain_grace_s=30.0)
+        api.create(self._serving(name="llm", replicas=2, port=9000))
+        mgr.run_until_idle()
+        kubelet.tick()
+        mgr.run_until_idle()
+        sv = api.get("Serving", "llm", "team-a")
+        sv.spec.replicas = 1
+        api.update(sv)
+        mgr.run_until_idle()
+        # within the grace window: replica 1 still exists (in-flight
+        # requests finish) but is gone from the dispatch set
+        pod1 = api.try_get("Pod", "llm-serving-1", "team-a")
+        assert pod1 is not None
+        from kubeflow_tpu.controlplane.controllers.serving import (
+            ServingController,
+        )
+        assert ServingController.DRAIN_ANNOTATION in pod1.metadata.annotations
+        sv = api.get("Serving", "llm", "team-a")
+        assert len(sv.status.endpoints) == 1
+        assert sv.status.endpoints[0].endswith(":9000")
+
+    def test_scale_down_deletes_after_grace(self):
+        api, mgr, kubelet = self._replica_world(drain_grace_s=0.0)
+        api.create(self._serving(name="llm", replicas=3, port=9000))
+        mgr.run_until_idle()
+        kubelet.tick()
+        mgr.run_until_idle()
+        sv = api.get("Serving", "llm", "team-a")
+        sv.spec.replicas = 1
+        api.update(sv)
+        mgr.run_until_idle()
+        mgr.run_until_idle()   # second pass: drain marked, then deleted
+        assert api.try_get("Pod", "llm-serving-1", "team-a") is None
+        assert api.try_get("Pod", "llm-serving-2", "team-a") is None
+        assert api.try_get("Pod", "llm-serving-0", "team-a") is not None
+
+    def test_failed_replica_recreated(self):
+        api, mgr, kubelet = self._replica_world()
+        kubelet.outcome = lambda name: None
+        api.create(self._serving(name="llm", replicas=2))
+        mgr.run_until_idle()
+        kubelet.tick()
+        mgr.run_until_idle()
+        old_uid = api.get("Pod", "llm-serving-1", "team-a").metadata.uid
+        # replica 1 crashes ONCE (a one-shot outcome: the recreated pod
+        # must not be re-failed or reconcile livelocks by design)
+        crashed = []
+
+        def crash_once(name):
+            if name.endswith("-1") and not crashed:
+                crashed.append(name)
+                return "Failed"
+            return None
+
+        kubelet.outcome = crash_once
+        kubelet.tick()
+        mgr.run_until_idle()
+        kubelet.outcome = None
+        kubelet.tick()
+        mgr.run_until_idle()
+        pod = api.get("Pod", "llm-serving-1", "team-a")
+        assert pod.metadata.uid != old_uid
+        assert pod.status.phase == "Running"
+        sv = api.get("Serving", "llm", "team-a")
+        assert sv.status.ready_replicas == 2
+
     def test_spec_change_recreates_pod(self):
         api, mgr, kubelet = self._world()
         api.create(self._serving(name="llm2", port=8000))

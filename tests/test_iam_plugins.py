@@ -1,0 +1,149 @@
+"""Cloud-IAM plugin conformance: every plugin behind the Profile
+controller's seam must satisfy the same contract.
+
+The reference shipped two cloud-IAM impls behind one Plugin interface —
+GCP workload identity (plugin_workload_identity.go:44-166) and AWS IRSA
+(plugin_iam.go:32-283). One conformance suite parametrized over both
+proves the seam isn't shaped around its only user: idempotent apply,
+revoke-on-delete via the finalizer, and the applied-plugins revoke ledger
+must hold for each.
+"""
+
+import pytest
+
+from kubeflow_tpu.controlplane.api import ObjectMeta, Profile, ProfileSpec
+from kubeflow_tpu.controlplane.api.types import ProfilePluginSpec
+from kubeflow_tpu.controlplane.controllers import ProfileController
+from kubeflow_tpu.controlplane.controllers.profile import (
+    PLUGIN_FINALIZER,
+    AwsIamForServiceAccountPlugin,
+    WorkloadIdentityPlugin,
+)
+from kubeflow_tpu.controlplane.runtime import (
+    ControllerManager,
+    InMemoryApiServer,
+)
+from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+CASES = [
+    pytest.param(
+        WorkloadIdentityPlugin,
+        {"gcpServiceAccount": "ml@proj.iam.gserviceaccount.com"},
+        "iam.gke.io/gcp-service-account",
+        "ml@proj.iam.gserviceaccount.com",
+        lambda ns: f"serviceAccount:{ns}/default-editor",
+        id="gcp-workload-identity",
+    ),
+    pytest.param(
+        AwsIamForServiceAccountPlugin,
+        {"awsIamRole": "arn:aws:iam::12345:role/kf-user"},
+        "eks.amazonaws.com/role-arn",
+        "arn:aws:iam::12345:role/kf-user",
+        lambda ns: f"system:serviceaccount:{ns}:default-editor",
+        id="aws-irsa",
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "plugin_cls,params,annotation,grant_key,principal", CASES)
+class TestPluginConformance:
+    def _world(self, plugin):
+        api = InMemoryApiServer()
+        reg = MetricsRegistry()
+        mgr = ControllerManager(api)
+        mgr.register(ProfileController(
+            api, reg, plugins={plugin.KIND: plugin}))
+        return api, mgr
+
+    def _profile(self, plugin_cls, params, name="team-a"):
+        return Profile(
+            metadata=ObjectMeta(name=name),
+            spec=ProfileSpec(
+                owner="alice@example.com",
+                plugins=[ProfilePluginSpec(kind=plugin_cls.KIND,
+                                           params=dict(params))],
+            ),
+        )
+
+    def test_apply_grants_and_annotates(
+            self, plugin_cls, params, annotation, grant_key, principal):
+        plugin = plugin_cls()
+        api, mgr = self._world(plugin)
+        api.create(self._profile(plugin_cls, params))
+        mgr.run_until_idle()
+        sa = api.get("ServiceAccount", "default-editor", "team-a")
+        assert sa.metadata.annotations[annotation] == params[
+            list(params)[0]]
+        assert principal("team-a") in plugin.iam[grant_key]
+        prof = api.get("Profile", "team-a")
+        assert prof.status.phase == "Ready"
+        assert [p.kind for p in prof.status.applied_plugins] == \
+            [plugin_cls.KIND]
+        assert PLUGIN_FINALIZER in prof.metadata.finalizers
+
+    def test_apply_is_idempotent(
+            self, plugin_cls, params, annotation, grant_key, principal):
+        plugin = plugin_cls()
+        api, mgr = self._world(plugin)
+        api.create(self._profile(plugin_cls, params))
+        mgr.run_until_idle()
+        # a second full reconcile pass must not duplicate grants or ledger
+        ctl = [c for c in mgr.controllers
+               if isinstance(c, ProfileController)][0]
+        ctl.reconcile("", "team-a")
+        ctl.reconcile("", "team-a")
+        mgr.run_until_idle()
+        assert plugin.iam[grant_key] == {principal("team-a")}
+        prof = api.get("Profile", "team-a")
+        assert len(prof.status.applied_plugins) == 1
+
+    def test_delete_revokes_via_finalizer(
+            self, plugin_cls, params, annotation, grant_key, principal):
+        plugin = plugin_cls()
+        api, mgr = self._world(plugin)
+        api.create(self._profile(plugin_cls, params))
+        mgr.run_until_idle()
+        api.delete("Profile", "team-a")
+        mgr.run_until_idle()
+        assert plugin.iam[grant_key] == set()
+        assert api.try_get("Profile", "team-a") is None
+
+    def test_ledger_revokes_edited_grant(
+            self, plugin_cls, params, annotation, grant_key, principal):
+        """Editing the plugin params revokes the OLD grant (the ledger
+        diff), not just adds the new one."""
+        plugin = plugin_cls()
+        api, mgr = self._world(plugin)
+        api.create(self._profile(plugin_cls, params))
+        mgr.run_until_idle()
+        prof = api.get("Profile", "team-a")
+        key = list(params)[0]
+        new_params = {key: params[key].replace("kf-user", "other")
+                      .replace("ml@", "other@")}
+        prof.spec.plugins = [ProfilePluginSpec(kind=plugin_cls.KIND,
+                                               params=new_params)]
+        api.update(prof)
+        mgr.run_until_idle()
+        assert plugin.iam[grant_key] == set()          # old grant revoked
+        assert principal("team-a") in plugin.iam[new_params[key]]
+        sa = api.get("ServiceAccount", "default-editor", "team-a")
+        assert sa.metadata.annotations[annotation] == new_params[key]
+
+    def test_missing_params_fail_loudly(
+            self, plugin_cls, params, annotation, grant_key, principal):
+        plugin = plugin_cls()
+        api, mgr = self._world(plugin)
+        api.create(self._profile(plugin_cls, {}))
+        mgr.run_until_idle()
+        prof = api.get("Profile", "team-a")
+        assert prof.status.phase == "Failed"
+        assert prof.status.conditions[-1].reason == "PluginError"
+
+
+class TestBothRegisteredByDefault:
+    def test_default_plugin_set(self):
+        api = InMemoryApiServer()
+        ctl = ProfileController(api, MetricsRegistry())
+        assert set(ctl.plugins) == {
+            "WorkloadIdentity", "AwsIamForServiceAccount"}

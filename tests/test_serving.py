@@ -608,6 +608,41 @@ class TestTrainServeHandoff:
         finally:
             server.stop()
 
+    def test_engine_knobs_from_env(self, monkeypatch):
+        """KFTPU_SERVING_QUANTIZE / PARAM_DTYPE / PREFILL_BUCKETS /
+        PIPELINE_DEPTH reach the engine's ServingConfig — the CRD-to-engine
+        path that makes int8 switchable from a Serving CR."""
+        import os
+
+        from kubeflow_tpu.serving.server import build_server, env_config
+
+        for k in list(os.environ):
+            if k.startswith("KFTPU_SERVING"):
+                monkeypatch.delenv(k)
+        monkeypatch.setenv("KFTPU_SERVING_MODEL", "llama-tiny")
+        monkeypatch.setenv("KFTPU_SERVING_MAX_LEN", "64")
+        monkeypatch.setenv("KFTPU_SERVING_HOST", "127.0.0.1")
+        monkeypatch.setenv("KFTPU_SERVING_PORT", "0")
+        monkeypatch.setenv("KFTPU_SERVING_QUANTIZE", "int8")
+        monkeypatch.setenv("KFTPU_SERVING_PARAM_DTYPE", "float32")
+        monkeypatch.setenv("KFTPU_SERVING_PREFILL_BUCKETS", "16,32")
+        monkeypatch.setenv("KFTPU_SERVING_PIPELINE_DEPTH", "1")
+        cfg = env_config()
+        assert cfg["quantize"] == "int8"
+        assert cfg["prefill_buckets"] == [16, 32]
+        server = build_server(cfg)
+        assert server.engine.cfg.quantize == "int8"
+        assert server.engine.cfg.param_dtype == "float32"
+        assert server.engine.cfg.prefill_buckets == (16, 32)
+        assert server.engine.cfg.pipeline_depth == 1
+        # defaults survive when env is absent
+        for k in ("KFTPU_SERVING_QUANTIZE", "KFTPU_SERVING_PARAM_DTYPE",
+                  "KFTPU_SERVING_PREFILL_BUCKETS",
+                  "KFTPU_SERVING_PIPELINE_DEPTH"):
+            monkeypatch.delenv(k)
+        cfg = env_config()
+        assert cfg["quantize"] == "" and cfg["prefill_buckets"] == []
+
     def test_missing_checkpoint_fails_loudly(self, tmp_path, monkeypatch):
         from kubeflow_tpu.serving.server import build_server, env_config
 

@@ -1,0 +1,297 @@
+"""Serving load balancer (serving/lb.py): least-loaded dispatch, health,
+failover, drain on scale-down.
+
+The reference's serving scale-out was a TF-Serving Deployment behind a
+Service with kube-proxy connection spreading
+(reference testing/test_tf_serving.py:60-100); the platform replaces that
+with an L7 balancer aware of per-request load and streams.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.serving.lb import ServingLBServer, ServingLoadBalancer
+from kubeflow_tpu.webapps.router import (
+    JsonHttpServer,
+    NdjsonStream,
+    Request,
+    RestError,
+    Router,
+)
+
+
+class StubBackend:
+    """Looks like serving.server to the LB: /v1/generate, /v1/models,
+    /healthz. Generation echoes which backend served it; an Event can
+    hold responses open so tests can pin in-flight load."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.requests = 0
+        self.hold = threading.Event()
+        self.hold.set()                 # open = respond immediately
+        self.ok = True
+        r = Router()
+        r.post("/v1/generate", self._generate)
+        r.get("/v1/models", lambda q: {"models": [{"name": self.name}]})
+        r.get("/healthz", self._healthz)
+        self._srv = JsonHttpServer(r, port=0).start()
+        self.addr = f"127.0.0.1:{self._srv.port}"
+
+    def _healthz(self, q: Request):
+        return {"ok": True} if self.ok else (503, {"ok": False})
+
+    def _generate(self, q: Request):
+        self.requests += 1
+        if not q.body.get("tokens"):
+            raise RestError(400, "body.tokens must be a list of ints")
+        self.hold.wait(10)
+        if q.body.get("stream"):
+            def chunks():
+                yield {"tokens": [1, 2], "backend": self.name}
+                self.hold.wait(10)
+                yield {"done": True, "backend": self.name}
+            return NdjsonStream(chunks())
+        return {"tokens": [1, 2, 3], "backend": self.name}
+
+    def stop(self):
+        self._srv.stop()
+
+
+@pytest.fixture()
+def backends():
+    b = [StubBackend("b0"), StubBackend("b1")]
+    yield b
+    for x in b:
+        x.stop()
+
+
+def _post(url, body, timeout=10):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+class TestDispatch:
+    def test_least_loaded_dispatch(self, backends):
+        b0, b1 = backends
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        url = f"http://127.0.0.1:{srv.port}/v1/generate"
+        try:
+            # hold b-something busy with a pinned request, then send
+            # another: it must go to the idle backend
+            b0.hold.clear()
+            b1.hold.clear()
+            first = threading.Thread(
+                target=lambda: _post(url, {"tokens": [1]}).read())
+            first.start()
+            deadline = time.time() + 5
+            while not (b0.requests or b1.requests):
+                assert time.time() < deadline
+                time.sleep(0.01)
+            busy, idle = (b0, b1) if b0.requests else (b1, b0)
+            idle.hold.set()
+            out = json.load(_post(url, {"tokens": [1]}))
+            assert out["backend"] == idle.name
+            busy.hold.set()
+            first.join(timeout=5)
+            assert busy.requests == 1 and idle.requests == 1
+        finally:
+            b0.hold.set()
+            b1.hold.set()
+            srv.stop()
+
+    def test_application_errors_relay_untouched(self, backends):
+        b0, b1 = backends
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"http://127.0.0.1:{srv.port}/v1/generate",
+                      {"tokens": []})
+            assert ei.value.code == 400
+            assert "tokens" in json.load(ei.value)["error"]
+            # a 400 is the backend SPEAKING http — it must stay healthy
+            assert all(b["healthy"] for b in lb.backends())
+        finally:
+            srv.stop()
+
+    def test_failover_to_live_backend(self, backends):
+        b0, b1 = backends
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        try:
+            b0.stop()   # dead socket: connection refused
+            out = json.load(_post(
+                f"http://127.0.0.1:{srv.port}/v1/generate", {"tokens": [1]}))
+            assert out["backend"] == "b1"
+            snap = {b["addr"]: b for b in lb.backends()}
+            assert snap[b0.addr]["healthy"] is False
+            assert snap[b1.addr]["healthy"] is True
+        finally:
+            srv.stop()
+
+    def test_all_dead_is_502_then_503(self, backends):
+        b0, b1 = backends
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        try:
+            b0.stop()
+            b1.stop()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"http://127.0.0.1:{srv.port}/v1/generate",
+                      {"tokens": [1]})
+            assert ei.value.code == 502
+            # both now marked unhealthy -> no candidates -> 503
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"http://127.0.0.1:{srv.port}/v1/generate",
+                      {"tokens": [1]})
+            assert ei.value.code == 503
+        finally:
+            srv.stop()
+
+    def test_streaming_relay(self, backends):
+        b0, _ = backends
+        lb = ServingLoadBalancer([b0.addr])
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        try:
+            resp = _post(f"http://127.0.0.1:{srv.port}/v1/generate",
+                         {"tokens": [1], "stream": True})
+            chunks = [json.loads(l) for l in resp if l.strip()]
+            assert chunks[0]["tokens"] == [1, 2]
+            assert chunks[-1]["done"] is True
+        finally:
+            srv.stop()
+
+
+class TestHealthAndDrain:
+    def test_health_check_recovers_backend(self, backends):
+        b0, b1 = backends
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        b0.ok = False
+        assert lb.health_check() == 1
+        snap = {b["addr"]: b for b in lb.backends()}
+        assert snap[b0.addr]["healthy"] is False
+        b0.ok = True
+        assert lb.health_check() == 2
+        assert all(b["healthy"] for b in lb.backends())
+
+    def test_drain_holds_until_in_flight_zero(self, backends):
+        b0, b1 = backends
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        url = f"http://127.0.0.1:{srv.port}/v1/generate"
+        try:
+            b0.hold.clear()
+            b1.hold.clear()
+            t = threading.Thread(
+                target=lambda: _post(url, {"tokens": [1]}).read())
+            t.start()
+            deadline = time.time() + 5
+            while not (b0.requests or b1.requests):
+                assert time.time() < deadline
+                time.sleep(0.01)
+            busy = b0 if b0.requests else b1
+            other = b1 if busy is b0 else b0
+            # scale down to just the idle backend: busy one must DRAIN,
+            # not vanish (its request is still in flight)
+            lb.set_backends([other.addr])
+            snap = {b["addr"]: b for b in lb.backends()}
+            assert snap[busy.addr]["draining"] is True
+            # new requests only go to the survivor
+            other.hold.set()
+            out = json.load(_post(url, {"tokens": [1]}))
+            assert out["backend"] == other.name
+            # in-flight completes -> drained backend is dropped
+            busy.hold.set()
+            t.join(timeout=5)
+            deadline = time.time() + 5
+            while any(b["addr"] == busy.addr for b in lb.backends()):
+                assert time.time() < deadline
+                time.sleep(0.01)
+        finally:
+            b0.hold.set()
+            b1.hold.set()
+            srv.stop()
+
+    def test_set_backends_revert_undrains(self, backends):
+        b0, b1 = backends
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        url = f"http://127.0.0.1:{srv.port}/v1/generate"
+        try:
+            b0.hold.clear()
+            t = threading.Thread(
+                target=lambda: _post(url, {"tokens": [1]}).read())
+            t.start()
+            deadline = time.time() + 5
+            while not (b0.requests or b1.requests):
+                assert time.time() < deadline
+                time.sleep(0.01)
+            busy = b0 if b0.requests else b1
+            lb.set_backends([b1.addr] if busy is b0 else [b0.addr])
+            lb.set_backends([b0.addr, b1.addr])   # scale-down reverted
+            snap = {b["addr"]: b for b in lb.backends()}
+            assert not any(b["draining"] for b in snap.values())
+            b0.hold.set()
+            t.join(timeout=5)
+        finally:
+            b0.hold.set()
+            srv.stop()
+
+    def test_healthz_aggregates(self, backends):
+        b0, b1 = backends
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        try:
+            body = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz"))
+            assert body["ok"] is True
+            assert len(body["backends"]) == 2
+            lb.set_backends([])
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/healthz")
+            assert ei.value.code == 503
+        finally:
+            srv.stop()
+
+
+class TestLBServer:
+    def test_follows_serving_cr_endpoints(self, backends):
+        """ServingLBServer.tick() syncs the dispatch set from the Serving
+        CR's status.endpoints (what the controller maintains)."""
+        from kubeflow_tpu.controlplane.api import Serving, ServingSpec
+        from kubeflow_tpu.controlplane.api.meta import ObjectMeta
+        from kubeflow_tpu.controlplane.runtime.apiserver import (
+            InMemoryApiServer,
+        )
+
+        b0, b1 = backends
+        api = InMemoryApiServer()
+        sv = Serving(metadata=ObjectMeta(name="llm", namespace="team-a"),
+                     spec=ServingSpec(model="llama-tiny"))
+        api.create(sv)
+        sv = api.get("Serving", "llm", "team-a")
+        sv.status.endpoints = [b0.addr, b1.addr]
+        api.update_status(sv)
+
+        lb = ServingLoadBalancer()
+        srv = ServingLBServer(lb, api=api, namespace="team-a", name="llm")
+        srv.tick()
+        assert {b["addr"] for b in lb.backends()} == {b0.addr, b1.addr}
+        # replica leaves status.endpoints (controller drain) -> LB drains
+        sv = api.get("Serving", "llm", "team-a")
+        sv.status.endpoints = [b0.addr]
+        api.update_status(sv)
+        srv.tick()
+        assert {b["addr"] for b in lb.backends()} == {b0.addr}
+        srv.stop()
