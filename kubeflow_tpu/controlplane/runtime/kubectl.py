@@ -176,17 +176,38 @@ class KubectlApiServer:
                         stdin=self._manifest(obj))
         return self._parse(out)
 
+    # get -> graft -> replace has a read-modify-write window a concurrent
+    # writer can land in; bounded retries keep the in-memory contract
+    # (update_status never Conflicts against a live object).
+    STATUS_CONFLICT_RETRIES = 5
+
     def update_status(self, obj: Any) -> Any:
         # Replace only the status subresource: read the live object, graft
         # our status on, keep the live spec (concurrent spec writes win —
-        # the same contract as InMemoryApiServer.update_status).
-        live = self.get(obj.kind, obj.metadata.name, obj.metadata.namespace)
-        live.status = obj.status
-        out = self._run(
-            ["replace", "--subresource", "status", "-f", "-", "-o", "json"],
-            stdin=self._manifest(live),
-        )
-        return self._parse(out)
+        # the same contract as InMemoryApiServer.update_status, whose
+        # status write ALWAYS succeeds against a live object). A real
+        # apiserver 409s when a writer slips between our read and replace;
+        # retrying with a fresh read is exactly what controller-runtime's
+        # retry.RetryOnConflict does, and without it the adapter would
+        # surface spurious Conflicts the in-memory backend never raises.
+        last: Exception
+        for attempt in range(self.STATUS_CONFLICT_RETRIES):
+            live = self.get(obj.kind, obj.metadata.name,
+                            obj.metadata.namespace)
+            live.status = obj.status
+            try:
+                out = self._run(
+                    ["replace", "--subresource", "status",
+                     "-f", "-", "-o", "json"],
+                    stdin=self._manifest(live),
+                )
+                return self._parse(out)
+            except ConflictError as e:
+                last = e
+                log.info("status write conflicted; rereading",
+                         kv={"kind": obj.kind, "name": obj.metadata.name,
+                             "attempt": attempt + 1})
+        raise last
 
     def delete(self, kind: str, name: str, namespace: str = "") -> None:
         self._run(

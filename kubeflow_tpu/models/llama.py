@@ -390,6 +390,7 @@ class Llama(nn.Module):
         *,
         positions: Optional[jax.Array] = None,
         decode: bool = False,
+        return_hidden: bool = False,
     ) -> jax.Array:
         cfg = self.cfg
         B, S = tokens.shape
@@ -460,6 +461,17 @@ class Llama(nn.Module):
                 x = layer_cls(cfg, name=f"layer_{i}")(x, positions, decode)
 
         x = RMSNorm(cfg, name="final_norm")(x)
+        if return_hidden:
+            # Chunked-loss path (train.losses.chunked_cross_entropy): the
+            # caller owns the lm_head matmul so [B,S,V] logits never
+            # materialise. The lm_head params must still exist for
+            # checkpoints/serving parity, so touch the Dense without
+            # running it on real data (init cost: one [1,E] row).
+            if not cfg.tie_embeddings:
+                _dense(cfg.vocab_size, ("embed", "vocab"), cfg, "lm_head")(
+                    jax.lax.stop_gradient(x[:1, :1])
+                )
+            return x
         out_dtype = jnp.float32 if cfg.logits_f32 else cfg.dtype
         if cfg.tie_embeddings:
             logits = jnp.einsum(

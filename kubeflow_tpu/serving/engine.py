@@ -26,6 +26,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import dataclasses
+import functools
 import itertools
 import time
 from typing import Deque, Dict, List, Optional
@@ -107,6 +108,65 @@ class _InFlight:
     snapshot: list                       # slot objects active at dispatch
 
 
+def _quantizable(path, x, min_size: int) -> bool:
+    """Matmul-sized floating leaves quantize; embedding tables (lookups
+    and tied logits are quality-sensitive) and small tensors pass through."""
+    keys = tuple(str(k).strip("'[]. ") for k in path)
+    is_embed = any("embed" in k for k in keys)
+    return (
+        jnp.issubdtype(x.dtype, jnp.floating)
+        and x.ndim >= 2
+        and x.size >= min_size
+        and not is_embed
+    )
+
+
+def _quantize_leaf(x, contract: int):
+    """Symmetric per-output-channel int8: scale = amax/127 over the
+    contraction axis."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=contract, keepdims=True)
+    scale = jnp.maximum(amax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.bfloat16)
+
+
+@functools.lru_cache(maxsize=None)
+def _surrogate_leaf_fn(shape, dtype_str: str, kind: str, contract: int):
+    """Cached per-signature builders for surrogate leaves — unrolled
+    models repeat the same leaf shape per layer, and a fresh closure per
+    leaf would recompile identical programs dozens of times."""
+    dt = jnp.dtype(dtype_str)
+
+    if kind == "quant":
+
+        @jax.jit
+        def f(k):
+            x = (jax.random.normal(k, shape, jnp.bfloat16) * 0.02).astype(dt)
+            return _quantize_leaf(x, contract)
+
+    elif kind == "ones":
+
+        @jax.jit
+        def f(k):
+            return jnp.ones(shape, dt)
+
+    elif kind == "zeros":
+
+        @jax.jit
+        def f(k):
+            return jnp.zeros(shape, dt)
+
+    else:
+
+        @jax.jit
+        def f(k):
+            return (jax.random.normal(k, shape, jnp.bfloat16)
+                    * 0.02).astype(dt)
+
+    return f
+
+
 def _quantize_int8(params, min_size: int = 65536, *,
                    stacked_layers: bool = False):
     """Split a param tree into (int8-or-passthrough tree, per-leaf scale
@@ -121,23 +181,12 @@ def _quantize_int8(params, min_size: int = 65536, *,
     scale marker."""
 
     def split(path, x):
-        keys = tuple(str(k).strip("'[]. ") for k in path)
-        is_embed = any("embed" in k for k in keys)
-        if (
-            jnp.issubdtype(x.dtype, jnp.floating)
-            and x.ndim >= 2
-            and x.size >= min_size
-            and not is_embed
-        ):
+        if _quantizable(path, x, min_size):
             contract = 1 if (stacked_layers and x.ndim >= 3) else 0
-            xf = x.astype(jnp.float32)
-            amax = jnp.max(jnp.abs(xf), axis=contract, keepdims=True)
-            scale = jnp.maximum(amax / 127.0, 1e-12)
-            q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
             # bf16 scales: the dequantised weight must stay bf16 (an f32
             # scale would promote the whole weight to f32 and double the
             # very HBM traffic quantization removes).
-            return q, scale.astype(jnp.bfloat16)
+            return _quantize_leaf(x, contract)
         return x, jnp.zeros((0,), jnp.bfloat16)
 
     pairs = jax.tree_util.tree_map_with_path(split, params)
@@ -184,34 +233,127 @@ class ServingEngine:
         self._rng = jax.random.PRNGKey(0)
 
         # Accept params straight from model.init (boxed with flax logical-
-        # partitioning metadata) or already-unboxed trees.
-        params = nn.meta.unbox(params)
-        if cfg.param_dtype:
-            dt = jnp.dtype(cfg.param_dtype)
-            params = jax.tree.map(
-                lambda x: x.astype(dt)
-                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
-                params,
-            )
+        # partitioning metadata), already-unboxed trees, or a zero-arg
+        # CALLABLE producing them. The callable form exists for scale:
+        # init + dtype-cast + quantize run as ONE compiled program, so the
+        # full-precision weights are freed inside the computation as each
+        # quantized leaf is produced — an 8B random-init int8 server fits
+        # a 16G chip, where init-then-quantize (32G f32, or 16G bf16 + 8G
+        # int8 live together) cannot.
         self._scales = None
         self._qflags = None
-        if cfg.quantize:
-            if cfg.quantize != "int8":
-                raise ValueError(f"unsupported quantize={cfg.quantize!r}")
-            params, self._scales = _quantize_int8(
-                params, cfg.quantize_min_size,
-                stacked_layers=bool(
-                    getattr(model.cfg, "scan_layers", False)
-                ),
-            )
-            self._qflags = jax.tree.map(
-                lambda s: bool(s.size > 0), self._scales
-            )
-        self.params = self._place_params(params)
+        if cfg.quantize and cfg.quantize != "int8":
+            raise ValueError(f"unsupported quantize={cfg.quantize!r}")
+        if callable(params) and self.mesh is None:
+            params_fn = params
+            if cfg.quantize:
+                # Streaming surrogate init: the quantized tree is built
+                # LEAF BY LEAF on device (random values in the right
+                # shapes/dtypes, norms at 1), so peak HBM is the int8 tree
+                # plus ONE full-precision leaf — a whole-tree
+                # init-then-quantize materialises every bf16 weight at
+                # once and OOMs an 8B model on a 16G chip (measured; XLA
+                # does not interleave init with quantize across leaves).
+                # Real weights always arrive via checkpoints, where values
+                # matter; a random-init int8 server is a dev/bench surface.
+                params, self._scales = self._surrogate_quantized(params_fn)
+                self._qflags = jax.tree.map(
+                    lambda s: bool(s.size > 0), self._scales
+                )
+                self.params = params
+            else:
+
+                def build():
+                    p = nn.meta.unbox(params_fn())
+                    if cfg.param_dtype:
+                        dt = jnp.dtype(cfg.param_dtype)
+                        p = jax.tree.map(
+                            lambda x: x.astype(dt)
+                            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                            p,
+                        )
+                    return p
+
+                self.params = jax.jit(build)()
+        else:
+            if callable(params):
+                # Sharded engines have N x HBM of headroom; materialise
+                # then follow the eager path (placement needs the mesh).
+                params = params()
+            params = nn.meta.unbox(params)
+            if cfg.param_dtype:
+                dt = jnp.dtype(cfg.param_dtype)
+                params = jax.tree.map(
+                    lambda x: x.astype(dt)
+                    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                    else x,
+                    params,
+                )
+            if cfg.quantize:
+                params, self._scales = _quantize_int8(
+                    params, cfg.quantize_min_size,
+                    stacked_layers=bool(
+                        getattr(model.cfg, "scan_layers", False)
+                    ),
+                )
+                self._qflags = jax.tree.map(
+                    lambda s: bool(s.size > 0), self._scales
+                )
+            self.params = self._place_params(params)
         self._cache = self._init_cache()
         self._decode_fn = jax.jit(self._decode_step, donate_argnums=(1,))
         self._prefill_fns: Dict[tuple, object] = {}  # (bucket, k) -> jit
         self.tokens_generated = 0
+        self.decode_dispatches = 0
+
+    def _surrogate_quantized(self, params_fn):
+        """Build the int8 param tree leaf-by-leaf on device.
+
+        Shapes/dtypes come from ``jax.eval_shape(params_fn)`` (zero FLOPs,
+        zero buffers); values are surrogates — N(0, 0.02) kernels and
+        embeddings, ones for 1-D (norm) leaves — generated and quantized
+        one leaf per compiled call so at most one full-precision leaf is
+        ever resident. Serving throughput is weight-agnostic; servers with
+        meaningful weights restore a checkpoint instead."""
+        import numpy as _np
+
+        cfg = self.cfg
+        abstract = jax.eval_shape(lambda: nn.meta.unbox(params_fn()))
+        flat, treedef = jax.tree_util.tree_flatten_with_path(abstract)
+        stacked = bool(getattr(self.model.cfg, "scan_layers", False))
+        base = jax.random.PRNGKey(0)
+        target_dt = jnp.dtype(cfg.param_dtype) if cfg.param_dtype else None
+        qleaves, sleaves = [], []
+        empty_scale = jnp.zeros((0,), jnp.bfloat16)
+        for i, (path, aval) in enumerate(flat):
+            floating = jnp.issubdtype(aval.dtype, jnp.floating)
+            dt = target_dt if (floating and target_dt is not None) \
+                else aval.dtype
+            key = jax.random.fold_in(base, i)
+            if _quantizable(path, aval, cfg.quantize_min_size):
+                contract = 1 if (stacked and aval.ndim >= 3) else 0
+                q, s = _surrogate_leaf_fn(
+                    aval.shape, str(dt), "quant", contract)(key)
+                qleaves.append(q)
+                sleaves.append(s)
+                continue
+            if floating and aval.ndim <= 1:
+                # 1-D floating leaves are norm scales in this model
+                # family: surrogate 1.0 keeps activations bounded.
+                kind = "ones"
+            elif not floating:
+                kind = "zeros"
+            else:
+                kind = "normal"
+            leaf = _surrogate_leaf_fn(aval.shape, str(dt), kind, 0)(key)
+            qleaves.append(leaf)
+            sleaves.append(empty_scale)
+        params = jax.tree_util.tree_unflatten(treedef, qleaves)
+        scales = jax.tree_util.tree_unflatten(treedef, sleaves)
+        n = sum(_np.prod(a.shape) for _, a in flat)
+        log.info("surrogate int8 params built",
+                 kv={"params": f"{n/1e9:.2f}B", "leaves": len(flat)})
+        return params, scales
 
     # ------------- sharding -------------
 
@@ -650,6 +792,9 @@ class ServingEngine:
                 self.params, self._cache, tokens_dev,
                 jnp.asarray(positions), sub, jnp.asarray(temps),
             )
+        # Hardware-independent cost metric: dispatches/token pins the part
+        # of serving latency a ~110ms-per-dispatch tunnel multiplies.
+        self.decode_dispatches += 1
         return _InFlight(out=toks, positions=positions,
                          snapshot=list(self._slots))
 

@@ -308,7 +308,15 @@ def build_server(cfg: dict) -> ServingServer:
     from kubeflow_tpu.models import get_model
     from kubeflow_tpu.topology import AxisSpec, make_host_local_mesh
 
-    model, _ = get_model(cfg["model"])
+    # Build the model in the serving dtype when its config accepts it:
+    # init then creates half-size weights directly (an 8B init in f32
+    # would OOM a 16G chip before the engine ever casts).
+    try:
+        model, _ = get_model(cfg["model"],
+                             param_dtype=cfg.get("param_dtype")
+                             or "bfloat16")
+    except TypeError:
+        model, _ = get_model(cfg["model"])
     mesh = None
     if cfg["mesh"]:
         mesh = make_host_local_mesh(
@@ -331,10 +339,13 @@ def build_server(cfg: dict) -> ServingServer:
                  kv={"dir": cfg["checkpoint_dir"],
                      "step": int(state["step"])})
     if params is None:
-        params = {"params": model.init(
-            jax.random.PRNGKey(0),
-            jax.numpy.zeros((1, 1), jax.numpy.int32), decode=True,
-        )["params"]}
+        # Lazy init: the engine fuses init+cast+quantize into one program
+        # (see ServingEngine) so flagship-size random-init servers fit.
+        def params():
+            return {"params": model.init(
+                jax.random.PRNGKey(0),
+                jax.numpy.zeros((1, 1), jax.numpy.int32), decode=True,
+            )["params"]}
     scfg_kw = dict(max_batch=cfg["max_batch"], max_len=cfg["max_len"],
                    decode_chunk=cfg["decode_chunk"])
     if cfg.get("quantize"):

@@ -208,11 +208,13 @@ def analytic_report(
     if is_lm:
         abstract = _abstract_params(model, (max(1, global_batch), seq_len))
     else:
-        # image models: a nominal NHWC batch (init shapes don't change
-        # param sizes; activation modeling is skipped anyway)
+        # image models: NHWC batch at the model's own image size (ViT
+        # position embeddings are patch-count-shaped, so a hardcoded 224
+        # would fail init for smaller configs)
         import jax.numpy as jnp
 
-        x = jax.ShapeDtypeStruct((max(1, global_batch), 224, 224, 3),
+        side = int(getattr(cfg, "image_size", 224))
+        x = jax.ShapeDtypeStruct((max(1, global_batch), side, side, 3),
                                  jnp.float32)
         rng = jax.eval_shape(lambda: jax.random.PRNGKey(0))
         abstract = jax.eval_shape(

@@ -27,7 +27,11 @@ from kubeflow_tpu.parallel.sharding import (
     Rules,
     param_shardings,
 )
-from kubeflow_tpu.train.losses import cross_entropy_loss, softmax_accuracy
+from kubeflow_tpu.train.losses import (
+    chunked_cross_entropy,
+    cross_entropy_loss,
+    softmax_accuracy,
+)
 from kubeflow_tpu.utils import get_logger
 
 log = get_logger("train")
@@ -61,6 +65,12 @@ class TrainConfig:
     # otherwise create nu in the params dtype, and bf16 nu underflows:
     # (1-b2)*g^2 increments vanish below bf16's 8-bit mantissa.
     mu_dtype: str = ""
+    # >0 fuses lm_head + cross-entropy blockwise over tokens
+    # (losses.chunked_cross_entropy): [B,S,V] logits never materialise,
+    # freeing ~2 x tokens x vocab bytes of activation memory. LM task
+    # only; ignored when the "vocab" axis is tp-sharded (the sharded path
+    # needs the einsum + sharded logsumexp).
+    loss_chunk: int = 0
 
     def make_optimizer(self) -> optax.GradientTransformation:
         schedule = optax.warmup_cosine_decay_schedule(
@@ -237,6 +247,23 @@ class Trainer:
 
     # ---------------- step ----------------
 
+    def _use_chunked_loss(self) -> bool:
+        if self.cfg.loss_chunk <= 0:
+            return False
+        mcfg = getattr(self.model, "cfg", None)
+        if mcfg is None or not hasattr(mcfg, "vocab_size"):
+            return False
+        # tp-sharded vocab keeps the unchunked path (sharded logsumexp).
+        rule = dict(self.rules).get("vocab")
+        axes = (rule,) if isinstance(rule, str) else tuple(rule or ())
+        return all(self.mesh.shape.get(a, 1) == 1 for a in axes)
+
+    def _lm_head_kernel(self, params):
+        mcfg = self.model.cfg
+        if getattr(mcfg, "tie_embeddings", False):
+            return params["embed"].T            # [V,E] -> [E,V]
+        return params["lm_head"]["kernel"]
+
     def _loss_lm(self, params, extra_vars, batch, rng):
         tokens = batch["inputs"]
         inputs, labels = tokens[:, :-1], tokens[:, 1:]
@@ -244,14 +271,31 @@ class Trainer:
         if mask is not None:
             mask = mask[:, 1:]
         rngs = {"router": rng} if rng is not None else None
+        chunked = self._use_chunked_loss()
         outs = self.model.apply(
             {"params": params, **extra_vars}, inputs,
             mutable=["losses"], rngs=rngs,
+            **({"return_hidden": True} if chunked else {}),
         )
-        logits, mut = outs
-        loss, _ = cross_entropy_loss(
-            logits, labels, mask=mask, z_loss_weight=self.cfg.z_loss_weight
-        )
+        if chunked:
+            hidden, mut = outs
+            B, S, E = hidden.shape
+            loss, count, hits = chunked_cross_entropy(
+                hidden.reshape(B * S, E),
+                self._lm_head_kernel(params),
+                labels.reshape(B * S),
+                mask=None if mask is None else mask.reshape(B * S),
+                z_loss_weight=self.cfg.z_loss_weight,
+                block=self.cfg.loss_chunk,
+            )
+            accuracy = hits / count
+        else:
+            logits, mut = outs
+            loss, _ = cross_entropy_loss(
+                logits, labels, mask=mask,
+                z_loss_weight=self.cfg.z_loss_weight,
+            )
+            accuracy = softmax_accuracy(logits, labels, mask=mask)
         aux_total = jnp.zeros((), jnp.float32)
         if self.aux_loss_weight > 0 and "losses" in mut:
             aux = jax.tree.leaves(mut["losses"])
@@ -264,7 +308,7 @@ class Trainer:
                 aux_total = sum(jnp.sum(a) for a in aux) / n
                 loss = loss + self.aux_loss_weight * aux_total
         metrics = {
-            "accuracy": softmax_accuracy(logits, labels, mask=mask),
+            "accuracy": accuracy,
             "aux_loss": aux_total,
         }
         return loss, ({}, metrics)

@@ -129,7 +129,7 @@ function spark(pts) {
   const d = vals.map((v, i) =>
     `${(i * step).toFixed(1)},${(24 - 22 * (v - lo) / span).toFixed(1)}`
   ).join(' ');
-  return `<svg width="120" height="26"><polyline points="${d}"` +
+  return `<svg width="120" height="26"><polyline points="${esc(d)}"` +
     ` fill="none" stroke="#1a73e8" stroke-width="1.5"/></svg>`;
 }
 async function loadMetrics() {
@@ -149,8 +149,8 @@ async function loadMetrics() {
     for (const g of groups) {
       if (!g.points.length) continue;
       const lbl = Object.entries(g.labels || {})
-        .map(([k, v]) => `${k}=${v}`).join(',');
-      const name = lbl ? `${s.series}{${lbl}}` : s.series;
+        .map(([k, v]) => k + '=' + v).join(',');
+      const name = lbl ? s.series + '{' + lbl + '}' : s.series;
       const last = g.points[g.points.length - 1].value;
       rows.push(`<tr><td>${esc(name)}</td>` +
         `<td>${esc(Number(last).toPrecision(4))}</td>` +

@@ -1,0 +1,292 @@
+"""Frontend page-script verification (webapps/frontend.py).
+
+The reference drove its UIs with Selenium/puppeteer against live
+deployments (testing/test_jwa.py:32-423,
+components/centraldashboard/test/e2e.test.ts). This environment ships NO
+JavaScript runtime (checked: node, bun, deno, d8, jsc, gjs, chromium,
+python quickjs/dukpy/js2py — none installed, zero egress to fetch one),
+so the page JS is covered at two tiers:
+
+1. **Static sink audit (always runs):** every ``${...}`` interpolation in
+   every page script must pass through ``esc()`` or
+   ``encodeURIComponent()`` (or be a ``.toFixed()`` numeral) — the
+   invariant that makes stored XSS via resource names impossible. This is
+   the regression class a DOM test would catch, enforced structurally.
+2. **Real execution (runs when a JS runtime exists):** a DOM/fetch shim
+   drives the REAL served page script against the REAL platform REST
+   surface over HTTP — spawner create -> list -> delete, hub contributor
+   add, and an XSS payload in a notebook name rendered inert. Skipped
+   with a loud reason where no runtime exists; runs under node or bun.
+"""
+
+import json
+import re
+import shutil
+import subprocess
+import textwrap
+import threading
+
+import pytest
+
+from kubeflow_tpu.controlplane.api import ObjectMeta
+from kubeflow_tpu.controlplane.api.types import PlatformConfig, Profile, ProfileSpec
+from kubeflow_tpu.controlplane.platform import Platform
+from kubeflow_tpu.webapps.frontend import central_hub
+from kubeflow_tpu.webapps.router import JsonHttpServer, Request
+
+USER_HEADER = "x-goog-authenticated-user-email"
+USER = "alice@example.com"
+
+
+def _page(path: str) -> str:
+    """Render a page exactly as served (script helpers included)."""
+    pf = Platform()
+    pf.apply_config(PlatformConfig(metadata=ObjectMeta(name="kubeflow-tpu")))
+    pf.reconcile()
+    hub = central_hub(pf.api, pf.dashboard, pf.jwa)
+    status, body = hub.dispatch(Request(
+        method="GET", path=path, params={}, query={}, body={},
+        caller=USER, headers={},
+    ))
+    assert status == 200
+    return str(body)
+
+
+def _scripts(html: str):
+    return re.findall(r"<script>(.*?)</script>", html, re.S)
+
+
+class TestStaticSinkAudit:
+    """Structural XSS guarantee: no template interpolation reaches the
+    DOM unescaped."""
+
+    # spark() is the one helper allowed to produce markup: its output is
+    # built solely from toFixed() numerals and esc() — both audited here
+    # since its body lives in the same script.
+    ALLOWED = re.compile(
+        r"^\s*(esc|encodeURIComponent|spark)\s*\(|\.toFixed\(\d+\)\s*$"
+    )
+
+    @pytest.mark.parametrize("path", ["/", "/spawner"])
+    def test_every_interpolation_is_escaped(self, path):
+        html = _page(path)
+        scripts = _scripts(html)
+        assert scripts, "page must inline its script"
+        checked = 0
+        for script in scripts:
+            for m in re.finditer(r"\$\{([^{}]+)\}", script):
+                expr = m.group(1)
+                assert self.ALLOWED.search(expr), (
+                    f"unescaped interpolation in {path} page script: "
+                    f"${{{expr}}} — wrap in esc() (DOM) or "
+                    f"encodeURIComponent() (URL)"
+                )
+                checked += 1
+        assert checked >= 5     # the audit actually saw the real sinks
+
+    def test_esc_covers_the_html_metacharacters(self):
+        html = _page("/")
+        (script,) = _scripts(html)[:1]
+        m = re.search(
+            r"function esc\(s\)\s*{\s*return String\(s\)\.replace\("
+            r"/\[(.*?)\]/g", script)
+        assert m, "esc() definition changed — update this audit"
+        cls = m.group(1)
+        for ch in ["&", "<", ">", '"']:
+            assert ch in cls, f"esc() must escape {ch!r}"
+        assert "'" in cls or "\\'" in cls
+        # the replacement map carries the right entities
+        for entity in ("&amp;", "&lt;", "&gt;", "&quot;", "&#39;"):
+            assert entity in script
+
+    def test_delete_buttons_use_dataset_not_inline_js(self):
+        """Event delegation contract: no inline onclick strings built from
+        user data (the classic injection that esc() alone cannot fix)."""
+        html = _page("/spawner")
+        script = "".join(_scripts(html))
+        assert 'data-name="${esc(n.name)}"' in script
+        assert "onclick=\"" not in script.replace('b.onclick', '')
+
+
+JS_RUNTIME = shutil.which("node") or shutil.which("bun")
+
+# DOM/fetch shim: just enough browser for the page scripts — element
+# registry with innerHTML/value/onsubmit/onclick, button.del delegation
+# via regex over the rendered HTML, fetch with the trusted identity
+# header injected (standing in for the gatekeeper AuthProxy).
+_SHIM = r"""
+const HUB = process.env.HUB;
+const USER_HEADER = process.env.USER_HEADER;
+const USER = process.env.USER_ID;
+const elements = new Map();
+function makeEl(id) {
+  const el = {
+    id, _html: "", value: "", textContent: "",
+    listeners: {},
+    set innerHTML(v) { this._html = String(v); },
+    get innerHTML() { return this._html; },
+    set onsubmit(f) { this.listeners.submit = f; },
+    get onsubmit() { return this.listeners.submit; },
+    set onclick(f) { this.listeners.click = f; },
+    get onclick() { return this.listeners.click; },
+    set onchange(f) { this.listeners.change = f; },
+    get onchange() { return this.listeners.change; },
+    querySelectorAll(sel) {
+      if (sel !== "button.del") return [];
+      const out = [];
+      const re = /<button class="del" data-name="([^"]*)"/g;
+      let m;
+      while ((m = re.exec(this._html)) !== null) {
+        const unescaped = m[1]
+          .replace(/&lt;/g, "<").replace(/&gt;/g, ">")
+          .replace(/&quot;/g, '"').replace(/&#39;/g, "'")
+          .replace(/&amp;/g, "&");
+        out.push({ dataset: { name: unescaped }, set onclick(f) {
+          this._click = f; }, get onclick() { return this._click; } });
+      }
+      this._delBtns = out;
+      return out;
+    },
+  };
+  return el;
+}
+const document = {
+  getElementById(id) {
+    if (!elements.has(id)) elements.set(id, makeEl(id));
+    return elements.get(id);
+  },
+};
+const location = { reload() {} };
+const realFetch = globalThis.fetch;
+async function fetch(path, opts) {
+  opts = opts || {};
+  opts.headers = Object.assign({}, opts.headers || {},
+                               { [USER_HEADER]: USER });
+  return realFetch(HUB + path, opts);
+}
+function setInterval() {}
+async function settle(ms) { await new Promise(r => setTimeout(r, ms)); }
+"""
+
+_DRIVER = r"""
+async function main() {
+  await settle(300);   // init()/loadNs() fire at script end; let them land
+  const PAYLOAD = '<img src=x onerror=globalThis.__xss=1>';
+  if (process.env.PAGE === "spawner") {
+    const list = document.getElementById("list");
+    if (!list._html.includes("<table"))
+      throw new Error("init/refresh never rendered: " + list._html);
+    // create a notebook whose NAME is an XSS payload
+    document.getElementById("name").value = PAYLOAD;
+    document.getElementById("image").value = "jupyter:latest";
+    document.getElementById("slice").value = "";
+    let err = null;
+    try {
+      await document.getElementById("spawn").listeners.submit(
+        { preventDefault() {} });
+    } catch (e) { err = e; }
+    if (err === null) {
+      await settle(200);
+      if (globalThis.__xss) throw new Error("XSS PAYLOAD EXECUTED");
+      if (list._html.includes("<img"))
+        throw new Error("payload reached innerHTML unescaped: "
+                        + list._html);
+      if (!list._html.includes("&lt;img"))
+        throw new Error("payload row missing (escaped form not found): "
+                        + list._html);
+      // delete it through the page's own delegation path
+      const btns = list.querySelectorAll("button.del");
+      const victim = btns.find(b => b.dataset.name === PAYLOAD);
+      if (!victim) throw new Error("delete button for payload not found");
+    } else {
+      // server-side name validation (DNS-1123) may reject the payload —
+      // equally inert; fall through to the clean-name flow
+    }
+    // clean create -> list -> delete
+    document.getElementById("name").value = "jsdrive";
+    await document.getElementById("spawn").listeners.submit(
+      { preventDefault() {} });
+    await settle(200);
+    if (!list._html.includes(">jsdrive<"))
+      throw new Error("created notebook not listed: " + list._html);
+    const btn = list.querySelectorAll("button.del")
+      .find(b => b.dataset.name === "jsdrive");
+    await btn.onclick();
+    await settle(200);
+    if (list._html.includes(">jsdrive<"))
+      throw new Error("deleted notebook still listed");
+    console.log("SPAWNER_OK xss_inert=" + !globalThis.__xss);
+  } else {
+    const contributors = document.getElementById("contributors");
+    document.getElementById("cemail").value = "bob@example.com";
+    await document.getElementById("addc").listeners.submit(
+      { preventDefault() {} });
+    await settle(300);
+    if (!contributors.textContent.includes("bob@example.com"))
+      throw new Error("contributor not rendered: "
+                      + contributors.textContent);
+    console.log("HUB_OK");
+  }
+}
+main().then(() => process.exit(0),
+            e => { console.error(e.stack || e); process.exit(1); });
+"""
+
+
+@pytest.mark.skipif(
+    JS_RUNTIME is None,
+    reason="no JS runtime in this image (node/bun absent; zero egress); "
+           "tier-1 static audit still enforces the escaping contract",
+)
+class TestRealPageExecution:
+    @pytest.fixture()
+    def stack(self):
+        pf = Platform()
+        pf.apply_config(PlatformConfig(
+            metadata=ObjectMeta(name="kubeflow-tpu")))
+        pf.api.create(Profile(metadata=ObjectMeta(name="alice"),
+                              spec=ProfileSpec(owner=USER)))
+        pf.reconcile()
+        pf.manager.start()
+        hub = central_hub(pf.api, pf.dashboard, pf.jwa)
+        srv = JsonHttpServer(hub, port=0).start()
+        yield pf, srv
+        srv.stop()
+        pf.manager.stop()
+
+    def _run_page(self, srv, page, tmp_path):
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/"
+            + ("spawner" if page == "spawner" else ""),
+            headers={USER_HEADER: USER},
+        )
+        html = urllib.request.urlopen(req).read().decode()
+        (page_script,) = _scripts(html)
+        harness = tmp_path / f"{page}.js"
+        harness.write_text(_SHIM + page_script + _DRIVER)
+        env = {
+            "HUB": f"http://127.0.0.1:{srv.port}",
+            "USER_HEADER": USER_HEADER,
+            "USER_ID": USER,
+            "PAGE": page,
+            "PATH": "/usr/bin:/bin:/usr/local/bin",
+        }
+        return subprocess.run(
+            [JS_RUNTIME, str(harness)], env=env,
+            capture_output=True, text=True, timeout=60,
+        )
+
+    def test_spawner_create_list_delete_and_xss_inert(self, stack,
+                                                      tmp_path):
+        _, srv = stack
+        out = self._run_page(srv, "spawner", tmp_path)
+        assert out.returncode == 0, out.stderr
+        assert "SPAWNER_OK" in out.stdout
+
+    def test_hub_contributor_add(self, stack, tmp_path):
+        _, srv = stack
+        out = self._run_page(srv, "hub", tmp_path)
+        assert out.returncode == 0, out.stderr
+        assert "HUB_OK" in out.stdout
