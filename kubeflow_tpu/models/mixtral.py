@@ -34,6 +34,10 @@ class MixtralConfig(LlamaConfig):
     capacity_factor: float = 1.25
     router_jitter: float = 0.0
     aux_loss_weight: float = 0.02
+    # parallel.moe dispatch mechanism: "auto" picks index-gather when the
+    # expert axis is unsharded, GShard einsum (clean all-to-all) when
+    # ep-sharded.
+    moe_dispatch: str = "auto"
 
     @classmethod
     def mixtral_8x7b(cls, **kw) -> "MixtralConfig":
@@ -108,6 +112,7 @@ class MoeMlp(nn.Module):
             num_experts=E,
             capacity_factor=cfg.capacity_factor,
             jitter_eps=cfg.router_jitter,
+            dispatch=cfg.moe_dispatch,
         )
         rng = None
         if cfg.router_jitter > 0 and self.has_rng("router"):

@@ -123,6 +123,8 @@ def _lib() -> ctypes.CDLL:
             lib.dl_next.restype = ctypes.c_int
             lib.dl_produced.argtypes = [ctypes.c_void_p]
             lib.dl_produced.restype = ctypes.c_uint64
+            lib.dl_stalls.argtypes = [ctypes.c_void_p]
+            lib.dl_stalls.restype = ctypes.c_uint64
             lib.dl_destroy.argtypes = [ctypes.c_void_p]
             _LIB = lib
     return _LIB
@@ -201,6 +203,15 @@ class NativeTokenLoader:
         if self._handle is None:
             return 0
         return int(self._lib.dl_produced(self._handle))
+
+    @property
+    def stalls(self) -> int:
+        """Times a ``next()`` arrived before any batch was ready — the
+        consumer outran the producers. A loader keeping up with the train
+        step holds this at ~0 (asserted by the loader-fed bench)."""
+        if self._handle is None:
+            return 0
+        return int(self._lib.dl_stalls(self._handle))
 
     def close(self) -> None:
         if self._handle is not None:

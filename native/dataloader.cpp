@@ -113,6 +113,12 @@ class Loader {
   // Blocking pop of the OLDEST ready batch into out (ordered delivery).
   bool next(int32_t* out) {
     std::unique_lock<std::mutex> lk(mu_);
+    if (!stop_ && !slot_ready(consume_idx_)) {
+      // The training step arrived before the producers: a stall. The
+      // bench asserts this stays ~0, proving the pipeline feeds the
+      // step rate (BASELINE loader=native row).
+      stalls_.fetch_add(1);
+    }
     cv_full_.wait(lk, [this] {
       return stop_ || slot_ready(consume_idx_);
     });
@@ -126,6 +132,8 @@ class Loader {
   }
 
   uint64_t produced() const { return produced_.load(); }
+
+  uint64_t stalls() const { return stalls_.load(); }
 
  private:
   enum State { kFree = 0, kFilling = 1, kReady = 2 };
@@ -206,6 +214,7 @@ class Loader {
   uint64_t fill_idx_ = 0;
   uint64_t consume_idx_ = 0;
   std::atomic<uint64_t> produced_;
+  std::atomic<uint64_t> stalls_{0};
   const int32_t* tokens_ = nullptr;
   uint64_t n_tokens_ = 0;
   size_t map_size_ = 0;
@@ -234,6 +243,10 @@ int dl_next(void* h, int32_t* out) {
 
 uint64_t dl_produced(void* h) {
   return static_cast<Loader*>(h)->produced();
+}
+
+uint64_t dl_stalls(void* h) {
+  return static_cast<Loader*>(h)->stalls();
 }
 
 void dl_destroy(void* h) { delete static_cast<Loader*>(h); }

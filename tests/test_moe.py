@@ -98,7 +98,7 @@ class TestGroupedDispatch:
 
         # Generous capacity: nothing drops in either layout.
         cfg1 = Top2GateConfig(num_experts=E, capacity_factor=8.0,
-                              group_size=0)
+                              group_size=0, dispatch="einsum")
         cfgG = dataclasses.replace(cfg1, group_size=64)
         out1, aux1 = moe_dispatch(x, logits, expert_fn, cfg1)
         outG, auxG = moe_dispatch(x, logits, expert_fn, cfgG)
@@ -121,7 +121,8 @@ class TestGroupedDispatch:
         # All tokens want expert 0 hard.
         logits = jnp.tile(jnp.array([10.0, 0.0, -10.0, -10.0]), (T, 1))
         cfg = Top2GateConfig(num_experts=E, capacity_factor=1.0,
-                             min_capacity=4, group_size=16)
+                             min_capacity=4, group_size=16,
+                             dispatch="einsum")
 
         def expert_fn(e_in):
             return e_in
@@ -144,7 +145,7 @@ class TestGroupedDispatch:
         x = jax.random.normal(jax.random.key(0), (T, M), jnp.float32)
         logits = jax.random.normal(jax.random.key(1), (T, E), jnp.float32)
         cfg = Top2GateConfig(num_experts=E, capacity_factor=8.0,
-                             group_size=256)
+                             group_size=256, dispatch="einsum")
         out, aux = moe_dispatch(x, logits, lambda e: e, cfg)
         assert out.shape == (T, M)
         assert np.isfinite(float(aux))
@@ -157,3 +158,95 @@ class TestGroupedDispatch:
         )
         np.testing.assert_allclose(np.asarray(out), np.asarray(out160),
                                    rtol=1e-5, atol=1e-5)
+
+
+class TestGatherDispatch:
+    """Index-gather dispatch (r4): must replicate the einsum path's
+    routing semantics exactly while spending no MXU flops on routing."""
+
+    def _data(self, T=128, M=16, E=4, seed=0):
+        x = jax.random.normal(jax.random.key(seed), (T, M), jnp.float32)
+        logits = jax.random.normal(jax.random.key(seed + 1), (T, E),
+                                   jnp.float32)
+        return x, logits
+
+    def test_matches_einsum_no_drops(self):
+        x, logits = self._data()
+        base = dict(num_experts=4, capacity_factor=8.0, group_size=0)
+
+        def expert_fn(e_in):
+            return e_in * 2.0 + 1.0 * (jnp.abs(e_in) > 0)
+
+        oe, ae = moe_dispatch(x, logits, expert_fn,
+                              Top2GateConfig(**base, dispatch="einsum"))
+        og, ag = moe_dispatch(x, logits, expert_fn,
+                              Top2GateConfig(**base, dispatch="gather"))
+        np.testing.assert_allclose(np.asarray(oe), np.asarray(og),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(float(ae), float(ag), rtol=1e-6)
+
+    def test_matches_einsum_with_capacity_drops(self):
+        x, logits = self._data()
+        # Skew routing hard so capacity drops engage.
+        logits = logits.at[:, 0].add(6.0)
+        base = dict(num_experts=4, capacity_factor=0.5, min_capacity=4,
+                    group_size=0)
+        oe, _ = moe_dispatch(x, logits, lambda e: e,
+                             Top2GateConfig(**base, dispatch="einsum"))
+        og, _ = moe_dispatch(x, logits, lambda e: e,
+                             Top2GateConfig(**base, dispatch="gather"))
+        np.testing.assert_allclose(np.asarray(oe), np.asarray(og),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grads_match_einsum(self):
+        x, logits = self._data(T=64)
+        base = dict(num_experts=4, capacity_factor=2.0, group_size=0)
+
+        def loss(mode, x, logits):
+            out, aux = moe_dispatch(
+                x, logits, lambda e: jnp.tanh(e),
+                Top2GateConfig(**base, dispatch=mode))
+            return (out ** 2).sum() + 0.1 * aux
+
+        ge = jax.grad(lambda *a: loss("einsum", *a), argnums=(0, 1))(
+            x, logits)
+        gg = jax.grad(lambda *a: loss("gather", *a), argnums=(0, 1))(
+            x, logits)
+        for a, b in zip(ge, gg):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_auto_uses_gather_off_mesh(self):
+        from kubeflow_tpu.parallel.moe import _expert_axis_sharded
+
+        assert _expert_axis_sharded() is False
+
+    def test_auto_uses_einsum_under_ep_mesh(self):
+        from kubeflow_tpu.parallel.context import parallel_context
+        from kubeflow_tpu.parallel.moe import _expert_axis_sharded
+        from kubeflow_tpu.topology import AxisSpec, make_host_local_mesh
+
+        mesh = make_host_local_mesh(AxisSpec(dp=-1, ep=2))
+        with parallel_context(mesh=mesh):
+            assert _expert_axis_sharded() is True
+
+    def test_mixtral_trains_with_gather(self):
+        from kubeflow_tpu.models import get_model
+        from kubeflow_tpu.topology import AxisSpec, make_host_local_mesh
+        from kubeflow_tpu.train import TrainConfig, Trainer
+
+        model, _ = get_model("mixtral-tiny")
+        mesh = make_host_local_mesh(AxisSpec(dp=-1))
+        trainer = Trainer(
+            model, TrainConfig(task="lm", aux_loss_weight=0.02), mesh)
+        rng = np.random.default_rng(0)
+        batch = trainer.shard_batch({"inputs": jnp.asarray(
+            rng.integers(1, 250, size=(8, 17)), jnp.int32)})
+        state = trainer.init_state(jax.random.PRNGKey(0), batch)
+        losses = []
+        for _ in range(6):
+            state, metrics = trainer.step(state, batch,
+                                          rng=jax.random.PRNGKey(2))
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]
+        assert np.isfinite(losses[-1])
