@@ -53,21 +53,24 @@ class Top2GateConfig:
         return -(-cap // 4) * 4
 
 
-def top2_gating(
+def top2_routing(
     logits: jax.Array,
     cfg: Top2GateConfig,
     *,
     rng: jax.Array | None = None,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """logits: [T, E] router outputs (f32).
-
-    Returns (combine [T, E, C], dispatch bool [T, E, C], aux_loss scalar).
-    Tokens overflowing an expert's capacity C are dropped (standard GShard
-    semantics); combine weights renormalised over the surviving experts.
+):
+    """The ONE routing implementation (both dispatch mechanisms derive
+    from it): per-token expert ids, buffer positions and renormalised
+    weights. GShard top-2 with static capacity: tokens overflowing an
+    expert's C-slot buffer are dropped, weights renormalised over the
+    survivors; second choices queue behind all first choices.
 
     If ``cfg.jitter_eps > 0`` and ``rng`` is given, router logits are
-    multiplied by uniform noise in [1-eps, 1+eps] (training-time exploration,
-    GShard §2.2); inference callers simply omit ``rng``.
+    multiplied by uniform noise in [1-eps, 1+eps] (training-time
+    exploration, GShard §2.2); inference callers simply omit ``rng``.
+
+    Returns (e1, e2 [T] int32, p1, p2 [T] int32, g1, g2 [T] f32 — zero
+    for capacity-dropped choices, aux_loss scalar).
     """
     T, E = logits.shape
     C = cfg.capacity(T)
@@ -92,65 +95,6 @@ def top2_gating(
     ce = jnp.mean(mask1, axis=0)
     aux_loss = jnp.sum(me * ce) * E
 
-    # Position of each token within its expert's buffer; second choices queue
-    # behind all first choices.
-    pos1 = jnp.cumsum(mask1, axis=0) - mask1
-    pos2 = jnp.cumsum(mask2, axis=0) - mask2 + jnp.sum(mask1, axis=0, keepdims=True)
-    mask1 = mask1 * (pos1 < C)
-    mask2 = mask2 * (pos2 < C)
-
-    g1 = jnp.sum(gates * mask1, axis=-1)
-    g2 = jnp.sum(gates * mask2, axis=-1)
-    denom = g1 + g2
-    denom = jnp.where(denom > 0, denom, 1.0)
-    g1, g2 = g1 / denom, g2 / denom
-
-    p1 = jnp.sum(pos1 * mask1, axis=-1).astype(jnp.int32)  # [T]
-    p2 = jnp.sum(pos2 * mask2, axis=-1).astype(jnp.int32)
-    oh1 = jax.nn.one_hot(p1, C, dtype=jnp.float32) * jnp.sum(mask1, -1, keepdims=True)
-    oh2 = jax.nn.one_hot(p2, C, dtype=jnp.float32) * jnp.sum(mask2, -1, keepdims=True)
-    combine = (
-        g1[:, None, None] * mask1[:, :, None] * oh1[:, None, :]
-        + g2[:, None, None] * mask2[:, :, None] * oh2[:, None, :]
-    )
-    dispatch = combine > 0.0
-    return combine, dispatch, aux_loss
-
-
-def top2_routing(
-    logits: jax.Array,
-    cfg: Top2GateConfig,
-    *,
-    rng: jax.Array | None = None,
-):
-    """Index/weight form of ``top2_gating``: per-token expert ids,
-    buffer positions and renormalised weights instead of the dense
-    [T, E, C] one-hot tensors. Same capacity/drop semantics.
-
-    Returns (e1, e2 [T] int32, p1, p2 [T] int32, g1, g2 [T] f32 — zero for
-    capacity-dropped choices, aux_loss scalar).
-    """
-    T, E = logits.shape
-    C = cfg.capacity(T)
-    logits = logits.astype(jnp.float32)
-    if cfg.jitter_eps > 0.0 and rng is not None:
-        noise = jax.random.uniform(
-            rng, logits.shape, jnp.float32,
-            minval=1.0 - cfg.jitter_eps, maxval=1.0 + cfg.jitter_eps,
-        )
-        logits = logits * noise
-    gates = jax.nn.softmax(logits, axis=-1)
-
-    idx1 = jnp.argmax(gates, axis=-1)
-    mask1 = jax.nn.one_hot(idx1, E, dtype=jnp.float32)
-    gates_no1 = gates * (1.0 - mask1)
-    idx2 = jnp.argmax(gates_no1, axis=-1)
-    mask2 = jax.nn.one_hot(idx2, E, dtype=jnp.float32)
-
-    me = jnp.mean(gates, axis=0)
-    ce = jnp.mean(mask1, axis=0)
-    aux_loss = jnp.sum(me * ce) * E
-
     pos1 = jnp.cumsum(mask1, axis=0) - mask1
     pos2 = (jnp.cumsum(mask2, axis=0) - mask2
             + jnp.sum(mask1, axis=0, keepdims=True))
@@ -167,6 +111,33 @@ def top2_routing(
     p2 = jnp.sum(pos2 * mask2, axis=-1).astype(jnp.int32)
     return (idx1.astype(jnp.int32), idx2.astype(jnp.int32),
             p1, p2, g1, g2, aux_loss)
+
+
+def top2_gating(
+    logits: jax.Array,
+    cfg: Top2GateConfig,
+    *,
+    rng: jax.Array | None = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Dense one-hot form of ``top2_routing`` (the einsum dispatch's
+    input): (combine [T, E, C], dispatch bool [T, E, C], aux_loss).
+    Derived from the index form so there is exactly one routing
+    implementation to keep correct."""
+    T, E = logits.shape
+    C = cfg.capacity(T)
+    e1, e2, p1, p2, g1, g2, aux_loss = top2_routing(logits, cfg, rng=rng)
+    k1 = (g1 > 0.0).astype(jnp.float32)
+    k2 = (g2 > 0.0).astype(jnp.float32)
+    oh_e1 = jax.nn.one_hot(e1, E, dtype=jnp.float32) * k1[:, None]
+    oh_e2 = jax.nn.one_hot(e2, E, dtype=jnp.float32) * k2[:, None]
+    oh_p1 = jax.nn.one_hot(p1, C, dtype=jnp.float32)
+    oh_p2 = jax.nn.one_hot(p2, C, dtype=jnp.float32)
+    combine = (
+        g1[:, None, None] * oh_e1[:, :, None] * oh_p1[:, None, :]
+        + g2[:, None, None] * oh_e2[:, :, None] * oh_p2[:, None, :]
+    )
+    dispatch = combine > 0.0
+    return combine, dispatch, aux_loss
 
 
 def _expert_axis_sharded() -> bool:
@@ -190,37 +161,59 @@ def _moe_dispatch_gather(
     cfg: Top2GateConfig,
     *,
     rng: jax.Array | None = None,
+    group: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
     """Index-based dispatch: one scatter of token ids into expert slots,
     one row-gather in, two row-gathers out. Replaces the dense one-hot
     einsums' O(T x E x C x M) MACs with O(T x M) copies — on one v5e chip
     those einsums were the gap between 16.7% and dense-model MFU
-    (VERDICT r3 Weak #1)."""
+    (VERDICT r3 Weak #1).
+
+    ``group`` keeps the SAME per-group capacity/fairness semantics as the
+    grouped einsum path (each group of tokens gets its own expert budget —
+    a hot expert in one group cannot starve another group's tokens); 0 or
+    >= T means one global group. Expert buffers are laid out [E, G*C, M],
+    identical to the grouped einsum layout."""
     T, M = x.shape
     E = router_logits.shape[-1]
-    C = cfg.capacity(T)
-    e1, e2, p1, p2, g1, g2, aux = top2_routing(router_logits, cfg, rng=rng)
-    trash = E * C                       # capacity-dropped choices land here
-    k1 = g1 > 0.0
-    k2 = g2 > 0.0
-    dest1 = jnp.where(k1, e1 * C + p1, trash)
-    dest2 = jnp.where(k2, e2 * C + p2, trash)
+    g = group if 0 < group < T else T
+    G = T // g
+    C = cfg.capacity(g)
+    lg = router_logits.reshape(G, g, E)
+    if rng is not None:
+        rngs = jax.random.split(rng, G)
+        e1, e2, p1, p2, w1, w2, aux = jax.vmap(
+            lambda l, r: top2_routing(l, cfg, rng=r))(lg, rngs)
+    else:
+        e1, e2, p1, p2, w1, w2, aux = jax.vmap(
+            lambda l: top2_routing(l, cfg))(lg)
+    grp = jnp.arange(G, dtype=jnp.int32)[:, None]        # [G, 1]
+    trash = E * G * C                   # capacity-dropped choices land here
+    k1 = w1 > 0.0
+    k2 = w2 > 0.0
+    dest1 = jnp.where(k1, e1 * (G * C) + grp * C + p1, trash).reshape(T)
+    dest2 = jnp.where(k2, e2 * (G * C) + grp * C + p2, trash).reshape(T)
+    w1, w2 = w1.reshape(T), w2.reshape(T)
+    k1, k2 = k1.reshape(T), k2.reshape(T)
+    g1, g2 = w1, w2
     tok = jnp.arange(T, dtype=jnp.int32)
     # Kept destinations are unique by construction (distinct positions per
-    # expert buffer), so scatter-set is collision-free except at trash.
+    # (group, expert) buffer), so scatter-set is collision-free except at
+    # trash.
     slot_tok = (
-        jnp.zeros((E * C + 1,), jnp.int32)
+        jnp.zeros((E * G * C + 1,), jnp.int32)
         .at[dest1].set(tok)
         .at[dest2].set(tok)
     )
     slot_valid = (
-        jnp.zeros((E * C + 1,), x.dtype)
+        jnp.zeros((E * G * C + 1,), x.dtype)
         .at[dest1].set(k1.astype(x.dtype))
         .at[dest2].set(k2.astype(x.dtype))
     )
-    expert_in = jnp.take(x, slot_tok[:E * C], axis=0) \
-        * slot_valid[:E * C, None]
-    expert_out = expert_fn(expert_in.reshape(E, C, M)).reshape(E * C, M)
+    expert_in = jnp.take(x, slot_tok[:E * G * C], axis=0) \
+        * slot_valid[:E * G * C, None]
+    expert_out = expert_fn(
+        expert_in.reshape(E, G * C, M)).reshape(E * G * C, M)
     padded = jnp.concatenate(
         [expert_out, jnp.zeros((1, M), expert_out.dtype)]
     )
@@ -228,7 +221,7 @@ def _moe_dispatch_gather(
         g1[:, None] * jnp.take(padded, dest1, axis=0).astype(jnp.float32)
         + g2[:, None] * jnp.take(padded, dest2, axis=0).astype(jnp.float32)
     )
-    return out.astype(x.dtype), aux
+    return out.astype(x.dtype), jnp.mean(aux)
 
 
 def moe_dispatch(
@@ -253,9 +246,6 @@ def moe_dispatch(
     mode = cfg.dispatch
     if mode == "auto":
         mode = "einsum" if _expert_axis_sharded() else "gather"
-    if mode == "gather":
-        return _moe_dispatch_gather(x, router_logits, expert_fn, cfg,
-                                    rng=rng)
     g = cfg.group_size
     if 0 < g < T and T % g != 0:
         # Keep grouping (and its O(T) dispatch cost) even when group_size
@@ -269,6 +259,11 @@ def moe_dispatch(
                 "group (O(T^2)) MoE dispatch",
                 kv={"tokens": T, "group_size": cfg.group_size},
             )
+    if mode == "gather":
+        # Same group semantics as the einsum path (per-group capacity);
+        # the mechanism alone differs.
+        return _moe_dispatch_gather(x, router_logits, expert_fn, cfg,
+                                    rng=rng, group=g)
     if g <= 0 or g >= T:
         # Single group: gate over all tokens at once.
         combine, dispatch, aux = top2_gating(router_logits, cfg, rng=rng)

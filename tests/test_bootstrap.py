@@ -1,0 +1,107 @@
+"""Deployment REST plane (controlplane/bootstrap.py): the kfctl-server
+surface — async create, polled status, idempotent re-apply, delete+GC
+(reference bootstrap/cmd/bootstrap/app/router.go:275-405,
+kfctlServer.go:43-330)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.controlplane.bootstrap import DeploymentServer
+
+PREFIX = "/kfctl/apps/v1beta1"
+
+
+def _req(port, method, path, body=None, timeout=10):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=None if body is None else json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method=method,
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.load(r)
+
+
+def _wait_phase(port, name, want, deadline_s=60):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        _, body = _req(port, "GET", f"{PREFIX}/get/{name}")
+        if body["phase"] in want:
+            return body
+        time.sleep(0.1)
+    raise AssertionError(f"{name} never reached {want}: {body}")
+
+
+@pytest.fixture()
+def server(tmp_path):
+    srv = DeploymentServer(state_dir=str(tmp_path / "deployments")).start()
+    yield srv
+    srv.stop()
+
+
+class TestDeploymentLifecycle:
+    def test_create_poll_ready_with_resources(self, server, tmp_path):
+        status, body = _req(server.port, "POST", f"{PREFIX}/create", {
+            "name": "dev",
+            "spec": {},
+            "resources": [{
+                "kind": "Profile",
+                "metadata": {"name": "team-a"},
+                "spec": {"owner": "alice@example.com"},
+            }],
+        })
+        assert status == 202 and body["phase"] == "Pending"
+        got = _wait_phase(server.port, "dev", {"Ready", "Failed"})
+        assert got["phase"] == "Ready", got
+        assert "tpujob-controller" in got["components"]
+        assert got["error"] == ""
+        # the deployment persisted in tpuctl's state layout and the
+        # applied Profile reconciled into a namespace
+        from kubeflow_tpu.controlplane.platform import Platform
+
+        pf = Platform.load(str(tmp_path / "deployments" / "dev"))
+        assert pf.api.try_get("Profile", "team-a") is not None
+        assert pf.api.try_get("Namespace", "team-a") is not None
+
+    def test_second_create_is_idempotent_reapply(self, server):
+        _req(server.port, "POST", f"{PREFIX}/create",
+             {"name": "dev", "spec": {}})
+        _wait_phase(server.port, "dev", {"Ready"})
+        status, body = _req(server.port, "POST", f"{PREFIX}/create",
+                            {"name": "dev", "spec": {}})
+        assert status == 202
+        got = _wait_phase(server.port, "dev", {"Ready", "Failed"})
+        assert got["phase"] == "Ready"
+
+    def test_bad_resource_surfaces_failed(self, server):
+        _req(server.port, "POST", f"{PREFIX}/create", {
+            "name": "broken",
+            "resources": [{"kind": "NoSuchKind", "metadata": {"name": "x"}}],
+        })
+        got = _wait_phase(server.port, "broken", {"Ready", "Failed"})
+        assert got["phase"] == "Failed"
+        assert got["error"]
+
+    def test_list_and_delete_gc(self, server, tmp_path):
+        _req(server.port, "POST", f"{PREFIX}/create",
+             {"name": "dev", "spec": {}})
+        _wait_phase(server.port, "dev", {"Ready"})
+        _, listing = _req(server.port, "GET", f"{PREFIX}/list")
+        assert [d["name"] for d in listing["deployments"]] == ["dev"]
+        assert (tmp_path / "deployments" / "dev").is_dir()
+        status, body = _req(server.port, "DELETE", f"{PREFIX}/delete/dev")
+        assert body["deleted"] == "dev"
+        assert not (tmp_path / "deployments" / "dev").exists()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(server.port, "GET", f"{PREFIX}/get/dev")
+        assert ei.value.code == 404
+
+    def test_invalid_names_rejected(self, server):
+        for bad in ("", "../etc", ".hidden"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _req(server.port, "POST", f"{PREFIX}/create", {"name": bad})
+            assert ei.value.code == 400

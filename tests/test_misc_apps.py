@@ -169,6 +169,28 @@ class TestRelease:
             m["images"]
         )
 
+    def test_dockerfiles_cover_every_release_image(self, tmp_path):
+        """The image-build half of the release story (reference
+        components/image-releaser/): one Dockerfile per release image,
+        entrypoints matching the env contracts the controllers inject."""
+        from kubeflow_tpu.tools.release import (
+            IMAGES,
+            write_dockerfiles,
+        )
+
+        paths = write_dockerfiles(str(tmp_path))
+        emitted = {p.split("/")[-2] for p in paths}
+        assert emitted == set(IMAGES)
+        text = {p.split("/")[-2]: open(p).read() for p in paths}
+        assert "kubeflow_tpu.train.runner" in text["runtime"]
+        assert "kubeflow_tpu.serving.server" in text["serving"]
+        assert "kubeflow_tpu.controlplane.main" in text["controlplane"]
+        # framework images ship the native loader source for on-host build
+        for name in ("runtime", "serving", "controlplane"):
+            assert "COPY native/ native/" in text[name]
+        # idempotent re-emit (release pipelines re-run)
+        assert write_dockerfiles(str(tmp_path)) == paths
+
     def test_bump_levels(self, tmp_path):
         from kubeflow_tpu.tools.release import bump_version
 

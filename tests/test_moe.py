@@ -250,3 +250,22 @@ class TestGatherDispatch:
             losses.append(float(metrics["loss"]))
         assert losses[-1] < losses[0]
         assert np.isfinite(losses[-1])
+
+    def test_grouped_gather_matches_grouped_einsum(self):
+        """group_size must mean the same thing under both mechanisms:
+        per-group capacity, a hot expert in one group cannot consume
+        another group's budget."""
+        T, M, E = 256, 16, 4
+        x = jax.random.normal(jax.random.key(3), (T, M), jnp.float32)
+        logits = jax.random.normal(jax.random.key(4), (T, E), jnp.float32)
+        # skew so per-group drops actually engage
+        logits = logits.at[:, 1].add(4.0)
+        base = dict(num_experts=E, capacity_factor=0.75, min_capacity=4,
+                    group_size=64)
+        oe, ae = moe_dispatch(x, logits, lambda e: e * 2.0,
+                              Top2GateConfig(**base, dispatch="einsum"))
+        og, ag = moe_dispatch(x, logits, lambda e: e * 2.0,
+                              Top2GateConfig(**base, dispatch="gather"))
+        np.testing.assert_allclose(np.asarray(oe), np.asarray(og),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(float(ae), float(ag), rtol=1e-6)
