@@ -161,6 +161,23 @@ class TestTpuctlPlan:
         assert rc == 2
         assert "DOES NOT FIT" in out
 
+    def test_plan_aot_subprocess(self, tmp_path, capsys):
+        """--aot re-execs the planner under a virtual mesh of the slice's
+        chip count and reads XLA's buffer assignment; the subprocess env
+        wiring (forced device count + platform override) is the part only
+        this test exercises."""
+        from kubeflow_tpu.tools.tpuctl import main
+
+        f = self._job_yaml(tmp_path, "llama-tiny", "v5e-8")
+        rc = main(["plan", "-f", f, "--aot", "-o", "json"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        reports = json.loads(out.strip().splitlines()[-1])
+        assert reports[0]["method"] == "aot"
+        assert reports[0]["num_chips"] == 8
+        assert reports[0]["activations"] > 0    # XLA temp, per device
+        assert "FITS" in out
+
     def test_plan_json_output(self, tmp_path, capsys):
         from kubeflow_tpu.tools.tpuctl import main
 
