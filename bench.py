@@ -211,6 +211,7 @@ def bench_serving(args) -> None:
     engine.submit(prompts[0], max_new_tokens=args.decode_chunk + 1)
     engine.run()
 
+    engine.decode_dispatches = 0
     t0 = time.perf_counter()
     rids = [engine.submit(p, max_new_tokens=args.gen_len) for p in prompts]
     engine.run()
@@ -231,6 +232,10 @@ def bench_serving(args) -> None:
         p99_ttft_s=round(pct(ttfts, 0.99), 4),
         p50_latency_s=round(pct(lats, 0.50), 4),
         p99_latency_s=round(pct(lats, 0.99), 4),
+        # Hardware-independent cost: TTFT/latency through the axon tunnel
+        # are relay-bound (~110ms/dispatch); dispatches/token transfers.
+        dispatches_per_token=round(
+            engine.decode_dispatches / max(1, gen_tokens), 4),
         requests=requests, batch=bs,
         prompt_len=args.prompt_len, gen_len=args.gen_len,
         decode_chunk=args.decode_chunk,
