@@ -147,6 +147,46 @@ class TestTpuctl:
         assert "# TYPE kftpu_tpujob_reconcile_total counter" in out
 
 
+SERVING_INT8_YAML = """
+apiVersion: tpu.kubeflow.org/v1alpha1
+kind: Serving
+metadata:
+  name: llm8b
+  namespace: ml
+spec:
+  model: llama3-8b
+  sliceType: v5e-8
+  maxLen: 512
+  maxBatch: 32
+  quantize: int8
+  prefillBuckets: [128]
+  replicas: 2
+"""
+
+
+class TestServingCrThroughTpuctl:
+    def test_apply_driven_serving_requests_int8(self, tmp_path, capsys):
+        """VERDICT r4 'done' criterion: a tpuctl-applied Serving CR can
+        switch on the engine's int8 path — YAML camelCase -> serde ->
+        controller -> KFTPU_SERVING_* env, end to end."""
+        sd = str(tmp_path / "state")
+        _run(["--state-dir", sd, "apply",
+              "-f", _write(tmp_path, "p.yaml", PLATFORM_YAML),
+              "-f", _write(tmp_path, "pr.yaml", PROFILE_YAML),
+              "-f", _write(tmp_path, "s.yaml", SERVING_INT8_YAML)], capsys)
+        pf = Platform.load(sd)
+        sv = pf.api.get("Serving", "llm8b", "ml")
+        assert sv.spec.quantize == "int8"
+        assert sv.spec.prefill_buckets == [128]
+        assert sv.spec.replicas == 2
+        for i in range(2):
+            pod = pf.api.get("Pod", f"llm8b-serving-{i}", "ml")
+            env = {e.name: e.value for e in pod.spec.containers[0].env}
+            assert env["KFTPU_SERVING_QUANTIZE"] == "int8"
+            assert env["KFTPU_SERVING_PREFILL_BUCKETS"] == "128"
+            assert env["KFTPU_SERVING_MAX_BATCH"] == "32"
+
+
 class TestTpuctlLogs:
     def test_logs_for_job_gang(self, tmp_path, capsys):
         state = str(tmp_path / "state")
