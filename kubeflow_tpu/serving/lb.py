@@ -318,3 +318,55 @@ class ServingLBServer:
         self._http.stop()
         if self._thread:
             self._thread.join(timeout=5)
+
+
+def main(argv=None) -> int:
+    """Deployable entrypoint: front N serving replicas with one L7
+    endpoint. Either a static backend list (--backends host:port,...) or
+    a Serving CR to follow (--follow <name> -n <ns>, kubectl backend) —
+    the dispatch set then tracks status.endpoints as the controller
+    scales/drains replicas."""
+    import argparse
+    import time
+
+    from kubeflow_tpu.controlplane.runtime.backend import (
+        add_backend_args,
+        build_backend,
+    )
+
+    p = argparse.ArgumentParser(prog="kftpu-serving-lb")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8081)
+    p.add_argument("--backends", default="",
+                   help="static comma-separated host:port list")
+    p.add_argument("--follow", default="",
+                   help="Serving CR name whose status.endpoints to follow")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--sync-interval", type=float, default=2.0)
+    add_backend_args(p)
+    args = p.parse_args(argv)
+    if not args.backends and not args.follow:
+        p.error("one of --backends or --follow is required")
+    lb = ServingLoadBalancer(
+        [b.strip() for b in args.backends.split(",") if b.strip()] or None
+    )
+    api = build_backend(args) if args.follow else None
+    server = ServingLBServer(
+        lb, host=args.host, port=args.port,
+        sync_interval_s=args.sync_interval,
+        api=api, namespace=args.namespace, name=args.follow,
+    ).start()
+    log.info("serving lb up", kv={"port": server.port,
+                                  "follow": args.follow or "-"})
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

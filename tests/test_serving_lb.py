@@ -265,6 +265,41 @@ class TestHealthAndDrain:
             srv.stop()
 
 
+class TestLBMain:
+    def test_entrypoint_with_static_backends(self, backends):
+        """`python -m kubeflow_tpu.serving.lb --backends ...` as a
+        subprocess: the deployable form of the balancer."""
+        import subprocess
+        import sys
+        import time as _time
+
+        b0, b1 = backends
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kubeflow_tpu.serving.lb",
+             "--host", "127.0.0.1", "--port", "0",
+             "--backends", f"{b0.addr},{b1.addr}"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        try:
+            # the entrypoint logs its bound port; parse it
+            port = None
+            deadline = _time.time() + 60
+            while _time.time() < deadline and port is None:
+                line = proc.stdout.readline()
+                if "serving lb up" in line:
+                    port = int(line.split("port=")[1].split()[0])
+            assert port, "lb entrypoint never reported its port"
+            out = json.load(_post(f"http://127.0.0.1:{port}/v1/generate",
+                                  {"tokens": [1]}))
+            assert out["backend"] in ("b0", "b1")
+            body = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz"))
+            assert body["ok"] is True and len(body["backends"]) == 2
+        finally:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
 class TestLBServer:
     def test_follows_serving_cr_endpoints(self, backends):
         """ServingLBServer.tick() syncs the dispatch set from the Serving
