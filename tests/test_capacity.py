@@ -117,7 +117,23 @@ class TestAot:
         assert rep.activations > 0      # temp: backward working set
         assert rep.fits()
 
-    def test_aot_needs_enough_devices(self):
+    def test_analytic_state_matches_xla_arguments(self):
+        """Cross-validate the tiers: XLA's per-device argument bytes
+        (train state + batch) must match the analytic params + opt_state
+        + batch arithmetic — the analytic tier's exactness claim, checked
+        against the compiler's own buffer assignment."""
+        import numpy as np
+
+        kw = dict(global_batch=8, seq_len=64, mu_dtype="bfloat16",
+                  param_dtype="bfloat16")
+        ana = analytic_report("llama-tiny", "v5e-8", AxisSpec(fsdp=-1),
+                              **kw)
+        aot = aot_report("llama-tiny", "v5e-8", AxisSpec(fsdp=-1), **kw)
+        batch_bytes = 8 * 65 * 4 // 8        # int32 tokens over 8 chips
+        want = ana.params + ana.opt_state + batch_bytes
+        # slack: step counters, schedule state, padding
+        assert abs(aot.arguments - want) / want < 0.10, (
+            f"aot args {aot.arguments} vs analytic state {want}")
         with pytest.raises(RuntimeError, match="device_count=16"):
             aot_report("llama-tiny", "v5e-16", AxisSpec(fsdp=-1))
 
