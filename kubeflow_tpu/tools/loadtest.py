@@ -113,15 +113,98 @@ def run_load(
     }
 
 
+def run_serving_lb_load(
+    *,
+    backends: int = 2,
+    clients: int = 8,
+    requests: int = 400,
+) -> Dict[str, float]:
+    """L7 balancer overhead: requests/sec through ServingLoadBalancer in
+    front of instant stub backends (no model — this isolates the
+    balancer's dispatch/bookkeeping cost from engine throughput), with
+    concurrent clients and the per-backend spread reported so a wedged
+    least-loaded picker (everything on one backend) is visible."""
+    import queue
+    import threading
+    import urllib.request
+
+    from kubeflow_tpu.serving.lb import ServingLoadBalancer
+    from kubeflow_tpu.webapps.router import (
+        JsonHttpServer,
+        Request,
+        Router,
+    )
+
+    stubs = []
+    counts = []
+    for i in range(backends):
+        r = Router()
+        n = {"count": 0}
+        counts.append(n)
+
+        def gen(q: Request, n=n, i=i):
+            n["count"] += 1
+            return {"tokens": [1], "backend": i}
+
+        r.post("/v1/generate", gen)
+        r.get("/healthz", lambda q: {"ok": True})
+        srv = JsonHttpServer(r, port=0).start()
+        stubs.append(srv)
+    lb = ServingLoadBalancer([f"127.0.0.1:{s.port}" for s in stubs])
+    front = JsonHttpServer(lb.router(), port=0).start()
+    url = f"http://127.0.0.1:{front.port}/v1/generate"
+    body = json.dumps({"tokens": [1, 2, 3]}).encode()
+    errors: "queue.Queue[str]" = queue.Queue()
+
+    def client(n):
+        for _ in range(n):
+            try:
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    r.read()
+            except Exception as e:  # noqa: BLE001
+                errors.put(repr(e))
+
+    per_client = requests // clients
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(per_client,))
+               for _ in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    for s in stubs:
+        s.stop()
+    front.stop()
+    done = per_client * clients
+    spread = [n["count"] for n in counts]
+    return {
+        "lb_requests": done,
+        "lb_backends": backends,
+        "lb_clients": clients,
+        "lb_seconds": round(dt, 3),
+        "lb_requests_per_sec": round(done / dt, 1),
+        "lb_errors": errors.qsize(),
+        "lb_backend_spread": spread,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="kftpu-loadtest")
     p.add_argument("--notebooks", type=int, default=100)
     p.add_argument("--jobs", type=int, default=20)
     p.add_argument("--profiles", type=int, default=10)
+    p.add_argument("--serving-lb", action="store_true",
+                   help="also measure L7 balancer requests/sec")
     args = p.parse_args(argv)
     out = run_load(
         notebooks=args.notebooks, jobs=args.jobs, profiles=args.profiles
     )
+    if args.serving_lb:
+        out.update(run_serving_lb_load())
     print(json.dumps(out))
     return 0 if out["notebooks_not_ready"] == 0 else 1
 

@@ -28,3 +28,19 @@ class TestControlPlaneLoad:
         # Loops per object must stay roughly constant: allow 3x headroom
         # over linear before calling it a regression.
         assert ratio < 3 * objects_ratio, (small, large)
+
+
+class TestServingLbLoad:
+    def test_lb_sustains_concurrent_load_and_spreads(self):
+        """The L7 balancer under 8 concurrent clients: no errors, sane
+        throughput floor (conservative: in-process stubs serve thousands
+        of req/s), and load actually spreads across backends — a wedged
+        least-loaded picker would pin everything to one."""
+        from kubeflow_tpu.tools.loadtest import run_serving_lb_load
+
+        out = run_serving_lb_load(backends=2, clients=8, requests=240)
+        assert out["lb_errors"] == 0
+        assert out["lb_requests_per_sec"] > 50       # floor, not a bench
+        spread = out["lb_backend_spread"]
+        assert sum(spread) == out["lb_requests"]
+        assert min(spread) > 0                       # both backends worked

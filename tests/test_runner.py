@@ -56,6 +56,24 @@ class TestRunnerContract:
         )
         assert report["steps"] == 3
 
+    def test_model_kw_reaches_the_registry_factory(self, monkeypatch,
+                                                   tmp_path):
+        """KFTPU_MODEL_KW (JSON kwargs for the model factory) is how a
+        flagship job requests bf16 params / a remat policy; the
+        admission-time capacity planner reads the same contract, so the
+        runner must actually honor it."""
+        report = _run(
+            monkeypatch, tmp_path,
+            KFTPU_MODEL_KW=json.dumps(
+                {"param_dtype": "bfloat16", "remat": False}),
+        )
+        assert report["loss"] > 0
+        # a bogus kwarg fails loudly rather than silently training a
+        # different model than the planner accounted for
+        with pytest.raises(TypeError):
+            _run(monkeypatch, tmp_path,
+                 KFTPU_MODEL_KW=json.dumps({"no_such_knob": 1}))
+
     def test_pp_mesh_requires_pipeline_support(self, monkeypatch, tmp_path):
         with pytest.raises(ValueError, match="pipeline"):
             _run(
