@@ -79,11 +79,13 @@ def bench_train(args) -> None:
     # bf16 logits (the round-3 defaults below), bs12 measures 55.9% MFU
     # vs 53.4% for full remat at the same batch.
     bs = args.batch_size or 12
+    policy = args.remat_policy or "qkv_attn"
     cfg = LlamaConfig(
         vocab_size=32000, embed_dim=2048, num_layers=12, num_heads=16,
         num_kv_heads=8, head_dim=128, mlp_dim=5632,
-        max_seq_len=args.seq_len, scan_layers=True, remat=True,
-        remat_policy=args.remat_policy,
+        max_seq_len=args.seq_len, scan_layers=True,
+        remat=policy != "none",
+        remat_policy=policy if policy != "none" else "full",
         logits_f32=not args.bf16_logits,
         param_dtype=_jnp.dtype(args.param_dtype),
     )
@@ -389,11 +391,13 @@ def bench_mixtral(args) -> None:
     # balance loss at 0.02 the router spreads load, so drops stay small —
     # the standard Switch/GShard production setting. Measured r4 ladder:
     # einsum 55.8k -> index-gather dispatch 63.4k -> cap 1.0 70.9k tok/s.
+    policy = args.remat_policy or "minimal"
     cfg = MixtralConfig(
         vocab_size=32000, embed_dim=1024, num_layers=6, num_heads=16,
         num_kv_heads=8, head_dim=64, mlp_dim=2048, num_experts=8,
-        max_seq_len=args.seq_len, scan_layers=True, remat=True,
-        remat_policy=args.remat_policy,
+        max_seq_len=args.seq_len, scan_layers=True,
+        remat=policy != "none",
+        remat_policy=policy if policy != "none" else "full",
         logits_f32=not args.bf16_logits,
         param_dtype=jnp.dtype(args.param_dtype),
         capacity_factor=args.capacity_factor,
@@ -423,11 +427,15 @@ def bench_mixtral(args) -> None:
         state, metrics = trainer.step(state, batch, rng=rng)
     if args.warmup > 0:
         _sync(metrics["loss"])
+    if args.trace_dir:
+        jax.profiler.start_trace(args.trace_dir)
     t0 = time.perf_counter()
     for _ in range(args.steps):
         state, metrics = trainer.step(state, batch, rng=rng)
     _sync(metrics["loss"])
     dt = time.perf_counter() - t0
+    if args.trace_dir:
+        jax.profiler.stop_trace()
     tokens = bs * ndev * args.seq_len * args.steps
     tps_chip = tokens / dt / ndev
     flops_per_token = train_flops_per_token(cfg, args.seq_len)
@@ -599,9 +607,13 @@ def main() -> None:
     # Round-3 measured defaults (decisive same-session sweep, min-of-3):
     # qkv_attn policy (save q/k/v + attention context, replay the MLP)
     # + bf16 Adam mu + bf16 logits beat full remat 55.9% vs 53.4% MFU.
-    p.add_argument("--remat-policy", default="qkv_attn",
-                   choices=["full", "minimal", "qkv_attn", "attn_only",
-                            "mlp_only", "dots"])
+    # Default is per-bench: train qkv_attn (55.9% MFU r3 sweep); mixtral
+    # minimal — with the MoE mlp_gate/mlp_up/moe_route tags saved, not
+    # replaying the expert block beats the lighter policy (r4: 76.7k vs
+    # 73.7k tok/s).
+    p.add_argument("--remat-policy", default=None,
+                   choices=["none", "full", "minimal", "qkv_attn",
+                            "attn_only", "mlp_only", "dots"])
     p.add_argument("--mu-dtype", default="bfloat16",
                    help="adam first-moment dtype ('' keeps f32)")
     p.add_argument("--capacity-factor", type=float, default=1.0,

@@ -138,7 +138,7 @@ def _remat_policy(name: str):
         return None
     if name == "minimal":
         return jax.checkpoint_policies.save_only_these_names(
-            "qkv", "attn_out", "mlp_gate", "mlp_up"
+            "qkv", "attn_out", "mlp_gate", "mlp_up", "moe_route"
         )
     if name == "qkv_attn":
         # Lighter variant: backward replays the MLP but not the attention

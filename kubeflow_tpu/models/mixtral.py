@@ -16,6 +16,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+from jax.ad_checkpoint import checkpoint_name
 
 from kubeflow_tpu.models.llama import (
     Attention,
@@ -101,6 +102,12 @@ class MoeMlp(nn.Module):
                 "ecm,emh->ech", e_in, w_up.astype(e_in.dtype),
                 preferred_element_type=jnp.float32,
             ).astype(e_in.dtype)
+            # Same tag names as the dense MLP so the "minimal"/"mlp_only"
+            # remat policies cover MoE experts too: without these, every
+            # selective policy replays the full dispatch+expert block in
+            # backward (the 44%-elementwise profile slice, BASELINE.md).
+            gate = checkpoint_name(gate, "mlp_gate")
+            up = checkpoint_name(up, "mlp_up")
             h = nn.silu(gate) * up
             out = jnp.einsum(
                 "ech,ehm->ecm", h, w_down.astype(h.dtype),

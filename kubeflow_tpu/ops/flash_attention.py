@@ -109,6 +109,12 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc, m_scr, l_scr, *, cfg: _FlashConfig):
     # m_scr tracks the running max in the exp2 domain (scores pre-scaled by
     # scale * log2(e)); lse converts back to natural log on output.
+    # When D < LANES the l statistic rides the AV matmul instead of a VPU
+    # reduction: v gets a ones column appended (lane D is dead padding
+    # anyway below 128), so pv[:, D] is sum(p) and acc[:, D] accumulates l
+    # under the same alpha-rescale as o. At D = LANES the extra column
+    # would spill into a second lane tile, so the VPU sum stays.
+    fold_l = q_ref.shape[-1] + 1 <= LANES
     i, j = pl.program_id(2), pl.program_id(3)
     nj = pl.num_programs(3)
     off = off_ref[0, 0]
@@ -116,7 +122,8 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     @pl.when(j == 0)
     def _init():
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
+        if not fold_l:
+            l_scr[:] = jnp.zeros_like(l_scr)
         acc[:] = jnp.zeros_like(acc)
 
     def _step(masked):
@@ -124,10 +131,17 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             q = q_ref[0, 0]                           # [bq, D]
             k = k_ref[0, 0]                           # [bkv, D]
             v = v_ref[0, 0]
+            if fold_l:
+                v = jnp.concatenate(
+                    [v, jnp.ones((v.shape[0], 1), v.dtype)], axis=1
+                )
+            # q arrives PRE-SCALED by scale*log2(e) (see _fwd_impl): the
+            # [bq, D] multiply there replaces a [bq, bkv] VPU pass here —
+            # 16x fewer elements at D=64, where this kernel is VPU-bound.
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            ) * (cfg.scale * LOG2E)                    # [bq, bkv], base-2
+            )                                          # [bq, bkv], base-2
             if masked:
                 mask = _causal_mask_block(
                     cfg, off, i, j, s.shape[0], s.shape[1]
@@ -145,13 +159,14 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             # f32 inputs keep the exact path.
             arg = s - jnp.maximum(m_new[:, :1], M_CLAMP)
             p = jnp.exp2(arg.astype(_exp_dtype(q.dtype)))
-            l_scr[:] = l_scr[:] * alpha + jnp.sum(
-                p.astype(jnp.float32), axis=-1, keepdims=True
-            )
+            if not fold_l:
+                l_scr[:] = l_scr[:] * alpha + jnp.sum(
+                    p.astype(jnp.float32), axis=-1, keepdims=True
+                )
             pv = jax.lax.dot_general(
                 p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            )                                          # [bq, D]
+            )                                          # [bq, D(+1)]
             acc[:] = acc[:] * alpha[:, :1] + pv
             m_scr[:] = m_new
         return body
@@ -166,12 +181,14 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(j == nj - 1)
     def _finish():
-        l = l_scr[:, :1]
+        D = q_ref.shape[-1]
+        l = acc[:, D:D + 1] if fold_l else l_scr[:, :1]
         o_ref[0, 0] = jnp.where(
-            l > 0, acc[:] / jnp.maximum(l, 1e-30), 0.0
+            l > 0, acc[:, :D] / jnp.maximum(l, 1e-30), 0.0
         ).astype(o_ref.dtype)
         m0 = m_scr[:, :STATS_LANES]
-        l0 = l_scr[:, :STATS_LANES]
+        l0 = jnp.broadcast_to(l, (l.shape[0], STATS_LANES)) if fold_l \
+            else l_scr[:, :STATS_LANES]
         lse_ref[0, 0] = jnp.where(
             l0 > 0, m0 * LN2 + jnp.log(jnp.maximum(l0, 1e-30)), NEG_INF
         )
@@ -193,6 +210,10 @@ def _fwd_impl(cfg: _FlashConfig, off, q, k, v) -> Tuple[jax.Array, jax.Array]:
     G = H // Hkv
     bq, bkv = cfg.block_q, cfg.block_kv
     grid = (B, H, Sq // bq, Skv // bkv)
+    # Pre-scale q so qk is directly the base-2 score (one [.., D] multiply
+    # out here vs a [bq, bkv] multiply inside the kernel; XLA fuses this
+    # into the producer).
+    q = (q * (cfg.scale * LOG2E)).astype(q.dtype)
 
     kv_spec = pl.BlockSpec(
         (1, 1, bkv, D), lambda b, h, i, j: (b, h // G, j, 0)
@@ -216,9 +237,11 @@ def _fwd_impl(cfg: _FlashConfig, off, q, k, v) -> Tuple[jax.Array, jax.Array]:
             jax.ShapeDtypeStruct((B, H, Sq, STATS_LANES), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bq, D), jnp.float32),
+            # +1 lane when l rides the AV matmul (see _fwd_kernel fold_l);
+            # l_scr is unused on that path, so it shrinks to one tile.
+            pltpu.VMEM((bq, D + 1 if D + 1 <= LANES else D), jnp.float32),
             pltpu.VMEM((bq, LANES), jnp.float32),
-            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((8 if D + 1 <= LANES else bq, LANES), jnp.float32),
         ],
         interpret=cfg.interpret,
     )(off.reshape(1, 1), q, k, v)
@@ -250,10 +273,14 @@ def _dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 lse_ref[0, 0][:, :1] * LOG2E, M_CLAMP
             )                                          # [bq, 1]
             delta = delta_ref[0, 0][:, :1]             # [bq, 1]
+            # q is pre-scaled by scale*log2(e) (see _bwd_impl), so qk is
+            # already the base-2 score; dq is the cotangent of the
+            # ORIGINAL q, so ds keeps the natural-domain scale factor and
+            # contracts against the unscaled k.
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            ) * (cfg.scale * LOG2E)
+            )
             if masked:
                 mask = _causal_mask_block(
                     cfg, off, i, j, s.shape[0], s.shape[1]
@@ -307,10 +334,14 @@ def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 lse_ref[0, 0][:, :1] * LOG2E, M_CLAMP
             )
             delta = delta_ref[0, 0][:, :1]
+            # q is pre-scaled by scale*log2(e) (see _bwd_impl). dk must be
+            # the cotangent of the ORIGINAL k but contracts against the
+            # scaled q, so ds carries ln2 instead of scale:
+            # ln2 * (scale*log2e) = scale.
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            ) * (cfg.scale * LOG2E)
+            )
             if masked:
                 mask = _causal_mask_block(
                     cfg, off, i, j, s.shape[0], s.shape[1]
@@ -325,7 +356,7 @@ def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 do, v, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            ds = p * (dp - delta) * cfg.scale
+            ds = p * (dp - delta) * LN2
             dk_acc[:] += jax.lax.dot_general(
                 ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -354,6 +385,9 @@ def _bwd_impl(cfg: _FlashConfig, off, q, k, v, o, lse, do, dlse=None):
     _, Hkv, Skv, _ = k.shape
     G = H // Hkv
     bq, bkv = cfg.block_q, cfg.block_kv
+    # Matches _fwd_impl: kernels see the base-2 pre-scaled q (the dk path
+    # compensates with an ln2 factor in ds).
+    q = (q * (cfg.scale * LOG2E)).astype(q.dtype)
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     if dlse is not None:

@@ -15,6 +15,7 @@ from typing import Callable, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from kubeflow_tpu.utils import get_logger
 
@@ -34,7 +35,7 @@ class Top2GateConfig:
     # total token count — measured 27ms vs 3.4ms at T=16k on one v5e.
     # Groups also give the standard per-group capacity/fairness semantics.
     # 0 = one group (legacy behaviour for small T).
-    group_size: int = 4096
+    group_size: int = 8192
     # Dispatch mechanism:
     #   "gather" — index-based: scatter token ids into expert slots, gather
     #              rows in, gather rows out. O(T x M) data movement and NO
@@ -154,6 +155,79 @@ def _expert_axis_sharded() -> bool:
     return any(ctx.mesh.shape.get(a, 1) > 1 for a in axes)
 
 
+@jax.custom_vjp
+def _gather_in(x, slot_tok, slot_valid, dest1, dest2):
+    """expert_in[s] = x[slot_tok[s]] * valid[s]. Backward uses the INVERSE
+    index maps (dest1/dest2: token -> slot, trash row for drops) so the
+    cotangent is two row-gathers instead of XLA's scatter-add of [S, M]
+    rows — measured 46 GB/s on v5e (8.6 ms/step in the mixtral bench, the
+    single largest backward op) vs ~memory-speed gathers."""
+    return jnp.take(x, slot_tok, axis=0) * slot_valid[:, None]
+
+
+def _gather_in_fwd(x, slot_tok, slot_valid, dest1, dest2):
+    return _gather_in(x, slot_tok, slot_valid, dest1, dest2), (dest1, dest2)
+
+
+def _gather_in_bwd(res, d_ein):
+    dest1, dest2 = res
+    # Kept choices: expert_in[dest_k[t]] = x[t] (valid=1 there); dropped
+    # choices point at the trash row, which we pad with zeros.
+    d_pad = jnp.concatenate(
+        [d_ein, jnp.zeros((1, d_ein.shape[1]), d_ein.dtype)]
+    )
+    d_x = jnp.take(d_pad, dest1, axis=0) + jnp.take(d_pad, dest2, axis=0)
+    return d_x, None, None, None, None
+
+
+_gather_in.defvjp(_gather_in_fwd, _gather_in_bwd)
+
+
+@jax.custom_vjp
+def _combine_out(y, g1, g2, dest1, dest2, slot_tok):
+    """out[t] = g1[t]*y_pad[dest1[t]] + g2[t]*y_pad[dest2[t]] (y [S, M]
+    expert outputs, trash row appended). Backward w.r.t. y is again a
+    gather: slot s was filled by token slot_tok[s]'s first or second
+    choice, so d_y[s] = w_s * d_out[slot_tok[s]] with w_s recovered by
+    comparing s against that token's dest — no scatter anywhere."""
+    yp = jnp.concatenate([y, jnp.zeros((1, y.shape[1]), y.dtype)])
+    out = (
+        g1[:, None] * jnp.take(yp, dest1, axis=0).astype(jnp.float32)
+        + g2[:, None] * jnp.take(yp, dest2, axis=0).astype(jnp.float32)
+    )
+    return out
+
+
+def _combine_out_fwd(y, g1, g2, dest1, dest2, slot_tok):
+    return (_combine_out(y, g1, g2, dest1, dest2, slot_tok),
+            (y, g1, g2, dest1, dest2, slot_tok))
+
+
+def _combine_out_bwd(res, d_out):
+    y, g1, g2, dest1, dest2, slot_tok = res
+    S = y.shape[0]
+    slots = jnp.arange(S, dtype=dest1.dtype)
+    t = slot_tok[:S]                                  # token behind slot s
+    w_s = (
+        jnp.where(jnp.take(dest1, t) == slots, jnp.take(g1, t), 0.0)
+        + jnp.where(jnp.take(dest2, t) == slots, jnp.take(g2, t), 0.0)
+    )
+    # Empty slots carry t=0 from the zeros-init scatter; both compares miss
+    # (token 0's dest slots are real slots holding token 0), so w_s = 0.
+    d_y = (w_s[:, None] * jnp.take(d_out, t, axis=0)).astype(y.dtype)
+    yp = jnp.concatenate([y, jnp.zeros((1, y.shape[1]), y.dtype)])
+    d_g1 = jnp.sum(
+        d_out * jnp.take(yp, dest1, axis=0).astype(jnp.float32), axis=-1
+    )
+    d_g2 = jnp.sum(
+        d_out * jnp.take(yp, dest2, axis=0).astype(jnp.float32), axis=-1
+    )
+    return d_y, d_g1, d_g2, None, None, None
+
+
+_combine_out.defvjp(_combine_out_fwd, _combine_out_bwd)
+
+
 def _moe_dispatch_gather(
     x: jax.Array,
     router_logits: jax.Array,
@@ -210,17 +284,23 @@ def _moe_dispatch_gather(
         .at[dest1].set(k1.astype(x.dtype))
         .at[dest2].set(k2.astype(x.dtype))
     )
-    expert_in = jnp.take(x, slot_tok[:E * G * C], axis=0) \
-        * slot_valid[:E * G * C, None]
+    # Tag the routing artifacts so selective remat policies ("minimal")
+    # can save them: they are int32/f32 vectors (~24 bytes/token — nothing
+    # next to activations), and saving them skips replaying the routing
+    # cumsum + id scatters in backward.
+    name = checkpoint_name
+    dest1 = name(dest1, "moe_route")
+    dest2 = name(dest2, "moe_route")
+    slot_tok = name(slot_tok, "moe_route")
+    slot_valid = name(slot_valid, "moe_route")
+    g1 = name(g1, "moe_route")
+    g2 = name(g2, "moe_route")
+    expert_in = _gather_in(
+        x, slot_tok[:E * G * C], slot_valid[:E * G * C], dest1, dest2
+    )
     expert_out = expert_fn(
         expert_in.reshape(E, G * C, M)).reshape(E * G * C, M)
-    padded = jnp.concatenate(
-        [expert_out, jnp.zeros((1, M), expert_out.dtype)]
-    )
-    out = (
-        g1[:, None] * jnp.take(padded, dest1, axis=0).astype(jnp.float32)
-        + g2[:, None] * jnp.take(padded, dest2, axis=0).astype(jnp.float32)
-    )
+    out = _combine_out(expert_out, g1, g2, dest1, dest2, slot_tok)
     return out.astype(x.dtype), jnp.mean(aux)
 
 

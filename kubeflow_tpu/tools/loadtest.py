@@ -137,13 +137,17 @@ def run_serving_lb_load(
 
     stubs = []
     counts = []
+    count_lock = threading.Lock()
     for i in range(backends):
         r = Router()
         n = {"count": 0}
         counts.append(n)
 
         def gen(q: Request, n=n, i=i):
-            n["count"] += 1
+            # JsonHttpServer handlers run on ThreadingHTTPServer threads;
+            # the += is not atomic under concurrent clients.
+            with count_lock:
+                n["count"] += 1
             return {"tokens": [1], "backend": i}
 
         r.post("/v1/generate", gen)
