@@ -57,6 +57,12 @@ M_CLAMP = -1e29  # subtracted-max clamp: exp2(s - max(m, M_CLAMP)) drives
                  # fully-masked rows (m == NEG_INF) to 0 without a second
                  # where over the [bq, bkv] block
 LANES = 128      # m/l scratch lane width (TPU vector lane count)
+_DQ_VMEM_BUDGET = 4 * 1024 * 1024  # fused-backward dq_all scratch cap: the
+                 # kernel's block windows + [bq, bkv] f32 temporaries take
+                 # ~10 MiB of the ~16 MiB scoped-vmem budget on their own
+                 # (measured: an 8 MiB dq_all compiled to an 18.6 MiB
+                 # stack — over); longer query ranges chunk (_bwd_impl).
+                 # Module-level so tests can shrink it to force chunking.
 STATS_LANES = 8  # minor dim of the lse/delta HBM arrays: TPU block specs
                  # need the last dim to be 128-divisible or equal to the
                  # array dim, so rank-3 [B,H,S] blocks are not loadable —
@@ -251,19 +257,39 @@ def _fwd_impl(cfg: _FlashConfig, off, q, k, v) -> Tuple[jax.Array, jax.Array]:
 # ----------------------------- backward -----------------------------------
 
 
-def _dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, dq_acc, *, cfg: _FlashConfig):
-    i, j = pl.program_id(2), pl.program_id(3)
-    nj = pl.num_programs(3)
+def _bwd_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dq_ref, dk_ref, dv_ref, dq_all, dk_acc, dv_acc,
+                *, cfg: _FlashConfig):
+    # FUSED backward: one (B, Hkv, j, g, i) grid produces dk/dv (VMEM
+    # accumulators, kv-block-major as before) AND dq. The win: s,
+    # p = exp2(s - lse) and dp = do @ v^T are computed ONCE instead of
+    # once per separate dq and dkv kernel — the backward was two full
+    # passes of VPU softmax work over S^2, now one.
+    #
+    # dq blocks are revisited non-consecutively (once per j), so dq
+    # accumulates in the ``dq_all`` VMEM scratch holding the WHOLE query
+    # group's gradient for the current (b, hkv) — [G * Sq, D] f32, a few
+    # MB for every shipped config (guarded in _bwd_impl) — and each
+    # block is flushed to HBM on the last kv step. This needs no HBM
+    # round-trip per revisit and, unlike input_output_aliasing, has
+    # identical semantics on hardware and in interpret mode.
+    j, g, i = pl.program_id(2), pl.program_id(3), pl.program_id(4)
+    ni = pl.num_programs(4)
+    bq = cfg.block_q
     off = off_ref[0, 0]
 
-    @pl.when(j == 0)
+    @pl.when((j == 0) & (g == 0) & (i == 0))
+    def _init_dq():
+        dq_all[:] = jnp.zeros_like(dq_all)
+
+    @pl.when((g == 0) & (i == 0))
     def _init():
-        dq_acc[:] = jnp.zeros_like(dq_acc)
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
 
     def _step(masked):
         def body():
-            q = q_ref[0, 0]
+            q = q_ref[0, 0]                # pre-scaled by scale*log2(e)
             k = k_ref[0, 0]
             v = v_ref[0, 0]
             do = do_ref[0, 0]
@@ -273,75 +299,10 @@ def _dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 lse_ref[0, 0][:, :1] * LOG2E, M_CLAMP
             )                                          # [bq, 1]
             delta = delta_ref[0, 0][:, :1]             # [bq, 1]
-            # q is pre-scaled by scale*log2(e) (see _bwd_impl), so qk is
-            # already the base-2 score; dq is the cotangent of the
-            # ORIGINAL q, so ds keeps the natural-domain scale factor and
-            # contracts against the unscaled k.
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            )
-            if masked:
-                mask = _causal_mask_block(
-                    cfg, off, i, j, s.shape[0], s.shape[1]
-                )
-                s = jnp.where(mask, s, NEG_INF)
-            p = jnp.exp2((s - lse2).astype(_exp_dtype(q.dtype)))
-            dp = jax.lax.dot_general(
-                do, v, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )                                          # [bq, bkv]
-            ds = p * (dp - delta) * cfg.scale
-            dq_acc[:] += jax.lax.dot_general(
-                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-        return body
-
-    if cfg.causal:
-        live = _block_live(cfg, off, i, j)
-        needs_mask = _block_needs_mask(cfg, off, i, j)
-        pl.when(live & needs_mask)(_step(True))
-        pl.when(live & jnp.logical_not(needs_mask))(_step(False))
-    else:
-        _step(False)()
-
-    @pl.when(j == nj - 1)
-    def _finish():
-        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
-
-
-def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, cfg: _FlashConfig):
-    # grid: (B, Hkv, j, g, i) — q-block i innermost, then group member g,
-    # so dk/dv for kv head hkv accumulate over the whole query group.
-    j, g, i = pl.program_id(2), pl.program_id(3), pl.program_id(4)
-    ng, ni = pl.num_programs(3), pl.num_programs(4)
-    off = off_ref[0, 0]
-
-    @pl.when((g == 0) & (i == 0))
-    def _init():
-        dk_acc[:] = jnp.zeros_like(dk_acc)
-        dv_acc[:] = jnp.zeros_like(dv_acc)
-
-    def _step(masked):
-        def body():
-            q = q_ref[0, 0]
-            k = k_ref[0, 0]
-            v = v_ref[0, 0]
-            do = do_ref[0, 0]
-            lse2 = jnp.maximum(
-                lse_ref[0, 0][:, :1] * LOG2E, M_CLAMP
-            )
-            delta = delta_ref[0, 0][:, :1]
-            # q is pre-scaled by scale*log2(e) (see _bwd_impl). dk must be
-            # the cotangent of the ORIGINAL k but contracts against the
-            # scaled q, so ds carries ln2 instead of scale:
-            # ln2 * (scale*log2e) = scale.
-            s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
+            )                                          # base-2 score
             if masked:
                 mask = _causal_mask_block(
                     cfg, off, i, j, s.shape[0], s.shape[1]
@@ -356,9 +317,20 @@ def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 do, v, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            ds = p * (dp - delta) * LN2
+            # ONE natural-domain conversion ds2 = p * (dp - delta) * ln2
+            # feeds both gradients (q is scaled by scale*log2e, so
+            # ln2 * scale*log2e = scale recovers dk; dq contracts against
+            # k scaled by scale/ln2 — a [bkv, D] multiply, 16x smaller
+            # than rescaling ds itself at D=64):
+            ds2 = p * ((dp - delta) * LN2)
             dk_acc[:] += jax.lax.dot_general(
-                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                ds2.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            k2 = (k * (cfg.scale / LN2)).astype(k.dtype)
+            row = (g * ni + i) * bq
+            dq_all[pl.ds(row, bq)] += jax.lax.dot_general(
+                ds2.astype(k2.dtype), k2, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
         return body
@@ -371,7 +343,12 @@ def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         _step(False)()
 
-    @pl.when((g == ng - 1) & (i == ni - 1))
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _write_dq():
+        dq_ref[0, 0] = dq_all[pl.ds((g * ni + i) * bq, bq)] \
+            .astype(dq_ref.dtype)
+
+    @pl.when((g == pl.num_programs(3) - 1) & (i == pl.num_programs(4) - 1))
     def _finish():
         dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
@@ -396,24 +373,8 @@ def _bwd_impl(cfg: _FlashConfig, off, q, k, v, o, lse, do, dlse=None):
     delta = jnp.broadcast_to(delta[..., None],
                              (*delta.shape, STATS_LANES))
 
-    kv_spec = pl.BlockSpec(
-        (1, 1, bkv, D), lambda b, h, i, j: (b, h // G, j, 0)
-    )
-    q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
-    r_spec = pl.BlockSpec((1, 1, bq, STATS_LANES),
-                          lambda b, h, i, j: (b, h, i, 0))
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, cfg=cfg),
-        grid=(B, H, Sq // bq, Skv // bkv),
-        in_specs=[_smem_spec(), q_spec, kv_spec, kv_spec, q_spec,
-                  r_spec, r_spec],
-        out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
-        interpret=cfg.interpret,
-    )(off.reshape(1, 1), q, k, v, do, lse, delta)
-
-    # dk/dv: kv-block-major grid, query group folded in.
+    # One fused pass: kv-block-major grid with the query group folded in;
+    # dq rides along via HBM accumulation (see _bwd_kernel).
     qg_spec = pl.BlockSpec(
         (1, 1, bq, D), lambda b, hkv, j, g, i: (b, hkv * G + g, i, 0)
     )
@@ -424,23 +385,51 @@ def _bwd_impl(cfg: _FlashConfig, off, q, k, v, o, lse, do, dlse=None):
     kvg_spec = pl.BlockSpec(
         (1, 1, bkv, D), lambda b, hkv, j, g, i: (b, hkv, j, 0)
     )
-    dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, cfg=cfg),
-        grid=(B, Hkv, Skv // bkv, G, Sq // bq),
-        in_specs=[_smem_spec(), qg_spec, kvg_spec, kvg_spec, qg_spec,
-                  rg_spec, rg_spec],
-        out_specs=[kvg_spec, kvg_spec],
-        out_shape=[
-            jax.ShapeDtypeStruct((B, Hkv, Skv, D), k.dtype),
-            jax.ShapeDtypeStruct((B, Hkv, Skv, D), v.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bkv, D), jnp.float32),
-            pltpu.VMEM((bkv, D), jnp.float32),
-        ],
-        interpret=cfg.interpret,
-    )(off.reshape(1, 1), q, k, v, do, lse, delta)
-    return dq, dk, dv
+    def call(qc, doc, lsec, deltac, offc):
+        Sqc = qc.shape[2]
+        return pl.pallas_call(
+            functools.partial(_bwd_kernel, cfg=cfg),
+            grid=(B, Hkv, Skv // bkv, G, Sqc // bq),
+            in_specs=[_smem_spec(), qg_spec, kvg_spec, kvg_spec, qg_spec,
+                      rg_spec, rg_spec],
+            out_specs=[qg_spec, kvg_spec, kvg_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, H, Sqc, D), q.dtype),
+                jax.ShapeDtypeStruct((B, Hkv, Skv, D), k.dtype),
+                jax.ShapeDtypeStruct((B, Hkv, Skv, D), v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((G * Sqc, D), jnp.float32),
+                pltpu.VMEM((bkv, D), jnp.float32),
+                pltpu.VMEM((bkv, D), jnp.float32),
+            ],
+            interpret=cfg.interpret,
+        )(offc.reshape(1, 1), qc, k, v, doc, lsec, deltac)
+
+    # dq_all holds the whole query group's f32 gradient in VMEM (see
+    # _bwd_kernel): G * Sq * D * 4 bytes — 2 MB for the 700M train config.
+    # The TPU scoped-vmem limit is ~16 MiB, so long sequences chunk the
+    # query range: one kernel call per chunk (s/p still computed once per
+    # q position), dk/dv partials summed (untouched kv blocks write the
+    # zero-initialised accumulator, so the sum is exact).
+    budget = _DQ_VMEM_BUDGET
+    budget_rows = budget // (G * D * 4)
+    budget_rows = max(bq, (budget_rows // bq) * bq)
+    if G * Sq * D * 4 <= budget or Sq <= budget_rows:
+        dq, dk, dv = call(q, do, lse, delta, off)
+        return dq, dk, dv
+    dqs, dk, dv = [], 0.0, 0.0
+    for c0 in range(0, Sq, budget_rows):
+        c1 = min(c0 + budget_rows, Sq)
+        dqc, dkc, dvc = call(
+            q[:, :, c0:c1], do[:, :, c0:c1], lse[:, :, c0:c1],
+            delta[:, :, c0:c1], off + c0,
+        )
+        dqs.append(dqc)
+        dk = dk + dkc.astype(jnp.float32)
+        dv = dv + dvc.astype(jnp.float32)
+    return (jnp.concatenate(dqs, axis=2),
+            dk.astype(k.dtype), dv.astype(v.dtype))
 
 
 def _int_cotangent():
