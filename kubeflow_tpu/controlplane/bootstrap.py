@@ -40,9 +40,10 @@ from kubeflow_tpu.controlplane.api.types import (
     PlatformConfig,
     PlatformConfigSpec,
 )
-from kubeflow_tpu.controlplane.platform import Platform
+from kubeflow_tpu.controlplane.platform import DEFAULT_COMPONENTS, Platform
 from kubeflow_tpu.utils import get_logger
 from kubeflow_tpu.webapps.router import (
+    Html,
     JsonHttpServer,
     Request,
     RestError,
@@ -52,6 +53,112 @@ from kubeflow_tpu.webapps.router import (
 log = get_logger("bootstrap")
 
 _PREFIX = "/kfctl/apps/v1beta1"
+
+# The click-to-deploy form (reference: gcp-click-to-deploy/src/
+# DeployForm.tsx — deployment name + project/zone/version pickers, a
+# Deploy button, and polled status). Same dependency-free vanilla-JS
+# approach as webapps/frontend.py, over this server's own REST surface;
+# every interpolation passes esc()/encodeURIComponent (the stored-XSS
+# invariant tests/test_frontend_js.py enforces structurally).
+_DEPLOY_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>Deploy Kubeflow TPU</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2rem; }
+ table { border-collapse: collapse; margin: 1rem 0; min-width: 30rem; }
+ td, th { border: 1px solid #ccc; padding: .3rem .6rem; text-align: left; }
+ .phase-Ready { color: #0a7d32; }
+ .phase-Failed { color: #b3261e; }
+ fieldset { margin: 1rem 0; max-width: 40rem; }
+ label { display: inline-block; margin: .2rem .8rem .2rem 0; }
+</style></head>
+<body>
+<h1>Deploy Kubeflow TPU</h1>
+<form id="deploy">
+ <input id="name" placeholder="deployment name" required
+        pattern="[a-z0-9]([-a-z0-9]*[a-z0-9])?">
+ <label>Default slice:
+  <select id="slice">__SLICES__</select></label>
+ <fieldset><legend>Components</legend>__COMPONENTS__</fieldset>
+ <button>Deploy</button>
+</form>
+<h2>Deployments</h2><div id="err" class="phase-Failed"></div>
+<div id="list"></div>
+<script>
+const H = {'content-type': 'application/json'};
+function esc(s) {
+  return String(s).replace(/[&<>"']/g, c => ({'&': '&amp;', '<': '&lt;',
+    '>': '&gt;', '"': '&quot;', "'": '&#39;'})[c]);
+}
+async function api(path, opts) {
+  const r = await fetch(path, opts);
+  const data = await r.json();
+  if (!r.ok) throw new Error(data.error || r.statusText);
+  return data;
+}
+function showErr(e) {
+  document.getElementById('err').textContent = e ? String(e.message || e)
+                                                 : '';
+}
+async function refresh() {
+  let out;
+  try { out = await api('__PREFIX__/list'); showErr(''); }
+  catch (e) { showErr(e); return; }
+  const list = document.getElementById('list');
+  list.innerHTML = '<table><tr><th>name</th><th>phase</th>' +
+    '<th>components</th><th>error</th><th></th></tr>' +
+    out.deployments.map(d =>
+      `<tr><td>${esc(d.name)}</td>` +
+      `<td class="phase-${esc(d.phase)}">${esc(d.phase)}</td>` +
+      `<td>${esc(d.components.length)}</td>` +
+      `<td>${esc(d.error)}</td>` +
+      `<td><button class="del" data-name="${esc(d.name)}">delete` +
+      `</button></td></tr>`).join('') + '</table>';
+  // Event delegation via dataset, no inline JS-string interpolation.
+  list.querySelectorAll('button.del').forEach(b => b.onclick = async () => {
+    try {
+      await api('__PREFIX__/delete/' + encodeURIComponent(b.dataset.name),
+                {method: 'DELETE'});
+      showErr('');
+    } catch (e) { showErr(e); }
+    refresh();
+  });
+}
+document.getElementById('deploy').onsubmit = async (e) => {
+  e.preventDefault();
+  const components = [...document.querySelectorAll('input.comp:checked')]
+    .map(c => ({name: c.value, enabled: true}));
+  try {
+    await api('__PREFIX__/create', {method: 'POST', headers: H,
+      body: JSON.stringify({
+        name: document.getElementById('name').value,
+        spec: {
+          default_slice_type: document.getElementById('slice').value,
+          components,
+        },
+      })});
+    showErr('');
+  } catch (err) { showErr(err); }
+  refresh();
+};
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+def _deploy_page() -> str:
+    from kubeflow_tpu.topology.slices import list_slices
+
+    slices = "".join(
+        f'<option{" selected" if s == "v5e-16" else ""}>{s}</option>'
+        for s in list_slices()
+    )
+    comps = "".join(
+        f'<label><input type="checkbox" class="comp" value="{c}" checked>'
+        f"{c}</label>"
+        for c in DEFAULT_COMPONENTS
+    )
+    return (_DEPLOY_PAGE
+            .replace("__SLICES__", slices)
+            .replace("__COMPONENTS__", comps)
+            .replace("__PREFIX__", _PREFIX))
 
 
 class _Deployment:
@@ -176,6 +283,9 @@ class DeploymentServer:
 
     def router(self) -> Router:
         r = Router()
+        # The click-to-deploy form (the reference SPA's job) over the same
+        # REST surface.
+        r.get("/", lambda q: Html(_deploy_page()))
         r.post(f"{_PREFIX}/create", self._create)
         r.get(f"{_PREFIX}/get/<name>", self._get)
         r.get(f"{_PREFIX}/list", self._list)

@@ -374,7 +374,8 @@ def _bwd_impl(cfg: _FlashConfig, off, q, k, v, o, lse, do, dlse=None):
                              (*delta.shape, STATS_LANES))
 
     # One fused pass: kv-block-major grid with the query group folded in;
-    # dq rides along via HBM accumulation (see _bwd_kernel).
+    # dq accumulates in the whole-query-group VMEM scratch (see
+    # _bwd_kernel / _DQ_VMEM_BUDGET).
     qg_spec = pl.BlockSpec(
         (1, 1, bq, D), lambda b, hkv, j, g, i: (b, hkv * G + g, i, 0)
     )

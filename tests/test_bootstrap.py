@@ -105,3 +105,59 @@ class TestDeploymentLifecycle:
             with pytest.raises(urllib.error.HTTPError) as ei:
                 _req(server.port, "POST", f"{PREFIX}/create", {"name": bad})
             assert ei.value.code == 400
+
+
+class TestDeployPage:
+    """The click-to-deploy form (the reference SPA's job,
+    gcp-click-to-deploy/src/DeployForm.tsx): served from the deployment
+    server itself over the same REST surface."""
+
+    def _page(self, port):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=10) as r:
+            assert r.status == 200
+            assert "text/html" in r.headers["Content-Type"]
+            return r.read().decode()
+
+    def test_form_covers_the_create_contract(self, server):
+        from kubeflow_tpu.controlplane.platform import DEFAULT_COMPONENTS
+
+        html = self._page(server.port)
+        assert '<form id="deploy">' in html
+        assert 'id="name"' in html and 'id="slice"' in html
+        for comp in DEFAULT_COMPONENTS:
+            assert f'value="{comp}"' in html
+        # The script posts to the same prefix the REST tests exercise.
+        assert f"{PREFIX}/create" in html
+        assert f"{PREFIX}/list" in html
+
+    def test_form_component_subset_round_trips(self, server):
+        """What the form submits (name + spec.components subset) must be
+        honoured by the engine: only the picked components come up."""
+        _req(server.port, "POST", f"{PREFIX}/create", {
+            "name": "subset",
+            "spec": {"components": [
+                {"name": "tpujob-controller", "enabled": True},
+                {"name": "kfam", "enabled": True},
+            ]},
+        })
+        body = _wait_phase(server.port, "subset", {"Ready", "Failed"})
+        assert body["phase"] == "Ready", body["error"]
+        assert sorted(body["components"]) == ["kfam", "tpujob-controller"]
+
+    def test_page_interpolations_are_escaped(self, server):
+        """Same structural XSS audit as tests/test_frontend_js.py: every
+        ${...} in the page script passes esc()/encodeURIComponent."""
+        import re
+
+        html = self._page(server.port)
+        scripts = re.findall(r"<script>(.*?)</script>", html, re.S)
+        assert scripts
+        allowed = re.compile(r"^\s*(esc|encodeURIComponent)\s*\(")
+        checked = 0
+        for script in scripts:
+            for m in re.finditer(r"\$\{([^{}]+)\}", script):
+                assert allowed.search(m.group(1)), (
+                    f"unescaped interpolation: ${{{m.group(1)}}}")
+                checked += 1
+        assert checked >= 5
