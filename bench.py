@@ -577,6 +577,9 @@ def bench_longctx(args) -> None:
     ring/Ulysses sequence parallelism)."""
     args.seq_len = args.seq_len if args.seq_len != 2048 else 8192
     args.batch_size = args.batch_size or 3
+    # Saving the flash lse residual pays off once the S^2 forward replay
+    # dominates (+4% at 8k; -2.5% at 2k — see _remat_policy docs).
+    args.remat_policy = args.remat_policy or "qkv_attn_lse"
     bench_train(args)
 
 
@@ -613,7 +616,8 @@ def main() -> None:
     # 73.7k tok/s).
     p.add_argument("--remat-policy", default=None,
                    choices=["none", "full", "minimal", "qkv_attn",
-                            "attn_only", "mlp_only", "dots"])
+                            "qkv_attn_lse", "attn_only", "mlp_only",
+                            "dots"])
     p.add_argument("--mu-dtype", default="bfloat16",
                    help="adam first-moment dtype ('' keeps f32)")
     p.add_argument("--capacity-factor", type=float, default=1.0,

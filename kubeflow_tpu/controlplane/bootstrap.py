@@ -127,6 +127,13 @@ document.getElementById('deploy').onsubmit = async (e) => {
   e.preventDefault();
   const components = [...document.querySelectorAll('input.comp:checked')]
     .map(c => ({name: c.value, enabled: true}));
+  // An empty components list means "use the defaults" to the engine
+  // (Platform.apply_config), which would be the opposite of what a
+  // deselect-everything click expresses — refuse it here.
+  if (!components.length) {
+    showErr('select at least one component');
+    return;
+  }
   try {
     await api('__PREFIX__/create', {method: 'POST', headers: H,
       body: JSON.stringify({

@@ -261,15 +261,22 @@ def analytic_report(
         mlp = getattr(cfg, "mlp_dim", 0)
         tok_shards = extents["dp"] * extents["fsdp"] * extents["sp"]
         t_dev = max(1, (B * S) // max(1, tok_shards))
+        # attn_resid (the flash custom-VJP residuals saved by
+        # minimal/qkv_attn_lse): a second bf16 copy of the attention
+        # context plus the f32 lse — expressed in bf16-element units
+        # since per_layer is multiplied by act_bytes=2.
+        attn_resid = heads + 2 * getattr(cfg, "num_heads", 0)
         per_layer = {
             # saved residuals per layer per policy (models/llama.py
             # remat taxonomy): full = scan carry only; qkv_attn adds
-            # q/k/v + attention context; minimal adds mlp gate/up;
-            # dots approximates every matmul output.
+            # q/k/v + attention context; minimal adds mlp gate/up and
+            # the flash custom-VJP residuals; dots approximates every
+            # matmul output.
             "full": E,
             "qkv_attn": 2 * E + heads + kv,
+            "qkv_attn_lse": 2 * E + heads + kv + attn_resid,
             "attn_only": 2 * E + heads + kv,
-            "minimal": 2 * E + heads + kv + 2 * mlp,
+            "minimal": 2 * E + heads + kv + 2 * mlp + attn_resid,
             "mlp_only": E + 2 * mlp,
             "dots": 3 * E + heads + kv + 3 * mlp,
         }.get(remat_policy, 2 * E + heads + kv)

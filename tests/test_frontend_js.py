@@ -56,6 +56,16 @@ def _scripts(html: str):
     return re.findall(r"<script>(.*?)</script>", html, re.S)
 
 
+def _any_page(path: str) -> str:
+    """Hub pages plus the bootstrap deploy form — every served page with
+    inline JS goes through the same structural audit."""
+    if path == "bootstrap:/":
+        from kubeflow_tpu.controlplane.bootstrap import _deploy_page
+
+        return _deploy_page()
+    return _page(path)
+
+
 class TestStaticSinkAudit:
     """Structural XSS guarantee: no template interpolation reaches the
     DOM unescaped."""
@@ -67,9 +77,9 @@ class TestStaticSinkAudit:
         r"^\s*(esc|encodeURIComponent|spark)\s*\(|\.toFixed\(\d+\)\s*$"
     )
 
-    @pytest.mark.parametrize("path", ["/", "/spawner"])
+    @pytest.mark.parametrize("path", ["/", "/spawner", "bootstrap:/"])
     def test_every_interpolation_is_escaped(self, path):
-        html = _page(path)
+        html = _any_page(path)
         scripts = _scripts(html)
         assert scripts, "page must inline its script"
         checked = 0
