@@ -30,9 +30,17 @@ BASELINES = {
     "serving8b": 0.0,      # tokens/s/chip generated, llama3-8b int8
     "resnet": 0.0,         # images/s/chip
     "mixtral": 0.0,        # tokens/s/chip
+    "serving_mixtral": 0.0,  # tokens/s/chip generated, MoE family
     "hpo": 0.0,            # trials/hour (shared-compile in-process sweep)
     "hpo_platform": 0.0,   # trials/hour through StudyJob->TpuJob->gang
 }
+
+# Config-3 arch (350M-active MoE, one v5e chip): shared by the mixtral
+# train bench and the MoE serving bench so "same arch" cannot drift.
+MIXTRAL_ARCH = dict(
+    vocab_size=32000, embed_dim=1024, num_layers=6, num_heads=16,
+    num_kv_heads=8, head_dim=64, mlp_dim=2048, num_experts=8,
+)
 
 
 def _emit(metric: str, value: float, unit: str, baseline: float, **extra):
@@ -178,15 +186,30 @@ def bench_serving(args) -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    from kubeflow_tpu.models import Llama, LlamaConfig
+    from kubeflow_tpu.models import Llama, LlamaConfig, Mixtral, MixtralConfig
     from kubeflow_tpu.serving import ServingConfig, ServingEngine
 
-    cfg = LlamaConfig(
-        vocab_size=32000, embed_dim=2048, num_layers=12, num_heads=16,
-        num_kv_heads=8, head_dim=128, mlp_dim=5632,
-        max_seq_len=1024, scan_layers=True, remat=False,
-    )
-    model = Llama(cfg)
+    if args.model == "mixtral":
+        # The MoE family through the SAME engine (it is model-generic —
+        # top-2 routing rides the cache/decode path like dense Llama);
+        # arch and capacity factor shared with the mixtral train bench.
+        cfg = MixtralConfig(
+            **MIXTRAL_ARCH,
+            max_seq_len=1024, scan_layers=True, remat=False,
+            capacity_factor=args.capacity_factor,
+        )
+        model = Mixtral(cfg)
+        metric = "mixtral_moe_serving_tokens_per_sec_per_chip"
+        baseline = BASELINES["serving_mixtral"]
+    else:
+        cfg = LlamaConfig(
+            vocab_size=32000, embed_dim=2048, num_layers=12, num_heads=16,
+            num_kv_heads=8, head_dim=128, mlp_dim=5632,
+            max_seq_len=1024, scan_layers=True, remat=False,
+        )
+        model = Llama(cfg)
+        metric = "llama_700m_serving_tokens_per_sec_per_chip"
+        baseline = BASELINES["serving"]
     params = {"params": model.init(
         jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32)
     )["params"]}
@@ -228,8 +251,8 @@ def bench_serving(args) -> None:
         return xs[min(len(xs) - 1, int(p * len(xs)))]
 
     _emit(
-        "llama_700m_serving_tokens_per_sec_per_chip",
-        gen_tokens / dt / ndev, "tokens/s/chip", BASELINES["serving"],
+        metric,
+        gen_tokens / dt / ndev, "tokens/s/chip", baseline,
         p50_ttft_s=round(pct(ttfts, 0.50), 4),
         p99_ttft_s=round(pct(ttfts, 0.99), 4),
         p50_latency_s=round(pct(lats, 0.50), 4),
@@ -393,8 +416,7 @@ def bench_mixtral(args) -> None:
     # einsum 55.8k -> index-gather dispatch 63.4k -> cap 1.0 70.9k tok/s.
     policy = args.remat_policy or "minimal"
     cfg = MixtralConfig(
-        vocab_size=32000, embed_dim=1024, num_layers=6, num_heads=16,
-        num_kv_heads=8, head_dim=64, mlp_dim=2048, num_experts=8,
+        **MIXTRAL_ARCH,
         max_seq_len=args.seq_len, scan_layers=True,
         remat=policy != "none",
         remat_policy=policy if policy != "none" else "full",
@@ -601,6 +623,10 @@ def main() -> None:
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--gen-len", type=int, default=128)
     p.add_argument("--decode-chunk", type=int, default=32)
+    p.add_argument("--model", default="llama",
+                   choices=["llama", "mixtral"],
+                   help="serving bench model family (the engine is "
+                        "model-generic)")
     p.add_argument("--max-len", type=int, default=512,
                    help="serving8b engine max_len (KV-cache bound)")
     p.add_argument("--quantize", default="", choices=["", "int8"],
