@@ -29,6 +29,7 @@ BASELINES = {
     "serving": 0.0,        # tokens/s/chip generated
     "serving8b": 0.0,      # tokens/s/chip generated, llama3-8b int8
     "resnet": 0.0,         # images/s/chip
+    "vit": 0.0,            # images/s/chip, ViT-B/16
     "mixtral": 0.0,        # tokens/s/chip
     "serving_mixtral": 0.0,  # tokens/s/chip generated, MoE family
     "hpo": 0.0,            # trials/hour (shared-compile in-process sweep)
@@ -196,7 +197,7 @@ def bench_serving(args) -> None:
         cfg = MixtralConfig(
             **MIXTRAL_ARCH,
             max_seq_len=1024, scan_layers=True, remat=False,
-            capacity_factor=args.capacity_factor,
+            capacity_factor=args.capacity_factor or 2.0,
         )
         model = Mixtral(cfg)
         metric = "mixtral_moe_serving_tokens_per_sec_per_chip"
@@ -354,7 +355,10 @@ def bench_serving8b(args) -> None:
 # ---------------------------------------------------------------- config 1
 
 
-def bench_resnet(args) -> None:
+def _bench_image(args, model_name: str, default_bs: int,
+                 metric: str, baseline_key: str) -> None:
+    """Shared image-training bench body (ResNet + ViT): one timing/warmup/
+    emit sequence so the two benches cannot drift apart."""
     import jax
     import jax.numpy as jnp
 
@@ -363,19 +367,17 @@ def bench_resnet(args) -> None:
     from kubeflow_tpu.train import TrainConfig, Trainer
     from kubeflow_tpu.train.data import SyntheticImageConfig, synthetic_images
 
-    model, _ = get_model("resnet50")
+    model, _ = get_model(model_name)
     ndev = len(jax.devices())
     mesh = make_host_local_mesh(AxisSpec(dp=-1))
     trainer = Trainer(
         model, TrainConfig(task="image", warmup_steps=10, total_steps=1000),
         mesh,
     )
-    # Conv stacks want large batches (measured: bs32 1420 -> bs128 ~2200
-    # -> bs256 ~2385 -> bs512 regresses, one v5e); explicit --batch-size
-    # always wins.
-    bs = (args.batch_size or 256) * ndev
+    bs = (args.batch_size or default_bs) * ndev
     it = synthetic_images(SyntheticImageConfig(batch_size=bs, image_size=224))
-    batch = trainer.shard_batch({k: jnp.asarray(v) for k, v in next(it).items()})
+    batch = trainer.shard_batch(
+        {k: jnp.asarray(v) for k, v in next(it).items()})
     state = trainer.init_state(jax.random.PRNGKey(0), batch)
     for _ in range(args.warmup):
         state, metrics = trainer.step(state, batch)
@@ -387,10 +389,25 @@ def bench_resnet(args) -> None:
     _sync(metrics["loss"])
     dt = time.perf_counter() - t0
     _emit(
-        "resnet50_train_images_per_sec_per_chip",
-        bs * args.steps / dt / ndev, "images/s/chip", BASELINES["resnet"],
+        metric, bs * args.steps / dt / ndev, "images/s/chip",
+        BASELINES.get(baseline_key, 0.0),
         batch=bs,
     )
+
+
+def bench_resnet(args) -> None:
+    # Conv stacks want large batches (measured: bs32 1420 -> bs128 ~2200
+    # -> bs256 ~2385 -> bs512 regresses, one v5e).
+    _bench_image(args, "resnet50", 256,
+                 "resnet50_train_images_per_sec_per_chip", "resnet")
+
+
+def bench_vit(args) -> None:
+    # ViT-B/16: completes measured coverage of the model zoo. Measured r4
+    # sweep on one v5e: bs32 663 -> bs48 668 -> bs64 718 -> bs128 675 ->
+    # bs256 594 img/s.
+    _bench_image(args, "vit-b16", 64,
+                 "vit_b16_train_images_per_sec_per_chip", "vit")
 
 
 # ---------------------------------------------------------------- config 3
@@ -422,7 +439,7 @@ def bench_mixtral(args) -> None:
         remat_policy=policy if policy != "none" else "full",
         logits_f32=not args.bf16_logits,
         param_dtype=jnp.dtype(args.param_dtype),
-        capacity_factor=args.capacity_factor,
+        capacity_factor=args.capacity_factor or 1.0,
     )
     model = Mixtral(cfg)
     ndev = len(jax.devices())
@@ -609,11 +626,12 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("which", nargs="?", default="train",
                    choices=["train", "serving", "serving8b", "resnet",
-                            "mixtral", "hpo", "hpo-platform", "longctx"])
+                            "vit", "mixtral", "hpo", "hpo-platform",
+                            "longctx"])
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)
-    # Default is per-bench (train 12, serving 16, resnet 256, mixtral 8);
-    # an explicit value always wins.
+    # Default is per-bench (train 12, serving 16, resnet 256, vit 64,
+    # mixtral 8); an explicit value always wins.
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--attn", default="flash",
@@ -646,8 +664,12 @@ def main() -> None:
                             "dots"])
     p.add_argument("--mu-dtype", default="bfloat16",
                    help="adam first-moment dtype ('' keeps f32)")
-    p.add_argument("--capacity-factor", type=float, default=1.0,
-                   help="MoE expert-buffer capacity factor (mixtral bench)")
+    p.add_argument("--capacity-factor", type=float, default=None,
+                   help="MoE expert-buffer capacity factor (default: 1.0 "
+                        "for training — the aux balance loss keeps drops "
+                        "small; 2.0 for serving, where a static buffer "
+                        "overflow silently drops token-expert assignments "
+                        "and no loss exists to spread the router)")
     p.add_argument("--loader", default="", choices=["", "native"],
                    help="'native' feeds the C++ ring-buffer pipeline a "
                         "fresh batch per step")
@@ -673,6 +695,7 @@ def main() -> None:
         "serving": bench_serving,
         "serving8b": bench_serving8b,
         "resnet": bench_resnet,
+        "vit": bench_vit,
         "mixtral": bench_mixtral,
         "hpo": bench_hpo,
         "hpo-platform": bench_hpo_platform,
