@@ -310,13 +310,34 @@ def build_server(cfg: dict) -> ServingServer:
 
     # Build the model in the serving dtype when its config accepts it:
     # init then creates half-size weights directly (an 8B init in f32
-    # would OOM a 16G chip before the engine ever casts).
-    try:
-        model, _ = get_model(cfg["model"],
-                             param_dtype=cfg.get("param_dtype")
-                             or "bfloat16")
-    except TypeError:
-        model, _ = get_model(cfg["model"])
+    # would OOM a 16G chip before the engine ever casts). scan_layers is
+    # forced off for decode — a scanned stacked KV cache pays a
+    # whole-layer-cache slice + writeback per scan step (+18% gen tok/s
+    # unrolled, BASELINE.md); checkpoints trained scanned are adapted at
+    # restore (models/layout.py). Configs that accept neither kw degrade
+    # gracefully (e.g. image models).
+    model = None
+    for kw in (
+        {"param_dtype": cfg.get("param_dtype") or "bfloat16",
+         "scan_layers": False},
+        {"param_dtype": cfg.get("param_dtype") or "bfloat16"},
+        {},
+    ):
+        try:
+            model, _ = get_model(cfg["model"], **kw)
+        except TypeError:
+            continue
+        if not kw:
+            # A degraded build (f32 scanned) is exactly what param_dtype
+            # exists to prevent for flagship sizes — be loud about it.
+            log.warning("model config accepted none of the serving "
+                        "overrides; built with registry defaults",
+                        kv={"model": cfg["model"]})
+        else:
+            log.info("serving model build", kv={"model": cfg["model"],
+                                                **{k: str(v) for k, v
+                                                   in kw.items()}})
+        break
     mesh = None
     if cfg["mesh"]:
         mesh = make_host_local_mesh(
@@ -334,7 +355,18 @@ def build_server(cfg: dict) -> ServingServer:
                 f"no checkpoint found in {cfg['checkpoint_dir']!r} "
                 "(serving a trained model requires one)"
             )
-        params = {"params": state["params"]}
+        from kubeflow_tpu.models.layout import adapt_layout
+
+        restored = state["params"]
+        n_layers = getattr(model.cfg, "num_layers", 0)
+        if n_layers:
+            # Train→serve handoff is layout-independent: checkpoints
+            # trained scan_layers=True carry a stacked "layers" subtree;
+            # the serving model is built unrolled (see above).
+            restored = adapt_layout(
+                restored, n_layers,
+                scanned=bool(getattr(model.cfg, "scan_layers", False)))
+        params = {"params": restored}
         log.info("serving from checkpoint",
                  kv={"dir": cfg["checkpoint_dir"],
                      "step": int(state["step"])})

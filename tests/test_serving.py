@@ -700,3 +700,95 @@ class TestBatchedPrefill:
         assert all(len(eng.result(r).tokens) == 2 for r in rids)
         assert (32, 6) in eng._prefill_fns
         assert not any(k > 6 for (_, k) in eng._prefill_fns)
+
+
+class TestScanLayoutHandoff:
+    def test_scanned_checkpoint_serves_unrolled(self, tmp_path, monkeypatch):
+        """Serving decode builds the model UNROLLED (a scanned stacked KV
+        cache pays a whole-layer-cache slice+writeback per scan step;
+        BASELINE.md measures +18% gen tok/s), while training prefers
+        scan_layers=True for O(1) compile. A checkpoint trained scanned
+        must restore into the unrolled server via models/layout.py."""
+        import os
+
+        from kubeflow_tpu.train import runner
+        from kubeflow_tpu.serving.server import build_server, env_config
+
+        ckpt = str(tmp_path / "ckpt")
+        for k in list(os.environ):
+            if k.startswith("KFTPU_"):
+                monkeypatch.delenv(k)
+        for k, v in {
+            "KFTPU_MODEL": "llama-tiny", "KFTPU_TRAIN_STEPS": "2",
+            "KFTPU_MODEL_KW": json.dumps({"scan_layers": True}),
+            "KFTPU_BATCH_PER_HOST": "8", "KFTPU_SEQ_LEN": "16",
+            "KFTPU_MESH": json.dumps({"dp": -1}),
+            "KFTPU_CHECKPOINT_DIR": ckpt,
+            "KFTPU_CHECKPOINT_EVERY": "1",
+            "KFTPU_TERMINATION_LOG": str(tmp_path / "t.json"),
+        }.items():
+            monkeypatch.setenv(k, v)
+        assert runner.run(runner.env_config()) == 0
+
+        # The checkpoint really is in the scanned layout.
+        from kubeflow_tpu.train.checkpoint import CheckpointService
+
+        svc = CheckpointService(ckpt)
+        saved = svc.restore_raw_latest()
+        svc.close()
+        assert "layers" in saved["params"]
+
+        monkeypatch.setenv("KFTPU_SERVING_MODEL", "llama-tiny")
+        monkeypatch.setenv("KFTPU_SERVING_CHECKPOINT_DIR", ckpt)
+        monkeypatch.setenv("KFTPU_SERVING_MAX_LEN", "64")
+        monkeypatch.setenv("KFTPU_SERVING_HOST", "127.0.0.1")
+        monkeypatch.setenv("KFTPU_SERVING_PORT", "0")
+        server = build_server(env_config())
+        served = server.engine.params["params"]
+        assert "layers" not in served and "layer_0" in served
+        # Adapted params carry the trained values, not a fresh init.
+        import numpy as np
+
+        np.testing.assert_allclose(
+            np.asarray(jax.tree.leaves(
+                served["layer_0"])[0], np.float32),
+            np.asarray(jax.tree.leaves(
+                jax.tree.map(lambda x: x[0], saved["params"]["layers"])
+            )[0], np.float32),
+            # bf16 serving cast of the f32-trained params
+            rtol=1e-2, atol=1e-2,
+        )
+        # And it decodes.
+        eng = server.engine
+        eng.warmup(8)
+        rid = eng.submit([1, 2, 3], max_new_tokens=4)
+        eng.run()
+        assert len(eng.result(rid).tokens) == 4
+
+
+class TestLayoutHelpers:
+    def test_round_trip(self):
+        from kubeflow_tpu.models.layout import (
+            adapt_layout,
+            to_layer_layout,
+            to_scanned_layout,
+        )
+
+        scanned = {
+            "embed": jnp.ones((4, 3)),
+            "layers": {"w": jnp.arange(12.0).reshape(3, 4),
+                       "b": jnp.arange(6.0).reshape(3, 2)},
+        }
+        unrolled = to_layer_layout(scanned, 3)
+        assert "layers" not in unrolled
+        assert set(k for k in unrolled if k.startswith("layer_")) == {
+            "layer_0", "layer_1", "layer_2"}
+        np.testing.assert_array_equal(
+            unrolled["layer_1"]["w"], scanned["layers"]["w"][1])
+        back = to_scanned_layout(unrolled, 3)
+        jax.tree.map(np.testing.assert_array_equal, back, scanned)
+        # adapt_layout is idempotent in either direction
+        assert adapt_layout(unrolled, 3, scanned=False) is unrolled
+        jax.tree.map(
+            np.testing.assert_array_equal,
+            adapt_layout(scanned, 3, scanned=True), scanned)
