@@ -200,6 +200,7 @@ def bench_serving(args) -> None:
             # whole-layer-cache slice+writeback per scan step.
             max_seq_len=1024, scan_layers=False, remat=False,
             capacity_factor=args.capacity_factor or 2.0,
+            kv_cache_dtype=args.quantize_kv,
         )
         model = Mixtral(cfg)
         metric = "mixtral_moe_serving_tokens_per_sec_per_chip"
@@ -214,6 +215,7 @@ def bench_serving(args) -> None:
             # Unrolled for decode (+18% gen tok/s vs scanned: no stacked-
             # cache slice+writeback per scan step; BASELINE.md).
             max_seq_len=1024, scan_layers=False, remat=False,
+            kv_cache_dtype=args.quantize_kv,
         )
         model = Llama(cfg)
         metric = "llama_700m_serving_tokens_per_sec_per_chip"
@@ -297,6 +299,7 @@ def bench_serving8b(args) -> None:
     model, mcfg = get_model(
         "llama3-8b", param_dtype="bfloat16",
         max_seq_len=args.max_len, scan_layers=False, remat=False,
+        kv_cache_dtype=args.quantize_kv,
     )
 
     def params():
@@ -308,9 +311,13 @@ def bench_serving8b(args) -> None:
         )["params"]}
 
     # Measured ladder (r4, one v5e chip): bs8 417 -> bs16 701 -> bs24 894
-    # -> bs32 1056 tok/s (KV cache 4.2G; bs40+ exceeds HBM at max_len 512).
-    bs = args.batch_size or 32
-    requests = args.requests or 64
+    # -> bs32 1056-1084 -> bs40 1234 tok/s (bs40 unlocked by the
+    # split-head prefill: the [k, bucket, 128k-vocab] logits tensor no
+    # longer materialises; bs48 still exceeds HBM at max_len 512 —
+    # --quantize-kv int8 runs it at 992, and is what makes max_len 1024
+    # possible at all: 590 tok/s at bs24 x 512-token prompts).
+    bs = args.batch_size or 40
+    requests = args.requests or 2 * bs
     bucket = 1 << (args.prompt_len - 1).bit_length()
     engine = ServingEngine(
         model, params,
@@ -658,6 +665,8 @@ def main() -> None:
                    help="serving8b engine max_len (KV-cache bound)")
     p.add_argument("--quantize", default="", choices=["", "int8"],
                    help="serving weight-only quantization")
+    p.add_argument("--quantize-kv", default="", choices=["", "int8"],
+                   help="serving KV-cache quantization (halves KV HBM)")
     p.add_argument("--trace-dir", default="",
                    help="write a jax.profiler trace of the timed steps")
     # Round-3 measured defaults (decisive same-session sweep, min-of-3):

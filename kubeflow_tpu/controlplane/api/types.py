@@ -259,7 +259,9 @@ class ServingSpec:
     decode_chunk: int = 8               # tokens per device dispatch
     # Engine compute/memory knobs (serving.engine.ServingConfig): int8
     # weight-only quantization is what lets an 8B model fit a 16G chip.
-    quantize: str = ""                  # "" | "int8"
+    quantize: str = ""                  # "" | "int8" (weights)
+    quantize_kv: str = ""               # "" | "int8" (decode KV cache:
+                                        # halves KV HBM -> bigger batches)
     param_dtype: str = "bfloat16"       # cast float params at engine start
     prefill_buckets: List[int] = dataclasses.field(default_factory=list)
     pipeline_depth: int = 0             # 0 = engine default

@@ -222,6 +222,9 @@ class ServingController(Controller):
                     f"{st.num_hosts} hosts")
         if sv.spec.replicas < 1:
             return f"replicas must be >= 1, got {sv.spec.replicas}"
+        if sv.spec.quantize_kv not in ("", "int8"):
+            return (f"unknown quantize_kv {sv.spec.quantize_kv!r}; "
+                    "supported: '' (kv in the activation dtype), 'int8'")
         if sv.spec.quantize not in ("", "int8"):
             return (f"unknown quantize {sv.spec.quantize!r}; "
                     "supported: '', 'int8'")
@@ -269,6 +272,9 @@ class ServingController(Controller):
         # pods (and their drift contract) are untouched by the defaults.
         if sv.spec.quantize:
             env.append(EnvVar("KFTPU_SERVING_QUANTIZE", sv.spec.quantize))
+        if sv.spec.quantize_kv:
+            env.append(EnvVar("KFTPU_SERVING_QUANTIZE_KV",
+                              sv.spec.quantize_kv))
         if sv.spec.param_dtype != "bfloat16":
             env.append(EnvVar("KFTPU_SERVING_PARAM_DTYPE",
                               sv.spec.param_dtype))

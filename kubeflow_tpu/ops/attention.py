@@ -37,9 +37,19 @@ def mha_reference(
     mask: Optional[jax.Array] = None,
     causal: bool = False,
     scale: Optional[float] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """q: [B, Sq, H, D]; k, v: [B, Skv, Hkv, D] with H % Hkv == 0 (GQA/MQA
-    via head repetition). Returns [B, Sq, H, D] in q.dtype."""
+    via head repetition). Returns [B, Sq, H, D] in q.dtype.
+
+    ``k_scale``/``v_scale`` ([B, Skv, Hkv, 1]) declare k/v as
+    absmax-quantized integers (the int8 KV cache): the big tensors enter
+    the einsums through a bare dtype convert (which XLA fuses as an
+    operand conversion — no dequantized copy in HBM), and the row scales
+    apply on the SMALL side: k's on the [.., Sq, Skv] logits, v's folded
+    into the softmax weights. Exact: (q @ k8) * ks == q @ (k8 * ks) and
+    (p * vs) @ v8 == p @ (v8 * vs) row-for-row."""
     B, Sq, H, D = q.shape
     _, Skv, Hkv, _ = k.shape
     if H % Hkv != 0:
@@ -54,8 +64,13 @@ def mha_reference(
         G = H // Hkv
         qg = q.reshape(B, Sq, Hkv, G, D)
         logits = jnp.einsum(
-            "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+            "bqhgd,bkhd->bhgqk", qg, k.astype(q.dtype),
+            preferred_element_type=jnp.float32,
         ) * scale
+        if k_scale is not None:
+            # [B, Skv, Hkv, 1] -> [B, Hkv, 1, 1, Skv] over the logits.
+            logits = logits * k_scale[..., 0].transpose(0, 2, 1)[
+                :, :, None, None, :]
         if causal:
             cm = causal_mask(Sq, Skv, q_offset=Skv - Sq)
             logits = jnp.where(cm[None, None, None, :, :], logits, -jnp.inf)
@@ -69,15 +84,21 @@ def mha_reference(
             logits = jnp.where(mg, logits, -jnp.inf)
         weights = jax.nn.softmax(logits, axis=-1)
         weights = jnp.where(jnp.isnan(weights), 0.0, weights)
+        if v_scale is not None:
+            weights = weights * v_scale[..., 0].transpose(0, 2, 1)[
+                :, :, None, None, :]
         out = jnp.einsum(
-            "bhgqk,bkhd->bqhgd", weights.astype(v.dtype), v,
+            "bhgqk,bkhd->bqhgd", weights.astype(q.dtype), v.astype(q.dtype),
             preferred_element_type=jnp.float32,
         )
         return out.reshape(B, Sq, H, D).astype(q.dtype)
 
     logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        "bqhd,bkhd->bhqk", q, k.astype(q.dtype),
+        preferred_element_type=jnp.float32,
     ) * scale
+    if k_scale is not None:
+        logits = logits * k_scale[..., 0].transpose(0, 2, 1)[:, :, None, :]
     if causal:
         cm = causal_mask(Sq, Skv, q_offset=Skv - Sq)
         logits = jnp.where(cm[None, None, :, :], logits, -jnp.inf)
@@ -86,8 +107,10 @@ def mha_reference(
     # Fully-masked rows (possible with segment masks) would yield NaN; guard.
     weights = jax.nn.softmax(logits, axis=-1)
     weights = jnp.where(jnp.isnan(weights), 0.0, weights)
+    if v_scale is not None:
+        weights = weights * v_scale[..., 0].transpose(0, 2, 1)[:, :, None, :]
     out = jnp.einsum(
-        "bhqk,bkhd->bqhd", weights.astype(v.dtype), v,
+        "bhqk,bkhd->bqhd", weights.astype(q.dtype), v.astype(q.dtype),
         preferred_element_type=jnp.float32,
     )
     return out.astype(q.dtype)

@@ -656,11 +656,26 @@ class ServingEngine:
             jnp.arange(tokens.shape[1]), tokens.shape
         )
         params = self._materialize(params)
+        # LM-family models carry their logits tail as a pure function
+        # (Llama.HEAD_LOGITS = staticmethod(head_logits)): run the stack
+        # hidden-only, then lm_head on each row's LAST position — the
+        # full [k, bucket, V] prefill logits are discarded except one row
+        # each, and at 128k vocab x bucket 512 they are a 3.9 GB HBM
+        # blocker. Models without the hook keep the plain path.
+        head_fn = getattr(type(self.model), "HEAD_LOGITS", None)
+        split_head = callable(head_fn)
         with self._pctx():
-            logits, mut = self.model.apply(
-                {"params": params["params"], "cache": rows}, tokens,
-                positions=positions, decode="prefill", mutable=["cache"],
-            )
+            if split_head:
+                hidden, mut = self.model.apply(
+                    {"params": params["params"], "cache": rows}, tokens,
+                    positions=positions, decode="prefill",
+                    mutable=["cache"], return_hidden=True,
+                )
+            else:
+                logits, mut = self.model.apply(
+                    {"params": params["params"], "cache": rows}, tokens,
+                    positions=positions, decode="prefill", mutable=["cache"],
+                )
         new_rows = jax.tree.map(
             lambda x: jnp.broadcast_to(
                 lengths, x.shape
@@ -679,9 +694,18 @@ class ServingEngine:
             return batch_leaf.at[..., slot_idxs, :, :, :].set(row_leaf)
 
         cache = jax.tree.map(install, cache, new_rows)
-        last_logits = jnp.take_along_axis(
-            logits, (lengths - 1)[:, None, None], axis=1
-        )[:, 0]                                   # [k, V]
+        if split_head:
+            last_h = jnp.take_along_axis(
+                hidden, (lengths - 1)[:, None, None], axis=1
+            )                                     # [k, 1, E]
+            with self._pctx():
+                last_logits = head_fn(
+                    self.model.cfg, params["params"], last_h
+                )[:, 0]                           # [k, V]
+        else:
+            last_logits = jnp.take_along_axis(
+                logits, (lengths - 1)[:, None, None], axis=1
+            )[:, 0]                               # [k, V]
         # Sample on device (same scheme as decode): ONE k-int transfer to
         # host instead of per-row slice+argmax round trips.
         toks = self._sample_logits(last_logits.astype(jnp.float32),

@@ -277,6 +277,7 @@ def env_config() -> dict:
         # quantization is the 8B-on-a-16G-chip enabler; empty values fall
         # through to the engine defaults.
         "quantize": os.environ.get("KFTPU_SERVING_QUANTIZE", ""),
+        "quantize_kv": os.environ.get("KFTPU_SERVING_QUANTIZE_KV", ""),
         "param_dtype": os.environ.get("KFTPU_SERVING_PARAM_DTYPE", ""),
         "prefill_buckets": [
             int(b)
@@ -317,9 +318,12 @@ def build_server(cfg: dict) -> ServingServer:
     # restore (models/layout.py). Configs that accept neither kw degrade
     # gracefully (e.g. image models).
     model = None
+    base_kw = {"param_dtype": cfg.get("param_dtype") or "bfloat16",
+               "scan_layers": False}
+    if cfg.get("quantize_kv"):
+        base_kw["kv_cache_dtype"] = cfg["quantize_kv"]
     for kw in (
-        {"param_dtype": cfg.get("param_dtype") or "bfloat16",
-         "scan_layers": False},
+        base_kw,
         {"param_dtype": cfg.get("param_dtype") or "bfloat16"},
         {},
     ):
@@ -337,6 +341,13 @@ def build_server(cfg: dict) -> ServingServer:
             log.info("serving model build", kv={"model": cfg["model"],
                                                 **{k: str(v) for k, v
                                                    in kw.items()}})
+        if cfg.get("quantize_kv") and "kv_cache_dtype" not in kw:
+            # Sizing max_batch for a halved KV footprint and silently
+            # getting bf16 would OOM at the planned batch — refuse.
+            raise ValueError(
+                f"model {cfg['model']!r} does not support quantize_kv="
+                f"{cfg['quantize_kv']!r} (config rejects kv_cache_dtype)"
+            )
         break
     mesh = None
     if cfg["mesh"]:

@@ -250,3 +250,30 @@ class TestViTDropout:
         c = model.apply(params, imgs, train=False)
         d = model.apply(params, imgs, train=False)
         np.testing.assert_allclose(np.asarray(c), np.asarray(d))
+
+
+class TestHeadLogits:
+    """head_logits (the serving prefill's split logits tail) must mirror
+    the model's own logits op-for-op in every config variant."""
+
+    @pytest.mark.parametrize("kw", [
+        {},
+        {"tie_embeddings": True},
+        {"logits_softcap": 30.0},
+        {"logits_f32": False},
+    ])
+    def test_matches_model_logits(self, kw):
+        from kubeflow_tpu.models.llama import Llama, LlamaConfig, head_logits
+
+        cfg = LlamaConfig.tiny(**kw)
+        m = Llama(cfg)
+        tokens = jnp.asarray([[3, 1, 4, 1, 5, 9, 2, 6]], jnp.int32)
+        variables = m.init(jax.random.PRNGKey(0), tokens)
+        full = m.apply(variables, tokens)
+        hidden = m.apply(variables, tokens, return_hidden=True)
+        split = head_logits(cfg, variables["params"], hidden)
+        assert split.dtype == full.dtype
+        np.testing.assert_allclose(
+            np.asarray(split, np.float32), np.asarray(full, np.float32),
+            rtol=1e-5, atol=1e-6,
+        )
