@@ -104,6 +104,16 @@ class LlamaConfig:
     # Prefill attends the live k/v, so only decode reads dequantized
     # cache rows (dequant fuses into the attention matmuls).
     kv_cache_dtype: str = ""
+    # >0: decode steps write k/v into a [B, C, Hkv, D] staging buffer at
+    # the chunk-step index (ONE cheap scalar-index DUS — the same column
+    # for every slot) instead of per-slot scatters into the main cache;
+    # the engine flushes the staging rows into the cache once per decode
+    # chunk in C-row granules. The per-step per-slot scatters this
+    # replaces measured 25% of decode device time (3072 four-KB scatters
+    # per 32-step chunk at bs24). Requires the engine to pass
+    # ``stage_step`` and flush (ServingEngine does); 0 = classic per-step
+    # writes.
+    decode_staging: int = 0
 
     @classmethod
     def llama3_8b(cls, **kw) -> "LlamaConfig":
@@ -219,6 +229,7 @@ class Attention(nn.Module):
         positions: jax.Array,
         *,
         decode: bool = False,
+        stage_step=None,
     ) -> jax.Array:
         cfg = self.cfg
         H, Hkv, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -254,7 +265,8 @@ class Attention(nn.Module):
         if decode:
             # decode is True (single-step against filled cache) or
             # "prefill" (fresh rows — causal over the incoming block).
-            out = self._decode_attention(q, k, v, mode=decode)
+            out = self._decode_attention(q, k, v, mode=decode,
+                                         stage_step=stage_step)
         else:
             out = self._train_attention(q, k, v)
         out = constrain(out, ("act_batch", "act_seq", "act_heads", "act_kv"))
@@ -286,7 +298,8 @@ class Attention(nn.Module):
             return flash_attention(q, k, v, causal=True)
         return mha_reference(q, k, v, causal=True)
 
-    def _decode_attention(self, q, k, v, mode=True) -> jax.Array:
+    def _decode_attention(self, q, k, v, mode=True,
+                          stage_step=None) -> jax.Array:
         """Single-step (or prefill) attention against a mutable KV cache.
 
         Cache layout: [B, max_len, Hkv, Dh]; cache_index is **per-slot**
@@ -330,9 +343,45 @@ class Attention(nn.Module):
                 jnp.zeros, (B, cfg.max_seq_len, cfg.num_kv_heads, 1),
                 jnp.float32,
             )
+        staging = cfg.decode_staging
+        if staging > 0:
+            # Chunk staging buffers (see LlamaConfig.decode_staging): the
+            # decode write becomes one scalar-index DUS shared by every
+            # slot; the engine flushes these into the main cache once per
+            # chunk. Always the activation dtype — with an int8 main
+            # cache, quantization happens at flush over C rows at once.
+            stage_key = self.variable(
+                "cache", "stage_key",
+                jnp.zeros, (B, staging, cfg.num_kv_heads, cfg.head_dim),
+                cfg.dtype,
+            )
+            stage_value = self.variable(
+                "cache", "stage_value",
+                jnp.zeros, (B, staging, cfg.num_kv_heads, cfg.head_dim),
+                cfg.dtype,
+            )
         cache_index = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((B,), jnp.int32)
         )
+        if not is_init and mode is True and staging > 0 \
+                and stage_step is not None:
+            # Staged decode step: write this step's k/v at the chunk-step
+            # column (uniform across slots), attend over
+            # [flushed cache | staged rows 0..stage_step].
+            idx = cache_index.value                # flushed length [B]
+            kc = k.astype(cfg.dtype)               # [B, 1, Hkv, D]
+            vc = v.astype(cfg.dtype)
+            stage_key.value = jax.lax.dynamic_update_slice_in_dim(
+                stage_key.value, kc, stage_step, axis=1)
+            stage_value.value = jax.lax.dynamic_update_slice_in_dim(
+                stage_value.value, vc, stage_step, axis=1)
+            return _staged_decode_attention(
+                cfg, q, idx, stage_step,
+                cached_key.value, cached_value.value,
+                stage_key.value, stage_value.value,
+                key_scale.value if quant else None,
+                value_scale.value if quant else None,
+            )
         if not is_init:
             idx = cache_index.value           # [B]
             S_new = q.shape[1]
@@ -343,16 +392,9 @@ class Attention(nn.Module):
                     (i,) + (0,) * (cache_row.ndim - 1)
                 )
 
-            def q8(x):
-                s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
-                            keepdims=True) / 127.0
-                s = jnp.maximum(s, 1e-8)
-                return (jnp.round(x.astype(jnp.float32) / s)
-                        .astype(jnp.int8), s)
-
             if quant:
-                k8, ks = q8(k)
-                v8, vs = q8(v)
+                k8, ks = quantize_kv_rows(k)
+                v8, vs = quantize_kv_rows(v)
                 cached_key.value = jax.vmap(upd)(cached_key.value, k8, idx)
                 cached_value.value = jax.vmap(upd)(
                     cached_value.value, v8, idx)
@@ -391,6 +433,61 @@ class Attention(nn.Module):
         return mha_reference(q, k, v, causal=True)
 
 
+def quantize_kv_rows(x):
+    """Absmax int8 per (.., position, kv-head) row: returns (int8 rows,
+    f32 scales [..., 1]). Shared by the per-step cache write and the
+    serving engine's staged-chunk flush so the two paths cannot diverge."""
+    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-8)
+    return jnp.round(x.astype(jnp.float32) / s).astype(jnp.int8), s
+
+
+def _staged_decode_attention(cfg, q, idx, stage_step, ck, cv, sk, sv,
+                             k_scale, v_scale):
+    """One decode step's attention over [flushed cache | staging rows].
+    The big cache tensors never concatenate — only the [.., S] and
+    [.., C] SCORE vectors do, and one softmax spans both parts (exactly
+    the joint distribution). Mirrors mha_reference's GQA fold and its
+    int8 scale placement (scales on the score/weight side, cache through
+    a fused convert)."""
+    B, Sq, H, D = q.shape                      # Sq == 1 at decode
+    S, C = ck.shape[1], sk.shape[1]
+    Hkv = ck.shape[2]
+    G = H // Hkv
+    scale = D ** -0.5
+    qg = q.reshape(B, Sq, Hkv, G, D)
+    s1 = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, ck.astype(q.dtype),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if k_scale is not None:
+        s1 = s1 * k_scale[..., 0].transpose(0, 2, 1)[:, :, None, None, :]
+    valid_main = jnp.arange(S)[None, :] < idx[:, None]          # [B, S]
+    s1 = jnp.where(valid_main[:, None, None, None, :], s1, -jnp.inf)
+    s2 = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, sk.astype(q.dtype),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    valid_stage = jnp.arange(C) <= stage_step                   # [C]
+    s2 = jnp.where(valid_stage[None, None, None, None, :], s2, -jnp.inf)
+    w = jax.nn.softmax(jnp.concatenate([s1, s2], axis=-1), axis=-1)
+    w1, w2 = w[..., :S], w[..., S:]
+    if v_scale is not None:
+        w1 = w1 * v_scale[..., 0].transpose(0, 2, 1)[:, :, None, None, :]
+    out = (
+        jnp.einsum(
+            "bhgqk,bkhd->bqhgd", w1.astype(q.dtype), cv.astype(q.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        + jnp.einsum(
+            "bhgqk,bkhd->bqhgd", w2.astype(q.dtype), sv.astype(q.dtype),
+            preferred_element_type=jnp.float32,
+        )
+    )
+    return out.reshape(B, Sq, H, D).astype(q.dtype)
+
+
 class Mlp(nn.Module):
     cfg: LlamaConfig
 
@@ -419,11 +516,13 @@ class DecoderLayer(nn.Module):
 
     @nn.compact
     def __call__(
-        self, x: jax.Array, positions: jax.Array, decode: bool = False
+        self, x: jax.Array, positions: jax.Array, decode: bool = False,
+        stage_step=None,
     ) -> jax.Array:
         cfg = self.cfg
         h = RMSNorm(cfg, name="input_norm")(x)
-        h = Attention(cfg, name="attn")(h, positions, decode=decode)
+        h = Attention(cfg, name="attn")(h, positions, decode=decode,
+                                        stage_step=stage_step)
         x = x + h
         h = RMSNorm(cfg, name="post_attn_norm")(x)
         h = Mlp(cfg, name="mlp")(h)
@@ -457,6 +556,7 @@ class Llama(nn.Module):
         positions: Optional[jax.Array] = None,
         decode: bool = False,
         return_hidden: bool = False,
+        stage_step=None,
     ) -> jax.Array:
         cfg = self.cfg
         B, S = tokens.shape
@@ -516,7 +616,8 @@ class Llama(nn.Module):
             )(x, positions)
         elif cfg.scan_layers:
             x, _ = nn.scan(
-                lambda mdl, carry, _: (mdl(carry, positions, decode), None),
+                lambda mdl, carry, _: (
+                    mdl(carry, positions, decode, stage_step), None),
                 variable_axes={c: 0 for c in self.SCAN_COLLECTIONS},
                 split_rngs={r: True for r in self.SCAN_RNGS},
                 length=cfg.num_layers,
@@ -524,7 +625,8 @@ class Llama(nn.Module):
             )(layer_cls(cfg, name="layers"), x, None)
         else:
             for i in range(cfg.num_layers):
-                x = layer_cls(cfg, name=f"layer_{i}")(x, positions, decode)
+                x = layer_cls(cfg, name=f"layer_{i}")(
+                    x, positions, decode, stage_step)
 
         x = RMSNorm(cfg, name="final_norm")(x)
         if return_hidden:

@@ -138,11 +138,13 @@ class MixtralLayer(nn.Module):
 
     @nn.compact
     def __call__(
-        self, x: jax.Array, positions: jax.Array, decode: bool = False
+        self, x: jax.Array, positions: jax.Array, decode: bool = False,
+        stage_step=None,
     ) -> jax.Array:
         cfg = self.cfg
         h = RMSNorm(cfg, name="input_norm")(x)
-        h = Attention(cfg, name="attn")(h, positions, decode=decode)
+        h = Attention(cfg, name="attn")(h, positions, decode=decode,
+                                        stage_step=stage_step)
         x = x + h
         h = RMSNorm(cfg, name="post_attn_norm")(x)
         h = MoeMlp(cfg, name="moe")(h)

@@ -222,6 +222,22 @@ class ServingEngine:
                 f"model max_seq_len {model.cfg.max_seq_len} < engine max_len "
                 f"{cfg.max_len}"
             )
+        staging = int(getattr(model.cfg, "decode_staging", 0) or 0)
+        if staging and staging < cfg.decode_chunk:
+            # A chunk longer than the staging buffer would wrap and
+            # overwrite un-flushed rows.
+            raise ValueError(
+                f"model decode_staging {staging} < engine decode_chunk "
+                f"{cfg.decode_chunk}"
+            )
+        if staging and getattr(model.cfg, "scan_layers", False):
+            # _flush_staging vmaps the per-slot scatter over the batch
+            # axis; a scanned cache tree stacks a leading layer axis onto
+            # every leaf, which that vmap would map against cache_index.
+            raise ValueError(
+                "decode_staging requires scan_layers=False (the serving "
+                "layout; see models/layout.py for checkpoint adaptation)"
+            )
         self.model = model
         self.cfg = cfg
         self.mesh = mesh
@@ -756,33 +772,92 @@ class ServingEngine:
     def _decode_step(self, params, cache, tokens, positions, rng, temps):
         """Decode ``decode_chunk`` tokens in one device program: a lax.scan
         whose carry is (last token, position, cache) — one dispatch per
-        chunk instead of per token."""
+        chunk instead of per token. With a staging-enabled model
+        (cfg.decode_staging), each step writes k/v at the chunk-step
+        column and the whole chunk flushes into the main cache ONCE at
+        the end (_flush_staging)."""
+        staging = int(getattr(self.model.cfg, "decode_staging", 0) or 0)
 
-        def body(carry, rng_k):
+        def body(carry, xs):
             toks, pos, cache_c = carry
+            rng_k, step_i = xs
             # Dequant inside the scan body: the int8->bf16 convert fuses
             # into each step's dots so HBM reads stay int8 per step (were
             # it hoisted out of the loop, the materialised bf16 weights
             # would be re-read every step — the traffic quantization is
             # meant to remove).
             mat = self._materialize(params)
+            kw = {"stage_step": step_i} if staging else {}
             with self._pctx():
                 logits, mut = self.model.apply(
                     {"params": mat["params"], "cache": cache_c}, toks,
-                    positions=pos, decode=True, mutable=["cache"],
+                    positions=pos, decode=True, mutable=["cache"], **kw,
                 )
             nxt = self._sample_logits(logits[:, 0], rng_k, temps)
             return (nxt[:, None], pos + 1, mut["cache"]), nxt
 
         K = self.cfg.decode_chunk
         if K <= 1:
-            (toks, _, cache), out = body((tokens, positions, cache), rng)
+            (toks, _, cache), out = body(
+                (tokens, positions, cache), (rng, jnp.int32(0)))
+            if staging:
+                cache = self._flush_staging(cache, 1)
             return out[:, None], cache
         rngs = jax.random.split(rng, K)
         (_, _, cache), out = jax.lax.scan(
-            body, (tokens, positions, cache), rngs
+            body, (tokens, positions, cache),
+            (rngs, jnp.arange(K, dtype=jnp.int32)),
         )
+        if staging:
+            cache = self._flush_staging(cache, K)
         return out.T, cache                        # [B, K]
+
+    def _flush_staging(self, cache, steps: int):
+        """Scatter each layer's staging rows [B, :steps] into its main
+        cache at the per-slot cache_index, in one steps-row granule per
+        slot (the per-step per-slot scatters this replaces were 25% of
+        decode device time), then advance cache_index. With an int8 main
+        cache the rows quantize here (models.llama.quantize_kv_rows —
+        the same function the unstaged write path uses)."""
+        from kubeflow_tpu.models.llama import quantize_kv_rows
+
+        quant = getattr(self.model.cfg, "kv_cache_dtype", "") == "int8"
+
+        def upd(cache_row, new_row, i):
+            return jax.lax.dynamic_update_slice(
+                cache_row, new_row, (i,) + (0,) * (cache_row.ndim - 1)
+            )
+
+        from collections.abc import Mapping
+
+        def flush(node):
+            if not isinstance(node, Mapping):
+                return node
+            if not ("stage_key" in node and "cached_key" in node):
+                return {k: flush(v) for k, v in node.items()}
+            node = dict(node)
+            idx = node["cache_index"]
+            sk = node["stage_key"][:, :steps]
+            sv = node["stage_value"][:, :steps]
+            if quant:
+                k8, ks = quantize_kv_rows(sk)
+                v8, vs = quantize_kv_rows(sv)
+                node["cached_key"] = jax.vmap(upd)(
+                    node["cached_key"], k8, idx)
+                node["cached_value"] = jax.vmap(upd)(
+                    node["cached_value"], v8, idx)
+                node["key_scale"] = jax.vmap(upd)(node["key_scale"], ks, idx)
+                node["value_scale"] = jax.vmap(upd)(
+                    node["value_scale"], vs, idx)
+            else:
+                node["cached_key"] = jax.vmap(upd)(
+                    node["cached_key"], sk, idx)
+                node["cached_value"] = jax.vmap(upd)(
+                    node["cached_value"], sv, idx)
+            node["cache_index"] = idx + steps
+            return node
+
+        return flush(cache)
 
     def _dispatch_decode(
         self, chain: Optional["_InFlight"] = None

@@ -319,14 +319,29 @@ def build_server(cfg: dict) -> ServingServer:
     # gracefully (e.g. image models).
     model = None
     base_kw = {"param_dtype": cfg.get("param_dtype") or "bfloat16",
-               "scan_layers": False}
+               "scan_layers": False,
+               # Chunk-staged decode writes (one flush per chunk instead
+               # of per-step per-slot scatters — 25% of decode time).
+               "decode_staging": cfg["decode_chunk"]}
     if cfg.get("quantize_kv"):
         base_kw["kv_cache_dtype"] = cfg["quantize_kv"]
-    for kw in (
+    fallbacks = [
         base_kw,
+        {"param_dtype": cfg.get("param_dtype") or "bfloat16",
+         "scan_layers": False},
         {"param_dtype": cfg.get("param_dtype") or "bfloat16"},
         {},
-    ):
+    ]
+    if cfg.get("quantize_kv"):
+        # A model may support the int8 KV cache while rejecting other
+        # overrides; without this entry a decode_staging TypeError would
+        # cascade into a wrong "does not support quantize_kv" refusal.
+        fallbacks.insert(1, {
+            "param_dtype": cfg.get("param_dtype") or "bfloat16",
+            "scan_layers": False,
+            "kv_cache_dtype": cfg["quantize_kv"],
+        })
+    for kw in fallbacks:
         try:
             model, _ = get_model(cfg["model"], **kw)
         except TypeError:

@@ -854,3 +854,51 @@ class TestKvCacheQuantization:
         monkeypatch.setenv("KFTPU_SERVING_QUANTIZE_KV", "int8")
         server = build_server(env_config())
         assert server.engine.model.cfg.kv_cache_dtype == "int8"
+
+
+class TestDecodeStaging:
+    """Chunk-staged decode (LlamaConfig.decode_staging): k/v write at the
+    chunk-step column, one flush per chunk. Must be token-identical to the
+    classic per-step writes across multi-chunk generations, alone and
+    composed with the int8 KV cache."""
+
+    def _tokens(self, staging, kv_dtype, chunk=4, n=11):
+        from kubeflow_tpu.models import Llama, LlamaConfig
+
+        m = Llama(LlamaConfig.tiny(
+            kv_cache_dtype=kv_dtype,
+            decode_staging=chunk if staging else 0,
+        ))
+        params = {"params": m.init(
+            jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32)
+        )["params"]}
+        eng = ServingEngine(
+            m, params,
+            ServingConfig(max_batch=2, max_len=64, decode_chunk=chunk,
+                          prefill_buckets=(8,)),
+        )
+        eng.warmup(8)
+        rids = [eng.submit([3, 1, 4, 1, 5], max_new_tokens=n),
+                eng.submit([2, 7, 1], max_new_tokens=n)]
+        eng.run()
+        return [eng.result(r).tokens for r in rids]
+
+    @pytest.mark.parametrize("kv_dtype", ["", "int8"])
+    def test_staged_matches_unstaged(self, kv_dtype):
+        # n=11 with chunk=4 crosses two flush boundaries mid-generation.
+        want = self._tokens(False, kv_dtype)
+        got = self._tokens(True, kv_dtype)
+        assert all(len(t) == 11 for t in got)
+        assert got == want
+
+    def test_chunk_longer_than_staging_refused(self):
+        from kubeflow_tpu.models import Llama, LlamaConfig
+
+        m = Llama(LlamaConfig.tiny(decode_staging=2))
+        params = {"params": m.init(
+            jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32)
+        )["params"]}
+        with pytest.raises(ValueError, match="decode_staging"):
+            ServingEngine(m, params,
+                          ServingConfig(max_batch=2, max_len=64,
+                                        decode_chunk=4))
