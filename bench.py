@@ -200,7 +200,7 @@ def bench_serving(args) -> None:
             # whole-layer-cache slice+writeback per scan step.
             max_seq_len=1024, scan_layers=False, remat=False,
             capacity_factor=args.capacity_factor or 2.0,
-            kv_cache_dtype=args.quantize_kv,
+            kv_cache_dtype=args.quantize_kv or "",
             decode_staging=args.decode_chunk,
         )
         model = Mixtral(cfg)
@@ -217,7 +217,7 @@ def bench_serving(args) -> None:
             # Unrolled for decode (+18% gen tok/s vs scanned: no stacked-
             # cache slice+writeback per scan step; BASELINE.md).
             max_seq_len=1024, scan_layers=False, remat=False,
-            kv_cache_dtype=args.quantize_kv,
+            kv_cache_dtype=args.quantize_kv or "",
             decode_staging=args.decode_chunk,
         )
         model = Llama(cfg)
@@ -299,10 +299,14 @@ def bench_serving8b(args) -> None:
     # stacked weights materialises the full 16G bf16 tree (measured OOM);
     # unrolled layers let XLA fuse the dequant per layer. Costs ~4-7 min
     # of one-time compile through the tunnel.
+    # int8 KV by default: with the staged flush it strictly wins at 8B
+    # (bs48 1,945 tok/s at BETTER TTFT than bf16 bs40's 1,631; ladder to
+    # 2,804 @ bs96). --quantize-kv '' selects the bf16 cache.
+    kv = args.quantize_kv if args.quantize_kv is not None else "int8"
     model, mcfg = get_model(
         "llama3-8b", param_dtype="bfloat16",
         max_seq_len=args.max_len, scan_layers=False, remat=False,
-        kv_cache_dtype=args.quantize_kv,
+        kv_cache_dtype=kv,
         decode_staging=args.decode_chunk,
     )
 
@@ -314,13 +318,12 @@ def bench_serving8b(args) -> None:
             decode=True,
         )["params"]}
 
-    # Measured ladder (r4, one v5e chip): bs8 417 -> bs16 701 -> bs24 894
-    # -> bs32 1056-1084 -> bs40 1234 tok/s (bs40 unlocked by the
-    # split-head prefill: the [k, bucket, 128k-vocab] logits tensor no
-    # longer materialises; bs48 still exceeds HBM at max_len 512 —
-    # --quantize-kv int8 runs it at 992, and is what makes max_len 1024
-    # possible at all: 590 tok/s at bs24 x 512-token prompts).
-    bs = args.batch_size or 40
+    # Measured ladder (r4, one v5e chip, staged decode + int8 KV):
+    # bs48 1,945 (TTFT 3.8s, BELOW the round-start record's 4.4s SLO) ->
+    # 64 2,152 -> 80 2,509 -> 96 2,804 -> 112 OOM. bf16-KV tops at bs40
+    # 1,631. int8 KV is also what makes max_len 1024 x 512-token prompts
+    # possible at all: 898 tok/s at bs24.
+    bs = args.batch_size or 48
     requests = args.requests or 2 * bs
     bucket = 1 << (args.prompt_len - 1).bit_length()
     engine = ServingEngine(
@@ -333,6 +336,7 @@ def bench_serving8b(args) -> None:
             prefill_buckets=(bucket,),
         ),
     )
+    kv_note = {"quantize_kv": kv} if kv else {}
     rng = np.random.default_rng(0)
     prompts = [
         rng.integers(1, mcfg.vocab_size, size=args.prompt_len).tolist()
@@ -369,6 +373,7 @@ def bench_serving8b(args) -> None:
         requests=requests, batch=bs,
         prompt_len=args.prompt_len, gen_len=args.gen_len,
         decode_chunk=args.decode_chunk, max_len=args.max_len,
+        **kv_note,
     )
 
 
@@ -669,8 +674,11 @@ def main() -> None:
                    help="serving8b engine max_len (KV-cache bound)")
     p.add_argument("--quantize", default="", choices=["", "int8"],
                    help="serving weight-only quantization")
-    p.add_argument("--quantize-kv", default="", choices=["", "int8"],
-                   help="serving KV-cache quantization (halves KV HBM)")
+    p.add_argument("--quantize-kv", default=None, choices=["", "int8"],
+                   help="serving KV-cache quantization (halves KV HBM). "
+                        "Default: int8 for serving8b (strictly wins with "
+                        "staged flush), off for the small-model serving "
+                        "benches")
     p.add_argument("--trace-dir", default="",
                    help="write a jax.profiler trace of the timed steps")
     # Round-3 measured defaults (decisive same-session sweep, min-of-3):
