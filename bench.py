@@ -200,15 +200,15 @@ def bench_serving(args) -> None:
             # whole-layer-cache slice+writeback per scan step.
             max_seq_len=1024, scan_layers=False, remat=False,
             capacity_factor=args.capacity_factor or 2.0,
-            kv_cache_dtype=args.quantize_kv or "",
+            kv_cache_dtype=args.quantize_kv
+            if args.quantize_kv is not None else "int8",
             decode_staging=args.decode_chunk,
         )
         model = Mixtral(cfg)
         metric = "mixtral_moe_serving_tokens_per_sec_per_chip"
         baseline = BASELINES["serving_mixtral"]
-        # r4 staged-decode sweep: bs32 5,343 (TTFT 0.90s) -> 64 10,452
-        # (TTFT 0.93s) -> staged flush keeps TTFT flat to 64; 64 is
-        # strictly better under the same SLO.
+        # r4 final sweep (staged decode + int8 KV, the default): bs64
+        # 10,646 (TTFT 0.90s); bf16 KV 10,452.
         default_bs = 64
     else:
         cfg = LlamaConfig(
@@ -217,15 +217,17 @@ def bench_serving(args) -> None:
             # Unrolled for decode (+18% gen tok/s vs scanned: no stacked-
             # cache slice+writeback per scan step; BASELINE.md).
             max_seq_len=1024, scan_layers=False, remat=False,
-            kv_cache_dtype=args.quantize_kv or "",
+            kv_cache_dtype=args.quantize_kv
+            if args.quantize_kv is not None else "int8",
             decode_staging=args.decode_chunk,
         )
         model = Llama(cfg)
         metric = "llama_700m_serving_tokens_per_sec_per_chip"
         baseline = BASELINES["serving"]
-        # r4 staged-decode sweep: bs24 3,742 (TTFT 0.95s) -> 48 5,559
-        # (TTFT 1.23s — the round-start record's SLO at 2.9x its tokens)
-        # -> 96 6,548 (TTFT 2.1s); 48 balances the SLO.
+        # r4 final sweep (staged decode + int8 KV, the default): bs48
+        # 6,558 (TTFT 1.08s — the round-start record served 1,948 at
+        # 1.13s) -> 96 9,058 (1.56s) -> 160 9,875 (2.4s); 48 balances
+        # the SLO. bf16 KV (--quantize-kv ''): bs48 5,559.
         default_bs = 48
     params = {"params": model.init(
         jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32)
@@ -676,9 +678,9 @@ def main() -> None:
                    help="serving weight-only quantization")
     p.add_argument("--quantize-kv", default=None, choices=["", "int8"],
                    help="serving KV-cache quantization (halves KV HBM). "
-                        "Default: int8 for serving8b (strictly wins with "
-                        "staged flush), off for the small-model serving "
-                        "benches")
+                        "Default int8 for every serving bench — with the "
+                        "staged flush it wins on throughput AND TTFT at "
+                        "every measured scale; '' selects the bf16 cache")
     p.add_argument("--trace-dir", default="",
                    help="write a jax.profiler trace of the timed steps")
     # Round-3 measured defaults (decisive same-session sweep, min-of-3):
