@@ -457,6 +457,7 @@ class TpuJobController(Controller):
         if cache_key in self._hbm_cache:
             return self._hbm_cache[cache_key]
         try:
+            hp = json.loads(env.get("KFTPU_HPARAMS", "{}") or "{}")
             rep = analytic_report(
                 job.spec.model, job.spec.slice_type,
                 AxisSpec(dp=m.dp, pp=m.pp, ep=m.ep, fsdp=m.fsdp,
@@ -465,9 +466,8 @@ class TpuJobController(Controller):
                 global_batch=int(
                     env.get("KFTPU_BATCH_PER_HOST", "8")) * n_hosts,
                 seq_len=int(env.get("KFTPU_SEQ_LEN", "1024")),
-                mu_dtype=str(json.loads(
-                    env.get("KFTPU_HPARAMS", "{}") or "{}"
-                ).get("mu_dtype", "")),
+                mu_dtype=str(hp.get("mu_dtype", "")),
+                optimizer=str(hp.get("optimizer", "adamw")),
                 model_kw=json.loads(
                     env.get("KFTPU_MODEL_KW", "{}") or "{}"),
             )

@@ -9,8 +9,12 @@ TPU-first design:
   host-side cache surgery and no per-request ``model.init``.
 - Per-slot cache indices (models.llama decode cache) let every slot sit at
   a different position — the core of continuous batching.
-- Sampling (greedy / temperature) happens on-device inside the compiled
-  step; only generated token ids cross to host each step.
+- Sampling (greedy / temperature / top-k / top-p) happens on-device inside
+  the compiled step; only generated token ids cross to host each step.
+  top-k/top-p restrict support over a static candidate set
+  (``sample_candidates``, JetStream-style) so the step stays one compiled
+  program; a ``lax.cond`` skips the candidate work entirely when no active
+  slot asks for it.
 - With a ``mesh``, params are device_put into their logical shardings and
   the KV cache is laid out sharded: slot (batch) dim over dp/fsdp, KV-head
   dim over tp — decode attention and the MLPs partition the same way the
@@ -49,6 +53,8 @@ class GenerationRequest:
     prompt: List[int]
     max_new_tokens: int = 32
     temperature: float = 0.0          # 0 => greedy
+    top_k: int = 0                    # 0 => no top-k restriction
+    top_p: float = 1.0                # 1.0 => no nucleus restriction
     eos_token: Optional[int] = None
     request_id: int = 0
     submitted_at: float = 0.0
@@ -98,6 +104,12 @@ class ServingConfig:
     # (depth-1)*decode_chunk extra speculative tokens per finished
     # sequence. 1 = fully synchronous.
     pipeline_depth: int = 2
+    # Static candidate-set size for top-k/top-p sampling: restricted
+    # sampling draws from the lax.top_k(logits, sample_candidates) set
+    # (requests asking top_k > this are clamped to it; top-p mass is
+    # computed within it). Keeps the decode step ONE compiled program with
+    # static shapes — the TPU answer to per-request dynamic vocab sorts.
+    sample_candidates: int = 64
 
 
 @dataclasses.dataclass
@@ -577,7 +589,7 @@ class ServingEngine:
                     jnp.full((k,), bucket, jnp.int32),
                     jnp.zeros((k,), jnp.int32),
                     sub,
-                    jnp.zeros((k,), jnp.float32),
+                    jnp.zeros((k, 3), jnp.float32),
                 )
                 toks.block_until_ready()
             B = self.cfg.max_batch
@@ -587,7 +599,7 @@ class ServingEngine:
                 jnp.zeros((B, 1), jnp.int32),
                 jnp.full((B, 1), bucket, jnp.int32),
                 sub,
-                jnp.zeros((B,), jnp.float32),
+                jnp.zeros((B, 3), jnp.float32),
             )
             np.asarray(toks)      # host fetch = reliable sync on remote TPUs
         # Dummy rows polluted the cache (junk K/V, advanced indices):
@@ -649,7 +661,7 @@ class ServingEngine:
         return jax.tree.map(dq, params, self._scales, self._qflags)
 
     def _prefill_step(self, params, cache, tokens, lengths, slot_idxs,
-                      rng, temps):
+                      rng, samp):
         """Whole group prefill as one program: run the [k, bucket] padded
         prompts against fresh zero cache rows, then scatter the rows into
         the donated batched cache at ``slot_idxs``. Pad tokens beyond each
@@ -725,7 +737,7 @@ class ServingEngine:
         # Sample on device (same scheme as decode): ONE k-int transfer to
         # host instead of per-row slice+argmax round trips.
         toks = self._sample_logits(last_logits.astype(jnp.float32),
-                                   rng, temps)
+                                   rng, samp)
         return toks, cache
 
     def _prefill_group(self, bucket: int, group: List[tuple]) -> None:
@@ -739,37 +751,84 @@ class ServingEngine:
         tokens = np.zeros((k, bucket), np.int32)
         lengths = np.zeros((k,), np.int32)
         slot_idxs = np.zeros((k,), np.int32)
-        temps = np.zeros((k,), np.float32)
+        samp = np.zeros((k, 3), np.float32)
         for row, (i, req) in enumerate(group):
             tokens[row, : len(req.prompt)] = req.prompt
             lengths[row] = len(req.prompt)
             slot_idxs[row] = i
-            temps[row] = req.temperature
+            samp[row] = self._samp_row(req)
         for row in range(len(group), k):          # pad: repeat row 0
             tokens[row] = tokens[0]
             lengths[row] = lengths[0]
             slot_idxs[row] = slot_idxs[0]
-            temps[row] = temps[0]
+            samp[row] = samp[0]
         self._rng, sub = jax.random.split(self._rng)
         with self._mesh_ctx():
             toks, self._cache = fn(
                 self.params, self._cache, jnp.asarray(tokens),
                 jnp.asarray(lengths), jnp.asarray(slot_idxs),
-                sub, jnp.asarray(temps),
+                sub, jnp.asarray(samp),
             )
         toks = np.asarray(toks)
         # First generated token per request from its prefill logits.
         for row, (i, req) in enumerate(group):
             self._record_token(i, int(toks[row]))
 
-    def _sample_logits(self, logits, rng, temps):
+    def _sample_logits(self, logits, rng, samp):
+        """On-device sampling. ``samp`` is [B, 3] f32 rows of
+        (temperature, top_k, top_p) — one packed array so the jitted step
+        signatures stay fixed as sampling modes grow.
+
+        Order matches the common convention: temperature scales logits,
+        then top-k cuts the support, then top-p (nucleus) trims it to the
+        smallest prefix holding >= top_p probability mass (the first
+        candidate always survives). Restricted sampling runs over the
+        static lax.top_k candidate set (cfg.sample_candidates) and only
+        when some active row asks for it — the lax.cond keeps pure
+        greedy / plain-temperature decode at its old cost."""
+        temps, top_ks, top_ps = samp[:, 0], samp[:, 1], samp[:, 2]
         greedy = jnp.argmax(logits, axis=-1)
-        gumbel = jax.random.gumbel(rng, logits.shape)
         temps_safe = jnp.maximum(temps, 1e-6)[:, None]
-        sampled = jnp.argmax(logits / temps_safe + gumbel, axis=-1)
+
+        def plain(r):
+            gumbel = jax.random.gumbel(r, logits.shape)
+            return jnp.argmax(logits / temps_safe + gumbel, axis=-1)
+
+        def restricted(r):
+            C = min(int(self.cfg.sample_candidates), logits.shape[-1])
+            vals, idx = jax.lax.top_k(logits, C)       # [B, C]
+            v = vals / temps_safe
+            pos = jnp.arange(C)[None, :]
+            ks = top_ks.astype(jnp.int32)
+            k_eff = jnp.where((ks <= 0) | (ks > C), C, ks)[:, None]
+            mask = pos < k_eff
+            p = jax.nn.softmax(jnp.where(mask, v, -jnp.inf), axis=-1)
+            cum = jnp.cumsum(p, axis=-1)
+            # Keep tokens whose preceding cumulative mass is < top_p; the
+            # first candidate has 0 preceding mass, so it always survives
+            # (top_p <= 0 degenerates to argmax-of-candidates).
+            mask = mask & ((cum - p) < jnp.maximum(
+                top_ps, 1e-6)[:, None])
+            gumbel = jax.random.gumbel(r, v.shape)
+            ch = jnp.argmax(jnp.where(mask, v + gumbel, -jnp.inf), axis=-1)
+            pick = jnp.take_along_axis(idx, ch[:, None], axis=-1)[:, 0]
+            # Rows that asked for NO restriction keep the full-vocab
+            # plain sample: without this, a plain-temperature request's
+            # distribution would be truncated to the candidate set
+            # whenever a top-k/top-p request shares the batch — output
+            # depending on unrelated neighbours.
+            wants = (top_ks > 0) | (top_ps < 1.0)
+            return jnp.where(wants, pick, plain(r))
+
+        need = jnp.any((temps > 0) & ((top_ks > 0) | (top_ps < 1.0)))
+        sampled = jax.lax.cond(need, restricted, plain, rng)
         return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
-    def _decode_step(self, params, cache, tokens, positions, rng, temps):
+    @staticmethod
+    def _samp_row(req: "GenerationRequest") -> tuple:
+        return (req.temperature, float(req.top_k), req.top_p)
+
+    def _decode_step(self, params, cache, tokens, positions, rng, samp):
         """Decode ``decode_chunk`` tokens in one device program: a lax.scan
         whose carry is (last token, position, cache) — one dispatch per
         chunk instead of per token. With a staging-enabled model
@@ -793,7 +852,7 @@ class ServingEngine:
                     {"params": mat["params"], "cache": cache_c}, toks,
                     positions=pos, decode=True, mutable=["cache"], **kw,
                 )
-            nxt = self._sample_logits(logits[:, 0], rng_k, temps)
+            nxt = self._sample_logits(logits[:, 0], rng_k, samp)
             return (nxt[:, None], pos + 1, mut["cache"]), nxt
 
         K = self.cfg.decode_chunk
@@ -869,11 +928,11 @@ class ServingEngine:
         host round trip between the two dispatches."""
         B = self.cfg.max_batch
         positions = np.zeros((B, 1), np.int32)
-        temps = np.zeros((B,), np.float32)
+        samp = np.zeros((B, 3), np.float32)
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
-            temps[i] = slot.req.temperature
+            samp[i] = self._samp_row(slot.req)
         if chain is not None:
             tokens_dev = chain.out[:, -1:]
             positions = chain.positions + self.cfg.decode_chunk
@@ -889,7 +948,7 @@ class ServingEngine:
         with self._mesh_ctx():
             toks, self._cache = self._decode_fn(
                 self.params, self._cache, tokens_dev,
-                jnp.asarray(positions), sub, jnp.asarray(temps),
+                jnp.asarray(positions), sub, jnp.asarray(samp),
             )
         # Hardware-independent cost metric: dispatches/token pins the part
         # of serving latency a ~110ms-per-dispatch tunnel multiplies.

@@ -6,7 +6,8 @@ testing/test_tf_serving.py:60-156). Here the front door is a thin stdlib
 HTTP app over the continuous-batching engine:
 
   POST /v1/generate   {"tokens": [...], "max_new_tokens": N,
-                       "temperature": t, "eos_token": id}
+                       "temperature": t, "top_k": k, "top_p": p,
+                       "eos_token": id}
                       -> {"tokens": [...], "ttft_s": ..., "latency_s": ...}
                       with "stream": true -> NDJSON chunks: {"tokens":
                       [delta...]}* then {"done": true, ...metadata}
@@ -161,6 +162,10 @@ class ServingServer:
             kw["max_new_tokens"] = int(req.body["max_new_tokens"])
         if "temperature" in req.body:
             kw["temperature"] = float(req.body["temperature"])
+        if "top_k" in req.body:
+            kw["top_k"] = int(req.body["top_k"])
+        if "top_p" in req.body:
+            kw["top_p"] = float(req.body["top_p"])
         if "eos_token" in req.body:
             kw["eos_token"] = int(req.body["eos_token"])
         stream = bool(req.body.get("stream", False))

@@ -139,6 +139,7 @@ def cmd_plan(args) -> int:
                 "--seq-len", str(seq_len),
                 "--model-kw", json.dumps(model_kw),
                 "--mu-dtype", str(hparams.get("mu_dtype", "")),
+                "--optimizer", str(hparams.get("optimizer", "adamw")),
             ]
             chips = st.num_chips * job.spec.num_slices
             sub_env = dict(os.environ)
@@ -163,6 +164,7 @@ def cmd_plan(args) -> int:
                 num_slices=job.spec.num_slices,
                 global_batch=global_batch, seq_len=seq_len,
                 mu_dtype=str(hparams.get("mu_dtype", "")),
+                optimizer=str(hparams.get("optimizer", "adamw")),
                 model_kw=model_kw,
             ).to_dict()
         reports.append(rep)

@@ -174,6 +174,7 @@ def analytic_report(
     mu_dtype: str = "",
     param_dtype: Optional[str] = None,
     model_kw: Optional[dict] = None,
+    optimizer: str = "adamw",
     rules=None,
 ) -> CapacityReport:
     """Device-free per-chip HBM estimate for a registry LM.
@@ -181,8 +182,11 @@ def analytic_report(
     Exact terms (from the abstract param tree + sharding rules):
       params        size x itemsize / shard_factor per leaf
       grads         params-shaped in the param dtype (value_and_grad)
-      opt_state     adamw: mu in ``mu_dtype`` + nu in f32, sharded like
-                    params (train.trainer._f32_moments keeps nu f32)
+      opt_state     per TrainConfig.optimizer family — adamw: mu in
+                    ``mu_dtype`` + nu in f32 (train.trainer._f32_moments
+                    keeps nu f32); lion/sgd: one moment; adafactor:
+                    factored f32 row+col stats for matrices, full f32
+                    for vectors — sharded like params
     Modeled term (transformer residual model, stated in ``detail``):
       activations   per-layer saved residuals under ``remat_policy``
                     + logits/CE buffers + a backward working-set term
@@ -245,8 +249,28 @@ def analytic_report(
         shards = _shard_factor(spec, extents)
         per_dev = leaf.size // shards
         params_b += per_dev * _dtype_bytes(leaf.dtype)
-        mu_b += per_dev * mu_itemsize
-        nu_b += per_dev * 4              # nu pinned f32 (_f32_moments)
+        if optimizer in ("adamw", "lion"):
+            mu_b += per_dev * mu_itemsize
+            if optimizer == "adamw":
+                nu_b += per_dev * 4      # nu pinned f32 (_f32_moments)
+        elif optimizer == "sgd":
+            mu_b += per_dev * 4          # momentum trace, f32
+        elif optimizer == "adafactor":
+            # Factored second moments, mirroring optax's rule: factor
+            # over the TWO LARGEST dims (stats = param shape minus one
+            # factored dim each) when the second-largest dim >= 128,
+            # else a full f32 stat. Factored stats REPLICATE (their
+            # shapes don't match any param, so the trainer's path-suffix
+            # matcher replicates them — no shard division); full stats
+            # are params-shaped and shard like the param.
+            shape = sorted(leaf.shape)
+            if len(shape) >= 2 and shape[-2] >= 128:
+                mu_b += (leaf.size // shape[-1]
+                         + leaf.size // shape[-2]) * 4
+            else:
+                mu_b += per_dev * 4
+        else:
+            raise ValueError(f"unknown optimizer {optimizer!r}")
     grads_b = params_b                   # grads in the param dtype
 
     act_b = 0
@@ -331,6 +355,7 @@ def aot_report(
     param_dtype: Optional[str] = None,
     model_kw: Optional[dict] = None,
     train_kw: Optional[dict] = None,
+    optimizer: str = "adamw",
 ) -> CapacityReport:
     """Compile the real sharded train step (no execution, no buffers) and
     read XLA's per-device buffer assignment. Ground truth for the analytic
@@ -359,7 +384,8 @@ def aot_report(
     model, cfg = _build_model(model_name, param_dtype, remat_policy,
                               model_kw)
     task = "lm" if hasattr(cfg, "vocab_size") else "image"
-    tcfg = TrainConfig(task=task, mu_dtype=mu_dtype, **(train_kw or {}))
+    tcfg = TrainConfig(task=task, mu_dtype=mu_dtype, optimizer=optimizer,
+                       **(train_kw or {}))
     trainer = Trainer(model, tcfg, mesh)
 
     if task == "lm":
@@ -428,6 +454,7 @@ def _main(argv=None) -> int:
     p.add_argument("--seq-len", type=int, default=1024)
     p.add_argument("--remat-policy", default="")
     p.add_argument("--mu-dtype", default="")
+    p.add_argument("--optimizer", default="adamw")
     p.add_argument("--param-dtype", default="")
     p.add_argument("--model-kw", default="{}")
     p.add_argument("--aot", action="store_true")
@@ -453,6 +480,7 @@ def _main(argv=None) -> int:
         mu_dtype=args.mu_dtype,
         param_dtype=args.param_dtype or None,
         model_kw=_json.loads(args.model_kw or "{}"),
+        optimizer=args.optimizer or "adamw",
     )
     print(_json.dumps(rep.to_dict()))
     return 0

@@ -71,6 +71,15 @@ class TrainConfig:
     # only; ignored when the "vocab" axis is tp-sharded (the sharded path
     # needs the einsum + sharded logsumexp).
     loss_chunk: int = 0
+    # Optimizer family. All share the warmup-cosine schedule and global
+    # grad clip; per-family state/memory profiles differ and the capacity
+    # planner (topology/capacity.py) models them:
+    #   adamw     - mu + nu per param (2x, nu forced f32; see _f32_moments)
+    #   lion      - mu only (1x; the sign update tolerates bf16 mu)
+    #   adafactor - factored second moments (~O(rows+cols) per matrix):
+    #               the optimizer-memory lever for flagship-scale runs
+    #   sgd       - momentum buffer (1x)
+    optimizer: str = "adamw"
 
     def make_optimizer(self) -> optax.GradientTransformation:
         schedule = optax.warmup_cosine_decay_schedule(
@@ -80,13 +89,40 @@ class TrainConfig:
             decay_steps=max(self.total_steps, self.warmup_steps + 1),
             end_value=self.learning_rate * 0.1,
         )
-        return _f32_moments(optax.chain(
-            optax.clip_by_global_norm(self.grad_clip_norm),
-            optax.adamw(
+        if self.optimizer == "adamw":
+            opt = optax.adamw(
                 schedule, b1=self.b1, b2=self.b2,
                 weight_decay=self.weight_decay,
                 mu_dtype=self.mu_dtype or None,
-            ),
+            )
+        elif self.optimizer == "lion":
+            opt = optax.lion(
+                schedule, b1=self.b1, b2=self.b2,
+                weight_decay=self.weight_decay,
+                mu_dtype=self.mu_dtype or None,
+            )
+        elif self.optimizer == "adafactor":
+            # adafactor manages its own clipping/decay internally; the
+            # outer global-norm clip still applies first.
+            opt = optax.adafactor(
+                learning_rate=schedule,
+                weight_decay_rate=self.weight_decay or None,
+            )
+        elif self.optimizer == "sgd":
+            # optax.sgd carries no decay of its own; chain L2 so
+            # weight_decay means the same thing across families.
+            opt = optax.chain(
+                optax.add_decayed_weights(self.weight_decay),
+                optax.sgd(schedule, momentum=self.b1, nesterov=True),
+            )
+        else:
+            raise ValueError(
+                f"unknown optimizer {self.optimizer!r} "
+                "(adamw | lion | adafactor | sgd)"
+            )
+        return _f32_moments(optax.chain(
+            optax.clip_by_global_norm(self.grad_clip_norm),
+            opt,
         ))
 
 

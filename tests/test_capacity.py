@@ -40,6 +40,24 @@ class TestAnalytic:
         assert rep.opt_state == pytest.approx(8.03e9 * 6 / 16, rel=0.05)
         assert rep.total < 12 * GiB
 
+    def test_optimizer_families_order_opt_state(self):
+        """adamw (mu+nu) > lion/sgd (one moment) > adafactor (factored):
+        the planner models TrainConfig.optimizer, so an adafactor job can
+        admit where adamw is rejected."""
+        kw = dict(global_batch=16, seq_len=2048, param_dtype="bfloat16",
+                  remat_policy="qkv_attn")
+        reps = {name: analytic_report("llama3-8b", "v5e-16",
+                                      AxisSpec(fsdp=-1), optimizer=name,
+                                      **kw)
+                for name in ("adamw", "lion", "sgd", "adafactor")}
+        n = 8.03e9
+        assert reps["adamw"].opt_state == pytest.approx(n * 8 / 16, rel=0.05)
+        assert reps["lion"].opt_state == pytest.approx(n * 4 / 16, rel=0.05)
+        assert reps["sgd"].opt_state == pytest.approx(n * 4 / 16, rel=0.05)
+        # Factored stats are ~size/min(rows,cols) and replicate: tiny
+        # next to any moment tree, but nonzero.
+        assert 0 < reps["adafactor"].opt_state < reps["lion"].opt_state / 10
+
     def test_llama3_70b_rejected_on_v5e16(self):
         rep = analytic_report(
             "llama3-70b", "v5e-16", AxisSpec(fsdp=-1),

@@ -97,6 +97,44 @@ class TestServingEngine:
         eng.run()
         assert eng.result(a).tokens != eng.result(b).tokens
 
+    def test_top_k_one_matches_greedy(self, model_and_params):
+        """top_k=1 collapses sampling to argmax regardless of temperature:
+        the whole engine path (prefill first token + chunked decode) must
+        be token-exact against the greedy reference."""
+        model, params = model_and_params
+        eng = ServingEngine(model, params,
+                            ServingConfig(max_batch=1, max_len=128))
+        prompt = [3, 14, 15]
+        eng.submit(prompt, max_new_tokens=6, temperature=1.7, top_k=1)
+        res = eng.run()[0]
+        assert res.tokens == greedy_reference(model, params, prompt, 6)
+
+    def test_tiny_top_p_matches_greedy(self, model_and_params):
+        """top_p -> 0 keeps only the head of the nucleus (the first
+        candidate always survives), i.e. argmax."""
+        model, params = model_and_params
+        eng = ServingEngine(model, params,
+                            ServingConfig(max_batch=1, max_len=128))
+        prompt = [5, 6, 7, 8]
+        eng.submit(prompt, max_new_tokens=5, temperature=2.0, top_p=1e-6)
+        res = eng.run()[0]
+        assert res.tokens == greedy_reference(model, params, prompt, 5)
+
+    def test_greedy_rows_unaffected_by_sampling_neighbours(
+            self, model_and_params):
+        """A greedy request sharing the batch with a top-k sampler must
+        still produce the greedy tokens (the cond takes the restricted
+        branch for the whole batch; the per-row where protects temp=0)."""
+        model, params = model_and_params
+        eng = ServingEngine(model, params,
+                            ServingConfig(max_batch=2, max_len=128))
+        prompt = [9, 10, 11]
+        g = eng.submit(prompt, max_new_tokens=6)
+        eng.submit([1, 2, 3], max_new_tokens=6, temperature=1.5, top_k=4)
+        eng.run()
+        ref = greedy_reference(model, params, prompt, 6)
+        assert eng.result(g).tokens == ref
+
     def test_rejects_oversized_prompt(self, model_and_params):
         model, params = model_and_params
         eng = ServingEngine(model, params,
@@ -115,6 +153,84 @@ class TestServingEngine:
         assert res.latency_s > 0
         assert 0 < res.ttft_s <= res.latency_s
         assert eng.tokens_generated == 4
+
+
+class TestSampleLogits:
+    """Unit tier for the on-device sampler: crafted logits, many draws."""
+
+    @pytest.fixture(scope="class")
+    def eng(self, model_and_params):
+        model, params = model_and_params
+        return ServingEngine(model, params,
+                             ServingConfig(max_batch=1, max_len=128))
+
+    def _draws(self, eng, logits, samp, n=64):
+        out = []
+        for i in range(n):
+            out.append(int(eng._sample_logits(
+                jnp.asarray(logits), jax.random.PRNGKey(i),
+                jnp.asarray(samp, jnp.float32))[0]))
+        return out
+
+    def test_top_k_support(self, eng):
+        logits = np.array([[5.0, 4.9, 4.8, -2.0, -3.0, -50.0]])
+        draws = self._draws(eng, logits, [[3.0, 3.0, 1.0]])
+        assert set(draws) <= {0, 1, 2}
+        assert len(set(draws)) > 1  # hot temperature really samples
+
+    def test_top_p_support(self, eng):
+        # softmax ~ [0.64, 0.24, 0.09, ...]: nucleus at 0.5 is {0} (mass
+        # before token 1 is 0.64 >= 0.5), at 0.7 it is {0, 1}.
+        logits = np.array([[4.0, 3.0, 2.0, -1.0, -1.0, -1.0]])
+        assert set(self._draws(eng, logits, [[1.0, 0.0, 0.5]])) == {0}
+        draws = self._draws(eng, logits, [[1.0, 0.0, 0.7]])
+        assert set(draws) <= {0, 1} and len(set(draws)) == 2
+
+    def test_combined_top_k_top_p(self, eng):
+        # top_k=2 cuts to {0,1}; renormalised p ~ [0.73, 0.27] so
+        # top_p=0.9 keeps both; both should appear at temp 1.
+        logits = np.array([[4.0, 3.0, 2.9, 2.8, -1.0, -1.0]])
+        draws = self._draws(eng, logits, [[1.0, 2.0, 0.9]])
+        assert set(draws) == {0, 1}
+
+    def test_per_row_independence(self, eng):
+        """Rows carry independent settings: greedy / top-k / plain-temp
+        rows in one batch each honour their own mode."""
+        logits = np.tile(
+            np.array([[1.0, 5.0, 4.95, 4.9, -9.0, -9.0]]), (3, 1))
+        samp = [[0.0, 0.0, 1.0],    # greedy -> always 1
+                [2.0, 2.0, 1.0],    # top-k 2 -> {1, 2}
+                [5.0, 0.0, 1.0]]    # hot plain -> anything but -9 rows
+        rows = [set() for _ in range(3)]
+        for i in range(64):
+            toks = np.asarray(eng._sample_logits(
+                jnp.asarray(logits), jax.random.PRNGKey(i),
+                jnp.asarray(samp, jnp.float32)))
+            for r in range(3):
+                rows[r].add(int(toks[r]))
+        assert rows[0] == {1}
+        assert rows[1] <= {1, 2} and len(rows[1]) == 2
+        assert len(rows[2]) >= 3
+
+    def test_plain_row_keeps_full_vocab_in_mixed_batch(self, eng):
+        """A plain-temperature row co-batched with a top-k row must still
+        sample the FULL vocab, not the top-``sample_candidates`` set the
+        restricted branch works over (regression: batch composition must
+        not change a request's distribution)."""
+        V = 128  # > sample_candidates (64)
+        logits = np.zeros((2, V), np.float32)
+        logits[0, :64] = 2.0   # plain row: candidate set would be 0..63,
+        # but the e^0 tail keeps ~40% mass at temp 5
+        logits[1, 0] = 5.0
+        samp = [[5.0, 0.0, 1.0],   # hot plain row
+                [1.0, 2.0, 1.0]]   # top-k row forces the restricted branch
+        draws = set()
+        for i in range(64):
+            toks = np.asarray(eng._sample_logits(
+                jnp.asarray(logits), jax.random.PRNGKey(i),
+                jnp.asarray(samp, jnp.float32)))
+            draws.add(int(toks[0]))
+        assert any(t >= 64 for t in draws), draws
 
 
 class TestChunkedDecode:
@@ -360,6 +476,19 @@ class TestServingServer:
             assert out["tokens"] == ref
             assert out["prompt_len"] == len(prompt)
             assert out["latency_s"] >= out["ttft_s"] > 0
+
+            # Sampling controls ride the same surface: top_k=1 at hot
+            # temperature must still reproduce the greedy tokens.
+            req = urllib.request.Request(
+                f"{base}/v1/generate",
+                data=json.dumps({
+                    "tokens": prompt, "max_new_tokens": 6,
+                    "temperature": 1.8, "top_k": 1, "top_p": 0.95,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            out = json.load(urllib.request.urlopen(req))
+            assert out["tokens"] == ref
         finally:
             server.stop()
 

@@ -85,6 +85,39 @@ class TestLmTrainer:
         assert np.isfinite(metrics["loss"])
 
 
+class TestOptimizerFamilies:
+    """TrainConfig.optimizer selects the optimizer; every family must
+    train (finite, decreasing loss) with params sharded over the same
+    mesh, and params-shaped moment subtrees must inherit param shardings
+    via the path-suffix matcher (factored/scalar stats replicate)."""
+
+    @pytest.mark.parametrize("name", ["lion", "adafactor", "sgd"])
+    def test_family_trains_sharded(self, mesh8, name):
+        model = Llama(LlamaConfig.tiny())
+        lr = {"lion": 1e-3, "adafactor": 1e-2, "sgd": 1e-2}[name]
+        trainer = Trainer(
+            model,
+            TrainConfig(task="lm", optimizer=name, learning_rate=lr,
+                        warmup_steps=2, total_steps=30),
+            mesh8,
+        )
+        batch = trainer.shard_batch(_lm_batch())
+        state = trainer.init_state(jax.random.PRNGKey(0), batch)
+        losses = []
+        for _ in range(12):
+            state, metrics = trainer.step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert np.isfinite(losses).all(), (name, losses)
+        assert losses[-1] < losses[0], (name, losses)
+        # Params stay sharded regardless of optimizer family.
+        mlp = state.params["layer_0"]["mlp"]["gate_proj"]["kernel"]
+        assert mlp.addressable_shards[0].data.size == mlp.size // 4
+
+    def test_unknown_family_rejected(self):
+        with pytest.raises(ValueError, match="unknown optimizer"):
+            TrainConfig(optimizer="rmsprop").make_optimizer()
+
+
 class TestImageTrainer:
     def test_resnet_loss_decreases(self, mesh8):
         model = ResNet(ResNetConfig.tiny())
