@@ -520,6 +520,36 @@ def _auto_interpret(interpret: Optional[bool]) -> bool:
 IntLike = Union[int, jax.Array]
 
 
+def _block_default(env: str, fallback: int) -> int:
+    """Benchmark/sweep override for the default block sizes
+    (KFTPU_FLASH_BLOCK_Q / KFTPU_FLASH_BLOCK_KV). Read per call — the
+    values are trace-time constants, so a sweep can rebuild its jitted
+    step per setting in one process."""
+    import os
+
+    v = os.environ.get(env, "")
+    if not v:
+        return fallback
+    try:
+        n = int(v)
+    except ValueError:
+        raise ValueError(f"{env}={v!r} is not an integer") from None
+    if n <= 0:
+        raise ValueError(f"{env}={v!r} must be positive (unset it to use "
+                         f"the default {fallback})")
+    return n
+
+
+def default_blocks(sq: int, skv: int) -> Tuple[int, int]:
+    """The (block_q, block_kv) the public entry points resolve when the
+    caller passes nothing — including any KFTPU_FLASH_BLOCK_* override.
+    Support checks elsewhere (ring attention's path selection) MUST use
+    this rather than hardcoding 1024, or an env sweep would desync path
+    selection from the kernel's actual blocking."""
+    return (min(_block_default("KFTPU_FLASH_BLOCK_Q", 1024), sq),
+            min(_block_default("KFTPU_FLASH_BLOCK_KV", 1024), skv))
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -541,6 +571,8 @@ def flash_attention(
     block cleanly."""
     B, Sq, H, D = q.shape
     _, Skv, Hkv, _ = k.shape
+    block_q = _block_default("KFTPU_FLASH_BLOCK_Q", block_q)
+    block_kv = _block_default("KFTPU_FLASH_BLOCK_KV", block_kv)
     bq, bkv = min(block_q, Sq), min(block_kv, Skv)
     if not _supported(Sq, Skv, H, Hkv, bq, bkv):
         from kubeflow_tpu.ops.attention import causal_mask, mha_reference
@@ -583,6 +615,8 @@ def flash_attention_lse(
     blocks with ``merge_attention_blocks``."""
     B, Sq, H, D = q.shape
     _, Skv, Hkv, _ = k.shape
+    block_q = _block_default("KFTPU_FLASH_BLOCK_Q", block_q)
+    block_kv = _block_default("KFTPU_FLASH_BLOCK_KV", block_kv)
     bq, bkv = min(block_q, Sq), min(block_kv, Skv)
     if not _supported(Sq, Skv, H, Hkv, bq, bkv):
         return None

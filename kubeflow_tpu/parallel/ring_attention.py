@@ -92,11 +92,14 @@ def _block_attn_update(
 
 
 def _ring_flash_supported(q, k) -> bool:
-    # Mirrors the default block choice inside flash_attention_lse.
-    from kubeflow_tpu.ops.flash_attention import _supported
+    # Resolves the SAME blocks flash_attention_lse will use (including
+    # KFTPU_FLASH_BLOCK_* overrides) so path selection never drifts from
+    # the kernel's actual blocking.
+    from kubeflow_tpu.ops.flash_attention import _supported, default_blocks
     B, Sq, H, D = q.shape
     _, Skv, Hkv, _ = k.shape
-    return _supported(Sq, Skv, H, Hkv, min(1024, Sq), min(1024, Skv))
+    bq, bkv = default_blocks(Sq, Skv)
+    return _supported(Sq, Skv, H, Hkv, bq, bkv)
 
 
 def ring_attention(

@@ -795,6 +795,11 @@ class ServingEngine:
             return jnp.argmax(logits / temps_safe + gumbel, axis=-1)
 
         def restricted(r):
+            # Distinct subkeys for the candidate draw and the nested
+            # plain draw: JAX's counter-based bits alias by flat index,
+            # so reusing r would correlate restricted rows' noise with
+            # plain rows' low vocab positions.
+            r, r_plain = jax.random.split(r)
             C = min(int(self.cfg.sample_candidates), logits.shape[-1])
             vals, idx = jax.lax.top_k(logits, C)       # [B, C]
             v = vals / temps_safe
@@ -818,7 +823,7 @@ class ServingEngine:
             # whenever a top-k/top-p request shares the batch — output
             # depending on unrelated neighbours.
             wants = (top_ks > 0) | (top_ps < 1.0)
-            return jnp.where(wants, pick, plain(r))
+            return jnp.where(wants, pick, plain(r_plain))
 
         need = jnp.any((temps > 0) & ((top_ks > 0) | (top_ps < 1.0)))
         sampled = jax.lax.cond(need, restricted, plain, rng)

@@ -109,11 +109,13 @@ class TrainConfig:
                 weight_decay_rate=self.weight_decay or None,
             )
         elif self.optimizer == "sgd":
-            # optax.sgd carries no decay of its own; chain L2 so
-            # weight_decay means the same thing across families.
+            # Decoupled decay to match adamw/lion semantics: the wd term
+            # joins AFTER the momentum trace (it never accumulates in the
+            # buffer) and is scaled by the same lr schedule.
             opt = optax.chain(
+                optax.trace(decay=self.b1, nesterov=True),
                 optax.add_decayed_weights(self.weight_decay),
-                optax.sgd(schedule, momentum=self.b1, nesterov=True),
+                optax.scale_by_learning_rate(schedule),
             )
         else:
             raise ValueError(
