@@ -105,7 +105,8 @@ def bench_train(args) -> None:
         model,
         TrainConfig(task="lm", warmup_steps=10, total_steps=1000,
                     attn_impl=args.attn, mu_dtype=args.mu_dtype,
-                    loss_chunk=args.loss_chunk),
+                    loss_chunk=args.loss_chunk,
+                    grad_accum_steps=args.grad_accum),
         mesh,
     )
     loader = None
@@ -708,6 +709,9 @@ def main() -> None:
     p.add_argument("--data-path", default="",
                    help="raw int32 token corpus for --loader native "
                         "('' = the loader's synthetic stream)")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="microbatch gradient accumulation for the train "
+                        "bench (TrainConfig.grad_accum_steps)")
     p.add_argument("--loss-chunk", type=int, default=0,
                    help="fuse lm_head+CE blockwise over this many tokens "
                         "(0 = off); frees the [B,S,V] logits buffer")

@@ -468,6 +468,7 @@ class TpuJobController(Controller):
                 seq_len=int(env.get("KFTPU_SEQ_LEN", "1024")),
                 mu_dtype=str(hp.get("mu_dtype", "")),
                 optimizer=str(hp.get("optimizer", "adamw")),
+                grad_accum=int(hp.get("grad_accum_steps", 1)),
                 model_kw=json.loads(
                     env.get("KFTPU_MODEL_KW", "{}") or "{}"),
             )

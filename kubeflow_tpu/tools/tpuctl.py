@@ -140,6 +140,7 @@ def cmd_plan(args) -> int:
                 "--model-kw", json.dumps(model_kw),
                 "--mu-dtype", str(hparams.get("mu_dtype", "")),
                 "--optimizer", str(hparams.get("optimizer", "adamw")),
+                "--grad-accum", str(hparams.get("grad_accum_steps", 1)),
             ]
             chips = st.num_chips * job.spec.num_slices
             sub_env = dict(os.environ)
@@ -165,6 +166,7 @@ def cmd_plan(args) -> int:
                 global_batch=global_batch, seq_len=seq_len,
                 mu_dtype=str(hparams.get("mu_dtype", "")),
                 optimizer=str(hparams.get("optimizer", "adamw")),
+                grad_accum=int(hparams.get("grad_accum_steps", 1)),
                 model_kw=model_kw,
             ).to_dict()
         reports.append(rep)

@@ -175,6 +175,7 @@ def analytic_report(
     param_dtype: Optional[str] = None,
     model_kw: Optional[dict] = None,
     optimizer: str = "adamw",
+    grad_accum: int = 1,
     rules=None,
 ) -> CapacityReport:
     """Device-free per-chip HBM estimate for a registry LM.
@@ -242,6 +243,7 @@ def analytic_report(
     params_b = 0
     mu_b = 0
     nu_b = 0
+    f32_acc_b = 0
     mu_itemsize = _dtype_bytes(mu_dtype or "float32")
     for path, leaf in params_leaves:
         key = tuple(str(k) for k in path)
@@ -249,6 +251,8 @@ def analytic_report(
         shards = _shard_factor(spec, extents)
         per_dev = leaf.size // shards
         params_b += per_dev * _dtype_bytes(leaf.dtype)
+        if grad_accum > 1:
+            f32_acc_b += per_dev * 4
         if optimizer in ("adamw", "lion"):
             mu_b += per_dev * mu_itemsize
             if optimizer == "adamw":
@@ -271,13 +275,16 @@ def analytic_report(
                 mu_b += per_dev * 4
         else:
             raise ValueError(f"unknown optimizer {optimizer!r}")
-    grads_b = params_b                   # grads in the param dtype
+    # Grads live in the param dtype; under microbatch accumulation
+    # (TrainConfig.grad_accum_steps) the f32 accumulator tree rides with
+    # them, while the activation model below shrinks by 1/K.
+    grads_b = params_b + f32_acc_b
 
     act_b = 0
     detail = ""
     if is_lm:
         act_bytes = 2                    # bf16 activations
-        B, S = global_batch, seq_len
+        B, S = max(1, global_batch // max(1, grad_accum)), seq_len
         E = cfg.embed_dim
         L = cfg.num_layers
         heads = getattr(cfg, "num_heads", 0) * getattr(cfg, "head_dim", 0)
@@ -356,6 +363,7 @@ def aot_report(
     model_kw: Optional[dict] = None,
     train_kw: Optional[dict] = None,
     optimizer: str = "adamw",
+    grad_accum: int = 1,
 ) -> CapacityReport:
     """Compile the real sharded train step (no execution, no buffers) and
     read XLA's per-device buffer assignment. Ground truth for the analytic
@@ -385,6 +393,7 @@ def aot_report(
                               model_kw)
     task = "lm" if hasattr(cfg, "vocab_size") else "image"
     tcfg = TrainConfig(task=task, mu_dtype=mu_dtype, optimizer=optimizer,
+                       grad_accum_steps=max(1, grad_accum),
                        **(train_kw or {}))
     trainer = Trainer(model, tcfg, mesh)
 
@@ -455,6 +464,7 @@ def _main(argv=None) -> int:
     p.add_argument("--remat-policy", default="")
     p.add_argument("--mu-dtype", default="")
     p.add_argument("--optimizer", default="adamw")
+    p.add_argument("--grad-accum", type=int, default=1)
     p.add_argument("--param-dtype", default="")
     p.add_argument("--model-kw", default="{}")
     p.add_argument("--aot", action="store_true")
@@ -481,6 +491,7 @@ def _main(argv=None) -> int:
         param_dtype=args.param_dtype or None,
         model_kw=_json.loads(args.model_kw or "{}"),
         optimizer=args.optimizer or "adamw",
+        grad_accum=args.grad_accum,
     )
     print(_json.dumps(rep.to_dict()))
     return 0

@@ -77,6 +77,15 @@ def env_config() -> dict:
         # (raw int32 dump). Default stays the in-process synthetic stream.
         "loader": os.environ.get("KFTPU_LOADER", ""),
         "data_path": os.environ.get("KFTPU_DATA_PATH", ""),
+        # Held-out evaluation: every eval_every steps (0 = off) run
+        # eval_batches batches through Trainer.evaluate. The eval stream
+        # is rebuilt from the same seed each time, so successive evals
+        # score the same held-out set (comparable across a run). A
+        # native-loader corpus for eval comes from KFTPU_EVAL_DATA_PATH;
+        # otherwise a synthetic stream on a seed disjoint from training.
+        "eval_every": int(os.environ.get("KFTPU_EVAL_EVERY", "0")),
+        "eval_batches": int(os.environ.get("KFTPU_EVAL_BATCHES", "8")),
+        "eval_data_path": os.environ.get("KFTPU_EVAL_DATA_PATH", ""),
     }
 
 
@@ -231,6 +240,25 @@ def run(cfg: dict) -> int:
     )
     state = trainer.init_state(jax.random.PRNGKey(0), batch)
 
+    def run_eval(st):
+        """Score the held-out set: a fresh iterator per call (same seed)
+        keeps successive evals comparable."""
+        if cfg["eval_data_path"]:
+            from kubeflow_tpu.train.native_loader import NativeTokenLoader
+
+            ev = NativeTokenLoader(
+                batch_size=batch_size, seq_len=cfg["seq_len"] + 1,
+                vocab_size=model_cfg.vocab_size,
+                token_file=cfg["eval_data_path"],
+            )
+        else:
+            ev = synthetic_text(SyntheticTextConfig(
+                batch_size=batch_size, seq_len=cfg["seq_len"],
+                vocab_size=model_cfg.vocab_size, seed=7919,
+            ))
+        batches = (next(ev) for _ in range(cfg["eval_batches"]))
+        return trainer.evaluate(st, batches)
+
     ckpt = None
     if cfg["checkpoint_dir"]:
         ckpt = CheckpointService(cfg["checkpoint_dir"])
@@ -271,6 +299,10 @@ def run(cfg: dict) -> int:
             log.info("trace written", kv={"dir": cfg["trace_dir"]})
         if ckpt is not None and (i + 1) % cfg["checkpoint_every"] == 0:
             ckpt.save(int(state.step), state)
+        if cfg["eval_every"] > 0 and (i + 1) % cfg["eval_every"] == 0:
+            em = run_eval(state)
+            log.info("eval", kv={"step": i + 1, **{
+                k: f"{v:.4f}" for k, v in em.items()}})
         if (i + 1) % 10 == 0:
             loss = float(metrics["loss"])
             tps = (
@@ -289,13 +321,22 @@ def run(cfg: dict) -> int:
         cfg["batch_per_host"] * cfg["num_processes"] * cfg["seq_len"]
         * (cfg["steps"] - start_step) / max(time.time() - t0, 1e-9)
     )
+    # Final held-out score: a COLLECTIVE computation over the gang mesh,
+    # so every process must participate (worker 0 alone would hang on the
+    # collectives); only worker 0 reports it.
+    final_eval = {}
+    if cfg["eval_every"] > 0 and ran_steps:
+        final_eval = run_eval(state)
     if cfg["process_id"] == 0:
-        # A resume at/past the final step runs zero steps and has no loss to
-        # report; omitting the key (rather than a sentinel) keeps the HPO
-        # controller from reading a fake objective into the study.
         report = {"tokens_per_sec": tokens_per_sec, "steps": cfg["steps"]}
+        # A resume at/past the final step runs zero steps and has no loss
+        # to report; omitting the key (rather than a sentinel) keeps the
+        # HPO controller from reading a fake objective into the study.
         if ran_steps:
             report["loss"] = float(metrics["loss"])
+        # eval_loss/eval_perplexity become TpuJob status.metrics, so a
+        # StudyJob can optimise validation loss instead of training loss.
+        report.update({f"eval_{k}": v for k, v in final_eval.items()})
         _report_termination(cfg["termination_log"], report)
     log.info(
         "training complete",

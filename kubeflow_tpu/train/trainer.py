@@ -71,6 +71,13 @@ class TrainConfig:
     # only; ignored when the "vocab" axis is tp-sharded (the sharded path
     # needs the einsum + sharded logsumexp).
     loss_chunk: int = 0
+    # >1 splits each step's batch into this many microbatches, scanned
+    # sequentially with gradients accumulated in f32 — the standard
+    # memory lever when the target global batch's activations exceed
+    # HBM (activation footprint scales by 1/K; one optimizer update per
+    # step, semantics identical to the full batch up to f32 summation).
+    # The batch dim must divide evenly. Modeled by the capacity planner.
+    grad_accum_steps: int = 1
     # Optimizer family. All share the warmup-cosine schedule and global
     # grad clip; per-family state/memory profiles differ and the capacity
     # planner (topology/capacity.py) models them:
@@ -177,6 +184,7 @@ class Trainer:
         )
         self.optimizer = train_cfg.make_optimizer()
         self._jit_step: Optional[Callable] = None
+        self._jit_eval: Optional[Callable] = None
         self._jit_init: Optional[Callable] = None
 
     # ---------------- init ----------------
@@ -369,15 +377,69 @@ class Trainer:
     def _train_step(self, state: TrainState, batch, rng):
         loss_fn = self._loss_lm if self.cfg.task == "lm" else self._loss_image
 
-        def wrapped(params):
-            with parallel_context(
-                mesh=self.mesh, rules=self.rules, attn_impl=self.cfg.attn_impl
-            ):
-                return loss_fn(params, state.extra_vars, batch, rng)
+        def grad_of(params, extra_vars, mb, r):
+            def wrapped(p):
+                with parallel_context(
+                    mesh=self.mesh, rules=self.rules,
+                    attn_impl=self.cfg.attn_impl,
+                ):
+                    return loss_fn(p, extra_vars, mb, r)
+            return jax.value_and_grad(wrapped, has_aux=True)(params)
 
-        (loss, (new_vars, metrics)), grads = jax.value_and_grad(
-            wrapped, has_aux=True
-        )(state.params)
+        K = self.cfg.grad_accum_steps
+        if K <= 1:
+            (loss, (new_vars, metrics)), grads = grad_of(
+                state.params, state.extra_vars, batch, rng)
+        else:
+            # Microbatch scan: grads accumulate in f32 (bf16 summation
+            # across K would lose low bits), extra_vars (BN stats) thread
+            # sequentially. Activations for one microbatch are live at a
+            # time — the memory lever. Each microbatch's (masked-mean)
+            # gradient and metrics are weighted by its VALID-token count,
+            # so the result matches the full-batch global normalisation
+            # even when padding is distributed unevenly across
+            # microbatches.
+            def split(x):
+                assert x.shape[0] % K == 0, (
+                    f"batch dim {x.shape[0]} not divisible by "
+                    f"grad_accum_steps {K}")
+                return x.reshape((K, x.shape[0] // K) + x.shape[1:])
+
+            micro = jax.tree.map(split, batch)
+            has_rng = rng is not None
+            rs = jax.random.split(rng, K) if has_rng \
+                else jnp.zeros((K,), jnp.uint32)
+
+            def weight_of(mb):
+                if self.cfg.task == "lm" and mb.get("mask") is not None:
+                    return mb["mask"][:, 1:].astype(jnp.float32).sum()
+                x = mb["inputs"]
+                n = x.shape[0] * (x.shape[1] - 1) \
+                    if self.cfg.task == "lm" else x.shape[0]
+                return jnp.float32(n)
+
+            def body(carry, xs):
+                acc, extra_vars, wsum = carry
+                mb, r = xs
+                (loss, (new_vars, metrics)), g = grad_of(
+                    state.params, extra_vars, mb, r if has_rng else None)
+                w = weight_of(mb)
+                acc = jax.tree.map(
+                    lambda a, gi: a + w * gi.astype(jnp.float32), acc, g)
+                return ((acc, {**extra_vars, **new_vars}, wsum + w),
+                        jax.tree.map(lambda m: w * m,
+                                     {"loss": loss, **metrics}))
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            (acc, new_vars, wsum), scans = jax.lax.scan(
+                body, (zeros, state.extra_vars, jnp.float32(0.0)),
+                (micro, rs))
+            wsum = jnp.maximum(wsum, 1e-9)
+            grads = jax.tree.map(lambda a: a / wsum, acc)
+            scans = jax.tree.map(lambda m: jnp.sum(m, axis=0) / wsum, scans)
+            loss = scans.pop("loss")
+            metrics = scans
         updates, new_opt = self.optimizer.update(
             grads, state.opt_state, state.params
         )
@@ -403,6 +465,80 @@ class Trainer:
     def step(self, state: TrainState, batch, rng=None) -> Tuple[TrainState, Dict]:
         with self.mesh:
             return self.compile_step()(state, batch, rng)
+
+    # ---------------- eval ----------------
+
+    def _eval_step(self, state: TrainState, batch):
+        """Pure evaluation metrics: CE without z-loss or aux terms (those
+        are optimization regularisers, not model quality), deterministic
+        routing (no rngs), BN in inference mode. No state is mutated."""
+        with parallel_context(
+            mesh=self.mesh, rules=self.rules, attn_impl=self.cfg.attn_impl
+        ):
+            variables = {"params": state.params, **state.extra_vars}
+            if self.cfg.task == "lm":
+                tokens = batch["inputs"]
+                inputs, labels = tokens[:, :-1], tokens[:, 1:]
+                mask = batch.get("mask")
+                if mask is not None:
+                    mask = mask[:, 1:]
+                if self._use_chunked_loss():
+                    # Same memory contract as the train step: a config
+                    # that needs loss_chunk to fit HBM must not OOM on
+                    # its own eval (the [B,S,V] logits never materialise).
+                    hidden, _ = self.model.apply(
+                        variables, inputs, mutable=["losses"],
+                        return_hidden=True,
+                    )
+                    B, S, E = hidden.shape
+                    loss, count, hits = chunked_cross_entropy(
+                        hidden.reshape(B * S, E),
+                        self._lm_head_kernel(state.params),
+                        labels.reshape(B * S),
+                        mask=None if mask is None else mask.reshape(B * S),
+                        block=self.cfg.loss_chunk,
+                    )
+                    acc = hits / count
+                else:
+                    logits, _ = self.model.apply(
+                        variables, inputs, mutable=["losses"]
+                    )
+                    loss, _ = cross_entropy_loss(logits, labels, mask=mask)
+                    acc = softmax_accuracy(logits, labels, mask=mask)
+            else:
+                logits = self.model.apply(
+                    variables, batch["inputs"], train=False
+                )
+                loss, _ = cross_entropy_loss(logits, batch["labels"])
+                acc = softmax_accuracy(logits, batch["labels"])
+        return {"loss": loss, "accuracy": acc}
+
+    def eval_step(self, state: TrainState, batch) -> Dict:
+        if self._jit_eval is None:
+            self._jit_eval = jax.jit(self._eval_step)
+        with self.mesh:
+            return self._jit_eval(state, batch)
+
+    def evaluate(self, state: TrainState, batches) -> Dict[str, float]:
+        """Mean metrics over an iterable of (host) batches; adds
+        perplexity for LM tasks. Batches are sharded here — pass raw
+        host arrays."""
+        import math
+
+        sums: Dict[str, float] = {}
+        n = 0
+        for b in batches:
+            m = self.eval_step(state, self.shard_batch(
+                {k: jnp.asarray(v) for k, v in b.items()}))
+            for k, v in m.items():
+                sums[k] = sums.get(k, 0.0) + float(v)
+            n += 1
+        if n == 0:
+            return {}
+        out = {k: v / n for k, v in sums.items()}
+        if self.cfg.task == "lm":
+            out["perplexity"] = math.exp(min(out["loss"], 30.0))
+        return out
 
     def shard_batch(self, batch: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
         sharding = NamedSharding(self.mesh, P(("dp", "fsdp")))

@@ -58,6 +58,19 @@ class TestAnalytic:
         # next to any moment tree, but nonzero.
         assert 0 < reps["adafactor"].opt_state < reps["lion"].opt_state / 10
 
+    def test_grad_accum_shrinks_activations(self):
+        """grad_accum=K models 1/K activation tokens plus the f32
+        accumulator tree riding with the grads."""
+        kw = dict(global_batch=16, seq_len=2048, param_dtype="bfloat16",
+                  remat_policy="qkv_attn")
+        base = analytic_report("llama3-8b", "v5e-16", AxisSpec(fsdp=-1),
+                               **kw)
+        acc = analytic_report("llama3-8b", "v5e-16", AxisSpec(fsdp=-1),
+                              grad_accum=4, **kw)
+        assert acc.activations < base.activations / 2
+        # grads gain the f32 accumulator: bf16 grads (2B) + f32 tree (4B)
+        assert acc.grads == pytest.approx(base.grads * 3, rel=0.01)
+
     def test_llama3_70b_rejected_on_v5e16(self):
         rep = analytic_report(
             "llama3-70b", "v5e-16", AxisSpec(fsdp=-1),

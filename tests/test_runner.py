@@ -56,6 +56,21 @@ class TestRunnerContract:
         )
         assert report["steps"] == 3
 
+    def test_eval_every_reports_heldout_metrics(self, monkeypatch, tmp_path):
+        """KFTPU_EVAL_EVERY wires Trainer.evaluate into the loop and the
+        final held-out score into the termination report (the StudyJob
+        objective channel: objective: eval_loss)."""
+        report = _run(
+            monkeypatch, tmp_path,
+            KFTPU_EVAL_EVERY="1", KFTPU_EVAL_BATCHES="2",
+        )
+        assert report["eval_loss"] > 0
+        assert report["eval_perplexity"] == pytest.approx(
+            __import__("math").exp(report["eval_loss"]), rel=1e-6)
+        # Train loss on the training batch and eval loss on the held-out
+        # stream are distinct numbers.
+        assert report["eval_loss"] != report["loss"]
+
     def test_model_kw_reaches_the_registry_factory(self, monkeypatch,
                                                    tmp_path):
         """KFTPU_MODEL_KW (JSON kwargs for the model factory) is how a

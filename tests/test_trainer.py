@@ -118,6 +118,133 @@ class TestOptimizerFamilies:
             TrainConfig(optimizer="rmsprop").make_optimizer()
 
 
+class TestEvaluate:
+    def test_lm_eval_metrics(self, mesh8):
+        model = Llama(LlamaConfig.tiny())
+        trainer = Trainer(model, TrainConfig(task="lm"), mesh8)
+        batch = _lm_batch()
+        sb = trainer.shard_batch(batch)
+        state = trainer.init_state(jax.random.PRNGKey(0), sb)
+        out = trainer.evaluate(state, [batch, _lm_batch(seed=1)])
+        assert set(out) == {"loss", "accuracy", "perplexity"}
+        assert np.isfinite(out["loss"]) and out["loss"] > 0
+        assert out["perplexity"] == pytest.approx(
+            np.exp(out["loss"]), rel=1e-6)
+        # Deterministic: same held-out set scores identically (no rngs,
+        # no state mutation).
+        again = trainer.evaluate(state, [batch, _lm_batch(seed=1)])
+        assert again["loss"] == out["loss"]
+
+    def test_eval_excludes_z_loss_and_aux(self, devices8):
+        """Eval loss is pure CE: on an MoE model the train-step loss
+        carries aux routing terms, evaluate must not."""
+        mesh = make_host_local_mesh(AxisSpec(dp=2, ep=4))
+        model = Mixtral(MixtralConfig.tiny(num_experts=4))
+        trainer = Trainer(
+            model,
+            TrainConfig(task="lm", aux_loss_weight=0.5, z_loss_weight=1.0),
+            mesh,
+        )
+        batch = _lm_batch()
+        sb = trainer.shard_batch(batch)
+        state = trainer.init_state(jax.random.PRNGKey(0), sb)
+        ev = trainer.evaluate(state, [batch])   # before step: step donates
+        _, train_metrics = trainer.step(state, sb, rng=jax.random.PRNGKey(1))
+        # The inflated z/aux train loss must exceed the pure-CE eval loss
+        # (both scored on the same pre-update params and batch).
+        assert float(train_metrics["loss"]) > ev["loss"]
+
+    def test_image_eval(self, mesh8):
+        model = ResNet(ResNetConfig.tiny())
+        trainer = Trainer(model, TrainConfig(task="image"), mesh8)
+        it = synthetic_images(
+            SyntheticImageConfig(batch_size=8, image_size=32, num_classes=10)
+        )
+        b = next(it)
+        sb = trainer.shard_batch({k: jnp.asarray(v) for k, v in b.items()})
+        state = trainer.init_state(jax.random.PRNGKey(0), sb)
+        out = trainer.evaluate(state, [b])
+        assert set(out) == {"loss", "accuracy"}
+        assert np.isfinite(out["loss"])
+
+
+class TestGradAccumulation:
+    """TrainConfig.grad_accum_steps: K microbatches scanned per step with
+    f32 gradient accumulation must match the full-batch step numerically
+    (same data, f32 params -> tolerance is summation-order noise)."""
+
+    def _trainer(self, mesh8, k):
+        model = Llama(LlamaConfig.tiny())
+        return Trainer(
+            model,
+            TrainConfig(task="lm", learning_rate=1e-2, warmup_steps=2,
+                        total_steps=30, grad_accum_steps=k),
+            mesh8,
+        )
+
+    def test_matches_full_batch_step(self, mesh8):
+        batch = _lm_batch(bs=8)
+        losses = {}
+        for k in (1, 4):
+            tr = self._trainer(mesh8, k)
+            b = tr.shard_batch(batch)
+            state = tr.init_state(jax.random.PRNGKey(0), b)
+            for _ in range(3):
+                state, metrics = tr.step(state, b)
+            losses[k] = float(metrics["loss"])
+            assert int(state.step) == 3
+        # Same data, same updates: after 3 steps the losses agree to
+        # f32 summation noise.
+        assert losses[1] == pytest.approx(losses[4], rel=2e-4), losses
+
+    def test_masked_batch_matches_global_normalisation(self, mesh8):
+        """Padding distributed unevenly across microbatches: per-microbatch
+        masked means must be token-weighted back to the full-batch global
+        normalisation, not averaged equally."""
+        batch = _lm_batch(bs=8)
+        # LM rows carry seq_len+1 tokens (the shift contract); mask
+        # matches the token shape and is sliced [:, 1:] to label shape.
+        mask = np.ones((8, 17), np.int32)
+        mask[:2, 4:] = 0     # rows 0-1 (microbatch 0 at K=4) mostly padding
+        batch = {**batch, "mask": jnp.asarray(mask)}
+        losses = {}
+        for k in (1, 4):
+            tr = self._trainer(mesh8, k)
+            b = tr.shard_batch(batch)
+            state = tr.init_state(jax.random.PRNGKey(0), b)
+            for _ in range(3):
+                state, metrics = tr.step(state, b)
+            losses[k] = float(metrics["loss"])
+        assert losses[1] == pytest.approx(losses[4], rel=2e-4), losses
+
+    def test_batchnorm_model_threads_stats(self, mesh8):
+        model = ResNet(ResNetConfig.tiny())
+        trainer = Trainer(
+            model,
+            TrainConfig(task="image", learning_rate=1e-2, warmup_steps=2,
+                        grad_accum_steps=2),
+            mesh8,
+        )
+        it = synthetic_images(
+            SyntheticImageConfig(batch_size=8, image_size=32, num_classes=10)
+        )
+        batch = trainer.shard_batch(
+            {k: jnp.asarray(v) for k, v in next(it).items()})
+        state = trainer.init_state(jax.random.PRNGKey(0), batch)
+        before = jax.tree.leaves(state.extra_vars)[0].copy()
+        state, metrics = trainer.step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+        after = jax.tree.leaves(state.extra_vars)[0]
+        assert not np.allclose(np.asarray(before), np.asarray(after))
+
+    def test_indivisible_batch_rejected(self, mesh8):
+        tr = self._trainer(mesh8, 3)
+        b = tr.shard_batch(_lm_batch(bs=8))
+        state = tr.init_state(jax.random.PRNGKey(0), b)
+        with pytest.raises(AssertionError, match="not divisible"):
+            tr.step(state, b)
+
+
 class TestImageTrainer:
     def test_resnet_loss_decreases(self, mesh8):
         model = ResNet(ResNetConfig.tiny())
