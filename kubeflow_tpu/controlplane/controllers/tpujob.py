@@ -472,6 +472,13 @@ class TpuJobController(Controller):
                 model_kw=json.loads(
                     env.get("KFTPU_MODEL_KW", "{}") or "{}"),
             )
+        except ValueError as e:
+            # Config-shaped errors (non-divisible grad_accum, unknown
+            # optimizer/schedule names) are the job's fault: reject, the
+            # same contract as mesh-validation failures above.
+            verdict = f"invalid training config: {e}"
+            self._hbm_cache[cache_key] = verdict
+            return verdict
         except Exception as e:  # noqa: BLE001 — estimator must fail open
             log.warning("hbm admission estimate failed",
                         kv={"job": job.metadata.name, "err": repr(e)})

@@ -201,6 +201,14 @@ def analytic_report(
     from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
 
     rules = rules or DEFAULT_RULES
+    if grad_accum > 1 and global_batch % grad_accum:
+        # The trainer's microbatch split asserts divisibility at trace
+        # time; green-lighting the config here would admit a job that
+        # crashes on step 1.
+        raise ValueError(
+            f"grad_accum_steps {grad_accum} does not divide global batch "
+            f"{global_batch}"
+        )
     st, resolved, total_chips = _resolve(slice_type, axes, num_slices)
     extents = resolved.as_dict()
 

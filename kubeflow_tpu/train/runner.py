@@ -86,6 +86,12 @@ def env_config() -> dict:
         "eval_every": int(os.environ.get("KFTPU_EVAL_EVERY", "0")),
         "eval_batches": int(os.environ.get("KFTPU_EVAL_BATCHES", "8")),
         "eval_data_path": os.environ.get("KFTPU_EVAL_DATA_PATH", ""),
+        # Base seed for param init and the data stream: two jobs with
+        # different seeds are independent runs; the same seed reproduces.
+        # Every process generates the same GLOBAL batch stream and its
+        # devices take their shard of it (shard_batch over the global
+        # mesh) — consistent by construction, no per-process offsets.
+        "seed": int(os.environ.get("KFTPU_SEED", "0")),
     }
 
 
@@ -220,7 +226,7 @@ def run(cfg: dict) -> int:
             it = NativeTokenLoader(
                 batch_size=batch_size, seq_len=cfg["seq_len"] + 1,
                 vocab_size=model_cfg.vocab_size,
-                token_file=cfg["data_path"],
+                token_file=cfg["data_path"], seed=cfg["seed"],
             )
             log.info("native loader active",
                      kv={"data": cfg["data_path"] or "synthetic"})
@@ -234,11 +240,12 @@ def run(cfg: dict) -> int:
             batch_size=batch_size,
             seq_len=cfg["seq_len"],
             vocab_size=model_cfg.vocab_size,
+            seed=cfg["seed"],
         ))
     batch = trainer.shard_batch(
         {k: jnp.asarray(v) for k, v in next(it).items()}
     )
-    state = trainer.init_state(jax.random.PRNGKey(0), batch)
+    state = trainer.init_state(jax.random.PRNGKey(cfg["seed"]), batch)
 
     def run_eval(st):
         """Score the held-out set: a fresh iterator per call (same seed)
@@ -249,12 +256,12 @@ def run(cfg: dict) -> int:
             ev = NativeTokenLoader(
                 batch_size=batch_size, seq_len=cfg["seq_len"] + 1,
                 vocab_size=model_cfg.vocab_size,
-                token_file=cfg["eval_data_path"],
+                token_file=cfg["eval_data_path"], seed=7919 + cfg["seed"],
             )
         else:
             ev = synthetic_text(SyntheticTextConfig(
                 batch_size=batch_size, seq_len=cfg["seq_len"],
-                vocab_size=model_cfg.vocab_size, seed=7919,
+                vocab_size=model_cfg.vocab_size, seed=7919 + cfg["seed"],
             ))
         batches = (next(ev) for _ in range(cfg["eval_batches"]))
         return trainer.evaluate(st, batches)
@@ -276,6 +283,7 @@ def run(cfg: dict) -> int:
             log.info("auto-resumed", kv={"step": int(state.step)})
 
     start_step = int(state.step)
+    last_eval = None               # (step, metrics) of the newest eval
     t0 = time.time()
     # Trace a window of steps after warm-up (step 2) so the capture shows
     # steady-state device work, not compilation.
@@ -300,9 +308,9 @@ def run(cfg: dict) -> int:
         if ckpt is not None and (i + 1) % cfg["checkpoint_every"] == 0:
             ckpt.save(int(state.step), state)
         if cfg["eval_every"] > 0 and (i + 1) % cfg["eval_every"] == 0:
-            em = run_eval(state)
+            last_eval = (i + 1, run_eval(state))
             log.info("eval", kv={"step": i + 1, **{
-                k: f"{v:.4f}" for k, v in em.items()}})
+                k: f"{v:.4f}" for k, v in last_eval[1].items()}})
         if (i + 1) % 10 == 0:
             loss = float(metrics["loss"])
             tps = (
@@ -326,7 +334,13 @@ def run(cfg: dict) -> int:
     # collectives); only worker 0 reports it.
     final_eval = {}
     if cfg["eval_every"] > 0 and ran_steps:
-        final_eval = run_eval(state)
+        # Reuse the in-loop result when the last eval already scored the
+        # final state (steps % eval_every == 0) — a full held-out pass
+        # is not free.
+        if last_eval is not None and last_eval[0] == cfg["steps"]:
+            final_eval = last_eval[1]
+        else:
+            final_eval = run_eval(state)
     if cfg["process_id"] == 0:
         report = {"tokens_per_sec": tokens_per_sec, "steps": cfg["steps"]}
         # A resume at/past the final step runs zero steps and has no loss

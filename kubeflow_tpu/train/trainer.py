@@ -87,15 +87,50 @@ class TrainConfig:
     #               the optimizer-memory lever for flagship-scale runs
     #   sgd       - momentum buffer (1x)
     optimizer: str = "adamw"
+    # Learning-rate schedule family. All start with a linear warmup over
+    # warmup_steps to learning_rate, then:
+    #   warmup_cosine - cosine decay to 10% over total_steps (default)
+    #   warmup_linear - linear decay to 10% over total_steps
+    #   constant      - hold the peak
+    #   rsqrt         - peak * sqrt(warmup/step) (the T5/scaling-law
+    #                   schedule: total_steps-independent, the choice for
+    #                   open-ended runs where total_steps isn't known)
+    lr_schedule: str = "warmup_cosine"
+
+    def make_schedule(self):
+        peak, w = self.learning_rate, max(1, self.warmup_steps)
+        total = max(self.total_steps, w + 1)
+        if self.lr_schedule == "warmup_cosine":
+            return optax.warmup_cosine_decay_schedule(
+                init_value=0.0, peak_value=peak, warmup_steps=w,
+                decay_steps=total, end_value=peak * 0.1,
+            )
+        if self.lr_schedule == "warmup_linear":
+            return optax.join_schedules(
+                [optax.linear_schedule(0.0, peak, w),
+                 optax.linear_schedule(peak, peak * 0.1, total - w)],
+                [w],
+            )
+        if self.lr_schedule == "constant":
+            return optax.join_schedules(
+                [optax.linear_schedule(0.0, peak, w),
+                 optax.constant_schedule(peak)],
+                [w],
+            )
+        if self.lr_schedule == "rsqrt":
+            def rsqrt(step):
+                step = jnp.asarray(step, jnp.float32)
+                warm = jnp.minimum(step / w, 1.0)
+                return peak * warm * jnp.sqrt(
+                    w / jnp.maximum(step, jnp.float32(w)))
+            return rsqrt
+        raise ValueError(
+            f"unknown lr_schedule {self.lr_schedule!r} "
+            "(warmup_cosine | warmup_linear | constant | rsqrt)"
+        )
 
     def make_optimizer(self) -> optax.GradientTransformation:
-        schedule = optax.warmup_cosine_decay_schedule(
-            init_value=0.0,
-            peak_value=self.learning_rate,
-            warmup_steps=self.warmup_steps,
-            decay_steps=max(self.total_steps, self.warmup_steps + 1),
-            end_value=self.learning_rate * 0.1,
-        )
+        schedule = self.make_schedule()
         if self.optimizer == "adamw":
             opt = optax.adamw(
                 schedule, b1=self.b1, b2=self.b2,
@@ -310,7 +345,10 @@ class Trainer:
             return params["embed"].T            # [V,E] -> [E,V]
         return params["lm_head"]["kernel"]
 
-    def _loss_lm(self, params, extra_vars, batch, rng):
+    def _lm_ce(self, params, extra_vars, batch, rng, *, z_loss_weight):
+        """Shared LM forward + cross-entropy for the train loss AND eval:
+        one definition of the shift/mask/chunked-vs-dense contract, so
+        the two paths cannot drift. Returns (ce_loss, accuracy, mut)."""
         tokens = batch["inputs"]
         inputs, labels = tokens[:, :-1], tokens[:, 1:]
         mask = batch.get("mask")
@@ -331,17 +369,23 @@ class Trainer:
                 self._lm_head_kernel(params),
                 labels.reshape(B * S),
                 mask=None if mask is None else mask.reshape(B * S),
-                z_loss_weight=self.cfg.z_loss_weight,
+                z_loss_weight=z_loss_weight,
                 block=self.cfg.loss_chunk,
             )
             accuracy = hits / count
         else:
             logits, mut = outs
             loss, _ = cross_entropy_loss(
-                logits, labels, mask=mask,
-                z_loss_weight=self.cfg.z_loss_weight,
+                logits, labels, mask=mask, z_loss_weight=z_loss_weight,
             )
             accuracy = softmax_accuracy(logits, labels, mask=mask)
+        return loss, accuracy, mut
+
+    def _loss_lm(self, params, extra_vars, batch, rng):
+        loss, accuracy, mut = self._lm_ce(
+            params, extra_vars, batch, rng,
+            z_loss_weight=self.cfg.z_loss_weight,
+        )
         aux_total = jnp.zeros((), jnp.float32)
         if self.aux_loss_weight > 0 and "losses" in mut:
             aux = jax.tree.leaves(mut["losses"])
@@ -475,37 +519,17 @@ class Trainer:
         with parallel_context(
             mesh=self.mesh, rules=self.rules, attn_impl=self.cfg.attn_impl
         ):
-            variables = {"params": state.params, **state.extra_vars}
             if self.cfg.task == "lm":
-                tokens = batch["inputs"]
-                inputs, labels = tokens[:, :-1], tokens[:, 1:]
-                mask = batch.get("mask")
-                if mask is not None:
-                    mask = mask[:, 1:]
-                if self._use_chunked_loss():
-                    # Same memory contract as the train step: a config
-                    # that needs loss_chunk to fit HBM must not OOM on
-                    # its own eval (the [B,S,V] logits never materialise).
-                    hidden, _ = self.model.apply(
-                        variables, inputs, mutable=["losses"],
-                        return_hidden=True,
-                    )
-                    B, S, E = hidden.shape
-                    loss, count, hits = chunked_cross_entropy(
-                        hidden.reshape(B * S, E),
-                        self._lm_head_kernel(state.params),
-                        labels.reshape(B * S),
-                        mask=None if mask is None else mask.reshape(B * S),
-                        block=self.cfg.loss_chunk,
-                    )
-                    acc = hits / count
-                else:
-                    logits, _ = self.model.apply(
-                        variables, inputs, mutable=["losses"]
-                    )
-                    loss, _ = cross_entropy_loss(logits, labels, mask=mask)
-                    acc = softmax_accuracy(logits, labels, mask=mask)
+                # Shared forward+CE (_lm_ce) with the regularisers off:
+                # z_loss is an optimisation term, routing is
+                # deterministic (no rngs), and the chunked-loss memory
+                # contract is honoured exactly as in training.
+                loss, acc, _ = self._lm_ce(
+                    state.params, state.extra_vars, batch, None,
+                    z_loss_weight=0.0,
+                )
             else:
+                variables = {"params": state.params, **state.extra_vars}
                 logits = self.model.apply(
                     variables, batch["inputs"], train=False
                 )

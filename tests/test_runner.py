@@ -56,6 +56,15 @@ class TestRunnerContract:
         )
         assert report["steps"] == 3
 
+    def test_seed_controls_init_and_data(self, monkeypatch, tmp_path):
+        """KFTPU_SEED: same seed reproduces the run; different seeds
+        produce different losses (init + data stream both keyed)."""
+        a = _run(monkeypatch, tmp_path, KFTPU_SEED="1")
+        b = _run(monkeypatch, tmp_path, KFTPU_SEED="1")
+        c = _run(monkeypatch, tmp_path, KFTPU_SEED="2")
+        assert a["loss"] == b["loss"]
+        assert a["loss"] != c["loss"]
+
     def test_eval_every_reports_heldout_metrics(self, monkeypatch, tmp_path):
         """KFTPU_EVAL_EVERY wires Trainer.evaluate into the loop and the
         final held-out score into the termination report (the StudyJob

@@ -118,6 +118,52 @@ class TestOptimizerFamilies:
             TrainConfig(optimizer="rmsprop").make_optimizer()
 
 
+class TestLrSchedules:
+    def _sched(self, name, lr=1e-3, warmup=10, total=100):
+        return TrainConfig(learning_rate=lr, warmup_steps=warmup,
+                           total_steps=total,
+                           lr_schedule=name).make_schedule()
+
+    @pytest.mark.parametrize("name", ["warmup_cosine", "warmup_linear",
+                                      "constant", "rsqrt"])
+    def test_warmup_and_peak(self, name):
+        s = self._sched(name)
+        assert float(s(0)) == pytest.approx(0.0, abs=1e-7)
+        assert float(s(10)) == pytest.approx(1e-3, rel=1e-5)
+
+    def test_tails(self):
+        # cosine/linear decay to 10%; constant holds; rsqrt follows
+        # peak*sqrt(w/step).
+        assert float(self._sched("warmup_cosine")(100)) == pytest.approx(
+            1e-4, rel=1e-3)
+        assert float(self._sched("warmup_linear")(100)) == pytest.approx(
+            1e-4, rel=1e-3)
+        assert float(self._sched("constant")(100)) == pytest.approx(
+            1e-3, rel=1e-6)
+        assert float(self._sched("rsqrt")(1000)) == pytest.approx(
+            1e-3 * (10 / 1000) ** 0.5, rel=1e-5)
+
+    def test_family_trains(self, mesh8):
+        model = Llama(LlamaConfig.tiny())
+        trainer = Trainer(
+            model,
+            TrainConfig(task="lm", learning_rate=1e-2, warmup_steps=2,
+                        total_steps=30, lr_schedule="rsqrt"),
+            mesh8,
+        )
+        batch = trainer.shard_batch(_lm_batch())
+        state = trainer.init_state(jax.random.PRNGKey(0), batch)
+        losses = []
+        for _ in range(10):
+            state, metrics = trainer.step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]
+
+    def test_unknown_rejected(self):
+        with pytest.raises(ValueError, match="unknown lr_schedule"):
+            TrainConfig(lr_schedule="cyclic").make_schedule()
+
+
 class TestEvaluate:
     def test_lm_eval_metrics(self, mesh8):
         model = Llama(LlamaConfig.tiny())
