@@ -265,6 +265,9 @@ class ServingSpec:
     param_dtype: str = "bfloat16"       # cast float params at engine start
     prefill_buckets: List[int] = dataclasses.field(default_factory=list)
     pipeline_depth: int = 0             # 0 = engine default
+    logprobs: bool = False              # per-token logprobs in responses
+                                        # (costs decode throughput; see
+                                        # ServingConfig.logprobs)
     port: int = 8000
     image: str = "kubeflow-tpu/serving:latest"
     # Train->serve handoff: restore params from this TpuJob checkpoint dir

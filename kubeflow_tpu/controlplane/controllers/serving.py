@@ -285,6 +285,8 @@ class ServingController(Controller):
         if sv.spec.pipeline_depth:
             env.append(EnvVar("KFTPU_SERVING_PIPELINE_DEPTH",
                               str(sv.spec.pipeline_depth)))
+        if sv.spec.logprobs:
+            env.append(EnvVar("KFTPU_SERVING_LOGPROBS", "1"))
         if getattr(sv.spec, "tokenizer", ""):
             env.append(EnvVar("KFTPU_SERVING_TOKENIZER",
                               sv.spec.tokenizer))

@@ -441,7 +441,11 @@ class TpuJobController(Controller):
         """Analytic per-chip HBM estimate for registry-model jobs; returns
         a rejection message when the job cannot fit. Estimator failures
         never block admission (fail open, loudly)."""
-        from kubeflow_tpu.topology.capacity import GiB, analytic_report
+        from kubeflow_tpu.topology.capacity import (
+            GiB,
+            InvalidTrainingConfig,
+            analytic_report,
+        )
 
         env = {e.name: e.value for e in job.spec.env}
         n_hosts = st.num_hosts * job.spec.num_slices
@@ -472,10 +476,11 @@ class TpuJobController(Controller):
                 model_kw=json.loads(
                     env.get("KFTPU_MODEL_KW", "{}") or "{}"),
             )
-        except ValueError as e:
-            # Config-shaped errors (non-divisible grad_accum, unknown
-            # optimizer/schedule names) are the job's fault: reject, the
-            # same contract as mesh-validation failures above.
+        except InvalidTrainingConfig as e:
+            # Config contradictions (non-divisible grad_accum, unknown
+            # optimizer names) are the job's fault: reject, the same
+            # contract as mesh-validation failures above. Every OTHER
+            # failure — bad JSON, estimator bugs — stays fail-open below.
             verdict = f"invalid training config: {e}"
             self._hbm_cache[cache_key] = verdict
             return verdict

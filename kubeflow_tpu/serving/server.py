@@ -8,7 +8,9 @@ HTTP app over the continuous-batching engine:
   POST /v1/generate   {"tokens": [...], "max_new_tokens": N,
                        "temperature": t, "top_k": k, "top_p": p,
                        "eos_token": id}
-                      -> {"tokens": [...], "ttft_s": ..., "latency_s": ...}
+                      -> {"tokens": [...], "ttft_s": ..., "latency_s": ...,
+                          "logprobs": [...] when the engine enables them
+                          (Serving.spec.logprobs / KFTPU_SERVING_LOGPROBS)}
                       with "stream": true -> NDJSON chunks: {"tokens":
                       [delta...]}* then {"done": true, ...metadata}
   GET  /v1/models     -> model + engine config
@@ -198,6 +200,8 @@ class ServingServer:
             "ttft_s": res.ttft_s,
             "latency_s": res.latency_s,
         }
+        if self.engine.cfg.logprobs:
+            out["logprobs"] = res.logprobs
         if self.tokenizer is not None:
             out["text"] = self.tokenizer.decode(res.tokens)
         return out
@@ -212,10 +216,20 @@ class ServingServer:
         deadline = time.time() + self.request_timeout_s
         sent = 0
         while True:
-            toks, finished = self.engine.partial(rid)
+            toks, lps, finished = self.engine.partial(rid)
             if len(toks) > sent:
-                yield {"tokens": toks[sent:]}
-                sent = len(toks)
+                # lps parallels toks but is appended after it by the
+                # driver thread; clamp the delta to the shorter list and
+                # let the next poll carry the remainder.
+                n = min(len(toks), len(lps))
+                if n <= sent:
+                    ev.wait(0.005)
+                    continue
+                chunk = {"tokens": toks[sent:n]}
+                if self.engine.cfg.logprobs:
+                    chunk["logprobs"] = lps[sent:n]
+                yield chunk
+                sent = n
             if finished:
                 break
             if time.time() > deadline:
@@ -292,6 +306,8 @@ def env_config() -> dict:
         ],
         "pipeline_depth": int(
             os.environ.get("KFTPU_SERVING_PIPELINE_DEPTH", "0")),
+        "logprobs": os.environ.get(
+            "KFTPU_SERVING_LOGPROBS", "") not in ("", "0", "false"),
         # Train->serve handoff: restore params from a TpuJob's checkpoint
         # dir (the same orbax tree the trainer writes).
         "checkpoint_dir": os.environ.get(
@@ -419,6 +435,8 @@ def build_server(cfg: dict) -> ServingServer:
         scfg_kw["prefill_buckets"] = tuple(cfg["prefill_buckets"])
     if cfg.get("pipeline_depth"):
         scfg_kw["pipeline_depth"] = cfg["pipeline_depth"]
+    if cfg.get("logprobs"):
+        scfg_kw["logprobs"] = True
     engine = ServingEngine(model, params, ServingConfig(**scfg_kw), mesh=mesh)
     tokenizer = None
     if cfg.get("tokenizer"):

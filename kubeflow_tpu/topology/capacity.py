@@ -38,6 +38,12 @@ log = get_logger("capacity")
 GiB = 1024 ** 3
 
 
+class InvalidTrainingConfig(ValueError):
+    """A training-config contradiction the job owner must fix (e.g.
+    grad_accum not dividing the batch, unknown optimizer name). Admission
+    REJECTS on this; any other estimator failure stays fail-open."""
+
+
 @dataclasses.dataclass(frozen=True)
 class CapacityReport:
     model: str
@@ -205,7 +211,7 @@ def analytic_report(
         # The trainer's microbatch split asserts divisibility at trace
         # time; green-lighting the config here would admit a job that
         # crashes on step 1.
-        raise ValueError(
+        raise InvalidTrainingConfig(
             f"grad_accum_steps {grad_accum} does not divide global batch "
             f"{global_batch}"
         )
@@ -282,7 +288,7 @@ def analytic_report(
             else:
                 mu_b += per_dev * 4
         else:
-            raise ValueError(f"unknown optimizer {optimizer!r}")
+            raise InvalidTrainingConfig(f"unknown optimizer {optimizer!r}")
     # Grads live in the param dtype; under microbatch accumulation
     # (TrainConfig.grad_accum_steps) the f32 accumulator tree rides with
     # them, while the activation model below shrinks by 1/K.

@@ -58,6 +58,16 @@ class TestAnalytic:
         # next to any moment tree, but nonzero.
         assert 0 < reps["adafactor"].opt_state < reps["lion"].opt_state / 10
 
+    def test_grad_accum_indivisible_is_config_error(self):
+        """Non-divisible grad_accum raises the dedicated config-error
+        type: admission REJECTS it (the trainer would assert at step 1)
+        while other estimator failures stay fail-open."""
+        from kubeflow_tpu.topology.capacity import InvalidTrainingConfig
+
+        with pytest.raises(InvalidTrainingConfig, match="does not divide"):
+            analytic_report("llama3-8b", "v5e-16", AxisSpec(fsdp=-1),
+                            global_batch=16, grad_accum=3)
+
     def test_grad_accum_shrinks_activations(self):
         """grad_accum=K models 1/K activation tokens plus the f32
         accumulator tree riding with the grads."""

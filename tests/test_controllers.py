@@ -484,7 +484,7 @@ class TestServing:
         api, mgr, kubelet = self._world()
         api.create(self._serving(
             name="q8", quantize="int8", param_dtype="float32",
-            prefill_buckets=[64, 256], pipeline_depth=3,
+            prefill_buckets=[64, 256], pipeline_depth=3, logprobs=True,
         ))
         mgr.run_until_idle()
         pod = api.get("Pod", "q8-serving-0", "team-a")
@@ -493,6 +493,7 @@ class TestServing:
         assert env["KFTPU_SERVING_PARAM_DTYPE"] == "float32"
         assert env["KFTPU_SERVING_PREFILL_BUCKETS"] == "64,256"
         assert env["KFTPU_SERVING_PIPELINE_DEPTH"] == "3"
+        assert env["KFTPU_SERVING_LOGPROBS"] == "1"
         # defaults stay off the env so existing pods see no spec drift
         api.create(self._serving(name="plain"))
         mgr.run_until_idle()
@@ -500,7 +501,8 @@ class TestServing:
         env = {e.name: e.value for e in pod.spec.containers[0].env}
         for k in ("KFTPU_SERVING_QUANTIZE", "KFTPU_SERVING_PARAM_DTYPE",
                   "KFTPU_SERVING_PREFILL_BUCKETS",
-                  "KFTPU_SERVING_PIPELINE_DEPTH"):
+                  "KFTPU_SERVING_PIPELINE_DEPTH",
+                  "KFTPU_SERVING_LOGPROBS"):
             assert k not in env
 
     def test_invalid_quantize_rejected(self):

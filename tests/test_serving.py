@@ -135,6 +135,36 @@ class TestServingEngine:
         ref = greedy_reference(model, params, prompt, 6)
         assert eng.result(g).tokens == ref
 
+    def test_greedy_logprobs_match_reforward(self, model_and_params):
+        """Per-token logprobs are the raw-model log-softmax at each
+        generated token — pinned against a full re-forward each step."""
+        model, params = model_and_params
+        eng = ServingEngine(
+            model, params,
+            ServingConfig(max_batch=1, max_len=128, logprobs=True))
+        prompt = [3, 14, 15, 92]
+        eng.submit(prompt, max_new_tokens=5)
+        res = eng.run()[0]
+        assert len(res.logprobs) == len(res.tokens)
+        toks = list(prompt)
+        for tok, lp in zip(res.tokens, res.logprobs):
+            logits = model.apply(params, jnp.asarray([toks]))[0, -1]
+            ref = jax.nn.log_softmax(logits.astype(jnp.float32))[tok]
+            assert lp == pytest.approx(float(ref), abs=5e-2), (tok, lp)
+            assert lp <= 0.0
+            toks.append(tok)
+
+    def test_logprobs_off_by_default(self, model_and_params):
+        """Default engines skip the logprob math (it costs decode
+        throughput): results carry zeros and the HTTP layer omits the
+        key (tested in TestServingServer via the enabled engine)."""
+        model, params = model_and_params
+        eng = ServingEngine(model, params,
+                            ServingConfig(max_batch=1, max_len=128))
+        eng.submit([1, 2, 3], max_new_tokens=3)
+        res = eng.run()[0]
+        assert res.logprobs == [0.0] * len(res.tokens)
+
     def test_rejects_oversized_prompt(self, model_and_params):
         model, params = model_and_params
         eng = ServingEngine(model, params,
@@ -167,9 +197,10 @@ class TestSampleLogits:
     def _draws(self, eng, logits, samp, n=64):
         out = []
         for i in range(n):
-            out.append(int(eng._sample_logits(
+            toks, _ = eng._sample_logits(
                 jnp.asarray(logits), jax.random.PRNGKey(i),
-                jnp.asarray(samp, jnp.float32))[0]))
+                jnp.asarray(samp, jnp.float32))
+            out.append(int(toks[0]))
         return out
 
     def test_top_k_support(self, eng):
@@ -203,9 +234,10 @@ class TestSampleLogits:
                 [5.0, 0.0, 1.0]]    # hot plain -> anything but -9 rows
         rows = [set() for _ in range(3)]
         for i in range(64):
-            toks = np.asarray(eng._sample_logits(
+            toks, _ = eng._sample_logits(
                 jnp.asarray(logits), jax.random.PRNGKey(i),
-                jnp.asarray(samp, jnp.float32)))
+                jnp.asarray(samp, jnp.float32))
+            toks = np.asarray(toks)
             for r in range(3):
                 rows[r].add(int(toks[r]))
         assert rows[0] == {1}
@@ -226,10 +258,10 @@ class TestSampleLogits:
                 [1.0, 2.0, 1.0]]   # top-k row forces the restricted branch
         draws = set()
         for i in range(64):
-            toks = np.asarray(eng._sample_logits(
+            toks, _ = eng._sample_logits(
                 jnp.asarray(logits), jax.random.PRNGKey(i),
-                jnp.asarray(samp, jnp.float32)))
-            draws.add(int(toks[0]))
+                jnp.asarray(samp, jnp.float32))
+            draws.add(int(np.asarray(toks)[0]))
         assert any(t >= 64 for t in draws), draws
 
 
@@ -452,8 +484,9 @@ class TestServingServer:
         start the server, wait healthy, query generate over HTTP, assert the
         tokens match the engine's ground truth."""
         model, params = model_and_params
-        engine = ServingEngine(model, params,
-                               ServingConfig(max_batch=2, max_len=128))
+        engine = ServingEngine(
+            model, params,
+            ServingConfig(max_batch=2, max_len=128, logprobs=True))
         server = ServingServer(engine, model_name="llama-test").start()
         try:
             base = f"http://127.0.0.1:{server.port}"
@@ -476,6 +509,8 @@ class TestServingServer:
             assert out["tokens"] == ref
             assert out["prompt_len"] == len(prompt)
             assert out["latency_s"] >= out["ttft_s"] > 0
+            assert len(out["logprobs"]) == len(out["tokens"])
+            assert all(lp <= 0.0 for lp in out["logprobs"])
 
             # Sampling controls ride the same surface: top_k=1 at hot
             # temperature must still reproduce the greedy tokens.
@@ -498,7 +533,8 @@ class TestServingServer:
         model, params = model_and_params
         engine = ServingEngine(
             model, params,
-            ServingConfig(max_batch=2, max_len=128, decode_chunk=2),
+            ServingConfig(max_batch=2, max_len=128, decode_chunk=2,
+                          logprobs=True),
         )
         server = ServingServer(engine, model_name="llama-test").start()
         try:
@@ -517,10 +553,12 @@ class TestServingServer:
                 for line in r:
                     chunks.append(json.loads(line))
             toks = [t for c in chunks if "tokens" in c for t in c["tokens"]]
+            lps = [l for c in chunks if "tokens" in c for l in c["logprobs"]]
             done = chunks[-1]
             assert done.get("done") is True
             assert done["prompt_len"] == len(prompt)
             assert toks == greedy_reference(model, params, prompt, 6)
+            assert len(lps) == len(toks) and all(l <= 0.0 for l in lps)
             # at least one token delta preceded the done chunk (chunk
             # COUNT is thread-scheduling dependent, so don't pin it)
             assert sum(1 for c in chunks if "tokens" in c) >= 1
