@@ -460,27 +460,38 @@ class TpuJobController(Controller):
         )
         if cache_key in self._hbm_cache:
             return self._hbm_cache[cache_key]
+        # Owner-fixable inputs parse in their own try: malformed JSON or
+        # non-numeric env values are the job's fault and reject with an
+        # actionable message — NOT fail-open material.
         try:
             hp = json.loads(env.get("KFTPU_HPARAMS", "{}") or "{}")
+            model_kw = json.loads(env.get("KFTPU_MODEL_KW", "{}") or "{}")
+            global_batch = int(
+                env.get("KFTPU_BATCH_PER_HOST", "8")) * n_hosts
+            seq_len = int(env.get("KFTPU_SEQ_LEN", "1024"))
+            grad_accum = int(hp.get("grad_accum_steps", 1))
+        except (ValueError, TypeError, AttributeError) as e:
+            verdict = f"invalid training config: {e}"
+            self._hbm_cache[cache_key] = verdict
+            return verdict
+        try:
             rep = analytic_report(
                 job.spec.model, job.spec.slice_type,
                 AxisSpec(dp=m.dp, pp=m.pp, ep=m.ep, fsdp=m.fsdp,
                          sp=m.sp, tp=m.tp),
                 num_slices=job.spec.num_slices,
-                global_batch=int(
-                    env.get("KFTPU_BATCH_PER_HOST", "8")) * n_hosts,
-                seq_len=int(env.get("KFTPU_SEQ_LEN", "1024")),
+                global_batch=global_batch,
+                seq_len=seq_len,
                 mu_dtype=str(hp.get("mu_dtype", "")),
                 optimizer=str(hp.get("optimizer", "adamw")),
-                grad_accum=int(hp.get("grad_accum_steps", 1)),
-                model_kw=json.loads(
-                    env.get("KFTPU_MODEL_KW", "{}") or "{}"),
+                grad_accum=grad_accum,
+                model_kw=model_kw,
             )
         except InvalidTrainingConfig as e:
             # Config contradictions (non-divisible grad_accum, unknown
             # optimizer names) are the job's fault: reject, the same
             # contract as mesh-validation failures above. Every OTHER
-            # failure — bad JSON, estimator bugs — stays fail-open below.
+            # failure here is an estimator bug and stays fail-open.
             verdict = f"invalid training config: {e}"
             self._hbm_cache[cache_key] = verdict
             return verdict

@@ -217,14 +217,13 @@ class ServingServer:
         sent = 0
         while True:
             toks, lps, finished = self.engine.partial(rid)
-            if len(toks) > sent:
-                # lps parallels toks but is appended after it by the
-                # driver thread; clamp the delta to the shorter list and
-                # let the next poll carry the remainder.
-                n = min(len(toks), len(lps))
-                if n <= sent:
-                    ev.wait(0.005)
-                    continue
+            # lps parallels toks but is appended after it by the driver
+            # thread; clamp the delta to the shorter list and let the
+            # next poll carry the remainder. Never skip the
+            # deadline/error checks below — a stalled driver must still
+            # time the stream out.
+            n = min(len(toks), len(lps))
+            if n > sent:
                 chunk = {"tokens": toks[sent:n]}
                 if self.engine.cfg.logprobs:
                     chunk["logprobs"] = lps[sent:n]

@@ -39,6 +39,26 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _clear_jax_caches_per_module():
+    """Drop compiled executables between test modules.
+
+    The full suite compiles many hundreds of XLA CPU programs in one
+    process; with all of them kept alive, the CPU backend segfaulted
+    (reproducibly, ~78% through the suite, inside
+    backend_compile_and_load on a fresh compile — not an OOM: 120 GB
+    free) while the same tests pass in module-sized runs. Bounding the
+    live-executable count per module avoids whatever compiler-state
+    limit that crash lives in, and caps suite RSS. Costs only
+    cross-module cache reuse, which module-scoped fixtures don't rely
+    on."""
+    yield
+    import gc
+
+    jax.clear_caches()
+    gc.collect()
+
+
 @pytest.fixture(scope="session")
 def devices8():
     import jax
