@@ -79,17 +79,25 @@ def _sample_one(p: ParameterSpec, rng: random.Random) -> Any:
     return v
 
 
+def stream_rng(tag: str, params: List[ParameterSpec], seed: int,
+               index: int) -> random.Random:
+    """Seeded per-(tag, space, seed, index) RNG — the ONE derivation of
+    the deterministic suggestion streams (sample and TPE share it via
+    distinct tags). Hashing the space means spec edits produce fresh
+    suggestions rather than stale re-use."""
+    key = hashlib.sha256(
+        f"{tag}{seed}:{index}:"
+        f"{[dataclasses.astuple(p) for p in params]}".encode()
+    ).digest()
+    return random.Random(int.from_bytes(key[:8], "big"))
+
+
 def sample(params: List[ParameterSpec], seed: int, index: int) -> Assignment:
     """Trial ``index``'s random assignment — a pure function of
     (space, seed, index), so reconcile loops can regenerate it without
     storing suggestion state (stable across restarts, unlike katib's
     vizier-core suggestion service which holds state in a DB)."""
-    # Derive a per-index stream; hash the space too so edits to the spec
-    # produce fresh suggestions rather than stale re-use.
-    key = hashlib.sha256(
-        f"{seed}:{index}:{[dataclasses.astuple(p) for p in params]}".encode()
-    ).digest()
-    rng = random.Random(int.from_bytes(key[:8], "big"))
+    rng = stream_rng("", params, seed, index)
     return {p.name: _sample_one(p, rng) for p in params}
 
 

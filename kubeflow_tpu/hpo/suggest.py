@@ -19,16 +19,17 @@ from kubeflow_tpu.hpo.space import (
     grid_at,
     grid_size,
     sample,
+    stream_rng,
     validate_space,
 )
 
-ALGORITHMS = ("random", "grid", "successive-halving")
+ALGORITHMS = ("random", "grid", "successive-halving", "tpe")
 
 
 def budget(params: List[ParameterSpec], algorithm: str,
            max_trials: int) -> int:
     """How many trials the study will actually run: grid is capped by the
-    grid size; random/successive-halving run exactly max_trials."""
+    grid size; random/successive-halving/tpe run exactly max_trials."""
     if algorithm == "grid":
         n = grid_size(params)
         return min(n, max_trials) if max_trials > 0 else n
@@ -47,7 +48,7 @@ def suggest(
     history — completed trials as {"parameters": Assignment,
     "objective": float or None} with objective normalised so LOWER is
     better (callers negate when maximizing); used by adaptive algorithms
-    (successive-halving exploits it, random/grid ignore it).
+    (tpe and successive-halving exploit it, random/grid ignore it).
     """
     validate_space(params)
     if algorithm == "random":
@@ -56,6 +57,8 @@ def suggest(
         return grid_at(params, index)
     if algorithm == "successive-halving":
         return _successive_halving(params, seed, index, history or [])
+    if algorithm == "tpe":
+        return _tpe(params, seed, index, history or [])
     raise ValueError(f"unknown algorithm {algorithm!r}; "
                      f"known: {ALGORITHMS}")
 
@@ -89,4 +92,88 @@ def _successive_halving(
         else:
             v = 0.5 * (float(b) + float(s))
             out[p.name] = int(round(v)) if p.type == "int" else v
+    return out
+
+
+def _tpe(
+    params: List[ParameterSpec], seed: int, index: int,
+    history: Sequence[Dict[str, Any]],
+    *, n_startup: int = 8, n_candidates: int = 24, gamma: float = 0.25,
+) -> Assignment:
+    """Tree-structured Parzen Estimator, hyperopt-style but stateless:
+    a pure function of (space, seed, index, history), like every other
+    algorithm here — no suggestion service, replayable from the spec.
+
+    Per dimension (univariate, as in classic TPE): split scored history
+    into the best ``gamma`` fraction (l) and the rest (g); draw candidates
+    from a Parzen mixture over l's values (log-domain for log_scale
+    params) and keep the candidate maximising l(x)/g(x). Categorical
+    dimensions weight choices by Laplace-smoothed good/bad count ratios.
+    The first ``n_startup`` trials (or with <4 scored) fall back to the
+    seeded random stream — TPE needs a population before it can split
+    one.
+    """
+    scored = [h for h in history if h.get("objective") is not None]
+    if index < n_startup or len(scored) < 4:
+        return sample(params, seed, index)
+    scored = sorted(scored, key=lambda h: h["objective"])
+    n_good = max(1, int(math.ceil(gamma * len(scored))))
+    good, bad = scored[:n_good], scored[n_good:]
+    rng = stream_rng("tpe:", params, seed, index)
+    fallback = sample(params, seed, index)
+    out: Assignment = {}
+    for p in params:
+        gvals = [h["parameters"].get(p.name) for h in good]
+        bvals = [h["parameters"].get(p.name) for h in bad]
+        gvals = [v for v in gvals if v is not None]
+        bvals = [v for v in bvals if v is not None]
+        if not gvals:
+            out[p.name] = fallback[p.name]
+            continue
+        if p.type == "categorical":
+            gc = {v: gvals.count(v) for v in p.values}
+            bc = {v: bvals.count(v) for v in p.values}
+            weights = [(gc[v] + 1.0) / (bc[v] + 1.0) for v in p.values]
+            out[p.name] = rng.choices(p.values, weights=weights)[0]
+            continue
+
+        def to_u(v):
+            return math.log(float(v)) if p.log_scale else float(v)
+
+        def from_u(u):
+            return math.exp(u) if p.log_scale else u
+
+        lo, hi = to_u(p.min), to_u(p.max)
+        gx = [min(max(to_u(v), lo), hi) for v in gvals]
+        bx = [min(max(to_u(v), lo), hi) for v in bvals] or gx
+        span = hi - lo
+
+        def bw_of(xs):
+            # Parzen bandwidth from the POINTS' spread (mean gap), not
+            # the range: range/sqrt(n) put half the range under one
+            # kernel and every candidate clamped to a bound. Floor at 5%
+            # of the range so a degenerate cluster still explores.
+            spread = (max(xs) - min(xs)) / max(len(xs) - 1, 1)
+            return max(0.05 * span, min(spread, span))
+
+        bw_g, bw_b = bw_of(gx), bw_of(bx)
+
+        def parzen(x, centers, bw):
+            return sum(
+                math.exp(-0.5 * ((x - c) / bw) ** 2) for c in centers
+            ) / (len(centers) * bw) + 1e-300
+
+        best_x, best_score = None, -math.inf
+        for _ in range(n_candidates):
+            c = gx[rng.randrange(len(gx))]
+            x = min(max(rng.gauss(c, bw_g), lo), hi)
+            score = parzen(x, gx, bw_g) / parzen(x, bx, bw_b)
+            if score > best_score:
+                best_x, best_score = x, score
+        # Clamp in the VALUE domain too: exp(log(max)) can overshoot
+        # max by an ulp after the u-space clamp.
+        v = min(max(from_u(best_x), p.min), p.max)
+        if p.type == "int":
+            v = int(round(v))
+        out[p.name] = v
     return out
