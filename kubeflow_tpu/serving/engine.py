@@ -6,7 +6,9 @@ TPU-first design:
 - Prefill runs per-slot at bucketed lengths (powers of two), compiled once
   per bucket; the whole path — fresh cache row, forward, cache install at
   the slot — is one jitted program with the batched cache donated, so no
-  host-side cache surgery and no per-request ``model.init``.
+  host-side cache surgery and no per-request ``model.init``. Prompts
+  longer than the largest bucket stream through bucket-width chunked
+  prefill (_extend_step) — any prompt up to max_len-1 serves.
 - Per-slot cache indices (models.llama decode cache) let every slot sit at
   a different position — the core of continuous batching.
 - Sampling (greedy / temperature / top-k / top-p) happens on-device inside
@@ -345,6 +347,7 @@ class ServingEngine:
         self._cache = self._init_cache()
         self._decode_fn = jax.jit(self._decode_step, donate_argnums=(1,))
         self._prefill_fns: Dict[tuple, object] = {}  # (bucket, k) -> jit
+        self._extend_fn = jax.jit(self._extend_step, donate_argnums=(1,))
         self.tokens_generated = 0
         self.decode_dispatches = 0
 
@@ -480,14 +483,15 @@ class ServingEngine:
         rid = next(self._req_ids)
         if not prompt:
             raise ValueError("empty prompt")
-        # Validate against BOTH limits here: _bucket raising later would
-        # poison the engine loop with an already-admitted slot.
-        limit = min(self.cfg.max_len - 1, self.cfg.prefill_buckets[-1])
+        # Validate here: a failure later would poison the engine loop
+        # with an already-admitted slot. Prompts longer than the largest
+        # prefill bucket are fine — they take the chunked-prefill path
+        # (_prefill_long); the only hard cap is the cache itself.
+        limit = self.cfg.max_len - 1
         if len(prompt) > limit:
             raise ValueError(
                 f"prompt length {len(prompt)} > limit {limit} "
-                f"(max_len {self.cfg.max_len}, largest prefill bucket "
-                f"{self.cfg.prefill_buckets[-1]})"
+                f"(max_len {self.cfg.max_len} needs one decode slot)"
             )
         self._queue.append(GenerationRequest(
             prompt=list(prompt), request_id=rid, submitted_at=time.time(), **kw
@@ -585,8 +589,21 @@ class ServingEngine:
                 "warmup() donates and resets the KV cache; call it before "
                 "submitting requests, not while generations are active"
             )
-        bucket = self._bucket(prompt_len)
+        big = self.cfg.prefill_buckets[-1]
+        chunked = prompt_len > big
+        bucket = self._bucket(min(prompt_len, big))
         with self._mesh_ctx():
+            if chunked:
+                # Long prompts take the chunked-prefill path: warm the
+                # extend step (one compiled program serves every chunk).
+                self._rng, sub = jax.random.split(self._rng)
+                toks, _, self._cache = self._extend_fn(
+                    self.params, self._cache,
+                    jnp.ones((1, big), jnp.int32),
+                    jnp.int32(0), jnp.int32(big), jnp.int32(0),
+                    sub, jnp.zeros((1, 3), jnp.float32),
+                )
+                toks.block_until_ready()
             ks = []
             k = 1
             while k < self.cfg.max_batch:
@@ -648,6 +665,12 @@ class ServingEngine:
             admissions.append((i, req))
         by_bucket: Dict[int, List[tuple]] = {}
         for i, req in admissions:
+            if len(req.prompt) > self.cfg.prefill_buckets[-1]:
+                # Longer than the largest bucket: chunked prefill, one
+                # slot at a time (rare path; the grouped dispatch below
+                # stays the fast path for bucket-sized prompts).
+                self._prefill_long(i, req)
+                continue
             by_bucket.setdefault(self._bucket(len(req.prompt)), []).append(
                 (i, req)
             )
@@ -792,6 +815,106 @@ class ServingEngine:
             self._record_token(
                 i, int(toks[row]),
                 float(lps[row]) if lps is not None else 0.0)
+
+    def _extend_step(self, params, cache, tokens, start, true_len,
+                     slot_idx, rng, samp):
+        """One chunk of chunked prefill for ONE slot: run ``tokens``
+        (always a FULL chunk width — _prefill_long slides the final
+        chunk back instead of padding, so writes never pass the prompt
+        end) through the model's generic multi-token decode path against
+        the slot's live cache rows at absolute position ``start``, then
+        install the rows back with cache_index = start + true_len. Also
+        samples from position true_len-1's logits, so the final chunk
+        yields the first generated token. One compiled program per chunk
+        width serves every chunk of every long prompt (start/true_len/
+        slot_idx are traced scalars)."""
+
+        def take(leaf):
+            if leaf.dtype == jnp.int32:            # [.., B] index
+                return jnp.full(leaf.shape[:-1] + (1,), start, jnp.int32)
+            return jax.lax.dynamic_slice_in_dim(leaf, slot_idx, 1, axis=-4)
+
+        rows = jax.tree.map(take, cache)
+        C = tokens.shape[1]
+        positions = start + jnp.arange(C)[None, :]
+        mat = self._materialize(params)
+        head_fn = getattr(type(self.model), "HEAD_LOGITS", None)
+        split_head = callable(head_fn)
+        with self._pctx():
+            if split_head:
+                hidden, mut = self.model.apply(
+                    {"params": mat["params"], "cache": rows}, tokens,
+                    positions=positions, decode=True, mutable=["cache"],
+                    return_hidden=True,
+                )
+            else:
+                logits, mut = self.model.apply(
+                    {"params": mat["params"], "cache": rows}, tokens,
+                    positions=positions, decode=True, mutable=["cache"],
+                )
+        total = start + true_len
+        new_rows = jax.tree.map(
+            lambda x: jnp.full_like(x, total)
+            if x.dtype == jnp.int32 else x,
+            mut["cache"],
+        )
+
+        def install(batch_leaf, row_leaf):
+            if batch_leaf.dtype == jnp.int32:
+                return jax.lax.dynamic_update_slice_in_dim(
+                    batch_leaf, row_leaf, slot_idx, axis=-1)
+            return jax.lax.dynamic_update_slice_in_dim(
+                batch_leaf, row_leaf, slot_idx, axis=-4)
+
+        cache = jax.tree.map(install, cache, new_rows)
+        pick = jnp.reshape(jnp.asarray(true_len - 1, jnp.int32), (1, 1, 1))
+        if split_head:
+            last_h = jnp.take_along_axis(hidden, pick, axis=1)  # [1,1,E]
+            with self._pctx():
+                last_logits = head_fn(
+                    self.model.cfg, mat["params"], last_h)[:, 0]
+        else:
+            last_logits = jnp.take_along_axis(
+                logits, pick, axis=1)[:, 0]                     # [1, V]
+        toks, lps = self._sample_logits(
+            last_logits.astype(jnp.float32), rng, samp)
+        return toks, lps, cache
+
+    def _prefill_long(self, slot_idx: int, req: "GenerationRequest") -> None:
+        """Chunked prefill for a prompt longer than the largest bucket:
+        bucket-width chunks stream through _extend_step against the
+        slot's cache in place. Costs one dispatch per chunk (vs one for
+        the whole grouped prefill) and full-cache masked attention per
+        chunk — the price of arbitrary prompt lengths up to max_len-1;
+        the first compile happens on the first long prompt."""
+        big = self.cfg.prefill_buckets[-1]
+        samp = np.asarray([self._samp_row(req)], np.float32)
+        prompt = req.prompt
+        # Every chunk is FULL width; a partial tail SLIDES BACK to end
+        # exactly at the prompt end, overlapping the previous chunk.
+        # Overlapped positions are rewritten with identical K/V (same
+        # tokens, same positions — deterministic), so the overlap is
+        # idempotent, and no chunk ever writes past len(prompt): a
+        # bucket-padded tail would dynamic-update-slice past
+        # max_seq_len, which JAX silently CLAMPS — corrupting earlier
+        # rows whenever ceil(len/big)*big > max_seq_len.
+        starts = list(range(0, len(prompt), big))
+        if starts[-1] + big > len(prompt):
+            starts[-1] = len(prompt) - big
+        toks = lps = None
+        with self._mesh_ctx():
+            for off in starts:
+                chunk = prompt[off:off + big]
+                self._rng, sub = jax.random.split(self._rng)
+                toks, lps, self._cache = self._extend_fn(
+                    self.params, self._cache,
+                    jnp.asarray(np.asarray([chunk], np.int32)),
+                    jnp.int32(off), jnp.int32(big),
+                    jnp.int32(slot_idx), sub, jnp.asarray(samp),
+                )
+        self._record_token(
+            slot_idx, int(np.asarray(toks)[0]),
+            float(np.asarray(lps)[0]) if self.cfg.logprobs else 0.0)
 
     def _sample_logits(self, logits, rng, samp):
         """On-device sampling. ``samp`` is [B, 3] f32 rows of

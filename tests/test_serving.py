@@ -185,6 +185,93 @@ class TestServingEngine:
         assert eng.tokens_generated == 4
 
 
+class TestChunkedPrefill:
+    """Prompts longer than the largest prefill bucket stream through
+    _extend_step in bucket-width chunks (vLLM-style chunked prefill):
+    the submit cap is max_len-1, not the bucket table."""
+
+    def _engine(self, model, params, **kw):
+        kw.setdefault("prefill_buckets", (16, 32))
+        return ServingEngine(model, params,
+                             ServingConfig(max_batch=2, max_len=128, **kw))
+
+    def test_long_prompt_matches_reforward(self, model_and_params):
+        model, params = model_and_params
+        eng = self._engine(model, params)
+        prompt = [(7 * i + 3) % 250 for i in range(70)]  # 70 > bucket 32
+        eng.submit(prompt, max_new_tokens=6)
+        res = eng.run()[0]
+        assert res.tokens == greedy_reference(model, params, prompt, 6)
+        assert res.prompt_len == 70
+
+    def test_exact_multiple_of_bucket(self, model_and_params):
+        model, params = model_and_params
+        eng = self._engine(model, params)
+        prompt = [(3 * i + 1) % 250 for i in range(64)]  # 2 full chunks
+        eng.submit(prompt, max_new_tokens=4)
+        res = eng.run()[0]
+        assert res.tokens == greedy_reference(model, params, prompt, 4)
+
+    def test_long_and_short_share_a_batch(self, model_and_params):
+        """A chunked-prefill request and a grouped-prefill request decode
+        together without corrupting each other's slots."""
+        model, params = model_and_params
+        long_p = [(5 * i + 2) % 250 for i in range(50)]
+        short_p = [9, 10, 11]
+        eng = self._engine(model, params)
+        a = eng.submit(long_p, max_new_tokens=5)
+        b = eng.submit(short_p, max_new_tokens=5)
+        eng.run()
+        assert eng.result(a).tokens == greedy_reference(
+            model, params, long_p, 5)
+        assert eng.result(b).tokens == greedy_reference(
+            model, params, short_p, 5)
+
+    def test_submit_caps_at_max_len(self, model_and_params):
+        model, params = model_and_params
+        eng = self._engine(model, params)
+        eng.submit(list(range(100)), max_new_tokens=1)   # > bucket: fine
+        with pytest.raises(ValueError, match="prompt length"):
+            eng.submit(list(range(128)))                  # >= max_len
+        eng.run()
+
+    def test_partial_tail_near_cache_end(self):
+        """Regression: a bucket-padded final chunk would
+        dynamic-update-slice past max_seq_len, which JAX silently CLAMPS
+        — overwriting earlier rows. The final chunk must slide back to
+        full width instead (max_seq_len=48, bucket 32, prompt 40:
+        ceil(40/32)*32 = 64 > 48)."""
+        from kubeflow_tpu.models import Llama, LlamaConfig
+
+        cfg = LlamaConfig.tiny(max_seq_len=48)
+        model = Llama(cfg)
+        params = {"params": model.init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]}
+        eng = ServingEngine(model, params, ServingConfig(
+            max_batch=2, max_len=48, prefill_buckets=(16, 32)))
+        prompt = [(13 * i + 7) % 250 for i in range(40)]
+        eng.submit(prompt, max_new_tokens=4)
+        res = eng.run()[0]
+        assert res.tokens == greedy_reference(model, params, prompt, 4)
+
+    def test_int8_kv_long_prompt(self):
+        """Chunked prefill through an int8 KV cache stays token-exact
+        against the bf16 full-reforward reference (greedy; the tiny
+        model's margins tolerate the cache quantization)."""
+        from kubeflow_tpu.models import Llama, LlamaConfig
+
+        cfg = LlamaConfig.tiny(max_seq_len=128, kv_cache_dtype="int8")
+        model = Llama(cfg)
+        ref_model = Llama(LlamaConfig.tiny(max_seq_len=128))
+        params = {"params": model.init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]}
+        eng = self._engine(model, params)
+        prompt = [(11 * i + 5) % 250 for i in range(40)]
+        eng.submit(prompt, max_new_tokens=4)
+        res = eng.run()[0]
+        assert res.tokens == greedy_reference(ref_model, params, prompt, 4)
+
+
 class TestSampleLogits:
     """Unit tier for the on-device sampler: crafted logits, many draws."""
 
@@ -642,8 +729,10 @@ class TestServingServer:
             server.stop()
 
     def test_oversized_prompt_rejected_not_fatal(self, model_and_params):
-        """A prompt beyond the largest prefill bucket must 400 — and must
-        NOT kill the engine driver (the server stays serviceable)."""
+        """A prompt the cache cannot hold (>= max_len) must 400 — and must
+        NOT kill the engine driver (the server stays serviceable).
+        Bucket-exceeding prompts are NOT oversized anymore: they take the
+        chunked-prefill path (TestChunkedPrefill)."""
         model, params = model_and_params
         engine = ServingEngine(
             model, params,
@@ -655,7 +744,7 @@ class TestServingServer:
             base = f"http://127.0.0.1:{server.port}"
             req = urllib.request.Request(
                 f"{base}/v1/generate",
-                data=json.dumps({"tokens": list(range(1, 60))}).encode(),
+                data=json.dumps({"tokens": list(range(1, 130))}).encode(),
                 headers={"Content-Type": "application/json"},
             )
             with pytest.raises(urllib.error.HTTPError) as e:
