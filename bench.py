@@ -652,12 +652,123 @@ def bench_longctx(args) -> None:
     bench_train(args)
 
 
+def bench_sp_crossover(args) -> None:
+    """Single-chip kernel proxy for the ring-vs-Ulysses ``sp`` decision
+    (parallel/policy.py): time the local attention each scheme runs at its
+    per-device shapes. Ring's critical-path device (the last, under causal)
+    makes ``sp`` flash calls over S/sp kv blocks + lse merges; Ulysses makes
+    one full-length call with H/sp query heads. The a2a / ppermute wire cost
+    is not visible single-chip — ring moves ~Hkv/H as many bytes, so the
+    kernel proxy is the part that can favour Ulysses at all."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.ops.flash_attention import (
+        NEG_INF, flash_attention, flash_attention_lse,
+        merge_attention_blocks,
+    )
+
+    B, H, Hkv, D = (args.batch_size or 2), 16, 8, 128
+    dtype = jnp.bfloat16
+    sp = args.sp
+    if H % sp or Hkv % sp:
+        raise SystemExit(f"--sp {sp} must divide H={H} and Hkv={Hkv} "
+                         "(the proxy models an exact Ulysses head split)")
+    bad = [S for S in args.seq_lens if S % sp]
+    if bad:
+        raise SystemExit(f"--seq-lens {bad} not divisible by --sp {sp}")
+    results = []
+    for S in args.seq_lens:
+        Sq = S // sp
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv_ = jax.random.split(key, 3)
+
+        def ring_proxy(q, k, v):
+            # Device sp-1's causal loop: every kv block is live.
+            o = jnp.zeros((B, Sq, H, D), jnp.float32)
+            lse = jnp.full((B, H, Sq), NEG_INF, jnp.float32)
+            for j in range(sp):
+                res = flash_attention_lse(
+                    q, k[:, j * Sq:(j + 1) * Sq], v[:, j * Sq:(j + 1) * Sq],
+                    causal=True, q_offset=(sp - 1) * Sq, kv_offset=j * Sq,
+                )
+                assert res is not None, "shapes must be kernel-eligible"
+                o, lse = merge_attention_blocks(o, lse, *res)
+            return o.astype(dtype)
+
+        def ulysses_proxy(q, k, v):
+            return flash_attention(q, k, v, causal=True)
+
+        q_r = jax.random.normal(kq, (B, Sq, H, D), dtype)
+        k_r = jax.random.normal(kk, (B, S, Hkv, D), dtype)
+        v_r = jax.random.normal(kv_, (B, S, Hkv, D), dtype)
+        q_u = jax.random.normal(kq, (B, S, H // sp, D), dtype)
+        k_u = jax.random.normal(kk, (B, S, Hkv // sp, D), dtype)
+        v_u = jax.random.normal(kv_, (B, S, Hkv // sp, D), dtype)
+
+        def timed(fn, q0, k0, v0):
+            # Per-dispatch tunnel latency (~110 ms) dwarfs these kernels:
+            # run `steps` iterations inside ONE jitted dispatch, chained
+            # through the q carry so XLA cannot CSE the repeats, and
+            # subtract a 0-iteration dispatch to remove the launch floor.
+            def repeat(n_iters):
+                def run(q, k, v):
+                    def body(qc, _):
+                        out = fn(qc, k, v)
+                        return qc + 1e-6 * out.astype(qc.dtype), None
+
+                    qf, _ = jax.lax.scan(body, q, None, length=n_iters)
+                    return jnp.sum(qf, dtype=jnp.float32)
+
+                return jax.jit(run)
+
+            f_n = repeat(args.steps)
+            f_0 = repeat(0)
+            _sync(f_n(q0, k0, v0)), _sync(f_0(q0, k0, v0))  # compile + warm
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                _sync(f_0(q0, k0, v0))
+                t_base = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                _sync(f_n(q0, k0, v0))
+                t_full = time.perf_counter() - t0
+                best = min(best, (t_full - t_base) / args.steps)
+            return best * 1e3
+
+        ring_ms = timed(ring_proxy, q_r, k_r, v_r)
+        uly_ms = timed(ulysses_proxy, q_u, k_u, v_u)
+        row = {"seq_len": S, "per_device_q": Sq,
+               "ring_ms": round(ring_ms, 3),
+               "ulysses_ms": round(uly_ms, 3)}
+        if ring_ms > 0 and uly_ms > 0:
+            row["ring_over_ulysses"] = round(ring_ms / uly_ms, 3)
+        else:
+            # Kernel time under the dispatch-jitter floor (short contexts
+            # / few --steps): a ratio would be noise, don't report one.
+            row["ring_over_ulysses"] = None
+            row["noise_floor"] = True
+        results.append(row)
+
+    # Headline: the ratio at the longest context. Measured ~1.8-2.9x in
+    # Ulysses' favour at every length (causal load skew: ring's last
+    # device attends the full rectangle) — hence choose_sp_impl prefers
+    # Ulysses whenever its collectives stay exact (see parallel/policy.py).
+    valid = [r for r in results if r["ring_over_ulysses"] is not None]
+    headline = valid[-1]["ring_over_ulysses"] if valid else 0.0
+    _emit("sp_crossover_ring_over_ulysses", headline,
+          "x kernel time (last valid ladder row)", 0.0,
+          sp=sp, ladder=results)
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("which", nargs="?", default="train",
                    choices=["train", "serving", "serving8b", "resnet",
                             "vit", "mixtral", "hpo", "hpo-platform",
-                            "longctx"])
+                            "longctx", "sp-crossover"])
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)
     # Default is per-bench (train 12, serving 16, resnet 256, vit 64,
@@ -711,6 +822,11 @@ def main() -> None:
     p.add_argument("--data-path", default="",
                    help="raw int32 token corpus for --loader native "
                         "('' = the loader's synthetic stream)")
+    p.add_argument("--sp", type=int, default=8,
+                   help="sp-crossover: modeled sequence-parallel extent")
+    p.add_argument("--seq-lens", type=int, nargs="+",
+                   default=[4096, 8192, 16384, 32768],
+                   help="sp-crossover: total context lengths to ladder")
     p.add_argument("--grad-accum", type=int, default=1,
                    help="microbatch gradient accumulation for the train "
                         "bench (TrainConfig.grad_accum_steps)")
@@ -738,6 +854,7 @@ def main() -> None:
         "hpo": bench_hpo,
         "hpo-platform": bench_hpo_platform,
         "longctx": bench_longctx,
+        "sp-crossover": bench_sp_crossover,
     }[args.which](args)
 
 

@@ -49,7 +49,7 @@ class TpuJobSpec:
     slice_type: str = "v5e-16"
     num_slices: int = 1                 # >1 => multislice over DCN
     mesh: MeshAxesSpec = dataclasses.field(default_factory=MeshAxesSpec)
-    attn_impl: str = "full"             # full | ring | ulysses
+    attn_impl: str = "full"             # full | flash | ring | ulysses | sp_auto
     # Workload: either a registry model (framework-run) or a custom image.
     model: str = ""                     # kubeflow_tpu.models registry name
     image: str = ""

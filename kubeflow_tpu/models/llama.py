@@ -279,6 +279,18 @@ class Attention(nn.Module):
 
     def _train_attention(self, q, k, v) -> jax.Array:
         ctx = get_context()
+        impl = ctx.attn_impl
+        if impl == "sp_auto":
+            # Resolve the measured ring/Ulysses crossover at trace time —
+            # shapes here are global (sharding is logical), so seq_len is
+            # the full context and sp_size the mesh extent.
+            from kubeflow_tpu.parallel.policy import choose_sp_impl
+
+            impl = choose_sp_impl(
+                seq_len=q.shape[1], sp=ctx.sp_size,
+                num_heads=q.shape[2], num_kv_heads=k.shape[2],
+            ) if ctx.sp_size > 1 else "flash"
+        ctx = dataclasses.replace(ctx, attn_impl=impl)
         if ctx.attn_impl == "ring" and ctx.sp_size > 1:
             return ring_attention_sharded(
                 q, k, v, ctx.mesh, causal=True

@@ -18,6 +18,7 @@ from kubeflow_tpu.parallel.sharding import (
     param_shardings,
     merge_rules,
 )
+from kubeflow_tpu.parallel.policy import choose_sp_impl
 from kubeflow_tpu.parallel.ring_attention import ring_attention
 from kubeflow_tpu.parallel.ulysses import ulysses_attention
 from kubeflow_tpu.parallel.moe import moe_dispatch, Top2GateConfig
@@ -32,6 +33,7 @@ __all__ = [
     "constrain",
     "param_shardings",
     "merge_rules",
+    "choose_sp_impl",
     "ring_attention",
     "ulysses_attention",
     "moe_dispatch",

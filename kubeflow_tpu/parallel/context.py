@@ -24,8 +24,10 @@ from kubeflow_tpu.parallel.sharding import DEFAULT_RULES, Rules, logical_spec
 class ParallelContext:
     mesh: Optional[Mesh] = None
     rules: Rules = DEFAULT_RULES
-    # "full" | "flash" | "ring" | "ulysses" — how attention handles the
-    # sequence axis ("flash": fused pallas kernel, sequence unsharded).
+    # "full" | "flash" | "ring" | "ulysses" | "sp_auto" — how attention
+    # handles the sequence axis ("flash": fused pallas kernel, sequence
+    # unsharded; "sp_auto": resolve ring-vs-Ulysses per the measured
+    # crossover in parallel.policy at trace time).
     attn_impl: str = "full"
 
     @property
@@ -50,7 +52,7 @@ def parallel_context(
     rules: Rules = DEFAULT_RULES,
     attn_impl: str = "full",
 ) -> Iterator[ParallelContext]:
-    if attn_impl not in ("full", "flash", "ring", "ulysses"):
+    if attn_impl not in ("full", "flash", "ring", "ulysses", "sp_auto"):
         raise ValueError(f"unknown attn_impl {attn_impl!r}")
     ctx = ParallelContext(mesh=mesh, rules=rules, attn_impl=attn_impl)
     token = _ctx.set(ctx)

@@ -1,0 +1,68 @@
+"""Measured ring-vs-Ulysses selection for the ``sp`` axis.
+
+Both schemes compute identical attention; they differ in where the causal
+work and the wire bytes land (SURVEY.md §5 long-context):
+
+- **Ring** keeps q sequence-sharded ([B, S/P, H, D]) and rotates the kv
+  block P-1 times over ICI. With contiguous blocks under a causal mask the
+  work is *skewed*: device p attends (p+1)/P of the sequence, so the last
+  device attends everything — a full Sq x S rectangle with no causal
+  savings, ~2x the per-device FLOPs of an even split. SPMD lockstep makes
+  that device the wall clock.
+- **Ulysses** all-to-alls q/k/v/out to head-sharded and runs ONE local
+  flash call over the full sequence ([B, S, H/P, D]). Every device
+  computes the same causal triangle; the work is perfectly balanced.
+
+Measured on one v5e chip (single-chip kernel proxy at the per-device
+shapes each scheme produces; ``bench.py sp-crossover``, H=16 Hkv=8 D=128
+bf16, min-of-3, dispatch-floor subtracted — BASELINE.md "Ring vs
+Ulysses"): ring's critical path runs **1.8-2.9x** Ulysses' kernel time
+across S=8k-32k at sp∈{4,8} — the causal-imbalance factor (asymptotically
+2x) plus ring's smaller per-call blocks. Ulysses wins whenever its
+collectives don't inflate.
+
+What the kernel proxy cannot see is the wire: per device, ring moves
+~2*B*S*Hkv*D*(P-1)/P bytes (kv rotations, overlappable with compute);
+Ulysses moves ~2*B*S*(H+Hkv)*D*(P-1)/P^2 (a2a, exposed). The ratio
+Ulysses/ring is (H+Hkv)/(Hkv*P): ~0.4 for the bench shape — Ulysses
+usually moves *less* — but extreme GQA/MQA (Hkv << H/P) flips it.
+RING_WIRE_ADVANTAGE_MAX guards that regime: past ~2x wire inflation the
+exposed a2a can eat the ~2x compute win.
+"""
+
+from __future__ import annotations
+
+# Ulysses-over-ring wire-byte ratio beyond which ring's cheap (and
+# compute-overlapped) kv rotation is preferred despite its ~2x causal
+# compute skew. Derivation + measured compute factor: module docstring.
+RING_WIRE_ADVANTAGE_MAX = 2.0
+
+
+def choose_sp_impl(
+    *,
+    seq_len: int,
+    sp: int,
+    num_heads: int,
+    num_kv_heads: int,
+) -> str:
+    """Pick "ring" or "ulysses" for a sequence-parallel attention mapping.
+
+    Rule (measured, see module docstring): Ulysses' balanced causal split
+    beats ring's skewed one by ~2x on the kernel critical path, so prefer
+    Ulysses whenever (a) both head counts divide sp exactly — otherwise
+    q can't split / kv repeats up to lcm(Hkv, sp) on the wire — and
+    (b) its a2a bytes don't exceed ring's rotation bytes by more than the
+    compute win (extreme GQA/MQA with many q heads and small sp).
+    ``seq_len`` currently doesn't change the choice (the measured factor
+    holds 8k-32k) but stays in the signature: it is the axis a future
+    zigzag-balanced ring would win back.
+    """
+    del seq_len  # measured factor is flat across 8k-32k (BASELINE.md)
+    if sp <= 1:
+        return "ring"  # degenerate: both collapse to local attention
+    if num_heads % sp != 0 or num_kv_heads % sp != 0:
+        return "ring"
+    wire_ratio = (num_heads + num_kv_heads) / (num_kv_heads * sp)
+    if wire_ratio > RING_WIRE_ADVANTAGE_MAX:
+        return "ring"
+    return "ulysses"

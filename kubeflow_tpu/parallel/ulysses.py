@@ -2,11 +2,13 @@
 
 Alternative to ring attention for short ``sp`` extents: instead of rotating
 K/V blocks P-1 times, do one all-to-all that re-shards tensors from
-sequence-sharded to head-sharded, run *local* full attention over the whole
+sequence-sharded to head-sharded, run *local* flash attention over the whole
 sequence, and all-to-all back. Two collectives total, but requires
-num_heads % sp == 0 and holds the full sequence per device during attention
-(memory O(S) vs ring's O(S/P)). The mesh planner maps ``sp`` onto an ICI
-dimension either way (kubeflow_tpu.topology.mesh).
+num_heads % sp == 0 and holds full-sequence activations per device during
+attention (O(B*S*H/P*D) — same bytes as ring's O(B*S/P*H*D), but kv is
+repeated when GQA heads don't divide sp). The mesh planner maps ``sp`` onto
+an ICI dimension either way; ``kubeflow_tpu.parallel.policy.choose_sp_impl``
+encodes the measured ring/Ulysses crossover.
 """
 
 from __future__ import annotations
@@ -19,7 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from kubeflow_tpu.ops.attention import mha_reference
+from kubeflow_tpu.ops.flash_attention import flash_attention
 
 
 def ulysses_attention(
@@ -56,7 +58,12 @@ def ulysses_attention(
         tiled=True,
     )
     qg, kg, vg = a2a(q), a2a(k), a2a(v)
-    out = mha_reference(qg, kg, vg, causal=causal, scale=scale)
+    # Local attention over the full sequence with H/P heads — exactly the
+    # flash kernel's layout. At the contexts where SP matters (8k+), the
+    # O(S^2) materialised score tensor of the reference path is what the
+    # kernel exists to avoid; flash_attention itself falls back to
+    # mha_reference for shapes that don't block cleanly (tiny tests).
+    out = flash_attention(qg, kg, vg, causal=causal, scale=scale)
     # head-sharded -> seq-sharded
     return lax.all_to_all(
         out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True

@@ -9,7 +9,7 @@ but consuming the TpuJob controller's env contract instead:
   KFTPU_PROCESS_ID            this pod's ordinal
   KFTPU_SLICE_TYPE            e.g. v5e-16
   KFTPU_MESH                  JSON {dp, pp, fsdp, tp, sp, ep}
-  KFTPU_ATTN_IMPL             full | ring | ulysses
+  KFTPU_ATTN_IMPL             full | flash | ring | ulysses | sp_auto
   KFTPU_MODEL                 registry model name
   KFTPU_CHECKPOINT_DIR        durable dir; auto-resume on restart
   KFTPU_RESTART_COUNT         gang restart generation (informational)

@@ -149,3 +149,119 @@ class TestUlyssesGqaLcm:
             q, k, v, sp_mesh, batch_axes=("dp",), head_axis=None, causal=True
         )
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+class TestUlyssesFlashPath:
+    """Kernel-eligible shapes must route Ulysses' local attention through
+    the pallas flash kernel (the a2a output is head-sharded full-sequence —
+    exactly the kernel's layout) and still match the reference. The O(S^2)
+    reference path remains only as the tiny-shape fallback inside
+    flash_attention itself."""
+
+    def _assert_flash_eligible(self, q, k, sp):
+        # Shapes as the local flash call sees them: full S, H/P heads.
+        from kubeflow_tpu.ops.flash_attention import _supported, default_blocks
+        B, S, H, D = q.shape
+        Hkv = k.shape[2]
+        bq, bkv = default_blocks(S, S)
+        assert _supported(S, S, H // sp, max(Hkv // sp, 1), bq, bkv)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, sp_mesh, causal):
+        q, k, v = _qkv(jax.random.PRNGKey(20), B=2, S=512, H=8, D=64, Hkv=4)
+        self._assert_flash_eligible(q, k, sp=4)
+        ref = mha_reference(q, k, v, causal=causal)
+        out = ulysses_attention_sharded(
+            q, k, v, sp_mesh, batch_axes=("dp",), head_axis=None, causal=causal
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_grads_match_reference(self, sp_mesh):
+        q, k, v = _qkv(jax.random.PRNGKey(21), B=2, S=512, H=8, D=64, Hkv=4)
+        co = jax.random.normal(jax.random.PRNGKey(22), q.shape)
+
+        def loss_uly(q, k, v):
+            return (ulysses_attention_sharded(
+                q, k, v, sp_mesh, batch_axes=("dp",), head_axis=None
+            ) * co).sum()
+
+        def loss_ref(q, k, v):
+            return (mha_reference(q, k, v, causal=True) * co).sum()
+
+        g_uly = jax.jit(jax.grad(loss_uly, argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for got, want, name in zip(g_uly, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=5e-4,
+                err_msg=f"d{name} mismatch through flash ulysses",
+            )
+
+    def test_parity_vs_ring_8k(self, sp_mesh):
+        """At the contexts SP exists for (8k+), ring and Ulysses are two
+        routings of the same attention: outputs must agree without either
+        touching an O(S^2) score tensor."""
+        q, k, v = _qkv(jax.random.PRNGKey(23), B=2, S=8192, H=8, D=64, Hkv=4)
+        self._assert_flash_eligible(q, k, sp=4)
+        ring = ring_attention_sharded(
+            q, k, v, sp_mesh, batch_axes=("dp",), head_axis=None, causal=True
+        )
+        uly = ulysses_attention_sharded(
+            q, k, v, sp_mesh, batch_axes=("dp",), head_axis=None, causal=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(uly), np.asarray(ring), atol=2e-4)
+
+
+class TestSpPolicy:
+    """choose_sp_impl encodes the MEASURED crossover (bench.py
+    sp-crossover): Ulysses' balanced causal split beats ring's skewed one
+    ~2x on the kernel critical path, so Ulysses is preferred whenever its
+    collectives stay exact (head counts divide sp) and its a2a bytes don't
+    inflate past the compute win (extreme GQA/MQA)."""
+
+    def test_divisible_heads_prefer_ulysses_at_any_length(self):
+        from kubeflow_tpu.parallel.policy import choose_sp_impl
+        for S in (2048, 8192, 32768):
+            assert choose_sp_impl(
+                seq_len=S, sp=4, num_heads=32, num_kv_heads=8) == "ulysses"
+
+    def test_indivisible_heads_force_ring(self):
+        from kubeflow_tpu.parallel.policy import choose_sp_impl
+        assert choose_sp_impl(
+            seq_len=2048, sp=4, num_heads=6, num_kv_heads=2) == "ring"
+
+    def test_gqa_repeat_forces_ring(self):
+        # kv heads don't divide sp: Ulysses would inflate kv on the wire.
+        from kubeflow_tpu.parallel.policy import choose_sp_impl
+        assert choose_sp_impl(
+            seq_len=2048, sp=4, num_heads=8, num_kv_heads=2) == "ring"
+
+    def test_extreme_gqa_wire_ratio_forces_ring(self):
+        # Divisible, but Ulysses' a2a would move (16+2)/(2*2) = 4.5x
+        # ring's rotation bytes — past the ~2x compute win.
+        from kubeflow_tpu.parallel.policy import choose_sp_impl
+        assert choose_sp_impl(
+            seq_len=8192, sp=2, num_heads=16, num_kv_heads=2) == "ring"
+
+    def test_sp_auto_resolves_in_training(self, devices8):
+        """attn_impl='sp_auto' must trace and step end-to-end (tiny config
+        has 2 kv heads vs sp=4: resolves to ring via the divisibility
+        guard)."""
+        from kubeflow_tpu.models import Llama, LlamaConfig
+        from kubeflow_tpu.topology import AxisSpec
+        from kubeflow_tpu.topology.mesh import make_host_local_mesh
+        from kubeflow_tpu.train import TrainConfig, Trainer
+        from kubeflow_tpu.train.data import SyntheticTextConfig, synthetic_text
+
+        mesh = make_host_local_mesh(AxisSpec(dp=2, sp=4))
+        model = Llama(LlamaConfig.tiny(scan_layers=True, num_layers=2))
+        trainer = Trainer(
+            model, TrainConfig(task="lm", attn_impl="sp_auto",
+                               warmup_steps=1), mesh)
+        it = synthetic_text(SyntheticTextConfig(
+            batch_size=4, seq_len=32, vocab_size=256))
+        batch = trainer.shard_batch(
+            {kk: jnp.asarray(vv) for kk, vv in next(it).items()})
+        state = trainer.init_state(jax.random.PRNGKey(0), batch)
+        state, metrics = trainer.step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
