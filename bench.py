@@ -43,6 +43,18 @@ MIXTRAL_ARCH = dict(
     num_kv_heads=8, head_dim=64, mlp_dim=2048, num_experts=8,
 )
 
+# Falsification probe for the "config-3's 25-26% MFU ceiling is the small
+# arch, not the framework" claim (BASELINE.md round-4b): same family with
+# head_dim 128 (the dense model's well-tiling size) and 2x wider expert
+# matmuls ([*, 2048]x[2048, 2048]), still one-chip-sized (~835M total,
+# ~380M active). If the claim is right this config should clear ~40% MFU
+# on the SAME framework code; if it doesn't, the framework has a real MoE
+# bottleneck to find. `bench.py mixtral --arch d128`.
+MIXTRAL_D128_ARCH = dict(
+    vocab_size=32000, embed_dim=2048, num_layers=6, num_heads=16,
+    num_kv_heads=8, head_dim=128, mlp_dim=2048, num_experts=8,
+)
+
 
 def _emit(metric: str, value: float, unit: str, baseline: float, **extra):
     print(json.dumps({
@@ -462,14 +474,16 @@ def bench_mixtral(args) -> None:
     # the standard Switch/GShard production setting. Measured r4 ladder:
     # einsum 55.8k -> index-gather dispatch 63.4k -> cap 1.0 70.9k tok/s.
     policy = args.remat_policy or "minimal"
+    arch = MIXTRAL_D128_ARCH if args.arch == "d128" else MIXTRAL_ARCH
     cfg = MixtralConfig(
-        **MIXTRAL_ARCH,
+        **arch,
         max_seq_len=args.seq_len, scan_layers=True,
         remat=policy != "none",
         remat_policy=policy if policy != "none" else "full",
         logits_f32=not args.bf16_logits,
         param_dtype=jnp.dtype(args.param_dtype),
         capacity_factor=args.capacity_factor or 1.0,
+        moe_dispatch=args.moe_dispatch,
     )
     model = Mixtral(cfg)
     ndev = len(jax.devices())
@@ -484,7 +498,7 @@ def bench_mixtral(args) -> None:
                     mu_dtype=args.mu_dtype),
         mesh,
     )
-    bs = args.batch_size or 8
+    bs = args.batch_size or (6 if args.arch == "d128" else 8)
     it = synthetic_text(SyntheticTextConfig(
         batch_size=bs * ndev, seq_len=args.seq_len,
         vocab_size=cfg.vocab_size,
@@ -512,7 +526,7 @@ def bench_mixtral(args) -> None:
     _emit(
         "mixtral_moe_train_tokens_per_sec_per_chip", tps_chip,
         "tokens/s/chip", BASELINES["mixtral"],
-        ep=ep,
+        ep=ep, arch=args.arch,
         mfu=round(tps_chip * flops_per_token / (peak * 1e12), 4)
         if peak > 0 else 0.0,
     )
@@ -822,6 +836,12 @@ def main() -> None:
     p.add_argument("--data-path", default="",
                    help="raw int32 token corpus for --loader native "
                         "('' = the loader's synthetic stream)")
+    p.add_argument("--moe-dispatch", default="auto",
+                   choices=["auto", "gather", "einsum"],
+                   help="MoE dispatch mechanism A/B (MixtralConfig)")
+    p.add_argument("--arch", default="d64", choices=["d64", "d128"],
+                   help="mixtral train bench arch: d64 = config 3; d128 = "
+                        "the wider head_dim-128 falsification probe")
     p.add_argument("--sp", type=int, default=8,
                    help="sp-crossover: modeled sequence-parallel extent")
     p.add_argument("--seq-lens", type=int, nargs="+",

@@ -290,16 +290,15 @@ class Attention(nn.Module):
                 seq_len=q.shape[1], sp=ctx.sp_size,
                 num_heads=q.shape[2], num_kv_heads=k.shape[2],
             ) if ctx.sp_size > 1 else "flash"
-        ctx = dataclasses.replace(ctx, attn_impl=impl)
-        if ctx.attn_impl == "ring" and ctx.sp_size > 1:
+        if impl == "ring" and ctx.sp_size > 1:
             return ring_attention_sharded(
                 q, k, v, ctx.mesh, causal=True
             )
-        if ctx.attn_impl == "ulysses" and ctx.sp_size > 1:
+        if impl == "ulysses" and ctx.sp_size > 1:
             return ulysses_attention_sharded(
                 q, k, v, ctx.mesh, causal=True
             )
-        if ctx.attn_impl == "flash":
+        if impl == "flash":
             if ctx.sp_size > 1:
                 # Sequence-sharded activations: the pallas call can't be
                 # SPMD-partitioned on seq, so route through the ring (which
