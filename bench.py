@@ -425,15 +425,36 @@ def _bench_image(args, model_name: str, default_bs: int,
         state, metrics = trainer.step(state, batch)
     if args.warmup > 0:
         _sync(metrics["loss"])
+    if args.trace_dir:
+        jax.profiler.start_trace(args.trace_dir)
     t0 = time.perf_counter()
     for _ in range(args.steps):
         state, metrics = trainer.step(state, batch)
     _sync(metrics["loss"])
     dt = time.perf_counter() - t0
+    if args.trace_dir:
+        jax.profiler.stop_trace()
+
+    # MFU via XLA's own cost model (FMA = 2 flops, the same convention as
+    # device_peak_tflops) — vision archs have no single "params x tokens"
+    # formula like the LLM rows, and the compiled step's counted flops is
+    # the honest, convention-consistent numerator.
+    from kubeflow_tpu.train.flops import device_peak_tflops
+    peak = device_peak_tflops()
+    mfu = {}
+    if peak > 0:
+        try:
+            cost = trainer.step_cost_analysis(state, batch)
+            step_flops = float(cost.get("flops", 0.0))
+            if step_flops > 0:
+                mfu = {"mfu": round(
+                    step_flops * args.steps / dt / (peak * 1e12) / ndev, 4)}
+        except Exception as e:  # cost analysis is best-effort per backend
+            mfu = {"mfu_error": str(e)[:80]}
     _emit(
         metric, bs * args.steps / dt / ndev, "images/s/chip",
         BASELINES.get(baseline_key, 0.0),
-        batch=bs,
+        batch=bs, **mfu,
     )
 
 

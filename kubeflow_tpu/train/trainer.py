@@ -510,6 +510,17 @@ class Trainer:
         with self.mesh:
             return self.compile_step()(state, batch, rng)
 
+    def step_cost_analysis(self, state: TrainState, batch, rng=None) -> Dict:
+        """XLA cost analysis of the compiled train step (flops counted at
+        the FMA=2 convention — comparable against device peak TFLOPs).
+        Lowers+compiles a second executable; use for benching, not in the
+        step loop."""
+        with self.mesh:
+            lowered = jax.jit(
+                self._train_step, donate_argnums=(0,)
+            ).lower(state, batch, rng)
+            return dict(lowered.compile().cost_analysis() or {})
+
     # ---------------- eval ----------------
 
     def _eval_step(self, state: TrainState, batch):
