@@ -445,10 +445,12 @@ def _bench_image(args, model_name: str, default_bs: int,
     if peak > 0:
         try:
             cost = trainer.step_cost_analysis(state, batch)
+            # cost_analysis reports the SPMD-partitioned per-device
+            # executable's flops — already per-chip, no ndev division.
             step_flops = float(cost.get("flops", 0.0))
             if step_flops > 0:
                 mfu = {"mfu": round(
-                    step_flops * args.steps / dt / (peak * 1e12) / ndev, 4)}
+                    step_flops * args.steps / dt / (peak * 1e12), 4)}
         except Exception as e:  # cost analysis is best-effort per backend
             mfu = {"mfu_error": str(e)[:80]}
     _emit(

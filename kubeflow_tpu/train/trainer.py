@@ -516,9 +516,7 @@ class Trainer:
         Lowers+compiles a second executable; use for benching, not in the
         step loop."""
         with self.mesh:
-            lowered = jax.jit(
-                self._train_step, donate_argnums=(0,)
-            ).lower(state, batch, rng)
+            lowered = self.compile_step().lower(state, batch, rng)
             return dict(lowered.compile().cost_analysis() or {})
 
     # ---------------- eval ----------------
