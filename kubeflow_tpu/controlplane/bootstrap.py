@@ -99,10 +99,17 @@ function showErr(e) {
   document.getElementById('err').textContent = e ? String(e.message || e)
                                                  : '';
 }
+let listErr = false;
 async function refresh() {
   let out;
-  try { out = await api('__PREFIX__/list'); showErr(''); }
-  catch (e) { showErr(e); return; }
+  // Only clear an error THIS list path set: a create/delete failure
+  // rendered by the submit handler must survive the trailing refresh()
+  // (found by the executed-page tier: the error flashed and vanished).
+  try {
+    out = await api('__PREFIX__/list');
+    if (listErr) { showErr(''); listErr = false; }
+  }
+  catch (e) { showErr(e); listErr = true; return; }
   const list = document.getElementById('list');
   list.innerHTML = '<table><tr><th>name</th><th>phase</th>' +
     '<th>components</th><th>error</th><th></th></tr>' +
@@ -119,7 +126,7 @@ async function refresh() {
       await api('__PREFIX__/delete/' + encodeURIComponent(b.dataset.name),
                 {method: 'DELETE'});
       showErr('');
-    } catch (e) { showErr(e); }
+    } catch (e) { showErr(e); listErr = false; }
     refresh();
   });
 }
@@ -144,7 +151,7 @@ document.getElementById('deploy').onsubmit = async (e) => {
         },
       })});
     showErr('');
-  } catch (err) { showErr(err); }
+  } catch (err) { showErr(err); listErr = false; }
   refresh();
 };
 refresh(); setInterval(refresh, 2000);

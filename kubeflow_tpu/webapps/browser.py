@@ -1,0 +1,268 @@
+"""MicroBrowser — a headless page harness that EXECUTES the served UI.
+
+The reference drives its frontends with real browsers (Selenium over
+jupyter-web-app — testing/test_jwa.py:32-423 — and puppeteer over
+centraldashboard — components/centraldashboard/test/e2e.test.ts). This
+image has no browser and no JS runtime, so the framework ships the whole
+stack itself: ``minijs`` interprets the page script, and this module is
+the browser around it — document/elements, (synchronous) fetch against
+the live HTTP server with the trusted identity header injected (standing
+in for the gatekeeper AuthProxy), and enough form/select semantics for
+the pages' flows.
+
+What is faithfully modeled (because the pages use it):
+
+- ``document.getElementById`` with an auto-creating element registry;
+  elements carry ``innerHTML``/``value``/``textContent`` and writable
+  ``onsubmit``/``onclick``/``onchange`` handler slots
+- setting ``innerHTML`` containing ``<option>`` rows updates ``value`` to
+  the first option (browser select behavior the scripts rely on)
+- ``element.querySelectorAll('button.del')`` parses the element's
+  rendered HTML and returns stable button objects (handler assignments
+  from the page's event-delegation pass stay addressable by the test)
+- ``document.querySelectorAll('input.comp:checked')`` over the static
+  page HTML (the click-to-deploy component checkboxes)
+- ``fetch(path, opts)``: urllib against ``base_url`` with the identity
+  header; a Response exposes ``ok``/``status``/``statusText``/``json()``
+
+Async collapses to synchronous execution (see minijs), so after
+``submit()``/``click()`` return, every await in the handler chain —
+including the refresh re-render — has completed: no settling sleeps.
+"""
+
+from __future__ import annotations
+
+import html as _html_mod
+import json as _json
+import re
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional
+
+from kubeflow_tpu.webapps.minijs import Interpreter, undefined
+
+__all__ = ["MicroBrowser", "Element"]
+
+_OPTION_RE = re.compile(
+    r"<option(?P<attrs>[^>]*)>(?P<text>[^<]*)", re.I)
+_VALUE_ATTR_RE = re.compile(r'value="(?P<v>[^"]*)"')
+_DEL_BTN_RE = re.compile(r'<button class="del" data-name="(?P<name>[^"]*)"')
+_CHECKBOX_RE = re.compile(
+    r'<input type="checkbox" class="comp" value="(?P<v>[^"]*)"'
+    r"(?P<checked> checked)?", re.I)
+_SCRIPT_RE = re.compile(r"<script>(.*?)</script>", re.S)
+
+
+def _unescape(s: str) -> str:
+    return _html_mod.unescape(s)
+
+
+class _DelButton:
+    """A delegation-target button: the page assigns ``onclick`` on it."""
+
+    def __init__(self, name: str):
+        self.dataset = {"name": name}
+        self.onclick: Optional[Callable] = None
+
+
+class _Checkbox:
+    def __init__(self, value: str, checked: bool):
+        self.value = value
+        self.checked = checked
+
+
+class Element:
+    """Just enough DOM element: handler slots are ordinary attributes
+    (minijs host-object setattr), innerHTML tracks select semantics."""
+
+    def __init__(self, el_id: str):
+        self.id = el_id
+        self._html = ""
+        self.value = ""
+        self.textContent = ""
+        self.onsubmit: Optional[Callable] = None
+        self.onclick: Optional[Callable] = None
+        self.onchange: Optional[Callable] = None
+        self._del_buttons: List[_DelButton] = []
+
+    # innerHTML is a property so select-value and delegation-button
+    # bookkeeping stay in sync with what the page renders.
+    @property
+    def innerHTML(self) -> str:  # noqa: N802 — DOM casing
+        return self._html
+
+    @innerHTML.setter
+    def innerHTML(self, v) -> None:  # noqa: N802
+        self._html = str(v)
+        self._del_buttons = []
+        m = _OPTION_RE.search(self._html)
+        if m is not None:
+            # Browser behavior: assigning options selects the first one.
+            va = _VALUE_ATTR_RE.search(m.group("attrs") or "")
+            self.value = _unescape(
+                va.group("v") if va is not None else m.group("text"))
+
+    def querySelectorAll(self, selector):  # noqa: N802 — DOM casing
+        if selector == "button.del":
+            if not self._del_buttons:
+                self._del_buttons = [
+                    _DelButton(_unescape(m.group("name")))
+                    for m in _DEL_BTN_RE.finditer(self._html)
+                ]
+            return list(self._del_buttons)
+        return []
+
+    def del_button(self, name: str) -> _DelButton:
+        """Test accessor: the button object the page's delegation pass
+        assigned ``onclick`` on (same identity, not a re-parse)."""
+        for b in self._del_buttons or self.querySelectorAll("button.del"):
+            if b.dataset["name"] == name:
+                return b
+        raise AssertionError(
+            f"no delete button for {name!r} in #{self.id}: {self._html!r}")
+
+
+class _Document:
+    def __init__(self, page_html: str):
+        self._elements: Dict[str, Element] = {}
+        self._page_html = page_html
+        self._checkboxes = [
+            _Checkbox(_unescape(m.group("v")), bool(m.group("checked")))
+            for m in _CHECKBOX_RE.finditer(page_html)
+        ]
+
+    def getElementById(self, el_id):  # noqa: N802 — DOM casing
+        el_id = str(el_id)
+        if el_id not in self._elements:
+            self._elements[el_id] = Element(el_id)
+        return self._elements[el_id]
+
+    def querySelectorAll(self, selector):  # noqa: N802 — DOM casing
+        if selector == "input.comp:checked":
+            return [c for c in self._checkboxes if c.checked]
+        return []
+
+
+class _Response:
+    def __init__(self, status: int, body: bytes, reason: str = ""):
+        self.status = float(status)
+        self.ok = 200 <= status < 300
+        self.statusText = reason or str(status)
+        self._body = body
+
+    def json(self):
+        try:
+            return _json.loads(self._body.decode() or "null")
+        except ValueError:
+            return {"error": self._body.decode(errors="replace")[:200]}
+
+
+class _Location:
+    def __init__(self):
+        self.reloaded = 0
+
+    def reload(self):
+        self.reloaded += 1
+
+
+class Event:
+    """The event object handlers receive: only preventDefault is used."""
+
+    def __init__(self):
+        self.default_prevented = False
+
+    def preventDefault(self):  # noqa: N802 — DOM casing
+        self.default_prevented = True
+
+
+class MicroBrowser:
+    def __init__(self, base_url: str, *,
+                 user_header: Optional[str] = None,
+                 user: Optional[str] = None):
+        self.base_url = base_url.rstrip("/")
+        self.user_header = user_header
+        self.user = user
+        self.document: Optional[_Document] = None
+        self.location = _Location()
+        self.interp: Optional[Interpreter] = None
+        self.page_html = ""
+
+    # ---------------- network ----------------
+
+    def fetch(self, path, opts=undefined):
+        opts = opts if isinstance(opts, dict) else {}
+        method = str(opts.get("method", "GET"))
+        headers = dict(opts.get("headers") or {})
+        if self.user_header and self.user:
+            headers[self.user_header] = self.user
+        body = opts.get("body")
+        data = str(body).encode() if isinstance(body, str) else None
+        url = path if str(path).startswith("http") else \
+            self.base_url + str(path)
+        req = urllib.request.Request(
+            url, data=data, headers=headers, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return _Response(r.status, r.read(), r.reason or "")
+        except urllib.error.HTTPError as e:
+            return _Response(e.code, e.read(), e.reason or "")
+
+    # ---------------- page lifecycle ----------------
+
+    def open(self, path: str) -> "MicroBrowser":
+        """GET the page, build the document, EXECUTE its inline scripts.
+        Returns self; on return the page's init flow (and every await in
+        it) has completed."""
+        r = self.fetch(path)
+        if not r.ok:
+            raise AssertionError(
+                f"GET {path} -> {int(r.status)} {r.statusText}")
+        self.page_html = r._body.decode()
+        self.document = _Document(self.page_html)
+        self.interp = Interpreter({
+            "document": self.document,
+            "location": self.location,
+            "fetch": self.fetch,
+            "setInterval": lambda fn, ms=0.0, *a: 0.0,
+            "setTimeout": lambda fn, ms=0.0, *a: fn(),
+            "clearInterval": lambda h=0.0: undefined,
+            "window": {},
+        })
+        scripts = _SCRIPT_RE.findall(self.page_html)
+        if not scripts:
+            raise AssertionError(f"page {path} has no inline script")
+        for script in scripts:
+            self.interp.run(script)
+        return self
+
+    # ---------------- interaction ----------------
+
+    def element(self, el_id: str) -> Element:
+        assert self.document is not None, "open() a page first"
+        return self.document.getElementById(el_id)
+
+    def set_value(self, el_id: str, value: str) -> None:
+        self.element(el_id).value = value
+
+    def submit(self, form_id: str) -> Event:
+        """Fire the form's submit handler exactly as the browser would.
+        Raises minijs.JSError if the handler throws (e.g. an api() error
+        the page chose not to catch)."""
+        el = self.element(form_id)
+        assert callable(el.onsubmit), f"#{form_id} has no submit handler"
+        ev = Event()
+        el.onsubmit(ev)
+        return ev
+
+    def click_delete(self, list_id: str, name: str) -> None:
+        """Click the delegation-bound delete button for ``name``."""
+        btn = self.element(list_id).del_button(name)
+        assert callable(btn.onclick), \
+            f"page never bound onclick for {name!r}"
+        btn.onclick()
+
+    def call(self, fn_name: str, *args) -> Any:
+        """Invoke a page-script global (e.g. a manual refresh())."""
+        assert self.interp is not None
+        fn = self.interp.globals[fn_name]
+        return fn(*args)

@@ -1,0 +1,1650 @@
+"""minijs — a minimal JavaScript interpreter for executing the page scripts.
+
+Why this exists: the reference's UI tier is tested by *executing* its
+frontend code against a live backend (Selenium over the jupyter-web-app —
+reference testing/test_jwa.py:32-423 — and puppeteer over centraldashboard —
+components/centraldashboard/test/e2e.test.ts). This image ships no JS
+runtime (node/bun/deno absent, zero egress), so the framework vendors one:
+a small tree-walking interpreter covering exactly the dialect the pages
+are written in (webapps/frontend.py, controlplane/bootstrap.py — the
+builder controls both sides of this contract).
+
+Dialect covered (and intentionally nothing more):
+
+- ``const``/``let`` (multi-declarator, array-destructuring patterns),
+  function declarations, arrow functions (expression and block bodies,
+  destructured params), ``async``/``await``
+- template literals with nested ``${...}`` substitutions, string/regex
+  literals, object/array literals (shorthand props, computed keys,
+  spread), ``new``, ``typeof``-free — the pages never use it
+- member/index/call chains, optional spread args, ternary, ``||``/``&&``,
+  strict (in)equality, arithmetic with JS string-concat semantics
+- ``if``/``else``, ``for...of``, ``try``/``catch``, ``throw``, ``return``
+- stdlib the pages touch: ``String``/``Number``/``Array.isArray``/
+  ``Object.entries``/``Object.assign``/``JSON.stringify``/``Math``/
+  ``Promise.all``/``encodeURIComponent``, string ``replace`` (with regex +
+  callback), array ``map/filter/find/forEach/join/slice/push/includes``,
+  number ``toFixed``/``toPrecision``
+
+**Async model**: the pages' async functions are linear awaits over fetch;
+the host ``fetch`` shim is synchronous under the hood, so ``await`` simply
+evaluates its operand and ``async`` functions run eagerly to completion
+(``Promise.all`` maps to its argument list). This collapses the microtask
+queue — correct for the pages' sequential flows, and what makes the
+interpreter small enough to vendor.
+
+**Host interop**: JS values ARE Python values (dict/list/str/float/bool/
+None + an ``undefined`` sentinel); host objects (the DOM shim's elements,
+fetch responses) are ordinary Python objects accessed via getattr/setattr,
+so the test harness writes its browser shim in Python.
+"""
+
+from __future__ import annotations
+
+import json as _json
+import math
+import re as _re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["Interpreter", "JSError", "Undefined", "undefined"]
+
+
+# ---------------------------------------------------------------- values
+
+
+class _UndefinedType:
+    _inst: Optional["_UndefinedType"] = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "undefined"
+
+    def __bool__(self):
+        return False
+
+
+Undefined = _UndefinedType
+undefined = _UndefinedType()
+
+
+class JSError(Exception):
+    """A thrown JS value (``throw`` / runtime errors). ``.value`` is the
+    thrown value — for ``new Error(m)`` a dict with a ``message`` key."""
+
+    def __init__(self, value):
+        self.value = value
+        super().__init__(js_to_string(
+            value.get("message") if isinstance(value, dict) else value))
+
+
+class _Return(Exception):
+    def __init__(self, value):
+        self.value = value
+
+
+def js_truthy(v) -> bool:
+    if v is undefined or v is None or v is False:
+        return False
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return not (v == 0 or (isinstance(v, float) and math.isnan(v)))
+    if isinstance(v, str):
+        return len(v) > 0
+    return True
+
+
+def js_to_string(v) -> str:
+    if v is undefined:
+        return "undefined"
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "NaN"
+        if math.isinf(v):
+            return "Infinity" if v > 0 else "-Infinity"
+        if v == int(v) and abs(v) < 1e21:
+            return str(int(v))
+        return repr(v)
+    if isinstance(v, int):
+        return str(v)
+    if isinstance(v, str):
+        return v
+    if isinstance(v, list):
+        return ",".join("" if x is undefined or x is None else js_to_string(x)
+                        for x in v)
+    if isinstance(v, dict):
+        return "[object Object]"
+    if callable(v):
+        return "function"
+    return str(v)
+
+
+def js_to_number(v) -> float:
+    if isinstance(v, bool):
+        return 1.0 if v else 0.0
+    if isinstance(v, (int, float)):
+        return float(v)
+    if v is None:
+        return 0.0
+    if isinstance(v, str):
+        s = v.strip()
+        if not s:
+            return 0.0
+        try:
+            return float(s)
+        except ValueError:
+            return float("nan")
+    return float("nan")
+
+
+def _js_regex(pattern: str, flags: str):
+    f = 0
+    if "i" in flags:
+        f |= _re.IGNORECASE
+    if "s" in flags:
+        f |= _re.DOTALL
+    if "m" in flags:
+        f |= _re.MULTILINE
+    return _re.compile(pattern, f)
+
+
+class _Regex:
+    def __init__(self, pattern: str, flags: str):
+        self.source, self.flags = pattern, flags
+        self.re = _js_regex(pattern, flags)
+        self.global_ = "g" in flags
+
+
+# ---------------------------------------------------------------- lexer
+
+_PUNCT = [
+    "...", "===", "!==", "=>", "<=", ">=", "&&", "||", "++", "--",
+    "+=", "-=", "*=", "/=", "==", "!=",
+    "(", ")", "[", "]", "{", "}", ",", ";", ":", "?", ".", "=",
+    "+", "-", "*", "/", "%", "<", ">", "!",
+]
+
+_KEYWORDS = {
+    "const", "let", "var", "function", "return", "if", "else", "for",
+    "of", "while", "try", "catch", "finally", "throw", "new", "async",
+    "await", "true", "false", "null", "undefined", "typeof", "in",
+}
+
+
+class _Tok:
+    __slots__ = ("kind", "value", "line")
+
+    def __init__(self, kind, value, line):
+        self.kind, self.value, self.line = kind, value, line
+
+    def __repr__(self):
+        return f"{self.kind}({self.value!r})@{self.line}"
+
+
+class _Lexer:
+    """Produces a token list. Template literals become one token whose
+    value is a list of ('str', text) / ('expr', subtokens) parts —
+    substitutions are recursively lexed (nesting included)."""
+
+    def __init__(self, src: str):
+        self.src = src
+        self.i = 0
+        self.line = 1
+        self.toks: List[_Tok] = []
+
+    def error(self, msg):
+        raise SyntaxError(f"minijs lex error line {self.line}: {msg}")
+
+    def lex(self) -> List[_Tok]:
+        while self.i < len(self.src):
+            c = self.src[self.i]
+            if c == "\n":
+                self.line += 1
+                self.i += 1
+                continue
+            if c in " \t\r":
+                self.i += 1
+                continue
+            if self.src.startswith("//", self.i):
+                nl = self.src.find("\n", self.i)
+                self.i = len(self.src) if nl < 0 else nl
+                continue
+            if self.src.startswith("/*", self.i):
+                end = self.src.find("*/", self.i + 2)
+                if end < 0:
+                    self.error("unterminated block comment")
+                self.line += self.src.count("\n", self.i, end)
+                self.i = end + 2
+                continue
+            if c == "`":
+                self.toks.append(self._template())
+                continue
+            if c in "'\"":
+                self.toks.append(self._string(c))
+                continue
+            if c.isdigit() or (c == "." and self.i + 1 < len(self.src)
+                               and self.src[self.i + 1].isdigit()):
+                self.toks.append(self._number())
+                continue
+            if c.isalpha() or c in "_$":
+                self.toks.append(self._ident())
+                continue
+            if c == "/" and self._regex_allowed():
+                self.toks.append(self._regex())
+                continue
+            for p in _PUNCT:
+                if self.src.startswith(p, self.i):
+                    self.toks.append(_Tok("punct", p, self.line))
+                    self.i += len(p)
+                    break
+            else:
+                self.error(f"unexpected character {c!r}")
+        self.toks.append(_Tok("eof", None, self.line))
+        return self.toks
+
+    def _regex_allowed(self) -> bool:
+        for t in reversed(self.toks):
+            if t.kind in ("num", "str", "template", "regex"):
+                return False
+            if t.kind == "ident" and t.value not in _KEYWORDS:
+                return False
+            if t.kind == "ident":       # keyword: return /.../ is a regex
+                return True
+            if t.kind == "punct":
+                return t.value not in (")", "]", "}")
+        return True
+
+    def _string(self, quote) -> _Tok:
+        self.i += 1
+        out = []
+        while self.i < len(self.src):
+            c = self.src[self.i]
+            if c == "\\":
+                out.append(self._escape())
+                continue
+            if c == quote:
+                self.i += 1
+                return _Tok("str", "".join(out), self.line)
+            if c == "\n":
+                self.error("unterminated string")
+            out.append(c)
+            self.i += 1
+        self.error("unterminated string")
+
+    def _escape(self) -> str:
+        self.i += 1  # backslash
+        c = self.src[self.i]
+        self.i += 1
+        table = {"n": "\n", "t": "\t", "r": "\r", "b": "\b", "f": "\f",
+                 "0": "\0", "\n": ""}
+        if c == "u":
+            hexs = self.src[self.i:self.i + 4]
+            self.i += 4
+            return chr(int(hexs, 16))
+        if c == "x":
+            hexs = self.src[self.i:self.i + 2]
+            self.i += 2
+            return chr(int(hexs, 16))
+        return table.get(c, c)
+
+    def _number(self) -> _Tok:
+        m = _re.match(r"0[xX][0-9a-fA-F]+|\d+\.?\d*(?:[eE][+-]?\d+)?|\.\d+",
+                      self.src[self.i:])
+        text = m.group(0)
+        self.i += len(text)
+        if text.lower().startswith("0x"):
+            return _Tok("num", float(int(text, 16)), self.line)
+        return _Tok("num", float(text), self.line)
+
+    def _ident(self) -> _Tok:
+        m = _re.match(r"[A-Za-z_$][A-Za-z0-9_$]*", self.src[self.i:])
+        text = m.group(0)
+        self.i += len(text)
+        return _Tok("ident", text, self.line)
+
+    def _regex(self) -> _Tok:
+        start = self.i
+        self.i += 1  # /
+        in_class = False
+        body = []
+        while self.i < len(self.src):
+            c = self.src[self.i]
+            if c == "\\":
+                body.append(self.src[self.i:self.i + 2])
+                self.i += 2
+                continue
+            if c == "[":
+                in_class = True
+            elif c == "]":
+                in_class = False
+            elif c == "/" and not in_class:
+                self.i += 1
+                m = _re.match(r"[a-z]*", self.src[self.i:])
+                flags = m.group(0)
+                self.i += len(flags)
+                return _Tok("regex", ("".join(body), flags), self.line)
+            elif c == "\n":
+                break
+            body.append(c)
+            self.i += 1
+        self.i = start
+        self.error("unterminated regex")
+
+    def _template(self) -> _Tok:
+        self.i += 1  # backtick
+        parts: List[Tuple[str, Any]] = []
+        buf: List[str] = []
+        while self.i < len(self.src):
+            c = self.src[self.i]
+            if c == "\\":
+                buf.append(self._escape())
+                continue
+            if c == "`":
+                self.i += 1
+                if buf:
+                    parts.append(("str", "".join(buf)))
+                return _Tok("template", parts, self.line)
+            if self.src.startswith("${", self.i):
+                if buf:
+                    parts.append(("str", "".join(buf)))
+                    buf = []
+                self.i += 2
+                sub = self._sub_expression()
+                parts.append(("expr", sub))
+                continue
+            if c == "\n":
+                self.line += 1
+            buf.append(c)
+            self.i += 1
+        self.error("unterminated template literal")
+
+    def _sub_expression(self) -> List[_Tok]:
+        """Lex tokens until the matching close brace of a ``${``."""
+        depth = 0
+        sub = _Lexer("")
+        sub.src = self.src
+        sub.i = self.i
+        sub.line = self.line
+        while sub.i < len(sub.src):
+            # Peek at raw chars for the brace bookkeeping, but delegate all
+            # tokenization (strings, nested templates, regexes) to the
+            # sub-lexer's machinery by lexing one token at a time.
+            c = sub.src[sub.i]
+            if c == "}" and depth == 0:
+                sub.toks.append(_Tok("eof", None, sub.line))
+                self.i = sub.i + 1
+                self.line = sub.line
+                return sub.toks
+            before = len(sub.toks)
+            sub._lex_one()
+            for t in sub.toks[before:]:
+                if t.kind == "punct" and t.value == "{":
+                    depth += 1
+                elif t.kind == "punct" and t.value == "}":
+                    depth -= 1
+        self.error("unterminated ${...} substitution")
+
+    def _lex_one(self):
+        """Advance by exactly one token (or skip whitespace/comments)."""
+        while self.i < len(self.src):
+            c = self.src[self.i]
+            if c == "\n":
+                self.line += 1
+                self.i += 1
+                continue
+            if c in " \t\r":
+                self.i += 1
+                continue
+            if self.src.startswith("//", self.i):
+                nl = self.src.find("\n", self.i)
+                self.i = len(self.src) if nl < 0 else nl
+                continue
+            if self.src.startswith("/*", self.i):
+                end = self.src.find("*/", self.i + 2)
+                self.line += self.src.count("\n", self.i, end)
+                self.i = end + 2
+                continue
+            break
+        if self.i >= len(self.src):
+            return
+        c = self.src[self.i]
+        if c == "`":
+            self.toks.append(self._template())
+        elif c in "'\"":
+            self.toks.append(self._string(c))
+        elif c.isdigit():
+            self.toks.append(self._number())
+        elif c.isalpha() or c in "_$":
+            self.toks.append(self._ident())
+        elif c == "/" and self._regex_allowed():
+            self.toks.append(self._regex())
+        else:
+            for p in _PUNCT:
+                if self.src.startswith(p, self.i):
+                    self.toks.append(_Tok("punct", p, self.line))
+                    self.i += len(p)
+                    return
+            self.error(f"unexpected character {c!r}")
+
+
+# ---------------------------------------------------------------- parser
+#
+# AST nodes are plain tuples: (kind, ...). Kept positional for compactness;
+# the evaluator is the single consumer.
+
+
+class _Parser:
+    def __init__(self, toks: List[_Tok]):
+        self.toks = toks
+        self.i = 0
+
+    # -- helpers --
+
+    def peek(self, k=0) -> _Tok:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self) -> _Tok:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def at(self, value) -> bool:
+        t = self.peek()
+        return t.kind == "punct" and t.value == value
+
+    def at_kw(self, word) -> bool:
+        t = self.peek()
+        return t.kind == "ident" and t.value == word
+
+    def expect(self, value) -> _Tok:
+        t = self.next()
+        if t.kind != "punct" or t.value != value:
+            raise SyntaxError(
+                f"minijs parse error line {t.line}: expected {value!r}, "
+                f"got {t.kind} {t.value!r}")
+        return t
+
+    def expect_kw(self, word):
+        t = self.next()
+        if t.kind != "ident" or t.value != word:
+            raise SyntaxError(
+                f"minijs parse error line {t.line}: expected {word!r}")
+
+    # -- entry --
+
+    def parse_program(self):
+        body = []
+        while self.peek().kind != "eof":
+            body.append(self.statement())
+        return ("block", body)
+
+    # -- statements --
+
+    def statement(self):
+        t = self.peek()
+        if t.kind == "punct" and t.value == "{":
+            return self.block()
+        if t.kind == "punct" and t.value == ";":
+            self.next()
+            return ("empty",)
+        if t.kind == "ident":
+            w = t.value
+            if w in ("const", "let", "var"):
+                return self.var_decl()
+            if w == "function":
+                return self.func_decl(is_async=False)
+            if w == "async" and self.peek(1).kind == "ident" \
+                    and self.peek(1).value == "function":
+                self.next()
+                return self.func_decl(is_async=True)
+            if w == "return":
+                self.next()
+                if self.at(";") or self.at("}") or self.peek().kind == "eof":
+                    val = ("lit", undefined)
+                else:
+                    val = self.expression()
+                self._semi()
+                return ("return", val)
+            if w == "if":
+                return self.if_stmt()
+            if w == "for":
+                return self.for_stmt()
+            if w == "while":
+                return self.while_stmt()
+            if w == "try":
+                return self.try_stmt()
+            if w == "throw":
+                self.next()
+                val = self.expression()
+                self._semi()
+                return ("throw", val)
+        expr = self.expression()
+        self._semi()
+        return ("exprstmt", expr)
+
+    def _semi(self):
+        if self.at(";"):
+            self.next()
+
+    def block(self):
+        self.expect("{")
+        body = []
+        while not self.at("}"):
+            body.append(self.statement())
+        self.expect("}")
+        return ("block", body)
+
+    def var_decl(self):
+        kw = self.next().value  # const/let/var
+        decls = []
+        while True:
+            decls.append(self._declarator())
+            if self.at(","):
+                self.next()
+                continue
+            break
+        self._semi()
+        return ("vardecl", kw, decls)
+
+    def _declarator(self):
+        if self.at("["):  # array destructuring
+            self.next()
+            names = []
+            while not self.at("]"):
+                names.append(self.next().value)
+                if self.at(","):
+                    self.next()
+            self.expect("]")
+            self.expect("=")
+            return (("arraypat", names), self.expression_no_comma())
+        name = self.next().value
+        if self.at("="):
+            self.next()
+            return (name, self.expression_no_comma())
+        return (name, ("lit", undefined))
+
+    def func_decl(self, is_async):
+        self.expect_kw("function")
+        name = self.next().value
+        params = self._param_list()
+        body = self.block()
+        return ("funcdecl", name, params, body, is_async)
+
+    def _param_list(self):
+        self.expect("(")
+        params = []
+        while not self.at(")"):
+            if self.at("["):
+                self.next()
+                names = []
+                while not self.at("]"):
+                    names.append(self.next().value)
+                    if self.at(","):
+                        self.next()
+                self.expect("]")
+                params.append(("arraypat", names))
+            else:
+                params.append(self.next().value)
+            if self.at(","):
+                self.next()
+        self.expect(")")
+        return params
+
+    def if_stmt(self):
+        self.expect_kw("if")
+        self.expect("(")
+        cond = self.expression()
+        self.expect(")")
+        then = self.statement()
+        other = None
+        if self.at_kw("else"):
+            self.next()
+            other = self.statement()
+        return ("if", cond, then, other)
+
+    def while_stmt(self):
+        self.expect_kw("while")
+        self.expect("(")
+        cond = self.expression()
+        self.expect(")")
+        return ("while", cond, self.statement())
+
+    def for_stmt(self):
+        self.expect_kw("for")
+        self.expect("(")
+        # Only for...of (the pages use nothing else).
+        kw = self.next()  # const/let
+        if kw.kind != "ident" or kw.value not in ("const", "let", "var"):
+            raise SyntaxError(
+                f"minijs line {kw.line}: only for (const x of ...) loops "
+                "are supported")
+        name = self.next().value
+        self.expect_kw("of")
+        it = self.expression()
+        self.expect(")")
+        return ("forof", name, it, self.statement())
+
+    def try_stmt(self):
+        self.expect_kw("try")
+        body = self.block()
+        param, handler = None, None
+        if self.at_kw("catch"):
+            self.next()
+            if self.at("("):
+                self.next()
+                param = self.next().value
+                self.expect(")")
+            handler = self.block()
+        fin = None
+        if self.at_kw("finally"):
+            self.next()
+            fin = self.block()
+        return ("try", body, param, handler, fin)
+
+    # -- expressions (precedence climbing) --
+
+    def expression(self):
+        e = self.expression_no_comma()
+        while self.at(","):
+            self.next()
+            e = ("seq", e, self.expression_no_comma())
+        return e
+
+    def expression_no_comma(self):
+        return self.assignment()
+
+    def assignment(self):
+        left = self.ternary()
+        if self.at("="):
+            self.next()
+            right = self.assignment()
+            return ("assign", left, right)
+        for op in ("+=", "-=", "*=", "/="):
+            if self.at(op):
+                self.next()
+                right = self.assignment()
+                return ("assign", left, ("binop", op[0], left, right))
+        return left
+
+    def ternary(self):
+        cond = self.logical_or()
+        if self.at("?"):
+            self.next()
+            a = self.assignment()
+            self.expect(":")
+            b = self.assignment()
+            return ("ternary", cond, a, b)
+        return cond
+
+    def logical_or(self):
+        e = self.logical_and()
+        while self.at("||"):
+            self.next()
+            e = ("or", e, self.logical_and())
+        return e
+
+    def logical_and(self):
+        e = self.equality()
+        while self.at("&&"):
+            self.next()
+            e = ("and", e, self.equality())
+        return e
+
+    def equality(self):
+        e = self.relational()
+        while True:
+            for op in ("===", "!==", "==", "!="):
+                if self.at(op):
+                    self.next()
+                    e = ("binop", op, e, self.relational())
+                    break
+            else:
+                return e
+
+    def relational(self):
+        e = self.additive()
+        while True:
+            for op in ("<=", ">=", "<", ">"):
+                if self.at(op):
+                    self.next()
+                    e = ("binop", op, e, self.additive())
+                    break
+            else:
+                return e
+
+    def additive(self):
+        e = self.multiplicative()
+        while self.at("+") or self.at("-"):
+            op = self.next().value
+            e = ("binop", op, e, self.multiplicative())
+        return e
+
+    def multiplicative(self):
+        e = self.unary()
+        while self.at("*") or self.at("/") or self.at("%"):
+            op = self.next().value
+            e = ("binop", op, e, self.unary())
+        return e
+
+    def unary(self):
+        if self.at("!"):
+            self.next()
+            return ("not", self.unary())
+        if self.at("-"):
+            self.next()
+            return ("neg", self.unary())
+        if self.at("+"):
+            self.next()
+            return ("pos", self.unary())
+        if self.at_kw("await"):
+            self.next()
+            return ("await", self.unary())
+        if self.at_kw("new"):
+            self.next()
+            callee = self.postfix(self.primary(), no_call=True)
+            args = self._args() if self.at("(") else []
+            return ("new", callee, args)
+        if self.at_kw("typeof"):
+            self.next()
+            return ("typeof", self.unary())
+        return self.postfix(self.primary())
+
+    def postfix(self, e, no_call=False):
+        while True:
+            if self.at("."):
+                self.next()
+                e = ("member", e, self.next().value)
+            elif self.at("["):
+                self.next()
+                idx = self.expression()
+                self.expect("]")
+                e = ("index", e, idx)
+            elif self.at("(") and not no_call:
+                e = ("call", e, self._args())
+            else:
+                return e
+
+    def _args(self):
+        self.expect("(")
+        args = []
+        while not self.at(")"):
+            if self.at("..."):
+                self.next()
+                args.append(("spread", self.expression_no_comma()))
+            else:
+                args.append(self.expression_no_comma())
+            if self.at(","):
+                self.next()
+        self.expect(")")
+        return args
+
+    def _arrow_ahead(self) -> bool:
+        """At '(' — does this parenthesized group end with '=>'?"""
+        depth = 0
+        j = self.i
+        while j < len(self.toks):
+            t = self.toks[j]
+            if t.kind == "punct":
+                if t.value in ("(", "[", "{"):
+                    depth += 1
+                elif t.value in (")", "]", "}"):
+                    depth -= 1
+                    if depth == 0:
+                        nxt = self.toks[j + 1]
+                        return nxt.kind == "punct" and nxt.value == "=>"
+            j += 1
+        return False
+
+    def primary(self):
+        t = self.peek()
+        if t.kind == "num":
+            self.next()
+            return ("lit", t.value)
+        if t.kind == "str":
+            self.next()
+            return ("lit", t.value)
+        if t.kind == "regex":
+            self.next()
+            return ("regexlit", t.value[0], t.value[1])
+        if t.kind == "template":
+            self.next()
+            parts = []
+            for kind, payload in t.value:
+                if kind == "str":
+                    parts.append(("str", payload))
+                else:
+                    parts.append(("expr", _Parser(payload).expression()))
+            return ("template", parts)
+        if t.kind == "punct":
+            if t.value == "(":
+                if self._arrow_ahead():
+                    return self._arrow(is_async=False)
+                self.next()
+                e = self.expression()
+                self.expect(")")
+                return self.postfix(e)
+            if t.value == "[":
+                return self._array_literal()
+            if t.value == "{":
+                return self._object_literal()
+        if t.kind == "ident":
+            w = t.value
+            if w == "true":
+                self.next()
+                return ("lit", True)
+            if w == "false":
+                self.next()
+                return ("lit", False)
+            if w == "null":
+                self.next()
+                return ("lit", None)
+            if w == "undefined":
+                self.next()
+                return ("lit", undefined)
+            if w == "async":
+                nxt = self.peek(1)
+                if nxt.kind == "punct" and nxt.value == "(":
+                    self.next()
+                    return self._arrow(is_async=True)
+                if nxt.kind == "ident" and self.peek(2).kind == "punct" \
+                        and self.peek(2).value == "=>":
+                    self.next()
+                    return self._arrow(is_async=True)
+            if w == "function":
+                return self._func_expr(is_async=False)
+            # single-param arrow: x => ...
+            nxt = self.peek(1)
+            if nxt.kind == "punct" and nxt.value == "=>":
+                return self._arrow(is_async=False)
+            self.next()
+            return ("name", w)
+        raise SyntaxError(
+            f"minijs parse error line {t.line}: unexpected "
+            f"{t.kind} {t.value!r}")
+
+    def _func_expr(self, is_async):
+        self.expect_kw("function")
+        name = None
+        if self.peek().kind == "ident":
+            name = self.next().value
+        params = self._param_list()
+        body = self.block()
+        return ("func", name, params, body, is_async)
+
+    def _arrow(self, is_async):
+        if self.at("("):
+            params = self._param_list()
+        else:
+            params = [self.next().value]
+        self.expect("=>")
+        if self.at("{"):
+            body = self.block()
+            return ("func", None, params, body, is_async)
+        body = self.expression_no_comma()
+        return ("func", None, params, ("return", body), is_async)
+
+    def _array_literal(self):
+        self.expect("[")
+        items = []
+        while not self.at("]"):
+            if self.at("..."):
+                self.next()
+                items.append(("spread", self.expression_no_comma()))
+            else:
+                items.append(self.expression_no_comma())
+            if self.at(","):
+                self.next()
+        self.expect("]")
+        return self.postfix(("array", items))
+
+    def _object_literal(self):
+        self.expect("{")
+        props = []
+        while not self.at("}"):
+            if self.at("..."):
+                self.next()
+                props.append(("spreadprop", self.expression_no_comma()))
+            elif self.at("["):
+                self.next()
+                key = self.expression()
+                self.expect("]")
+                self.expect(":")
+                props.append(("computed", key, self.expression_no_comma()))
+            else:
+                t = self.next()
+                key = t.value if t.kind in ("ident", "str") else \
+                    js_to_string(t.value)
+                if self.at(":"):
+                    self.next()
+                    props.append(("prop", key, self.expression_no_comma()))
+                elif self.at("(") :
+                    params = self._param_list()
+                    body = self.block()
+                    props.append(
+                        ("prop", key, ("func", key, params, body, False)))
+                else:  # shorthand
+                    props.append(("prop", key, ("name", key)))
+            if self.at(","):
+                self.next()
+        self.expect("}")
+        return self.postfix(("object", props))
+
+
+# ---------------------------------------------------------------- runtime
+
+
+class _Env:
+    __slots__ = ("vars", "parent")
+
+    def __init__(self, parent=None, vars=None):
+        self.vars: Dict[str, Any] = vars or {}
+        self.parent = parent
+
+    def get(self, name):
+        env = self
+        while env is not None:
+            if name in env.vars:
+                return env.vars[name]
+            env = env.parent
+        raise JSError({"message": f"{name} is not defined"})
+
+    def set(self, name, value):
+        env = self
+        while env is not None:
+            if name in env.vars:
+                env.vars[name] = value
+                return
+            env = env.parent
+        # implicit global (the pages only assign to declared names; this
+        # matches sloppy-mode JS)
+        root = self
+        while root.parent is not None:
+            root = root.parent
+        root.vars[name] = value
+
+    def declare(self, name, value):
+        self.vars[name] = value
+
+
+class _JSFunction:
+    __slots__ = ("params", "body", "env", "interp", "name")
+
+    def __init__(self, name, params, body, env, interp):
+        self.name, self.params, self.body = name, params, body
+        self.env, self.interp = env, interp
+
+    def __call__(self, *args):
+        env = _Env(self.env)
+        for i, p in enumerate(self.params):
+            v = args[i] if i < len(args) else undefined
+            if isinstance(p, tuple) and p[0] == "arraypat":
+                seq = v if isinstance(v, (list, tuple)) else []
+                for j, n in enumerate(p[1]):
+                    env.declare(n, seq[j] if j < len(seq) else undefined)
+            else:
+                env.declare(p, v)
+        try:
+            self.interp._exec(self.body, env)
+        except _Return as r:
+            return r.value
+        return undefined
+
+
+def _make_error(*args):
+    msg = js_to_string(args[0]) if args else ""
+    return {"message": msg, "stack": msg, "name": "Error"}
+
+
+class Interpreter:
+    """One global scope + stdlib. ``run(src)`` executes a script;
+    ``env`` is exposed for host shims to inject globals and to call back
+    into JS functions (they are plain Python callables)."""
+
+    def __init__(self, globals: Optional[Dict[str, Any]] = None):
+        self.global_env = _Env(vars=dict(globals or {}))
+        g = self.global_env.vars
+        g.setdefault("JSON", {
+            "stringify": lambda v, *a: _json_stringify(v),
+            "parse": lambda s, *a: _json.loads(s),
+        })
+        g.setdefault("Math", {
+            "min": lambda *a: min(js_to_number(x) for x in a)
+            if a else float("inf"),
+            "max": lambda *a: max(js_to_number(x) for x in a)
+            if a else float("-inf"),
+            "floor": lambda x: float(math.floor(js_to_number(x))),
+            "ceil": lambda x: float(math.ceil(js_to_number(x))),
+            "round": lambda x: float(math.floor(js_to_number(x) + 0.5)),
+            "abs": lambda x: abs(js_to_number(x)),
+        })
+        g.setdefault("Object", {
+            "entries": lambda o: [[k, v] for k, v in o.items()]
+            if isinstance(o, dict) else [],
+            "keys": lambda o: list(o.keys()) if isinstance(o, dict) else [],
+            "values": lambda o: list(o.values())
+            if isinstance(o, dict) else [],
+            "assign": _object_assign,
+        })
+        g.setdefault("Array", {
+            "isArray": lambda v: isinstance(v, list),
+            "from": lambda v, *a: list(v),
+        })
+        g.setdefault("Promise", {
+            # async collapses to sync: an "awaited" value IS the value.
+            "all": lambda xs: list(xs),
+            "resolve": lambda x=undefined: x,
+        })
+        g.setdefault("String", js_to_string)
+        g.setdefault("Number", js_to_number)
+        g.setdefault("Boolean", js_truthy)
+        g.setdefault("Error", _make_error)
+        g.setdefault("encodeURIComponent", _encode_uri_component)
+        g.setdefault("decodeURIComponent", _decode_uri_component)
+        g.setdefault("parseInt", lambda s, base=10.0:
+                     float(int(js_to_string(s).strip() or "0",
+                               int(base or 10))))
+        g.setdefault("parseFloat", js_to_number)
+        g.setdefault("isNaN", lambda v: math.isnan(js_to_number(v)))
+        g.setdefault("console", {
+            "log": lambda *a: None, "error": lambda *a: None,
+            "warn": lambda *a: None,
+        })
+        g.setdefault("globalThis", g)
+
+    # -- public --
+
+    def run(self, src: str):
+        ast = _Parser(_Lexer(src).lex()).parse_program()
+        # Top-level declarations are script-globals: execute the program
+        # body directly in the global scope (no wrapper block scope).
+        for st in ast[1]:
+            if st[0] == "funcdecl":
+                self.global_env.declare(
+                    st[1], _JSFunction(st[1], st[2], st[3],
+                                       self.global_env, self))
+        for st in ast[1]:
+            self._exec(st, self.global_env)
+        return undefined
+
+    @property
+    def globals(self) -> Dict[str, Any]:
+        return self.global_env.vars
+
+    # -- statements --
+
+    def _exec(self, node, env):
+        kind = node[0]
+        if kind == "block":
+            block_env = _Env(env)
+            # hoist function declarations (the pages call helpers defined
+            # later in the script — e.g. refresh() before its decl)
+            for st in node[1]:
+                if st[0] == "funcdecl":
+                    block_env.declare(
+                        st[1],
+                        _JSFunction(st[1], st[2], st[3], block_env, self))
+            for st in node[1]:
+                self._exec(st, block_env)
+            return undefined
+        if kind == "exprstmt":
+            self._eval(node[1], env)
+            return undefined
+        if kind == "empty":
+            return undefined
+        if kind == "vardecl":
+            for target, init in node[2]:
+                v = self._eval(init, env)
+                if isinstance(target, tuple) and target[0] == "arraypat":
+                    seq = v if isinstance(v, (list, tuple)) else []
+                    for j, n in enumerate(target[1]):
+                        env.declare(n, seq[j] if j < len(seq) else undefined)
+                else:
+                    env.declare(target, v)
+            return undefined
+        if kind == "funcdecl":
+            # already hoisted; re-binding is harmless
+            env.declare(node[1],
+                        _JSFunction(node[1], node[2], node[3], env, self))
+            return undefined
+        if kind == "return":
+            raise _Return(self._eval(node[1], env))
+        if kind == "if":
+            if js_truthy(self._eval(node[1], env)):
+                self._exec(node[2], env)
+            elif node[3] is not None:
+                self._exec(node[3], env)
+            return undefined
+        if kind == "while":
+            guard = 0
+            while js_truthy(self._eval(node[1], env)):
+                self._exec(node[2], env)
+                guard += 1
+                if guard > 1_000_000:
+                    raise JSError({"message": "while loop exceeded 1e6 "
+                                   "iterations (minijs guard)"})
+            return undefined
+        if kind == "forof":
+            it = self._eval(node[2], env)
+            if isinstance(it, dict):
+                it = list(it.values())
+            for item in list(it):
+                loop_env = _Env(env)
+                loop_env.declare(node[1], item)
+                self._exec(node[3], loop_env)
+            return undefined
+        if kind == "try":
+            _, body, param, handler, fin = node
+            try:
+                self._exec(body, env)
+            except JSError as e:
+                if handler is None:
+                    raise  # try/finally: the error propagates after fin
+                henv = _Env(env)
+                if param:
+                    henv.declare(param, e.value)
+                self._exec(handler, henv)
+            finally:
+                if fin is not None:
+                    self._exec(fin, env)
+            return undefined
+        if kind == "throw":
+            raise JSError(self._eval(node[1], env))
+        raise AssertionError(f"unknown statement {kind}")
+
+    # -- expressions --
+
+    def _eval(self, node, env):
+        kind = node[0]
+        if kind == "lit":
+            return node[1]
+        if kind == "name":
+            return env.get(node[1])
+        if kind == "template":
+            out = []
+            for pk, payload in node[1]:
+                if pk == "str":
+                    out.append(payload)
+                else:
+                    out.append(js_to_string(self._eval(payload, env)))
+            return "".join(out)
+        if kind == "regexlit":
+            return _Regex(node[1], node[2])
+        if kind == "array":
+            out = []
+            for item in node[1]:
+                if item[0] == "spread":
+                    out.extend(list(self._eval(item[1], env)))
+                else:
+                    out.append(self._eval(item, env))
+            return out
+        if kind == "object":
+            obj: Dict[str, Any] = {}
+            for prop in node[1]:
+                if prop[0] == "prop":
+                    obj[prop[1]] = self._eval(prop[2], env)
+                elif prop[0] == "computed":
+                    obj[js_to_string(self._eval(prop[1], env))] = \
+                        self._eval(prop[2], env)
+                else:  # spreadprop
+                    src = self._eval(prop[1], env)
+                    if isinstance(src, dict):
+                        obj.update(src)
+            return obj
+        if kind == "func":
+            return _JSFunction(node[1], node[2], node[3], env, self)
+        if kind == "seq":
+            self._eval(node[1], env)
+            return self._eval(node[2], env)
+        if kind == "assign":
+            return self._assign(node[1], self._eval(node[2], env), env)
+        if kind == "ternary":
+            return self._eval(node[2] if js_truthy(self._eval(node[1], env))
+                              else node[3], env)
+        if kind == "or":
+            left = self._eval(node[1], env)
+            return left if js_truthy(left) else self._eval(node[2], env)
+        if kind == "and":
+            left = self._eval(node[1], env)
+            return self._eval(node[2], env) if js_truthy(left) else left
+        if kind == "not":
+            return not js_truthy(self._eval(node[1], env))
+        if kind == "neg":
+            return -js_to_number(self._eval(node[1], env))
+        if kind == "pos":
+            return js_to_number(self._eval(node[1], env))
+        if kind == "await":
+            return self._eval(node[1], env)  # async collapses to sync
+        if kind == "typeof":
+            return _js_typeof(self._eval(node[1], env))
+        if kind == "binop":
+            return self._binop(node[1], self._eval(node[2], env),
+                               self._eval(node[3], env))
+        if kind == "member":
+            return self._member_get(self._eval(node[1], env), node[2])
+        if kind == "index":
+            obj = self._eval(node[1], env)
+            idx = self._eval(node[2], env)
+            if isinstance(obj, (list, str)) and isinstance(
+                    idx, (int, float)) and not isinstance(idx, bool):
+                i = int(idx)
+                if 0 <= i < len(obj):
+                    return obj[i]
+                return undefined
+            return self._member_get(obj, js_to_string(idx))
+        if kind == "call":
+            return self._call(node, env)
+        if kind == "new":
+            ctor = self._eval(node[1], env)
+            args = self._eval_args(node[2], env)
+            return ctor(*args)
+        if kind == "spread":
+            raise JSError({"message": "spread outside call/array"})
+        raise AssertionError(f"unknown expression {kind}")
+
+    def _eval_args(self, arg_nodes, env):
+        args = []
+        for a in arg_nodes:
+            if a[0] == "spread":
+                args.extend(list(self._eval(a[1], env)))
+            else:
+                args.append(self._eval(a, env))
+        return args
+
+    def _call(self, node, env):
+        callee = node[1]
+        args = self._eval_args(node[2], env)
+        if callee[0] == "member":
+            obj = self._eval(callee[1], env)
+            fn = self._member_get(obj, callee[2])
+        elif callee[0] == "index":
+            obj = self._eval(callee[1], env)
+            fn = self._member_get(obj, js_to_string(
+                self._eval(callee[2], env)))
+        else:
+            fn = self._eval(callee, env)
+        if not callable(fn):
+            name = callee[2] if callee[0] == "member" else \
+                (callee[1] if callee[0] == "name" else "?")
+            raise JSError({"message": f"{name} is not a function"})
+        return fn(*args)
+
+    def _assign(self, target, value, env):
+        kind = target[0]
+        if kind == "name":
+            env.set(target[1], value)
+            return value
+        if kind == "member":
+            obj = self._eval(target[1], env)
+            self._member_set(obj, target[2], value)
+            return value
+        if kind == "index":
+            obj = self._eval(target[1], env)
+            idx = self._eval(target[2], env)
+            if isinstance(obj, list) and isinstance(
+                    idx, (int, float)) and not isinstance(idx, bool):
+                i = int(idx)
+                while len(obj) <= i:
+                    obj.append(undefined)
+                obj[i] = value
+            elif isinstance(obj, dict):
+                obj[js_to_string(idx)] = value
+            else:
+                self._member_set(obj, js_to_string(idx), value)
+            return value
+        raise JSError({"message": "invalid assignment target"})
+
+    def _binop(self, op, a, b):
+        if op == "+":
+            if isinstance(a, str) or isinstance(b, str) or \
+                    isinstance(a, (list, dict)) or isinstance(b, (list, dict)):
+                return js_to_string(a) + js_to_string(b)
+            return js_to_number(a) + js_to_number(b)
+        if op == "-":
+            return js_to_number(a) - js_to_number(b)
+        if op == "*":
+            return js_to_number(a) * js_to_number(b)
+        if op == "/":
+            bn = js_to_number(b)
+            an = js_to_number(a)
+            if bn == 0:
+                if an == 0:
+                    return float("nan")
+                return float("inf") if an > 0 else float("-inf")
+            return an / bn
+        if op == "%":
+            return math.fmod(js_to_number(a), js_to_number(b))
+        if op in ("===", "=="):
+            return _strict_eq(a, b)
+        if op in ("!==", "!="):
+            return not _strict_eq(a, b)
+        if op == "<":
+            return self._compare(a, b, lambda x, y: x < y)
+        if op == ">":
+            return self._compare(a, b, lambda x, y: x > y)
+        if op == "<=":
+            return self._compare(a, b, lambda x, y: x <= y)
+        if op == ">=":
+            return self._compare(a, b, lambda x, y: x >= y)
+        raise AssertionError(op)
+
+    @staticmethod
+    def _compare(a, b, fn):
+        if isinstance(a, str) and isinstance(b, str):
+            return fn(a, b)
+        return fn(js_to_number(a), js_to_number(b))
+
+    # -- member protocol --
+
+    def _member_get(self, obj, name):
+        if obj is undefined or obj is None:
+            raise JSError({"message":
+                           f"cannot read property {name!r} of "
+                           f"{js_to_string(obj)}"})
+        if isinstance(obj, dict):
+            if name in obj:
+                return obj[name]
+            return undefined
+        if isinstance(obj, list):
+            return _array_member(obj, name, self)
+        if isinstance(obj, str):
+            return _string_member(obj, name)
+        if isinstance(obj, bool):
+            return undefined
+        if isinstance(obj, (int, float)):
+            return _number_member(obj, name)
+        if isinstance(obj, _Regex):
+            return {"source": obj.source, "flags": obj.flags}.get(
+                name, undefined)
+        # host object
+        try:
+            v = getattr(obj, name)
+        except AttributeError:
+            return undefined
+        return v
+
+    def _member_set(self, obj, name, value):
+        if isinstance(obj, dict):
+            obj[name] = value
+            return
+        if isinstance(obj, list):
+            if name == "length":
+                n = int(js_to_number(value))
+                del obj[n:]
+                return
+            raise JSError({"message": f"cannot set {name} on array"})
+        # host object
+        setattr(obj, name, value)
+
+
+def _strict_eq(a, b):
+    if a is undefined or b is undefined:
+        return a is b
+    if a is None or b is None:
+        return a is b
+    if isinstance(a, bool) or isinstance(b, bool):
+        return isinstance(a, bool) and isinstance(b, bool) and a == b
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return float(a) == float(b)
+    if isinstance(a, str) and isinstance(b, str):
+        return a == b
+    return a is b
+
+
+def _js_typeof(v):
+    if v is undefined:
+        return "undefined"
+    if v is None:
+        return "object"
+    if isinstance(v, bool):
+        return "boolean"
+    if isinstance(v, (int, float)):
+        return "number"
+    if isinstance(v, str):
+        return "string"
+    if callable(v):
+        return "function"
+    return "object"
+
+
+def _object_assign(target, *sources):
+    for s in sources:
+        if isinstance(s, dict):
+            target.update(s)
+    return target
+
+
+def _json_stringify(v):
+    def conv(x):
+        if x is undefined:
+            return None
+        if isinstance(x, dict):
+            return {k: conv(val) for k, val in x.items()
+                    if val is not undefined}
+        if isinstance(x, list):
+            return [conv(i) for i in x]
+        if isinstance(x, float) and x == int(x) and abs(x) < 1e15:
+            return int(x)
+        return x
+    return _json.dumps(conv(v), separators=(",", ":"))
+
+
+def _encode_uri_component(s):
+    from urllib.parse import quote
+
+    return quote(js_to_string(s), safe="!'()*-._~")
+
+
+def _decode_uri_component(s):
+    from urllib.parse import unquote
+
+    return unquote(js_to_string(s))
+
+
+# -- built-in member banks --
+
+
+def _array_member(arr: list, name: str, interp: Interpreter):
+    if name == "length":
+        return float(len(arr))
+    if name == "map":
+        return lambda fn, *a: [fn(v, float(i), arr)
+                               for i, v in enumerate(list(arr))]
+    if name == "filter":
+        return lambda fn, *a: [v for i, v in enumerate(list(arr))
+                               if js_truthy(fn(v, float(i), arr))]
+    if name == "forEach":
+        def _each(fn, *a):
+            for i, v in enumerate(list(arr)):
+                fn(v, float(i), arr)
+            return undefined
+        return _each
+    if name == "find":
+        def _find(fn, *a):
+            for i, v in enumerate(list(arr)):
+                if js_truthy(fn(v, float(i), arr)):
+                    return v
+            return undefined
+        return _find
+    if name == "findIndex":
+        def _findi(fn, *a):
+            for i, v in enumerate(list(arr)):
+                if js_truthy(fn(v, float(i), arr)):
+                    return float(i)
+            return -1.0
+        return _findi
+    if name == "join":
+        return lambda sep=",": js_to_string(sep).join(
+            "" if v is undefined or v is None else js_to_string(v)
+            for v in arr)
+    if name == "slice":
+        def _slice(start=0.0, end=None):
+            s = int(js_to_number(start))
+            e = len(arr) if end is None else int(js_to_number(end))
+            return list(arr[s:e])
+        return _slice
+    if name == "push":
+        def _push(*vals):
+            arr.extend(vals)
+            return float(len(arr))
+        return _push
+    if name == "pop":
+        return lambda: arr.pop() if arr else undefined
+    if name == "includes":
+        return lambda v: any(_strict_eq(v, x) for x in arr)
+    if name == "indexOf":
+        def _index(v):
+            for i, x in enumerate(arr):
+                if _strict_eq(v, x):
+                    return float(i)
+            return -1.0
+        return _index
+    if name == "concat":
+        def _concat(*others):
+            out = list(arr)
+            for o in others:
+                if isinstance(o, list):
+                    out.extend(o)
+                else:
+                    out.append(o)
+            return out
+        return _concat
+    if name == "some":
+        return lambda fn: any(js_truthy(fn(v, float(i), arr))
+                              for i, v in enumerate(list(arr)))
+    if name == "every":
+        return lambda fn: all(js_truthy(fn(v, float(i), arr))
+                              for i, v in enumerate(list(arr)))
+    if name == "flat":
+        def _flat(depth=1.0):
+            out = []
+            for v in arr:
+                if isinstance(v, list) and depth >= 1:
+                    out.extend(v)
+                else:
+                    out.append(v)
+            return out
+        return _flat
+    if name == "sort":
+        def _sort(fn=None):
+            if fn is None:
+                arr.sort(key=js_to_string)
+            else:
+                import functools
+                arr.sort(key=functools.cmp_to_key(
+                    lambda a, b: -1 if js_to_number(fn(a, b)) < 0
+                    else (1 if js_to_number(fn(a, b)) > 0 else 0)))
+            return arr
+        return _sort
+    if name == "reverse":
+        def _rev():
+            arr.reverse()
+            return arr
+        return _rev
+    return undefined
+
+
+def _string_member(s: str, name: str):
+    if name == "length":
+        return float(len(s))
+    if name == "replace":
+        def _replace(pat, repl):
+            def do_one(m):
+                if callable(repl):
+                    groups = [m.group(0)] + [
+                        g if g is not None else undefined
+                        for g in m.groups()]
+                    return js_to_string(repl(*groups))
+                # $1-style backrefs are not used by the pages; treat the
+                # replacement as a literal string.
+                return js_to_string(repl)
+            if isinstance(pat, _Regex):
+                return pat.re.sub(do_one, s, count=0 if pat.global_ else 1)
+            pat_s = js_to_string(pat)
+            if callable(repl):
+                idx = s.find(pat_s)
+                if idx < 0:
+                    return s
+                return s[:idx] + js_to_string(repl(pat_s)) + \
+                    s[idx + len(pat_s):]
+            return s.replace(pat_s, js_to_string(repl), 1)
+        return _replace
+    if name == "includes":
+        return lambda sub: js_to_string(sub) in s
+    if name == "startsWith":
+        return lambda sub: s.startswith(js_to_string(sub))
+    if name == "endsWith":
+        return lambda sub: s.endswith(js_to_string(sub))
+    if name == "indexOf":
+        return lambda sub: float(s.find(js_to_string(sub)))
+    if name == "slice":
+        def _slice(start=0.0, end=None):
+            st = int(js_to_number(start))
+            e = len(s) if end is None else int(js_to_number(end))
+            return s[st:e]
+        return _slice
+    if name == "split":
+        def _split(sep=None, *a):
+            if sep is None:
+                return [s]
+            if isinstance(sep, _Regex):
+                return sep.re.split(s)
+            sep_s = js_to_string(sep)
+            if sep_s == "":
+                return list(s)
+            return s.split(sep_s)
+        return _split
+    if name == "toLowerCase":
+        return lambda: s.lower()
+    if name == "toUpperCase":
+        return lambda: s.upper()
+    if name == "trim":
+        return lambda: s.strip()
+    if name == "charAt":
+        return lambda i=0.0: s[int(js_to_number(i))] \
+            if 0 <= int(js_to_number(i)) < len(s) else ""
+    if name == "repeat":
+        return lambda n: s * int(js_to_number(n))
+    if name == "padStart":
+        return lambda n, fill=" ": s.rjust(int(js_to_number(n)),
+                                           js_to_string(fill) or " ")
+    if name == "match":
+        def _match(pat):
+            if not isinstance(pat, _Regex):
+                pat = _Regex(js_to_string(pat), "")
+            if pat.global_:
+                return [m.group(0) for m in pat.re.finditer(s)] or None
+            m = pat.re.search(s)
+            if m is None:
+                return None
+            return [m.group(0)] + [g if g is not None else undefined
+                                   for g in m.groups()]
+        return _match
+    return undefined
+
+
+def _number_member(n, name: str):
+    if name == "toFixed":
+        return lambda digits=0.0: f"{float(n):.{int(js_to_number(digits))}f}"
+    if name == "toPrecision":
+        def _prec(digits=None):
+            if digits is None:
+                return js_to_string(n)
+            d = int(js_to_number(digits))
+            out = f"{float(n):.{d}g}"
+            # JS pads to the requested significant digits
+            if "e" not in out and "." not in out and len(
+                    out.lstrip("-")) < d:
+                out += "." + "0" * (d - len(out.lstrip("-")))
+            return out
+        return _prec
+    if name == "toString":
+        return lambda *a: js_to_string(n)
+    return undefined

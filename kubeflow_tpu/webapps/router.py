@@ -16,7 +16,7 @@ import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, unquote, urlparse
 
 from kubeflow_tpu.utils import get_logger
 
@@ -94,7 +94,12 @@ class Router:
             matched_path = True
             if method != req.method:
                 continue
-            req.params = m.groupdict()
+            # Percent-decode AFTER segment matching (a %2F in a resource
+            # name must not smuggle a path separator past the route
+            # pattern) — the same order Flask/werkzeug uses. Found by the
+            # executed-page-JS tier: encodeURIComponent'd names arrived
+            # still encoded and lookups missed.
+            req.params = {k: unquote(v) for k, v in m.groupdict().items()}
             out = handler(req)
             if isinstance(out, tuple):
                 return out

@@ -1,0 +1,283 @@
+"""minijs interpreter unit tier: every dialect feature the page scripts
+use (webapps/frontend.py, controlplane/bootstrap.py) has a direct test
+here, so a page-script change that outgrows the interpreter fails loudly
+in THIS file before the UI-execution tests go red."""
+
+import pytest
+
+from kubeflow_tpu.webapps.minijs import (
+    Interpreter,
+    JSError,
+    js_to_string,
+    undefined,
+)
+
+
+def run(src, **globals_):
+    it = Interpreter(globals_)
+    it.run(src)
+    return it
+
+
+def ev(src, **globals_):
+    it = Interpreter(globals_)
+    it.run(f"__result = ({src});")
+    return it.globals["__result"]
+
+
+class TestExpressions:
+    def test_arithmetic_and_precedence(self):
+        assert ev("1 + 2 * 3") == 7
+        assert ev("(24 - 22 * (5 - 1) / 4)") == 2
+        assert ev("7 % 3") == 1
+
+    def test_string_concat_coerces(self):
+        assert ev("'a' + 1") == "a1"
+        assert ev("1 + '2'") == "12"
+        assert ev("'x=' + undefined") == "x=undefined"
+        assert ev("'' + [1, 2]") == "1,2"
+
+    def test_number_to_string_drops_integral_point(self):
+        assert ev("'' + 24") == "24"
+        assert ev("'' + 24.5") == "24.5"
+        assert ev("(120 / 2) + ''") == "60"
+
+    def test_strict_equality(self):
+        assert ev("1 === 1") is True
+        assert ev("'1' === 1") is False
+        assert ev("null === undefined") is False
+        assert ev("!1") is False
+
+    def test_ternary_or_and(self):
+        assert ev("0 || 'fallback'") == "fallback"
+        assert ev("'x' && 'y'") == "y"
+        assert ev("1 ? 'a' : 'b'") == "a"
+        assert ev("(5 - 5) || 1") == 1
+
+    def test_template_literals_nested(self):
+        assert ev("`a${1 + 1}b`") == "a2b"
+        assert ev("`outer ${`inner ${1}`} end`") == "outer inner 1 end"
+        assert ev("`${[1,2].map(x => `<${x}>`).join('')}`") == "<1><2>"
+
+    def test_template_with_object_braces_in_substitution(self):
+        assert ev("`${({a: 1})['a']}`") == "1"
+
+    def test_object_literals(self):
+        assert ev("({a: 1, 'b': 2}).b") == 2
+        assert ev("({x: 5}).missing") is undefined
+        it = run("const k = 'dyn'; __o = {[k]: 1, short: 2};")
+        assert it.globals["__o"] == {"dyn": 1, "short": 2}
+
+    def test_object_shorthand(self):
+        assert ev("(() => { const components = [1]; "
+                  "return {components}; })()") == {"components": [1]}
+
+    def test_array_literals_and_spread(self):
+        assert ev("[1, ...[2, 3], 4]") == [1, 2, 3, 4]
+        assert ev("Math.min(...[3, 1, 2])") == 1
+        assert ev("Math.max(1, ...[0.5])") == 1
+
+    def test_index_and_member(self):
+        assert ev("[10, 20][1]") == 20
+        assert ev("[[1], [2]][1][0]") == 2
+        assert ev("({a: {b: 3}}).a.b") == 3
+        assert ev("'abc'.length") == 3
+        assert ev("[1,2,3].length") == 3
+
+    def test_out_of_range_index_is_undefined(self):
+        assert ev("[1][5]") is undefined
+
+
+class TestFunctions:
+    def test_arrow_forms(self):
+        assert ev("(x => x * 2)(21)") == 42
+        assert ev("((a, b) => a + b)(1, 2)") == 3
+        assert ev("(() => 7)()") == 7
+        assert ev("((x) => { return x + 1; })(1)") == 2
+
+    def test_destructured_params(self):
+        assert ev("([a, b]) => a + ':' + b")(["k", "v"]) == "k:v"
+        assert ev("[[1, 'a'], [2, 'b']].map(([n, s]) => s + n).join()") \
+            == "a1,b2"
+
+    def test_function_decl_and_hoisting(self):
+        it = run("""
+            __out = helper(2);
+            function helper(x) { return x * 10; }
+        """)
+        assert it.globals["__out"] == 20
+
+    def test_async_collapses_to_sync(self):
+        it = run("""
+            async function f(x) { return x + 1; }
+            __out = await f(1);
+            __all = await Promise.all([f(1), f(2)]);
+        """)
+        assert it.globals["__out"] == 2
+        assert it.globals["__all"] == [2, 3]
+
+    def test_closures(self):
+        assert ev("(() => { let n = 0; "
+                  "const inc = () => { n = n + 1; return n; }; "
+                  "inc(); return inc(); })()") == 2
+
+    def test_js_function_callable_from_python(self):
+        it = run("function add(a, b) { return a + b; }")
+        assert it.globals["add"](2, 3) == 5
+
+
+class TestStatements:
+    def test_const_let_multi_declarator(self):
+        it = run("const lo = 1, hi = 5; let x = lo + hi;")
+        assert it.globals["x"] == 6
+
+    def test_array_destructuring_decl(self):
+        it = run("const [a, b] = [1, 2];")
+        assert it.globals["a"] == 1 and it.globals["b"] == 2
+
+    def test_if_else_for_of(self):
+        it = run("""
+            let total = 0;
+            for (const v of [1, 2, 3]) {
+                if (v === 2) { total = total + 10; }
+                else total = total + v;
+            }
+        """)
+        assert it.globals["total"] == 14
+
+    def test_try_catch_throw(self):
+        it = run("""
+            let msg = '';
+            try { throw new Error('boom'); }
+            catch (e) { msg = e.message; }
+        """)
+        assert it.globals["msg"] == "boom"
+
+    def test_uncaught_throw_raises_jserror(self):
+        with pytest.raises(JSError, match="boom"):
+            run("throw new Error('boom');")
+
+    def test_try_finally_propagates_and_runs_cleanup(self):
+        with pytest.raises(JSError, match="boom"):
+            run("""
+                let cleaned = false;
+                try { throw new Error('boom'); }
+                finally { cleaned = true; }
+            """)
+        it = Interpreter()
+        try:
+            it.run("try { throw new Error('x'); } "
+                   "finally { __cleaned = true; }")
+        except JSError:
+            pass
+        assert it.globals["__cleaned"] is True
+
+    def test_catch_rethrow_and_return_inside(self):
+        assert ev("(() => { try { return 'a'; } catch (e) { return 'b'; } "
+                  "})()") == "a"
+
+    def test_undefined_variable_throws(self):
+        with pytest.raises(JSError, match="not defined"):
+            run("nope + 1;")
+
+
+class TestStdlib:
+    def test_esc_replace_with_callback(self):
+        # The exact esc() from the served pages.
+        it = run("""
+            function esc(s) {
+              return String(s).replace(/[&<>"']/g, c => ({'&': '&amp;',
+                '<': '&lt;', '>': '&gt;', '"': '&quot;',
+                "'": '&#39;'})[c]);
+            }
+            __out = esc('<img src=x onerror="hi">&\\'');
+        """)
+        assert it.globals["__out"] == \
+            "&lt;img src=x onerror=&quot;hi&quot;&gt;&amp;&#39;"
+
+    def test_array_methods(self):
+        assert ev("[1, 2, 3].map(x => x * 2)") == [2, 4, 6]
+        assert ev("[1, 2, 3].filter(x => x > 1)") == [2, 3]
+        assert ev("[1, 2, 3].find(x => x === 2)") == 2
+        assert ev("[1, 2].includes(2)") is True
+        assert ev("['a', 'b'].join(', ')") == "a, b"
+        assert ev("[1, 2, 3, 4].slice(0, 2)") == [1, 2]
+        it = run("const a = []; a.push('x'); a.push('y'); __n = a.length;")
+        assert it.globals["__n"] == 2
+
+    def test_foreach_assigns_handlers(self):
+        # The delegation pattern: forEach(b => b.onclick = async () => ...)
+        class Btn:
+            onclick = None
+
+        b1, b2 = Btn(), Btn()
+        it = Interpreter({"btns": [b1, b2]})
+        it.run("btns.forEach(b => b.onclick = async () => 'clicked');")
+        assert callable(b1.onclick) and callable(b2.onclick)
+        assert b1.onclick() == "clicked"
+
+    def test_object_entries(self):
+        assert ev("Object.entries({a: 1}).map(([k, v]) => k + v)") == ["a1"]
+
+    def test_json_stringify(self):
+        assert ev("JSON.stringify({name: 'x', n: 2})") == \
+            '{"name":"x","n":2}'
+        assert ev("JSON.stringify({a: [1, 'b', true]})") == '{"a":[1,"b",true]}'
+
+    def test_math_and_number_formatting(self):
+        assert ev("Math.min(3, 1, 2)") == 1
+        assert ev("(1.23456).toFixed(1)") == "1.2"
+        assert ev("(0.000123456).toPrecision(4)") == "0.0001235"
+        assert ev("Number('42') + 1") == 43
+
+    def test_encode_uri_component(self):
+        assert ev("encodeURIComponent('a b/c?')") == "a%20b%2Fc%3F"
+
+    def test_array_isarray(self):
+        assert ev("Array.isArray([1])") is True
+        assert ev("Array.isArray('no')") is False
+
+    def test_string_methods(self):
+        assert ev("'a,b'.split(',')") == ["a", "b"]
+        assert ev("'hello'.includes('ell')") is True
+        assert ev("'  x '.trim()") == "x"
+
+    def test_js_to_string_object(self):
+        assert js_to_string({"a": 1}) == "[object Object]"
+
+
+class TestHostInterop:
+    def test_host_object_get_set(self):
+        class El:
+            def __init__(self):
+                self.innerHTML = ""
+                self.value = "seed"
+
+        el = El()
+        it = Interpreter({"el": el})
+        it.run("el.innerHTML = '<p>' + el.value + '</p>';")
+        assert el.innerHTML == "<p>seed</p>"
+
+    def test_host_function_receives_js_values(self):
+        seen = {}
+
+        def grab(path, opts=undefined):
+            seen["path"] = path
+            seen["opts"] = opts
+            return {"ok": True}
+
+        it = Interpreter({"grab": grab})
+        it.run("__r = grab('/api/x', {method: 'POST'}); __ok = __r.ok;")
+        assert seen["path"] == "/api/x"
+        assert seen["opts"] == {"method": "POST"}
+        assert it.globals["__ok"] is True
+
+    def test_missing_host_attr_is_undefined(self):
+        class El:
+            pass
+
+        assert ev("el.nope", el=El()) is undefined
+
+    def test_member_of_null_throws(self):
+        with pytest.raises(JSError, match="cannot read"):
+            run("const x = null; x.y;")
