@@ -13,6 +13,9 @@ from typing import Dict, List, Optional
 
 @dataclasses.dataclass
 class OwnerReference:
+    # metav1.OwnerReference requires apiVersion on a real apiserver; every
+    # owner in this platform is one of our own CRs, so default the group.
+    api_version: str = "tpu.kubeflow.org/v1alpha1"
     kind: str = ""
     name: str = ""
     uid: str = ""

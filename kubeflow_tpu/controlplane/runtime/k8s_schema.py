@@ -1,0 +1,625 @@
+"""Vendored Kubernetes structural schemas + validator.
+
+The reference vendors the full k8s OpenAPI spec so everything it emits is
+checked against the real API schema (reference bootstrap/k8sSpec/v1.11.7/
+— used by its kfctl apply path), and its controllers run against a real
+etcd+apiserver (profile-controller/controllers/suite_test.go:50-72). This
+environment has no cluster and no egress, so the equivalent contract is
+vendored by hand: STRUCTURAL schemas — the same subset the k8s apiserver
+enforces for CRDs (types, required fields, unknown-field pruning) — for
+every kind the platform emits, transcribed from the upstream API
+definitions (k8s core/v1, apps/v1, rbac/v1, apiextensions/v1 at v1.29;
+Istio networking/v1beta1 + security/v1).
+
+``validate(doc)`` returns a list of errors (empty = valid): unknown
+fields under typed sections, wrong JSON types, missing required fields,
+malformed DNS-1123 names and label keys/values — the error classes a real
+apiserver's create would reject and a mirror-image fake parser would
+happily accept. Wired into:
+
+- the kubectl adapter's outgoing manifests (runtime/kubectl.py raises
+  before exec'ing kubectl with an invalid manifest),
+- the kubectl test double (tests/fake_kubectl.py rejects invalid incoming
+  objects apiserver-style),
+- the release-manifest test tier (tools/release.py emissions).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+__all__ = ["validate", "validate_metadata", "schema_for", "SCHEMAS"]
+
+_DNS1123_SUBDOMAIN = re.compile(
+    r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*$")
+_DNS1123_LABEL = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+_RFC1035_LABEL = re.compile(r"^[a-z]([-a-z0-9]*[a-z0-9])?$")
+
+# metadata.name rules differ by resource on a real apiserver: Services
+# are RFC1035 labels (DNS A-record hosts), Namespaces DNS-1123 labels,
+# RBAC kinds allow path-segment names (e.g. "system:controller:x" — the
+# reference's kfam emits "namespaceAdmin"), most others DNS-1123
+# subdomains. Keyed by kind; "path-segment" = anything without "/" or
+# "%", not "." / "..".
+_NAME_RULES = {
+    "Service": ("RFC-1035 label", _RFC1035_LABEL),
+    "Namespace": ("DNS-1123 label", _DNS1123_LABEL),
+    "Role": ("path segment", None),
+    "ClusterRole": ("path segment", None),
+    "RoleBinding": ("path segment", None),
+    "ClusterRoleBinding": ("path segment", None),
+}
+_LABEL_VALUE = re.compile(r"^(([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9])?$")
+_QUALIFIED_NAME = re.compile(
+    r"^([a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*/)?"
+    r"[A-Za-z0-9]([-A-Za-z0-9_.]*[A-Za-z0-9])?$")
+_QUANTITY = re.compile(
+    r"^[+-]?(\d+|\d+\.\d*|\.\d+)([eE][+-]?\d+|[kKMGTPE]i?|[mun])?$")
+
+# -------------------------------------------------------------- DSL
+# str_ / int_ / num / boolean: scalars. obj(props, required=[...],
+# open=True) allows unknown props; map_of(v): string-keyed map; arr(item);
+# enum(...); int_or_str; quantity (k8s resource.Quantity string); any_.
+
+str_ = {"type": "string"}
+int_ = {"type": "integer"}
+num = {"type": "number"}
+boolean = {"type": "boolean"}
+int_or_str = {"type": "int-or-string"}
+quantity = {"type": "quantity"}
+any_ = {"type": "any"}
+
+
+def obj(props: Dict[str, Any], required: Optional[List[str]] = None,
+        open: bool = False) -> Dict[str, Any]:
+    return {"type": "object", "properties": props,
+            "required": required or [], "open": open}
+
+
+def map_of(value_schema: Dict[str, Any]) -> Dict[str, Any]:
+    return {"type": "map", "values": value_schema}
+
+
+def arr(item: Dict[str, Any]) -> Dict[str, Any]:
+    return {"type": "array", "items": item}
+
+
+def enum(*values: str) -> Dict[str, Any]:
+    return {"type": "string", "enum": list(values)}
+
+
+# -------------------------------------------------------------- shared
+
+_OWNER_REF = obj({
+    "apiVersion": str_, "kind": str_, "name": str_, "uid": str_,
+    "controller": boolean, "blockOwnerDeletion": boolean,
+}, required=["apiVersion", "kind", "name", "uid"])
+
+METADATA = obj({
+    "name": str_, "namespace": str_, "generateName": str_,
+    "labels": map_of(str_), "annotations": map_of(str_),
+    "uid": str_, "resourceVersion": str_, "generation": int_,
+    "creationTimestamp": str_, "deletionTimestamp": str_,
+    "ownerReferences": arr(_OWNER_REF), "finalizers": arr(str_),
+    "managedFields": arr(any_), "selfLink": str_,
+    "deletionGracePeriodSeconds": int_,
+}, required=["name"])
+
+_LABEL_SELECTOR = obj({
+    "matchLabels": map_of(str_),
+    "matchExpressions": arr(obj({
+        "key": str_, "operator": str_, "values": arr(str_),
+    }, required=["key", "operator"])),
+})
+
+# -------------------------------------------------------------- core/v1
+
+_ENV_VAR = obj({
+    "name": str_, "value": str_,
+    "valueFrom": obj({
+        "fieldRef": obj({"fieldPath": str_, "apiVersion": str_},
+                        required=["fieldPath"]),
+        "configMapKeyRef": obj({"name": str_, "key": str_, "optional":
+                                boolean}, required=["key"]),
+        "secretKeyRef": obj({"name": str_, "key": str_, "optional":
+                             boolean}, required=["key"]),
+        "resourceFieldRef": obj({"resource": str_, "containerName": str_,
+                                 "divisor": quantity},
+                                required=["resource"]),
+    }),
+}, required=["name"])
+
+_CONTAINER = obj({
+    "name": str_, "image": str_,
+    "command": arr(str_), "args": arr(str_),
+    "env": arr(_ENV_VAR),
+    "envFrom": arr(obj({
+        "configMapRef": obj({"name": str_, "optional": boolean}),
+        "secretRef": obj({"name": str_, "optional": boolean}),
+        "prefix": str_,
+    })),
+    "ports": arr(obj({
+        "containerPort": int_, "name": str_, "protocol":
+        enum("TCP", "UDP", "SCTP"), "hostPort": int_, "hostIP": str_,
+    }, required=["containerPort"])),
+    "resources": obj({
+        "requests": map_of(quantity), "limits": map_of(quantity),
+        "claims": arr(any_),
+    }),
+    "volumeMounts": arr(obj({
+        "name": str_, "mountPath": str_, "readOnly": boolean,
+        "subPath": str_, "mountPropagation": str_,
+    }, required=["name", "mountPath"])),
+    "workingDir": str_, "imagePullPolicy":
+    enum("Always", "IfNotPresent", "Never"),
+    "securityContext": any_, "livenessProbe": any_,
+    "readinessProbe": any_, "startupProbe": any_, "lifecycle": any_,
+    "terminationMessagePath": str_, "terminationMessagePolicy": str_,
+    "stdin": boolean, "tty": boolean,
+    # Upstream requires only "name" (image may be injected by admission).
+}, required=["name"])
+
+_VOLUME = obj({
+    "name": str_,
+    "emptyDir": obj({"medium": str_, "sizeLimit": quantity}),
+    "persistentVolumeClaim": obj({"claimName": str_, "readOnly": boolean},
+                                 required=["claimName"]),
+    "configMap": obj({"name": str_, "items": arr(any_), "optional":
+                      boolean, "defaultMode": int_}),
+    "secret": obj({"secretName": str_, "items": arr(any_), "optional":
+                   boolean, "defaultMode": int_}),
+    "hostPath": obj({"path": str_, "type": str_}, required=["path"]),
+    "downwardAPI": any_, "projected": any_,
+}, required=["name"])
+
+_POD_SPEC = obj({
+    "containers": arr(_CONTAINER),
+    "initContainers": arr(_CONTAINER),
+    "volumes": arr(_VOLUME),
+    "nodeSelector": map_of(str_),
+    "serviceAccountName": str_, "serviceAccount": str_,
+    "restartPolicy": enum("Always", "OnFailure", "Never"),
+    "subdomain": str_, "hostname": str_, "nodeName": str_,
+    "schedulerName": str_, "priorityClassName": str_, "priority": int_,
+    "terminationGracePeriodSeconds": int_, "activeDeadlineSeconds": int_,
+    "dnsPolicy": str_, "hostNetwork": boolean, "tolerations": arr(any_),
+    "affinity": any_, "topologySpreadConstraints": arr(any_),
+    "imagePullSecrets": arr(obj({"name": str_})),
+    "securityContext": any_, "enableServiceLinks": boolean,
+    "automountServiceAccountToken": boolean,
+}, required=["containers"])
+
+_POD_STATUS = obj({
+    "phase": enum("Pending", "Running", "Succeeded", "Failed", "Unknown"),
+    "podIP": str_, "hostIP": str_, "message": str_, "reason": str_,
+    "conditions": arr(obj({
+        "type": str_, "status": str_, "reason": str_, "message": str_,
+        "lastTransitionTime": str_, "lastProbeTime": str_,
+    }, required=["type", "status"])),
+    "containerStatuses": arr(obj({
+        "name": str_, "ready": boolean, "restartCount": int_,
+        "image": str_, "imageID": str_, "state": any_, "lastState": any_,
+        "started": boolean, "containerID": str_,
+    }, required=["name"])),
+    "podIPs": arr(obj({"ip": str_})), "startTime": str_,
+    "qosClass": str_, "initContainerStatuses": arr(any_),
+})
+
+POD = obj({
+    "apiVersion": enum("v1"), "kind": enum("Pod"),
+    "metadata": METADATA, "spec": _POD_SPEC, "status": _POD_STATUS,
+}, required=["apiVersion", "kind", "metadata", "spec"])
+
+SERVICE = obj({
+    "apiVersion": enum("v1"), "kind": enum("Service"),
+    "metadata": METADATA,
+    "spec": obj({
+        "selector": map_of(str_),
+        "ports": arr(obj({
+            "name": str_, "port": int_, "targetPort": int_or_str,
+            "protocol": enum("TCP", "UDP", "SCTP"), "nodePort": int_,
+            "appProtocol": str_,
+        }, required=["port"])),
+        "clusterIP": str_, "clusterIPs": arr(str_),
+        "type": enum("ClusterIP", "NodePort", "LoadBalancer",
+                     "ExternalName"),
+        "externalName": str_, "sessionAffinity": str_,
+        "ipFamilies": arr(str_), "ipFamilyPolicy": str_,
+        "internalTrafficPolicy": str_, "externalTrafficPolicy": str_,
+    }),
+    "status": any_,
+}, required=["apiVersion", "kind", "metadata", "spec"])
+
+NAMESPACE = obj({
+    "apiVersion": enum("v1"), "kind": enum("Namespace"),
+    "metadata": METADATA,
+    "spec": obj({"finalizers": arr(str_)}),
+    "status": obj({"phase": enum("Active", "Terminating"),
+                   "conditions": arr(any_)}, open=True),
+}, required=["apiVersion", "kind", "metadata"])
+
+SERVICE_ACCOUNT = obj({
+    "apiVersion": enum("v1"), "kind": enum("ServiceAccount"),
+    "metadata": METADATA,
+    "secrets": arr(obj({"name": str_}, open=True)),
+    "imagePullSecrets": arr(obj({"name": str_})),
+    "automountServiceAccountToken": boolean,
+}, required=["apiVersion", "kind", "metadata"])
+
+RESOURCE_QUOTA = obj({
+    "apiVersion": enum("v1"), "kind": enum("ResourceQuota"),
+    "metadata": METADATA,
+    "spec": obj({"hard": map_of(quantity), "scopes": arr(str_),
+                 "scopeSelector": any_}),
+    "status": obj({"hard": map_of(quantity), "used": map_of(quantity)}),
+}, required=["apiVersion", "kind", "metadata", "spec"])
+
+EVENT = obj({
+    "apiVersion": enum("v1"), "kind": enum("Event"),
+    "metadata": METADATA,
+    "involvedObject": obj({
+        "kind": str_, "name": str_, "namespace": str_, "uid": str_,
+        "apiVersion": str_, "resourceVersion": str_, "fieldPath": str_,
+    }),
+    "type": enum("Normal", "Warning"),
+    "reason": str_, "message": str_, "count": int_,
+    "firstTimestamp": str_, "lastTimestamp": str_, "eventTime": str_,
+    "source": obj({"component": str_, "host": str_}),
+    "reportingComponent": str_, "reportingInstance": str_,
+    "action": str_, "related": any_, "series": any_,
+}, required=["apiVersion", "kind", "metadata", "involvedObject"])
+
+SECRET = obj({
+    "apiVersion": enum("v1"), "kind": enum("Secret"),
+    "metadata": METADATA,
+    "type": str_, "data": map_of(str_), "stringData": map_of(str_),
+    "immutable": boolean,
+}, required=["apiVersion", "kind", "metadata"])
+
+CONFIG_MAP = obj({
+    "apiVersion": enum("v1"), "kind": enum("ConfigMap"),
+    "metadata": METADATA,
+    "data": map_of(str_), "binaryData": map_of(str_),
+    "immutable": boolean,
+}, required=["apiVersion", "kind", "metadata"])
+
+# -------------------------------------------------------------- rbac/v1
+
+_POLICY_RULE = obj({
+    "apiGroups": arr(str_), "resources": arr(str_), "verbs": arr(str_),
+    "resourceNames": arr(str_), "nonResourceURLs": arr(str_),
+}, required=["verbs"])
+
+_SUBJECT = obj({
+    "kind": enum("User", "Group", "ServiceAccount"),
+    "name": str_, "namespace": str_, "apiGroup": str_,
+}, required=["kind", "name"])
+
+_ROLE_REF = obj({
+    "apiGroup": enum("rbac.authorization.k8s.io"),
+    "kind": enum("Role", "ClusterRole"), "name": str_,
+}, required=["apiGroup", "kind", "name"])
+
+
+def _rbac(kind: str, namespaced_rules: bool) -> Dict[str, Any]:
+    props: Dict[str, Any] = {
+        "apiVersion": enum("rbac.authorization.k8s.io/v1"),
+        "kind": enum(kind), "metadata": METADATA,
+    }
+    req = ["apiVersion", "kind", "metadata"]
+    if kind.endswith("Binding"):
+        props["roleRef"] = _ROLE_REF
+        props["subjects"] = arr(_SUBJECT)
+        req.append("roleRef")
+    else:
+        props["rules"] = arr(_POLICY_RULE)
+        if kind == "ClusterRole":
+            props["aggregationRule"] = any_
+    return obj(props, required=req)
+
+
+ROLE = _rbac("Role", True)
+CLUSTER_ROLE = _rbac("ClusterRole", False)
+ROLE_BINDING = _rbac("RoleBinding", True)
+CLUSTER_ROLE_BINDING = _rbac("ClusterRoleBinding", False)
+
+# -------------------------------------------------------------- apps/v1
+
+DEPLOYMENT = obj({
+    "apiVersion": enum("apps/v1"), "kind": enum("Deployment"),
+    "metadata": METADATA,
+    "spec": obj({
+        "replicas": int_,
+        "selector": _LABEL_SELECTOR,
+        "template": obj({
+            "metadata": obj({
+                "labels": map_of(str_), "annotations": map_of(str_),
+                "name": str_,
+            }),
+            "spec": _POD_SPEC,
+        }, required=["spec"]),
+        "strategy": any_, "minReadySeconds": int_,
+        "revisionHistoryLimit": int_, "progressDeadlineSeconds": int_,
+        "paused": boolean,
+    }, required=["selector", "template"]),
+    "status": any_,
+}, required=["apiVersion", "kind", "metadata", "spec"])
+
+# ------------------------------------------------- apiextensions/v1 CRD
+
+_CRD_VERSION = obj({
+    "name": str_, "served": boolean, "storage": boolean,
+    "schema": obj({"openAPIV3Schema": any_}),
+    "subresources": obj({"status": obj({}), "scale": any_}),
+    "additionalPrinterColumns": arr(any_),
+    "deprecated": boolean, "deprecationWarning": str_,
+}, required=["name", "served", "storage"])
+
+CRD = obj({
+    "apiVersion": enum("apiextensions.k8s.io/v1"),
+    "kind": enum("CustomResourceDefinition"),
+    "metadata": METADATA,
+    "spec": obj({
+        "group": str_,
+        "names": obj({
+            "plural": str_, "singular": str_, "kind": str_,
+            "listKind": str_, "shortNames": arr(str_),
+            "categories": arr(str_),
+        }, required=["plural", "kind"]),
+        "scope": enum("Namespaced", "Cluster"),
+        "versions": arr(_CRD_VERSION),
+        "conversion": any_, "preserveUnknownFields": boolean,
+    }, required=["group", "names", "scope", "versions"]),
+    "status": any_,
+}, required=["apiVersion", "kind", "metadata", "spec"])
+
+# -------------------------------------------------------------- istio
+
+VIRTUAL_SERVICE = obj({
+    "apiVersion": enum("networking.istio.io/v1beta1",
+                       "networking.istio.io/v1alpha3",
+                       "networking.istio.io/v1"),
+    "kind": enum("VirtualService"),
+    "metadata": METADATA,
+    "spec": obj({
+        "hosts": arr(str_), "gateways": arr(str_),
+        "http": arr(obj({
+            "match": arr(obj({
+                "uri": obj({"prefix": str_, "exact": str_, "regex": str_}),
+                "headers": any_, "method": any_, "port": int_,
+            })),
+            "route": arr(obj({
+                "destination": obj({
+                    "host": str_,
+                    "port": obj({"number": int_}, required=["number"]),
+                    "subset": str_,
+                }, required=["host"]),
+                "weight": int_, "headers": any_,
+            }, required=["destination"])),
+            "rewrite": obj({"uri": str_, "authority": str_}),
+            "redirect": any_, "timeout": str_, "retries": any_,
+            "headers": any_, "name": str_,
+        })),
+        "tcp": arr(any_), "tls": arr(any_), "exportTo": arr(str_),
+    }, required=["hosts"]),
+}, required=["apiVersion", "kind", "metadata", "spec"])
+
+AUTHORIZATION_POLICY = obj({
+    "apiVersion": enum("security.istio.io/v1",
+                       "security.istio.io/v1beta1"),
+    "kind": enum("AuthorizationPolicy"),
+    "metadata": METADATA,
+    "spec": obj({
+        "action": enum("ALLOW", "DENY", "AUDIT", "CUSTOM"),
+        "rules": arr(obj({
+            "from": arr(obj({"source": any_})),
+            "to": arr(obj({"operation": any_})),
+            "when": arr(obj({
+                "key": str_, "values": arr(str_),
+                "notValues": arr(str_),
+            }, required=["key"])),
+        })),
+        "selector": obj({"matchLabels": map_of(str_)}),
+        "provider": any_,
+    }),
+}, required=["apiVersion", "kind", "metadata", "spec"])
+
+# ------------------------------------------- platform CRs (own group)
+
+_CR_GROUP = "tpu.kubeflow.org"
+
+# Platform CRs: structural at the envelope (the CRD is installed with
+# x-kubernetes-preserve-unknown-fields, our serde owns spec validation),
+# strict at metadata — exactly what a real apiserver enforces for them.
+PLATFORM_CR = obj({
+    "apiVersion": str_, "kind": str_, "metadata": METADATA,
+    "spec": any_, "status": any_,
+}, required=["apiVersion", "kind", "metadata"])
+
+
+SCHEMAS: Dict[str, Dict[str, Any]] = {
+    "v1/Pod": POD,
+    "v1/Service": SERVICE,
+    "v1/Namespace": NAMESPACE,
+    "v1/ServiceAccount": SERVICE_ACCOUNT,
+    "v1/ResourceQuota": RESOURCE_QUOTA,
+    "v1/Event": EVENT,
+    "v1/Secret": SECRET,
+    "v1/ConfigMap": CONFIG_MAP,
+    "rbac.authorization.k8s.io/v1/Role": ROLE,
+    "rbac.authorization.k8s.io/v1/ClusterRole": CLUSTER_ROLE,
+    "rbac.authorization.k8s.io/v1/RoleBinding": ROLE_BINDING,
+    "rbac.authorization.k8s.io/v1/ClusterRoleBinding": CLUSTER_ROLE_BINDING,
+    "apps/v1/Deployment": DEPLOYMENT,
+    "apiextensions.k8s.io/v1/CustomResourceDefinition": CRD,
+    "networking.istio.io/v1beta1/VirtualService": VIRTUAL_SERVICE,
+    "networking.istio.io/v1alpha3/VirtualService": VIRTUAL_SERVICE,
+    "networking.istio.io/v1/VirtualService": VIRTUAL_SERVICE,
+    "security.istio.io/v1/AuthorizationPolicy": AUTHORIZATION_POLICY,
+    "security.istio.io/v1beta1/AuthorizationPolicy": AUTHORIZATION_POLICY,
+}
+
+
+def schema_for(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    api_version = doc.get("apiVersion", "")
+    kind = doc.get("kind", "")
+    key = f"{api_version}/{kind}"
+    if key in SCHEMAS:
+        return SCHEMAS[key]
+    if api_version.startswith(_CR_GROUP + "/"):
+        return PLATFORM_CR
+    return None
+
+
+# -------------------------------------------------------------- validator
+
+
+def _type_name(v: Any) -> str:
+    if isinstance(v, bool):
+        return "boolean"
+    if isinstance(v, int):
+        return "integer"
+    if isinstance(v, float):
+        return "number"
+    if isinstance(v, str):
+        return "string"
+    if isinstance(v, list):
+        return "array"
+    if isinstance(v, dict):
+        return "object"
+    if v is None:
+        return "null"
+    return type(v).__name__
+
+
+def _walk(schema: Dict[str, Any], value: Any, path: str,
+          errors: List[str]) -> None:
+    stype = schema.get("type", "any")
+    if stype == "any":
+        return
+    if stype == "string":
+        if not isinstance(value, str):
+            errors.append(f"{path}: expected string, got "
+                          f"{_type_name(value)}")
+            return
+        allowed = schema.get("enum")
+        if allowed and value not in allowed:
+            errors.append(f"{path}: {value!r} not in {allowed}")
+        return
+    if stype == "integer":
+        if isinstance(value, bool) or not isinstance(value, int):
+            errors.append(f"{path}: expected integer, got "
+                          f"{_type_name(value)}")
+        return
+    if stype == "number":
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            errors.append(f"{path}: expected number, got "
+                          f"{_type_name(value)}")
+        return
+    if stype == "boolean":
+        if not isinstance(value, bool):
+            errors.append(f"{path}: expected boolean, got "
+                          f"{_type_name(value)}")
+        return
+    if stype == "int-or-string":
+        if isinstance(value, bool) or not isinstance(value, (int, str)):
+            errors.append(f"{path}: expected int-or-string, got "
+                          f"{_type_name(value)}")
+        return
+    if stype == "quantity":
+        if isinstance(value, bool) or not isinstance(value, (int, float,
+                                                             str)):
+            errors.append(f"{path}: expected quantity, got "
+                          f"{_type_name(value)}")
+            return
+        if isinstance(value, str) and not _QUANTITY.match(value):
+            errors.append(f"{path}: {value!r} is not a valid quantity")
+        return
+    if stype == "map":
+        if not isinstance(value, dict):
+            errors.append(f"{path}: expected object, got "
+                          f"{_type_name(value)}")
+            return
+        for k, v in value.items():
+            if not isinstance(k, str):
+                errors.append(f"{path}: non-string key {k!r}")
+                continue
+            _walk(schema["values"], v, f"{path}.{k}", errors)
+        return
+    if stype == "array":
+        if not isinstance(value, list):
+            errors.append(f"{path}: expected array, got "
+                          f"{_type_name(value)}")
+            return
+        for i, item in enumerate(value):
+            _walk(schema["items"], item, f"{path}[{i}]", errors)
+        return
+    if stype == "object":
+        if not isinstance(value, dict):
+            errors.append(f"{path}: expected object, got "
+                          f"{_type_name(value)}")
+            return
+        props = schema.get("properties", {})
+        for req in schema.get("required", []):
+            if req not in value:
+                errors.append(f"{path}: missing required field {req!r}")
+        for k, v in value.items():
+            if k in props:
+                _walk(props[k], v, f"{path}.{k}", errors)
+            elif not schema.get("open", False):
+                errors.append(f"{path}: unknown field {k!r}")
+        return
+    raise AssertionError(f"bad schema node type {stype!r}")
+
+
+def validate_metadata(meta: Dict[str, Any], path: str = "metadata",
+                      errors: Optional[List[str]] = None,
+                      kind: str = "") -> List[str]:
+    """Name/label syntax — the validation layer beyond structure that a
+    real apiserver applies (per-kind name rules, qualified label keys,
+    label value charset)."""
+    errors = errors if errors is not None else []
+    name = meta.get("name", "")
+    rule_name, rule_re = _NAME_RULES.get(
+        kind, ("DNS-1123 subdomain", _DNS1123_SUBDOMAIN))
+    if name:
+        if rule_re is None:  # path segment
+            if "/" in name or "%" in name or name in (".", ".."):
+                errors.append(f"{path}.name: {name!r} is not a valid "
+                              f"{rule_name}")
+        elif not rule_re.match(name):
+            errors.append(f"{path}.name: {name!r} is not a {rule_name}")
+    ns = meta.get("namespace", "")
+    if ns and not _DNS1123_SUBDOMAIN.match(ns):
+        errors.append(
+            f"{path}.namespace: {ns!r} is not a DNS-1123 subdomain")
+    for k, v in (meta.get("labels") or {}).items():
+        if not _QUALIFIED_NAME.match(k):
+            errors.append(f"{path}.labels: bad key {k!r}")
+        if not isinstance(v, str) or not _LABEL_VALUE.match(v):
+            errors.append(f"{path}.labels[{k}]: bad value {v!r}")
+    for k in (meta.get("annotations") or {}):
+        if not _QUALIFIED_NAME.match(k):
+            errors.append(f"{path}.annotations: bad key {k!r}")
+    return errors
+
+
+def validate(doc: Dict[str, Any]) -> List[str]:
+    """Validate one wire manifest. Returns error strings (empty = valid).
+    Unknown (apiVersion, kind) pairs are themselves an error — a real
+    apiserver rejects resources it has no registered type for."""
+    if not isinstance(doc, dict):
+        return [f"manifest must be an object, got {_type_name(doc)}"]
+    schema = schema_for(doc)
+    if schema is None:
+        return [f"no vendored schema for "
+                f"{doc.get('apiVersion', '?')}/{doc.get('kind', '?')} — "
+                "register it in k8s_schema.SCHEMAS"]
+    errors: List[str] = []
+    _walk(schema, doc, doc.get("kind", "?"), errors)
+    meta = doc.get("metadata")
+    if isinstance(meta, dict):
+        validate_metadata(meta, f"{doc.get('kind', '?')}.metadata", errors,
+                          kind=doc.get("kind", ""))
+    return errors

@@ -11,11 +11,16 @@ the deployment mode the reference's controllers always assumed
 Scope and honesty:
 - CRs (TpuJob, Notebook, ..., our group's kinds) round-trip faithfully —
   their schema *is* our dataclasses.
-- Core kinds (Pod/Service/...) use the framework's simplified shapes: a
-  real cluster accepts them as far as the fields go, but cluster-added
-  fields beyond our dataclasses are dropped on read (from_dict ignores
-  unknown keys). Full-schema parity is a non-goal: controllers only read
-  back fields they wrote, plus status.phase.
+- Core/Istio kinds cross the boundary through ``runtime/k8swire.py``,
+  which produces REAL Kubernetes wire shapes (containerPort objects,
+  requests/limits, RFC3339 timestamps, spec-nested Istio, ...); every
+  outgoing manifest is validated against the vendored structural schemas
+  in ``runtime/k8s_schema.py`` before kubectl ever sees it, and the
+  kubectl test double applies the same validation to what arrives —
+  the two-sided contract the reference gets from its vendored OpenAPI
+  spec + envtest apiserver. Cluster-added fields beyond our dataclasses
+  are dropped on read (controllers only read back what they wrote, plus
+  status).
 - Admission mutators are a server-side concern in a real cluster
   (admission-webhook); ``register_mutator`` here is a no-op with a log.
 - Watch is poll-based (informer resync-style): a background poller (or
@@ -126,25 +131,28 @@ class KubectlApiServer:
 
     @staticmethod
     def _from_manifest(data: dict, kind: str = "") -> Any:
-        # K8s resourceVersions are numeric strings; our metadata holds int.
-        meta = data.get("metadata", {})
-        rv = meta.get("resourceVersion")
-        if isinstance(rv, str) and rv.isdigit():
-            meta["resourceVersion"] = int(rv)
-        if kind:
-            data.setdefault("kind", kind)
-        return object_from_dict(data)
+        from kubeflow_tpu.controlplane.runtime.k8swire import from_wire
+
+        return from_wire(data, kind=kind)
 
     @classmethod
     def _parse(cls, raw: str) -> Any:
         return cls._from_manifest(json.loads(raw))
 
     def _manifest(self, obj: Any) -> str:
-        data = to_dict(obj)
-        meta = data.setdefault("metadata", {})
-        rv = meta.get("resourceVersion")
-        if rv:
-            meta["resourceVersion"] = str(rv)
+        from kubeflow_tpu.controlplane.runtime.k8s_schema import validate
+        from kubeflow_tpu.controlplane.runtime.k8swire import to_wire
+
+        data = to_wire(obj)
+        errors = validate(data)
+        if errors:
+            # Fail HERE, not at the cluster: an invalid manifest reaching
+            # a real apiserver is a controller bug, and the vendored
+            # schema is the contract that catches it in-process.
+            raise ApiError(
+                f"manifest for {data.get('kind')}/"
+                f"{data.get('metadata', {}).get('name')} fails k8s schema "
+                f"validation: {'; '.join(errors[:5])}")
         return json.dumps(data)
 
     # ----------------- CRUD -----------------

@@ -8,14 +8,32 @@ analogue of the reference's envtest: real exec + serialization semantics,
 no cluster. Implements apiserver behaviours the adapter's error mapping
 relies on: AlreadyExists/NotFound/Conflict(resourceVersion), and
 ownerReference cascade on delete.
+
+Schema grounding: every incoming create/replace manifest is validated
+against the vendored Kubernetes structural schemas
+(kubeflow_tpu/controlplane/runtime/k8s_schema.py) — NOT this file's own
+parser — so a field-name or type error a real apiserver would reject
+fails here too, apiserver-style ("error validating data"). This is the
+fake half of the contract whose emit half lives in runtime/kubectl.py.
 """
 
+import datetime
+import importlib.util
 import json
 import os
 import sys
-import time
 import uuid
 from pathlib import Path
+
+# Load the schema module by file path: going through the kubeflow_tpu
+# package __init__ would import jax (~2s per kubectl invocation — the
+# adapter shells out hundreds of times per test run).
+_schema_path = (Path(__file__).resolve().parent.parent / "kubeflow_tpu"
+                / "controlplane" / "runtime" / "k8s_schema.py")
+_spec = importlib.util.spec_from_file_location("_k8s_schema", _schema_path)
+_k8s_schema = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_k8s_schema)
+validate = _k8s_schema.validate
 
 STORE = Path(os.environ.get("FAKE_KUBECTL_DIR", "/tmp/fake-kubectl"))
 CLUSTER_SCOPED = {"Namespace", "Profile", "PlatformConfig"}
@@ -100,8 +118,15 @@ def parse_flags(argv):
     return flags
 
 
+def check_schema(obj):
+    errors = validate(obj)
+    if errors:
+        fail("error: error validating data: " + "; ".join(errors[:5]))
+
+
 def cmd_create(flags):
     obj = json.load(sys.stdin)
+    check_schema(obj)
     kind, meta = obj["kind"], obj["metadata"]
     p = path_for(kind, meta.get("namespace", ""), meta["name"])
     if p.exists():
@@ -110,7 +135,8 @@ def cmd_create(flags):
     meta["uid"] = str(uuid.uuid4())
     meta["resourceVersion"] = str(next_rv())
     meta["generation"] = 1
-    meta["creationTimestamp"] = time.time()
+    meta["creationTimestamp"] = datetime.datetime.now(
+        datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
     save(obj)
     print(json.dumps(obj))
 
@@ -138,6 +164,7 @@ def cmd_get(flags):
 
 def cmd_replace(flags):
     obj = json.load(sys.stdin)
+    check_schema(obj)
     kind, meta = obj["kind"], obj["metadata"]
     p = path_for(kind, meta.get("namespace", ""), meta["name"])
     if not p.exists():

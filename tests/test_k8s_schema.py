@@ -1,0 +1,347 @@
+"""Schema-grounded manifest validation (VERDICT r4 Missing #4).
+
+The reference vendors the k8s OpenAPI spec so its emitted manifests are
+checked against the real API schema (bootstrap/k8sSpec/v1.11.7) and runs
+controllers against a real apiserver (profile-controller suite_test.go).
+Here the same contract is enforced by the vendored structural schemas
+(runtime/k8s_schema.py) + the k8s wire adapter (runtime/k8swire.py):
+
+1. everything release.py emits validates;
+2. everything the CONTROLLERS produce validates through to_wire and
+   round-trips without spec drift;
+3. injected structural errors (wrong field name, wrong type, bad DNS
+   name, two-slash annotation key) FAIL — the classes a mirror-image
+   parser would wave through;
+4. the kubectl adapter refuses to exec an invalid manifest, and the
+   kubectl test double rejects invalid incoming objects apiserver-style.
+"""
+
+import copy
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from kubeflow_tpu.controlplane.api import ObjectMeta
+from kubeflow_tpu.controlplane.api.core import (
+    Container,
+    Pod,
+    PodSpec,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    Volume,
+)
+from kubeflow_tpu.controlplane.api.serde import to_dict
+from kubeflow_tpu.controlplane.api.types import (
+    Notebook,
+    NotebookSpec,
+    PlatformConfig,
+    Profile,
+    ProfileSpec,
+    TpuJob,
+    TpuJobSpec,
+)
+from kubeflow_tpu.controlplane.platform import Platform
+from kubeflow_tpu.controlplane.runtime.k8s_schema import (
+    validate,
+    validate_metadata,
+)
+from kubeflow_tpu.controlplane.runtime.k8swire import from_wire, to_wire
+from kubeflow_tpu.tools.release import build_k8s_manifests
+
+WIRE_KINDS = ("Pod", "Service", "Namespace", "ServiceAccount",
+              "ResourceQuota", "RoleBinding", "VirtualService",
+              "AuthorizationPolicy", "Event")
+
+
+@pytest.fixture(scope="module")
+def platform_objects():
+    """A reconciled platform with a profile, a notebook and a gang job —
+    every wire-crossing kind the controllers emit, as wire manifests."""
+    pf = Platform()
+    pf.apply_config(PlatformConfig(metadata=ObjectMeta(name="kubeflow-tpu")))
+    pf.api.create(Profile(metadata=ObjectMeta(name="team-a"),
+                          spec=ProfileSpec(owner="a@x.com",
+                                           tpu_chip_quota=32)))
+    pf.reconcile()
+    pf.api.create(Notebook(metadata=ObjectMeta(name="nb", namespace="team-a"),
+                           spec=NotebookSpec(image="jupyter:latest")))
+    pf.api.create(TpuJob(metadata=ObjectMeta(name="job", namespace="team-a"),
+                         spec=TpuJobSpec(slice_type="v5e-16",
+                                         model="llama-tiny")))
+    pf.reconcile()
+    pf.reconcile()
+    out = []
+    for kind in WIRE_KINDS:
+        items = list(pf.api.list(kind, namespace="team-a"))
+        if kind == "Namespace":
+            items += list(pf.api.list(kind))
+        out.extend((kind, o) for o in items)
+    return out
+
+
+class TestEmittedManifests:
+    def test_release_manifests_all_validate(self):
+        docs = build_k8s_manifests()
+        assert len(docs) >= 20
+        kinds = set()
+        for d in docs:
+            errs = validate(d)
+            assert not errs, (d["kind"], d["metadata"]["name"], errs)
+            kinds.add(d["kind"])
+        # The full fresh-cluster shape is covered, not a token subset.
+        assert {"CustomResourceDefinition", "Deployment", "Service",
+                "ServiceAccount", "ClusterRole", "ClusterRoleBinding",
+                "Namespace", "Secret"} <= kinds
+
+    def test_controller_objects_all_validate(self, platform_objects):
+        assert len(platform_objects) >= 15   # pods, services, rbac, ...
+        seen = set()
+        for kind, obj in platform_objects:
+            wire = to_wire(obj)
+            errs = validate(wire)
+            assert not errs, (kind, obj.metadata.name, errs)
+            seen.add(kind)
+        assert set(WIRE_KINDS) <= seen, (
+            f"fixture no longer produces {set(WIRE_KINDS) - seen}")
+
+    def test_wire_roundtrip_preserves_spec(self, platform_objects):
+        for kind, obj in platform_objects:
+            wire = json.loads(json.dumps(to_wire(obj)))  # through JSON
+            back = from_wire(wire)
+            assert to_dict(back).get("spec") == to_dict(obj).get("spec"), (
+                kind, obj.metadata.name)
+
+
+class TestWireShapes:
+    """The adapter emits REAL k8s shapes, not the internal ones."""
+
+    def test_pod_wire_shape(self):
+        pod = Pod(
+            metadata=ObjectMeta(name="w0", namespace="team-a"),
+            spec=PodSpec(
+                containers=[Container(
+                    name="main", image="img:1", ports=[8471],
+                    resources={"google.com/tpu": "4"})],
+                volumes=[Volume(name="ckpt", pvc="ckpt-claim")],
+                service_account="runner",
+                scheduler_hints={"gang-size": "4"},
+            ),
+        )
+        wire = to_wire(pod)
+        c = wire["spec"]["containers"][0]
+        assert c["ports"] == [{"containerPort": 8471}]
+        assert c["resources"]["limits"] == {"google.com/tpu": "4"}
+        assert c["resources"]["requests"] == {"google.com/tpu": "4"}
+        assert wire["spec"]["volumes"][0]["persistentVolumeClaim"] == {
+            "claimName": "ckpt-claim"}
+        assert wire["spec"]["serviceAccountName"] == "runner"
+        # hints ride a single-slash qualified annotation key
+        anno = wire["metadata"]["annotations"]
+        assert anno["scheduler-hints.tpu.kubeflow.org/gang-size"] == "4"
+        assert not validate(wire), validate(wire)
+
+    def test_pod_wire_accepts_real_cluster_extras(self):
+        """from_wire must swallow the fields a live apiserver adds."""
+        wire = to_wire(Pod(
+            metadata=ObjectMeta(name="w0", namespace="team-a"),
+            spec=PodSpec(containers=[Container(name="m", image="i")])))
+        wire["metadata"]["managedFields"] = [{"manager": "kubectl"}]
+        wire["metadata"]["creationTimestamp"] = "2026-01-01T00:00:00Z"
+        wire["spec"]["nodeName"] = "node-1"
+        wire["spec"]["dnsPolicy"] = "ClusterFirst"
+        wire["spec"]["containers"][0]["imagePullPolicy"] = "IfNotPresent"
+        wire["status"] = {"phase": "Running", "podIP": "10.0.0.7",
+                          "qosClass": "Guaranteed"}
+        pod = from_wire(wire)
+        assert pod.status.phase == "Running"
+        assert pod.status.pod_ip == "10.0.0.7"
+        assert pod.metadata.creation_timestamp > 0
+
+    def test_service_wire_shape(self):
+        svc = Service(
+            metadata=ObjectMeta(name="gang", namespace="team-a"),
+            spec=ServiceSpec(selector={"app": "gang"},
+                             ports=[ServicePort(name="grpc", port=8471,
+                                                target_port=8471)],
+                             cluster_ip="None"))
+        wire = to_wire(svc)
+        assert wire["spec"]["clusterIP"] == "None"
+        assert wire["spec"]["ports"][0] == {
+            "name": "grpc", "port": 8471, "targetPort": 8471}
+        assert not validate(wire)
+
+    def test_istio_kinds_nest_under_spec(self, platform_objects):
+        by_kind = {k: o for k, o in platform_objects}
+        vs = to_wire(by_kind["VirtualService"])
+        assert "hosts" in vs["spec"] and "http" in vs["spec"]
+        assert vs["spec"]["http"][0]["route"][0]["destination"]["port"]
+        ap = to_wire(by_kind["AuthorizationPolicy"])
+        assert ap["spec"]["action"] == "ALLOW"
+        assert ap["spec"]["rules"][0]["when"][0]["key"].startswith(
+            "request.headers[")
+
+    def test_event_wire_has_involved_object(self, platform_objects):
+        ev = next(o for k, o in platform_objects if k == "Event")
+        wire = to_wire(ev)
+        assert wire["involvedObject"]["kind"]
+        assert "involvedKind" not in wire
+
+
+class TestInjectedErrors:
+    """A structural error in ANY emitted manifest must fail validation —
+    the self-consistent-loop problem this tier exists to break."""
+
+    @pytest.fixture()
+    def deployment(self):
+        return copy.deepcopy(next(
+            d for d in build_k8s_manifests() if d["kind"] == "Deployment"))
+
+    def test_misspelled_field_fails(self, deployment):
+        spec = deployment["spec"]["template"]["spec"]
+        spec["serviceAcountName"] = spec.pop("serviceAccountName")
+        assert any("serviceAcountName" in e for e in validate(deployment))
+
+    def test_wrong_type_fails(self, deployment):
+        deployment["spec"]["replicas"] = "1"
+        assert any("replicas" in e and "integer" in e
+                   for e in validate(deployment))
+
+    def test_container_port_as_bare_int_fails(self, deployment):
+        spec = deployment["spec"]["template"]["spec"]
+        spec["containers"][0]["ports"] = [8080]   # the OLD internal shape
+        assert validate(deployment)
+
+    def test_flat_resources_fails(self, deployment):
+        spec = deployment["spec"]["template"]["spec"]
+        spec["containers"][0]["resources"] = {"cpu": "1"}  # old shape
+        assert any("resources" in e for e in validate(deployment))
+
+    def test_bad_quantity_fails(self, deployment):
+        spec = deployment["spec"]["template"]["spec"]
+        spec["containers"][0]["resources"] = {
+            "limits": {"cpu": "lots"}, "requests": {"cpu": "1"}}
+        assert any("quantity" in e for e in validate(deployment))
+
+    def test_bad_dns_name_fails(self, deployment):
+        deployment["metadata"]["name"] = "Bad_Name"
+        assert any("DNS-1123" in e for e in validate(deployment))
+
+    def test_two_slash_annotation_key_fails(self):
+        errs = validate_metadata(
+            {"name": "x", "annotations": {"a.b/c/d": "v"}})
+        assert errs
+
+    def test_unknown_kind_fails(self):
+        assert validate({"apiVersion": "v9", "kind": "Gizmo",
+                         "metadata": {"name": "x"}})
+
+    def test_rbac_path_segment_names_allowed(self):
+        # kfam's namespaceAdmin binding is legal RBAC (path-segment rule)
+        doc = {"apiVersion": "rbac.authorization.k8s.io/v1",
+               "kind": "RoleBinding",
+               "metadata": {"name": "namespaceAdmin", "namespace": "a"},
+               "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                           "kind": "ClusterRole", "name": "kubeflow-admin"},
+               "subjects": [{"apiGroup": "rbac.authorization.k8s.io",
+                             "kind": "User", "name": "a@x.com"}]}
+        assert not validate(doc)
+
+
+class TestKubectlBoundary:
+    def test_adapter_refuses_invalid_manifest(self):
+        """A controller bug producing an invalid manifest dies in-process,
+        not at the cluster."""
+        from kubeflow_tpu.controlplane.runtime.apiserver import ApiError
+        from kubeflow_tpu.controlplane.runtime.kubectl import (
+            KubectlApiServer,
+        )
+
+        api = KubectlApiServer(kubectl="/nonexistent-kubectl")
+        pod = Pod(metadata=ObjectMeta(name="UPPER", namespace="x"),
+                  spec=PodSpec(containers=[Container(name="c", image="i")]))
+        with pytest.raises(ApiError, match="DNS-1123"):
+            api.create(pod)
+
+    def test_fake_kubectl_rejects_invalid_incoming(self, tmp_path):
+        """The test double validates with the SAME schemas — apiserver
+        style — instead of its own permissive parser."""
+        fake = Path(__file__).parent / "fake_kubectl.py"
+        bad = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "p"},
+               "spec": {"containers": [{"name": "c", "image": "i",
+                                        "ports": [8080]}]}}
+        out = subprocess.run(
+            [sys.executable, "-S", str(fake), "create", "-f", "-",
+             "-o", "json"],
+            input=json.dumps(bad), capture_output=True, text=True,
+            env={"FAKE_KUBECTL_DIR": str(tmp_path)},
+        )
+        assert out.returncode != 0
+        assert "error validating data" in out.stderr
+
+        good = copy.deepcopy(bad)
+        good["spec"]["containers"][0]["ports"] = [{"containerPort": 8080}]
+        out = subprocess.run(
+            [sys.executable, "-S", str(fake), "create", "-f", "-",
+             "-o", "json"],
+            input=json.dumps(good), capture_output=True, text=True,
+            env={"FAKE_KUBECTL_DIR": str(tmp_path)},
+        )
+        assert out.returncode == 0, out.stderr
+
+
+class TestReviewRegressions:
+    """Round-5 review findings, pinned."""
+
+    def test_owner_references_carry_api_version(self, platform_objects):
+        owned = [o for _, o in platform_objects
+                 if o.metadata.owner_references]
+        assert owned, "fixture lost its owned objects"
+        for o in owned:
+            wire = to_wire(o)
+            for ref in wire["metadata"]["ownerReferences"]:
+                assert ref.get("apiVersion"), (o.metadata.name, ref)
+
+    def test_missing_owner_ref_api_version_fails_validation(self):
+        wire = to_wire(Pod(
+            metadata=ObjectMeta(name="p", namespace="a"),
+            spec=PodSpec(containers=[Container(name="c", image="i")])))
+        wire["metadata"]["ownerReferences"] = [
+            {"kind": "Notebook", "name": "nb", "uid": "u1"}]
+        assert any("apiVersion" in e for e in validate(wire))
+
+    def test_pod_conditions_round_trip_rfc3339(self):
+        from kubeflow_tpu.controlplane.api.meta import Condition
+        from kubeflow_tpu.controlplane.api.core import PodStatus
+
+        pod = Pod(
+            metadata=ObjectMeta(name="p", namespace="a"),
+            spec=PodSpec(containers=[Container(name="c", image="i")]),
+            status=PodStatus(phase="Pending", message="unschedulable",
+                             conditions=[Condition(
+                                 type="PodScheduled", status="False",
+                                 reason="Unschedulable",
+                                 last_transition_time=1700000000.0)]))
+        wire = to_wire(pod)
+        # Pending status persists, with RFC3339 condition stamps.
+        assert wire["status"]["message"] == "unschedulable"
+        ts = wire["status"]["conditions"][0]["lastTransitionTime"]
+        assert ts.endswith("Z") and "T" in ts
+        assert not validate(wire), validate(wire)
+        back = from_wire(json.loads(json.dumps(wire)))
+        assert back.status.message == "unschedulable"
+        cond = back.status.conditions[0]
+        assert cond.last_transition_time == 1700000000.0
+        assert cond.reason == "Unschedulable"
+
+    def test_spec_node_name_read_back_into_status(self):
+        wire = to_wire(Pod(
+            metadata=ObjectMeta(name="p", namespace="a"),
+            spec=PodSpec(containers=[Container(name="c", image="i")])))
+        wire["spec"]["nodeName"] = "tpu-node-3"
+        pod = from_wire(wire)
+        assert pod.status.node_name == "tpu-node-3"

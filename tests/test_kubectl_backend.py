@@ -36,10 +36,13 @@ FAKE = Path(__file__).parent / "fake_kubectl.py"
 @pytest.fixture()
 def api(tmp_path, monkeypatch):
     monkeypatch.setenv("FAKE_KUBECTL_DIR", str(tmp_path / "store"))
-    # Invoke the double through the same interpreter (no +x / shebang needs).
+    # Invoke the double through the same interpreter (no +x / shebang
+    # needs). -S skips site initialisation: the double is stdlib-only and
+    # this host's sitecustomize costs ~1.8s per interpreter start — paid
+    # on EVERY kubectl call otherwise.
     wrapper = tmp_path / "kubectl"
     wrapper.write_text(
-        f"#!/bin/sh\nexec {sys.executable} {FAKE} \"$@\"\n"
+        f"#!/bin/sh\nexec {sys.executable} -S {FAKE} \"$@\"\n"
     )
     wrapper.chmod(0o755)
     return KubectlApiServer(kubectl=str(wrapper))
