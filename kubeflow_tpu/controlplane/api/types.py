@@ -375,6 +375,39 @@ class ComponentConfig:
 
 
 @dataclasses.dataclass
+class SlicePoolSpec:
+    """A pool of TPU slices the substrate provider must create — the
+    platform analogue of the reference's Deployment-Manager cluster
+    resources (bootstrap/cmd/bootstrap/app/kfctlServer.go:219-296 runs
+    Apply(PLATFORM) before Apply(K8S))."""
+
+    name: str = ""
+    slice_type: str = "v5e-16"     # topology.slices key
+    num_slices: int = 1
+
+
+@dataclasses.dataclass
+class NodePoolSpec:
+    """CPU node pool for the control plane / webapps."""
+
+    name: str = ""
+    machine_type: str = "n2-standard-8"
+    count: int = 1
+
+
+@dataclasses.dataclass
+class SubstrateSpec:
+    """Cloud-substrate provisioning request: which provider creates the
+    TPU slice pools + node pools BEFORE the k8s-level apply. Provider
+    implementations register in controlplane.substrate.PROVIDERS."""
+
+    provider: str = ""             # "" = substrate already exists
+    slice_pools: List[SlicePoolSpec] = dataclasses.field(
+        default_factory=list)
+    node_pools: List[NodePoolSpec] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
 class PlatformConfigSpec:
     # Which controllers/services to run.
     components: List[ComponentConfig] = dataclasses.field(default_factory=list)
@@ -383,6 +416,9 @@ class PlatformConfigSpec:
     user_id_header: str = "x-goog-authenticated-user-email"
     istio_gateway: str = "kubeflow/kubeflow-gateway"
     cluster_domain: str = "cluster.local"
+    # Optional cloud-substrate half (Apply(PLATFORM)): provision slice/
+    # node pools through a SubstrateProvider before components start.
+    substrate: Optional[SubstrateSpec] = None
 
 
 @dataclasses.dataclass

@@ -288,12 +288,34 @@ class DeploymentServer:
             raise RestError(404, f"no deployment {name!r}")
         if dep.thread is not None:
             dep.thread.join(timeout=30)
+            if dep.thread.is_alive():
+                # An in-flight apply could re-provision substrate AFTER
+                # our deprovision passed its leak check — refuse rather
+                # than race it.
+                with self._lock:
+                    self._deployments.setdefault(name, dep)
+                raise RestError(
+                    409, f"deployment {name} apply still running; retry")
+        reclaimed = []
         if dep.platform is not None:
+            from kubeflow_tpu.controlplane.substrate import SubstrateError
+
+            try:
+                # Substrate teardown with leak check (the reference's
+                # kfctl delete contract): a leak is a loud 500, not a
+                # silently-dropped deployment record.
+                reclaimed = dep.platform.delete_config(name)
+            except SubstrateError as e:
+                with self._lock:
+                    # setdefault: a concurrent create may have taken the
+                    # name; never clobber the live record.
+                    self._deployments.setdefault(name, dep)
+                raise RestError(500, f"substrate teardown failed: {e}")
             dep.platform.manager.stop()
         if self.state_dir:
             shutil.rmtree(os.path.join(self.state_dir, name),
                           ignore_errors=True)
-        return {"deleted": name}
+        return {"deleted": name, "substratePools": reclaimed}
 
     def router(self) -> Router:
         r = Router()

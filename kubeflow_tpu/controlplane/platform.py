@@ -76,8 +76,32 @@ class Platform:
 
     def apply_config(self, cfg: PlatformConfig) -> List[str]:
         """Bring up the components the config enables. Idempotent: already-
-        running components are left alone."""
+        running components are left alone. When the spec carries a
+        ``substrate`` section, the provider half (Apply(PLATFORM)) runs
+        FIRST — slice/node pools exist before any component starts — and
+        the config is finalizer-guarded so delete must reclaim them."""
         self._config = cfg
+        from kubeflow_tpu.controlplane.substrate import (
+            SUBSTRATE_FINALIZER,
+            deprovision_checked,
+            provision,
+        )
+
+        prior = self.api.try_get("PlatformConfig", cfg.metadata.name)
+        prior_sub = prior.spec.substrate if prior is not None else None
+        new_sub = cfg.spec.substrate
+        if prior_sub is not None and prior_sub.provider and (
+                new_sub is None or prior_sub.provider != new_sub.provider):
+            # The re-applied spec dropped (or switched) its substrate:
+            # reclaim the old provider's pools NOW, leak-checked —
+            # otherwise they orphan with no spec left pointing at them.
+            deprovision_checked(cfg.metadata.name, prior_sub)
+        if new_sub is not None and new_sub.provider:
+            provision(cfg.metadata.name, new_sub)
+            if SUBSTRATE_FINALIZER not in cfg.metadata.finalizers:
+                cfg.metadata.finalizers.append(SUBSTRATE_FINALIZER)
+        elif SUBSTRATE_FINALIZER in cfg.metadata.finalizers:
+            cfg.metadata.finalizers.remove(SUBSTRATE_FINALIZER)
         wanted = [
             c.name for c in cfg.spec.components if c.enabled
         ] or list(DEFAULT_COMPONENTS)
@@ -97,12 +121,16 @@ class Platform:
         existing = self.api.try_get("PlatformConfig", cfg.metadata.name)
         if existing is None:
             self.api.create(cfg)
-        elif existing.spec != cfg.spec or existing.status != cfg.status:
+        elif (existing.spec != cfg.spec or existing.status != cfg.status
+              or existing.metadata.finalizers != cfg.metadata.finalizers):
             # Second-apply idempotency contract (reference
             # testing/kfctl/kfctl_second_apply.py:12-24): an apply that
-            # changes nothing must not bump any resourceVersion.
+            # changes nothing must not bump any resourceVersion. The
+            # finalizer list IS part of what an apply may change (the
+            # substrate guard must persist on the STORED config).
             existing.spec = cfg.spec
             existing.status = cfg.status
+            existing.metadata.finalizers = list(cfg.metadata.finalizers)
             self.api.update(existing)
         return started
 
@@ -228,6 +256,29 @@ class Platform:
         if self.prober is not None:
             self.prober.maybe_probe()
         return n
+
+    def delete_config(self, name: str) -> List[str]:
+        """Tear the deployment's substrate down (finalizer-guarded) and
+        delete the PlatformConfig. Deprovision is leak-checked: anything
+        the provider still tracks afterwards raises instead of silently
+        surviving (reference kfctl_delete_test.py:44-71). Returns the
+        reclaimed pool names."""
+        from kubeflow_tpu.controlplane.substrate import (
+            SUBSTRATE_FINALIZER,
+            deprovision_checked,
+        )
+
+        cfg = self.api.try_get("PlatformConfig", name)
+        spec_substrate = (cfg.spec.substrate if cfg is not None
+                          else (self._config.spec.substrate
+                                if self._config is not None else None))
+        deleted = deprovision_checked(name, spec_substrate)
+        if cfg is not None:
+            if SUBSTRATE_FINALIZER in cfg.metadata.finalizers:
+                cfg.metadata.finalizers.remove(SUBSTRATE_FINALIZER)
+                self.api.update(cfg)
+            self.api.delete("PlatformConfig", name)
+        return deleted
 
     # ------------- persistence -------------
 

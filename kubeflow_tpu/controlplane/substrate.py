@@ -1,0 +1,196 @@
+"""Cloud-substrate provisioning seam — the ``Apply(PLATFORM)`` half.
+
+The reference's kfctl server doesn't just apply K8s manifests: it first
+creates the cluster substrate through GCP Deployment Manager and tears it
+down with a resource-leak check
+(reference bootstrap/cmd/bootstrap/app/kfctlServer.go:219-296,
+testing/kfctl/kfctl_delete_test.py:44-71). Here the substrate is TPU
+slice pools + CPU node pools, created through a typed provider plugin
+BEFORE the platform's k8s-level apply and reclaimed on deployment delete:
+
+- ``SubstrateProvider``: the seam — ``ensure_pools`` (idempotent create/
+  update), ``deprovision`` (delete everything the deployment owns),
+  ``list_resources`` (the leak check's source of truth).
+- ``FakeSubstrateProvider``: the in-env implementation (no cloud, zero
+  egress) with real provider semantics: slice types validated against
+  the topology catalog, spec-diffing updates, per-deployment ownership.
+  A GCP/AWS implementation replaces the pool-record store with TPU API /
+  EC2 calls — the seam's shape is the contract (same pattern as the
+  profile controller's two IAM plugins, controllers/profile.py).
+- Finalizer guard: ``Platform.apply_config`` adds SUBSTRATE_FINALIZER to
+  the PlatformConfig; ``Platform.delete_config`` deprovisions, LEAK-CHECKS
+  (raises if anything the provider still tracks survives), and only then
+  removes the finalizer — delete leaves nothing, provably.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from kubeflow_tpu.controlplane.api.types import SubstrateSpec
+from kubeflow_tpu.utils import get_logger
+
+log = get_logger("substrate")
+
+SUBSTRATE_FINALIZER = "substrate.tpu.kubeflow.org"
+
+
+class SubstrateError(Exception):
+    pass
+
+
+class SubstrateLeakError(SubstrateError):
+    """Deprovision left resources behind — the delete contract is broken
+    (reference kfctl_delete_test.py:44-71 greps for leaked DM resources).
+    """
+
+
+class SubstrateProvider:
+    """Provider seam. Implementations own (deployment, pool) -> resource
+    lifecycles; all methods are synchronous and idempotent."""
+
+    KIND = ""
+
+    def ensure_pools(self, deployment: str,
+                     spec: SubstrateSpec) -> List[str]:
+        """Create/update every pool in ``spec``; delete pools the spec no
+        longer lists (the deployment owns exactly its spec). Returns the
+        pool names now live. Must be idempotent."""
+        raise NotImplementedError
+
+    def deprovision(self, deployment: str) -> List[str]:
+        """Delete everything the deployment owns; returns what was
+        deleted."""
+        raise NotImplementedError
+
+    def list_resources(self, deployment: str) -> List[Dict[str, Any]]:
+        """Everything the provider still tracks for the deployment — the
+        leak check reads this after deprovision."""
+        raise NotImplementedError
+
+
+class FakeSubstrateProvider(SubstrateProvider):
+    KIND = "fake"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (deployment, pool_name) -> record
+        self._pools: Dict[Tuple[str, str], Dict[str, Any]] = {}
+
+    def _records_for(self, spec: SubstrateSpec) -> Dict[str, Dict[str, Any]]:
+        from kubeflow_tpu.topology.slices import list_slices
+
+        known = set(list_slices())
+        out: Dict[str, Dict[str, Any]] = {}
+        for sp in spec.slice_pools:
+            if not sp.name:
+                raise SubstrateError("slicePools[].name is required")
+            if sp.name in out:
+                raise SubstrateError(
+                    f"duplicate slice pool name {sp.name!r}")
+            if sp.slice_type not in known:
+                raise SubstrateError(
+                    f"unknown slice_type {sp.slice_type!r} "
+                    f"(catalog: {sorted(known)})")
+            if sp.num_slices < 1:
+                raise SubstrateError(
+                    f"slice pool {sp.name}: numSlices must be >= 1")
+            out[sp.name] = {"kind": "SlicePool", "name": sp.name,
+                            "sliceType": sp.slice_type,
+                            "numSlices": sp.num_slices}
+        for np_ in spec.node_pools:
+            if not np_.name:
+                raise SubstrateError("nodePools[].name is required")
+            if np_.name in out:
+                raise SubstrateError(
+                    f"pool name {np_.name!r} used by both a slice pool "
+                    "and a node pool")
+            if np_.count < 1:
+                raise SubstrateError(
+                    f"node pool {np_.name}: count must be >= 1")
+            out[np_.name] = {"kind": "NodePool", "name": np_.name,
+                             "machineType": np_.machine_type,
+                             "count": np_.count}
+        return out
+
+    def ensure_pools(self, deployment: str,
+                     spec: SubstrateSpec) -> List[str]:
+        wanted = self._records_for(spec)
+        with self._lock:
+            current = {pool: rec for (dep, pool), rec in self._pools.items()
+                       if dep == deployment}
+            for pool, rec in wanted.items():
+                if current.get(pool) != rec:
+                    verb = "updated" if pool in current else "created"
+                    self._pools[(deployment, pool)] = copy.deepcopy(rec)
+                    log.info(f"substrate pool {verb}",
+                             kv={"deployment": deployment, "pool": pool,
+                                 "kind": rec["kind"]})
+            for pool in set(current) - set(wanted):
+                del self._pools[(deployment, pool)]
+                log.info("substrate pool deleted (no longer in spec)",
+                         kv={"deployment": deployment, "pool": pool})
+        return sorted(wanted)
+
+    def deprovision(self, deployment: str) -> List[str]:
+        with self._lock:
+            mine = [k for k in self._pools if k[0] == deployment]
+            for k in mine:
+                del self._pools[k]
+        if mine:
+            log.info("substrate deprovisioned",
+                     kv={"deployment": deployment, "pools": len(mine)})
+        return sorted(pool for _, pool in mine)
+
+    def list_resources(self, deployment: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [copy.deepcopy(rec)
+                    for (dep, _), rec in sorted(self._pools.items())
+                    if dep == deployment]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._pools.clear()
+
+
+# Provider registry: singletons, because substrate state outlives any one
+# Platform engine instance (a cloud does too). Tests reset the fake.
+PROVIDERS: Dict[str, SubstrateProvider] = {
+    FakeSubstrateProvider.KIND: FakeSubstrateProvider(),
+}
+
+
+def get_provider(name: str) -> SubstrateProvider:
+    if name not in PROVIDERS:
+        raise SubstrateError(
+            f"unknown substrate provider {name!r} "
+            f"(registered: {sorted(PROVIDERS)})")
+    return PROVIDERS[name]
+
+
+def provision(deployment: str,
+              spec: Optional[SubstrateSpec]) -> List[str]:
+    """Apply(PLATFORM): run the provider half if the config asks for it.
+    Returns provisioned pool names ([] when no substrate is requested)."""
+    if spec is None or not spec.provider:
+        return []
+    return get_provider(spec.provider).ensure_pools(deployment, spec)
+
+
+def deprovision_checked(deployment: str,
+                        spec: Optional[SubstrateSpec]) -> List[str]:
+    """Deprovision + leak check: anything the provider still tracks for
+    the deployment afterwards is an error, not a warning."""
+    if spec is None or not spec.provider:
+        return []
+    provider = get_provider(spec.provider)
+    deleted = provider.deprovision(deployment)
+    leaked = provider.list_resources(deployment)
+    if leaked:
+        raise SubstrateLeakError(
+            f"deployment {deployment}: {len(leaked)} substrate resources "
+            f"leaked after deprovision: "
+            f"{[r['name'] for r in leaked]}")
+    return deleted

@@ -1,0 +1,306 @@
+"""SubstrateProvider seam (VERDICT r4 Missing #2): the Apply(PLATFORM)
+half of kfctl — provision TPU slice/node pools before the k8s apply,
+finalizer-guarded, delete reclaims everything with a leak check.
+
+Mirrors the IAM plugin conformance pattern (tests/test_iam_plugins.py):
+the provider contract is tested generically so a GCP/AWS implementation
+drops into the same suite. Reference:
+bootstrap/cmd/bootstrap/app/kfctlServer.go:219-296 (DM deployment before
+k8s apply), testing/kfctl/kfctl_delete_test.py:44-71 (delete-leak check).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.controlplane.api import ObjectMeta
+from kubeflow_tpu.controlplane.api.types import (
+    NodePoolSpec,
+    PlatformConfig,
+    PlatformConfigSpec,
+    SlicePoolSpec,
+    SubstrateSpec,
+)
+from kubeflow_tpu.controlplane.platform import Platform
+from kubeflow_tpu.controlplane.substrate import (
+    PROVIDERS,
+    SUBSTRATE_FINALIZER,
+    FakeSubstrateProvider,
+    SubstrateError,
+    SubstrateLeakError,
+    get_provider,
+    provision,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_fake():
+    fake = PROVIDERS["fake"]
+    fake.reset()
+    yield fake
+    fake.reset()
+
+
+def _spec(**kw):
+    kw.setdefault("provider", "fake")
+    kw.setdefault("slice_pools", [
+        SlicePoolSpec(name="train-pool", slice_type="v5e-16", num_slices=2),
+        SlicePoolSpec(name="serve-pool", slice_type="v5e-4", num_slices=1),
+    ])
+    kw.setdefault("node_pools", [
+        NodePoolSpec(name="cp-pool", machine_type="n2-standard-8", count=3),
+    ])
+    return SubstrateSpec(**kw)
+
+
+# Parametrized like the IAM conformance suite: every registered provider
+# must satisfy the same lifecycle contract.
+@pytest.fixture(params=["fake"])
+def provider(request, fresh_fake):
+    return get_provider(request.param)
+
+
+class TestProviderConformance:
+    def test_ensure_creates_all_pools(self, provider):
+        names = provider.ensure_pools("dep-a", _spec())
+        assert names == ["cp-pool", "serve-pool", "train-pool"]
+        recs = provider.list_resources("dep-a")
+        kinds = {r["name"]: r["kind"] for r in recs}
+        assert kinds == {"train-pool": "SlicePool", "serve-pool": "SlicePool",
+                        "cp-pool": "NodePool"}
+
+    def test_ensure_is_idempotent(self, provider):
+        provider.ensure_pools("dep-a", _spec())
+        before = provider.list_resources("dep-a")
+        provider.ensure_pools("dep-a", _spec())
+        assert provider.list_resources("dep-a") == before
+
+    def test_ensure_updates_changed_pool(self, provider):
+        provider.ensure_pools("dep-a", _spec())
+        changed = _spec(slice_pools=[
+            SlicePoolSpec(name="train-pool", slice_type="v5e-16",
+                          num_slices=4),
+            SlicePoolSpec(name="serve-pool", slice_type="v5e-4",
+                          num_slices=1),
+        ])
+        provider.ensure_pools("dep-a", changed)
+        rec = {r["name"]: r for r in provider.list_resources("dep-a")}
+        assert rec["train-pool"]["numSlices"] == 4
+
+    def test_ensure_prunes_pools_dropped_from_spec(self, provider):
+        provider.ensure_pools("dep-a", _spec())
+        provider.ensure_pools("dep-a", _spec(
+            slice_pools=[SlicePoolSpec(name="train-pool",
+                                       slice_type="v5e-16", num_slices=2)],
+            node_pools=[]))
+        names = [r["name"] for r in provider.list_resources("dep-a")]
+        assert names == ["train-pool"]
+
+    def test_deployments_are_isolated(self, provider):
+        provider.ensure_pools("dep-a", _spec())
+        provider.ensure_pools("dep-b", _spec(
+            slice_pools=[SlicePoolSpec(name="other",
+                                       slice_type="v5e-8", num_slices=1)],
+            node_pools=[]))
+        provider.deprovision("dep-b")
+        assert provider.list_resources("dep-b") == []
+        assert len(provider.list_resources("dep-a")) == 3
+
+    def test_deprovision_leaves_nothing(self, provider):
+        provider.ensure_pools("dep-a", _spec())
+        deleted = provider.deprovision("dep-a")
+        assert deleted == ["cp-pool", "serve-pool", "train-pool"]
+        assert provider.list_resources("dep-a") == []
+
+    def test_unknown_slice_type_fails_loudly(self, provider):
+        with pytest.raises(SubstrateError, match="slice_type"):
+            provider.ensure_pools("dep-a", _spec(slice_pools=[
+                SlicePoolSpec(name="x", slice_type="h100-pod")]))
+
+    def test_nameless_pool_fails(self, provider):
+        with pytest.raises(SubstrateError, match="name"):
+            provider.ensure_pools("dep-a", _spec(slice_pools=[
+                SlicePoolSpec(name="", slice_type="v5e-16")]))
+
+    def test_duplicate_pool_name_across_kinds_fails(self, provider):
+        with pytest.raises(SubstrateError, match="both"):
+            provider.ensure_pools("dep-a", _spec(
+                slice_pools=[SlicePoolSpec(name="p", slice_type="v5e-16")],
+                node_pools=[NodePoolSpec(name="p")]))
+
+    def test_unknown_provider_fails(self):
+        with pytest.raises(SubstrateError, match="unknown substrate"):
+            provision("dep-a", SubstrateSpec(provider="gcp-dm"))
+
+
+class TestPlatformIntegration:
+    def _config(self, name="kf-sub"):
+        return PlatformConfig(
+            metadata=ObjectMeta(name=name),
+            spec=PlatformConfigSpec(substrate=_spec()))
+
+    def test_apply_provisions_before_components_and_adds_finalizer(
+            self, fresh_fake):
+        pf = Platform()
+        pf.apply_config(self._config())
+        assert len(fresh_fake.list_resources("kf-sub")) == 3
+        cfg = pf.api.get("PlatformConfig", "kf-sub")
+        assert SUBSTRATE_FINALIZER in cfg.metadata.finalizers
+
+    def test_second_apply_is_idempotent(self, fresh_fake):
+        pf = Platform()
+        pf.apply_config(self._config())
+        before = fresh_fake.list_resources("kf-sub")
+        pf.apply_config(self._config())
+        assert fresh_fake.list_resources("kf-sub") == before
+        cfg = pf.api.get("PlatformConfig", "kf-sub")
+        assert cfg.metadata.finalizers.count(SUBSTRATE_FINALIZER) == 1
+
+    def test_delete_config_reclaims_everything(self, fresh_fake):
+        pf = Platform()
+        pf.apply_config(self._config())
+        deleted = pf.delete_config("kf-sub")
+        assert deleted == ["cp-pool", "serve-pool", "train-pool"]
+        assert fresh_fake.list_resources("kf-sub") == []
+        assert pf.api.try_get("PlatformConfig", "kf-sub") is None
+
+    def test_leak_raises_and_keeps_finalizer(self, fresh_fake,
+                                             monkeypatch):
+        pf = Platform()
+        pf.apply_config(self._config())
+
+        # A buggy provider that forgets one pool on deprovision.
+        real = fresh_fake.deprovision
+
+        def leaky(deployment):
+            real(deployment)
+            fresh_fake._pools[(deployment, "train-pool")] = {
+                "kind": "SlicePool", "name": "train-pool",
+                "sliceType": "v5e-16", "numSlices": 2}
+            return []
+
+        monkeypatch.setattr(fresh_fake, "deprovision", leaky)
+        with pytest.raises(SubstrateLeakError, match="leaked"):
+            pf.delete_config("kf-sub")
+        # The config (and its finalizer) survive: nothing was silently
+        # dropped while cloud resources are still alive.
+        cfg = pf.api.get("PlatformConfig", "kf-sub")
+        assert SUBSTRATE_FINALIZER in cfg.metadata.finalizers
+
+    def test_no_substrate_section_is_a_noop(self, fresh_fake):
+        pf = Platform()
+        pf.apply_config(PlatformConfig(metadata=ObjectMeta(name="plain")))
+        assert fresh_fake.list_resources("plain") == []
+        cfg = pf.api.get("PlatformConfig", "plain")
+        assert SUBSTRATE_FINALIZER not in cfg.metadata.finalizers
+        pf.delete_config("plain")
+        assert pf.api.try_get("PlatformConfig", "plain") is None
+
+
+class TestBootstrapE2E:
+    """Provision-then-apply through the deployment REST plane — the
+    kfctl-server flow with the substrate half attached."""
+
+    @pytest.fixture()
+    def server(self, tmp_path, fresh_fake):
+        from kubeflow_tpu.controlplane.bootstrap import DeploymentServer
+
+        srv = DeploymentServer(state_dir=str(tmp_path)).start()
+        yield srv
+        srv.stop()
+
+    def _post(self, srv, path, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}{path}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        return json.load(urllib.request.urlopen(req))
+
+    def _wait_ready(self, srv, name, tries=100):
+        for _ in range(tries):
+            out = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/kfctl/apps/v1beta1/get/{name}"))
+            if out["phase"] in ("Ready", "Failed"):
+                return out
+            time.sleep(0.05)
+        raise AssertionError("deployment never settled")
+
+    def test_create_provisions_then_applies_delete_reclaims(
+            self, server, fresh_fake):
+        self._post(server, "/kfctl/apps/v1beta1/create", {
+            "name": "subdep",
+            "spec": {
+                "substrate": {
+                    "provider": "fake",
+                    "slicePools": [{"name": "train-pool",
+                                    "sliceType": "v5e-16",
+                                    "numSlices": 2}],
+                    "nodePools": [{"name": "cp-pool", "count": 1}],
+                },
+            },
+        })
+        out = self._wait_ready(server, "subdep")
+        assert out["phase"] == "Ready", out
+        assert len(fresh_fake.list_resources("subdep")) == 2
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/kfctl/apps/v1beta1/delete/"
+            "subdep", method="DELETE")
+        out = json.load(urllib.request.urlopen(req))
+        assert out["substratePools"] == ["cp-pool", "train-pool"]
+        assert fresh_fake.list_resources("subdep") == []
+
+    def test_bad_substrate_fails_the_deployment_loudly(self, server,
+                                                       fresh_fake):
+        self._post(server, "/kfctl/apps/v1beta1/create", {
+            "name": "badsub",
+            "spec": {"substrate": {"provider": "fake",
+                                   "slicePools": [{"name": "x",
+                                                   "sliceType": "gpu-a100"}]}},
+        })
+        out = self._wait_ready(server, "badsub")
+        assert out["phase"] == "Failed"
+        assert "slice_type" in out["error"]
+        assert fresh_fake.list_resources("badsub") == []
+
+
+class TestReviewRegressions:
+    """Round-5 review findings, pinned."""
+
+    def test_spec_dropping_substrate_reclaims_pools(self, fresh_fake):
+        pf = Platform()
+        pf.apply_config(PlatformConfig(
+            metadata=ObjectMeta(name="kf-sub"),
+            spec=PlatformConfigSpec(substrate=_spec())))
+        assert len(fresh_fake.list_resources("kf-sub")) == 3
+        # Re-apply WITHOUT the substrate section: the old pools must be
+        # reclaimed (leak-checked), not silently orphaned.
+        pf.apply_config(PlatformConfig(
+            metadata=ObjectMeta(name="kf-sub"),
+            spec=PlatformConfigSpec()))
+        assert fresh_fake.list_resources("kf-sub") == []
+        cfg = pf.api.get("PlatformConfig", "kf-sub")
+        assert SUBSTRATE_FINALIZER not in cfg.metadata.finalizers
+
+    def test_finalizer_persists_on_stored_config_after_reapply(
+            self, fresh_fake):
+        pf = Platform()
+        pf.apply_config(PlatformConfig(
+            metadata=ObjectMeta(name="kf-sub"),
+            spec=PlatformConfigSpec()))
+        # Substrate introduced on a RE-apply: the finalizer must land on
+        # the STORED config, where a direct api.delete would consult it.
+        pf.apply_config(PlatformConfig(
+            metadata=ObjectMeta(name="kf-sub"),
+            spec=PlatformConfigSpec(substrate=_spec())))
+        stored = pf.api.get("PlatformConfig", "kf-sub")
+        assert SUBSTRATE_FINALIZER in stored.metadata.finalizers
+
+    def test_duplicate_slice_pool_names_fail(self, fresh_fake):
+        with pytest.raises(SubstrateError, match="duplicate"):
+            fresh_fake.ensure_pools("d", _spec(slice_pools=[
+                SlicePoolSpec(name="train", slice_type="v5e-16"),
+                SlicePoolSpec(name="train", slice_type="v5e-4"),
+            ]))
