@@ -114,6 +114,10 @@ class NotebookSpec:
     # PodDefault labels to match (spawner "configurations",
     # jupyter-web-app .../utils.py:338-530)
     pod_defaults: List[str] = dataclasses.field(default_factory=list)
+    # Spawn-from-checkpoint (Rok-variant analogue, rok/app.py:16-136):
+    # the name of a TpuJob in this namespace whose orbax checkpoint the
+    # notebook restores on start (controller injects KFTPU_RESTORE_DIR).
+    checkpoint: str = ""
 
 
 @dataclasses.dataclass

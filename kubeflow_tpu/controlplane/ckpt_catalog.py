@@ -1,0 +1,74 @@
+"""Checkpoint catalog: named orbax checkpoints a notebook can spawn from.
+
+The reference's Rok spawner variant lists storage snapshots and creates
+notebooks from rok-token-authenticated snapshot URLs (reference
+jupyter-web-app/backend/kubeflow_jupyter/rok/app.py:16-136). The
+TPU-native analogue: TpuJobs write orbax checkpoints to
+``spec.checkpoint_dir`` (train/checkpoint.py), and this catalog surfaces
+every job-produced checkpoint in a namespace so the spawner can offer
+"start from checkpoint X" — the notebook pod then gets
+``KFTPU_RESTORE_DIR`` pointing at the snapshot.
+
+Step discovery reads the orbax CheckpointManager layout directly (numeric
+step subdirectories) — no orbax import in the control plane.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+__all__ = ["list_checkpoints", "resolve_checkpoint"]
+
+
+def _latest_step(directory: str) -> Optional[int]:
+    """Newest COMPLETE step in an orbax CheckpointManager directory (step
+    subdirs are plain integers; in-progress saves carry a .orbax-* marker
+    suffix and never parse as int)."""
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return None
+    steps = [int(e) for e in entries
+             if e.isdigit() and os.path.isdir(os.path.join(directory, e))]
+    return max(steps) if steps else None
+
+
+def list_checkpoints(api, namespace: str) -> List[Dict[str, Any]]:
+    """Every TpuJob in the namespace whose checkpoint_dir holds at least
+    one completed step. Sorted by name; entry names are the producing
+    job's name (what the spawner shows and NotebookSpec.checkpoint
+    stores)."""
+    out = []
+    for job in api.list("TpuJob", namespace=namespace):
+        d = job.spec.checkpoint_dir
+        if not d:
+            continue
+        step = _latest_step(d)
+        if step is None:
+            continue
+        out.append({
+            "name": job.metadata.name,
+            "dir": d,
+            "latestStep": step,
+            "sourceKind": "TpuJob",
+            "model": job.spec.model,
+        })
+    return sorted(out, key=lambda e: e["name"])
+
+
+def resolve_checkpoint(api, namespace: str,
+                       name: str) -> Optional[Dict[str, Any]]:
+    """The catalog entry for ``name``, or None (missing job, no
+    checkpoint_dir, or no completed step yet). Direct lookup — this runs
+    in the notebook controller's requeue path, so it must not scan every
+    job's checkpoint directory."""
+    job = api.try_get("TpuJob", name, namespace)
+    if job is None or not job.spec.checkpoint_dir:
+        return None
+    step = _latest_step(job.spec.checkpoint_dir)
+    if step is None:
+        return None
+    return {"name": job.metadata.name, "dir": job.spec.checkpoint_dir,
+            "latestStep": step, "sourceKind": "TpuJob",
+            "model": job.spec.model}

@@ -144,7 +144,41 @@ class NotebookController(Controller):
             return Result()
 
         if live_pod is None:
-            self.api.create(self._pod(nb, pod_name))
+            restore_dir = ""
+            if nb.spec.checkpoint:
+                from kubeflow_tpu.controlplane.ckpt_catalog import (
+                    resolve_checkpoint,
+                )
+
+                entry = resolve_checkpoint(self.api, namespace,
+                                           nb.spec.checkpoint)
+                if entry is None:
+                    # Loud + recoverable: surface the miss as a condition
+                    # and retry (the producing job may still be saving its
+                    # first step). The event fires only on the TRANSITION
+                    # into this state — a waiting notebook requeues every
+                    # 5s and must not mint an Event per tick.
+                    already = any(
+                        c.type == "Ready"
+                        and c.reason == "CheckpointNotFound"
+                        for c in nb.status.conditions)
+                    nb.status.container_state = "Waiting"
+                    nb.status.conditions = set_condition(
+                        nb.status.conditions,
+                        Condition(type="Ready", status="False",
+                                  reason="CheckpointNotFound",
+                                  message=f"checkpoint {nb.spec.checkpoint!r}"
+                                          " has no completed step (or its "
+                                          "TpuJob is gone)"),
+                    )
+                    self._sync_status(nb)
+                    if not already:
+                        self.recorder.event(
+                            nb, "Warning", "CheckpointNotFound",
+                            f"no checkpoint named {nb.spec.checkpoint!r}")
+                    return Result(requeue_after=5.0)
+                restore_dir = entry["dir"]
+            self.api.create(self._pod(nb, pod_name, restore_dir=restore_dir))
             self.metrics_created.inc()
             self.recorder.event(nb, "Normal", "Created", f"pod {pod_name}")
             live_pod = self.api.get("Pod", pod_name, namespace)
@@ -198,7 +232,7 @@ class NotebookController(Controller):
         return OwnerReference(kind="Notebook", name=nb.metadata.name,
                               uid=nb.metadata.uid)
 
-    def _pod(self, nb, pod_name: str) -> Pod:
+    def _pod(self, nb, pod_name: str, restore_dir: str = "") -> Pod:
         ns, name = nb.metadata.namespace, nb.metadata.name
         resources = {"cpu": nb.spec.cpu, "memory": nb.spec.memory}
         node_selector = {}
@@ -212,11 +246,20 @@ class NotebookController(Controller):
             resources[st.resource_name()] = str(st.chips_per_host)
             node_selector = st.node_selectors()
         env = [EnvVar(NB_PREFIX_ENV, f"/notebook/{ns}/{name}")] + list(nb.spec.env)
+        annotations = {}
+        if restore_dir:
+            # Spawn-from-checkpoint: the in-pod kernel restores from here
+            # (train.CheckpointService.restore_latest reads the same
+            # layout the producing TpuJob wrote).
+            env.append(EnvVar("KFTPU_RESTORE_DIR", restore_dir))
+            annotations["checkpoint-source.tpu.kubeflow.org/job"] = \
+                nb.spec.checkpoint
         return Pod(
             metadata=ObjectMeta(
                 name=pod_name, namespace=ns,
                 labels={"statefulset": name, "notebook-name": name,
                         **nb.metadata.labels},
+                annotations=annotations,
                 owner_references=[self._owner(nb)],
             ),
             spec=PodSpec(

@@ -90,6 +90,13 @@ class Platform:
         prior = self.api.try_get("PlatformConfig", cfg.metadata.name)
         prior_sub = prior.spec.substrate if prior is not None else None
         new_sub = cfg.spec.substrate
+        if new_sub is not None and new_sub.provider:
+            # DRY-validate the new substrate FIRST: a provider switch
+            # must never destroy healthy pools for a config that could
+            # not have provisioned anyway.
+            from kubeflow_tpu.controlplane.substrate import get_provider
+
+            get_provider(new_sub.provider).validate_spec(new_sub)
         if prior_sub is not None and prior_sub.provider and (
                 new_sub is None or prior_sub.provider != new_sub.provider):
             # The re-applied spec dropped (or switched) its substrate:

@@ -60,6 +60,12 @@ class SubstrateProvider:
         pool names now live. Must be idempotent."""
         raise NotImplementedError
 
+    def validate_spec(self, spec: SubstrateSpec) -> None:
+        """Raise SubstrateError if ``spec`` could never provision — a
+        DRY check with no side effects, so callers can validate a new
+        substrate before tearing an old one down."""
+        raise NotImplementedError
+
     def deprovision(self, deployment: str) -> List[str]:
         """Delete everything the deployment owns; returns what was
         deleted."""
@@ -114,6 +120,9 @@ class FakeSubstrateProvider(SubstrateProvider):
                              "machineType": np_.machine_type,
                              "count": np_.count}
         return out
+
+    def validate_spec(self, spec: SubstrateSpec) -> None:
+        self._records_for(spec)
 
     def ensure_pools(self, deployment: str,
                      spec: SubstrateSpec) -> List[str]:

@@ -170,6 +170,7 @@ _SPAWNER_BODY = """
  <input id="name" placeholder="notebook name" required>
  <select id="image"></select>
  <select id="slice"></select>
+ <select id="ckpt"></select>
  <button>Spawn</button>
 </form>
 <h2>Notebooks</h2><div id="list"></div>
@@ -190,6 +191,14 @@ async function init() {
   document.getElementById('slice').innerHTML =
     '<option value="">no TPU</option>' +
     cfg.tpuSlices.map(s => `<option>${esc(s)}</option>`).join('');
+  // Spawn-from-checkpoint picker (Rok-variant snapshot list): every
+  // TpuJob-produced orbax checkpoint in the namespace.
+  const ck = await api(`/api/namespaces/${encodeURIComponent(NS)}/checkpoints`);
+  document.getElementById('ckpt').innerHTML =
+    '<option value="">blank notebook</option>' +
+    ck.checkpoints.map(c =>
+      `<option value="${esc(c.name)}">from ${esc(c.name)}` +
+      ` @ step ${esc(c.latestStep)}</option>`).join('');
   refresh();
 }
 async function refresh() {
@@ -219,6 +228,7 @@ document.getElementById('spawn').onsubmit = async (e) => {
       name: document.getElementById('name').value,
       image: document.getElementById('image').value,
       tpuSlice: document.getElementById('slice').value,
+      checkpoint: document.getElementById('ckpt').value,
     })});
   refresh();
 };

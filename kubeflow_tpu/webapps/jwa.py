@@ -134,6 +134,19 @@ class NotebookWebApp:
                     f"slice {tpu_slice} spans {s.num_hosts} hosts; notebooks "
                     "attach single-host slices only (use a TpuJob)",
                 )
+        checkpoint = form.get("checkpoint", "")
+        if checkpoint:
+            from kubeflow_tpu.controlplane.ckpt_catalog import (
+                resolve_checkpoint,
+            )
+
+            if resolve_checkpoint(self.api, namespace, checkpoint) is None:
+                raise RestError(
+                    400,
+                    f"unknown checkpoint {checkpoint!r}: no TpuJob in "
+                    f"{namespace} with a completed checkpoint step by "
+                    "that name (GET .../checkpoints lists them)",
+                )
         nb = Notebook(
             metadata=ObjectMeta(
                 name=name,
@@ -147,6 +160,7 @@ class NotebookWebApp:
                 memory=str(form.get("memory", "4Gi")),
                 tpu_slice=tpu_slice,
                 pod_defaults=list(form.get("configurations", [])),
+                checkpoint=checkpoint,
             ),
         )
         try:
@@ -166,6 +180,16 @@ class NotebookWebApp:
             self.requests.inc(op="delete", result="missing")
             raise RestError(404, f"notebook {namespace}/{name} not found")
         self.requests.inc(op="delete", result="ok")
+
+    def list_checkpoints(self, caller: str, namespace: str) -> List[Dict]:
+        """Spawnable checkpoints (the Rok variant's snapshot listing,
+        rok/app.py:16-136): TpuJob-produced orbax checkpoints with at
+        least one completed step."""
+        self._authorize(caller, "list", namespace)
+        self.heartbeat.beat()
+        from kubeflow_tpu.controlplane.ckpt_catalog import list_checkpoints
+
+        return list_checkpoints(self.api, namespace)
 
     def list_poddefaults(self, caller: str, namespace: str) -> List[Dict]:
         self._authorize(caller, "list", namespace)
@@ -206,6 +230,7 @@ class NotebookWebApp:
             "memory": nb.spec.memory,
             "tpuSlice": nb.spec.tpu_slice,
             "configurations": list(nb.spec.pod_defaults),
+            "checkpoint": nb.spec.checkpoint,
             "owner": nb.metadata.annotations.get("owner", ""),
             "status": {"phase": phase, "reason": reason},
             "events": events,
@@ -231,6 +256,12 @@ class NotebookWebApp:
             lambda q: {"success": True,
                        "notebook": self.create_notebook(
                            q.caller, q.params["ns"], q.body)},
+        )
+        r.get(
+            "/api/namespaces/<ns>/checkpoints",
+            lambda q: {"success": True,
+                       "checkpoints": self.list_checkpoints(
+                           q.caller, q.params["ns"])},
         )
         r.delete(
             "/api/namespaces/<ns>/notebooks/<nb>",

