@@ -738,12 +738,39 @@ def bench_sp_crossover(args) -> None:
         def ulysses_proxy(q, k, v):
             return flash_attention(q, k, v, causal=True)
 
+        half = Sq // 2
+
+        def zigzag_proxy(q, k, v):
+            # Zigzag ring's per-device schedule is UNIFORM: P+1 half-q
+            # flash calls (device 0 shown: lo half once, far half against
+            # every kv block). q here is [B, Sq/2, H, D].
+            o = jnp.zeros((B, half, H, D), jnp.float32)
+            lse = jnp.full((B, H, half), NEG_INF, jnp.float32)
+            res = flash_attention_lse(q, k[:, :Sq], v[:, :Sq], causal=True,
+                                      q_offset=0, kv_offset=0)
+            assert res is not None, "zigzag halves must be kernel-eligible"
+            o, lse = merge_attention_blocks(o, lse, *res)
+            o2 = jnp.zeros((B, half, H, D), jnp.float32)
+            lse2 = jnp.full((B, H, half), NEG_INF, jnp.float32)
+            off_far = (2 * sp - 1) * half
+            for j in range(sp):
+                res = flash_attention_lse(
+                    q, k[:, j * Sq:(j + 1) * Sq], v[:, j * Sq:(j + 1) * Sq],
+                    causal=True, q_offset=off_far, kv_offset=j * Sq,
+                )
+                assert res is not None, "zigzag halves must be kernel-eligible"
+                o2, lse2 = merge_attention_blocks(o2, lse2, *res)
+            # Sum (not concat+slice): both halves must stay live or XLA
+            # dead-code-eliminates the far loop entirely.
+            return (o + o2).astype(dtype)
+
         q_r = jax.random.normal(kq, (B, Sq, H, D), dtype)
         k_r = jax.random.normal(kk, (B, S, Hkv, D), dtype)
         v_r = jax.random.normal(kv_, (B, S, Hkv, D), dtype)
         q_u = jax.random.normal(kq, (B, S, H // sp, D), dtype)
         k_u = jax.random.normal(kk, (B, S, Hkv // sp, D), dtype)
         v_u = jax.random.normal(kv_, (B, S, Hkv // sp, D), dtype)
+        q_z = jax.random.normal(kq, (B, Sq // 2, H, D), dtype)
 
         def timed(fn, q0, k0, v0):
             # Per-dispatch tunnel latency (~110 ms) dwarfs these kernels:
@@ -777,8 +804,10 @@ def bench_sp_crossover(args) -> None:
 
         ring_ms = timed(ring_proxy, q_r, k_r, v_r)
         uly_ms = timed(ulysses_proxy, q_u, k_u, v_u)
+        zz_ms = timed(zigzag_proxy, q_z, k_r, v_r)
         row = {"seq_len": S, "per_device_q": Sq,
                "ring_ms": round(ring_ms, 3),
+               "zigzag_ring_ms": round(zz_ms, 3),
                "ulysses_ms": round(uly_ms, 3)}
         if ring_ms > 0 and uly_ms > 0:
             row["ring_over_ulysses"] = round(ring_ms / uly_ms, 3)

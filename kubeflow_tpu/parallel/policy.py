@@ -16,10 +16,15 @@ work and the wire bytes land (SURVEY.md §5 long-context):
 Measured on one v5e chip (single-chip kernel proxy at the per-device
 shapes each scheme produces; ``bench.py sp-crossover``, H=16 Hkv=8 D=128
 bf16, min-of-3, dispatch-floor subtracted — BASELINE.md "Ring vs
-Ulysses"): ring's critical path runs **1.8-2.9x** Ulysses' kernel time
-across S=8k-32k at sp∈{4,8} — the causal-imbalance factor (asymptotically
-2x) plus ring's smaller per-call blocks. Ulysses wins whenever its
-collectives don't inflate.
+Ulysses"): CONTIGUOUS ring's critical path runs **1.8-2.9x** Ulysses'
+kernel time across S=8k-32k at sp∈{4,8} — the causal-imbalance factor
+(asymptotically 2x) plus ring's smaller per-call blocks. The ring
+default is now the ZIGZAG schedule (ring_attention.py: mirror-swapped q
+halves give every device P+1 half-block calls), which reclaims ~44% of
+that critical path — zigzag ring measures within 5-13% of Ulysses at
+32k while keeping ring's smaller, compute-overlappable wire. Ulysses
+still wins the kernel proxy whenever its collectives stay exact, so the
+rule below stands; the penalty for the ring fallback cases is now small.
 
 What the kernel proxy cannot see is the wire: per device, ring moves
 ~2*B*S*Hkv*D*(P-1)/P bytes (kv rotations, overlappable with compute);

@@ -102,6 +102,23 @@ def _ring_flash_supported(q, k) -> bool:
     return _supported(Sq, Skv, H, Hkv, bq, bkv)
 
 
+def _zigzag_supported(q, k) -> bool:
+    """Zigzag splits local q in half; the halves must stay
+    kernel-blockable."""
+    from kubeflow_tpu.ops.flash_attention import _supported, default_blocks
+
+    B, Sq, H, D = q.shape
+    _, Skv, Hkv, _ = k.shape
+    # The zigzag liveness skips are derived in units where the rotating
+    # kv chunk equals the local q chunk; mismatched extents must use the
+    # contiguous path (its absolute offsets handle Sq != Skv).
+    if Sq % 2 or Skv != Sq:
+        return False
+    half = Sq // 2
+    bq, bkv = default_blocks(half, Skv)
+    return _supported(half, Skv, H, Hkv, bq, bkv)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -111,6 +128,7 @@ def ring_attention(
     causal: bool = True,
     scale: Optional[float] = None,
     use_flash: Optional[bool] = None,
+    zigzag: Optional[bool] = None,
 ) -> jax.Array:
     """Ring attention body — call INSIDE shard_map with q/k/v sequence-sharded
     over ``axis_name``. Shapes per device: q [B, Sq, H, D], k/v [B, Skv, Hkv, D].
@@ -120,6 +138,18 @@ def ring_attention(
     across rotations); otherwise the jnp online-softmax update. Either way
     the rotating payload stays [B, Skv, Hkv, D] (GQA heads are never
     repeated over the wire).
+
+    ``zigzag`` (auto when causal + flash-eligible): contiguous-block causal
+    ring is load-skewed — device p attends (p+1)/P of the sequence, so the
+    last device computes a full rectangle (~2x an even split) and lockstep
+    makes it the wall clock (measured 1.8-2.9x vs Ulysses, BASELINE.md
+    "Ring vs Ulysses"). The zigzag schedule swaps each device's SECOND
+    q half with its mirror device (one half-q ppermute each way), leaving
+    device p with global half-chunks {2p, 2P-1-2p} whose causal work sums
+    to a constant: (idx+1) + (P-idx) = P+1 half-block flash calls on EVERY
+    device. Dead (q-half, kv-block) pairs are skipped with lax.cond (TPU
+    cores branch independently on scalars). kv rotation is unchanged, so
+    the wire cost stays ~2*B*S*Hkv*D*(P-1)/P + one half-q round trip.
     """
     B, Sq, H, D = q.shape
     _, Skv, Hkv, _ = k.shape
@@ -139,6 +169,67 @@ def ring_attention(
     # use_flash=True is a hint, not a forcing: unsupported shapes always take
     # the jnp online-softmax path.
     use_flash = supported if use_flash is None else (use_flash and supported)
+    zz_ok = (use_flash and causal and P_ > 1
+             and _zigzag_supported(q, k))
+    zigzag = zz_ok if zigzag is None else (zigzag and zz_ok)
+
+    if use_flash and zigzag:
+        from kubeflow_tpu.ops.flash_attention import (
+            NEG_INF,
+            flash_attention_lse,
+            merge_attention_blocks,
+        )
+
+        half = Sq // 2
+        mirror = [(i, P_ - 1 - i) for i in range(P_)]
+        q_lo = q[:, :half]                       # global half-chunk 2*idx
+        # Swap the local SECOND half with the mirror device: we receive
+        # its second half — global half-chunk 2*(P-1-idx)+1 = 2P-1-2*idx.
+        q_far = lax.ppermute(q[:, half:], axis_name, mirror)
+        off_far = (2 * P_ - 1 - 2 * idx) * half
+
+        def acc0():
+            return (jnp.zeros((B, half, H, D), jnp.float32),
+                    jnp.full((B, H, half), NEG_INF, jnp.float32))
+
+        def body(j, state):
+            o_lo, lse_lo, o_far, lse_far, kj, vj = state
+            kchunk = (idx - j) % P_
+            kv_offset = kchunk * Skv
+
+            def attend(qh, off, o, lse):
+                res = flash_attention_lse(
+                    qh, kj, vj, causal=True, scale=scale_,
+                    q_offset=off, kv_offset=kv_offset,
+                )
+                assert res is not None, "zigzag halves must stay blockable"
+                return merge_attention_blocks(o, lse, *res)
+
+            # Liveness: kv chunk kchunk overlaps a q half iff its start
+            # precedes the half's causal end (integer arithmetic in units
+            # of Skv / half derived in the docstring).
+            o_lo, lse_lo = lax.cond(
+                kchunk <= idx,
+                lambda: attend(q_lo, q_offset, o_lo, lse_lo),
+                lambda: (o_lo, lse_lo),
+            )
+            o_far, lse_far = lax.cond(
+                kchunk <= P_ - 1 - idx,
+                lambda: attend(q_far, off_far, o_far, lse_far),
+                lambda: (o_far, lse_far),
+            )
+            kj = lax.ppermute(kj, axis_name, perm)
+            vj = lax.ppermute(vj, axis_name, perm)
+            return o_lo, lse_lo, o_far, lse_far, kj, vj
+
+        o_lo, _, o_far, _, _, _ = lax.fori_loop(
+            0, P_, body, (*acc0(), *acc0(), k, v))
+        # The far half's output belongs to the mirror device; cast to the
+        # output dtype BEFORE the send-home hop (the f32 accumulator would
+        # double the return-leg bytes for bf16 models, loss-free either
+        # way since the result is cast right after).
+        o_hi = lax.ppermute(o_far.astype(q.dtype), axis_name, mirror)
+        return jnp.concatenate([o_lo.astype(q.dtype), o_hi], axis=1)
 
     if use_flash:
         from kubeflow_tpu.ops.flash_attention import (
@@ -205,12 +296,14 @@ def ring_attention_sharded(
     head_axis: Optional[str] = "tp",
     causal: bool = True,
     scale: Optional[float] = None,
+    zigzag: Optional[bool] = None,
 ) -> jax.Array:
     """shard_map wrapper: q/k/v are global [B, S, H, D] arrays; the sequence
     dim is sharded over ``axis_name`` and rotated via ppermute."""
     spec = P(tuple(batch_axes), axis_name, head_axis, None)
     fn = functools.partial(
-        ring_attention, axis_name=axis_name, causal=causal, scale=scale
+        ring_attention, axis_name=axis_name, causal=causal, scale=scale,
+        zigzag=zigzag,
     )
     return jax.shard_map(
         fn,
