@@ -77,67 +77,94 @@ class SubstrateProvider:
         raise NotImplementedError
 
 
-class FakeSubstrateProvider(SubstrateProvider):
-    KIND = "fake"
+def _spec_records(spec: SubstrateSpec) -> Dict[str, Dict[str, Any]]:
+    """Validate a substrate spec and normalise it to pool records — the
+    spec rules are provider-independent; only resource creation differs
+    per provider."""
+    from kubeflow_tpu.topology.slices import list_slices
+
+    known = set(list_slices())
+    out: Dict[str, Dict[str, Any]] = {}
+    for sp in spec.slice_pools:
+        if not sp.name:
+            raise SubstrateError("slicePools[].name is required")
+        if sp.name in out:
+            raise SubstrateError(
+                f"duplicate slice pool name {sp.name!r}")
+        if sp.slice_type not in known:
+            raise SubstrateError(
+                f"unknown slice_type {sp.slice_type!r} "
+                f"(catalog: {sorted(known)})")
+        if sp.num_slices < 1:
+            raise SubstrateError(
+                f"slice pool {sp.name}: numSlices must be >= 1")
+        out[sp.name] = {"kind": "SlicePool", "name": sp.name,
+                        "sliceType": sp.slice_type,
+                        "numSlices": sp.num_slices}
+    for np_ in spec.node_pools:
+        if not np_.name:
+            raise SubstrateError("nodePools[].name is required")
+        if np_.name in out:
+            raise SubstrateError(
+                f"pool name {np_.name!r} used by both a slice pool "
+                "and a node pool")
+        if np_.count < 1:
+            raise SubstrateError(
+                f"node pool {np_.name}: count must be >= 1")
+        out[np_.name] = {"kind": "NodePool", "name": np_.name,
+                         "machineType": np_.machine_type,
+                         "count": np_.count}
+    return out
+
+
+class _MirrorStoreProvider(SubstrateProvider):
+    """Shared provider skeleton: a (deployment, pool) -> record mirror of
+    what exists cloud-side, with the diff/prune/ownership logic in one
+    place. Subclasses implement ONLY resource creation/deletion
+    (`_create_resource` / `_delete_resource`); the whole
+    read-diff-mutate sequence holds the lock so concurrent ensure calls
+    for one deployment cannot double-issue creates."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        # (deployment, pool_name) -> record
         self._pools: Dict[Tuple[str, str], Dict[str, Any]] = {}
 
-    def _records_for(self, spec: SubstrateSpec) -> Dict[str, Dict[str, Any]]:
-        from kubeflow_tpu.topology.slices import list_slices
+    # hooks -----------------------------------------------------------
 
-        known = set(list_slices())
-        out: Dict[str, Dict[str, Any]] = {}
-        for sp in spec.slice_pools:
-            if not sp.name:
-                raise SubstrateError("slicePools[].name is required")
-            if sp.name in out:
-                raise SubstrateError(
-                    f"duplicate slice pool name {sp.name!r}")
-            if sp.slice_type not in known:
-                raise SubstrateError(
-                    f"unknown slice_type {sp.slice_type!r} "
-                    f"(catalog: {sorted(known)})")
-            if sp.num_slices < 1:
-                raise SubstrateError(
-                    f"slice pool {sp.name}: numSlices must be >= 1")
-            out[sp.name] = {"kind": "SlicePool", "name": sp.name,
-                            "sliceType": sp.slice_type,
-                            "numSlices": sp.num_slices}
-        for np_ in spec.node_pools:
-            if not np_.name:
-                raise SubstrateError("nodePools[].name is required")
-            if np_.name in out:
-                raise SubstrateError(
-                    f"pool name {np_.name!r} used by both a slice pool "
-                    "and a node pool")
-            if np_.count < 1:
-                raise SubstrateError(
-                    f"node pool {np_.name}: count must be >= 1")
-            out[np_.name] = {"kind": "NodePool", "name": np_.name,
-                             "machineType": np_.machine_type,
-                             "count": np_.count}
-        return out
+    def _create_resource(self, deployment: str, rec: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def _delete_resource(self, deployment: str, rec: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    # contract --------------------------------------------------------
 
     def validate_spec(self, spec: SubstrateSpec) -> None:
-        self._records_for(spec)
+        _spec_records(spec)
 
     def ensure_pools(self, deployment: str,
                      spec: SubstrateSpec) -> List[str]:
-        wanted = self._records_for(spec)
+        wanted = _spec_records(spec)
         with self._lock:
             current = {pool: rec for (dep, pool), rec in self._pools.items()
                        if dep == deployment}
             for pool, rec in wanted.items():
-                if current.get(pool) != rec:
-                    verb = "updated" if pool in current else "created"
-                    self._pools[(deployment, pool)] = copy.deepcopy(rec)
-                    log.info(f"substrate pool {verb}",
-                             kv={"deployment": deployment, "pool": pool,
-                                 "kind": rec["kind"]})
+                if current.get(pool) == rec:
+                    continue
+                if pool in current:
+                    # Pools are immutable cloud-side: recreate on change.
+                    # Drop the mirror entry as soon as the delete lands so
+                    # a failed create cannot leave a stale claim (retry
+                    # would then re-issue the delete against nothing).
+                    self._delete_resource(deployment, current[pool])
+                    del self._pools[(deployment, pool)]
+                self._create_resource(deployment, rec)
+                self._pools[(deployment, pool)] = copy.deepcopy(rec)
+                log.info("substrate pool ensured",
+                         kv={"deployment": deployment, "pool": pool,
+                             "kind": rec["kind"]})
             for pool in set(current) - set(wanted):
+                self._delete_resource(deployment, current[pool])
                 del self._pools[(deployment, pool)]
                 log.info("substrate pool deleted (no longer in spec)",
                          kv={"deployment": deployment, "pool": pool})
@@ -145,9 +172,11 @@ class FakeSubstrateProvider(SubstrateProvider):
 
     def deprovision(self, deployment: str) -> List[str]:
         with self._lock:
-            mine = [k for k in self._pools if k[0] == deployment]
-            for k in mine:
-                del self._pools[k]
+            mine = {k: v for k, v in self._pools.items()
+                    if k[0] == deployment}
+            for (dep, pool), rec in sorted(mine.items()):
+                self._delete_resource(dep, rec)
+                del self._pools[(dep, pool)]
         if mine:
             log.info("substrate deprovisioned",
                      kv={"deployment": deployment, "pools": len(mine)})
@@ -164,10 +193,115 @@ class FakeSubstrateProvider(SubstrateProvider):
             self._pools.clear()
 
 
+class FakeSubstrateProvider(_MirrorStoreProvider):
+    """In-env provider: the mirror store IS the substrate."""
+
+    KIND = "fake"
+
+    def _create_resource(self, deployment: str, rec: Dict[str, Any]) -> None:
+        pass
+
+    def _delete_resource(self, deployment: str, rec: Dict[str, Any]) -> None:
+        pass
+
+
+class GcloudTpuProvider(_MirrorStoreProvider):
+    """GCP implementation shaped around the real CLI surface: one
+    `gcloud compute tpus tpu-vm create` per slice in a pool (the CLI
+    creates one TPU VM per invocation) and GKE node pools under the
+    deployment's cluster. The executor is injectable (same seam as the
+    kubectl backend's subprocess boundary), so in this env — zero
+    egress, no project — the provider is driven end-to-end against a
+    recording executor while production swaps in subprocess.run. Proves
+    the SubstrateProvider seam fits a second cloud the way the profile
+    controller's AWS IRSA plugin proved the IAM seam.
+    """
+
+    KIND = "gcloud"
+
+    def __init__(self, runner=None, project: str = "", zone: str = "",
+                 cluster: str = "kubeflow-tpu",
+                 runtime_version: str = "tpu-ubuntu2204-base"):
+        super().__init__()
+        self.project = project
+        self.zone = zone
+        self.cluster = cluster
+        self.runtime_version = runtime_version
+        self.runner = runner if runner is not None else self._no_runner
+
+    @staticmethod
+    def _no_runner(argv: List[str]) -> str:
+        raise SubstrateError(
+            "GcloudTpuProvider has no executor wired: construct it with "
+            "runner=subprocess-backed callable (production) or a fake "
+            "(tests)")
+
+    def validate_spec(self, spec: SubstrateSpec) -> None:
+        if self.runner is self._no_runner:
+            # An unwired provider must fail at VALIDATION time: the
+            # platform dry-validates a new substrate before tearing the
+            # old one down, and "would fail on first command" must count
+            # as invalid there.
+            raise SubstrateError(
+                "gcloud provider has no executor wired (construct with "
+                "runner=...) — refusing to validate a spec it could "
+                "never provision")
+        super().validate_spec(spec)
+
+    def _scope(self) -> List[str]:
+        out = []
+        if self.project:
+            out += ["--project", self.project]
+        if self.zone:
+            out += ["--zone", self.zone]
+        return out
+
+    def _label(self, deployment: str) -> str:
+        return f"kftpu-deployment={deployment}"
+
+    def _slice_names(self, deployment: str, rec: Dict[str, Any]) -> List[str]:
+        base = f"{deployment}-{rec['name']}"
+        n = int(rec["numSlices"])
+        return [base] if n == 1 else [f"{base}-{i}" for i in range(n)]
+
+    def _create_resource(self, deployment: str, rec: Dict[str, Any]) -> None:
+        if rec["kind"] == "SlicePool":
+            for vm in self._slice_names(deployment, rec):
+                self.runner([
+                    "gcloud", "compute", "tpus", "tpu-vm", "create", vm,
+                    "--accelerator-type", rec["sliceType"],
+                    "--version", self.runtime_version,
+                    "--labels", self._label(deployment),
+                    *self._scope()])
+        else:
+            self.runner([
+                "gcloud", "container", "node-pools", "create",
+                f"{deployment}-{rec['name']}",
+                "--cluster", self.cluster,
+                "--machine-type", rec["machineType"],
+                "--num-nodes", str(rec["count"]),
+                "--node-labels", self._label(deployment),
+                *self._scope()])
+
+    def _delete_resource(self, deployment: str, rec: Dict[str, Any]) -> None:
+        if rec["kind"] == "SlicePool":
+            for vm in self._slice_names(deployment, rec):
+                self.runner(["gcloud", "compute", "tpus", "tpu-vm",
+                             "delete", vm, "--quiet", *self._scope()])
+        else:
+            self.runner(["gcloud", "container", "node-pools", "delete",
+                         f"{deployment}-{rec['name']}",
+                         "--cluster", self.cluster, "--quiet",
+                         *self._scope()])
+
+
 # Provider registry: singletons, because substrate state outlives any one
-# Platform engine instance (a cloud does too). Tests reset the fake.
+# Platform engine instance (a cloud does too). Tests reset the fake; the
+# gcloud provider needs an executor wired before use (register a
+# configured instance over this default).
 PROVIDERS: Dict[str, SubstrateProvider] = {
     FakeSubstrateProvider.KIND: FakeSubstrateProvider(),
+    GcloudTpuProvider.KIND: GcloudTpuProvider(),
 }
 
 

@@ -56,9 +56,16 @@ def _spec(**kw):
 
 
 # Parametrized like the IAM conformance suite: every registered provider
-# must satisfy the same lifecycle contract.
-@pytest.fixture(params=["fake"])
+# must satisfy the same lifecycle contract. The gcloud impl runs against
+# a recording executor (the production seam is subprocess.run).
+@pytest.fixture(params=["fake", "gcloud"])
 def provider(request, fresh_fake):
+    if request.param == "gcloud":
+        from kubeflow_tpu.controlplane.substrate import GcloudTpuProvider
+
+        p = GcloudTpuProvider(runner=lambda argv: "", project="proj",
+                              zone="us-east5-a")
+        return p
     return get_provider(request.param)
 
 
@@ -320,3 +327,102 @@ class TestReviewRegressions:
                 spec=PlatformConfigSpec(substrate=SubstrateSpec(
                     provider="gcp-dm"))))
         assert len(fresh_fake.list_resources("kf-sub")) == 3
+        # Same for a REGISTERED but unwired provider (the default gcloud
+        # registry entry has no executor): validate_spec refuses, so the
+        # fake's pools survive.
+        with pytest.raises(SubstrateError, match="no executor"):
+            pf.apply_config(PlatformConfig(
+                metadata=ObjectMeta(name="kf-sub"),
+                spec=PlatformConfigSpec(substrate=_spec(
+                    provider="gcloud"))))
+        assert len(fresh_fake.list_resources("kf-sub")) == 3
+
+
+class TestGcloudProviderCommands:
+    """The gcloud impl's value is the command surface: assert the exact
+    CLI lines the seam would execute in production."""
+
+    def _provider(self):
+        from kubeflow_tpu.controlplane.substrate import GcloudTpuProvider
+
+        calls = []
+
+        def runner(argv):
+            calls.append(list(argv))
+            return ""
+
+        return GcloudTpuProvider(runner=runner, project="proj",
+                                 zone="us-east5-a"), calls
+
+    def test_create_commands(self):
+        p, calls = self._provider()
+        p.ensure_pools("dep-a", _spec())
+        joined = [" ".join(c) for c in calls]
+        # One tpu-vm create PER SLICE (the CLI creates one VM per call),
+        # with the runtime --version the real gcloud requires.
+        for vm in ("dep-a-train-pool-0", "dep-a-train-pool-1"):
+            assert any(
+                c.startswith(f"gcloud compute tpus tpu-vm create {vm}")
+                and "--accelerator-type v5e-16" in c
+                and "--version tpu-ubuntu2204-base" in c
+                and "--labels kftpu-deployment=dep-a" in c
+                and "--project proj" in c and "--zone us-east5-a" in c
+                for c in joined), joined
+        # Single-slice pools use the bare pool name.
+        assert any(
+            c.startswith("gcloud compute tpus tpu-vm create dep-a-serve-pool ")
+            for c in joined), joined
+        assert any(
+            c.startswith("gcloud container node-pools create dep-a-cp-pool")
+            and "--cluster kubeflow-tpu" in c
+            and "--machine-type n2-standard-8" in c and "--num-nodes 3" in c
+            for c in joined), joined
+
+    def test_idempotent_ensure_issues_no_commands(self):
+        p, calls = self._provider()
+        p.ensure_pools("dep-a", _spec())
+        n = len(calls)
+        p.ensure_pools("dep-a", _spec())
+        assert len(calls) == n  # nothing re-created
+
+    def test_spec_change_recreates_pool(self):
+        p, calls = self._provider()
+        p.ensure_pools("dep-a", _spec())
+        calls.clear()
+        p.ensure_pools("dep-a", _spec(slice_pools=[
+            SlicePoolSpec(name="train-pool", slice_type="v5e-16",
+                          num_slices=4),
+            SlicePoolSpec(name="serve-pool", slice_type="v5e-4",
+                          num_slices=1)], node_pools=[]))
+        joined = [" ".join(c) for c in calls]
+        assert any("tpu-vm delete dep-a-train-pool-0" in c for c in joined)
+        # re-created at the new width: 4 per-slice creates
+        for i in range(4):
+            assert any(f"tpu-vm create dep-a-train-pool-{i} " in c
+                       for c in joined), joined
+        # serve-pool untouched, cp-pool (dropped from spec) deleted
+        assert not any("serve-pool" in c and "create" in c for c in joined)
+        assert any("node-pools delete dep-a-cp-pool" in c
+                   and "--cluster kubeflow-tpu" in c for c in joined)
+
+    def test_deprovision_deletes_everything(self):
+        p, calls = self._provider()
+        p.ensure_pools("dep-a", _spec())
+        calls.clear()
+        p.deprovision("dep-a")
+        joined = [" ".join(c) for c in calls]
+        # train-pool has 2 slices -> 2 deletes; serve-pool 1; cp-pool 1.
+        assert sum("delete" in c for c in joined) == 4
+        assert p.list_resources("dep-a") == []
+
+    def test_unwired_executor_fails_loudly(self):
+        from kubeflow_tpu.controlplane.substrate import GcloudTpuProvider
+
+        p = GcloudTpuProvider()
+        with pytest.raises(SubstrateError, match="no executor"):
+            p.ensure_pools("dep-a", _spec())
+        # validate_spec must ALSO refuse: the platform dry-validates a
+        # new provider before tearing the old pools down, and an unwired
+        # provider could never provision.
+        with pytest.raises(SubstrateError, match="no executor"):
+            p.validate_spec(_spec())
