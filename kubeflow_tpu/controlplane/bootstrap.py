@@ -21,7 +21,11 @@ Here the same surface wraps the platform's own apply engine
 - ``GET    /kfctl/apps/v1beta1/get/<name>``       mutex-guarded status
   copy: phase Pending|Applying|Ready|Failed, applied components, error.
 - ``GET    /kfctl/apps/v1beta1/list``
-- ``DELETE /kfctl/apps/v1beta1/delete/<name>``    teardown + state GC.
+- ``GET    /kfctl/apps/v1beta1/substrate/<name>`` what the cloud provider
+  currently holds for the deployment (the delete-leak check's view) —
+  includes pools a FAILED apply provisioned before its config stored.
+- ``DELETE /kfctl/apps/v1beta1/delete/<name>``    teardown + state GC
+  (substrate deprovision is leak-checked; a leak is a loud 500).
 
 Re-POSTing an existing name re-applies idempotently (the reference's
 repeated-apply contract, kfctl_second_apply.py:12-24).
@@ -275,6 +279,24 @@ class DeploymentServer:
             # Mutex-guarded copy (kfctlServer.GetLatestKfDef:74-77).
             return copy.deepcopy(self._status(dep))
 
+    def _substrate(self, req: Request):
+        """What the cloud currently holds for the deployment — the same
+        provider view the delete-leak check reads, surfaced for operators
+        (the reference's DM-resources listing)."""
+        name = req.params["name"]
+        with self._lock:
+            dep = self._deployments.get(name)
+        if dep is None:
+            raise RestError(404, f"no deployment {name!r}")
+        sub = (dep.platform.substrate_spec(name)
+               if dep.platform is not None else None)
+        if sub is None or not sub.provider:
+            return {"name": name, "provider": "", "resources": []}
+        from kubeflow_tpu.controlplane.substrate import get_provider
+
+        return {"name": name, "provider": sub.provider,
+                "resources": get_provider(sub.provider).list_resources(name)}
+
     def _list(self, req: Request):
         with self._lock:
             return {"deployments": [copy.deepcopy(self._status(d))
@@ -324,6 +346,7 @@ class DeploymentServer:
         r.get("/", lambda q: Html(_deploy_page()))
         r.post(f"{_PREFIX}/create", self._create)
         r.get(f"{_PREFIX}/get/<name>", self._get)
+        r.get(f"{_PREFIX}/substrate/<name>", self._substrate)
         r.get(f"{_PREFIX}/list", self._list)
         r.delete(f"{_PREFIX}/delete/<name>", self._delete)
         return r

@@ -264,6 +264,18 @@ class Platform:
             self.prober.maybe_probe()
         return n
 
+    def substrate_spec(self, name: str):
+        """The deployment's effective substrate spec: the STORED config
+        wins, falling back to the in-memory applied config — a failed
+        apply may have provisioned pools before the config ever reached
+        the store, and both delete and the operator inspection endpoint
+        must see them."""
+        cfg = self.api.try_get("PlatformConfig", name)
+        if cfg is not None:
+            return cfg.spec.substrate
+        return (self._config.spec.substrate
+                if self._config is not None else None)
+
     def delete_config(self, name: str) -> List[str]:
         """Tear the deployment's substrate down (finalizer-guarded) and
         delete the PlatformConfig. Deprovision is leak-checked: anything
@@ -276,10 +288,7 @@ class Platform:
         )
 
         cfg = self.api.try_get("PlatformConfig", name)
-        spec_substrate = (cfg.spec.substrate if cfg is not None
-                          else (self._config.spec.substrate
-                                if self._config is not None else None))
-        deleted = deprovision_checked(name, spec_substrate)
+        deleted = deprovision_checked(name, self.substrate_spec(name))
         if cfg is not None:
             if SUBSTRATE_FINALIZER in cfg.metadata.finalizers:
                 cfg.metadata.finalizers.remove(SUBSTRATE_FINALIZER)

@@ -259,6 +259,52 @@ class TestBootstrapE2E:
         assert out["substratePools"] == ["cp-pool", "train-pool"]
         assert fresh_fake.list_resources("subdep") == []
 
+    def test_substrate_inspection_endpoint(self, server, fresh_fake):
+        self._post(server, "/kfctl/apps/v1beta1/create", {
+            "name": "viewdep",
+            "spec": {"substrate": {"provider": "fake",
+                                   "slicePools": [{"name": "tp",
+                                                   "sliceType": "v5e-16",
+                                                   "numSlices": 2}]}},
+        })
+        self._wait_ready(server, "viewdep")
+        out = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/kfctl/apps/v1beta1/"
+            "substrate/viewdep"))
+        assert out["provider"] == "fake"
+        assert [r["name"] for r in out["resources"]] == ["tp"]
+        assert out["resources"][0]["numSlices"] == 2
+
+    def test_substrate_endpoint_shows_pools_of_failed_apply(
+            self, server, fresh_fake):
+        """A failed apply may have provisioned BEFORE its config reached
+        the store — the inspection endpoint must still surface the pools
+        (they are exactly the leak the operator needs to see)."""
+        self._post(server, "/kfctl/apps/v1beta1/create", {
+            "name": "faildep",
+            "spec": {
+                "substrate": {"provider": "fake",
+                              "slicePools": [{"name": "tp",
+                                              "sliceType": "v5e-16"}]},
+                "components": [{"name": "bogus-component"}],
+            },
+        })
+        out = self._wait_ready(server, "faildep")
+        assert out["phase"] == "Failed"
+        assert len(fresh_fake.list_resources("faildep")) == 1
+        view = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/kfctl/apps/v1beta1/"
+            "substrate/faildep"))
+        assert view["provider"] == "fake"
+        assert [r["name"] for r in view["resources"]] == ["tp"]
+        # and delete still reclaims them (the fallback feeds delete too)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/kfctl/apps/v1beta1/delete/"
+            "faildep", method="DELETE")
+        out = json.load(urllib.request.urlopen(req))
+        assert out["substratePools"] == ["tp"]
+        assert fresh_fake.list_resources("faildep") == []
+
     def test_bad_substrate_fails_the_deployment_loudly(self, server,
                                                        fresh_fake):
         self._post(server, "/kfctl/apps/v1beta1/create", {
