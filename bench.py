@@ -117,7 +117,7 @@ def bench_train(args) -> None:
         model,
         TrainConfig(task="lm", warmup_steps=10, total_steps=1000,
                     attn_impl=args.attn, mu_dtype=args.mu_dtype,
-                    loss_chunk=args.loss_chunk,
+                    loss_chunk=args.loss_chunk or 0,
                     grad_accum_steps=args.grad_accum),
         mesh,
     )
@@ -678,14 +678,36 @@ def bench_hpo_platform(args) -> None:
 
 
 def bench_longctx(args) -> None:
-    """Long-context variant of config 2: seq 8192 on one chip (the
-    round-3 memory work fits it; beyond 16k the multi-chip path is
-    ring/Ulysses sequence parallelism)."""
+    """Long-context variant of config 2 on ONE chip. Defaults encode the
+    MEASURED per-length recipe (BASELINE.md context ladder, 2k→64k):
+
+    - ≤16k: ``qkv_attn_lse`` (saving the flash lse residuals beats
+      replaying the S² forward; +4% at 8k)
+    - 32k:  ``qkv_attn`` + chunked CE (the lse residuals exceed HBM)
+    - 64k:  ``full`` remat + chunked CE (qkv_attn's saved q/k/v ~3 GB +
+      replay working set no longer fit; measured OOM)
+
+    Beyond 64k the path is ring/Ulysses sequence parallelism.
+    Explicit --remat-policy/--loss-chunk/--batch-size always win
+    (--loss-chunk 0 explicitly disables chunking at any length)."""
     args.seq_len = args.seq_len if args.seq_len != 2048 else 8192
-    args.batch_size = args.batch_size or 3
-    # Saving the flash lse residual pays off once the S^2 forward replay
-    # dominates (+4% at 8k; -2.5% at 2k — see _remat_policy docs).
-    args.remat_policy = args.remat_policy or "qkv_attn_lse"
+    if args.seq_len >= 65536:
+        args.batch_size = args.batch_size or 1
+        args.remat_policy = args.remat_policy or "full"
+        if args.loss_chunk is None:
+            args.loss_chunk = 4096
+    elif args.seq_len > 16384:
+        # Between the validated 16k (lse residuals fit) and 32k (measured
+        # 1.23G over) points, take the 32k-safe recipe.
+        args.batch_size = args.batch_size or 1
+        args.remat_policy = args.remat_policy or "qkv_attn"
+        if args.loss_chunk is None:
+            args.loss_chunk = 8192
+    else:
+        # Records: 8k = bs3, 16k = bs1 (BASELINE context ladder rows).
+        args.batch_size = args.batch_size or (3 if args.seq_len <= 8192
+                                              else 1)
+        args.remat_policy = args.remat_policy or "qkv_attn_lse"
     bench_train(args)
 
 
@@ -902,7 +924,7 @@ def main() -> None:
     p.add_argument("--grad-accum", type=int, default=1,
                    help="microbatch gradient accumulation for the train "
                         "bench (TrainConfig.grad_accum_steps)")
-    p.add_argument("--loss-chunk", type=int, default=0,
+    p.add_argument("--loss-chunk", type=int, default=None,
                    help="fuse lm_head+CE blockwise over this many tokens "
                         "(0 = off); frees the [B,S,V] logits buffer")
     p.add_argument("--bf16-logits", dest="bf16_logits", default=True,
