@@ -221,3 +221,48 @@ class TestSpawnerPageE2E:
                   if e.reason == "CheckpointNotFound"
                   and e.involved_name == "wait-nb"]
         assert len(events) == 1, [e.message for e in events]
+
+
+class TestRealOrbaxLoop:
+    """Close the loop with a REAL orbax checkpoint: what the producing
+    job's CheckpointService wrote is exactly what the spawned notebook's
+    KFTPU_RESTORE_DIR restores — byte-exact, not a fake step dir."""
+
+    def test_write_catalog_spawn_restore(self, tmp_path):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kubeflow_tpu.train.checkpoint import CheckpointService
+
+        ckdir = str(tmp_path / "real-run")
+        svc = CheckpointService(ckdir)
+        state = {"params": {"w": jnp.arange(8, dtype=jnp.float32)},
+                 "step": 7}
+        svc.save(7, state)
+        svc.close()
+
+        pf = Platform()
+        pf.apply_config(PlatformConfig(
+            metadata=ObjectMeta(name="kubeflow-tpu")))
+        pf.api.create(Profile(metadata=ObjectMeta(name="alice"),
+                              spec=ProfileSpec(owner=USER)))
+        pf.reconcile()
+        pf.api.create(TpuJob(
+            metadata=ObjectMeta(name="real-run", namespace="alice"),
+            spec=TpuJobSpec(slice_type="v5e-16", model="llama-tiny",
+                            checkpoint_dir=ckdir)))
+        entry = resolve_checkpoint(pf.api, "alice", "real-run")
+        assert entry is not None and entry["latestStep"] == 7
+
+        pf.jwa.create_notebook(USER, "alice", {
+            "name": "resume-nb", "checkpoint": "real-run"})
+        pf.reconcile()
+        pod = pf.api.get("Pod", "resume-nb-0", "alice")
+        env = {e.name: e.value for e in pod.spec.containers[0].env}
+
+        restored = CheckpointService(
+            env["KFTPU_RESTORE_DIR"]).restore_raw_latest()
+        assert restored["step"] == 7
+        np.testing.assert_array_equal(
+            np.asarray(restored["params"]["w"]),
+            np.arange(8, dtype=np.float32))

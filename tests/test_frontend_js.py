@@ -297,3 +297,104 @@ class TestDeployFormExecution:
         b.set_value("name", "Bad/Name")
         b.submit("deploy")   # must NOT raise: the page catches api errors
         assert b.element("err").textContent != ""
+
+
+class TestExecutedXssPolyglots:
+    """Stored-XSS polyglot battery through the EXECUTED pipeline: every
+    payload is created directly on the API server (bypassing JWA's name
+    validation — the stored vector) and must come back inert through the
+    real page script's esc()."""
+
+    PAYLOADS = [
+        '"><svg onload=alert(1)>',
+        "'onmouseover='alert(1)",
+        '<img src=x onerror=alert(1)>',
+        '&lt;already-escaped&gt;<b>',
+        '<script>alert(1)</script>',
+    ]
+
+    @pytest.fixture()
+    def stack(self):
+        pf = Platform()
+        pf.apply_config(PlatformConfig(
+            metadata=ObjectMeta(name="kubeflow-tpu")))
+        pf.api.create(Profile(metadata=ObjectMeta(name="alice"),
+                              spec=ProfileSpec(owner=USER)))
+        pf.reconcile()
+        hub = central_hub(pf.api, pf.dashboard, pf.jwa)
+        srv = JsonHttpServer(hub, port=0).start()
+        yield pf, srv
+        srv.stop()
+
+    def test_all_polyglots_inert_and_deletable(self, stack):
+        from kubeflow_tpu.controlplane.api.types import (
+            Notebook,
+            NotebookSpec,
+        )
+
+        pf, srv = stack
+        for i, payload in enumerate(self.PAYLOADS):
+            pf.api.create(Notebook(
+                metadata=ObjectMeta(name=payload, namespace="alice"),
+                spec=NotebookSpec(image=f"img-{i}:latest")))
+        b = MicroBrowser(f"http://127.0.0.1:{srv.port}",
+                         user_header=USER_HEADER, user=USER).open("/spawner")
+        html = b.element("list").innerHTML
+        # No raw executable sinks survive (the '&lt;already-escaped&gt;'
+        # payload must be DOUBLE-escaped — rendering stored text verbatim
+        # would un-escape it).
+        assert "<svg" not in html and "<script" not in html
+        assert "<img src=x" not in html
+        assert "onmouseover='alert" not in html
+        assert "&amp;lt;already-escaped&amp;gt;" in html
+        # Attribute context: every delete button's TAG must have exactly
+        # the expected shape — an attribute breakout would add attributes
+        # or truncate the quoted value.
+        import re as _re
+
+        tags = _re.findall(r'<button class="del"[^>]*>', html)
+        assert len(tags) == len(self.PAYLOADS)
+        for tag in tags:
+            assert _re.fullmatch(
+                r'<button class="del" data-name="[^"<>]*">', tag), tag
+        # Every payload row is deletable through the delegation path.
+        for payload in self.PAYLOADS:
+            b.click_delete("list", payload)
+        final = b.element("list").innerHTML
+        for i in range(len(self.PAYLOADS)):
+            assert f"img-{i}" not in final, final
+
+
+class TestExecutedMetricsPanel:
+    """loadMetrics() + spark() through the real script against a live
+    MetricsService — the one audit-whitelisted markup helper (spark)
+    executes for real."""
+
+    def test_sparkline_table_renders(self):
+        from kubeflow_tpu.webapps.metrics import (
+            MetricsService,
+            TimeSeriesStore,
+        )
+
+        pf = Platform()
+        pf.apply_config(PlatformConfig(
+            metadata=ObjectMeta(name="kubeflow-tpu")))
+        pf.api.create(Profile(metadata=ObjectMeta(name="alice"),
+                              spec=ProfileSpec(owner=USER)))
+        pf.reconcile()
+        store = TimeSeriesStore()
+        for i in range(8):
+            store.record("tokens_per_sec", 1000.0 + 50 * i,
+                         labels=(("job", "pretrain"),))
+        hub = central_hub(pf.api, pf.dashboard, pf.jwa,
+                          metrics_service=MetricsService(store))
+        srv = JsonHttpServer(hub, port=0).start()
+        try:
+            b = MicroBrowser(f"http://127.0.0.1:{srv.port}",
+                             user_header=USER_HEADER, user=USER).open("/")
+            html = b.element("metrics").innerHTML
+            assert "tokens_per_sec{job=pretrain}" in html
+            assert "<svg" in html and "<polyline" in html
+            assert "1350" in html    # latest value via toPrecision(4)
+        finally:
+            srv.stop()
