@@ -1,0 +1,39 @@
+"""Deterministic fault injection for the control plane.
+
+The reference platform leans on controller-runtime's rate-limited
+workqueue and Kubernetes restart machinery to ride out API conflicts and
+node loss, and only ever exercises that machinery on live GKE clusters.
+This package makes failure a first-class, *seeded* test input instead:
+
+- :class:`ChaosApiServer` — wraps ``InMemoryApiServer`` and injects
+  configurable rates of conflicts, not-founds, transient write failures
+  and latency per verb/kind, driven by a seeded RNG.
+- :class:`SlicePreemptor` — marks TPU slices preempted (the dominant TPU
+  failure mode), failing their worker pods and optionally reclaiming
+  schedulable capacity so gangs must land on surviving slices.
+- :class:`BackendFlapper` — flaps serving LB backends to prove request
+  failover is client-invisible.
+- :func:`run_soak` — the seeded convergence soak shared by tier-1 tests
+  and the CI ``chaos-smoke`` stage.
+
+See docs/chaos.md for the injection points and knobs.
+"""
+
+from kubeflow_tpu.chaos.api import (
+    ChaosApiServer,
+    FaultSpec,
+    TransientApiError,
+)
+from kubeflow_tpu.chaos.flapper import BackendFlapper
+from kubeflow_tpu.chaos.preemptor import SlicePreemptor
+from kubeflow_tpu.chaos.soak import SoakReport, run_soak
+
+__all__ = [
+    "BackendFlapper",
+    "ChaosApiServer",
+    "FaultSpec",
+    "SlicePreemptor",
+    "SoakReport",
+    "TransientApiError",
+    "run_soak",
+]

@@ -1,0 +1,205 @@
+"""Fault-injecting wrapper around the in-memory API server.
+
+``ChaosApiServer`` delegates every call to the wrapped
+``InMemoryApiServer`` and, first, rolls a seeded RNG against per-verb/kind
+fault rules. One roll per call, partitioned into bands (conflict, then
+transient, then not-found), keeps the fault sequence a pure function of
+the seed and the call sequence — the same test run always injects the
+same faults.
+
+Injection points (chosen to match where a real apiserver can fail):
+
+====================  =======================================
+verb                  injectable faults
+====================  =======================================
+``create``            transient, latency
+``update``            conflict, transient, latency
+``update_status``     conflict, transient, latency
+``delete``            transient, not_found, latency
+``get``               not_found, transient, latency
+``list``              transient, latency
+``try_get``           none — models the local informer cache,
+                      which cannot spuriously miss
+====================  =======================================
+
+``try_get`` staying clean is deliberate: controllers use it as the
+"is my primary still there" read, and a spurious None would be
+indistinguishable from a real deletion — no amount of retrying fixes a
+read that lies silently. Faults that *raise* are retried by the
+reconciler's backoff limiter; that is the contract chaos exercises.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Any, Dict, List, Optional
+
+from kubeflow_tpu.controlplane.runtime.apiserver import (
+    ApiError,
+    ConflictError,
+    InMemoryApiServer,
+    NotFoundError,
+)
+from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
+
+log = get_logger("chaos")
+
+WRITE_VERBS = ("create", "update", "update_status", "delete")
+
+
+class TransientApiError(ApiError):
+    """An injected one-shot server failure (the 500/timeout class of error
+    a real apiserver returns under load); retry-able by design."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """Per-rule fault rates (each in [0, 1]; their sum must be <= 1 since
+    one RNG roll is banded across them) plus injected latency."""
+
+    conflict_rate: float = 0.0      # update/update_status raise ConflictError
+    transient_rate: float = 0.0     # any verb raises TransientApiError
+    not_found_rate: float = 0.0     # get/delete raise NotFoundError
+    latency_s: float = 0.0          # sleep before the call (0 in tier-1)
+
+    def __post_init__(self) -> None:
+        total = self.conflict_rate + self.transient_rate + self.not_found_rate
+        if total > 1.0 + 1e-9:
+            raise ValueError(f"fault rates sum to {total} > 1")
+
+
+class ChaosApiServer:
+    """Seeded fault-injection proxy for :class:`InMemoryApiServer`.
+
+    ``rules`` maps ``"verb:kind"`` patterns to :class:`FaultSpec`; either
+    side may be ``*``. The most specific match wins:
+    ``verb:kind > verb:* > *:kind > *:*``.
+    """
+
+    def __init__(
+        self,
+        inner: InMemoryApiServer,
+        *,
+        seed: int = 0,
+        rules: Optional[Dict[str, FaultSpec]] = None,
+        registry: MetricsRegistry = global_registry,
+    ):
+        self.inner = inner
+        self.rng = random.Random(seed)
+        self.rules = dict(rules or {})
+        self.enabled = True
+        # Plain-dict tally ("verb:kind:fault" -> n) for cheap test asserts
+        # and determinism comparisons, next to the exported counter.
+        self.injected: Dict[str, int] = {}
+        self.metrics_injected = registry.counter(
+            "kftpu_chaos_injected_total",
+            "Faults injected by the chaos API server",
+            labels=("verb", "kind", "fault"),
+        )
+
+    # ----------------- knobs -----------------
+
+    def set_rule(self, pattern: str, spec: FaultSpec) -> None:
+        if ":" not in pattern:
+            raise ValueError(f"rule pattern must be 'verb:kind', got {pattern!r}")
+        self.rules[pattern] = spec
+
+    def quiesce(self) -> None:
+        """Stop injecting (the 'faults stop' phase of a soak)."""
+        self.enabled = False
+
+    def resume(self) -> None:
+        self.enabled = True
+
+    # ----------------- injection -----------------
+
+    def _rule(self, verb: str, kind: str) -> Optional[FaultSpec]:
+        for pat in (f"{verb}:{kind}", f"{verb}:*", f"*:{kind}", "*:*"):
+            spec = self.rules.get(pat)
+            if spec is not None:
+                return spec
+        return None
+
+    def _record(self, verb: str, kind: str, fault: str) -> None:
+        key = f"{verb}:{kind}:{fault}"
+        self.injected[key] = self.injected.get(key, 0) + 1
+        self.metrics_injected.inc(verb=verb, kind=kind, fault=fault)
+
+    def _maybe_inject(self, verb: str, kind: str, ref: str) -> None:
+        if not self.enabled:
+            return
+        spec = self._rule(verb, kind)
+        if spec is None:
+            return
+        if spec.latency_s > 0:
+            time.sleep(spec.latency_s)
+        # Single roll, banded per-verb: which faults apply to which verb is
+        # fixed here so a rule can be written once with wildcard verbs.
+        roll = self.rng.random()
+        edge = 0.0
+        if verb in ("update", "update_status"):
+            edge += spec.conflict_rate
+            if roll < edge:
+                self._record(verb, kind, "conflict")
+                raise ConflictError(
+                    f"chaos: injected conflict on {verb} {kind} {ref}"
+                )
+        edge += spec.transient_rate
+        if roll < edge:
+            self._record(verb, kind, "transient")
+            raise TransientApiError(
+                f"chaos: injected transient failure on {verb} {kind} {ref}"
+            )
+        if verb in ("get", "delete"):
+            edge += spec.not_found_rate
+            if roll < edge:
+                self._record(verb, kind, "not_found")
+                raise NotFoundError(
+                    f"chaos: injected not-found on {verb} {kind} {ref}"
+                )
+
+    # ----------------- proxied CRUD -----------------
+
+    def create(self, obj: Any) -> Any:
+        self._maybe_inject("create", obj.kind, obj.metadata.name)
+        return self.inner.create(obj)
+
+    def get(self, kind: str, name: str, namespace: str = "") -> Any:
+        self._maybe_inject("get", kind, name)
+        return self.inner.get(kind, name, namespace)
+
+    def try_get(self, kind: str, name: str, namespace: str = "") -> Optional[Any]:
+        # Informer-cache read: never injected (see module docstring).
+        return self.inner.try_get(kind, name, namespace)
+
+    def update(self, obj: Any) -> Any:
+        self._maybe_inject("update", obj.kind, obj.metadata.name)
+        return self.inner.update(obj)
+
+    def update_status(self, obj: Any) -> Any:
+        self._maybe_inject("update_status", obj.kind, obj.metadata.name)
+        return self.inner.update_status(obj)
+
+    def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        self._maybe_inject("delete", kind, name)
+        return self.inner.delete(kind, name, namespace)
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Any]:
+        self._maybe_inject("list", kind, namespace or "")
+        return self.inner.list(kind, namespace, label_selector)
+
+    # Everything else (watch, stop_watch, register_mutator, internals the
+    # CI gate inspects) passes straight through — watches never drop
+    # events: a real informer re-lists through transient failures, so
+    # modelling lossy watches would test a failure mode the client
+    # machinery already hides.
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.inner, name)

@@ -1,0 +1,46 @@
+"""Serving LB backend flapper.
+
+Marks a seeded-random live backend unhealthy — the state a backend enters
+when it dies between health checks — so tests can prove the balancer's
+failover keeps every request client-visible-error-free while backends
+flap, and that ``health_check()`` recovers flapped backends once they
+answer ``/healthz`` again.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional
+
+from kubeflow_tpu.serving.lb import ServingLoadBalancer
+from kubeflow_tpu.utils import get_logger
+
+log = get_logger("chaos-flapper")
+
+
+class BackendFlapper:
+    def __init__(self, lb: ServingLoadBalancer, *, seed: int = 0):
+        self.lb = lb
+        self.rng = random.Random(seed)
+        self.flapped: List[str] = []
+
+    def flap(self, keep_one: bool = True) -> Optional[str]:
+        """Mark one healthy, non-draining backend unhealthy; returns its
+        address. ``keep_one`` refuses to take down the last healthy
+        backend (a flap models one backend dying, not an outage —
+        pass False to chaos-test the 503 path)."""
+        live = [b["addr"] for b in self.lb.backends()
+                if b["healthy"] and not b["draining"]]
+        if not live or (keep_one and len(live) <= 1):
+            return None
+        addr = live[self.rng.randrange(len(live))]
+        self.lb.set_backend_health(addr, False, "chaos: injected flap")
+        self.flapped.append(addr)
+        log.warning("flapped backend", kv={"addr": addr})
+        return addr
+
+    def heal(self) -> int:
+        """Re-probe every backend (flapped ones recover iff their
+        /healthz really answers); returns the healthy count."""
+        self.flapped.clear()
+        return self.lb.health_check()

@@ -1,0 +1,135 @@
+"""Slice preemption injector.
+
+On TPUs the dominant failure mode is not a crashed process but a
+*reclaimed slice*: every host in one ICI domain vanishes at once
+("Exploring the limits of Concurrency in ML Training on Google TPUs"
+treats preemption-tolerant scheduling as table stakes). The preemptor
+reproduces that fault against the in-memory control plane:
+
+- every worker pod of one slice group is marked Failed with the
+  :data:`~kubeflow_tpu.controlplane.controllers.tpujob.PREEMPTION_MESSAGE`
+  marker (the TpuJob controller keys its preemption policy off it and
+  emits the corresponding pod deletions during the gang restart);
+- optionally one unit of schedulable capacity for that slice type is
+  reclaimed, so the restarted gang re-enters admission and must land on
+  *surviving* capacity — or park Pending until :meth:`restore_capacity`.
+
+Hand it the **raw** inner API server, not the chaos wrapper: the
+preemption itself models hardware, which does not fail to fail.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional
+
+from kubeflow_tpu.controlplane.controllers.tpujob import (
+    JOB_LABEL,
+    PREEMPTION_MESSAGE,
+)
+from kubeflow_tpu.controlplane.runtime import InMemoryApiServer
+from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
+
+log = get_logger("chaos-preemptor")
+
+PREEMPTIBLE_PHASES = ("Starting", "Running")
+
+
+class SlicePreemptor:
+    def __init__(
+        self,
+        api: InMemoryApiServer,
+        *,
+        seed: int = 0,
+        # The TpuJobController's capacity dict (slice_type -> schedulable
+        # slices); preemptions reclaim from it when given. Shared by
+        # reference, not copied.
+        capacity: Optional[Dict[str, int]] = None,
+        registry: MetricsRegistry = global_registry,
+    ):
+        self.api = api
+        self.rng = random.Random(seed)
+        self.capacity = capacity
+        self.total = 0                      # slices preempted so far
+        self._reclaimed: Dict[str, int] = {}
+        self.metrics_preempted = registry.counter(
+            "kftpu_chaos_preemptions_total",
+            "Slice preemptions injected",
+            labels=("slice_type",),
+        )
+
+    # ----------------- selection -----------------
+
+    def preemptible_jobs(self) -> List:
+        return [
+            j for j in self.api.list("TpuJob")
+            if j.status.phase in PREEMPTIBLE_PHASES and j.spec.preemptible
+        ]
+
+    # ----------------- injection -----------------
+
+    def preempt(self, job, slice_id: Optional[int] = None) -> int:
+        """Preempt one slice of ``job``'s gang; returns pods preempted."""
+        ns, name = job.metadata.namespace, job.metadata.name
+        pods = self.api.list("Pod", namespace=ns,
+                             label_selector={JOB_LABEL: name})
+        groups = sorted({
+            p.spec.scheduler_hints.get("slice-group", "")
+            for p in pods if p.status.phase not in ("Succeeded", "Failed")
+        })
+        if not groups:
+            return 0
+        if slice_id is None:
+            group = groups[self.rng.randrange(len(groups))]
+        else:
+            group = f"{name}-{slice_id}"
+        hit = 0
+        for p in pods:
+            if p.spec.scheduler_hints.get("slice-group", "") != group:
+                continue
+            if p.status.phase in ("Succeeded", "Failed"):
+                continue
+            p.status.phase = "Failed"
+            p.status.message = PREEMPTION_MESSAGE
+            self.api.update_status(p)
+            hit += 1
+        if hit:
+            self.total += 1
+            self._reclaim(job.spec.slice_type)
+            self.metrics_preempted.inc(slice_type=job.spec.slice_type)
+            log.warning("slice preempted", kv={
+                "job": f"{ns}/{name}", "group": group, "pods": hit,
+            })
+        return hit
+
+    def preempt_random(self) -> Optional[str]:
+        """Preempt one slice of a seeded-random running job; returns its
+        ``ns/name`` or None when nothing is preemptible."""
+        jobs = self.preemptible_jobs()
+        if not jobs:
+            return None
+        job = jobs[self.rng.randrange(len(jobs))]
+        if self.preempt(job) == 0:
+            return None
+        return f"{job.metadata.namespace}/{job.metadata.name}"
+
+    # ----------------- capacity -----------------
+
+    def _reclaim(self, slice_type: str) -> None:
+        if self.capacity is None or slice_type not in self.capacity:
+            return
+        if self.capacity[slice_type] <= 0:
+            return
+        self.capacity[slice_type] -= 1
+        self._reclaimed[slice_type] = self._reclaimed.get(slice_type, 0) + 1
+
+    def restore_capacity(self) -> Dict[str, int]:
+        """Give back every reclaimed slice (the fleet 'coming back' after
+        the preemption wave); returns what was restored."""
+        restored = dict(self._reclaimed)
+        if self.capacity is not None:
+            for st, n in self._reclaimed.items():
+                self.capacity[st] += n
+        self._reclaimed.clear()
+        return restored

@@ -1,0 +1,182 @@
+"""Seeded chaos convergence soak.
+
+One driver shared by the tier-1 chaos tests and the CI ``chaos-smoke``
+stage: reconcile a fleet of TpuJobs to completion while the chaos API
+server injects conflicts/transients into every controller write, a
+preemptor periodically takes out whole slices (reclaiming schedulable
+capacity), and then — faults stopped, capacity restored — assert the
+world converges: every job terminal, the manager idle, availability 1.0.
+
+Everything is driven through ``run_until_idle(include_timers_within=...)``
+so the soak is sleep-free and, being seeded end to end, byte-for-byte
+reproducible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from kubeflow_tpu.chaos.api import ChaosApiServer, FaultSpec
+from kubeflow_tpu.chaos.preemptor import SlicePreemptor
+from kubeflow_tpu.controlplane.api.meta import ObjectMeta
+from kubeflow_tpu.controlplane.api.types import MeshAxesSpec, TpuJob, TpuJobSpec
+from kubeflow_tpu.controlplane.controllers.podrunner import FakeKubelet
+from kubeflow_tpu.controlplane.controllers.tpujob import TpuJobController
+from kubeflow_tpu.controlplane.prober import AvailabilityProber, controller_target
+from kubeflow_tpu.controlplane.runtime import (
+    ControllerManager,
+    ExponentialBackoffLimiter,
+    InMemoryApiServer,
+)
+from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+log = get_logger("chaos-soak")
+
+TERMINAL = ("Succeeded", "Failed")
+
+
+@dataclasses.dataclass
+class SoakReport:
+    converged: bool                  # every job terminal, manager idle
+    all_succeeded: bool
+    phases: Dict[str, str]           # job name -> final phase
+    rounds: int
+    injected: Dict[str, int]         # "verb:kind:fault" -> count
+    preemptions: int                 # slices taken out
+    job_preemption_restarts: int     # sum of status.preemptions
+    retries_total: float             # sum of kftpu_*_retries_total
+    availability: float              # kftpu_availability after the soak
+
+    def stuck_jobs(self) -> Dict[str, str]:
+        return {n: p for n, p in self.phases.items() if p not in TERMINAL}
+
+
+def run_soak(
+    *,
+    num_jobs: int = 4,
+    seed: int = 0,
+    conflict_rate: float = 0.3,
+    transient_rate: float = 0.05,
+    preempt_every: int = 3,          # rounds between slice preemptions
+    fault_rounds: int = 9,           # rounds before faults stop
+    max_rounds: int = 40,
+    work_ticks: int = 2,             # kubelet outcome passes before Succeeded
+    slice_type: str = "v5e-16",
+    constrained_capacity: bool = True,
+    registry: Optional[MetricsRegistry] = None,
+) -> SoakReport:
+    registry = registry or MetricsRegistry()
+    inner = InMemoryApiServer()
+    chaos = ChaosApiServer(inner, seed=seed, registry=registry, rules={
+        "update:*": FaultSpec(conflict_rate=conflict_rate,
+                              transient_rate=transient_rate),
+        "update_status:*": FaultSpec(conflict_rate=conflict_rate,
+                                     transient_rate=transient_rate),
+        "create:*": FaultSpec(transient_rate=transient_rate),
+        "delete:*": FaultSpec(transient_rate=transient_rate),
+        "list:*": FaultSpec(transient_rate=transient_rate),
+    })
+    capacity = {slice_type: num_jobs} if constrained_capacity else None
+    mgr = ControllerManager(
+        chaos, registry,
+        limiter=ExponentialBackoffLimiter(seed=seed + 1),
+    )
+    job_ctl = TpuJobController(chaos, registry, capacity=capacity,
+                               hbm_check=False)
+    mgr.register(job_ctl)
+
+    # Deterministic workload: a worker succeeds after `work_ticks` kubelet
+    # status-sync passes observe it Running.
+    seen: Dict[str, int] = {}
+
+    def outcome(name: str) -> Optional[str]:
+        seen[name] = seen.get(name, 0) + 1
+        return "Succeeded" if seen[name] >= work_ticks else None
+
+    kubelet = FakeKubelet(chaos, registry, outcome=outcome)
+    mgr.register(kubelet)
+
+    # Preemptor and prober work against the RAW server: hardware faults
+    # and SLO measurement are not themselves subject to API chaos.
+    preemptor = SlicePreemptor(inner, seed=seed + 2, capacity=capacity,
+                               registry=registry)
+    prober = AvailabilityProber({}, registry, interval_s=1e9)
+    prober.add_target("tpujob-controller",
+                      controller_target(mgr, job_ctl), registry)
+    prober.add_target("kubelet", controller_target(mgr, kubelet), registry)
+    prober.add_target(
+        "fleet-converged",
+        lambda: all(j.status.phase in TERMINAL
+                    for j in inner.list("TpuJob")),
+        registry,
+    )
+
+    for i in range(num_jobs):
+        inner.create(TpuJob(
+            metadata=ObjectMeta(name=f"soak-{i:02d}", namespace="chaos"),
+            spec=TpuJobSpec(
+                slice_type=slice_type,
+                mesh=MeshAxesSpec(dp=-1),
+                backoff_seconds=0.0,     # no restart hold: sleep-free soak
+                max_restarts=3,
+                preemption_policy="restart",
+            ),
+        ))
+
+    # While faults fly, only fast-forward short (backoff-scale) timers —
+    # fast-forwarding the 5s admission requeue of a capacity-starved job
+    # would spin run_until_idle against a gate that cannot open yet.
+    # Once capacity is restored and faults stop, widen the window so
+    # parked admission/backoff timers all fire and the fleet drains.
+    fault_window, drain_window = 2.0, 120.0
+    rounds = 0
+    for r in range(max_rounds):
+        rounds = r + 1
+        window = fault_window if chaos.enabled else drain_window
+        mgr.run_until_idle(max_iterations=50000,
+                           include_timers_within=window)
+        kubelet.tick()
+        mgr.run_until_idle(max_iterations=50000,
+                           include_timers_within=window)
+        if chaos.enabled and preempt_every and r > 0 \
+                and r % preempt_every == 0:
+            victim = preemptor.preempt_random()
+            if victim:
+                mgr.run_until_idle(max_iterations=50000,
+                                   include_timers_within=window)
+        if chaos.enabled and rounds >= fault_rounds:
+            chaos.quiesce()
+            preemptor.restore_capacity()
+        phases = {j.metadata.name: j.status.phase
+                  for j in inner.list("TpuJob")}
+        if not chaos.enabled and all(p in TERMINAL for p in phases.values()):
+            break
+
+    phases = {j.metadata.name: j.status.phase for j in inner.list("TpuJob")}
+    converged = all(p in TERMINAL for p in phases.values()) and mgr.is_idle()
+    retries = sum(
+        v for name, _, v in registry.snapshot()
+        if name.endswith("_retries_total")
+    )
+    availability = 1.0 if prober.probe() else 0.0
+    report = SoakReport(
+        converged=converged,
+        all_succeeded=all(p == "Succeeded" for p in phases.values()),
+        phases=phases,
+        rounds=rounds,
+        injected=dict(chaos.injected),
+        preemptions=preemptor.total,
+        job_preemption_restarts=sum(
+            j.status.preemptions for j in inner.list("TpuJob")
+        ),
+        retries_total=retries,
+        availability=availability,
+    )
+    log.info("soak done", kv={
+        "converged": converged, "rounds": rounds,
+        "injected": sum(report.injected.values()),
+        "preemptions": report.preemptions,
+    })
+    return report

@@ -64,6 +64,11 @@ class TpuJobSpec:
     # Failure policy
     max_restarts: int = 3
     backoff_seconds: float = 10.0
+    # What a slice preemption does to the gang: "restart" reschedules onto
+    # surviving capacity WITHOUT consuming the max_restarts budget (the
+    # preemption isn't the job's fault — VirtualFlow-style decoupling of
+    # job from hardware); "fail" terminates the job on first preemption.
+    preemption_policy: str = "restart"  # restart | fail
     # Scheduling
     priority: int = 0
     preemptible: bool = True
@@ -74,6 +79,9 @@ class TpuJobStatus:
     phase: str = "Pending"  # Pending|Scheduling|Starting|Running|Restarting|Succeeded|Failed
     conditions: List[Condition] = dataclasses.field(default_factory=list)
     restarts: int = 0
+    # Gang restarts caused by slice preemption — tracked separately from
+    # ``restarts`` because they do not consume the max_restarts budget.
+    preemptions: int = 0
     # Final metrics reported by worker-0 via its termination message
     # (the K8s terminationMessagePath channel; consumed by the StudyJob
     # controller as the trial objective).

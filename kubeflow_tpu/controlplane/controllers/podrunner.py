@@ -26,6 +26,7 @@ import tempfile
 from typing import Any, Callable, Dict, List, Optional
 
 from kubeflow_tpu.controlplane.runtime import (
+    ApiError,
     Controller,
     InMemoryApiServer,
     Result,
@@ -66,9 +67,18 @@ class FakeKubelet(Controller):
     def tick(self) -> None:
         """Simulate a kubelet status-sync pass: re-reconcile every pod (the
         outcome script may have changed). Tests call this, then drain the
-        manager to propagate the resulting watch events."""
-        for pod in self.api.list("Pod"):
-            self.reconcile(pod.metadata.namespace, pod.metadata.name)
+        manager to propagate the resulting watch events. Per-pod API errors
+        (conflicts/transients under chaos injection) are swallowed — a real
+        kubelet's status sync just retries next pass."""
+        try:
+            pods = self.api.list("Pod")
+        except ApiError:
+            return  # status sync skipped this pass; next tick retries
+        for pod in pods:
+            try:
+                self.reconcile(pod.metadata.namespace, pod.metadata.name)
+            except ApiError:
+                continue
 
     def reconcile(self, namespace: str, name: str) -> Result:
         pod = self.api.try_get("Pod", name, namespace)

@@ -47,6 +47,7 @@ from kubeflow_tpu.controlplane.runtime import (
     Controller,
     EventRecorder,
     InMemoryApiServer,
+    NotFoundError,
     Result,
     create_or_update,
 )
@@ -59,6 +60,12 @@ log = get_logger("tpujob")
 
 JOB_LABEL = "tpu.kubeflow.org/job-name"
 REPLICA_LABEL = "tpu.kubeflow.org/replica-index"
+
+# Pod status.message marker a slice preemption stamps on its victims
+# (written by chaos.SlicePreemptor and, in a cluster deployment, by the
+# node-event relay). The controller keys its restart-vs-fail policy and
+# budget accounting off this marker.
+PREEMPTION_MESSAGE = "preempted: TPU slice reclaimed"
 
 
 class TpuJobController(Controller):
@@ -254,6 +261,9 @@ class TpuJobController(Controller):
         name = job.metadata.name
         mesh_json = json.dumps(plan.axes.as_dict())
         slice_id = index // st.num_hosts
+        # Failure AND preemption restarts bump the gang generation — both
+        # must invalidate the previous generation's pods.
+        generation = job.status.restarts + job.status.preemptions
         env = [
             EnvVar("KFTPU_COORDINATOR_ADDRESS", coordinator),
             EnvVar("KFTPU_NUM_PROCESSES", str(n_hosts)),
@@ -263,7 +273,7 @@ class TpuJobController(Controller):
             EnvVar("KFTPU_ATTN_IMPL", job.spec.attn_impl),
             EnvVar("KFTPU_MODEL", job.spec.model),
             EnvVar("KFTPU_CHECKPOINT_DIR", job.spec.checkpoint_dir),
-            EnvVar("KFTPU_RESTART_COUNT", str(job.status.restarts)),
+            EnvVar("KFTPU_RESTART_COUNT", str(generation)),
         ]
         if job.spec.trace_dir:
             env.append(EnvVar("KFTPU_TRACE_DIR", job.spec.trace_dir))
@@ -296,7 +306,7 @@ class TpuJobController(Controller):
                 labels={
                     JOB_LABEL: name,
                     REPLICA_LABEL: str(index),
-                    "restart-generation": str(job.status.restarts),
+                    "restart-generation": str(generation),
                 },
                 owner_references=[self._owner_ref(job)],
             ),
@@ -315,11 +325,21 @@ class TpuJobController(Controller):
 
     @staticmethod
     def _pod_copy(live: Pod, want: Pod) -> bool:
-        """Pods are mostly immutable; only re-label (restart-generation is
-        how a gang restart invalidates old pods)."""
+        """Pods are mostly immutable; only re-label — EXCEPT
+        restart-generation, which is the pod's identity: it records which
+        gang generation created the pod and is how a resumed teardown
+        tells survivors of the old generation from freshly recreated
+        workers. Overwriting it here let a recreate pass that raced an
+        interrupted teardown relabel old-generation Running workers as
+        current, silently downgrading the all-or-nothing gang restart to
+        a single-pod restart."""
         changed = False
-        if live.metadata.labels != want.metadata.labels:
-            live.metadata.labels = want.metadata.labels
+        want_labels = dict(want.metadata.labels)
+        gen = live.metadata.labels.get("restart-generation")
+        if gen is not None:
+            want_labels["restart-generation"] = gen
+        if live.metadata.labels != want_labels:
+            live.metadata.labels = want_labels
             changed = True
         return changed
 
@@ -361,14 +381,45 @@ class TpuJobController(Controller):
 
         requeue: Optional[float] = None
         if n_failed > 0:
-            if job.status.restarts < job.spec.max_restarts:
-                # Gang restart: tear down every worker; next reconcile
-                # recreates them with a bumped restart-generation. Workers
-                # auto-resume from spec.checkpoint_dir (train.CheckpointService
-                # restore-latest contract).
+            # Per-pod classification: only marker-carrying failures are
+            # preemptions. A genuine worker crash that coincides with a
+            # slice preemption must still consume the restart budget —
+            # any() over the gang would launder crashes as preemptions.
+            n_preempted = sum(
+                1 for p in pods
+                if p.status.phase == "Failed"
+                and p.status.message == PREEMPTION_MESSAGE
+            )
+            crash_failures = n_failed - n_preempted
+            if job.status.phase == "Restarting":
+                # Restart accounting already committed; a previous
+                # teardown was interrupted — finish it without
+                # re-counting (idempotent re-entry).
+                return self._teardown_gang(job, pods, stale_only=True)
+            if crash_failures == 0 and job.spec.preemption_policy == "fail":
+                job.status.phase = "Failed"
+                job.status.completion_time = time.time()
+                self.recorder.event(
+                    job, "Warning", "JobFailed",
+                    "slice preempted and preemption_policy=fail",
+                )
+            elif crash_failures == 0:
+                # Preemption is not the job's fault: reschedule onto
+                # surviving capacity without consuming the max_restarts
+                # budget (the gang re-enters admission, so a reclaimed
+                # slice parks it Pending until capacity returns).
+                job.status.preemptions += 1
+                self._commit_restart_status(job)
+                self.metrics_restarts.inc(reason="preempted")
+                self.recorder.event(
+                    job, "Warning", "SlicePreempted",
+                    f"slice preempted; reschedule {job.status.preemptions}, "
+                    f"resuming from {job.spec.checkpoint_dir or 'scratch'}",
+                )
+                return self._teardown_gang(job, pods)
+            elif job.status.restarts < job.spec.max_restarts:
                 job.status.restarts += 1
-                job.status.phase = "Restarting"
-                job.status.last_restart_time = time.time()
+                self._commit_restart_status(job)
                 self.metrics_restarts.inc(reason="worker-failed")
                 self.recorder.event(
                     job, "Warning", "GangRestart",
@@ -376,9 +427,7 @@ class TpuJobController(Controller):
                     f"{job.spec.max_restarts}, resuming from "
                     f"{job.spec.checkpoint_dir or 'scratch'}",
                 )
-                for p in pods:
-                    self.api.delete("Pod", p.metadata.name, p.metadata.namespace)
-                requeue = job.spec.backoff_seconds
+                return self._teardown_gang(job, pods)
             else:
                 job.status.phase = "Failed"
                 job.status.completion_time = time.time()
@@ -424,6 +473,42 @@ class TpuJobController(Controller):
         if job.status != prev_status:
             self.api.update_status(job)
         return Result(requeue_after=requeue)
+
+    def _commit_restart_status(self, job: TpuJob) -> None:
+        """Persist the restart accounting BEFORE any pod is torn down: a
+        conflicting status write then requeues with the world untouched,
+        while a teardown interrupted AFTER the commit re-enters through
+        the idempotent phase=='Restarting' path without re-counting.
+        (Committing after deletion lost the restarts/preemptions bump
+        whenever the write failed — a crash-looping job whose status
+        writes kept conflicting could restart past max_restarts.)"""
+        job.status.phase = "Restarting"
+        job.status.last_restart_time = time.time()
+        self.api.update_status(job)
+
+    def _teardown_gang(self, job: TpuJob, pods, *,
+                       stale_only: bool = False) -> Result:
+        """Tear down workers; the next reconcile recreates them with a
+        bumped restart generation. Workers auto-resume from
+        spec.checkpoint_dir (train.CheckpointService restore-latest
+        contract). ``stale_only`` (the resumed-teardown path) spares pods
+        of the current generation that a recreate pass already made."""
+        generation = str(job.status.restarts + job.status.preemptions)
+        if stale_only:
+            pods = [
+                p for p in pods
+                if p.status.phase == "Failed"
+                or p.metadata.labels.get("restart-generation") != generation
+            ]
+        # Delete the Failed pods LAST: if a transient API error interrupts
+        # the teardown mid-way, the retry still sees the failure evidence
+        # and resumes the restart instead of quietly backfilling the gang.
+        for p in sorted(pods, key=lambda p: p.status.phase == "Failed"):
+            try:
+                self.api.delete("Pod", p.metadata.name, p.metadata.namespace)
+            except NotFoundError:
+                pass  # raced with cascade GC — already gone
+        return Result(requeue_after=job.spec.backoff_seconds)
 
     def _fail_invalid(self, job: TpuJob, msg: str,
                       reason: str = "InvalidTopology") -> Result:

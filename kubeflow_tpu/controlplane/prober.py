@@ -85,6 +85,9 @@ class AvailabilityProber:
     ):
         self.targets = dict(targets)
         self.interval_s = interval_s
+        # Guards targets/_gauges: add_target runs on caller threads while
+        # the background loop iterates in probe().
+        self._targets_lock = threading.Lock()
         self._gauges = {
             name: registry.gauge(
                 f"kftpu_component_up_{name.replace('-', '_')}",
@@ -103,22 +106,29 @@ class AvailabilityProber:
 
     def add_target(self, name: str, probe: ProbeFn,
                    registry: MetricsRegistry = global_registry) -> None:
-        self.targets[name] = probe
-        self._gauges[name] = registry.gauge(
+        gauge = registry.gauge(
             f"kftpu_component_up_{name.replace('-', '_')}",
             f"1 when the {name} probe passes",
         )
+        with self._targets_lock:
+            self.targets[name] = probe
+            self._gauges[name] = gauge
 
     def probe(self) -> bool:
         """One probe pass over every target. Returns overall availability."""
         ok = True
-        for name, fn in self.targets.items():
+        with self._targets_lock:
+            # Snapshot: add_target mutates targets while this loop runs on
+            # the background thread; iterating the live dict raced.
+            items = list(self.targets.items())
+            gauges = dict(self._gauges)
+        for name, fn in items:
             try:
                 up = bool(fn())
             except Exception as e:  # noqa: BLE001 — a probe must not kill the loop
                 log.error("probe raised", kv={"target": name, "err": repr(e)})
                 up = False
-            self._gauges[name].set(1.0 if up else 0.0)
+            gauges[name].set(1.0 if up else 0.0)
             if not up:
                 ok = False
         self.availability.set(1.0 if ok else 0.0)

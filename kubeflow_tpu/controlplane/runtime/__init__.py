@@ -5,6 +5,9 @@ from kubeflow_tpu.controlplane.runtime.apiserver import (
     NotFoundError,
     WatchEvent,
 )
+from kubeflow_tpu.controlplane.runtime.ratelimiter import (
+    ExponentialBackoffLimiter,
+)
 from kubeflow_tpu.controlplane.runtime.reconciler import (
     Controller,
     ControllerManager,
@@ -16,6 +19,7 @@ from kubeflow_tpu.controlplane.runtime.events import EventRecorder
 __all__ = [
     "ApiError",
     "ConflictError",
+    "ExponentialBackoffLimiter",
     "InMemoryApiServer",
     "NotFoundError",
     "WatchEvent",

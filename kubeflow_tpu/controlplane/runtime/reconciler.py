@@ -10,17 +10,22 @@ provides metrics and heartbeat for free (SURVEY.md §7.2).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import heapq
 import threading
 import time
 import traceback
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kubeflow_tpu.controlplane.runtime.apiserver import (
     ConflictError,
     InMemoryApiServer,
     NotFoundError,
+)
+from kubeflow_tpu.controlplane.runtime.ratelimiter import (
+    ExponentialBackoffLimiter,
 )
 from kubeflow_tpu.utils import get_logger
 from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
@@ -51,6 +56,11 @@ class Controller:
             f"Reconcile outcomes for {self.NAME}",
             labels=("result",),
         )
+        self.metrics_retries = registry.counter(
+            f"kftpu_{self.NAME}_retries_total",
+            f"Requeues after failed reconciles for {self.NAME}",
+            labels=("reason",),
+        )
         self.heartbeat = registry.heartbeat(self.NAME)
 
     # -- override points --
@@ -79,17 +89,61 @@ class ControllerManager:
       long-running services.
     """
 
-    def __init__(self, api: InMemoryApiServer):
+    #: Consecutive conflicts on one key retried immediately (the standard
+    #: informer dance: re-read, re-apply). Beyond this the key is fighting
+    #: another writer — fall back to the exponential limiter so a conflict
+    #: storm can't spin the queue hot.
+    CONFLICT_IMMEDIATE_RETRIES = 5
+
+    def __init__(
+        self,
+        api: InMemoryApiServer,
+        registry: MetricsRegistry = global_registry,
+        *,
+        limiter: Optional[ExponentialBackoffLimiter] = None,
+    ):
         self.api = api
         self.controllers: List[Controller] = []
+        self.limiter = limiter or ExponentialBackoffLimiter()
         self._queues: List[Any] = []
-        self._pending: List[Tuple[Controller, Tuple[str, str]]] = []
+        # deque + set mirror: O(1) at both ends — chaos-scale event storms
+        # made the old list's membership scans and pop(0) quadratic.
+        self._pending: "collections.deque[Tuple[Controller, Tuple[str, str]]]" = \
+            collections.deque()
+        self._pending_set: set = set()
         self._timers: List[Tuple[float, int, Controller, Tuple[str, str]]] = []
         self._timer_seq = 0
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._lock = threading.Lock()
         self.log = get_logger("manager")
+        # Queue-health gauges (client-go workqueue_depth analogues). On a
+        # shared registry the first manager's callbacks win, matching the
+        # one-manager-per-process deployment shape; the weakref keeps that
+        # first-wins registration from pinning a discarded manager alive.
+        wref = weakref.ref(self)
+
+        def _of_manager(attr_len: Callable[["ControllerManager"], float]):
+            def read() -> float:
+                m = wref()
+                return attr_len(m) if m is not None else 0.0
+            return read
+
+        registry.gauge(
+            "kftpu_workqueue_depth",
+            "Reconcile keys waiting in the immediate work queue",
+            fn=_of_manager(lambda m: float(len(m._pending))),
+        )
+        registry.gauge(
+            "kftpu_workqueue_backoff_pending",
+            "Reconcile keys parked on requeue/backoff timers",
+            fn=_of_manager(lambda m: float(len(m._timers))),
+        )
+        registry.gauge(
+            "kftpu_workqueue_failing_keys",
+            "Keys with a nonzero failure count in the backoff limiter",
+            fn=_of_manager(lambda m: float(m.limiter.tracked_keys())),
+        )
 
     def register(self, ctl: Controller) -> None:
         self.controllers.append(ctl)
@@ -113,18 +167,21 @@ class ControllerManager:
                     self._enqueue(ctl, key)
         return n
 
+    def _pending_add_locked(self, ctl: Controller, key: Tuple[str, str]) -> None:
+        if (ctl, key) not in self._pending_set:
+            self._pending_set.add((ctl, key))
+            self._pending.append((ctl, key))
+
     def _enqueue(self, ctl: Controller, key: Tuple[str, str]) -> None:
         with self._lock:
-            if (ctl, key) not in self._pending:
-                self._pending.append((ctl, key))
+            self._pending_add_locked(ctl, key)
 
     def _due_timers(self) -> None:
         now = time.time()
         with self._lock:
             while self._timers and self._timers[0][0] <= now:
                 _, _, ctl, key = heapq.heappop(self._timers)
-                if (ctl, key) not in self._pending:
-                    self._pending.append((ctl, key))
+                self._pending_add_locked(ctl, key)
 
     def _schedule(self, ctl: Controller, key: Tuple[str, str], after: float) -> None:
         with self._lock:
@@ -137,24 +194,41 @@ class ControllerManager:
         with self._lock:
             if not self._pending:
                 return False
-            ctl, key = self._pending.pop(0)
+            ctl, key = self._pending.popleft()
+            self._pending_set.discard((ctl, key))
+        lkey = (ctl.NAME, key)
         try:
             res = ctl.reconcile(*key) or Result()
             ctl.metrics_reconcile.inc(result="ok")
+            self.limiter.forget(lkey)
             if res.requeue_after is not None:
                 self._schedule(ctl, key, res.requeue_after)
         except ConflictError:
-            # Stale read: immediate requeue, the standard informer dance.
+            # Stale read: immediate requeue (re-read, re-apply — the
+            # standard informer dance) while the conflicts look transient;
+            # a key that keeps losing the write race backs off instead.
             ctl.metrics_reconcile.inc(result="conflict")
-            self._enqueue(ctl, key)
+            ctl.metrics_retries.inc(reason="conflict")
+            delay = self.limiter.next_delay(lkey)
+            if self.limiter.failures(lkey) <= self.CONFLICT_IMMEDIATE_RETRIES:
+                self._enqueue(ctl, key)
+            else:
+                self._schedule(ctl, key, delay)
         except NotFoundError:
+            # A NotFound from arbitrary API calls mid-reconcile is a race
+            # (dependent deleted under us, injected fault), not proof the
+            # primary is gone — retry with backoff; if the primary really
+            # was deleted the next pass exits cleanly via try_get.
             ctl.metrics_reconcile.inc(result="gone")
+            ctl.metrics_retries.inc(reason="not_found")
+            self._schedule(ctl, key, self.limiter.next_delay(lkey))
         except Exception:
             ctl.metrics_reconcile.inc(result="error")
+            ctl.metrics_retries.inc(reason="error")
             ctl.log.error(
                 f"reconcile {key} failed:\n{traceback.format_exc()}"
             )
-            self._schedule(ctl, key, 1.0)
+            self._schedule(ctl, key, self.limiter.next_delay(lkey))
         ctl.heartbeat.beat()
         return True
 
@@ -182,8 +256,7 @@ class ControllerManager:
                         self._timers[0][0] - time.time() <= include_timers_within
                     ):
                         _, _, ctl, key = heapq.heappop(self._timers)
-                        if (ctl, key) not in self._pending:
-                            self._pending.append((ctl, key))
+                        self._pending_add_locked(ctl, key)
             if not self._process_one():
                 if self._drain_watches() == 0:
                     return done

@@ -28,6 +28,7 @@ that dispatches on live per-replica load:
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 import urllib.error
@@ -81,10 +82,16 @@ class ServingLoadBalancer:
         connect_timeout_s: float = 5.0,
         request_timeout_s: float = 300.0,
         health_timeout_s: float = 2.0,
+        retry_after_s: Optional[float] = None,
     ):
         self.connect_timeout_s = connect_timeout_s
         self.request_timeout_s = request_timeout_s
         self.health_timeout_s = health_timeout_s
+        # Retry-After on "no healthy backend" 503s: how long until the
+        # next health-check pass could recover a backend. ServingLBServer
+        # derives it from its sync interval; standalone use defaults to
+        # the health probe timeout.
+        self.retry_after_s = retry_after_s
         self._backends: Dict[str, Backend] = {}
         self._lock = threading.Lock()
         if backends:
@@ -125,12 +132,22 @@ class ServingLoadBalancer:
 
     # ------------- dispatch -------------
 
+    def _retry_after(self) -> str:
+        """Retry-After seconds (integer, >= 1) derived from the
+        health-check cadence — clients back off for one recovery window
+        instead of hammering."""
+        interval = self.retry_after_s
+        if interval is None:
+            interval = self.health_timeout_s
+        return str(max(1, int(math.ceil(interval))))
+
     def _acquire(self) -> Backend:
         with self._lock:
             live = [b for b in self._backends.values()
                     if b.healthy and not b.draining]
             if not live:
-                raise RestError(503, "no healthy serving backend")
+                raise RestError(503, "no healthy serving backend",
+                                headers={"Retry-After": self._retry_after()})
             b = min(live, key=lambda b: b.in_flight)
             b.in_flight += 1
             b.requests_total += 1
@@ -148,6 +165,19 @@ class ServingLoadBalancer:
             b.healthy = False
             b.last_error = err
         log.warning("backend unhealthy", kv={"addr": b.addr, "err": err})
+
+    def set_backend_health(self, addr: str, healthy: bool,
+                           err: str = "") -> bool:
+        """Flip one backend's health by address (the chaos BackendFlapper
+        hook; health_check() re-probes and recovers it). Returns False if
+        the address is not in the dispatch set."""
+        with self._lock:
+            b = self._backends.get(addr)
+            if b is None:
+                return False
+            b.healthy = healthy
+            b.last_error = "" if healthy else (err or "chaos: injected flap")
+        return True
 
     def health_check(self) -> int:
         """Probe every backend's /healthz; flips healthy both ways.
@@ -288,6 +318,9 @@ class ServingLBServer:
     ):
         self.lb = lb
         self.sync_interval_s = sync_interval_s
+        if lb.retry_after_s is None:
+            # One health-check cycle is the soonest a 503 could recover.
+            lb.retry_after_s = sync_interval_s
         self._api, self._ns, self._name = api, namespace, name
         self._http = JsonHttpServer(lb.router(), host=host, port=port)
         self.port = self._http.port

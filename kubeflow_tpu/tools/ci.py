@@ -15,7 +15,11 @@ Stages (any failure exits non-zero — the merge gate contract):
    (testing/kfctl/kfctl_second_apply.py:12-24).
 4. **smoke**: run a TpuJob through the FakeKubelet to completion — the
    in-process analogue of the reference's tf-cnn smoke job.
-5. **bench-gate**: if --bench-json is given, require
+5. **chaos-smoke**: the seeded chaos soak (kubeflow_tpu.chaos.run_soak)
+   with a fixed round budget — injected conflicts/transients plus slice
+   preemption; fails when any TpuJob is stuck in a non-terminal phase,
+   the manager won't go idle, or availability doesn't recover to 1.
+6. **bench-gate**: if --bench-json is given, require
    ``vs_baseline >= --min-vs-baseline`` for every record — the perf
    regression gate SURVEY §7.8 prescribes.
 """
@@ -45,8 +49,33 @@ def _stage(name: str):
     print(f"[ci] {name} ...", flush=True)
 
 
+def run_chaos_smoke(seed: int = 20260803) -> None:
+    """Seeded soak with a fixed budget; raises GateFailure on any job
+    stuck non-terminal, a non-idle manager, or degraded availability."""
+    from kubeflow_tpu.chaos import run_soak
+
+    rep = run_soak(num_jobs=4, seed=seed, conflict_rate=0.3,
+                   transient_rate=0.05, preempt_every=3, fault_rounds=9,
+                   max_rounds=40)
+    if not rep.converged:
+        raise GateFailure(
+            f"chaos smoke (seed={seed}): stuck jobs after {rep.rounds} "
+            f"rounds: {rep.stuck_jobs()}"
+        )
+    if not rep.all_succeeded:
+        raise GateFailure(
+            f"chaos smoke (seed={seed}): jobs failed: {rep.phases}"
+        )
+    if rep.availability != 1.0:
+        raise GateFailure(
+            f"chaos smoke (seed={seed}): availability "
+            f"{rep.availability} != 1.0 after faults stopped"
+        )
+
+
 def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
-             skip_smoke: bool = False) -> List[str]:
+             skip_smoke: bool = False, skip_chaos: bool = False,
+             chaos_seed: int = 20260803) -> List[str]:
     """Run all stages; returns the list of passed stages, raises
     GateFailure on the first failing one."""
     passed: List[str] = []
@@ -114,6 +143,11 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
                               f"({job.status.worker_states})")
         passed.append("smoke")
 
+    if not skip_chaos:
+        _stage("chaos-smoke")
+        run_chaos_smoke(seed=chaos_seed)
+        passed.append("chaos-smoke")
+
     if bench_json:
         _stage("bench-gate")
         with open(bench_json) as f:
@@ -143,12 +177,17 @@ def main(argv=None) -> int:
                    help="JSONL of bench records to gate on vs_baseline")
     g.add_argument("--min-vs-baseline", type=float, default=0.9)
     g.add_argument("--skip-smoke", action="store_true")
+    g.add_argument("--skip-chaos", action="store_true")
+    g.add_argument("--chaos-seed", type=int, default=20260803,
+                   help="seed for the chaos-smoke soak (reproducibility)")
     args = p.parse_args(argv)
     try:
         passed = run_gate(
             bench_json=args.bench_json,
             min_vs_baseline=args.min_vs_baseline,
             skip_smoke=args.skip_smoke,
+            skip_chaos=args.skip_chaos,
+            chaos_seed=args.chaos_seed,
         )
     except GateFailure as e:
         print(f"[ci] FAIL: {e}", file=sys.stderr)

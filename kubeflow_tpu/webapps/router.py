@@ -24,9 +24,13 @@ log = get_logger("webapps")
 
 
 class RestError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 headers: Optional[Dict[str, str]] = None):
         super().__init__(message)
         self.status = status
+        # Extra response headers (e.g. Retry-After on a 503 so clients
+        # back off instead of hammering a backendless balancer).
+        self.headers = dict(headers or {})
 
 
 class Html(str):
@@ -171,19 +175,22 @@ class JsonHttpServer:
                     caller=self.headers.get(hdr, ""),
                     headers={k.lower(): v for k, v in self.headers.items()},
                 )
+                extra_headers: Dict[str, str] = {}
                 try:
                     status, payload = rt.dispatch(req)
                 except RestError as e:
                     status, payload = e.status, {"error": str(e)}
+                    extra_headers = e.headers
                 except KeyError as e:
                     status, payload = 400, {"error": f"missing field {e}"}
                 except Exception as e:  # surface, don't kill the thread
                     log.error("handler error", kv={"path": url.path,
                                                    "err": repr(e)})
                     status, payload = 500, {"error": "internal error"}
-                self._send(status, payload)
+                self._send(status, payload, extra_headers)
 
-            def _send(self, status: int, payload: Any) -> None:
+            def _send(self, status: int, payload: Any,
+                      extra_headers: Optional[Dict[str, str]] = None) -> None:
                 if isinstance(payload, NdjsonStream):
                     # HTTP/1.0 clients cannot parse chunked transfer
                     # coding: stream to them close-delimited (raw NDJSON,
@@ -220,6 +227,8 @@ class JsonHttpServer:
                 else:
                     ctype, data = "application/json", json.dumps(payload).encode()
                 self.send_response(status)
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
                 if (300 <= status < 400 and isinstance(payload, dict)
                         and "location" in payload):
                     self.send_header("Location", payload["location"])

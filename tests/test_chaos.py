@@ -1,0 +1,487 @@
+"""Chaos-injection layer + backoff workqueue (kubeflow_tpu.chaos).
+
+Everything here is seeded and sleep-free: faults are a pure function of
+(seed, call sequence), and all waiting is fast-forwarded through
+``run_until_idle(include_timers_within=...)``.
+"""
+
+import pytest
+
+from kubeflow_tpu.chaos import (
+    ChaosApiServer,
+    FaultSpec,
+    SlicePreemptor,
+    TransientApiError,
+    run_soak,
+)
+from kubeflow_tpu.controlplane.api import ObjectMeta, TpuJob, TpuJobSpec
+from kubeflow_tpu.controlplane.api.types import MeshAxesSpec
+from kubeflow_tpu.controlplane.controllers import FakeKubelet, TpuJobController
+from kubeflow_tpu.controlplane.controllers.tpujob import (
+    JOB_LABEL,
+    PREEMPTION_MESSAGE,
+)
+from kubeflow_tpu.controlplane.runtime import (
+    ConflictError,
+    Controller,
+    ControllerManager,
+    ExponentialBackoffLimiter,
+    InMemoryApiServer,
+    NotFoundError,
+    Result,
+)
+from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+
+def _job(name="train", ns="chaos", **spec_kw):
+    spec_kw.setdefault("backoff_seconds", 0.0)
+    return TpuJob(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=TpuJobSpec(slice_type="v5e-16", mesh=MeshAxesSpec(dp=-1),
+                        **spec_kw),
+    )
+
+
+# --------------------------------------------------------------------------
+# Exponential backoff limiter
+# --------------------------------------------------------------------------
+
+class TestBackoffLimiter:
+    def test_exact_doubling_without_jitter(self):
+        lim = ExponentialBackoffLimiter(base_delay=0.01, max_delay=1.0,
+                                        jitter=0.0)
+        delays = [lim.next_delay("k") for _ in range(10)]
+        assert delays[:7] == [0.01 * 2 ** i for i in range(7)]
+        assert delays[7] == delays[8] == delays[9] == 1.0  # capped
+
+    def test_monotone_jittered_capped(self):
+        """Property: delays are in [raw*(1-j), raw], monotone
+        non-decreasing until the cap, and never exceed the cap."""
+        base, cap, j = 0.05, 5.0, 0.2
+        lim = ExponentialBackoffLimiter(base_delay=base, max_delay=cap,
+                                        jitter=j, seed=7)
+        delays = [lim.next_delay("k") for _ in range(16)]
+        raws = [min(base * 2 ** i, cap) for i in range(16)]
+        for d, raw in zip(delays, raws):
+            assert raw * (1 - j) <= d <= raw
+        pre_cap = sum(1 for r in raws if r < cap)
+        for i in range(pre_cap):
+            assert delays[i + 1] >= delays[i], (i, delays)
+        assert max(delays) <= cap
+
+    def test_reset_on_success(self):
+        lim = ExponentialBackoffLimiter(base_delay=0.01, max_delay=1.0,
+                                        jitter=0.0)
+        for _ in range(5):
+            lim.next_delay("k")
+        assert lim.failures("k") == 5
+        assert lim.tracked_keys() == 1
+        lim.forget("k")
+        assert lim.failures("k") == 0
+        assert lim.tracked_keys() == 0
+        assert lim.next_delay("k") == 0.01  # back at the base band
+
+    def test_per_key_isolation(self):
+        lim = ExponentialBackoffLimiter(base_delay=0.01, max_delay=1.0,
+                                        jitter=0.0)
+        for _ in range(6):
+            lim.next_delay("hot")
+        assert lim.next_delay("cold") == 0.01
+
+    def test_deterministic_given_seed(self):
+        mk = lambda: ExponentialBackoffLimiter(seed=42)  # noqa: E731
+        a, b = mk(), mk()
+        assert [a.next_delay("k") for _ in range(12)] == \
+               [b.next_delay("k") for _ in range(12)]
+
+    def test_jitter_validation(self):
+        with pytest.raises(ValueError, match="jitter"):
+            ExponentialBackoffLimiter(jitter=0.8)
+
+
+# --------------------------------------------------------------------------
+# Workqueue backoff semantics in the manager
+# --------------------------------------------------------------------------
+
+class _Scripted(Controller):
+    """Raises the scripted exceptions in order, then reconciles clean."""
+
+    NAME = "scripted"
+    WATCH_KINDS = ("TpuJob",)
+
+    def __init__(self, api, registry, script):
+        super().__init__(api, registry)
+        self.script = list(script)
+        self.clean_reconciles = 0
+
+    def reconcile(self, namespace, name):
+        if self.script:
+            raise self.script.pop(0)
+        self.clean_reconciles += 1
+        return Result()
+
+
+class _RecordingLimiter(ExponentialBackoffLimiter):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.delays = []
+
+    def next_delay(self, key):
+        d = super().next_delay(key)
+        self.delays.append(d)
+        return d
+
+
+def _scripted_world(script, *, limiter=None):
+    api = InMemoryApiServer()
+    reg = MetricsRegistry()
+    limiter = limiter or _RecordingLimiter(
+        base_delay=0.001, max_delay=0.1, jitter=0.0)
+    mgr = ControllerManager(api, reg, limiter=limiter)
+    ctl = _Scripted(api, reg, script)
+    mgr.register(ctl)
+    return api, mgr, ctl, limiter
+
+
+class TestWorkqueueBackoff:
+    def test_error_backoff_grows_then_resets(self):
+        api, mgr, ctl, lim = _scripted_world(
+            [RuntimeError("boom")] * 3)
+        api.create(_job())
+        mgr.run_until_idle(include_timers_within=5.0)
+        assert ctl.clean_reconciles >= 1
+        assert ctl.metrics_retries.value(reason="error") == 3
+        # Exponential growth, then failure count forgotten on success.
+        assert lim.delays == [0.001, 0.002, 0.004]
+        assert lim.failures(("scripted", ("chaos", "train"))) == 0
+
+    def test_not_found_is_retried_not_dropped(self):
+        """A NotFound raised mid-reconcile (dependent race / injected
+        fault) must requeue with backoff — the old kernel dropped the key
+        as 'gone' and the object was never reconciled again."""
+        api, mgr, ctl, _ = _scripted_world(
+            [NotFoundError("injected"), NotFoundError("injected")])
+        api.create(_job())
+        mgr.run_until_idle(include_timers_within=5.0)
+        assert ctl.clean_reconciles >= 1
+        assert ctl.metrics_retries.value(reason="not_found") == 2
+
+    def test_conflict_storm_backs_off_instead_of_spinning(self):
+        """Transient conflicts requeue immediately (informer dance); a key
+        that KEEPS losing the write race is parked on a backoff timer
+        instead of spinning the queue hot."""
+        api, mgr, ctl, _ = _scripted_world([ConflictError("stale")] * 50)
+        api.create(_job())
+        grace = ControllerManager.CONFLICT_IMMEDIATE_RETRIES
+        # Without the backoff fallback this would burn all 50 conflicts as
+        # immediate requeues; with it the key parks after the grace burst.
+        done = mgr.run_until_idle(max_iterations=30)
+        assert done == grace + 1
+        assert ctl.metrics_retries.value(reason="conflict") == grace + 1
+        # The parked key resumes from the timer and eventually succeeds.
+        mgr.run_until_idle(include_timers_within=60.0)
+        assert ctl.clean_reconciles >= 1
+
+    def test_queue_gauges_exported(self):
+        api = InMemoryApiServer()
+        reg = MetricsRegistry()
+        mgr = ControllerManager(api, reg)
+        rendered = reg.render()
+        assert "kftpu_workqueue_depth" in rendered
+        assert "kftpu_workqueue_backoff_pending" in rendered
+        assert "kftpu_workqueue_failing_keys" in rendered
+        assert mgr.is_idle()
+
+    def test_retry_metrics_per_controller(self):
+        api, mgr, ctl, _ = _scripted_world([RuntimeError("x")])
+        api.create(_job())
+        mgr.run_until_idle(include_timers_within=5.0)
+        reg_lines = ctl.metrics_retries.render()
+        assert any("kftpu_scripted_retries_total" in l for l in reg_lines)
+
+
+# --------------------------------------------------------------------------
+# Chaos API server
+# --------------------------------------------------------------------------
+
+def _driven_ops(chaos):
+    """A fixed op sequence hammered against a chaos server; returns the
+    outcome tally. Ops that fault are swallowed — the tally IS the fault
+    record."""
+    outcomes = []
+    for i in range(60):
+        try:
+            chaos.create(_job(name=f"j{i:02d}"))
+            outcomes.append("create-ok")
+        except Exception as e:  # noqa: BLE001
+            outcomes.append(type(e).__name__)
+    for i in range(60):
+        try:
+            j = chaos.inner.get("TpuJob", f"j{i:02d}", "chaos")
+            j.spec.max_restarts = i
+            chaos.update(j)
+            outcomes.append("update-ok")
+        except Exception as e:  # noqa: BLE001
+            outcomes.append(type(e).__name__)
+    return outcomes
+
+
+class TestChaosApiServer:
+    RULES = {
+        "update:*": FaultSpec(conflict_rate=0.3, transient_rate=0.1),
+        "create:*": FaultSpec(transient_rate=0.2),
+    }
+
+    def test_seeded_faults_are_reproducible(self):
+        runs = []
+        for _ in range(2):
+            chaos = ChaosApiServer(InMemoryApiServer(), seed=11,
+                                   rules=dict(self.RULES),
+                                   registry=MetricsRegistry())
+            runs.append((_driven_ops(chaos), dict(chaos.injected)))
+        assert runs[0] == runs[1]
+        assert runs[0][1]  # something was actually injected
+
+    def test_different_seeds_differ(self):
+        tallies = []
+        for seed in (1, 2):
+            chaos = ChaosApiServer(InMemoryApiServer(), seed=seed,
+                                   rules=dict(self.RULES),
+                                   registry=MetricsRegistry())
+            tallies.append(_driven_ops(chaos))
+        assert tallies[0] != tallies[1]
+
+    def test_verb_banding(self):
+        """Conflicts only hit updates; not-founds only reads/deletes;
+        try_get (the informer-cache read) is never injected."""
+        chaos = ChaosApiServer(
+            InMemoryApiServer(), seed=0,
+            rules={"*:*": FaultSpec(conflict_rate=0.5, not_found_rate=0.5)},
+            registry=MetricsRegistry(),
+        )
+        job = chaos.inner.create(_job())
+        for _ in range(20):
+            assert chaos.try_get("TpuJob", "train", "chaos") is not None
+        with pytest.raises(NotFoundError, match="chaos"):
+            for _ in range(50):
+                chaos.get("TpuJob", "train", "chaos")
+        with pytest.raises(ConflictError, match="chaos"):
+            for _ in range(50):
+                job = chaos.inner.get("TpuJob", "train", "chaos")
+                chaos.update(job)
+        assert all(not k.startswith("create") for k in chaos.injected)
+
+    def test_quiesce_and_resume(self):
+        chaos = ChaosApiServer(
+            InMemoryApiServer(), seed=0,
+            rules={"create:*": FaultSpec(transient_rate=1.0)},
+            registry=MetricsRegistry(),
+        )
+        chaos.quiesce()
+        chaos.create(_job())          # no fault while quiesced
+        chaos.resume()
+        with pytest.raises(TransientApiError):
+            chaos.create(_job(name="other"))
+
+    def test_rule_specificity(self):
+        chaos = ChaosApiServer(
+            InMemoryApiServer(), seed=0,
+            rules={
+                "*:*": FaultSpec(transient_rate=1.0),
+                "create:TpuJob": FaultSpec(),   # exact rule wins: no faults
+            },
+            registry=MetricsRegistry(),
+        )
+        chaos.create(_job())  # does not raise
+
+    def test_rates_validation(self):
+        with pytest.raises(ValueError, match="sum"):
+            FaultSpec(conflict_rate=0.7, transient_rate=0.7)
+
+
+# --------------------------------------------------------------------------
+# Slice preemption + restart policy (no API chaos: deterministic)
+# --------------------------------------------------------------------------
+
+def _gang_world(*, capacity=None, outcome=None):
+    api = InMemoryApiServer()
+    reg = MetricsRegistry()
+    mgr = ControllerManager(api, reg)
+    ctl = TpuJobController(api, reg, capacity=capacity, hbm_check=False)
+    mgr.register(ctl)
+    kubelet = FakeKubelet(api, reg, outcome=outcome)
+    mgr.register(kubelet)
+    return api, reg, mgr, ctl, kubelet
+
+
+class TestSlicePreemption:
+    def test_preemption_restarts_without_consuming_budget(self):
+        api, reg, mgr, ctl, _ = _gang_world()
+        api.create(_job(max_restarts=2))
+        mgr.run_until_idle()
+        job = api.get("TpuJob", "train", "chaos")
+        assert job.status.phase == "Running"
+
+        pre = SlicePreemptor(api, seed=3, registry=reg)
+        assert pre.preempt(job) > 0
+        mgr.run_until_idle(include_timers_within=60.0)
+
+        job = api.get("TpuJob", "train", "chaos")
+        assert job.status.phase == "Running"       # rescheduled
+        assert job.status.preemptions == 1
+        assert job.status.restarts == 0            # budget untouched
+        # The new gang carries a bumped restart generation.
+        pods = api.list("Pod", namespace="chaos",
+                        label_selector={JOB_LABEL: "train"})
+        assert pods and all(
+            p.metadata.labels["restart-generation"] == "1" for p in pods
+        )
+        assert ctl.metrics_restarts.value(reason="preempted") == 1
+
+    def test_preemption_policy_fail(self):
+        api, reg, mgr, _, _ = _gang_world()
+        api.create(_job(preemption_policy="fail"))
+        mgr.run_until_idle()
+        pre = SlicePreemptor(api, seed=3, registry=reg)
+        pre.preempt(api.get("TpuJob", "train", "chaos"))
+        mgr.run_until_idle(include_timers_within=60.0)
+        job = api.get("TpuJob", "train", "chaos")
+        assert job.status.phase == "Failed"
+        assert job.status.preemptions == 0
+
+    def test_worker_failure_still_consumes_budget(self):
+        """A plain worker crash (no preemption marker) keeps the original
+        max_restarts accounting."""
+        api, reg, mgr, _, kubelet = _gang_world()
+        api.create(_job(max_restarts=2))
+        mgr.run_until_idle()
+        pod = api.list("Pod", namespace="chaos")[0]
+        pod.status.phase = "Failed"
+        pod.status.message = "exit code 137"
+        api.update_status(pod)
+        mgr.run_until_idle(include_timers_within=60.0)
+        job = api.get("TpuJob", "train", "chaos")
+        assert job.status.restarts == 1
+        assert job.status.preemptions == 0
+
+    def test_capacity_reclaim_parks_job_until_restore(self):
+        capacity = {"v5e-16": 1}
+        api, reg, mgr, _, _ = _gang_world(capacity=capacity)
+        api.create(_job())
+        mgr.run_until_idle()
+        job = api.get("TpuJob", "train", "chaos")
+        assert job.status.phase == "Running"
+
+        pre = SlicePreemptor(api, seed=0, capacity=capacity, registry=reg)
+        pre.preempt(job)
+        assert capacity["v5e-16"] == 0             # slice reclaimed
+        mgr.run_until_idle()
+        job = api.get("TpuJob", "train", "chaos")
+        assert job.status.phase == "Pending"        # parked: no capacity
+        cond = {c.type: c for c in job.status.conditions}["Admitted"]
+        assert cond.reason == "InsufficientCapacity"
+
+        assert pre.restore_capacity() == {"v5e-16": 1}
+        mgr.run_until_idle(include_timers_within=10.0)
+        job = api.get("TpuJob", "train", "chaos")
+        assert job.status.phase == "Running"        # rescheduled
+        assert job.status.preemptions == 1
+
+    def test_interrupted_teardown_still_restarts_whole_gang(self):
+        """A transient API error mid-teardown (after the restart commit)
+        must not downgrade the all-or-nothing gang restart: the retried
+        reconcile has to tear down the SURVIVING old-generation workers
+        too, even though the recreate pass already ran over them."""
+
+        class OneShotDeleteFail:
+            """Fails exactly the first delete, then passes through."""
+
+            def __init__(self, inner):
+                self.inner = inner
+                self.fails_left = 1
+
+            def delete(self, *a, **kw):
+                if self.fails_left:
+                    self.fails_left -= 1
+                    raise TransientApiError("injected: teardown interrupted")
+                return self.inner.delete(*a, **kw)
+
+            def __getattr__(self, name):
+                return getattr(self.inner, name)
+
+        inner = InMemoryApiServer()
+        flaky = OneShotDeleteFail(inner)
+        reg = MetricsRegistry()
+        mgr = ControllerManager(flaky, reg)
+        mgr.register(TpuJobController(flaky, reg, hbm_check=False))
+        mgr.register(FakeKubelet(inner, reg))
+        inner.create(_job(max_restarts=2))
+        mgr.run_until_idle()
+        assert inner.get("TpuJob", "train", "chaos").status.phase == "Running"
+
+        pod = inner.list("Pod", namespace="chaos")[0]
+        pod.status.phase = "Failed"
+        pod.status.message = "exit code 137"
+        inner.update_status(pod)
+        mgr.run_until_idle(include_timers_within=60.0)
+
+        assert flaky.fails_left == 0               # the fault actually fired
+        job = inner.get("TpuJob", "train", "chaos")
+        assert job.status.phase == "Running"
+        assert job.status.restarts == 1
+        pods = inner.list("Pod", namespace="chaos",
+                          label_selector={JOB_LABEL: "train"})
+        assert len(pods) == 4
+        # EVERY worker is generation 1 — no old-generation survivor kept
+        # running past the restart.
+        for p in pods:
+            assert p.metadata.labels["restart-generation"] == "1", \
+                p.metadata.name
+            env = {e.name: e.value for e in p.spec.containers[0].env}
+            assert env["KFTPU_RESTART_COUNT"] == "1", p.metadata.name
+
+    def test_preempt_random_skips_terminal_jobs(self):
+        api, reg, mgr, _, _ = _gang_world()
+        pre = SlicePreemptor(api, seed=0, registry=reg)
+        assert pre.preempt_random() is None         # empty world
+        api.create(_job())
+        mgr.run_until_idle()
+        assert pre.preempt_random() == "chaos/train"
+
+    def test_preemption_marker_is_the_contract(self):
+        api, reg, mgr, _, _ = _gang_world()
+        api.create(_job())
+        mgr.run_until_idle()
+        pre = SlicePreemptor(api, seed=0, registry=reg)
+        pre.preempt(api.get("TpuJob", "train", "chaos"), slice_id=0)
+        failed = [p for p in api.list("Pod", namespace="chaos")
+                  if p.status.phase == "Failed"]
+        assert failed
+        assert all(p.status.message == PREEMPTION_MESSAGE for p in failed)
+
+
+# --------------------------------------------------------------------------
+# The full seeded soak (the CI chaos-smoke contract)
+# --------------------------------------------------------------------------
+
+class TestChaosSoak:
+    def test_soak_converges_under_conflicts_and_preemption(self):
+        rep = run_soak(num_jobs=4, seed=20260803)
+        assert rep.converged, rep.stuck_jobs()
+        assert rep.all_succeeded, rep.phases
+        assert rep.availability == 1.0
+        assert rep.retries_total > 0
+        assert any(k.endswith(":conflict") for k in rep.injected)
+        assert rep.preemptions >= 1
+
+    def test_soak_other_seed(self):
+        rep = run_soak(num_jobs=3, seed=7, conflict_rate=0.3,
+                       transient_rate=0.08)
+        assert rep.converged, rep.stuck_jobs()
+        assert rep.all_succeeded, rep.phases
+        assert rep.availability == 1.0
+
+    def test_ci_chaos_smoke_stage(self):
+        from kubeflow_tpu.tools.ci import run_chaos_smoke
+
+        run_chaos_smoke(seed=20260803)  # raises GateFailure on failure

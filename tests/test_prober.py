@@ -53,6 +53,35 @@ class TestProber:
         assert prober.probe() is False
         srv.shutdown()
 
+    def test_add_target_races_probe_loop(self):
+        """add_target mutates the target dict while probe() iterates it on
+        the background thread; without the snapshot+lock this raised
+        'dictionary changed size during iteration' and killed the loop."""
+        reg = MetricsRegistry()
+        prober = AvailabilityProber({"seed": lambda: True}, reg)
+        stop = threading.Event()
+        errors = []
+
+        def register_many():
+            try:
+                for i in range(300):
+                    prober.add_target(f"t{i}", lambda: True, reg)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            finally:
+                stop.set()
+
+        t = threading.Thread(target=register_many)
+        t.start()
+        try:
+            while not stop.is_set():
+                assert prober.probe() is True
+        finally:
+            t.join(timeout=10)
+        assert not errors
+        assert prober.probe() is True
+        assert "kftpu_component_up_t299" in reg.render()
+
     def test_heartbeat_target_staleness(self):
         reg = MetricsRegistry()
         hb = reg.heartbeat("testctl")

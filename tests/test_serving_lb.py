@@ -265,6 +265,64 @@ class TestHealthAndDrain:
             srv.stop()
 
 
+class TestChaosFlapsAndRetryAfter:
+    def test_flapped_backend_is_invisible_to_clients(self, backends):
+        """BackendFlapper takes a backend down between health checks; every
+        request still succeeds via the survivor — zero client-visible
+        errors — and health_check() recovers the flapped backend."""
+        from kubeflow_tpu.chaos import BackendFlapper
+
+        b0, b1 = backends
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        url = f"http://127.0.0.1:{srv.port}/v1/generate"
+        try:
+            flapper = BackendFlapper(lb, seed=5)
+            served = []
+            for i in range(12):
+                if i % 4 == 1:
+                    assert flapper.flap() is not None
+                if i % 4 == 3:
+                    assert flapper.heal() == 2   # /healthz still answers
+                out = json.load(_post(url, {"tokens": [1]}))
+                served.append(out["backend"])
+            assert len(served) == 12             # no request ever failed
+            assert {"b0", "b1"} >= set(served)
+        finally:
+            srv.stop()
+
+    def test_flapper_keeps_last_backend(self, backends):
+        from kubeflow_tpu.chaos import BackendFlapper
+
+        b0, _ = backends
+        lb = ServingLoadBalancer([b0.addr])
+        flapper = BackendFlapper(lb, seed=0)
+        assert flapper.flap() is None            # refuses a full outage
+        assert flapper.flap(keep_one=False) == b0.addr
+
+    def test_503_carries_retry_after(self):
+        """A backendless balancer tells clients when to come back instead
+        of letting them hammer: Retry-After derives from the health-check
+        interval."""
+        lb = ServingLoadBalancer([], retry_after_s=7.3)
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"http://127.0.0.1:{srv.port}/v1/generate",
+                      {"tokens": [1]})
+            assert ei.value.code == 503
+            assert ei.value.headers["Retry-After"] == "8"  # ceil(7.3)
+        finally:
+            srv.stop()
+
+    def test_retry_after_defaults_to_sync_interval(self):
+        lb = ServingLoadBalancer([])
+        ServingLBServer(lb, sync_interval_s=4.0).stop()
+        assert lb.retry_after_s == 4.0
+        lb2 = ServingLoadBalancer([])
+        assert lb2._retry_after() == "2"  # health_timeout_s fallback
+
+
 class TestLBMain:
     def test_entrypoint_with_static_backends(self, backends):
         """`python -m kubeflow_tpu.serving.lb --backends ...` as a
