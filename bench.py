@@ -6,6 +6,7 @@ flagship Llama training-throughput bench the driver runs every round.
   python bench.py resnet       # config 1: ResNet-50 images/s/chip
   python bench.py mixtral      # config 3: MoE train tokens/s/chip
   python bench.py hpo          # config 4: in-process sweep trials/hour
+  python bench.py controlplane # reconciles/s + copy-counter O(matches) proof
 
 Each invocation prints ONE JSON line:
 {"metric", "value", "unit", "vs_baseline", ...extras}.
@@ -34,6 +35,7 @@ BASELINES = {
     "serving_mixtral": 0.0,  # tokens/s/chip generated, MoE family
     "hpo": 0.0,            # trials/hour (shared-compile in-process sweep)
     "hpo_platform": 0.0,   # trials/hour through StudyJob->TpuJob->gang
+    "controlplane": 0.0,   # reconciles/s, N-job sweep to convergence
 }
 
 # Config-3 arch (350M-active MoE, one v5e chip): shared by the mixtral
@@ -677,6 +679,37 @@ def bench_hpo_platform(args) -> None:
     )
 
 
+def bench_controlplane(args) -> None:
+    """Control-plane throughput (ISSUE 3's headline): N TpuJobs x 4-host
+    gangs driven to Succeeded through the reconciler kernel against the
+    indexed, copy-light apiserver. No JAX involved — this measures the
+    coordination layer (the wall of arxiv 2011.03641), and proves the
+    O(matches) list contract with a deterministic copy counter rather
+    than wall-clock."""
+    from kubeflow_tpu.controlplane.benchmark import run_controlplane_sweep
+
+    jobs = args.requests or 1000
+    rep = run_controlplane_sweep(num_jobs=jobs,
+                                 num_namespaces=args.namespaces)
+    # Hard gates (raise, not assert: python -O must not skip them).
+    if not rep.all_succeeded:
+        raise SystemExit(f"sweep did not converge: {rep.phases}")
+    # The counter-based acceptance gate: a namespaced list copies
+    # O(matches) objects, not O(store).
+    if not rep.copies_scale_with_matches:
+        raise SystemExit(
+            f"list({rep.probe_namespace}) copied {rep.list_copies} objects "
+            f"for {rep.list_matches} matches in a {rep.store_objects}-object "
+            "store — the indexed/copy-light read path regressed to O(store)"
+        )
+    _emit(
+        "controlplane_sweep_reconciles_per_sec",
+        rep.reconciles_per_sec, "reconciles/s",
+        BASELINES["controlplane"],
+        **rep.summary(),
+    )
+
+
 def bench_longctx(args) -> None:
     """Long-context variant of config 2 on ONE chip. Defaults encode the
     MEASURED per-length recipe (BASELINE.md context ladder, 2k→64k):
@@ -860,7 +893,7 @@ def main() -> None:
     p.add_argument("which", nargs="?", default="train",
                    choices=["train", "serving", "serving8b", "resnet",
                             "vit", "mixtral", "hpo", "hpo-platform",
-                            "longctx", "sp-crossover"])
+                            "controlplane", "longctx", "sp-crossover"])
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)
     # Default is per-bench (train 12, serving 16, resnet 256, vit 64,
@@ -870,7 +903,11 @@ def main() -> None:
     p.add_argument("--attn", default="flash",
                    choices=["full", "flash", "ring", "ulysses"])
     p.add_argument("--requests", type=int, default=None,
-                   help="serving requests (default 48) / hpo trials (16)")
+                   help="serving requests (default 48) / hpo trials (16) "
+                        "/ controlplane jobs (1000)")
+    p.add_argument("--namespaces", type=int, default=20,
+                   help="controlplane bench: namespaces the job fleet is "
+                        "spread across (exercises the per-ns index)")
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--gen-len", type=int, default=128)
     p.add_argument("--decode-chunk", type=int, default=32)
@@ -951,6 +988,7 @@ def main() -> None:
         "mixtral": bench_mixtral,
         "hpo": bench_hpo,
         "hpo-platform": bench_hpo_platform,
+        "controlplane": bench_controlplane,
         "longctx": bench_longctx,
         "sp-crossover": bench_sp_crossover,
     }[args.which](args)

@@ -167,13 +167,15 @@ class ChaosApiServer:
         self._maybe_inject("create", obj.kind, obj.metadata.name)
         return self.inner.create(obj)
 
-    def get(self, kind: str, name: str, namespace: str = "") -> Any:
+    def get(self, kind: str, name: str, namespace: str = "", *,
+            copy: bool = True) -> Any:
         self._maybe_inject("get", kind, name)
-        return self.inner.get(kind, name, namespace)
+        return self.inner.get(kind, name, namespace, copy=copy)
 
-    def try_get(self, kind: str, name: str, namespace: str = "") -> Optional[Any]:
+    def try_get(self, kind: str, name: str, namespace: str = "", *,
+                copy: bool = True) -> Optional[Any]:
         # Informer-cache read: never injected (see module docstring).
-        return self.inner.try_get(kind, name, namespace)
+        return self.inner.try_get(kind, name, namespace, copy=copy)
 
     def update(self, obj: Any) -> Any:
         self._maybe_inject("update", obj.kind, obj.metadata.name)
@@ -192,9 +194,11 @@ class ChaosApiServer:
         kind: str,
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
+        *,
+        copy: bool = True,
     ) -> List[Any]:
         self._maybe_inject("list", kind, namespace or "")
-        return self.inner.list(kind, namespace, label_selector)
+        return self.inner.list(kind, namespace, label_selector, copy=copy)
 
     # Everything else (watch, stop_watch, register_mutator, internals the
     # CI gate inspects) passes straight through — watches never drop

@@ -63,7 +63,7 @@ class SlicePreemptor:
 
     def preemptible_jobs(self) -> List:
         return [
-            j for j in self.api.list("TpuJob")
+            j for j in self.api.list("TpuJob", copy=False)
             if j.status.phase in PREEMPTIBLE_PHASES and j.spec.preemptible
         ]
 

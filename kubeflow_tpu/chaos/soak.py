@@ -62,22 +62,43 @@ def run_soak(
     preempt_every: int = 3,          # rounds between slice preemptions
     fault_rounds: int = 9,           # rounds before faults stop
     max_rounds: int = 40,
-    work_ticks: int = 2,             # kubelet outcome passes before Succeeded
+    # Kubelet outcome passes before a worker Succeeds. High enough that the
+    # fleet is still Running through the first preemption window
+    # (preempt_every): with the informer cache serving controller reads,
+    # reconcile sweeps stopped stumbling over injected list faults and a
+    # too-short workload would finish before any slice could be preempted.
+    work_ticks: int = 6,
     slice_type: str = "v5e-16",
     constrained_capacity: bool = True,
+    latency_s: float = 0.0,          # per-verb injected API latency
     registry: Optional[MetricsRegistry] = None,
 ) -> SoakReport:
     registry = registry or MetricsRegistry()
     inner = InMemoryApiServer()
-    chaos = ChaosApiServer(inner, seed=seed, registry=registry, rules={
+    # ``latency_s`` models a slow apiserver on every chaos-visible verb —
+    # the tier-1 latency soak profile (docs/chaos.md): backoff timers and
+    # informer-cache reads must converge, not deadlock, under slow APIs.
+    rules = {
         "update:*": FaultSpec(conflict_rate=conflict_rate,
-                              transient_rate=transient_rate),
+                              transient_rate=transient_rate,
+                              latency_s=latency_s),
         "update_status:*": FaultSpec(conflict_rate=conflict_rate,
-                                     transient_rate=transient_rate),
-        "create:*": FaultSpec(transient_rate=transient_rate),
-        "delete:*": FaultSpec(transient_rate=transient_rate),
-        "list:*": FaultSpec(transient_rate=transient_rate),
-    })
+                                     transient_rate=transient_rate,
+                                     latency_s=latency_s),
+        "create:*": FaultSpec(transient_rate=transient_rate,
+                              latency_s=latency_s),
+        "delete:*": FaultSpec(transient_rate=transient_rate,
+                              latency_s=latency_s),
+        "list:*": FaultSpec(transient_rate=transient_rate,
+                            latency_s=latency_s),
+    }
+    if latency_s > 0:
+        # A latency-only get rule: gets stay fault-free but slow. Installed
+        # only when asked — a rule consumes one RNG roll per call, so adding
+        # it unconditionally would shift the fault sequence of every
+        # existing seed.
+        rules["get:*"] = FaultSpec(latency_s=latency_s)
+    chaos = ChaosApiServer(inner, seed=seed, registry=registry, rules=rules)
     capacity = {slice_type: num_jobs} if constrained_capacity else None
     mgr = ControllerManager(
         chaos, registry,
@@ -109,7 +130,7 @@ def run_soak(
     prober.add_target(
         "fleet-converged",
         lambda: all(j.status.phase in TERMINAL
-                    for j in inner.list("TpuJob")),
+                    for j in inner.list("TpuJob", copy=False)),
         registry,
     )
 
@@ -150,17 +171,19 @@ def run_soak(
             chaos.quiesce()
             preemptor.restore_capacity()
         phases = {j.metadata.name: j.status.phase
-                  for j in inner.list("TpuJob")}
+                  for j in inner.list("TpuJob", copy=False)}
         if not chaos.enabled and all(p in TERMINAL for p in phases.values()):
             break
 
-    phases = {j.metadata.name: j.status.phase for j in inner.list("TpuJob")}
+    phases = {j.metadata.name: j.status.phase
+              for j in inner.list("TpuJob", copy=False)}
     converged = all(p in TERMINAL for p in phases.values()) and mgr.is_idle()
     retries = sum(
         v for name, _, v in registry.snapshot()
         if name.endswith("_retries_total")
     )
     availability = 1.0 if prober.probe() else 0.0
+    mgr.close()     # release the soak's watch queues (throwaway manager)
     report = SoakReport(
         converged=converged,
         all_succeeded=all(p == "Succeeded" for p in phases.values()),
@@ -169,7 +192,7 @@ def run_soak(
         injected=dict(chaos.injected),
         preemptions=preemptor.total,
         job_preemption_restarts=sum(
-            j.status.preemptions for j in inner.list("TpuJob")
+            j.status.preemptions for j in inner.list("TpuJob", copy=False)
         ),
         retries_total=retries,
         availability=availability,

@@ -40,7 +40,7 @@ def list_checkpoints(api, namespace: str) -> List[Dict[str, Any]]:
     job's name (what the spawner shows and NotebookSpec.checkpoint
     stores)."""
     out = []
-    for job in api.list("TpuJob", namespace=namespace):
+    for job in api.list("TpuJob", namespace=namespace, copy=False):
         d = job.spec.checkpoint_dir
         if not d:
             continue

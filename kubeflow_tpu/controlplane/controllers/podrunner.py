@@ -71,7 +71,9 @@ class FakeKubelet(Controller):
         (conflicts/transients under chaos injection) are swallowed — a real
         kubelet's status sync just retries next pass."""
         try:
-            pods = self.api.list("Pod")
+            # Zero-copy read: only names are taken here; reconcile()
+            # re-reads each pod as a private copy before mutating status.
+            pods = self.reader.list("Pod", copy=False)
         except ApiError:
             return  # status sync skipped this pass; next tick retries
         for pod in pods:
@@ -81,10 +83,16 @@ class FakeKubelet(Controller):
                 continue
 
     def reconcile(self, namespace: str, name: str) -> Result:
-        pod = self.api.try_get("Pod", name, namespace)
+        # Zero-copy peek first: most passes observe a pod that needs no
+        # transition (Running with no outcome, terminal). Only an actual
+        # phase change pays the private-copy read before mutating.
+        pod = self.api.try_get("Pod", name, namespace, copy=False)
         if pod is None:
             return Result()
         if pod.status.phase == "Pending" and self.auto_run:
+            pod = self.api.try_get("Pod", name, namespace)
+            if pod is None or pod.status.phase != "Pending":
+                return Result()
             pod.status.phase = "Running"
             pod.status.pod_ip = f"10.0.0.{abs(hash(name)) % 250 + 1}"
             pod.status.node_name = f"node-{abs(hash(name)) % 16}"
@@ -93,6 +101,9 @@ class FakeKubelet(Controller):
         if pod.status.phase == "Running" and self.outcome is not None:
             term = self.outcome(name)
             if term in ("Succeeded", "Failed"):
+                pod = self.api.try_get("Pod", name, namespace)
+                if pod is None or pod.status.phase != "Running":
+                    return Result()
                 pod.status.phase = term
                 if self.termination is not None:
                     pod.status.termination_message = self.termination(pod)

@@ -205,7 +205,8 @@ class ServingController(Controller):
         )
         self._sync_status(sv)
         self.metrics_ready.set(float(sum(
-            1 for s in self.api.list("Serving") if s.status.ready
+            1 for s in self.reader.list("Serving", copy=False)
+            if s.status.ready
         )))
         return Result(requeue_after=requeue)
 

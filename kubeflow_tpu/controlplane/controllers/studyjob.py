@@ -87,9 +87,10 @@ class StudyJobController(Controller):
 
         jobs = {
             j.metadata.labels.get(TRIAL_INDEX_LABEL, ""): j
-            for j in self.api.list(
+            for j in self.reader.list(
                 "TpuJob", namespace=namespace,
                 label_selector={STUDY_LABEL: name},
+                copy=False,
             )
         }
 

@@ -206,12 +206,16 @@ class TpuJobController(Controller):
         chips = st.num_chips * job.spec.num_slices
         # Per-namespace TPU chip quota from ResourceQuota (emitted by the
         # profile controller from Profile.spec.tpu_chip_quota).
-        for rq in self.api.list("ResourceQuota", namespace=job.metadata.namespace):
+        for rq in self.reader.list("ResourceQuota",
+                                   namespace=job.metadata.namespace,
+                                   copy=False):
             hard = int(rq.hard.get("google.com/tpu", "0") or 0)
             if hard <= 0:
                 continue
             used = 0
-            for other in self.api.list("TpuJob", namespace=job.metadata.namespace):
+            for other in self.reader.list("TpuJob",
+                                          namespace=job.metadata.namespace,
+                                          copy=False):
                 if other.metadata.name == job.metadata.name:
                     continue
                 if other.status.phase in (
@@ -234,7 +238,7 @@ class TpuJobController(Controller):
             cap = self.capacity.get(job.spec.slice_type, 0)
             in_use = sum(
                 o.spec.num_slices
-                for o in self.api.list("TpuJob")
+                for o in self.reader.list("TpuJob", copy=False)
                 if o.metadata.uid != job.metadata.uid
                 and o.spec.slice_type == job.spec.slice_type
                 and o.status.phase in (
@@ -348,9 +352,12 @@ class TpuJobController(Controller):
     def _update_status(self, job: TpuJob, n_hosts: int, coordinator: str) -> Result:
         import copy
 
-        pods = self.api.list(
+        # Informer-cache read, zero-copy: pods are only *read* here (and
+        # deleted by name in _teardown_gang) — never mutated in place.
+        pods = self.reader.list(
             "Pod", namespace=job.metadata.namespace,
             label_selector={JOB_LABEL: job.metadata.name},
+            copy=False,
         )
         states = {p.metadata.name: p.status.phase for p in pods}
         prev_status = copy.deepcopy(job.status)

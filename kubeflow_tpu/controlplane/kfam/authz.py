@@ -27,7 +27,8 @@ class SubjectAccessReviewer:
 
     def roles_for(self, user: str, namespace: str) -> List[str]:
         roles = []
-        for rb in self.api.list("RoleBinding", namespace=namespace):
+        for rb in self.api.list("RoleBinding", namespace=namespace,
+                                copy=False):
             if any(s.kind == "User" and s.name == user for s in rb.subjects):
                 roles.append(rb.role_ref.name)
         return roles
@@ -40,7 +41,7 @@ class SubjectAccessReviewer:
 
     def is_cluster_admin(self, user: str) -> bool:
         # Cluster admins are recorded as a label on their Profile.
-        for p in self.api.list("Profile"):
+        for p in self.api.list("Profile", copy=False):
             if (
                 p.spec.owner == user
                 and p.metadata.labels.get("cluster-admin") == "true"

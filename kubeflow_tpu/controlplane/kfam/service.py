@@ -126,7 +126,8 @@ class AccessManagement:
         self.requests.inc(op="delete-profile", result="ok")
 
     def profile_exists(self, user: str) -> bool:
-        return any(p.spec.owner == user for p in self.api.list("Profile"))
+        return any(p.spec.owner == user
+                   for p in self.api.list("Profile", copy=False))
 
     # ------------- contributor bindings -------------
 
@@ -142,7 +143,8 @@ class AccessManagement:
         """Locate the RoleBinding for (user, role, namespace) by its
         annotations, so grants created under older naming schemes stay
         manageable after upgrades."""
-        for rb in self.api.list("RoleBinding", namespace=b.namespace):
+        for rb in self.api.list("RoleBinding", namespace=b.namespace,
+                                copy=False):
             if (rb.metadata.annotations.get("user") == b.user
                     and rb.metadata.annotations.get("role") == b.role):
                 return rb
@@ -205,7 +207,8 @@ class AccessManagement:
     ) -> List[Binding]:
         self.heartbeat.beat()
         out = []
-        for rb in self.api.list("RoleBinding", namespace=namespace):
+        for rb in self.api.list("RoleBinding", namespace=namespace,
+                                copy=False):
             u = rb.metadata.annotations.get("user")
             r = rb.metadata.annotations.get("role")
             if not u or not r:
@@ -216,7 +219,7 @@ class AccessManagement:
                 continue
             out.append(Binding(user=u, namespace=rb.metadata.namespace, role=r))
         # Owners are implicit admins of their profile namespaces.
-        for p in self.api.list("Profile"):
+        for p in self.api.list("Profile", copy=False):
             if user is not None and p.spec.owner != user:
                 continue
             if namespace is not None and p.metadata.name != namespace:

@@ -329,12 +329,7 @@ class Platform:
         from kubeflow_tpu.controlplane.api.serde import from_dict as _fd
 
         for data in resources:
-            obj = object_from_dict(data)
-            key = (obj.kind,
-                   "" if obj.kind in ("Namespace", "Profile", "PlatformConfig")
-                   else obj.metadata.namespace,
-                   obj.metadata.name)
-            platform.api._objects[key] = obj
+            platform.api.load_snapshot(object_from_dict(data))
         platform.api._rv = int(meta.get("resourceVersionCounter", 0))
         # Re-start components per stored PlatformConfig.
         pcs = platform.api.list("PlatformConfig")

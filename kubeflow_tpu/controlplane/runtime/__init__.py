@@ -9,6 +9,7 @@ from kubeflow_tpu.controlplane.runtime.ratelimiter import (
     ExponentialBackoffLimiter,
 )
 from kubeflow_tpu.controlplane.runtime.reconciler import (
+    CachedReader,
     Controller,
     ControllerManager,
     Result,
@@ -23,6 +24,7 @@ __all__ = [
     "InMemoryApiServer",
     "NotFoundError",
     "WatchEvent",
+    "CachedReader",
     "Controller",
     "ControllerManager",
     "Result",

@@ -19,18 +19,44 @@ Semantics implemented (the subset the reference's controllers rely on):
   (how STS->pods and job->pods cleanup behaves for the reference).
 - label-selector list; namespaced and cluster-scoped kinds.
 - watch: per-subscriber queues receiving ADDED/MODIFIED/DELETED events.
+
+Scaling model (docs/controlplane-perf.md): the store keeps **canonical
+immutable snapshots**. Every write deep-copies the inbound object once and
+*replaces* the stored snapshot — a snapshot, once stored, is never edited
+in place. That invariant is what makes the read path cheap:
+
+- ``get``/``try_get``/``list`` default to ``copy=True`` (a private,
+  mutate-then-update-able copy — the read-modify-write idiom every
+  controller write loop uses), but read-only callers pass ``copy=False``
+  and receive the shared snapshot with **zero** copying.
+- ``list`` resolves through per-kind / per-(kind, namespace) secondary
+  indexes, so its cost — and, with ``copy=True``, its copy count — scales
+  with the number of *matching* objects, never with store size.
+- watch events share one event object (and the stored snapshot) across
+  all subscribers; late-watcher replay reuses the stored snapshots too.
+- ``_cascade_delete`` resolves dependents through an owner-uid index,
+  breadth-first, instead of re-scanning the whole store per level.
+
+Zero-copy results are read-only by contract (exactly client-go's shared
+informer cache contract). Read-path copies are tallied per verb in
+``self.copied`` and exported as
+``kftpu_apiserver_objects_copied_total{verb}`` so benches and the CI
+``cp-bench-smoke`` stage can assert the O(matches) property by counting,
+not timing.
 """
 
 from __future__ import annotations
 
-import copy
+import collections
 import dataclasses
 import queue
 import threading
 import time
+from copy import deepcopy
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from kubeflow_tpu.controlplane.api.meta import fresh_identity
+from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
 
 CLUSTER_SCOPED = {"Namespace", "Profile", "PlatformConfig"}
 
@@ -66,15 +92,82 @@ def _key(obj: Any) -> Key:
     return (kind, ns, obj.metadata.name)
 
 
+def match_labels(obj: Any, selector: Optional[Dict[str, str]]) -> bool:
+    """The list() label-selector predicate, shared with CachedReader so the
+    informer cache cannot drift from the server's matching semantics."""
+    if not selector:
+        return True
+    labels = obj.metadata.labels
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def _sorted_objs(objs: List[Any]) -> List[Any]:
+    return sorted(objs, key=lambda o: (o.metadata.namespace, o.metadata.name))
+
+
+def index_put(by_kind: Dict[str, Dict[Key, Any]],
+              by_kind_ns: Dict[Tuple[str, str], Dict[Key, Any]],
+              key: Key, obj: Any) -> None:
+    """Insert into the kind / (kind, namespace) index pair. Shared with
+    CachedReader so the two index implementations cannot drift."""
+    by_kind.setdefault(key[0], {})[key] = obj
+    by_kind_ns.setdefault(key[:2], {})[key] = obj
+
+
+def list_bucket(by_kind: Dict[str, Dict[Key, Any]],
+                by_kind_ns: Dict[Tuple[str, str], Dict[Key, Any]],
+                kind: str, namespace: Optional[str],
+                label_selector: Optional[Dict[str, str]]) -> List[Any]:
+    """Resolve a list() query against the index pair: pick the bucket,
+    apply the selector. One implementation shared by the server and the
+    informer cache so their answers cannot drift. Callers hold their own
+    lock and sort/copy the result themselves."""
+    if namespace is None or kind in CLUSTER_SCOPED:
+        bucket = by_kind.get(kind, {})
+    else:
+        bucket = by_kind_ns.get((kind, namespace), {})
+    return [obj for obj in bucket.values()
+            if match_labels(obj, label_selector)]
+
+
+def index_drop(by_kind: Dict[str, Dict[Key, Any]],
+               by_kind_ns: Dict[Tuple[str, str], Dict[Key, Any]],
+               key: Key) -> None:
+    """Remove from the index pair, pruning buckets that empty out (a
+    long-lived store/cache must not accumulate one dead dict per kind or
+    namespace ever seen)."""
+    for mapping, mkey in ((by_kind, key[0]), (by_kind_ns, key[:2])):
+        bucket = mapping.get(mkey)
+        if bucket is not None:
+            bucket.pop(key, None)
+            if not bucket:
+                del mapping[mkey]
+
+
 class InMemoryApiServer:
-    def __init__(self) -> None:
+    def __init__(self, registry: MetricsRegistry = global_registry) -> None:
         self._objects: Dict[Key, Any] = {}
+        # Secondary indexes (all under self._lock, all holding the same
+        # snapshot references as self._objects — replaced together on
+        # every write):
+        self._by_kind: Dict[str, Dict[Key, Any]] = {}
+        self._by_kind_ns: Dict[Tuple[str, str], Dict[Key, Any]] = {}
+        self._by_owner: Dict[str, Dict[Key, Any]] = {}   # owner uid -> deps
         self._rv = 0
         self._lock = threading.RLock()
         self._watchers: List[Tuple[Optional[str], "queue.Queue[WatchEvent]"]] = []
         # Admission mutators run on create (the PodDefault webhook seam,
         # admission-webhook/main.go:389-470).
         self._mutators: List[Callable[[Any], Any]] = []
+        # Read-path deepcopy tally, per verb ("get"/"list"). Deterministic
+        # (a pure function of the call sequence), so benches and CI gate on
+        # counts instead of wall-clock.
+        self.copied: Dict[str, int] = {}
+        self.metrics_copied = registry.counter(
+            "kftpu_apiserver_objects_copied_total",
+            "Objects deep-copied on the API server read path",
+            labels=("verb",),
+        )
 
     # ----------------- helpers -----------------
 
@@ -82,7 +175,52 @@ class InMemoryApiServer:
         self._rv += 1
         return self._rv
 
+    def _count_copies(self, verb: str, n: int) -> None:
+        if n <= 0:
+            return
+        self.copied[verb] = self.copied.get(verb, 0) + n
+        self.metrics_copied.inc(n, verb=verb)
+
+    def copied_total(self) -> int:
+        return sum(self.copied.values())
+
+    def _index_add(self, key: Key, obj: Any) -> None:
+        index_put(self._by_kind, self._by_kind_ns, key, obj)
+        for ref in obj.metadata.owner_references:
+            if ref.uid:
+                self._by_owner.setdefault(ref.uid, {})[key] = obj
+
+    def _index_remove(self, key: Key, obj: Any) -> None:
+        index_drop(self._by_kind, self._by_kind_ns, key)
+        for ref in obj.metadata.owner_references:
+            bucket = self._by_owner.get(ref.uid)
+            if bucket is not None:
+                bucket.pop(key, None)
+                if not bucket:
+                    del self._by_owner[ref.uid]
+
+    def _store(self, key: Key, obj: Any) -> None:
+        """Replace (never edit) the stored snapshot, keeping every index in
+        step — owner references may have changed on update."""
+        old = self._objects.get(key)
+        if old is not None:
+            self._index_remove(key, old)
+        self._objects[key] = obj
+        self._index_add(key, obj)
+
+    def _remove(self, key: Key) -> Any:
+        obj = self._objects.pop(key)
+        self._index_remove(key, obj)
+        return obj
+
     def _notify(self, event: WatchEvent) -> None:
+        # ONE event object shared by every subscriber: the payload is the
+        # stored snapshot, which is immutable by contract, so per-watcher
+        # deep copies bought nothing but O(watchers) deepcopy per write.
+        # Always called with self._lock held, so delivery order == write
+        # order — the invariant last-wins consumers (CachedReader) rely on;
+        # notifying outside the lock let two racing writers enqueue their
+        # events in the wrong order and wedge a cache stale forever.
         for kind, q in list(self._watchers):
             if kind is None or kind == event.object.kind:
                 q.put(event)
@@ -91,11 +229,19 @@ class InMemoryApiServer:
         with self._lock:
             self._mutators.append(fn)
 
+    def load_snapshot(self, obj: Any) -> None:
+        """Restore a persisted object verbatim: identity fields kept, no
+        resourceVersion bump, no watch events, indexes maintained — the
+        Platform.save/load seam. (Writing into ``_objects`` directly would
+        leave the secondary indexes empty.)"""
+        with self._lock:
+            self._store(_key(obj), obj)
+
     # ----------------- CRUD -----------------
 
     def create(self, obj: Any) -> Any:
         with self._lock:
-            obj = copy.deepcopy(obj)
+            obj = deepcopy(obj)
             if not obj.metadata.name:
                 raise ApiError(f"{obj.kind}: metadata.name required")
             if obj.kind not in CLUSTER_SCOPED and not obj.metadata.namespace:
@@ -110,22 +256,30 @@ class InMemoryApiServer:
             fresh_identity(obj.metadata)
             obj.metadata.resource_version = self._next_rv()
             obj.metadata.generation = 1
-            self._objects[key] = obj
-            out = copy.deepcopy(obj)
-        self._notify(WatchEvent("ADDED", copy.deepcopy(obj)))
+            self._store(key, obj)
+            out = deepcopy(obj)
+            self._notify(WatchEvent("ADDED", obj))
         return out
 
-    def get(self, kind: str, name: str, namespace: str = "") -> Any:
+    def get(self, kind: str, name: str, namespace: str = "", *,
+            copy: bool = True) -> Any:
+        """``copy=True`` (default) returns a private mutate-then-update-able
+        copy; ``copy=False`` returns the shared snapshot (read-only by
+        contract — never mutate it)."""
         with self._lock:
             ns = "" if kind in CLUSTER_SCOPED else namespace
             obj = self._objects.get((kind, ns, name))
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
-            return copy.deepcopy(obj)
+            if not copy:
+                return obj
+            self._count_copies("get", 1)
+            return deepcopy(obj)
 
-    def try_get(self, kind: str, name: str, namespace: str = "") -> Optional[Any]:
+    def try_get(self, kind: str, name: str, namespace: str = "", *,
+                copy: bool = True) -> Optional[Any]:
         try:
-            return self.get(kind, name, namespace)
+            return self.get(kind, name, namespace, copy=copy)
         except NotFoundError:
             return None
 
@@ -140,26 +294,31 @@ class InMemoryApiServer:
                     f"{key}: resourceVersion {obj.metadata.resource_version} "
                     f"!= {cur.metadata.resource_version}"
                 )
-            obj = copy.deepcopy(obj)
+            obj = deepcopy(obj)
             # Identity fields are server-owned.
             obj.metadata.uid = cur.metadata.uid
             obj.metadata.creation_timestamp = cur.metadata.creation_timestamp
             obj.metadata.resource_version = self._next_rv()
             if self._spec_changed(cur, obj):
                 obj.metadata.generation = cur.metadata.generation + 1
-            self._objects[key] = obj
-
-            if (
+            removed = (
                 obj.metadata.deletion_timestamp is not None
                 and not obj.metadata.finalizers
-            ):
-                del self._objects[key]
-                out = copy.deepcopy(obj)
-                self._notify(WatchEvent("DELETED", copy.deepcopy(obj)))
-                self._cascade_delete(obj)
-                return out
-            out = copy.deepcopy(obj)
-        self._notify(WatchEvent("MODIFIED", copy.deepcopy(obj)))
+            )
+            if removed:
+                # Last finalizer cleared: the update completes the delete —
+                # don't pay a _store index add just to tear it down again.
+                self._remove(key)
+                self._notify(WatchEvent("DELETED", obj))
+            else:
+                self._store(key, obj)
+                self._notify(WatchEvent("MODIFIED", obj))
+            out = deepcopy(obj)
+        if removed:
+            # Cascade OUTSIDE the lock (like delete()): a finalizer clear on
+            # an owner must not stall all API traffic for the whole
+            # dependent-tree teardown.
+            self._cascade_delete(obj)
         return out
 
     @staticmethod
@@ -169,6 +328,13 @@ class InMemoryApiServer:
         return sa != sb
 
     def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        removed = self._delete_one(kind, name, namespace)
+        if removed is not None:
+            self._cascade_delete(removed)
+
+    def _delete_one(self, kind: str, name: str, namespace: str) -> Optional[Any]:
+        """Delete without cascading; returns the removed object, or None when
+        finalizers only marked it (deletionTimestamp set, object retained)."""
         with self._lock:
             ns = "" if kind in CLUSTER_SCOPED else namespace
             key = (kind, ns, name)
@@ -177,52 +343,60 @@ class InMemoryApiServer:
                 raise NotFoundError(f"{key} not found")
             if cur.metadata.finalizers:
                 if cur.metadata.deletion_timestamp is None:
-                    cur = copy.deepcopy(cur)
+                    cur = deepcopy(cur)
                     cur.metadata.deletion_timestamp = time.time()
                     cur.metadata.resource_version = self._next_rv()
-                    self._objects[key] = cur
-                    self._notify(WatchEvent("MODIFIED", copy.deepcopy(cur)))
-                return
-            del self._objects[key]
-            obj = cur
-        self._notify(WatchEvent("DELETED", copy.deepcopy(obj)))
-        self._cascade_delete(obj)
+                    self._store(key, cur)
+                    self._notify(WatchEvent("MODIFIED", cur))
+                return None
+            self._remove(key)
+            self._notify(WatchEvent("DELETED", cur))
+            return cur
 
     def _cascade_delete(self, owner: Any) -> None:
-        """Delete dependents referencing the owner's uid."""
-        uid = owner.metadata.uid
-        with self._lock:
-            dependents = [
-                o for o in self._objects.values()
-                if any(r.uid == uid for r in o.metadata.owner_references)
-            ]
-        for dep in dependents:
-            try:
-                self.delete(dep.kind, dep.metadata.name, dep.metadata.namespace)
-            except NotFoundError:
-                pass
+        """Delete dependents referencing the owner's uid, breadth-first via
+        the owner-uid index — the old implementation re-scanned the whole
+        store once per dependency *level*."""
+        pending: "collections.deque[str]" = collections.deque(
+            [owner.metadata.uid]
+        )
+        while pending:
+            uid = pending.popleft()
+            with self._lock:
+                deps = list(self._by_owner.get(uid, {}).values())
+            for dep in deps:
+                try:
+                    removed = self._delete_one(
+                        dep.kind, dep.metadata.name, dep.metadata.namespace
+                    )
+                except NotFoundError:
+                    continue
+                if removed is not None:
+                    pending.append(removed.metadata.uid)
 
     def list(
         self,
         kind: str,
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
+        *,
+        copy: bool = True,
     ) -> List[Any]:
+        """Index-resolved list: touches only the (kind) or (kind, namespace)
+        bucket, so cost is O(bucket) and copy count (``copy=True``) is
+        O(matches) — never O(store). ``copy=False`` returns the shared
+        snapshots (read-only by contract)."""
         with self._lock:
-            out = []
-            for (k, ns, _), obj in self._objects.items():
-                if k != kind:
-                    continue
-                if namespace is not None and kind not in CLUSTER_SCOPED \
-                        and ns != namespace:
-                    continue
-                if label_selector and not all(
-                    obj.metadata.labels.get(lk) == lv
-                    for lk, lv in label_selector.items()
-                ):
-                    continue
-                out.append(copy.deepcopy(obj))
-            return sorted(out, key=lambda o: (o.metadata.namespace, o.metadata.name))
+            out = list_bucket(self._by_kind, self._by_kind_ns,
+                              kind, namespace, label_selector)
+            if copy:
+                self._count_copies("list", len(out))
+        if copy:
+            # Snapshots are immutable once stored, so the copies happen
+            # OUTSIDE the lock — a big copy=True list must not stall every
+            # concurrent writer for the duration of the deepcopy loop.
+            out = [deepcopy(o) for o in out]
+        return _sorted_objs(out)
 
     # ----------------- status + finalizer conveniences -----------------
 
@@ -233,12 +407,12 @@ class InMemoryApiServer:
             cur = self._objects.get(key)
             if cur is None:
                 raise NotFoundError(f"{key} not found")
-            new = copy.deepcopy(cur)
-            new.status = copy.deepcopy(obj.status)
+            new = deepcopy(cur)
+            new.status = deepcopy(obj.status)
             new.metadata.resource_version = self._next_rv()
-            self._objects[key] = new
-            out = copy.deepcopy(new)
-        self._notify(WatchEvent("MODIFIED", copy.deepcopy(new)))
+            self._store(key, new)
+            out = deepcopy(new)
+            self._notify(WatchEvent("MODIFIED", new))
         return out
 
     # ----------------- watch -----------------
@@ -246,10 +420,16 @@ class InMemoryApiServer:
     def watch(self, kind: Optional[str] = None) -> "queue.Queue[WatchEvent]":
         q: "queue.Queue[WatchEvent]" = queue.Queue()
         with self._lock:
-            # Replay current state so late watchers converge (informer-style).
-            for obj in self._objects.values():
-                if kind is None or obj.kind == kind:
-                    q.put(WatchEvent("ADDED", copy.deepcopy(obj)))
+            # Replay current state so late watchers converge (informer-
+            # style). Replay shares the stored snapshots: the old
+            # deepcopy-the-store-under-the-lock stalled every writer for
+            # the whole copy.
+            if kind is None:
+                replay: Iterator[Any] = iter(self._objects.values())
+            else:
+                replay = iter(self._by_kind.get(kind, {}).values())
+            for obj in replay:
+                q.put(WatchEvent("ADDED", obj))
             self._watchers.append((kind, q))
         return q
 

@@ -38,6 +38,7 @@ class EventRecorder:
 
     def events_for(self, obj: Any):
         return [
-            e for e in self.api.list("Event", namespace=obj.metadata.namespace)
+            e for e in self.api.list("Event", namespace=obj.metadata.namespace,
+                                     copy=False)
             if e.involved_kind == obj.kind and e.involved_name == obj.metadata.name
         ]

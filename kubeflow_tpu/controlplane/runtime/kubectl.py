@@ -166,16 +166,22 @@ class KubectlApiServer:
                         stdin=self._manifest(obj))
         return self._parse(out)
 
-    def get(self, kind: str, name: str, namespace: str = "") -> Any:
+    def get(self, kind: str, name: str, namespace: str = "", *,
+            copy: bool = True) -> Any:
+        # ``copy`` is accepted for interface parity with the in-memory
+        # server's zero-copy read path; kubectl objects are always freshly
+        # parsed, so the flag is a no-op here.
+        del copy
         out = self._run(
             ["get", resource_for(kind), name,
              *self._ns_args(kind, namespace), "-o", "json"]
         )
         return self._parse(out)
 
-    def try_get(self, kind: str, name: str, namespace: str = "") -> Optional[Any]:
+    def try_get(self, kind: str, name: str, namespace: str = "", *,
+                copy: bool = True) -> Optional[Any]:
         try:
-            return self.get(kind, name, namespace)
+            return self.get(kind, name, namespace, copy=copy)
         except NotFoundError:
             return None
 
@@ -228,7 +234,10 @@ class KubectlApiServer:
         kind: str,
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
+        *,
+        copy: bool = True,
     ) -> List[Any]:
+        del copy        # interface parity; kubectl objects are always fresh
         args = ["get", resource_for(kind)]
         if kind in CLUSTER_SCOPED or namespace is None:
             if kind not in CLUSTER_SCOPED:

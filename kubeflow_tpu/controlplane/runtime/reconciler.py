@@ -17,12 +17,19 @@ import threading
 import time
 import traceback
 import weakref
+from copy import deepcopy as _deepcopy
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kubeflow_tpu.controlplane.runtime.apiserver import (
+    CLUSTER_SCOPED,
     ConflictError,
     InMemoryApiServer,
     NotFoundError,
+    _key,
+    _sorted_objs,
+    index_drop,
+    index_put,
+    list_bucket,
 )
 from kubeflow_tpu.controlplane.runtime.ratelimiter import (
     ExponentialBackoffLimiter,
@@ -34,6 +41,113 @@ from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
 @dataclasses.dataclass
 class Result:
     requeue_after: Optional[float] = None   # seconds
+
+
+class CachedReader:
+    """Informer-style read cache: serves ``get``/``try_get``/``list`` for
+    watched kinds straight from the watch stream (the client-go shared
+    informer / Store analogue), so controller read loops never pay an API
+    round trip — and, in-process, never pay a deepcopy: cached objects ARE
+    the server's immutable snapshots, shared by reference.
+
+    Contract mirrors client-go:
+    - ``copy`` defaults to True — the same always-safe default as every
+      API-server implementation, so a controller behaves identically
+      whether its ``reader`` is the cache or the API itself. Read-only
+      loops opt into the zero-copy path with ``copy=False``, whose results
+      are **read-only by contract** (mutating one is the client-go
+      mutate-a-cached-object programming error);
+    - kinds not subscribed fall through to the underlying API — which may
+      be a ``ChaosApiServer``, so fault injection still sits *ahead* of
+      the cache for everything that actually leaves the informer;
+    - freshness: events are enqueued synchronously at write time and
+      drained on every read (``sync``), so in-process reads always observe
+      their own writes.
+    """
+
+    def __init__(self, api: Any):
+        self.api = api
+        self._watches: Dict[str, Any] = {}     # kind -> watch queue
+        self._store: Dict[Tuple[str, str, str], Any] = {}
+        self._by_kind: Dict[str, Dict[Tuple[str, str, str], Any]] = {}
+        self._by_kind_ns: Dict[Tuple[str, str], Dict[Tuple[str, str, str], Any]] = {}
+        self._lock = threading.Lock()
+
+    def watch_kind(self, kind: str) -> None:
+        with self._lock:
+            if kind in self._watches:
+                return
+            self._watches[kind] = self.api.watch(kind)
+
+    def caches(self, kind: str) -> bool:
+        return kind in self._watches
+
+    def sync(self) -> int:
+        """Drain every subscription into the local store; returns events
+        applied."""
+        n = 0
+        with self._lock:
+            for q in self._watches.values():
+                while not q.empty():
+                    ev = q.get()
+                    key = _key(ev.object)
+                    if ev.type == "DELETED":
+                        self._store.pop(key, None)
+                        index_drop(self._by_kind, self._by_kind_ns, key)
+                    else:
+                        self._store[key] = ev.object
+                        index_put(self._by_kind, self._by_kind_ns,
+                                  key, ev.object)
+                    n += 1
+        return n
+
+    # -- reads --
+
+    def get(self, kind: str, name: str, namespace: str = "", *,
+            copy: bool = True) -> Any:
+        if not self.caches(kind):
+            return self.api.get(kind, name, namespace, copy=copy)
+        self.sync()
+        ns = "" if kind in CLUSTER_SCOPED else namespace
+        with self._lock:
+            obj = self._store.get((kind, ns, name))
+        if obj is None:
+            raise NotFoundError(f"{kind} {namespace}/{name} not found")
+        return _deepcopy(obj) if copy else obj
+
+    def try_get(self, kind: str, name: str, namespace: str = "", *,
+                copy: bool = True) -> Optional[Any]:
+        try:
+            return self.get(kind, name, namespace, copy=copy)
+        except NotFoundError:
+            return None
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        *,
+        copy: bool = True,
+    ) -> List[Any]:
+        if not self.caches(kind):
+            return self.api.list(kind, namespace, label_selector, copy=copy)
+        self.sync()
+        with self._lock:
+            out = list_bucket(self._by_kind, self._by_kind_ns,
+                              kind, namespace, label_selector)
+        if copy:
+            out = [_deepcopy(o) for o in out]
+        return _sorted_objs(out)
+
+    def close(self) -> None:
+        with self._lock:
+            for q in self._watches.values():
+                self.api.stop_watch(q)
+            self._watches.clear()
+            self._store.clear()
+            self._by_kind.clear()
+            self._by_kind_ns.clear()
 
 
 class Controller:
@@ -50,6 +164,11 @@ class Controller:
 
     def __init__(self, api: InMemoryApiServer, registry: MetricsRegistry = global_registry):
         self.api = api
+        # Read surface for list/get loops that do NOT mutate-then-update.
+        # Defaults to the API itself; ControllerManager.register swaps in
+        # its shared CachedReader (informer cache) when the backend
+        # supports synchronous watches.
+        self.reader: Any = api
         self.log = get_logger(self.NAME)
         self.metrics_reconcile = registry.counter(
             f"kftpu_{self.NAME}_reconcile_total",
@@ -101,11 +220,23 @@ class ControllerManager:
         registry: MetricsRegistry = global_registry,
         *,
         limiter: Optional[ExponentialBackoffLimiter] = None,
+        use_cache: Optional[bool] = None,
     ):
         self.api = api
         self.controllers: List[Controller] = []
         self.limiter = limiter or ExponentialBackoffLimiter()
         self._queues: List[Any] = []
+        # Shared informer cache for controller reads. Enabled only when the
+        # backend delivers watch events synchronously at write time (the
+        # in-memory server, possibly behind a chaos/fault wrapper exposing
+        # .inner) — the kubectl backend's poll-based watch would make cache
+        # reads lag direct reads, so it keeps reader == api.
+        if use_cache is None:
+            use_cache = isinstance(
+                getattr(api, "inner", api), InMemoryApiServer
+            )
+        self._cache: Optional[CachedReader] = \
+            CachedReader(api) if use_cache else None
         # deque + set mirror: O(1) at both ends — chaos-scale event storms
         # made the old list's membership scans and pop(0) quadratic.
         self._pending: "collections.deque[Tuple[Controller, Tuple[str, str]]]" = \
@@ -150,6 +281,43 @@ class ControllerManager:
         for i, kind in enumerate(ctl.WATCH_KINDS):
             q = self.api.watch(kind)
             self._queues.append((ctl, i == 0, q))
+            if self._cache is not None:
+                self._cache.watch_kind(kind)
+        if self._cache is not None:
+            ctl.reader = self._cache
+
+    def unregister(self, ctl: Controller) -> None:
+        """Release a controller's watch queues and drop its pending work.
+        (Registered watches used to leak: a discarded manager's queues kept
+        accumulating a copy of every matching event forever.)"""
+        with self._lock:
+            released = [e[2] for e in self._queues if e[0] is ctl]
+            self._queues = [e for e in self._queues if e[0] is not ctl]
+            if ctl in self.controllers:
+                self.controllers.remove(ctl)
+            self._pending = collections.deque(
+                (c, k) for c, k in self._pending if c is not ctl
+            )
+            self._pending_set = {(c, k) for c, k in self._pending_set
+                                 if c is not ctl}
+            self._timers = [t for t in self._timers if t[2] is not ctl]
+            heapq.heapify(self._timers)
+        ctl.reader = ctl.api
+        # stop_watch outside the manager lock: it takes the API server's
+        # lock, and no path holds them in the opposite order.
+        for q in released:
+            self.api.stop_watch(q)
+
+    def close(self) -> None:
+        """Tear the manager down: stop the background thread, release every
+        registered watch queue and the shared informer cache. Tests and
+        benches that build throwaway managers call this so discarded
+        managers stop receiving (and buffering) every future event."""
+        self.stop()
+        for ctl in list(self.controllers):
+            self.unregister(ctl)
+        if self._cache is not None:
+            self._cache.close()
 
     # ------------- queue pumping -------------
 
@@ -168,6 +336,11 @@ class ControllerManager:
         return n
 
     def _pending_add_locked(self, ctl: Controller, key: Tuple[str, str]) -> None:
+        if ctl not in self.controllers:
+            # unregister() raced a pump thread still draining the released
+            # queue: drop the key instead of reconciling a controller the
+            # caller already tore down.
+            return
         if (ctl, key) not in self._pending_set:
             self._pending_set.add((ctl, key))
             self._pending.append((ctl, key))
@@ -310,7 +483,36 @@ def create_or_update(
     ``copy_fields(live, desired) -> changed`` defaults to comparing+copying
     ``spec`` plus labels/annotations — the same field set the reference's
     Copy*Fields functions sync.
+
+    The steady-state call is a no-op (idempotent second pass), so for the
+    default field set the live object is first read zero-copy and compared
+    without mutation; only a detected drift pays the private copy + update.
+    A custom ``copy_fields`` mutates its ``live`` argument, so that path
+    always reads a private copy.
+
+    The return value is READ-ONLY by contract: on the no-drift fast path
+    it is the store's shared snapshot (every other path happens to return
+    a private object, but callers must not rely on that). A caller that
+    wants to mutate-then-update afterwards re-reads with
+    ``api.get(..., copy=True)``.
     """
+    if copy_fields is None:
+        probe = api.try_get(
+            desired.kind, desired.metadata.name, desired.metadata.namespace,
+            copy=False,
+        )
+        if probe is not None and (
+            (getattr(desired, "spec", None) is None
+             or probe.spec == desired.spec)
+            and all(
+                {**getattr(probe.metadata, f), **getattr(desired.metadata, f)}
+                == getattr(probe.metadata, f)
+                for f in ("labels", "annotations")
+            )
+        ):
+            return probe
+    # Missing, drifted, or custom copy_fields: read a private copy (the
+    # same informer-read fault surface as before) and apply below.
     live = api.try_get(
         desired.kind, desired.metadata.name, desired.metadata.namespace
     )

@@ -19,7 +19,13 @@ Stages (any failure exits non-zero — the merge gate contract):
    with a fixed round budget — injected conflicts/transients plus slice
    preemption; fails when any TpuJob is stuck in a non-terminal phase,
    the manager won't go idle, or availability doesn't recover to 1.
-6. **bench-gate**: if --bench-json is given, require
+   ``--chaos-latency-s`` additionally runs the latency soak profile
+   (per-verb injected API latency; docs/chaos.md).
+6. **cp-bench-smoke**: a small (N=50) control-plane sweep
+   (kubeflow_tpu.controlplane.benchmark) gated on the *deterministic*
+   copies-per-list counter: a namespaced list must deepcopy exactly its
+   matches, never the store (count-based, not wall-clock — cannot flake).
+7. **bench-gate**: if --bench-json is given, require
    ``vs_baseline >= --min-vs-baseline`` for every record — the perf
    regression gate SURVEY §7.8 prescribes.
 """
@@ -49,14 +55,16 @@ def _stage(name: str):
     print(f"[ci] {name} ...", flush=True)
 
 
-def run_chaos_smoke(seed: int = 20260803) -> None:
+def run_chaos_smoke(seed: int = 20260803, latency_s: float = 0.0) -> None:
     """Seeded soak with a fixed budget; raises GateFailure on any job
-    stuck non-terminal, a non-idle manager, or degraded availability."""
+    stuck non-terminal, a non-idle manager, or degraded availability.
+    ``latency_s`` > 0 selects the latency soak profile (every chaos-visible
+    verb sleeps that long before executing)."""
     from kubeflow_tpu.chaos import run_soak
 
     rep = run_soak(num_jobs=4, seed=seed, conflict_rate=0.3,
                    transient_rate=0.05, preempt_every=3, fault_rounds=9,
-                   max_rounds=40)
+                   max_rounds=40, latency_s=latency_s)
     if not rep.converged:
         raise GateFailure(
             f"chaos smoke (seed={seed}): stuck jobs after {rep.rounds} "
@@ -73,9 +81,33 @@ def run_chaos_smoke(seed: int = 20260803) -> None:
         )
 
 
+def run_cp_bench_smoke(num_jobs: int = 50, num_namespaces: int = 5) -> None:
+    """Small control-plane sweep gated on the deterministic copy counter:
+    the probe list must deepcopy exactly its matches (O(matches)), and the
+    fleet must fully converge. Counter-based, so it cannot flake on a slow
+    CI host the way a wall-clock threshold would."""
+    from kubeflow_tpu.controlplane.benchmark import run_controlplane_sweep
+
+    rep = run_controlplane_sweep(num_jobs=num_jobs,
+                                 num_namespaces=num_namespaces)
+    if not rep.all_succeeded:
+        raise GateFailure(
+            f"cp-bench-smoke: sweep did not converge: {rep.phases}"
+        )
+    if not rep.copies_scale_with_matches:
+        raise GateFailure(
+            f"cp-bench-smoke: copies-per-list regressed — "
+            f"list({rep.probe_namespace!r}) copied {rep.list_copies} "
+            f"objects for {rep.list_matches} matches "
+            f"(store holds {rep.store_objects}); the read path is back "
+            "to O(store)"
+        )
+
+
 def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
              skip_smoke: bool = False, skip_chaos: bool = False,
-             chaos_seed: int = 20260803) -> List[str]:
+             chaos_seed: int = 20260803, chaos_latency_s: float = 0.0,
+             skip_cp_bench: bool = False) -> List[str]:
     """Run all stages; returns the list of passed stages, raises
     GateFailure on the first failing one."""
     passed: List[str] = []
@@ -147,6 +179,15 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
         _stage("chaos-smoke")
         run_chaos_smoke(seed=chaos_seed)
         passed.append("chaos-smoke")
+        if chaos_latency_s > 0:
+            _stage("chaos-latency-smoke")
+            run_chaos_smoke(seed=chaos_seed, latency_s=chaos_latency_s)
+            passed.append("chaos-latency-smoke")
+
+    if not skip_cp_bench:
+        _stage("cp-bench-smoke")
+        run_cp_bench_smoke()
+        passed.append("cp-bench-smoke")
 
     if bench_json:
         _stage("bench-gate")
@@ -180,6 +221,11 @@ def main(argv=None) -> int:
     g.add_argument("--skip-chaos", action="store_true")
     g.add_argument("--chaos-seed", type=int, default=20260803,
                    help="seed for the chaos-smoke soak (reproducibility)")
+    g.add_argument("--chaos-latency-s", type=float, default=0.0,
+                   help="also run the latency soak profile with this "
+                        "per-verb injected API latency (0 = skip)")
+    g.add_argument("--skip-cp-bench", action="store_true",
+                   help="skip the control-plane copy-counter smoke")
     args = p.parse_args(argv)
     try:
         passed = run_gate(
@@ -188,6 +234,8 @@ def main(argv=None) -> int:
             skip_smoke=args.skip_smoke,
             skip_chaos=args.skip_chaos,
             chaos_seed=args.chaos_seed,
+            chaos_latency_s=args.chaos_latency_s,
+            skip_cp_bench=args.skip_cp_bench,
         )
     except GateFailure as e:
         print(f"[ci] FAIL: {e}", file=sys.stderr)

@@ -92,11 +92,11 @@ def run_load(
     dt = time.perf_counter() - t0
 
     not_ready = [
-        nb.metadata.name for nb in api.list("Notebook")
+        nb.metadata.name for nb in api.list("Notebook", copy=False)
         if nb.status.ready_replicas < 1
     ]
     unsched = [
-        job.metadata.name for job in api.list("TpuJob")
+        job.metadata.name for job in api.list("TpuJob", copy=False)
         if job.status.phase not in ("Running", "Succeeded")
     ]
     total = profiles + notebooks + jobs

@@ -193,7 +193,8 @@ def cmd_get(args) -> int:
         objs = _kubectl_api(args).list(args.kind, namespace=args.namespace)
     else:
         platform = Platform.load(args.state_dir)
-        objs = platform.api.list(args.kind, namespace=args.namespace)
+        objs = platform.api.list(args.kind, namespace=args.namespace,
+                                 copy=False)
     if args.output == "yaml":
         yaml.safe_dump_all([to_dict(o) for o in objs], sys.stdout,
                            sort_keys=False)
@@ -222,7 +223,7 @@ def cmd_status(args) -> int:
     }
     for kind in ("TpuJob", "StudyJob", "Serving", "Notebook", "Profile",
                  "Pod", "Tensorboard"):
-        objs = platform.api.list(kind)
+        objs = platform.api.list(kind, copy=False)
         if objs:
             out["resources"][kind] = {
                 f"{o.metadata.namespace or '-'}/{o.metadata.name}":
@@ -333,7 +334,8 @@ def cmd_logs(args) -> int:
         pods = [pod]
     else:
         pods = platform.api.list(
-            "Pod", namespace=ns, label_selector={JOB_LABEL: args.name}
+            "Pod", namespace=ns, label_selector={JOB_LABEL: args.name},
+            copy=False,
         )
         if not pods:
             print(f"no pod or TpuJob {args.name!r} in {ns}",

@@ -64,7 +64,7 @@ class DashboardApi:
         namespace; api_workgroup.ts:322-333)."""
         if not caller:
             raise RestError(401, "missing identity header")
-        for p in self.api.list("Profile"):
+        for p in self.api.list("Profile", copy=False):
             if p.spec.owner == caller:
                 self.am.delete_profile(caller, p.metadata.name)
                 return {"message": f"Removed namespace/profile {p.metadata.name}"}
@@ -77,7 +77,7 @@ class DashboardApi:
             for b in self.am.list_bindings(user=caller)
         ] if caller else []
         platform = {"kind": self.platform_name, "components": []}
-        pcs = self.api.list("PlatformConfig")
+        pcs = self.api.list("PlatformConfig", copy=False)
         if pcs:
             platform["components"] = list(pcs[0].status.applied_components)
             platform["defaultSliceType"] = pcs[0].spec.default_slice_type

@@ -258,7 +258,7 @@ def central_hub(api, dashboard, jwa, metrics_service=None) -> Router:
         out = {}
         for kind in ("Notebook", "TpuJob", "Serving", "StudyJob"):
             items = []
-            for o in api.list(kind, namespace=ns):
+            for o in api.list(kind, namespace=ns, copy=False):
                 st = getattr(o, "status", None)
                 phase = (getattr(st, "phase", "")
                          or getattr(st, "condition", "")

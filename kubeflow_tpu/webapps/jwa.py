@@ -91,7 +91,7 @@ class NotebookWebApp:
         if not caller:
             raise RestError(401, "missing identity header")
         out = []
-        for ns in self.api.list("Namespace"):
+        for ns in self.api.list("Namespace", copy=False):
             if self.sar.is_cluster_admin(caller) or self.sar.can(
                 caller, "list", ns.metadata.name
             ):
@@ -102,7 +102,7 @@ class NotebookWebApp:
         self._authorize(caller, "list", namespace)
         self.heartbeat.beat()
         items = []
-        for nb in self.api.list("Notebook", namespace=namespace):
+        for nb in self.api.list("Notebook", namespace=namespace, copy=False):
             items.append(self._render(nb))
         self.requests.inc(op="list", result="ok")
         return items
@@ -194,7 +194,8 @@ class NotebookWebApp:
     def list_poddefaults(self, caller: str, namespace: str) -> List[Dict]:
         self._authorize(caller, "list", namespace)
         out = []
-        for pd in self.api.list("PodDefault", namespace=namespace):
+        for pd in self.api.list("PodDefault", namespace=namespace,
+                                copy=False):
             labels = list(pd.spec.selector.keys())
             out.append({
                 "label": labels[0] if labels else pd.metadata.name,
@@ -218,7 +219,8 @@ class NotebookWebApp:
             phase, reason = "stopped", "Notebook is culled/stopped"
         events = [
             {"reason": e.reason, "message": e.message, "type": e.type}
-            for e in self.api.list("Event", namespace=nb.metadata.namespace)
+            for e in self.api.list("Event", namespace=nb.metadata.namespace,
+                                    copy=False)
             if e.involved_kind == "Notebook"
             and e.involved_name == nb.metadata.name
         ]

@@ -1,0 +1,257 @@
+"""Indexed, copy-light apiserver (ISSUE 3): secondary-index list
+equivalence against the naive full scan, snapshot-replacement mutation
+safety, deterministic copy counters, and breadth-first cascade GC."""
+
+import random
+
+import pytest
+
+from kubeflow_tpu.controlplane.api import (
+    Namespace,
+    ObjectMeta,
+    Pod,
+    TpuJob,
+    TpuJobSpec,
+)
+from kubeflow_tpu.controlplane.api.meta import OwnerReference
+from kubeflow_tpu.controlplane.runtime import (
+    InMemoryApiServer,
+    NotFoundError,
+)
+from kubeflow_tpu.controlplane.runtime.apiserver import CLUSTER_SCOPED
+from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+
+def _job(name, ns="u", labels=None):
+    j = TpuJob(metadata=ObjectMeta(name=name, namespace=ns),
+               spec=TpuJobSpec(slice_type="v5e-16"))
+    j.metadata.labels = dict(labels or {})
+    return j
+
+
+def _naive_list(api, kind, namespace=None, label_selector=None):
+    """The pre-index reference implementation: full store scan. The indexed
+    list must return exactly this, for every query shape."""
+    out = []
+    for (k, ns, _), obj in api._objects.items():
+        if k != kind:
+            continue
+        if namespace is not None and kind not in CLUSTER_SCOPED \
+                and ns != namespace:
+            continue
+        if label_selector and not all(
+            obj.metadata.labels.get(lk) == lv
+            for lk, lv in label_selector.items()
+        ):
+            continue
+        out.append(obj)
+    return sorted(out, key=lambda o: (o.metadata.namespace, o.metadata.name))
+
+
+def _ids(objs):
+    return [(o.kind, o.metadata.namespace, o.metadata.name) for o in objs]
+
+
+class TestIndexedListEquivalence:
+    KINDS = ("TpuJob", "Pod", "Namespace")       # Namespace: cluster-scoped
+    NAMESPACES = ("u1", "u2", "u3")
+    LABELS = ({"team": "x"}, {"team": "y"}, {"tier": "prod"}, {})
+
+    def _random_object(self, rng, i):
+        kind = rng.choice(self.KINDS)
+        labels = dict(rng.choice(self.LABELS))
+        if kind == "Namespace":
+            obj = Namespace(metadata=ObjectMeta(name=f"ns-{i:03d}"))
+        elif kind == "Pod":
+            obj = Pod(metadata=ObjectMeta(
+                name=f"pod-{i:03d}", namespace=rng.choice(self.NAMESPACES)))
+        else:
+            obj = _job(f"job-{i:03d}", ns=rng.choice(self.NAMESPACES))
+        obj.metadata.labels = labels
+        return obj
+
+    def _queries(self, rng, n):
+        for _ in range(n):
+            yield (
+                rng.choice(self.KINDS),
+                rng.choice((None,) + self.NAMESPACES),
+                rng.choice((None,) + tuple(
+                    s for s in self.LABELS if s)),
+            )
+
+    @pytest.mark.parametrize("seed", [0, 1, 2026])
+    def test_property_indexed_equals_naive(self, seed):
+        """Random store + random churn: every (kind, ns, selector) query
+        answered by the indexes matches the naive full scan exactly —
+        including cluster-scoped kinds, where namespace is ignored."""
+        rng = random.Random(seed)
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        live = []
+        for i in range(rng.randrange(40, 80)):
+            obj = self._random_object(rng, i)
+            live.append(api.create(obj))
+        # Churn: random updates (relabel) and deletes keep the indexes
+        # honest under replacement and removal.
+        rng.shuffle(live)
+        for obj in live[: len(live) // 3]:
+            got = api.get(obj.kind, obj.metadata.name,
+                          obj.metadata.namespace)
+            got.metadata.labels = dict(rng.choice(self.LABELS))
+            api.update(got)
+        for obj in live[-len(live) // 4:]:
+            api.delete(obj.kind, obj.metadata.name, obj.metadata.namespace)
+
+        for kind, ns, sel in self._queries(rng, 60):
+            want = _ids(_naive_list(api, kind, ns, sel))
+            assert _ids(api.list(kind, ns, sel)) == want, (kind, ns, sel)
+            assert _ids(api.list(kind, ns, sel, copy=False)) == want
+
+    def test_owner_index_follows_updates(self):
+        """Re-parenting an object on update must move it between owner-uid
+        buckets: cascade-deleting the old owner spares it, the new owner
+        takes it down."""
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        a = api.create(_job("owner-a"))
+        b = api.create(_job("owner-b"))
+        pod = Pod(metadata=ObjectMeta(
+            name="p", namespace="u",
+            owner_references=[OwnerReference(kind="TpuJob", name="owner-a",
+                                             uid=a.metadata.uid)]))
+        api.create(pod)
+        live = api.get("Pod", "p", "u")
+        live.metadata.owner_references = [
+            OwnerReference(kind="TpuJob", name="owner-b",
+                           uid=b.metadata.uid)]
+        api.update(live)
+        api.delete("TpuJob", "owner-a", "u")
+        assert api.try_get("Pod", "p", "u") is not None
+        api.delete("TpuJob", "owner-b", "u")
+        assert api.try_get("Pod", "p", "u") is None
+
+
+class TestCopyLightReads:
+    def test_zero_copy_reads_share_the_snapshot(self):
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        api.create(_job("a"))
+        s1 = api.list("TpuJob", namespace="u", copy=False)[0]
+        s2 = api.list("TpuJob", namespace="u", copy=False)[0]
+        s3 = api.get("TpuJob", "a", "u", copy=False)
+        assert s1 is s2 is s3          # zero copies: one shared snapshot
+        assert api.copied == {}        # and the counter agrees
+
+    def test_copy_counter_counts_matches_not_store(self):
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        for i in range(30):
+            api.create(_job(f"j-{i:02d}", ns=f"ns-{i % 3}"))
+        for i in range(40):
+            api.create(Pod(metadata=ObjectMeta(name=f"p-{i:02d}",
+                                               namespace="ns-0")))
+        api.copied = {}
+        got = api.list("TpuJob", namespace="ns-0")      # default copy=True
+        assert len(got) == 10
+        assert api.copied == {"list": 10}               # O(matches): 10/70
+        api.get("TpuJob", "j-00", "ns-0")
+        assert api.copied == {"list": 10, "get": 1}
+
+    def test_mutating_a_zero_copy_read_cannot_corrupt_the_store(self):
+        """Snapshots are REPLACED on every write, never edited in place: a
+        rogue mutation of a previously handed-out zero-copy result lands on
+        a detached snapshot and the store never sees it."""
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        api.create(_job("a"))
+        shared = api.list("TpuJob", namespace="u", copy=False)[0]
+
+        # A legitimate writer replaces the snapshot wholesale...
+        writer = api.get("TpuJob", "a", "u")            # private copy
+        writer.spec.max_restarts = 9
+        api.update(writer)
+        # ...so the reader's old snapshot is detached; vandalising it
+        # cannot reach the store.
+        shared.spec.slice_type = "HACKED"
+        live = api.get("TpuJob", "a", "u")
+        assert live.spec.slice_type == "v5e-16"
+        assert live.spec.max_restarts == 9
+
+    def test_update_status_replaces_not_edits(self):
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        api.create(_job("a"))
+        shared = api.get("TpuJob", "a", "u", copy=False)
+        writer = api.get("TpuJob", "a", "u")
+        writer.status.phase = "Running"
+        api.update_status(writer)
+        assert shared.status.phase == "Pending"   # old snapshot untouched...
+        assert api.get("TpuJob", "a", "u",
+                       copy=False).status.phase == "Running"
+        assert api.get("TpuJob", "a", "u", copy=False) is not shared
+
+    def test_private_copies_stay_private(self):
+        """The pre-existing store-isolation contract, restated for the new
+        read path: default reads are safe to mutate freely."""
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        api.create(_job("a"))
+        mine = api.list("TpuJob", namespace="u")[0]
+        mine.spec.slice_type = "SCRIBBLED"
+        assert api.get("TpuJob", "a", "u").spec.slice_type == "v5e-16"
+
+    def test_watch_events_share_one_object_across_watchers(self):
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        q1, q2 = api.watch("TpuJob"), api.watch("TpuJob")
+        api.create(_job("a"))
+        e1, e2 = q1.get_nowait(), q2.get_nowait()
+        assert e1 is e2                      # one event object per write
+        assert e1.object is api.get("TpuJob", "a", "u", copy=False)
+
+    def test_watch_replay_is_snapshot_backed(self):
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        api.create(_job("a"))
+        api.copied = {}
+        q = api.watch("TpuJob")
+        ev = q.get_nowait()
+        assert ev.type == "ADDED"
+        assert ev.object is api.get("TpuJob", "a", "u", copy=False)
+        assert api.copied == {}              # replay copied nothing
+
+
+class TestCascadeBfs:
+    def test_transitive_cascade_via_owner_index(self):
+        """job -> pod -> grandchild: the whole chain goes down breadth-
+        first off the owner-uid index."""
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        job = api.create(_job("root"))
+        pod = api.create(Pod(metadata=ObjectMeta(
+            name="child", namespace="u",
+            owner_references=[OwnerReference(kind="TpuJob", name="root",
+                                             uid=job.metadata.uid)])))
+        api.create(Pod(metadata=ObjectMeta(
+            name="grandchild", namespace="u",
+            owner_references=[OwnerReference(kind="Pod", name="child",
+                                             uid=pod.metadata.uid)])))
+        api.delete("TpuJob", "root", "u")
+        for name in ("child", "grandchild"):
+            with pytest.raises(NotFoundError):
+                api.get("Pod", name, "u")
+
+    def test_cascade_respects_finalizers(self):
+        """A finalizer-carrying dependent is only *marked* by the cascade;
+        its own dependents survive until the finalizer clears — then the
+        update-path removal cascades on."""
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        job = api.create(_job("root"))
+        mid = Pod(metadata=ObjectMeta(
+            name="mid", namespace="u",
+            finalizers=["tpu.kubeflow.org/drain"],
+            owner_references=[OwnerReference(kind="TpuJob", name="root",
+                                             uid=job.metadata.uid)]))
+        mid = api.create(mid)
+        api.create(Pod(metadata=ObjectMeta(
+            name="leaf", namespace="u",
+            owner_references=[OwnerReference(kind="Pod", name="mid",
+                                             uid=mid.metadata.uid)])))
+        api.delete("TpuJob", "root", "u")
+        held = api.get("Pod", "mid", "u")
+        assert held.metadata.deletion_timestamp is not None
+        assert api.try_get("Pod", "leaf", "u") is not None
+        held.metadata.finalizers = []
+        api.update(held)
+        assert api.try_get("Pod", "mid", "u") is None
+        assert api.try_get("Pod", "leaf", "u") is None

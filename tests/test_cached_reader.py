@@ -1,0 +1,196 @@
+"""CachedReader (informer read cache) + ControllerManager watch-queue
+lifecycle (ISSUE 3 satellites): reads served from the watch stream, chaos
+injection staying ahead of the cache, and unregister/close releasing the
+watch queues that used to leak from discarded managers."""
+
+import pytest
+
+from kubeflow_tpu.chaos import ChaosApiServer, FaultSpec, TransientApiError
+from kubeflow_tpu.controlplane.api import (
+    ObjectMeta,
+    Pod,
+    TpuJob,
+    TpuJobSpec,
+)
+from kubeflow_tpu.controlplane.runtime import (
+    CachedReader,
+    Controller,
+    ControllerManager,
+    InMemoryApiServer,
+    NotFoundError,
+    Result,
+)
+from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+
+def _job(name="j1", ns="u", labels=None):
+    j = TpuJob(metadata=ObjectMeta(name=name, namespace=ns),
+               spec=TpuJobSpec())
+    j.metadata.labels = dict(labels or {})
+    return j
+
+
+class TestCachedReader:
+    def _reader(self, api=None):
+        api = api or InMemoryApiServer(registry=MetricsRegistry())
+        reader = CachedReader(api)
+        reader.watch_kind("TpuJob")
+        return api, reader
+
+    def test_serves_reads_from_watch_stream(self):
+        api, reader = self._reader()
+        api.create(_job("a"))
+        api.create(_job("b", labels={"team": "x"}))
+        assert [o.metadata.name for o in reader.list("TpuJob", "u")] == \
+            ["a", "b"]
+        assert [o.metadata.name
+                for o in reader.list("TpuJob", "u",
+                                     label_selector={"team": "x"})] == ["b"]
+        assert reader.get("TpuJob", "a", "u").metadata.name == "a"
+
+    def test_cache_is_zero_copy_over_store_snapshots(self):
+        api, reader = self._reader()
+        api.create(_job("a"))
+        assert reader.list("TpuJob", "u", copy=False)[0] is \
+            api.get("TpuJob", "a", "u", copy=False)
+        # The default (copy=True) hands out a private, mutate-safe object —
+        # the same safe default as every API-server implementation.
+        mine = reader.get("TpuJob", "a", "u")
+        mine.spec.max_restarts = 9
+        assert api.get("TpuJob", "a", "u").spec.max_restarts == 3
+
+    def test_follows_updates_and_deletes(self):
+        api, reader = self._reader()
+        api.create(_job("a"))
+        assert reader.try_get("TpuJob", "a", "u") is not None
+        live = api.get("TpuJob", "a", "u")
+        live.status.phase = "Running"
+        api.update_status(live)
+        assert reader.get("TpuJob", "a", "u").status.phase == "Running"
+        api.delete("TpuJob", "a", "u")
+        assert reader.try_get("TpuJob", "a", "u") is None
+        with pytest.raises(NotFoundError):
+            reader.get("TpuJob", "a", "u")
+
+    def test_unwatched_kind_falls_through_to_api(self):
+        api, reader = self._reader()
+        api.create(Pod(metadata=ObjectMeta(name="p", namespace="u")))
+        assert not reader.caches("Pod")
+        assert [p.metadata.name for p in reader.list("Pod", "u")] == ["p"]
+
+    def test_chaos_injects_ahead_of_the_cache(self):
+        """The chaos wrapper sits between the store and the reader: cached
+        reads are informer reads (never injected, like try_get), while
+        fall-through reads of unwatched kinds still roll the dice."""
+        inner = InMemoryApiServer(registry=MetricsRegistry())
+        chaos = ChaosApiServer(
+            inner, seed=0,
+            rules={"list:Pod": FaultSpec(transient_rate=1.0)},
+            registry=MetricsRegistry(),
+        )
+        reader = CachedReader(chaos)
+        reader.watch_kind("TpuJob")
+        inner.create(_job("a"))
+        assert reader.list("TpuJob", "u")          # cached: no injection
+        with pytest.raises(TransientApiError):
+            reader.list("Pod", "u")                # fall-through: injected
+
+    def test_close_releases_watches(self):
+        api, reader = self._reader()
+        assert len(api._watchers) == 1
+        reader.close()
+        assert len(api._watchers) == 0
+
+    def test_concurrent_writers_cannot_wedge_the_cache_stale(self):
+        """Watch events are emitted under the store lock, so delivery order
+        is write order and a last-wins cache always converges to the live
+        state — racing writers used to be able to enqueue their events
+        inverted and leave the cache stale forever."""
+        import threading
+
+        api, reader = self._reader()
+        api.create(_job("a"))
+
+        def hammer(n):
+            for _ in range(200):
+                try:
+                    live = api.get("TpuJob", "a", "u")
+                    live.status.phase = f"w{n}"
+                    api.update_status(live)
+                except Exception:
+                    pass
+
+        threads = [threading.Thread(target=hammer, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        live = api.get("TpuJob", "a", "u", copy=False)
+        cached = reader.get("TpuJob", "a", "u", copy=False)
+        assert cached is live
+        assert cached.metadata.resource_version == \
+            live.metadata.resource_version
+
+
+class _Echo(Controller):
+    NAME = "echo-cache"
+    WATCH_KINDS = ("TpuJob",)
+
+    def reconcile(self, namespace, name):
+        return Result()
+
+
+class TestManagerLifecycle:
+    def test_register_wires_shared_reader(self):
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        mgr = ControllerManager(api, MetricsRegistry())
+        ctl = _Echo(api, registry=MetricsRegistry())
+        assert ctl.reader is api                   # pre-registration default
+        mgr.register(ctl)
+        assert isinstance(ctl.reader, CachedReader)
+        api.create(_job("a"))
+        assert ctl.reader.get("TpuJob", "a", "u").metadata.name == "a"
+        mgr.close()
+
+    def test_close_releases_every_watch_queue(self):
+        """The leak this PR fixes: a discarded manager's registered watches
+        kept every future event alive forever."""
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        mgr = ControllerManager(api, MetricsRegistry())
+        mgr.register(_Echo(api, registry=MetricsRegistry()))
+        # 1 manager queue + 1 shared-cache subscription for the kind.
+        assert len(api._watchers) == 2
+        mgr.close()
+        assert len(api._watchers) == 0
+        assert mgr.controllers == []
+
+    def test_unregister_single_controller(self):
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        mgr = ControllerManager(api, MetricsRegistry())
+        a = _Echo(api, registry=MetricsRegistry())
+
+        class _Other(_Echo):
+            NAME = "other"
+            WATCH_KINDS = ("Pod",)
+
+        b = _Other(api, registry=MetricsRegistry())
+        mgr.register(a)
+        mgr.register(b)
+        before = len(api._watchers)
+        mgr.unregister(a)
+        assert len(api._watchers) == before - 1
+        assert mgr.controllers == [b]
+        assert a.reader is api                     # reader unwired
+        api.create(_job("x"))
+        mgr.run_until_idle()                       # only b's queues pumped
+        mgr.close()
+
+    def test_kubectl_style_backend_skips_cache(self):
+        """A backend without synchronous watches keeps reader == api."""
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        mgr = ControllerManager(api, MetricsRegistry(), use_cache=False)
+        ctl = _Echo(api, registry=MetricsRegistry())
+        mgr.register(ctl)
+        assert ctl.reader is api
+        mgr.close()

@@ -1,0 +1,73 @@
+"""Control-plane bench driver + latency soak profile (ISSUE 3): the
+tier-1 wiring for ``bench.py controlplane``, the CI ``cp-bench-smoke``
+copy-counter gate, and the chaos layer's latency_s injection."""
+
+import pytest
+
+from kubeflow_tpu.chaos import run_soak
+from kubeflow_tpu.controlplane.benchmark import run_controlplane_sweep
+from kubeflow_tpu.tools.ci import GateFailure, run_cp_bench_smoke
+
+
+class TestControlPlaneSweep:
+    def test_sweep_converges_and_counts(self):
+        rep = run_controlplane_sweep(num_jobs=24, num_namespaces=4)
+        assert rep.all_succeeded, rep.phases
+        assert rep.pods == 24 * 4                 # v5e-16: 4-host gangs
+        assert rep.reconciles > 0
+        assert rep.wall_s > 0
+
+    def test_list_copies_scale_with_matches_not_store(self):
+        """The acceptance assertion at small N: the probe list's deepcopy
+        count equals its matches and stays far under the store size."""
+        rep = run_controlplane_sweep(num_jobs=24, num_namespaces=4)
+        assert rep.list_matches == 6              # 24 jobs / 4 namespaces
+        assert rep.copies_scale_with_matches, (
+            rep.list_copies, rep.list_matches)
+        # Store: 24 jobs + 96 pods + 24 services + events >> 6 matches.
+        assert rep.store_objects > 10 * rep.list_copies
+
+    def test_copy_counts_are_deterministic(self):
+        """Count-based gating only works if the tally is a pure function of
+        the (single-threaded) drive sequence — same run, same numbers."""
+        a = run_controlplane_sweep(num_jobs=8, num_namespaces=2)
+        b = run_controlplane_sweep(num_jobs=8, num_namespaces=2)
+        assert a.copied_during_sweep == b.copied_during_sweep
+        assert (a.list_matches, a.list_copies) == \
+            (b.list_matches, b.list_copies)
+        assert a.reconciles == b.reconciles
+
+    def test_ci_cp_bench_smoke_stage(self):
+        run_cp_bench_smoke(num_jobs=20, num_namespaces=4)
+
+    def test_ci_gate_raises_on_unconverged(self, monkeypatch):
+        import kubeflow_tpu.tools.ci as ci
+
+        def broken(**kw):
+            rep = run_controlplane_sweep(num_jobs=4, num_namespaces=2)
+            rep.all_succeeded = False
+            return rep
+
+        monkeypatch.setattr(
+            "kubeflow_tpu.controlplane.benchmark.run_controlplane_sweep",
+            broken)
+        with pytest.raises(GateFailure, match="converge"):
+            ci.run_cp_bench_smoke(num_jobs=4, num_namespaces=2)
+
+
+class TestLatencySoakProfile:
+    def test_latency_soak_converges(self):
+        """The ROADMAP follow-up made tier-1: per-verb injected latency —
+        a slow apiserver — must not deadlock the backoff timers or the
+        cached read path; the fleet still fully converges."""
+        rep = run_soak(num_jobs=2, seed=5, conflict_rate=0.2,
+                       transient_rate=0.05, latency_s=0.002,
+                       fault_rounds=6, max_rounds=40)
+        assert rep.converged, rep.stuck_jobs()
+        assert rep.all_succeeded, rep.phases
+        assert rep.availability == 1.0
+
+    def test_ci_latency_smoke_variant(self):
+        from kubeflow_tpu.tools.ci import run_chaos_smoke
+
+        run_chaos_smoke(seed=20260803, latency_s=0.001)
