@@ -31,8 +31,11 @@ reconciler's backoff limiter; that is the contract chaos exercises.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import queue as queue_mod
 import random
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -71,12 +74,106 @@ class FaultSpec:
             raise ValueError(f"fault rates sum to {total} > 1")
 
 
+class _LaggedQueue:
+    """A watch queue that releases events only after a hold-down lag —
+    the ROADMAP "watch-lag injection" follow-up: a real informer stream
+    lags its apiserver under load, and controllers must converge anyway.
+
+    Duck-types the queue surface the reconciler and CachedReader use
+    (``empty``/``get``): an event enqueued at write time T becomes
+    *visible* at ``T + lag`` (lag read per-event, so ``quiesce()`` releases
+    everything immediately). Delivery order is preserved — lag delays, it
+    never reorders. The injected lag lands in the manager's
+    ``kftpu_watch_delivery_lag_seconds`` histogram because events keep
+    their original ``ts_mono`` write stamp."""
+
+    def __init__(self, inner: Any, lag_fn):
+        self.inner = inner           # the real subscription queue
+        self._lag_fn = lag_fn
+        # (base_mono, event): release time is computed lazily as
+        # base + lag() so quiesce() (lag -> 0) releases held events
+        # immediately instead of serving out their old sentences.
+        # _held is guarded by _lock: the manager's background pump thread
+        # and probers calling is_idle()/empty() race otherwise (and a
+        # non-atomic empty()+blocking get() pair could wedge a thread on
+        # an event another consumer just took).
+        self._held: "collections.deque" = collections.deque()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _base(ev: Any) -> float:
+        ts = getattr(ev, "ts_mono", 0.0)
+        return ts if ts > 0 else time.monotonic()
+
+    def _pump_locked(self) -> None:
+        # Non-blocking drain: never hold a blocking inner.get() under the
+        # race where another thread drained the event first.
+        while True:
+            try:
+                ev = self.inner.get(block=False)
+            except queue_mod.Empty:
+                return
+            self._held.append((self._base(ev), ev))
+
+    def _release_at(self, base: float) -> float:
+        return base + float(self._lag_fn())
+
+    def empty(self) -> bool:
+        with self._lock:
+            self._pump_locked()
+            return not (
+                self._held
+                and self._release_at(self._held[0][0]) <= time.monotonic()
+            )
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        while True:
+            with self._lock:
+                self._pump_locked()
+                if self._held:
+                    base, ev = self._held[0]
+                    wait = self._release_at(base) - time.monotonic()
+                    if wait <= 0:
+                        self._held.popleft()
+                        return ev
+                else:
+                    wait = None     # nothing held: wait on the inner queue
+            if not block:
+                raise queue_mod.Empty
+            if wait is None:
+                # Block (bounded) for an arrival, then loop to re-evaluate
+                # under the lock — the arrival still serves its lag.
+                remaining = None if deadline is None \
+                    else max(0.0, deadline - time.monotonic())
+                ev = self.inner.get(block=True, timeout=remaining)
+                with self._lock:
+                    self._held.append((self._base(ev), ev))
+                continue
+            if deadline is not None and time.monotonic() + wait > deadline:
+                # queue.Queue contract: a timed get must not overstay its
+                # timeout serving out the injected lag.
+                time.sleep(max(0.0, deadline - time.monotonic()))
+                raise queue_mod.Empty
+            time.sleep(wait)
+
+    def qsize(self) -> int:
+        with self._lock:
+            self._pump_locked()
+            return len(self._held) + self.inner.qsize()
+
+
 class ChaosApiServer:
     """Seeded fault-injection proxy for :class:`InMemoryApiServer`.
 
     ``rules`` maps ``"verb:kind"`` patterns to :class:`FaultSpec`; either
     side may be ``*``. The most specific match wins:
     ``verb:kind > verb:* > *:kind > *:*``.
+
+    ``watch_lag_s`` > 0 additionally wraps every subsequent ``watch()``
+    subscription in a :class:`_LaggedQueue` delaying event visibility —
+    the watch-delivery analogue of ``FaultSpec.latency_s``.
     """
 
     def __init__(
@@ -86,10 +183,12 @@ class ChaosApiServer:
         seed: int = 0,
         rules: Optional[Dict[str, FaultSpec]] = None,
         registry: MetricsRegistry = global_registry,
+        watch_lag_s: float = 0.0,
     ):
         self.inner = inner
         self.rng = random.Random(seed)
         self.rules = dict(rules or {})
+        self.watch_lag_s = float(watch_lag_s)
         self.enabled = True
         # Plain-dict tally ("verb:kind:fault" -> n) for cheap test asserts
         # and determinism comparisons, next to the exported counter.
@@ -108,11 +207,33 @@ class ChaosApiServer:
         self.rules[pattern] = spec
 
     def quiesce(self) -> None:
-        """Stop injecting (the 'faults stop' phase of a soak)."""
+        """Stop injecting (the 'faults stop' phase of a soak). Also zeroes
+        the *effective* watch lag: held events release immediately."""
         self.enabled = False
 
     def resume(self) -> None:
         self.enabled = True
+
+    def set_watch_lag(self, lag_s: float) -> None:
+        """Delay event visibility on every lag-wrapped subscription (those
+        made after construction with ``watch_lag_s`` > 0, or after this
+        call). Applies to in-flight held events too — the lag is read per
+        ``empty()``/``get()``."""
+        self.watch_lag_s = float(lag_s)
+
+    # ----------------- watch (lag injection point) -----------------
+
+    def watch(self, kind: Optional[str] = None):
+        q = self.inner.watch(kind)
+        if self.watch_lag_s <= 0:
+            return q
+        return _LaggedQueue(
+            q, lambda: self.watch_lag_s if self.enabled else 0.0
+        )
+
+    def stop_watch(self, q: Any) -> None:
+        # Unwrap lag-injected subscriptions back to the real queue.
+        self.inner.stop_watch(getattr(q, "inner", q))
 
     # ----------------- injection -----------------
 
@@ -200,10 +321,11 @@ class ChaosApiServer:
         self._maybe_inject("list", kind, namespace or "")
         return self.inner.list(kind, namespace, label_selector, copy=copy)
 
-    # Everything else (watch, stop_watch, register_mutator, internals the
-    # CI gate inspects) passes straight through — watches never drop
-    # events: a real informer re-lists through transient failures, so
-    # modelling lossy watches would test a failure mode the client
-    # machinery already hides.
+    # Everything else (register_mutator, internals the CI gate inspects)
+    # passes straight through. Watches never DROP events — a real informer
+    # re-lists through transient failures, so modelling lossy watches would
+    # test a failure mode the client machinery already hides — but they can
+    # be DELAYED (watch_lag_s above): delivery lag is real informer
+    # behaviour under load, and the thing the watch-lag histogram measures.
     def __getattr__(self, name: str) -> Any:
         return getattr(self.inner, name)

@@ -48,6 +48,13 @@ class SoakReport:
     job_preemption_restarts: int     # sum of status.preemptions
     retries_total: float             # sum of kftpu_*_retries_total
     availability: float              # kftpu_availability after the soak
+    # Latency decomposition under chaos (ISSUE 4): p50/p95/p99 from the
+    # kernel histograms — the soak's answer to "how slow did faults make
+    # the loop", next to "did it converge".
+    reconcile_latency_s: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    queue_wait_s: Dict[str, float] = dataclasses.field(default_factory=dict)
+    watch_lag_s: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     def stuck_jobs(self) -> Dict[str, str]:
         return {n: p for n, p in self.phases.items() if p not in TERMINAL}
@@ -71,10 +78,11 @@ def run_soak(
     slice_type: str = "v5e-16",
     constrained_capacity: bool = True,
     latency_s: float = 0.0,          # per-verb injected API latency
+    watch_lag_s: float = 0.0,        # injected watch-delivery lag
     registry: Optional[MetricsRegistry] = None,
 ) -> SoakReport:
     registry = registry or MetricsRegistry()
-    inner = InMemoryApiServer()
+    inner = InMemoryApiServer(registry=registry)
     # ``latency_s`` models a slow apiserver on every chaos-visible verb —
     # the tier-1 latency soak profile (docs/chaos.md): backoff timers and
     # informer-cache reads must converge, not deadlock, under slow APIs.
@@ -98,7 +106,11 @@ def run_soak(
         # it unconditionally would shift the fault sequence of every
         # existing seed.
         rules["get:*"] = FaultSpec(latency_s=latency_s)
-    chaos = ChaosApiServer(inner, seed=seed, registry=registry, rules=rules)
+    # watch_lag_s delays watch-event visibility (the informer-lag soak
+    # profile): the manager's watch-lag histogram must absorb it and the
+    # fleet must still converge once faults stop (lag quiesces with them).
+    chaos = ChaosApiServer(inner, seed=seed, registry=registry, rules=rules,
+                           watch_lag_s=watch_lag_s)
     capacity = {slice_type: num_jobs} if constrained_capacity else None
     mgr = ControllerManager(
         chaos, registry,
@@ -153,9 +165,16 @@ def run_soak(
     # parked admission/backoff timers all fire and the fleet drains.
     fault_window, drain_window = 2.0, 120.0
     rounds = 0
+    import time as _time
+
     for r in range(max_rounds):
         rounds = r + 1
         window = fault_window if chaos.enabled else drain_window
+        if watch_lag_s > 0 and chaos.enabled:
+            # Let held watch events mature past the injected lag so each
+            # round makes progress instead of burning the round budget
+            # spinning against invisible queues.
+            _time.sleep(watch_lag_s)
         mgr.run_until_idle(max_iterations=50000,
                            include_timers_within=window)
         kubelet.tick()
@@ -196,6 +215,11 @@ def run_soak(
         ),
         retries_total=retries,
         availability=availability,
+        reconcile_latency_s=registry.percentiles(
+            "kftpu_reconcile_duration_seconds"),
+        queue_wait_s=registry.percentiles("kftpu_workqueue_wait_seconds"),
+        watch_lag_s=registry.percentiles(
+            "kftpu_watch_delivery_lag_seconds"),
     )
     log.info("soak done", kv={
         "converged": converged, "rounds": rounds,

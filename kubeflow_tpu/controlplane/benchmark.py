@@ -12,6 +12,10 @@ numbers ISSUE 3 puts on the scoreboard:
   ``list("TpuJob", ns)`` copies O(matches) objects — never O(store).
   Counts, not wall-clock, so the CI ``cp-bench-smoke`` gate built on this
   driver cannot flake.
+- **latency decomposition** (ISSUE 4): p50/p95/p99 of reconcile
+  execution, queue wait and watch-delivery lag from the kernel's
+  histograms, so BENCH files track *where time goes*, not just
+  throughput.
 
 Everything is in-process and sleep-free (``run_until_idle`` +
 ``kubelet.tick``), so N=1000 jobs x 4-host gangs runs in seconds.
@@ -32,6 +36,7 @@ from kubeflow_tpu.controlplane.runtime import (
     InMemoryApiServer,
 )
 from kubeflow_tpu.utils.monitoring import MetricsRegistry
+from kubeflow_tpu.utils.tracing import Tracer
 
 
 @dataclasses.dataclass
@@ -50,6 +55,16 @@ class ControlPlaneReport:
     probe_namespace: str
     list_matches: int             # jobs the probe list returned
     list_copies: int              # deepcopies that list performed
+    # Latency decomposition (ISSUE 4): p50/p95/p99 over the sweep, from
+    # the kernel's histograms. Empty dicts when nothing was observed.
+    reconcile_latency_s: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    queue_wait_s: Dict[str, float] = dataclasses.field(default_factory=dict)
+    watch_lag_s: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # Reconcile spans retained in the tracer's bounded ring — equals
+    # `reconciles` while under ring capacity (what obs-smoke gates on);
+    # large sweeps keep only the newest spans by design.
+    reconcile_spans: int = 0
 
     @property
     def copies_scale_with_matches(self) -> bool:
@@ -72,6 +87,10 @@ class ControlPlaneReport:
             "list_matches": self.list_matches,
             "list_copies": self.list_copies,
             "copies_scale_with_matches": self.copies_scale_with_matches,
+            "reconcile_latency_s": dict(self.reconcile_latency_s),
+            "queue_wait_s": dict(self.queue_wait_s),
+            "watch_lag_s": dict(self.watch_lag_s),
+            "reconcile_spans": self.reconcile_spans,
         }
 
 
@@ -82,13 +101,17 @@ def run_controlplane_sweep(
     slice_type: str = "v5e-16",      # 4 hosts -> 4 worker pods per job
     max_rounds: int = 12,
     registry: Optional[MetricsRegistry] = None,
+    tracer: Optional[Tracer] = None,
 ) -> ControlPlaneReport:
     if num_jobs < 1 or num_namespaces < 1:
         raise ValueError("num_jobs and num_namespaces must be >= 1")
     num_namespaces = min(num_namespaces, num_jobs)
     registry = registry or MetricsRegistry()
-    api = InMemoryApiServer(registry=registry)
-    mgr = ControllerManager(api, registry)
+    # A private tracer per sweep: the ring buffer bounds memory and the
+    # CI obs-smoke stage counts reconcile spans out of it.
+    tracer = tracer or Tracer()
+    api = InMemoryApiServer(registry=registry, tracer=tracer)
+    mgr = ControllerManager(api, registry, tracer=tracer)
     job_ctl = TpuJobController(api, registry, hbm_check=False)
     mgr.register(job_ctl)
     kubelet = FakeKubelet(api, registry,
@@ -145,6 +168,7 @@ def run_controlplane_sweep(
     phase_tally: Dict[str, int] = {}
     for j in api.list("TpuJob", copy=False):
         phase_tally[j.status.phase] = phase_tally.get(j.status.phase, 0) + 1
+
     report = ControlPlaneReport(
         jobs=num_jobs,
         pods=num_jobs * hosts,
@@ -159,6 +183,12 @@ def run_controlplane_sweep(
         probe_namespace=probe_ns,
         list_matches=len(matches),
         list_copies=list_copies,
+        reconcile_latency_s=registry.percentiles(
+            "kftpu_reconcile_duration_seconds"),
+        queue_wait_s=registry.percentiles("kftpu_workqueue_wait_seconds"),
+        watch_lag_s=registry.percentiles(
+            "kftpu_watch_delivery_lag_seconds"),
+        reconcile_spans=len(tracer.spans("reconcile")),
     )
     mgr.close()     # throwaway manager: release its watch queues
     return report

@@ -37,8 +37,11 @@ from kubeflow_tpu.controlplane.runtime import (
 )
 from kubeflow_tpu.utils import get_logger
 from kubeflow_tpu.utils.monitoring import MetricsRegistry
+from kubeflow_tpu.utils.tracing import Tracer
 
 log = get_logger("platform")
+
+TRACE_FILE = "trace.jsonl"
 
 DEFAULT_COMPONENTS = (
     "tpujob-controller",
@@ -61,10 +64,18 @@ _START_ORDER = {name: i for i, name in enumerate(DEFAULT_COMPONENTS)}
 
 
 class Platform:
-    def __init__(self, registry: Optional[MetricsRegistry] = None):
-        self.api = InMemoryApiServer()
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 tracer: Optional[Tracer] = None):
         self.registry = registry or MetricsRegistry()
-        self.manager = ControllerManager(self.api)
+        # Per-platform tracer + registry on the apiserver and the manager
+        # (not the process-global ones): `tpuctl metrics` renders THIS
+        # registry, so the verb/reconcile histograms must land here, and
+        # two Platforms in one process must not interleave their traces.
+        self.tracer = tracer or Tracer()
+        self.api = InMemoryApiServer(registry=self.registry,
+                                     tracer=self.tracer)
+        self.manager = ControllerManager(self.api, self.registry,
+                                         tracer=self.tracer)
         self.kfam: Optional[AccessManagement] = None
         self.jwa = None          # NotebookWebApp when enabled
         self.dashboard = None    # DashboardApi when enabled
@@ -311,6 +322,13 @@ class Platform:
         }
         with open(path, "w") as f:
             yaml.safe_dump_all([meta] + docs, f, sort_keys=False)
+        # Append spans recorded since the last save so `tpuctl trace` can
+        # reconstruct causal timelines across tpuctl invocations; the file
+        # is trimmed to its newest half past 4 MB (the ring is bounded,
+        # the state dir must be too).
+        trace_path = os.path.join(state_dir, TRACE_FILE)
+        self.tracer.export_new_jsonl(trace_path)
+        self.tracer.trim_jsonl(trace_path)
         return path
 
     @classmethod

@@ -35,7 +35,12 @@ from kubeflow_tpu.controlplane.runtime.ratelimiter import (
     ExponentialBackoffLimiter,
 )
 from kubeflow_tpu.utils import get_logger
-from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
+from kubeflow_tpu.utils.monitoring import (
+    MetricsRegistry,
+    global_registry,
+    sanitize_metric_name,
+)
+from kubeflow_tpu.utils.tracing import SpanContext, Tracer, global_tracer
 
 
 @dataclasses.dataclass
@@ -170,13 +175,17 @@ class Controller:
         # supports synchronous watches.
         self.reader: Any = api
         self.log = get_logger(self.NAME)
+        # Sanitized interpolation: NAMEs like "fake-kubelet" must not
+        # produce exposition-illegal metric names (CI obs-smoke parses
+        # the scrape).
+        mname = sanitize_metric_name(self.NAME)
         self.metrics_reconcile = registry.counter(
-            f"kftpu_{self.NAME}_reconcile_total",
+            f"kftpu_{mname}_reconcile_total",
             f"Reconcile outcomes for {self.NAME}",
             labels=("result",),
         )
         self.metrics_retries = registry.counter(
-            f"kftpu_{self.NAME}_retries_total",
+            f"kftpu_{mname}_retries_total",
             f"Requeues after failed reconciles for {self.NAME}",
             labels=("reason",),
         )
@@ -214,6 +223,11 @@ class ControllerManager:
     #: storm can't spin the queue hot.
     CONFLICT_IMMEDIATE_RETRIES = 5
 
+    #: Causal links kept per pending key: events that dedup into an
+    #: already-queued key append their write span, capped so a hot key
+    #: cannot grow an unbounded link list.
+    MAX_LINKS_PER_KEY = 4
+
     def __init__(
         self,
         api: InMemoryApiServer,
@@ -221,8 +235,10 @@ class ControllerManager:
         *,
         limiter: Optional[ExponentialBackoffLimiter] = None,
         use_cache: Optional[bool] = None,
+        tracer: Tracer = global_tracer,
     ):
         self.api = api
+        self.tracer = tracer
         self.controllers: List[Controller] = []
         self.limiter = limiter or ExponentialBackoffLimiter()
         self._queues: List[Any] = []
@@ -242,6 +258,14 @@ class ControllerManager:
         self._pending: "collections.deque[Tuple[Controller, Tuple[str, str]]]" = \
             collections.deque()
         self._pending_set: set = set()
+        # Per-pending-key observability meta (under self._lock, popped at
+        # dequeue): first-enqueue monotonic time (queue-wait measurement)
+        # and the span contexts of the writes whose events enqueued it
+        # (reconcile-span links).
+        self._pending_meta: Dict[
+            Tuple[Controller, Tuple[str, str]],
+            Tuple[float, List[SpanContext]],
+        ] = {}
         self._timers: List[Tuple[float, int, Controller, Tuple[str, str]]] = []
         self._timer_seq = 0
         self._thread: Optional[threading.Thread] = None
@@ -275,6 +299,32 @@ class ControllerManager:
             "Keys with a nonzero failure count in the backoff limiter",
             fn=_of_manager(lambda m: float(m.limiter.tracked_keys())),
         )
+        # Latency decomposition (ISSUE 4): where a key's end-to-end time
+        # goes — write → watch delivery → queue wait → reconcile. Queue
+        # wait and watch lag get a wider tail than the verb/reconcile
+        # histograms: at fleet scale a key legitimately waits tens of
+        # seconds behind thousands of peers, and clamping at 5s would
+        # erase exactly the signal this layer exists to expose.
+        from kubeflow_tpu.utils.monitoring import DEFAULT_LATENCY_BUCKETS
+
+        wait_buckets = DEFAULT_LATENCY_BUCKETS + (10.0, 30.0, 60.0, 120.0)
+        self.metrics_reconcile_latency = registry.histogram(
+            "kftpu_reconcile_duration_seconds",
+            "Reconcile execution latency",
+            labels=("controller", "result"),
+        )
+        self.metrics_queue_wait = registry.histogram(
+            "kftpu_workqueue_wait_seconds",
+            "Enqueue-to-dequeue wait in the immediate work queue",
+            labels=("controller",),
+            buckets=wait_buckets,
+        )
+        self.metrics_watch_lag = registry.histogram(
+            "kftpu_watch_delivery_lag_seconds",
+            "Write-to-drain lag of watch events",
+            labels=("controller",),
+            buckets=wait_buckets,
+        )
 
     def register(self, ctl: Controller) -> None:
         self.controllers.append(ctl)
@@ -300,6 +350,8 @@ class ControllerManager:
             )
             self._pending_set = {(c, k) for c, k in self._pending_set
                                  if c is not ctl}
+            self._pending_meta = {pk: m for pk, m in self._pending_meta.items()
+                                  if pk[0] is not ctl}
             self._timers = [t for t in self._timers if t[2] is not ctl]
             heapq.heapify(self._timers)
         ctl.reader = ctl.api
@@ -323,31 +375,50 @@ class ControllerManager:
 
     def _drain_watches(self) -> int:
         n = 0
+        now = time.monotonic()
         for ctl, primary, q in self._queues:
             while not q.empty():
                 ev = q.get()
                 n += 1
+                if ev.ts_mono > 0:
+                    # Write-time → drain-time lag; under chaos watch-lag
+                    # injection this provably includes the injected delay.
+                    self.metrics_watch_lag.observe(
+                        max(0.0, now - ev.ts_mono), controller=ctl.NAME)
                 if primary:
                     key = (ev.object.metadata.namespace, ev.object.metadata.name)
                 else:
                     key = ctl.map_to_primary(ev.object)
                 if key is not None:
-                    self._enqueue(ctl, key)
+                    self._enqueue(ctl, key, link=ev.span_ctx)
         return n
 
-    def _pending_add_locked(self, ctl: Controller, key: Tuple[str, str]) -> None:
+    def _pending_add_locked(self, ctl: Controller, key: Tuple[str, str],
+                            link: Optional[SpanContext] = None) -> None:
         if ctl not in self.controllers:
             # unregister() raced a pump thread still draining the released
             # queue: drop the key instead of reconciling a controller the
             # caller already tore down.
             return
-        if (ctl, key) not in self._pending_set:
-            self._pending_set.add((ctl, key))
-            self._pending.append((ctl, key))
+        pkey = (ctl, key)
+        if pkey not in self._pending_set:
+            self._pending_set.add(pkey)
+            self._pending.append(pkey)
+            self._pending_meta[pkey] = (
+                time.monotonic(), [link] if link is not None else []
+            )
+        elif link is not None:
+            # Deduped into an existing entry: keep the causal link (bounded)
+            # so the one reconcile that retires N collapsed events can
+            # point back at each triggering write.
+            meta = self._pending_meta.get(pkey)
+            if meta is not None and len(meta[1]) < self.MAX_LINKS_PER_KEY:
+                meta[1].append(link)
 
-    def _enqueue(self, ctl: Controller, key: Tuple[str, str]) -> None:
+    def _enqueue(self, ctl: Controller, key: Tuple[str, str],
+                 link: Optional[SpanContext] = None) -> None:
         with self._lock:
-            self._pending_add_locked(ctl, key)
+            self._pending_add_locked(ctl, key, link)
 
     def _due_timers(self) -> None:
         now = time.time()
@@ -369,39 +440,70 @@ class ControllerManager:
                 return False
             ctl, key = self._pending.popleft()
             self._pending_set.discard((ctl, key))
+            meta = self._pending_meta.pop((ctl, key), None)
+        links: List[SpanContext] = []
+        if meta is not None:
+            self.metrics_queue_wait.observe(
+                max(0.0, time.monotonic() - meta[0]), controller=ctl.NAME)
+            links = meta[1]
         lkey = (ctl.NAME, key)
-        try:
-            res = ctl.reconcile(*key) or Result()
-            ctl.metrics_reconcile.inc(result="ok")
-            self.limiter.forget(lkey)
-            if res.requeue_after is not None:
-                self._schedule(ctl, key, res.requeue_after)
-        except ConflictError:
-            # Stale read: immediate requeue (re-read, re-apply — the
-            # standard informer dance) while the conflicts look transient;
-            # a key that keeps losing the write race backs off instead.
-            ctl.metrics_reconcile.inc(result="conflict")
-            ctl.metrics_retries.inc(reason="conflict")
-            delay = self.limiter.next_delay(lkey)
-            if self.limiter.failures(lkey) <= self.CONFLICT_IMMEDIATE_RETRIES:
-                self._enqueue(ctl, key)
-            else:
+        # The reconcile span ADOPTS the trace of the write that enqueued it
+        # (first link), so one trace id covers write → watch → reconcile →
+        # the status updates made inside (those nest via the contextvar).
+        with self.tracer.span(
+            "reconcile",
+            attrs={"controller": ctl.NAME, "namespace": key[0],
+                   "name": key[1]},
+            links=links,
+            trace_id=links[0][0] if links else None,
+        ) as span:
+            outcome = "ok"
+            try:
+                res = ctl.reconcile(*key) or Result()
+                ctl.metrics_reconcile.inc(result="ok")
+                self.limiter.forget(lkey)
+                if res.requeue_after is not None:
+                    span.attrs["requeue_after_s"] = res.requeue_after
+                    self._schedule(ctl, key, res.requeue_after)
+            except ConflictError:
+                # Stale read: immediate requeue (re-read, re-apply — the
+                # standard informer dance) while the conflicts look
+                # transient; a key that keeps losing the write race backs
+                # off instead.
+                outcome = "conflict"
+                ctl.metrics_reconcile.inc(result="conflict")
+                ctl.metrics_retries.inc(reason="conflict")
+                delay = self.limiter.next_delay(lkey)
+                if self.limiter.failures(lkey) <= self.CONFLICT_IMMEDIATE_RETRIES:
+                    self._enqueue(ctl, key)
+                else:
+                    span.attrs["backoff_s"] = delay
+                    self._schedule(ctl, key, delay)
+            except NotFoundError:
+                # A NotFound from arbitrary API calls mid-reconcile is a
+                # race (dependent deleted under us, injected fault), not
+                # proof the primary is gone — retry with backoff; if the
+                # primary really was deleted the next pass exits cleanly
+                # via try_get.
+                outcome = "gone"
+                ctl.metrics_reconcile.inc(result="gone")
+                ctl.metrics_retries.inc(reason="not_found")
+                delay = self.limiter.next_delay(lkey)
+                span.attrs["backoff_s"] = delay
                 self._schedule(ctl, key, delay)
-        except NotFoundError:
-            # A NotFound from arbitrary API calls mid-reconcile is a race
-            # (dependent deleted under us, injected fault), not proof the
-            # primary is gone — retry with backoff; if the primary really
-            # was deleted the next pass exits cleanly via try_get.
-            ctl.metrics_reconcile.inc(result="gone")
-            ctl.metrics_retries.inc(reason="not_found")
-            self._schedule(ctl, key, self.limiter.next_delay(lkey))
-        except Exception:
-            ctl.metrics_reconcile.inc(result="error")
-            ctl.metrics_retries.inc(reason="error")
-            ctl.log.error(
-                f"reconcile {key} failed:\n{traceback.format_exc()}"
-            )
-            self._schedule(ctl, key, self.limiter.next_delay(lkey))
+            except Exception:
+                outcome = "error"
+                ctl.metrics_reconcile.inc(result="error")
+                ctl.metrics_retries.inc(reason="error")
+                ctl.log.error(
+                    f"reconcile {key} failed:\n{traceback.format_exc()}"
+                )
+                delay = self.limiter.next_delay(lkey)
+                span.attrs["backoff_s"] = delay
+                self._schedule(ctl, key, delay)
+            span.attrs["outcome"] = outcome
+        self.metrics_reconcile_latency.observe(
+            span.duration_s, controller=ctl.NAME, result=outcome)
         ctl.heartbeat.beat()
         return True
 

@@ -25,7 +25,11 @@ Stages (any failure exits non-zero — the merge gate contract):
    (kubeflow_tpu.controlplane.benchmark) gated on the *deterministic*
    copies-per-list counter: a namespaced list must deepcopy exactly its
    matches, never the store (count-based, not wall-clock — cannot flake).
-7. **bench-gate**: if --bench-json is given, require
+7. **obs-smoke**: scrape a live MetricsHttpServer during a small fleet
+   sweep; assert the exposition parses (histograms included) and that
+   one reconcile span + one histogram observation exists per reconcile
+   executed — count-based, no wall-clock flake (docs/observability.md).
+8. **bench-gate**: if --bench-json is given, require
    ``vs_baseline >= --min-vs-baseline`` for every record — the perf
    regression gate SURVEY §7.8 prescribes.
 """
@@ -81,6 +85,69 @@ def run_chaos_smoke(seed: int = 20260803, latency_s: float = 0.0) -> None:
         )
 
 
+def run_obs_smoke(num_jobs: int = 10, num_namespaces: int = 2) -> None:
+    """Observability smoke (ISSUE 4): run a small control-plane fleet with
+    a live MetricsHttpServer attached, scrape it, and assert — **by
+    count, never wall-clock** — that
+
+    - the text exposition round-trips through the parser (histograms
+      included: cumulative buckets, ``+Inf`` == ``_count``);
+    - the scraped reconcile-duration count equals the sweep's reconcile
+      count (every reconcile was observed exactly once);
+    - the tracer exported one reconcile span per reconcile.
+    """
+    from urllib.request import urlopen
+
+    from kubeflow_tpu.controlplane.benchmark import run_controlplane_sweep
+    from kubeflow_tpu.utils.monitoring import (
+        MetricsHttpServer,
+        MetricsRegistry,
+        parse_exposition,
+    )
+    from kubeflow_tpu.utils.tracing import Tracer
+
+    registry = MetricsRegistry()
+    tracer = Tracer(capacity=100_000)   # never evict at smoke scale
+    rep = run_controlplane_sweep(num_jobs=num_jobs,
+                                 num_namespaces=num_namespaces,
+                                 registry=registry, tracer=tracer)
+    if not rep.all_succeeded:
+        raise GateFailure(f"obs-smoke: sweep did not converge: {rep.phases}")
+    srv = MetricsHttpServer(registry, port=0, host="127.0.0.1")
+    try:
+        with urlopen(f"http://127.0.0.1:{srv.port}/metrics",
+                     timeout=10) as resp:
+            text = resp.read().decode()
+    finally:
+        srv.stop()
+    try:
+        samples = parse_exposition(text)
+    except ValueError as e:
+        raise GateFailure(f"obs-smoke: exposition does not parse: {e}")
+    counts = [v for name, labels, v in samples
+              if name == "kftpu_reconcile_duration_seconds_count"]
+    inf_buckets = sum(
+        v for name, labels, v in samples
+        if name == "kftpu_reconcile_duration_seconds_bucket"
+        and labels.get("le") == "+Inf"
+    )
+    if int(sum(counts)) != rep.reconciles:
+        raise GateFailure(
+            f"obs-smoke: scraped reconcile histogram count {sum(counts)} "
+            f"!= {rep.reconciles} reconciles executed"
+        )
+    if int(inf_buckets) != rep.reconciles:
+        raise GateFailure(
+            f"obs-smoke: +Inf bucket {inf_buckets} != _count "
+            f"{rep.reconciles} — cumulative exposition broken"
+        )
+    if rep.reconcile_spans != rep.reconciles:
+        raise GateFailure(
+            f"obs-smoke: {rep.reconcile_spans} reconcile spans exported "
+            f"for {rep.reconciles} reconciles"
+        )
+
+
 def run_cp_bench_smoke(num_jobs: int = 50, num_namespaces: int = 5) -> None:
     """Small control-plane sweep gated on the deterministic copy counter:
     the probe list must deepcopy exactly its matches (O(matches)), and the
@@ -107,7 +174,8 @@ def run_cp_bench_smoke(num_jobs: int = 50, num_namespaces: int = 5) -> None:
 def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
              skip_smoke: bool = False, skip_chaos: bool = False,
              chaos_seed: int = 20260803, chaos_latency_s: float = 0.0,
-             skip_cp_bench: bool = False) -> List[str]:
+             skip_cp_bench: bool = False,
+             skip_obs: bool = False) -> List[str]:
     """Run all stages; returns the list of passed stages, raises
     GateFailure on the first failing one."""
     passed: List[str] = []
@@ -189,6 +257,11 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
         run_cp_bench_smoke()
         passed.append("cp-bench-smoke")
 
+    if not skip_obs:
+        _stage("obs-smoke")
+        run_obs_smoke()
+        passed.append("obs-smoke")
+
     if bench_json:
         _stage("bench-gate")
         with open(bench_json) as f:
@@ -226,6 +299,8 @@ def main(argv=None) -> int:
                         "per-verb injected API latency (0 = skip)")
     g.add_argument("--skip-cp-bench", action="store_true",
                    help="skip the control-plane copy-counter smoke")
+    g.add_argument("--skip-obs", action="store_true",
+                   help="skip the observability scrape/trace smoke")
     args = p.parse_args(argv)
     try:
         passed = run_gate(
@@ -236,6 +311,7 @@ def main(argv=None) -> int:
             chaos_seed=args.chaos_seed,
             chaos_latency_s=args.chaos_latency_s,
             skip_cp_bench=args.skip_cp_bench,
+            skip_obs=args.skip_obs,
         )
     except GateFailure as e:
         print(f"[ci] FAIL: {e}", file=sys.stderr)

@@ -16,6 +16,10 @@ Usage:
   tpuctl delete -f job.yaml | --kind TpuJob --name x -n ns  --state-dir .tpuctl
   tpuctl metrics --state-dir .tpuctl
   tpuctl logs   <pod | tpujob> -n ns   (gang logs; kubectl logs passthrough)
+  tpuctl trace  <kind>/<name> [-n ns]  (causal write->watch->reconcile
+                timeline from the state dir's recorded spans)
+  tpuctl top    --url http://host:port/metrics  (per-controller reconcile
+                p50/p95/p99 from a live exposition scrape)
 
 Backends (--backend):
   state    (default) the embedded Platform: in-memory apiserver + local
@@ -269,6 +273,167 @@ def cmd_delete(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Causal timeline for one object from the state dir's span record
+    (written by Platform.save on every state-backend command): the write
+    that created/mutated it, the reconciles its watch events triggered
+    (linked by span context), and the status updates nested inside them.
+
+    The tentpole's reading surface: where `tpuctl metrics` says how MANY
+    reconciles ran, `trace` says where the time between a write and its
+    convergence went."""
+    from kubeflow_tpu.controlplane.platform import TRACE_FILE
+    from kubeflow_tpu.utils.tracing import Tracer, assemble_trace
+
+    if "/" not in args.target:
+        print("trace target must be <kind>/<name>", file=sys.stderr)
+        return 2
+    kind, name = args.target.split("/", 1)
+    path = os.path.join(args.state_dir, TRACE_FILE)
+    if not os.path.exists(path):
+        print(f"no trace recorded under {args.state_dir} "
+              "(state-backend commands record one on save)", file=sys.stderr)
+        return 1
+    spans = Tracer.load_jsonl(path)
+    if not args.namespace:
+        # Without -n the reference filter matches every namespace; two
+        # same-named objects would silently merge into one timeline whose
+        # footer sums durations belonging to neither. Refuse instead.
+        namespaces = {
+            s.attrs.get("namespace") or ""
+            for s in spans
+            if s.attrs.get("name") == name
+            and s.attrs.get("kind") == kind
+        } - {""}
+        if len(namespaces) > 1:
+            print(f"{kind}/{name} exists in multiple namespaces "
+                  f"({', '.join(sorted(namespaces))}); pass -n",
+                  file=sys.stderr)
+            return 2
+    trace = assemble_trace(spans, kind, name, args.namespace or "")
+    if not trace:
+        print(f"no spans reference {kind}/{name}", file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(json.dumps([s.to_dict() for s in trace]))
+        return 0
+
+    t0 = min(s.start_unix for s in trace)
+    t_end = max(s.start_unix + max(s.duration_s, 0.0) for s in trace)
+    by_id = {s.span_id for s in trace}
+    print(f"TRACE {kind}/{args.namespace + '/' if args.namespace else ''}"
+          f"{name} — {len(trace)} spans, "
+          f"{len({s.trace_id for s in trace})} trace(s), "
+          f"timeline {(t_end - t0) * 1e3:.1f}ms")
+    reconcile_total = 0.0
+    reconciles = 0
+    for s in trace:
+        off_ms = (s.start_unix - t0) * 1e3
+        dur_ms = max(s.duration_s, 0.0) * 1e3
+        indent = "  " if s.parent_id in by_id else ""
+        a = s.attrs
+        if s.name.startswith("apiserver."):
+            what = (f"{a.get('verb', '?')} {a.get('kind', '')} "
+                    f"{a.get('namespace') or '-'}/{a.get('name', '')}")
+            if "rv" in a:
+                what += f" rv={a['rv']}"
+        elif s.name == "reconcile":
+            reconciles += 1
+            reconcile_total += max(s.duration_s, 0.0)
+            what = (f"reconcile {a.get('controller', '?')} "
+                    f"{a.get('namespace') or '-'}/{a.get('name', '')} "
+                    f"outcome={a.get('outcome', '?')}")
+            if "requeue_after_s" in a:
+                what += f" requeue_after={a['requeue_after_s']}s"
+            if "backoff_s" in a:
+                what += f" backoff={round(a['backoff_s'], 3)}s"
+            if s.links:
+                what += f" links={[l[1][-6:] for l in s.links]}"
+        else:
+            what = s.name
+        print(f"  t+{off_ms:9.3f}ms {dur_ms:9.3f}ms  {indent}{what} "
+              f"[{s.span_id[-6:]}]")
+    print(f"reconciles: {reconciles} spans, {reconcile_total * 1e3:.3f}ms "
+          f"total; timeline {(t_end - t0) * 1e3:.3f}ms")
+    return 0
+
+
+def _scrape(url: str) -> str:
+    from urllib.request import urlopen
+
+    with urlopen(url, timeout=10) as resp:
+        return resp.read().decode()
+
+
+def _hist_series(samples, base: str, label: str):
+    """Aggregate `{base}_bucket` samples into per-`label`-value cumulative
+    (le, count) pairs plus counts — summing across any OTHER labels (e.g.
+    reconcile results), which is sound because every series of one
+    histogram family shares identical bucket bounds."""
+    acc = {}
+    for name, labels, value in samples:
+        if name != f"{base}_bucket" or label not in labels or "le" not in labels:
+            continue
+        le = float("inf") if labels["le"] == "+Inf" else float(labels["le"])
+        bucket = acc.setdefault(labels[label], {})
+        bucket[le] = bucket.get(le, 0.0) + value
+    return {
+        k: sorted(v.items(), key=lambda p: p[0]) for k, v in acc.items()
+    }
+
+
+def cmd_top(args) -> int:
+    """Per-controller latency summary from a LIVE /metrics scrape — the
+    operator's `kubectl top` analogue for reconcile loops. Percentiles are
+    estimated from the exposition's histogram buckets with the same
+    interpolation the in-process benches use."""
+    from kubeflow_tpu.utils.monitoring import (
+        parse_exposition,
+        quantile_from_buckets,
+    )
+
+    try:
+        text = _scrape(args.url)
+    except Exception as e:
+        print(f"scrape {args.url} failed: {e}", file=sys.stderr)
+        return 1
+    try:
+        samples = parse_exposition(text)
+    except ValueError as e:
+        print(f"unparseable exposition: {e}", file=sys.stderr)
+        return 1
+    recon = _hist_series(samples, "kftpu_reconcile_duration_seconds",
+                         "controller")
+    qwait = _hist_series(samples, "kftpu_workqueue_wait_seconds",
+                         "controller")
+    wlag = _hist_series(samples, "kftpu_watch_delivery_lag_seconds",
+                        "controller")
+    if not recon:
+        print("no kftpu_reconcile_duration_seconds series in scrape "
+              "(is this a platform /metrics endpoint?)", file=sys.stderr)
+        return 1
+
+    def ms(pairs, q):
+        v = quantile_from_buckets(pairs, q)
+        return f"{v * 1e3:8.2f}" if v is not None else "       -"
+
+    rows = []
+    for ctl in sorted(recon):
+        pairs = recon[ctl]
+        count = int(pairs[-1][1]) if pairs else 0
+        rows.append((
+            ctl, count,
+            ms(pairs, 0.50), ms(pairs, 0.95), ms(pairs, 0.99),
+            ms(qwait.get(ctl, []), 0.95) if qwait.get(ctl) else "       -",
+            ms(wlag.get(ctl, []), 0.95) if wlag.get(ctl) else "       -",
+        ))
+    print(f"{'CONTROLLER':24} {'RECONCILES':>10} {'P50(ms)':>8} "
+          f"{'P95(ms)':>8} {'P99(ms)':>8} {'QWAIT95':>8} {'WLAG95':>8}")
+    for ctl, count, p50, p95, p99, qw, wl in rows:
+        print(f"{ctl:24} {count:>10} {p50} {p95} {p99} {qw} {wl}")
+    return 0
+
+
 def cmd_metrics(args) -> int:
     if args.backend == "kubectl":
         print("metrics is a state-backend command", file=sys.stderr)
@@ -402,6 +567,22 @@ def build_parser() -> argparse.ArgumentParser:
 
     mp = sub.add_parser("metrics", help="dump platform metrics")
     mp.set_defaults(fn=cmd_metrics)
+
+    tp = sub.add_parser(
+        "trace", help="causal write->watch->reconcile timeline for one "
+                      "object from the recorded spans")
+    tp.add_argument("target", help="<kind>/<name>, e.g. TpuJob/train1")
+    tp.add_argument("-n", "--namespace", default=None)
+    tp.add_argument("-o", "--output", choices=("timeline", "json"),
+                    default="timeline")
+    tp.set_defaults(fn=cmd_trace)
+
+    top = sub.add_parser(
+        "top", help="per-controller reconcile latency percentiles from a "
+                    "live /metrics scrape")
+    top.add_argument("--url", required=True,
+                     help="metrics endpoint, e.g. http://127.0.0.1:9090/")
+    top.set_defaults(fn=cmd_top)
 
     lp = sub.add_parser("logs", help="worker logs for a pod / TpuJob gang")
     lp.add_argument("name")

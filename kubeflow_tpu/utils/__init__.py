@@ -1,17 +1,25 @@
+from kubeflow_tpu.utils.logging import configure as configure_logging
 from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.utils.monitoring import (
     Counter,
     Gauge,
     Heartbeat,
+    Histogram,
     MetricsRegistry,
     global_registry,
 )
+from kubeflow_tpu.utils.tracing import Span, Tracer, global_tracer
 
 __all__ = [
+    "configure_logging",
     "get_logger",
     "Counter",
     "Gauge",
     "Heartbeat",
+    "Histogram",
     "MetricsRegistry",
     "global_registry",
+    "Span",
+    "Tracer",
+    "global_tracer",
 ]

@@ -5,14 +5,23 @@ main.go) and a `create_logger` helper in Python (components/jupyter-web-app/
 backend/kubeflow_jupyter/common/utils.py:34). We provide one structured
 logger factory with key=value context, shared by the control plane and the
 training runtime.
+
+``KFTPU_LOG_FORMAT=json`` switches the root handler to one-JSON-object-per-
+line output, and every record is stamped with the current ``trace_id``/
+``span_id`` from the in-process tracer (utils/tracing.py) when a span is
+open — the log↔trace correlation that lets ``tpuctl trace`` output be
+joined against controller logs. The text format stays the default.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import sys
 from typing import Any
+
+_json_mode = False
 
 
 class _KVAdapter(logging.LoggerAdapter):
@@ -20,7 +29,11 @@ class _KVAdapter(logging.LoggerAdapter):
         extra = kwargs.pop("kv", None) or {}
         bound = self.extra or {}
         merged = {**bound, **extra}
-        if merged:
+        if _json_mode:
+            # Structured output: hand the kv dict to the formatter via the
+            # record instead of flattening it into the message string.
+            kwargs.setdefault("extra", {})["kftpu_kv"] = merged
+        elif merged:
             kv = " ".join(f"{k}={v}" for k, v in merged.items())
             msg = f"{msg} {kv}"
         return msg, kwargs
@@ -29,28 +42,88 @@ class _KVAdapter(logging.LoggerAdapter):
         return _KVAdapter(self.logger, {**(self.extra or {}), **kv})
 
 
+class _JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        kv = getattr(record, "kftpu_kv", None)
+        if kv:
+            out.update({str(k): _jsonable(v) for k, v in kv.items()})
+        # Correlate with the active trace, when one is open on this
+        # thread — whichever Tracer instance opened it (Platform and the
+        # benches run private tracers; the current-span context is
+        # process-wide).
+        from kubeflow_tpu.utils.tracing import current_span
+
+        span = current_span()
+        if span is not None:
+            out["trace_id"] = span.trace_id
+            out["span_id"] = span.span_id
+        if record.exc_info and record.exc_info[0] is not None:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+def _jsonable(v: Any) -> Any:
+    try:
+        json.dumps(v)
+        return v
+    except (TypeError, ValueError):
+        return str(v)
+
+
 _configured = False
+_our_handler: "logging.Handler | None" = None
 
 
-def _configure_root() -> None:
-    global _configured
-    if _configured:
+def configure(force: bool = False) -> None:
+    """(Re-)configure the ``kubeflow_tpu`` root logger from the
+    environment: ``KFTPU_LOG_LEVEL`` and ``KFTPU_LOG_FORMAT`` (``text`` |
+    ``json``). ``force`` re-reads the env and swaps OUR handler — how
+    tests and long-lived services switch format at runtime. Handlers an
+    embedding application pre-installed are always left alone: the
+    implicit first call adds ours only when none exist, and force only
+    ever replaces the handler this module installed."""
+    global _configured, _json_mode, _our_handler
+    if _configured and not force:
         return
     level = os.environ.get("KFTPU_LOG_LEVEL", "INFO").strip().upper()
     if level not in ("CRITICAL", "ERROR", "WARNING", "INFO", "DEBUG"):
         level = "INFO"
-    handler = logging.StreamHandler(sys.stderr)
-    handler.setFormatter(
-        logging.Formatter("%(asctime)s %(levelname).1s %(name)s %(message)s")
+    _json_mode = (
+        os.environ.get("KFTPU_LOG_FORMAT", "text").strip().lower() == "json"
     )
+    handler = logging.StreamHandler(sys.stderr)
+    if _json_mode:
+        handler.setFormatter(_JsonFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname).1s %(name)s %(message)s")
+        )
     root = logging.getLogger("kubeflow_tpu")
     root.setLevel(level)
-    if not root.handlers:
+    had_ours = _our_handler is not None
+    if had_ours:
+        root.removeHandler(_our_handler)
+        _our_handler = None
+    # Install ours only when replacing our own or when no handler exists;
+    # force never ADDS next to an embedding app's handler (that would
+    # duplicate every line).
+    if had_ours or not root.handlers:
         root.addHandler(handler)
+        _our_handler = handler
+    # kv routing must match the handler that will render it: json mode is
+    # only honoured when OUR json handler is actually installed —
+    # otherwise a foreign handler would silently drop record.kftpu_kv.
+    _json_mode = _json_mode and _our_handler is handler
     root.propagate = False
     _configured = True
 
 
 def get_logger(name: str, **kv: Any) -> _KVAdapter:
-    _configure_root()
+    configure()
     return _KVAdapter(logging.getLogger(f"kubeflow_tpu.{name}"), kv)

@@ -10,11 +10,25 @@ text exposition format itself, so any scraper can consume it.
 
 from __future__ import annotations
 
+import bisect
+import re
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 LabelKV = Tuple[Tuple[str, str], ...]
+
+#: Default latency buckets (seconds). Tuned for an in-process control
+#: plane: reconciles and API verbs live in the 50µs–50ms band, with the
+#: tail buckets catching real-cluster RTTs and slow reconcile bodies.
+#: (Chaos-injected verb latency sleeps in the PROXY, ahead of the inner
+#: server's histogram — it shows up in reconcile/queue-wait/watch-lag
+#: numbers, deliberately not in kftpu_apiserver_request_duration_seconds,
+#: which measures the server itself.)
+DEFAULT_LATENCY_BUCKETS = (
+    0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+)
 
 
 def _fmt_value(v: float) -> str:
@@ -34,6 +48,14 @@ def _fmt_value(v: float) -> str:
 
 def _escape_label_value(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def sanitize_metric_name(part: str) -> str:
+    """Make an interpolated name fragment exposition-legal. Component
+    names like ``fake-kubelet`` produced ``kftpu_fake-kubelet_*`` metric
+    names, which every real Prometheus scraper rejects (`-` is outside
+    ``[a-zA-Z0-9_:]``) — found by the CI obs-smoke parse gate."""
+    return re.sub(r"[^a-zA-Z0-9_:]", "_", part)
 
 
 def _fmt_labels(labels: LabelKV) -> str:
@@ -79,38 +101,77 @@ class Counter:
             out.append(f"{self.name}{_fmt_labels(labels)} {_fmt_value(v)}")
         return out
 
+    def samples(self) -> List[Tuple[str, LabelKV, float]]:
+        with self._lock:
+            items = list(self._values.items())
+        return [(self.name, labels, v) for labels, v in items]
+
 
 class Gauge:
+    """A settable (or callback-backed) gauge. ``label_names`` turns it into
+    a labeled family: ``set(v, shard="0")`` / ``value(shard="0")`` — the
+    callback form stays unlabeled (one callable, one sample)."""
+
     def __init__(
         self,
         name: str,
         help_: str,
         fn: Optional[Callable[[], float]] = None,
+        label_names: Tuple[str, ...] = (),
     ):
+        if fn is not None and label_names:
+            raise ValueError(
+                f"gauge {name}: callback-backed gauges cannot take labels"
+            )
         self.name = name
         self.help = help_
+        self.label_names = tuple(label_names)
         self._fn = fn
-        self._value = 0.0
+        self._values: Dict[LabelKV, float] = {}
         self._lock = threading.Lock()
 
-    def set(self, v: float) -> None:
+    def _key(self, labels: Dict[str, str]) -> LabelKV:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"gauge {self.name} expects labels {self.label_names}, "
+                f"got {tuple(sorted(labels))}"
+            )
+        return tuple(sorted(labels.items()))
+
+    def set(self, v: float, **labels: str) -> None:
         if self._fn is not None:
             raise ValueError(f"gauge {self.name} is callback-backed; set() invalid")
+        key = self._key(labels)
         with self._lock:
-            self._value = v
+            self._values[key] = float(v)
 
-    def value(self) -> float:
+    def value(self, **labels: str) -> float:
         if self._fn is not None:
             return float(self._fn())
+        key = self._key(labels)
         with self._lock:
-            return self._value
+            return self._values.get(key, 0.0)
 
     def render(self) -> List[str]:
-        return [
+        out = [
             f"# HELP {self.name} {self.help}",
             f"# TYPE {self.name} gauge",
-            f"{self.name} {_fmt_value(self.value())}",
         ]
+        if self._fn is not None or not self.label_names:
+            out.append(f"{self.name} {_fmt_value(self.value())}")
+            return out
+        with self._lock:
+            items = sorted(self._values.items())
+        for labels, v in items:
+            out.append(f"{self.name}{_fmt_labels(labels)} {_fmt_value(v)}")
+        return out
+
+    def samples(self) -> List[Tuple[str, LabelKV, float]]:
+        if self._fn is not None or not self.label_names:
+            return [(self.name, (), self.value())]
+        with self._lock:
+            items = list(self._values.items())
+        return [(self.name, labels, v) for labels, v in items]
 
 
 class Heartbeat:
@@ -140,6 +201,227 @@ class Heartbeat:
             f"{self.name} {_fmt_value(self.last())}",
         ]
 
+    def samples(self) -> List[Tuple[str, LabelKV, float]]:
+        return [(self.name, (), self.last())]
+
+
+class Histogram:
+    """A Prometheus histogram: cumulative ``_bucket{le=...}`` counts plus
+    ``_sum``/``_count``, rendered in the text exposition format.
+
+    Buckets are the *upper bounds* of each band (ascending, finite); the
+    implicit ``+Inf`` bucket is always appended, so ``_bucket{le="+Inf"}``
+    equals ``_count`` by construction. ``quantile`` estimates percentiles
+    by linear interpolation inside the bucket containing the rank — the
+    same estimate a PromQL ``histogram_quantile`` would produce, which is
+    what lets ``tpuctl top`` (scraping text) and the in-process benches
+    (reading this object) report the same numbers.
+    """
+
+    def __init__(self, name: str, help_: str,
+                 label_names: Tuple[str, ...] = (),
+                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS):
+        bs = sorted(set(float(b) for b in buckets))
+        if not bs:
+            raise ValueError(f"histogram {name}: needs at least one bucket")
+        if any(b != b or b in (float("inf"), float("-inf")) for b in bs):
+            raise ValueError(f"histogram {name}: buckets must be finite "
+                             "(+Inf is implicit)")
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self.buckets = tuple(bs)
+        # per-labelset state: [per-bucket counts..., +Inf count], sum
+        self._counts: Dict[LabelKV, List[int]] = {}
+        self._sums: Dict[LabelKV, float] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> LabelKV:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"histogram {self.name} expects labels {self.label_names}, "
+                f"got {tuple(sorted(labels))}"
+            )
+        return tuple(sorted(labels.items()))
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        v = float(value)
+        with self._lock:
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = self._counts[key] = [0] * (len(self.buckets) + 1)
+                self._sums[key] = 0.0
+            # Non-cumulative per-band tally internally; cumulated at render
+            # so observe stays O(log b) not O(b).
+            counts[bisect.bisect_left(self.buckets, v)] += 1
+            self._sums[key] += v
+
+    def count(self, **labels: str) -> int:
+        key = self._key(labels)
+        with self._lock:
+            return sum(self._counts.get(key, ()))
+
+    def sum(self, **labels: str) -> float:
+        key = self._key(labels)
+        with self._lock:
+            return self._sums.get(key, 0.0)
+
+    def _merged(self, labels: Dict[str, str]) -> Tuple[List[int], float]:
+        """Aggregate (band counts, sum) across every labelset matching the
+        given *subset* of labels — ``quantile()`` with no labels spans the
+        whole family (e.g. all controllers)."""
+        want = set(labels.items())
+        bands = [0] * (len(self.buckets) + 1)
+        total = 0.0
+        with self._lock:
+            for key, counts in self._counts.items():
+                if want <= set(key):
+                    for i, c in enumerate(counts):
+                        bands[i] += c
+                    total += self._sums[key]
+        return bands, total
+
+    def quantile(self, q: float, **labels: str) -> Optional[float]:
+        """Estimated q-quantile (0 < q < 1) aggregated over every labelset
+        matching the given label subset; None with no observations."""
+        bands, _ = self._merged(labels)
+        pairs = []
+        cum = 0
+        for le, c in zip(self.buckets, bands):
+            cum += c
+            pairs.append((le, cum))
+        cum += bands[-1]
+        pairs.append((float("inf"), cum))
+        return quantile_from_buckets(pairs, q)
+
+    def percentiles(self, qs: Sequence[float] = (0.5, 0.95, 0.99),
+                    **labels: str) -> Dict[str, float]:
+        """{"p50": ..., "p95": ...} for the matching labelsets; empty dict
+        with no observations (so JSON reports omit rather than fake)."""
+        out: Dict[str, float] = {}
+        for q in qs:
+            v = self.quantile(q, **labels)
+            if v is not None:
+                # %g keying: int() float-truncates (0.29*100 -> p28) and
+                # collides p99 with p99.9; %g yields p29 / p99 / p99.9.
+                out[f"p{q * 100:g}"] = round(v, 6)
+        return out
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        with self._lock:
+            items = sorted((k, list(c), self._sums[k])
+                           for k, c in self._counts.items())
+        for labels, bands, total in items:
+            cum = 0
+            for le, c in zip(self.buckets, bands):
+                cum += c
+                lv = labels + (("le", _fmt_value(le)),)
+                out.append(
+                    f"{self.name}_bucket{_fmt_labels(lv)} {cum}")
+            cum += bands[-1]
+            lv = labels + (("le", "+Inf"),)
+            out.append(f"{self.name}_bucket{_fmt_labels(lv)} {cum}")
+            out.append(
+                f"{self.name}_sum{_fmt_labels(labels)} {_fmt_value(total)}")
+            out.append(f"{self.name}_count{_fmt_labels(labels)} {cum}")
+        return out
+
+    def samples(self) -> List[Tuple[str, LabelKV, float]]:
+        with self._lock:
+            items = [(k, list(c), self._sums[k])
+                     for k, c in self._counts.items()]
+        out: List[Tuple[str, LabelKV, float]] = []
+        for labels, bands, total in items:
+            cum = 0
+            for le, c in zip(self.buckets, bands):
+                cum += c
+                out.append((f"{self.name}_bucket",
+                            labels + (("le", _fmt_value(le)),), float(cum)))
+            cum += bands[-1]
+            out.append((f"{self.name}_bucket",
+                        labels + (("le", "+Inf"),), float(cum)))
+            out.append((f"{self.name}_sum", labels, total))
+            out.append((f"{self.name}_count", labels, float(cum)))
+        return out
+
+
+def quantile_from_buckets(
+    pairs: Sequence[Tuple[float, float]], q: float
+) -> Optional[float]:
+    """Quantile estimate from cumulative histogram buckets: ``pairs`` is
+    ascending ``(upper_bound, cumulative_count)`` ending with the +Inf
+    bucket. Linear interpolation inside the containing bucket; observations
+    past the last finite bound clamp to it (the PromQL convention). Shared
+    by :meth:`Histogram.quantile` and the ``tpuctl top`` scrape parser."""
+    if not 0.0 < q < 1.0:
+        raise ValueError(f"quantile must be in (0, 1), got {q}")
+    if not pairs:
+        return None
+    total = pairs[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_le, prev_cum = 0.0, 0.0
+    last_finite = 0.0
+    for le, cum in pairs:
+        if le != float("inf"):
+            last_finite = le
+        if cum >= rank:
+            if le == float("inf"):
+                return last_finite if last_finite else prev_le
+            span = cum - prev_cum
+            if span <= 0:
+                return le
+            return prev_le + (le - prev_le) * (rank - prev_cum) / span
+        prev_le, prev_cum = le, cum
+    return last_finite
+
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_UNESCAPE_RE = re.compile(r'\\(.)')
+_UNESCAPE_MAP = {"n": "\n", '"': '"', "\\": "\\"}
+
+
+def _unescape_label_value(v: str) -> str:
+    # Single-pass inverse of _escape_label_value: sequential str.replace
+    # corrupted values like 'C:\\new' (the escaped backslash's output fed
+    # the \n replacement).
+    return _UNESCAPE_RE.sub(
+        lambda m: _UNESCAPE_MAP.get(m.group(1), m.group(0)), v)
+
+
+def parse_exposition(text: str) -> List[Tuple[str, Dict[str, str], float]]:
+    """Parse the Prometheus text exposition format back into
+    ``(name, labels, value)`` samples — the consumer half of ``render()``,
+    used by ``tpuctl top`` and the CI obs-smoke scrape assertion."""
+    out: List[Tuple[str, Dict[str, str], float]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        name, raw_labels, raw_value = m.groups()
+        labels: Dict[str, str] = {}
+        if raw_labels:
+            for lm in _LABEL_RE.finditer(raw_labels):
+                labels[lm.group(1)] = _unescape_label_value(lm.group(2))
+        v = raw_value
+        if v == "+Inf":
+            value = float("inf")
+        elif v == "-Inf":
+            value = float("-inf")
+        else:
+            value = float(v)
+        out.append((name, labels, value))
+    return out
+
 
 class MetricsRegistry:
     """Holds metrics and renders the text exposition format. Metric names are
@@ -167,15 +449,44 @@ class MetricsRegistry:
         return m
 
     def gauge(
-        self, name: str, help_: str, fn: Optional[Callable[[], float]] = None
+        self, name: str, help_: str,
+        fn: Optional[Callable[[], float]] = None,
+        labels: Tuple[str, ...] = (),
     ) -> Gauge:
-        m = self._register(name, lambda: Gauge(name, help_, fn))
+        m = self._register(name, lambda: Gauge(name, help_, fn, labels))
         if not isinstance(m, Gauge):
             raise ValueError(f"metric {name} already registered as {type(m).__name__}")
         return m
 
+    def histogram(
+        self, name: str, help_: str,
+        labels: Tuple[str, ...] = (),
+        buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS,
+    ) -> Histogram:
+        m = self._register(name, lambda: Histogram(name, help_, labels, buckets))
+        if not isinstance(m, Histogram):
+            raise ValueError(f"metric {name} already registered as {type(m).__name__}")
+        return m
+
+    def get(self, name: str) -> Optional[object]:
+        """The registered metric object by name, or None — benches read
+        their histograms back this way instead of re-plumbing references."""
+        with self._lock:
+            return self._metrics.get(name)
+
+    def percentiles(self, name: str,
+                    qs: Sequence[float] = (0.5, 0.95, 0.99),
+                    **labels: str) -> Dict[str, float]:
+        """p50/p95/p99 dict for a registered histogram (empty when the
+        metric is missing, not a histogram, or has no observations) — the
+        one lookup the bench and soak reports share."""
+        h = self.get(name)
+        if not isinstance(h, Histogram):
+            return {}
+        return h.percentiles(qs, **labels)
+
     def heartbeat(self, component: str) -> Heartbeat:
-        name = f"kftpu_{component}_heartbeat"
+        name = f"kftpu_{sanitize_metric_name(component)}_heartbeat"
         m = self._register(
             name, lambda: Heartbeat(name, f"Unix time of last {component} heartbeat")
         )
@@ -192,19 +503,17 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
     def snapshot(self) -> List[Tuple[str, LabelKV, float]]:
-        """Point-in-time (name, labels, value) samples for every counter
-        and gauge — the stable read surface for samplers (the time-series
-        collector) that must not race concurrent registration."""
+        """Point-in-time (name, labels, value) samples for EVERY registered
+        metric — the stable read surface for samplers (the time-series
+        collector) that must not race concurrent registration. Duck-typed
+        through each metric's ``samples()`` so new metric types (and the
+        Heartbeat / labeled-gauge families an isinstance ladder silently
+        dropped) can never fall out of the sample stream again."""
         with self._lock:
-            metrics = list(self._metrics.items())
+            metrics = list(self._metrics.values())
         out: List[Tuple[str, LabelKV, float]] = []
-        for name, m in metrics:
-            if isinstance(m, Counter):
-                with m._lock:
-                    items = list(m._values.items())
-                out.extend((name, labels, v) for labels, v in items)
-            elif isinstance(m, Gauge):
-                out.append((name, (), m.value()))
+        for m in metrics:
+            out.extend(m.samples())  # type: ignore[attr-defined]
         return out
 
 

@@ -1,0 +1,306 @@
+"""Dependency-free in-process tracing for the control plane.
+
+The reference platform stops at counters-plus-heartbeat per controller
+(profile-controller/controllers/monitoring.go); at fleet scale the question
+those can't answer is *where time goes* between a write, its watch
+delivery, its queue wait, and the reconcile that retires it — the
+latency-decomposition problem of arxiv 2011.03641 / 1908.08082. This
+module is the span layer the apiserver and reconciler kernel thread their
+hot paths through:
+
+- :class:`Span` — name, attrs, ids, monotonic start/duration, parent id,
+  and causal *links* (the write-RV → reconcile edge: a reconcile span
+  links to the write span whose watch event enqueued its key, so one
+  trace covers "tpuctl write → watch event → reconcile → status update").
+- :class:`Tracer` — contextvar-based propagation (``tracer.span(...)``
+  nests: spans started inside become children, sharing the trace id), a
+  bounded ring-buffer exporter, and JSONL export/import so ``tpuctl
+  trace`` can reconstruct timelines across processes.
+
+Threads started *after* a span begins do not inherit the contextvar
+(Python threads snapshot a fresh context) — cross-thread causality is
+carried explicitly instead: watch events stamp the writing span's context
+(``SpanContext``), and the ControllerManager passes it through its queue
+as a link (tested in tests/test_tracing.py). Everything here is pure
+stdlib and allocation-light: no clocks beyond ``time``, no globals beyond
+one default tracer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+#: (trace_id, span_id) — the wire-size identity of a span, carried on
+#: watch events and queue entries instead of the span object itself.
+SpanContext = Tuple[str, str]
+
+_ids = itertools.count(1)
+# Per-process id prefix: pid low bits + 4 random bytes drawn ONCE at
+# import (os.urandom — not the `random` module, whose seeded streams
+# chaos tests depend on). pid bits alone collide under pid recycling,
+# and tpuctl appends every invocation's spans to one trace.jsonl —
+# colliding ids would merge unrelated sessions into one causal timeline.
+_pid_stamp = f"{os.getpid() & 0xffff:04x}{os.urandom(4).hex()}"
+
+#: One process-wide "current span" context, shared by every Tracer (see
+#: Tracer.__init__). Read via :func:`current_span`.
+_CURRENT_SPAN: "contextvars.ContextVar[Optional[Span]]" = \
+    contextvars.ContextVar("kftpu_current_span", default=None)
+
+
+def current_span() -> Optional["Span"]:
+    """The span currently open on this thread/context, whichever tracer
+    opened it — the hook structured logging uses to stamp trace ids."""
+    return _CURRENT_SPAN.get()
+
+
+def _new_id() -> str:
+    # Monotonic per-process counter + pid stamp: unique enough for trace
+    # reconstruction across tpuctl invocations, deterministic within one
+    # process (no RNG draw — chaos seeds must not shift under tracing).
+    return f"{_pid_stamp}{next(_ids):010x}"
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str = ""
+    start_unix: float = 0.0         # wall clock, for cross-process ordering
+    start_mono: float = 0.0         # monotonic, for duration math
+    duration_s: float = -1.0        # -1 while open
+    attrs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    links: List[SpanContext] = dataclasses.field(default_factory=list)
+
+    @property
+    def context(self) -> SpanContext:
+        return (self.trace_id, self.span_id)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_unix": self.start_unix,
+            "duration_s": self.duration_s,
+            "attrs": self.attrs,
+            "links": [list(l) for l in self.links],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Span":
+        return cls(
+            name=d["name"],
+            trace_id=d["trace_id"],
+            span_id=d["span_id"],
+            parent_id=d.get("parent_id", ""),
+            start_unix=float(d.get("start_unix", 0.0)),
+            start_mono=0.0,
+            duration_s=float(d.get("duration_s", -1.0)),
+            attrs=dict(d.get("attrs", {})),
+            links=[tuple(l) for l in d.get("links", [])],
+        )
+
+
+class Tracer:
+    """Bounded in-process span recorder with contextvar propagation.
+
+    ``capacity`` bounds the finished-span ring buffer (oldest evicted
+    first); a long-running platform can trace forever without growing.
+    """
+
+    def __init__(self, capacity: int = 8192):
+        self._spans: "deque[Span]" = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._total = 0             # spans ever recorded (incl. evicted)
+        self._exported_upto = 0     # high-water mark of export_new_jsonl
+        # The ACTIVE span is process-wide (one shared contextvar), not
+        # per-tracer: tracers differ only in where finished spans are
+        # ring-buffered. Log↔trace correlation (utils/logging.py) must see
+        # the current span no matter which tracer instance opened it —
+        # Platform and the benches all run private tracers.
+        self._current = _CURRENT_SPAN
+
+    # ------------- span lifecycle -------------
+
+    def current(self) -> Optional[Span]:
+        return self._current.get()
+
+    def current_context(self) -> Optional[SpanContext]:
+        s = self._current.get()
+        return s.context if s is not None else None
+
+    def start(
+        self,
+        name: str,
+        attrs: Optional[Dict[str, Any]] = None,
+        links: Sequence[SpanContext] = (),
+        trace_id: Optional[str] = None,
+    ) -> Span:
+        """Open a span and make it current; pair with :meth:`finish`.
+        Parentage: an explicit ``trace_id`` wins (the adopt-the-linked-
+        write's-trace case), else the contextvar's current span (nesting),
+        else a fresh trace. The imperative half of :meth:`span` — the
+        apiserver hot path uses it directly to skip generator-context-
+        manager overhead (profiled at ~3% of a control-plane sweep)."""
+        parent = self._current.get()
+        if trace_id is None:
+            trace_id = parent.trace_id if parent is not None else _new_id()
+        s = Span(
+            name=name,
+            trace_id=trace_id,
+            span_id=_new_id(),
+            parent_id=parent.span_id if parent is not None else "",
+            start_unix=time.time(),
+            start_mono=time.monotonic(),
+            attrs=attrs if attrs is not None else {},
+            links=list(links),
+        )
+        s._token = self._current.set(s)     # type: ignore[attr-defined]
+        return s
+
+    def finish(self, s: Span) -> None:
+        """Close a :meth:`start`-opened span: stamp duration, restore the
+        previous current span, record into the ring."""
+        s.duration_s = time.monotonic() - s.start_mono
+        token = getattr(s, "_token", None)
+        if token is not None:
+            self._current.reset(token)
+        with self._lock:
+            self._spans.append(s)
+            self._total += 1
+
+    @contextlib.contextmanager
+    def span(
+        self,
+        name: str,
+        attrs: Optional[Dict[str, Any]] = None,
+        links: Sequence[SpanContext] = (),
+        trace_id: Optional[str] = None,
+    ) -> Iterator[Span]:
+        """Context-managed :meth:`start`/:meth:`finish`."""
+        s = self.start(name, attrs, links, trace_id)
+        try:
+            yield s
+        finally:
+            self.finish(s)
+
+    # ------------- read / export -------------
+
+    def spans(self, name: Optional[str] = None,
+              **attr_filters: Any) -> List[Span]:
+        """Finished spans, oldest first, optionally filtered by span name
+        and exact attr values."""
+        with self._lock:
+            out = list(self._spans)
+        if name is not None:
+            out = [s for s in out if s.name == name]
+        for k, v in attr_filters.items():
+            out = [s for s in out if s.attrs.get(k) == v]
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def export_jsonl(self, path: str, append: bool = True) -> int:
+        """Write the ring buffer as JSON lines (one span per line); returns
+        spans written. Append mode is how successive ``tpuctl`` processes
+        accumulate one causal record under the state dir."""
+        with self._lock:
+            out = list(self._spans)
+        mode = "a" if append else "w"
+        with open(path, mode) as f:
+            for s in out:
+                f.write(json.dumps(s.to_dict()) + "\n")
+        return len(out)
+
+    def export_new_jsonl(self, path: str) -> int:
+        """Append only spans recorded since the last ``export_new_jsonl``
+        call — repeated exports (Platform.save per tpuctl subcommand) never
+        duplicate lines. Spans evicted from the ring before being exported
+        are gone (bounded-memory contract)."""
+        with self._lock:
+            fresh = self._total - self._exported_upto
+            out = list(self._spans)[-fresh:] if fresh > 0 else []
+            self._exported_upto = self._total
+        if not out:
+            return 0
+        with open(path, "a") as f:
+            for s in out:
+                f.write(json.dumps(s.to_dict()) + "\n")
+        return len(out)
+
+    @staticmethod
+    def trim_jsonl(path: str, max_bytes: int = 4 << 20) -> None:
+        """Bound an append-accumulated span file: when it outgrows
+        ``max_bytes``, keep the newest half (whole lines). The in-memory
+        ring is bounded; the state-dir file must be too, or a scripted
+        tpuctl loop grows it — and every ``tpuctl trace`` load — forever."""
+        try:
+            if os.path.getsize(path) <= max_bytes:
+                return
+        except OSError:
+            return
+        with open(path) as f:
+            lines = f.readlines()
+        keep, size = [], 0
+        for line in reversed(lines):
+            size += len(line)
+            if size > max_bytes // 2:
+                break
+            keep.append(line)
+        with open(path, "w") as f:
+            f.writelines(reversed(keep))
+
+    @staticmethod
+    def load_jsonl(path: str) -> List[Span]:
+        spans: List[Span] = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    spans.append(Span.from_dict(json.loads(line)))
+        return spans
+
+
+def assemble_trace(
+    spans: Sequence[Span], kind: str, name: str, namespace: str = ""
+) -> List[Span]:
+    """The causal slice of ``spans`` for one object: seed with every span
+    whose attrs reference (kind, name[, namespace]) — apiserver verb spans
+    carry kind/name/namespace, reconcile spans carry name/namespace — then
+    close over shared trace ids (write → watch → reconcile → status-update
+    chains share the originating write's trace id via span links). Sorted
+    by wall-clock start."""
+    def references(s: Span) -> bool:
+        # Seeds are apiserver verb spans carrying an EXACT kind match;
+        # reconcile spans (no kind attr) join via the trace-id closure
+        # only — otherwise tracing a nonexistent kind/name would adopt
+        # another kind's trace wholesale.
+        a = s.attrs
+        if a.get("name") != name or a.get("kind") != kind:
+            return False
+        ns = a.get("namespace")
+        return not namespace or ns in (namespace, None, "")
+
+    trace_ids = {s.trace_id for s in spans if references(s)}
+    out = [s for s in spans if s.trace_id in trace_ids]
+    return sorted(out, key=lambda s: (s.start_unix, s.span_id))
+
+
+#: Default tracer: what the apiserver / reconciler kernel record into when
+#: the caller doesn't wire a private one (Platform builds its own so state
+#: dirs don't cross-contaminate).
+global_tracer = Tracer()

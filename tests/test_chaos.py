@@ -485,3 +485,145 @@ class TestChaosSoak:
         from kubeflow_tpu.tools.ci import run_chaos_smoke
 
         run_chaos_smoke(seed=20260803)  # raises GateFailure on failure
+
+    def test_soak_reports_latency_percentiles(self):
+        """ISSUE 4: the soak's JSON now decomposes latency, not just
+        throughput — reconcile + queue-wait percentiles present."""
+        rep = run_soak(num_jobs=2, seed=11, fault_rounds=4, max_rounds=40)
+        assert rep.converged
+        for pcts in (rep.reconcile_latency_s, rep.queue_wait_s):
+            assert {"p50", "p95", "p99"} <= set(pcts)
+            assert 0 <= pcts["p50"] <= pcts["p95"] <= pcts["p99"]
+
+
+# --------------------------------------------------------------------------
+# Watch-lag injection (ISSUE 4 satellite: the ROADMAP follow-up)
+# --------------------------------------------------------------------------
+
+class TestWatchLagInjection:
+    LAG = 0.05
+
+    def test_events_held_for_lag_then_delivered_in_order(self):
+        import time
+
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        chaos = ChaosApiServer(api, seed=1, registry=MetricsRegistry(),
+                               watch_lag_s=self.LAG)
+        q = chaos.watch("TpuJob")
+        api.create(_job("a"))
+        api.create(_job("b"))
+        # Freshly written events are invisible until the lag elapses ...
+        assert q.empty()
+        time.sleep(self.LAG + 0.01)
+        # ... then release in write order.
+        assert not q.empty()
+        assert q.get().object.metadata.name == "a"
+        assert q.get().object.metadata.name == "b"
+        chaos.stop_watch(q)
+
+    def test_quiesce_releases_held_events_immediately(self):
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        chaos = ChaosApiServer(api, seed=1, registry=MetricsRegistry(),
+                               watch_lag_s=60.0)   # absurd lag
+        q = chaos.watch("TpuJob")
+        api.create(_job("held"))
+        assert q.empty()
+        chaos.quiesce()                             # lag goes with faults
+        assert not q.empty()
+        assert q.get().object.metadata.name == "held"
+        chaos.stop_watch(q)
+
+    def test_histogram_provably_measures_injected_lag(self):
+        """The acceptance criterion: with seeded watch-lag chaos, every
+        lag observation the manager records is >= the injected lag — the
+        buckets below it stay EMPTY (deterministic in outcome: real time
+        only ever adds lag on top)."""
+        import time
+
+        reg = MetricsRegistry()
+        api = InMemoryApiServer(registry=reg)
+        chaos = ChaosApiServer(api, seed=20260803, registry=reg,
+                               watch_lag_s=self.LAG)
+        mgr = ControllerManager(chaos, reg)
+        ctl = TpuJobController(chaos, reg, hbm_check=False)
+        mgr.register(ctl)
+        kubelet = FakeKubelet(chaos, reg, outcome=lambda name: "Succeeded")
+        mgr.register(kubelet)
+        api.create(_job("lagged"))
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            mgr.run_until_idle(include_timers_within=30.0)
+            kubelet.tick()
+            mgr.run_until_idle(include_timers_within=30.0)
+            job = api.get("TpuJob", "lagged", "chaos")
+            if job.status.phase in ("Succeeded", "Failed"):
+                break
+            time.sleep(self.LAG / 2)
+        assert job.status.phase == "Succeeded", job.status.phase
+        hist = reg.get("kftpu_watch_delivery_lag_seconds")
+        total = sum(hist.count(controller=c.NAME)
+                    for c in (ctl, kubelet))
+        assert total > 0
+        # No observation below the injected lag: the sub-lag buckets of
+        # every controller series are empty.
+        for c in (ctl, kubelet):
+            n = hist.count(controller=c.NAME)
+            if n == 0:
+                continue
+            below = [
+                (le, cum) for le, cum in zip(
+                    hist.buckets,
+                    _cumulative(hist, controller=c.NAME))
+                if le < self.LAG
+            ]
+            assert all(cum == 0 for _, cum in below), below
+        mgr.close()
+
+    def test_timed_get_honours_timeout_not_lag(self):
+        """queue.Queue contract: get(timeout=t) must raise Empty after ~t,
+        not serve out a 60s injected lag sentence."""
+        import queue as queue_mod
+        import time
+
+        import pytest as _pytest
+
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        chaos = ChaosApiServer(api, seed=1, registry=MetricsRegistry(),
+                               watch_lag_s=60.0)
+        q = chaos.watch("TpuJob")
+        api.create(_job("slow"))
+        t0 = time.monotonic()
+        with _pytest.raises(queue_mod.Empty):
+            q.get(timeout=0.05)
+        assert time.monotonic() - t0 < 5.0
+        chaos.stop_watch(q)
+
+    def test_unlagged_chaos_watch_passes_through(self):
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        chaos = ChaosApiServer(api, seed=1, registry=MetricsRegistry())
+        q = chaos.watch("TpuJob")
+        api.create(_job("now"))
+        assert not q.empty()                        # no lag configured
+        chaos.stop_watch(q)
+
+    def test_soak_with_watch_lag_converges(self):
+        rep = run_soak(num_jobs=2, seed=9, fault_rounds=3, max_rounds=40,
+                       watch_lag_s=0.01)
+        assert rep.converged, rep.stuck_jobs()
+        assert rep.all_succeeded, rep.phases
+        assert rep.watch_lag_s.get("p99", 0) >= 0.0
+
+
+def _cumulative(hist, **labels):
+    """Cumulative per-bucket counts for one labelset of a Histogram."""
+    samples = hist.samples()
+    want = tuple(sorted(labels.items()))
+    out = []
+    for le in hist.buckets:
+        from kubeflow_tpu.utils.monitoring import _fmt_value
+
+        key = want + (("le", _fmt_value(le)),)
+        got = [v for name, lab, v in samples
+               if name.endswith("_bucket") and lab == key]
+        out.append(got[0] if got else 0)
+    return out

@@ -40,6 +40,26 @@ class TestControlPlaneSweep:
     def test_ci_cp_bench_smoke_stage(self):
         run_cp_bench_smoke(num_jobs=20, num_namespaces=4)
 
+    def test_sweep_reports_latency_percentiles(self):
+        """ISSUE 4 acceptance: `bench.py controlplane` JSON carries
+        reconcile-latency and queue-wait p50/p95/p99 — latency
+        decomposition next to throughput."""
+        rep = run_controlplane_sweep(num_jobs=12, num_namespaces=3)
+        summary = rep.summary()
+        for key in ("reconcile_latency_s", "queue_wait_s"):
+            pcts = summary[key]
+            assert {"p50", "p95", "p99"} <= set(pcts), (key, pcts)
+            assert 0 <= pcts["p50"] <= pcts["p95"] <= pcts["p99"]
+        # One reconcile span per reconcile executed (count-based).
+        assert summary["reconcile_spans"] == rep.reconciles > 0
+
+    def test_ci_obs_smoke_stage(self):
+        """The new CI stage: live scrape parses and span/histogram counts
+        match reconciles exactly."""
+        from kubeflow_tpu.tools.ci import run_obs_smoke
+
+        run_obs_smoke(num_jobs=8, num_namespaces=2)
+
     def test_ci_gate_raises_on_unconverged(self, monkeypatch):
         import kubeflow_tpu.tools.ci as ci
 

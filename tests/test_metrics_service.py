@@ -91,6 +91,29 @@ class TestCollector:
         assert pt.value == 2.0
         assert dict(pt.labels) == {"kind": "Notebook"}
 
+    def test_tick_samples_heartbeats_for_staleness_detection(self):
+        """ISSUE 4 satellite regression: snapshot() used to skip Heartbeat
+        metrics, so the time-series collector could never show a wedged
+        controller's heartbeat going stale. Now each tick records it."""
+        reg = MetricsRegistry()
+        hb = reg.heartbeat("tpujob")
+        hb.beat()
+        st, col = self._collector(reg)
+        col.tick(now=60.0)
+        pts = st.query("kftpu_tpujob_heartbeat", now=60.0)
+        assert pts and pts[0].value == hb.last() > 0
+
+    def test_tick_samples_histogram_series(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("kftpu_lat_seconds", "t", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        st, col = self._collector(reg)
+        col.tick(now=60.0)
+        assert st.query("kftpu_lat_seconds_count", now=60.0)[0].value == 1.0
+        buckets = st.query_groups("kftpu_lat_seconds_bucket", now=60.0)
+        assert {dict(labels)["le"] for labels, _ in buckets} == \
+            {"0.1", "1", "+Inf"}
+
     def test_host_cpu_sampler_contract(self):
         sample = host_cpu_sampler()
         first = sample()

@@ -57,6 +57,186 @@ class TestMetricsRegistry:
             g.set(1.0)
 
 
+class TestHistogram:
+    """ISSUE 4 satellite: exposition-format contract for the new
+    Histogram — bucket cumulativity, +Inf == _count, label escaping."""
+
+    def _hist(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("kftpu_lat_seconds", "t", labels=("verb",),
+                          buckets=(0.01, 0.1, 1.0))
+        return reg, h
+
+    def test_buckets_are_cumulative(self):
+        reg, h = self._hist()
+        for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+            h.observe(v, verb="get")
+        from kubeflow_tpu.utils.monitoring import parse_exposition
+
+        samples = {
+            (name, labels.get("le")): v
+            for name, labels, v in parse_exposition(reg.render())
+            if labels.get("verb") == "get" or "verb" in labels
+        }
+        assert samples[("kftpu_lat_seconds_bucket", "0.01")] == 1
+        assert samples[("kftpu_lat_seconds_bucket", "0.1")] == 3
+        assert samples[("kftpu_lat_seconds_bucket", "1")] == 4
+        assert samples[("kftpu_lat_seconds_bucket", "+Inf")] == 5
+
+    def test_inf_bucket_equals_count(self):
+        reg, h = self._hist()
+        for v in (0.02, 0.2, 2.0, 20.0):
+            h.observe(v, verb="list")
+        text = reg.render()
+        from kubeflow_tpu.utils.monitoring import parse_exposition
+
+        samples = parse_exposition(text)
+        inf = [v for n, l, v in samples
+               if n == "kftpu_lat_seconds_bucket" and l.get("le") == "+Inf"]
+        count = [v for n, l, v in samples
+                 if n == "kftpu_lat_seconds_count"]
+        assert inf == count == [4]
+        total = [v for n, l, v in samples if n == "kftpu_lat_seconds_sum"]
+        assert total[0] == pytest.approx(22.22)
+
+    def test_boundary_value_lands_in_its_bucket(self):
+        """Prometheus buckets are le (<=): an observation exactly at a
+        bound counts in that bound's bucket."""
+        reg, h = self._hist()
+        h.observe(0.1, verb="get")
+        assert "kftpu_lat_seconds_bucket" in reg.render()
+        from kubeflow_tpu.utils.monitoring import parse_exposition
+
+        s = {l.get("le"): v
+             for n, l, v in parse_exposition(reg.render())
+             if n == "kftpu_lat_seconds_bucket"}
+        assert s["0.1"] == 1
+        assert s["0.01"] == 0
+
+    def test_label_escaping_in_histogram_series(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("kftpu_h", "t", labels=("op",), buckets=(1.0,))
+        h.observe(0.5, op='x "quoted"\nnl\\')
+        out = reg.render()
+        assert 'op="x \\"quoted\\"\\nnl\\\\"' in out
+        # Parses back to the original value.
+        from kubeflow_tpu.utils.monitoring import parse_exposition
+
+        names = [l["op"] for n, l, v in parse_exposition(out) if "op" in l]
+        assert names[0] == 'x "quoted"\nnl\\'
+
+    def test_unescape_is_single_pass(self):
+        """Regression: sequential str.replace corrupted a literal
+        backslash followed by 'n' (r'C:\\new' -> backslash+newline)."""
+        from kubeflow_tpu.utils.monitoring import parse_exposition
+
+        reg = MetricsRegistry()
+        c = reg.counter("kftpu_p_total", "t", labels=("path",))
+        for v in ("C:\\new", "a\\\\nb", "\\n", "end\\"):
+            c.inc(path=v)
+        parsed = {l["path"] for n, l, v in parse_exposition(reg.render())
+                  if "path" in l}
+        assert parsed == {"C:\\new", "a\\\\nb", "\\n", "end\\"}
+
+    def test_label_typo_raises(self):
+        _, h = self._hist()
+        with pytest.raises(ValueError):
+            h.observe(0.1, verv="get")
+
+    def test_nonfinite_buckets_rejected(self):
+        from kubeflow_tpu.utils.monitoring import Histogram
+
+        with pytest.raises(ValueError):
+            Histogram("kftpu_bad", "t", buckets=(0.1, float("inf")))
+
+    def test_quantile_interpolation(self):
+        reg, h = self._hist()
+        # 10 obs uniformly in (0, 0.01]: p50 interpolates inside bucket 1.
+        for _ in range(10):
+            h.observe(0.005, verb="get")
+        assert 0 < h.quantile(0.5, verb="get") <= 0.01
+        # All mass beyond the last finite bound clamps to it.
+        reg2 = MetricsRegistry()
+        h2 = reg2.histogram("kftpu_q", "t", buckets=(0.01, 0.1))
+        for _ in range(5):
+            h2.observe(99.0)
+        assert h2.quantile(0.99) == 0.1
+        assert reg2.get("kftpu_q") is h2
+
+    def test_quantile_aggregates_label_subsets(self):
+        reg, h = self._hist()
+        for _ in range(9):
+            h.observe(0.005, verb="get")
+        h.observe(0.5, verb="list")
+        # Per-label view vs whole-family view differ.
+        assert h.quantile(0.9, verb="get") <= 0.01
+        assert h.quantile(0.99) > 0.1
+        assert h.percentiles(verb="get")["p50"] <= 0.01
+
+    def test_empty_quantile_is_none(self):
+        _, h = self._hist()
+        assert h.quantile(0.5) is None
+        assert h.percentiles() == {}
+
+
+class TestSnapshotRegression:
+    """ISSUE 4 satellite: snapshot() used to silently skip Heartbeat
+    metrics (samplers missed stale-heartbeat detection) and could never
+    carry labeled gauges; now every registered metric contributes."""
+
+    def test_snapshot_includes_heartbeat(self):
+        reg = MetricsRegistry()
+        hb = reg.heartbeat("testctl")
+        hb.beat()
+        names = {name for name, _, _ in reg.snapshot()}
+        assert "kftpu_testctl_heartbeat" in names
+        val = [v for name, _, v in reg.snapshot()
+               if name == "kftpu_testctl_heartbeat"]
+        assert val[0] == hb.last() > 0
+
+    def test_snapshot_includes_labeled_gauge(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("kftpu_shard_depth", "t", labels=("shard",))
+        g.set(3.0, shard="0")
+        g.set(7.0, shard="1")
+        samples = {labels: v for name, labels, v in reg.snapshot()
+                   if name == "kftpu_shard_depth"}
+        assert samples[(("shard", "0"),)] == 3.0
+        assert samples[(("shard", "1"),)] == 7.0
+        assert 'shard="1"' in reg.render()
+
+    def test_snapshot_includes_histogram_series(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("kftpu_lat", "t", buckets=(0.1,))
+        h.observe(0.05)
+        names = {name for name, _, _ in reg.snapshot()}
+        assert {"kftpu_lat_bucket", "kftpu_lat_sum",
+                "kftpu_lat_count"} <= names
+
+    def test_snapshot_still_covers_counters_and_gauges(self):
+        reg = MetricsRegistry()
+        c = reg.counter("kftpu_c_total", "t", labels=("r",))
+        c.inc(r="ok")
+        reg.gauge("kftpu_g", "t", fn=lambda: 4.0)
+        got = {(name, labels): v for name, labels, v in reg.snapshot()}
+        assert got[("kftpu_c_total", (("r", "ok"),))] == 1.0
+        assert got[("kftpu_g", ())] == 4.0
+
+    def test_callback_gauge_cannot_take_labels(self):
+        from kubeflow_tpu.utils.monitoring import Gauge
+
+        with pytest.raises(ValueError):
+            Gauge("kftpu_x", "t", fn=lambda: 1.0, label_names=("a",))
+
+    def test_metric_name_sanitized(self):
+        from kubeflow_tpu.utils.monitoring import sanitize_metric_name
+
+        assert sanitize_metric_name("fake-kubelet") == "fake_kubelet"
+        reg = MetricsRegistry()
+        hb = reg.heartbeat("fake-kubelet")
+        assert hb.name == "kftpu_fake_kubelet_heartbeat"
+
+
 class TestValueFormatting:
     def test_timestamp_full_precision(self):
         from kubeflow_tpu.utils.monitoring import _fmt_value

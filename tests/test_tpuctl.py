@@ -187,6 +187,100 @@ class TestServingCrThroughTpuctl:
             assert env["KFTPU_SERVING_MAX_BATCH"] == "32"
 
 
+class TestTpuctlTrace:
+    def test_trace_timeline_for_completed_job(self, tmp_path, capsys):
+        """ISSUE 4 acceptance: `tpuctl trace` on an applied TpuJob prints
+        the causal write→reconcile timeline, and the reconcile span
+        durations sum consistently with (i.e. fit inside) the observed
+        convergence window."""
+        state = str(tmp_path / "state")
+        pf = _write(tmp_path, "platform.yaml", PLATFORM_YAML)
+        prof = _write(tmp_path, "profile.yaml", PROFILE_YAML)
+        job = _write(tmp_path, "job.yaml", JOB_YAML)
+        rc, _ = _run(["--state-dir", state, "apply", "-f", pf, "-f", prof,
+                      "-f", job], capsys)
+        assert rc == 0
+
+        rc, out = _run(["--state-dir", state, "trace", "TpuJob/train1",
+                        "-n", "ml"], capsys)
+        assert rc == 0
+        assert "TRACE TpuJob/ml/train1" in out
+        assert "create TpuJob ml/train1" in out
+        assert "reconcile tpujob ml/train1" in out
+        assert "links=" in out          # write-RV -> reconcile span links
+
+        # Machine-readable form: the span durations must be consistent —
+        # total reconcile time fits inside the timeline window.
+        rc, out = _run(["--state-dir", state, "trace", "TpuJob/train1",
+                        "-n", "ml", "-o", "json"], capsys)
+        assert rc == 0
+        spans = json.loads(out)
+        assert spans
+        t0 = min(s["start_unix"] for s in spans)
+        t_end = max(s["start_unix"] + max(s["duration_s"], 0)
+                    for s in spans)
+        recons = [s for s in spans if s["name"] == "reconcile"
+                  and s["attrs"].get("name") == "train1"]
+        assert recons
+        total_reconcile = sum(s["duration_s"] for s in recons)
+        assert 0 < total_reconcile <= (t_end - t0) + 1e-9
+        # Causality: at least one reconcile links back to a write span
+        # present in the same dump, sharing its trace id.
+        by_id = {s["span_id"]: s for s in spans}
+        linked = [s for s in recons if s["links"]]
+        assert linked
+        src = by_id.get(linked[0]["links"][0][1])
+        assert src is not None and src["name"].startswith("apiserver.")
+        assert src["trace_id"] == linked[0]["trace_id"]
+
+    def test_trace_unknown_object_fails(self, tmp_path, capsys):
+        state = str(tmp_path / "state")
+        pf = _write(tmp_path, "platform.yaml", PLATFORM_YAML)
+        _run(["--state-dir", state, "apply", "-f", pf], capsys)
+        rc = main(["--state-dir", state, "trace", "TpuJob/nope"])
+        assert rc == 1
+
+    def test_trace_without_state_fails(self, tmp_path, capsys):
+        rc = main(["--state-dir", str(tmp_path / "empty"), "trace",
+                   "TpuJob/x"])
+        assert rc == 1
+
+
+class TestTpuctlTop:
+    def test_top_summarizes_live_scrape(self, capsys):
+        """`tpuctl top` scrapes a LIVE /metrics endpoint and prints
+        per-controller reconcile p50/p95/p99 estimated from histogram
+        buckets."""
+        from kubeflow_tpu.controlplane.benchmark import run_controlplane_sweep
+        from kubeflow_tpu.utils.monitoring import (
+            MetricsHttpServer,
+            MetricsRegistry,
+        )
+
+        reg = MetricsRegistry()
+        rep = run_controlplane_sweep(num_jobs=6, num_namespaces=2,
+                                     registry=reg)
+        assert rep.all_succeeded
+        srv = MetricsHttpServer(reg, port=0, host="127.0.0.1")
+        try:
+            rc, out = _run(
+                ["top", "--url", f"http://127.0.0.1:{srv.port}/metrics"],
+                capsys)
+        finally:
+            srv.stop()
+        assert rc == 0
+        assert "CONTROLLER" in out and "P99(ms)" in out
+        assert "tpujob" in out and "fake-kubelet" in out
+        # Reconcile counts in the table match the sweep's executed total.
+        counts = [int(line.split()[1]) for line in out.splitlines()[1:]
+                  if line.strip()]
+        assert sum(counts) == rep.reconciles
+
+    def test_top_bad_url_fails(self, capsys):
+        rc = main(["top", "--url", "http://127.0.0.1:1/metrics"])
+        assert rc == 1
+
+
 class TestTpuctlLogs:
     def test_logs_for_job_gang(self, tmp_path, capsys):
         state = str(tmp_path / "state")

@@ -1,0 +1,262 @@
+"""ISSUE 4 tentpole: the in-process tracer, its threading through the
+apiserver + reconciler kernel (write-RV → reconcile span links, queue-wait
+and watch-lag histograms), cross-thread propagation under
+``ControllerManager.start()``, and log↔trace correlation."""
+
+import json
+import threading
+import time
+
+from kubeflow_tpu.controlplane.api import (
+    ObjectMeta,
+    TpuJob,
+    TpuJobSpec,
+)
+from kubeflow_tpu.controlplane.runtime import (
+    Controller,
+    ControllerManager,
+    InMemoryApiServer,
+    Result,
+)
+from kubeflow_tpu.utils.monitoring import MetricsRegistry
+from kubeflow_tpu.utils.tracing import Tracer, assemble_trace
+
+
+class StatusTouchController(Controller):
+    """Minimal controller: stamp a phase so reconciles write back."""
+
+    NAME = "touch"
+    WATCH_KINDS = ("TpuJob",)
+
+    def reconcile(self, namespace, name):
+        job = self.api.try_get("TpuJob", name, namespace)
+        if job is None:
+            return Result()
+        if job.status.phase != "Touched":
+            job.status.phase = "Touched"
+            self.api.update_status(job)
+        return Result()
+
+
+def _world():
+    tracer = Tracer()
+    registry = MetricsRegistry()
+    api = InMemoryApiServer(registry=registry, tracer=tracer)
+    mgr = ControllerManager(api, registry, tracer=tracer)
+    ctl = StatusTouchController(api, registry=MetricsRegistry())
+    mgr.register(ctl)
+    return tracer, registry, api, mgr
+
+
+def _job(name="j1", ns="t"):
+    return TpuJob(metadata=ObjectMeta(name=name, namespace=ns),
+                  spec=TpuJobSpec())
+
+
+class TestTracerCore:
+    def test_nesting_shares_trace_and_parent(self):
+        tr = Tracer()
+        with tr.span("outer") as outer:
+            assert tr.current() is outer
+            with tr.span("inner") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_id == outer.span_id
+        assert tr.current() is None
+        names = [s.name for s in tr.spans()]
+        assert names == ["inner", "outer"]      # recorded at close
+        assert all(s.duration_s >= 0 for s in tr.spans())
+
+    def test_sibling_traces_are_distinct(self):
+        tr = Tracer()
+        with tr.span("a"):
+            pass
+        with tr.span("b"):
+            pass
+        a, b = tr.spans()
+        assert a.trace_id != b.trace_id
+
+    def test_explicit_trace_id_adoption(self):
+        tr = Tracer()
+        with tr.span("write") as w:
+            pass
+        with tr.span("reconcile", links=[w.context],
+                     trace_id=w.trace_id) as r:
+            pass
+        assert r.trace_id == w.trace_id
+        assert r.links == [w.context]
+
+    def test_ring_buffer_bounds_memory(self):
+        tr = Tracer(capacity=8)
+        for i in range(20):
+            with tr.span(f"s{i}"):
+                pass
+        spans = tr.spans()
+        assert len(spans) == 8
+        assert spans[0].name == "s12"           # oldest evicted
+
+    def test_attr_filtering(self):
+        tr = Tracer()
+        with tr.span("x", attrs={"kind": "TpuJob", "name": "a"}):
+            pass
+        with tr.span("x", attrs={"kind": "Pod", "name": "a"}):
+            pass
+        assert len(tr.spans("x", kind="TpuJob")) == 1
+
+    def test_jsonl_round_trip(self, tmp_path):
+        tr = Tracer()
+        with tr.span("w", attrs={"rv": 3}) as w:
+            pass
+        with tr.span("r", links=[w.context]):
+            pass
+        p = str(tmp_path / "t.jsonl")
+        assert tr.export_jsonl(p) == 2
+        loaded = Tracer.load_jsonl(p)
+        assert [s.name for s in loaded] == ["w", "r"]
+        assert loaded[0].attrs["rv"] == 3
+        assert loaded[1].links == [w.context]
+
+    def test_export_new_never_duplicates(self, tmp_path):
+        """Platform.save calls this once per tpuctl subcommand — repeated
+        exports of an unchanged ring must append nothing."""
+        tr = Tracer()
+        p = str(tmp_path / "t.jsonl")
+        with tr.span("a"):
+            pass
+        assert tr.export_new_jsonl(p) == 1
+        assert tr.export_new_jsonl(p) == 0
+        with tr.span("b"):
+            pass
+        assert tr.export_new_jsonl(p) == 1
+        assert [s.name for s in Tracer.load_jsonl(p)] == ["a", "b"]
+
+
+class TestKernelInstrumentation:
+    def test_write_rv_link_reaches_reconcile_span(self):
+        """The tentpole contract: the reconcile span triggered by a write's
+        watch event links back to that write's span context and ADOPTS its
+        trace id — one trace covers write → watch → reconcile → status
+        update."""
+        tracer, registry, api, mgr = _world()
+        created = api.create(_job())
+        create_span = tracer.spans("apiserver.create", kind="TpuJob")[-1]
+        assert create_span.attrs["rv"] == created.metadata.resource_version
+        mgr.run_until_idle()
+        recons = tracer.spans("reconcile", controller="touch")
+        assert recons, "no reconcile spans recorded"
+        first = recons[0]
+        assert create_span.context in first.links
+        assert first.trace_id == create_span.trace_id
+        assert first.attrs["outcome"] == "ok"
+        # The status update the reconcile made nested under it: same
+        # trace, parented to the reconcile span.
+        status_spans = [
+            s for s in tracer.spans("apiserver.update_status")
+            if s.parent_id == first.span_id
+        ]
+        assert status_spans
+        assert status_spans[0].trace_id == create_span.trace_id
+        mgr.close()
+
+    def test_latency_histograms_observe_each_reconcile(self):
+        tracer, registry, api, mgr = _world()
+        for i in range(3):
+            api.create(_job(f"j{i}"))
+        n = mgr.run_until_idle()
+        hist = registry.get("kftpu_reconcile_duration_seconds")
+        assert hist.count(controller="touch", result="ok") == n
+        qwait = registry.get("kftpu_workqueue_wait_seconds")
+        assert qwait.count(controller="touch") == n
+        wlag = registry.get("kftpu_watch_delivery_lag_seconds")
+        assert wlag.count(controller="touch") > 0
+        # Per-verb apiserver latency histograms saw the writes too.
+        verb = registry.get("kftpu_apiserver_request_duration_seconds")
+        assert verb.count(verb="create") == 3
+        assert verb.quantile(0.5, verb="create") is not None
+        mgr.close()
+
+    def test_propagation_across_manager_thread(self):
+        """Satellite: spans recorded by the background start() thread still
+        carry the main-thread write's span context — propagation is
+        explicit (event stamps), not contextvar inheritance, so a fresh
+        thread context must not sever the causal chain."""
+        tracer, registry, api, mgr = _world()
+        main_thread = threading.get_ident()
+        with tracer.span("client.apply"):
+            api.create(_job("bg"))
+            create_span = tracer.spans("apiserver.create", kind="TpuJob")[-1]
+        mgr.start()
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if tracer.spans("reconcile", controller="touch"):
+                    job = api.get("TpuJob", "bg", "t")
+                    if job.status.phase == "Touched":
+                        break
+                time.sleep(0.01)
+        finally:
+            mgr.stop()
+        recons = tracer.spans("reconcile", controller="touch")
+        assert recons, "background thread recorded no reconcile spans"
+        assert create_span.context in recons[0].links
+        assert recons[0].trace_id == create_span.trace_id
+        # And the client-side root span really was on another thread than
+        # the reconcile (start() pumps in its own thread).
+        assert threading.get_ident() == main_thread
+        mgr.close()
+
+    def test_assemble_trace_covers_causal_chain(self):
+        tracer, registry, api, mgr = _world()
+        api.create(_job("asm"))
+        mgr.run_until_idle()
+        chain = assemble_trace(tracer.spans(), "TpuJob", "asm", "t")
+        names = {s.name for s in chain}
+        assert "apiserver.create" in names
+        assert "reconcile" in names
+        assert "apiserver.update_status" in names
+        # Chronological by wall clock.
+        starts = [s.start_unix for s in chain]
+        assert starts == sorted(starts)
+        mgr.close()
+
+
+class TestJsonLogging:
+    def test_json_format_carries_trace_ids(self, monkeypatch, capsys):
+        """Satellite: KFTPU_LOG_FORMAT=json emits one JSON object per line
+        with the active span's trace_id/span_id attached and kv pairs as
+        structured fields."""
+        from kubeflow_tpu.utils import logging as kflog
+
+        monkeypatch.setenv("KFTPU_LOG_FORMAT", "json")
+        kflog.configure(force=True)
+        try:
+            log = kflog.get_logger("tracetest")
+            # A PRIVATE tracer, not the global one: Platform/benches run
+            # their own, and correlation must still work (regression —
+            # the formatter once read only global_tracer's context).
+            with Tracer().span("op") as sp:
+                log.info("hello", kv={"job": "j1", "n": 3})
+            err = capsys.readouterr().err.strip().splitlines()
+            rec = json.loads(err[-1])
+            assert rec["msg"] == "hello"
+            assert rec["job"] == "j1" and rec["n"] == 3
+            assert rec["trace_id"] == sp.trace_id
+            assert rec["span_id"] == sp.span_id
+            assert rec["logger"] == "kubeflow_tpu.tracetest"
+            # Outside any span: no trace fields, still valid JSON.
+            log.info("bye")
+            rec2 = json.loads(
+                capsys.readouterr().err.strip().splitlines()[-1])
+            assert "trace_id" not in rec2
+        finally:
+            monkeypatch.setenv("KFTPU_LOG_FORMAT", "text")
+            kflog.configure(force=True)
+
+    def test_text_format_unchanged(self, monkeypatch, capsys):
+        from kubeflow_tpu.utils import logging as kflog
+
+        monkeypatch.setenv("KFTPU_LOG_FORMAT", "text")
+        kflog.configure(force=True)
+        log = kflog.get_logger("texttest", component="x")
+        log.info("plain", kv={"a": 1})
+        err = capsys.readouterr().err
+        assert "plain component=x a=1" in err
