@@ -685,28 +685,76 @@ def bench_controlplane(args) -> None:
     indexed, copy-light apiserver. No JAX involved — this measures the
     coordination layer (the wall of arxiv 2011.03641), and proves the
     O(matches) list contract with a deterministic copy counter rather
-    than wall-clock."""
+    than wall-clock.
+
+    ``--workers N`` (ISSUE 5) additionally runs the worker-pool scaling
+    sweep: the SAME fleet with serial dispatch and with an N-worker pool,
+    gated on final-state equality (count-based signature) between the
+    two. Worker sweeps default to a modeled per-verb API RTT
+    (``--rtt-us``, applied to BOTH runs): in-process at zero RTT the GIL
+    serializes the pure-Python reconcile bodies and the comparison would
+    measure the interpreter, not the dispatcher — real control planes
+    pay ~ms apiserver round trips, which is exactly the wait
+    MaxConcurrentReconciles-style pools overlap."""
     from kubeflow_tpu.controlplane.benchmark import run_controlplane_sweep
 
     jobs = args.requests or 1000
-    rep = run_controlplane_sweep(num_jobs=jobs,
-                                 num_namespaces=args.namespaces)
-    # Hard gates (raise, not assert: python -O must not skip them).
-    if not rep.all_succeeded:
-        raise SystemExit(f"sweep did not converge: {rep.phases}")
-    # The counter-based acceptance gate: a namespaced list copies
-    # O(matches) objects, not O(store).
-    if not rep.copies_scale_with_matches:
+
+    def gates(rep, tag=""):
+        # Hard gates (raise, not assert: python -O must not skip them).
+        if not rep.all_succeeded:
+            raise SystemExit(f"sweep{tag} did not converge: {rep.phases}")
+        # The counter-based acceptance gate: a namespaced list copies
+        # O(matches) objects, not O(store).
+        if not rep.copies_scale_with_matches:
+            raise SystemExit(
+                f"list({rep.probe_namespace}){tag} copied {rep.list_copies} "
+                f"objects for {rep.list_matches} matches in a "
+                f"{rep.store_objects}-object store — the indexed/copy-light "
+                "read path regressed to O(store)"
+            )
+
+    if args.workers <= 1:
+        # An explicit --rtt-us applies to the serial run too (a silent
+        # zero-RTT run would mislabel the emitted record).
+        rep = run_controlplane_sweep(
+            num_jobs=jobs, num_namespaces=args.namespaces,
+            rtt_s=(args.rtt_us or 0) * 1e-6,
+        )
+        gates(rep)
+        _emit(
+            "controlplane_sweep_reconciles_per_sec",
+            rep.reconciles_per_sec, "reconciles/s",
+            BASELINES["controlplane"],
+            **rep.summary(),
+        )
+        return
+
+    rtt_s = (args.rtt_us if args.rtt_us is not None else 500) * 1e-6
+    serial = run_controlplane_sweep(num_jobs=jobs,
+                                    num_namespaces=args.namespaces,
+                                    workers=1, rtt_s=rtt_s)
+    gates(serial, tag="[workers=1]")
+    par = run_controlplane_sweep(num_jobs=jobs,
+                                 num_namespaces=args.namespaces,
+                                 workers=args.workers, rtt_s=rtt_s)
+    gates(par, tag=f"[workers={args.workers}]")
+    if par.state_signature != serial.state_signature:
         raise SystemExit(
-            f"list({rep.probe_namespace}) copied {rep.list_copies} objects "
-            f"for {rep.list_matches} matches in a {rep.store_objects}-object "
-            "store — the indexed/copy-light read path regressed to O(store)"
+            f"worker-pool sweep diverged: workers={args.workers} converged "
+            f"to {par.final_state} but serial to {serial.final_state} — "
+            "per-key serialization or dirty-requeue semantics regressed"
         )
     _emit(
-        "controlplane_sweep_reconciles_per_sec",
-        rep.reconciles_per_sec, "reconciles/s",
-        BASELINES["controlplane"],
-        **rep.summary(),
+        "controlplane_workers_reconciles_per_sec",
+        par.reconciles_per_sec, "reconciles/s",
+        serial.reconciles_per_sec,      # baseline = the serial run
+        speedup_vs_serial=round(
+            par.reconciles_per_sec / serial.reconciles_per_sec, 3)
+        if serial.reconciles_per_sec else 0.0,
+        serial=serial.summary(),
+        final_state_identical=True,
+        **par.summary(),
     )
 
 
@@ -908,6 +956,15 @@ def main() -> None:
     p.add_argument("--namespaces", type=int, default=20,
                    help="controlplane bench: namespaces the job fleet is "
                         "spread across (exercises the per-ns index)")
+    p.add_argument("--workers", type=int, default=1,
+                   help="controlplane bench: reconcile worker-pool size; "
+                        ">1 runs the scaling sweep (serial vs pool, same "
+                        "fleet) gated on final-state equality")
+    p.add_argument("--rtt-us", type=int, default=None,
+                   help="controlplane --workers sweep: modeled per-verb "
+                        "API RTT in microseconds, paid by BOTH runs "
+                        "(default 500; 0 = in-process zero-RTT, where the "
+                        "GIL — not the dispatcher — is what's measured)")
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--gen-len", type=int, default=128)
     p.add_argument("--decode-chunk", type=int, default=32)

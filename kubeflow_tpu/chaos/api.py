@@ -192,7 +192,10 @@ class ChaosApiServer:
         self.enabled = True
         # Plain-dict tally ("verb:kind:fault" -> n) for cheap test asserts
         # and determinism comparisons, next to the exported counter.
+        # Locked: workers>1 soaks inject from concurrent reconciles, and
+        # an unlocked read-modify-write would silently undercount.
         self.injected: Dict[str, int] = {}
+        self._tally_lock = threading.Lock()
         self.metrics_injected = registry.counter(
             "kftpu_chaos_injected_total",
             "Faults injected by the chaos API server",
@@ -246,7 +249,8 @@ class ChaosApiServer:
 
     def _record(self, verb: str, kind: str, fault: str) -> None:
         key = f"{verb}:{kind}:{fault}"
-        self.injected[key] = self.injected.get(key, 0) + 1
+        with self._tally_lock:
+            self.injected[key] = self.injected.get(key, 0) + 1
         self.metrics_injected.inc(verb=verb, kind=kind, fault=fault)
 
     def _maybe_inject(self, verb: str, kind: str, ref: str) -> None:

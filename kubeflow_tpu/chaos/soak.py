@@ -55,6 +55,7 @@ class SoakReport:
         default_factory=dict)
     queue_wait_s: Dict[str, float] = dataclasses.field(default_factory=dict)
     watch_lag_s: Dict[str, float] = dataclasses.field(default_factory=dict)
+    workers: int = 1                 # reconcile worker-pool size
 
     def stuck_jobs(self) -> Dict[str, str]:
         return {n: p for n, p in self.phases.items() if p not in TERMINAL}
@@ -79,6 +80,7 @@ def run_soak(
     constrained_capacity: bool = True,
     latency_s: float = 0.0,          # per-verb injected API latency
     watch_lag_s: float = 0.0,        # injected watch-delivery lag
+    workers: int = 1,                # reconcile worker-pool size (ISSUE 5)
     registry: Optional[MetricsRegistry] = None,
 ) -> SoakReport:
     registry = registry or MetricsRegistry()
@@ -112,9 +114,15 @@ def run_soak(
     chaos = ChaosApiServer(inner, seed=seed, registry=registry, rules=rules,
                            watch_lag_s=watch_lag_s)
     capacity = {slice_type: num_jobs} if constrained_capacity else None
+    # workers > 1 hunts races: distinct keys reconcile concurrently while
+    # the chaos proxy injects conflicts/transients into their writes. The
+    # fault SEQUENCE is then a function of thread interleaving (one RNG,
+    # racing callers), so parallel soaks assert convergence, not the
+    # byte-identical injection tallies the serial seed contract gives.
     mgr = ControllerManager(
         chaos, registry,
         limiter=ExponentialBackoffLimiter(seed=seed + 1),
+        workers=workers,
     )
     job_ctl = TpuJobController(chaos, registry, capacity=capacity,
                                hbm_check=False)
@@ -220,6 +228,7 @@ def run_soak(
         queue_wait_s=registry.percentiles("kftpu_workqueue_wait_seconds"),
         watch_lag_s=registry.percentiles(
             "kftpu_watch_delivery_lag_seconds"),
+        workers=workers,
     )
     log.info("soak done", kv={
         "converged": converged, "rounds": rounds,

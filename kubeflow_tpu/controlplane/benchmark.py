@@ -24,6 +24,7 @@ Everything is in-process and sleep-free (``run_until_idle`` +
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import time
 from typing import Dict, Optional
 
@@ -65,6 +66,18 @@ class ControlPlaneReport:
     # `reconciles` while under ring capacity (what obs-smoke gates on);
     # large sweeps keep only the newest spans by design.
     reconcile_spans: int = 0
+    # Worker-pool sweep parameters (ISSUE 5): dispatch concurrency and
+    # the modeled per-verb API RTT the reconciles paid.
+    workers: int = 1
+    rtt_s: float = 0.0
+    # Converged-state identity: per-kind/phase object counts plus a
+    # signature over every (kind, namespace, name, phase) in the store
+    # (Events excluded — uuid-named byproducts whose count legitimately
+    # varies with reconcile interleaving). Two sweeps that converged to
+    # the same world have equal signatures regardless of worker count.
+    final_state: Dict[str, Dict[str, int]] = dataclasses.field(
+        default_factory=dict)
+    state_signature: str = ""
 
     @property
     def copies_scale_with_matches(self) -> bool:
@@ -91,7 +104,35 @@ class ControlPlaneReport:
             "queue_wait_s": dict(self.queue_wait_s),
             "watch_lag_s": dict(self.watch_lag_s),
             "reconcile_spans": self.reconcile_spans,
+            "workers": self.workers,
+            "rtt_s": self.rtt_s,
+            "final_state": {k: dict(v) for k, v in self.final_state.items()},
+            "state_signature": self.state_signature,
         }
+
+
+def state_fingerprint(objs) -> tuple:
+    """(per-kind phase counts, sha256 signature) over the given stored
+    objects (``api.list_all()``). The signature covers every
+    (kind, namespace, name, phase) — Events excluded: they are uuid-named
+    and their count varies with reconcile interleaving by design — so it
+    is identical across worker counts iff the sweeps converged to the
+    same world. Counts, never wall-clock: the CI gate built on this
+    cannot flake."""
+    rows = []
+    counts: Dict[str, Dict[str, int]] = {}
+    for obj in objs:
+        if obj.kind == "Event":
+            continue
+        phase = str(getattr(getattr(obj, "status", None), "phase", "") or "")
+        rows.append((obj.kind, obj.metadata.namespace or "",
+                     obj.metadata.name, phase))
+        counts.setdefault(obj.kind, {})
+        counts[obj.kind][phase or "-"] = counts[obj.kind].get(phase or "-", 0) + 1
+    digest = hashlib.sha256(
+        "\n".join("|".join(r) for r in sorted(rows)).encode()
+    ).hexdigest()
+    return counts, digest
 
 
 def run_controlplane_sweep(
@@ -102,7 +143,15 @@ def run_controlplane_sweep(
     max_rounds: int = 12,
     registry: Optional[MetricsRegistry] = None,
     tracer: Optional[Tracer] = None,
+    workers: int = 1,
+    rtt_s: float = 0.0,
 ) -> ControlPlaneReport:
+    """``workers`` sizes the manager's reconcile pool (ISSUE 5);
+    ``rtt_s`` > 0 models a per-verb API round trip (the latency every
+    real control plane pays to its apiserver) via a seeded latency-only
+    chaos proxy — the regime where dispatch concurrency, not CPU, is the
+    ceiling. Both default off, keeping the historical sweep byte-
+    identical."""
     if num_jobs < 1 or num_namespaces < 1:
         raise ValueError("num_jobs and num_namespaces must be >= 1")
     num_namespaces = min(num_namespaces, num_jobs)
@@ -111,10 +160,23 @@ def run_controlplane_sweep(
     # CI obs-smoke stage counts reconcile spans out of it.
     tracer = tracer or Tracer()
     api = InMemoryApiServer(registry=registry, tracer=tracer)
-    mgr = ControllerManager(api, registry, tracer=tracer)
-    job_ctl = TpuJobController(api, registry, hbm_check=False)
+    front: object = api
+    if rtt_s > 0:
+        from kubeflow_tpu.chaos.api import ChaosApiServer, FaultSpec
+
+        # Latency-only rules, no fault bands: every verb the controllers
+        # issue sleeps rtt_s before hitting the store (try_get stays
+        # free — it models the local informer read). The sleep happens
+        # outside the store lock, so concurrent reconciles overlap their
+        # RTTs — exactly what the worker pool exists to exploit.
+        front = ChaosApiServer(
+            api, seed=0, registry=registry,
+            rules={"*:*": FaultSpec(latency_s=rtt_s)},
+        )
+    mgr = ControllerManager(front, registry, tracer=tracer, workers=workers)
+    job_ctl = TpuJobController(front, registry, hbm_check=False)
     mgr.register(job_ctl)
-    kubelet = FakeKubelet(api, registry,
+    kubelet = FakeKubelet(front, registry,
                           outcome=lambda name: "Succeeded")
     mgr.register(kubelet)
 
@@ -169,6 +231,8 @@ def run_controlplane_sweep(
     for j in api.list("TpuJob", copy=False):
         phase_tally[j.status.phase] = phase_tally.get(j.status.phase, 0) + 1
 
+    store = api.list_all()
+    final_state, signature = state_fingerprint(store)
     report = ControlPlaneReport(
         jobs=num_jobs,
         pods=num_jobs * hosts,
@@ -178,7 +242,7 @@ def run_controlplane_sweep(
         reconciles_per_sec=reconciles / wall if wall > 0 else 0.0,
         all_succeeded=phase_tally.get("Succeeded", 0) == num_jobs,
         phases=phase_tally,
-        store_objects=len(api._objects),
+        store_objects=len(store),
         copied_during_sweep=copied_sweep,
         probe_namespace=probe_ns,
         list_matches=len(matches),
@@ -189,6 +253,10 @@ def run_controlplane_sweep(
         watch_lag_s=registry.percentiles(
             "kftpu_watch_delivery_lag_seconds"),
         reconcile_spans=len(tracer.spans("reconcile")),
+        workers=workers,
+        rtt_s=rtt_s,
+        final_state=final_state,
+        state_signature=signature,
     )
     mgr.close()     # throwaway manager: release its watch queues
     return report

@@ -24,8 +24,9 @@ TFJob+openmpi pair. Differences by design:
 from __future__ import annotations
 
 import json
+import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from kubeflow_tpu.controlplane.api.core import (
     Container,
@@ -90,6 +91,17 @@ class TpuJobController(Controller):
         # reconcile re-enters constantly, eval_shape only needs to run once
         # per distinct spec.
         self._hbm_cache: Dict[tuple, Optional[str]] = {}
+        # Admission serialization (ISSUE 5): the quota/capacity gates are
+        # cross-key check-then-act — each job lists OTHER jobs' phases and
+        # then writes only its OWN status, so resourceVersion conflicts
+        # never detect two jobs admitting at once. Per-key serialization
+        # doesn't cover that, so with workers>1 the whole gate runs under
+        # this lock and an admitted-but-not-yet-visible job holds a
+        # *reservation* (uid -> (namespace, slice_type, num_slices,
+        # chips)) counted by later checks until the store itself shows the
+        # job in an in-use phase.
+        self._admission_lock = threading.Lock()
+        self._admission_reserved: Dict[str, Tuple[str, str, int, int]] = {}
         self.recorder = EventRecorder(api, self.NAME)
         self.metrics_restarts = registry.counter(
             "kftpu_tpujob_gang_restarts_total", "Gang restarts", ("reason",)
@@ -202,25 +214,84 @@ class TpuJobController(Controller):
 
     # ------------- admission -------------
 
+    #: Phases that hold slice capacity / chip quota.
+    IN_USE_PHASES = ("Scheduling", "Starting", "Running", "Restarting")
+
     def _admission_blocked(self, job: TpuJob, st) -> Optional[tuple]:
+        """Gang admission (all or nothing). The whole check-then-reserve
+        runs under one lock: with a reconcile worker pool two jobs
+        checking concurrently would each see the other still Pending and
+        both admit past cap/quota — no ConflictError fires because each
+        writes only its own status. An admitted job holds a reservation
+        until the store shows it in an in-use phase."""
         chips = st.num_chips * job.spec.num_slices
-        # Per-namespace TPU chip quota from ResourceQuota (emitted by the
-        # profile controller from Profile.spec.tpu_chip_quota).
-        for rq in self.reader.list("ResourceQuota",
-                                   namespace=job.metadata.namespace,
-                                   copy=False):
-            hard = int(rq.hard.get("google.com/tpu", "0") or 0)
-            if hard <= 0:
-                continue
-            used = 0
-            for other in self.reader.list("TpuJob",
+        # Quota specs are read outside the lock (the lock protects the
+        # job-phase check-then-act, not rarely-changing quota objects).
+        quotas = [
+            rq for rq in self.reader.list("ResourceQuota",
                                           namespace=job.metadata.namespace,
-                                          copy=False):
-                if other.metadata.name == job.metadata.name:
+                                          copy=False)
+            if int(rq.hard.get("google.com/tpu", "0") or 0) > 0
+        ]
+        if not quotas and self.capacity is None:
+            # No gate configured (the unbounded dev/bench path): skip the
+            # lock, the cluster-wide job list and the ledger — otherwise
+            # every reconcile across the worker pool serializes here for
+            # nothing.
+            return None
+        with self._admission_lock:
+            blocked = self._admission_blocked_locked(job, chips, quotas)
+            if blocked is None:
+                self._admission_reserved[job.metadata.uid] = (
+                    job.metadata.namespace, job.spec.slice_type,
+                    job.spec.num_slices, chips,
+                )
+            else:
+                # A blocked job parks Pending: it must not keep holding
+                # capacity it admitted for in an earlier pass.
+                self._admission_reserved.pop(job.metadata.uid, None)
+            return blocked
+
+    def _admission_blocked_locked(self, job: TpuJob, chips: int,
+                                  quotas: List) -> Optional[tuple]:
+        if self.capacity is not None:
+            # The capacity gate is cluster-wide by definition.
+            all_jobs = self.reader.list("TpuJob", copy=False)
+        else:
+            # Quota-only: keep the namespaced read the old gate did —
+            # this scan runs under the one lock every worker must pass
+            # through. Namespaces holding reservations (few, short-lived)
+            # are added so pruning still sees those jobs' phases.
+            ns_needed = {job.metadata.namespace}
+            ns_needed.update(
+                ns for ns, _, _, _ in self._admission_reserved.values())
+            all_jobs = []
+            for ns in sorted(ns_needed):
+                all_jobs.extend(
+                    self.reader.list("TpuJob", namespace=ns, copy=False))
+        by_uid = {o.metadata.uid: o for o in all_jobs}
+        # Prune reservations: redundant once the store shows the job
+        # in-use (counted from its phase below), dead once terminal/gone.
+        for uid in list(self._admission_reserved):
+            o = by_uid.get(uid)
+            if o is None or o.status.phase in self.IN_USE_PHASES \
+                    or o.status.phase in ("Succeeded", "Failed"):
+                del self._admission_reserved[uid]
+        reserved = [r for uid, r in self._admission_reserved.items()
+                    if uid != job.metadata.uid]
+        # Per-namespace TPU chip quota from ResourceQuota (emitted by the
+        # profile controller from Profile.spec.tpu_chip_quota). The used
+        # tally depends only on the namespace + ledger, not the quota
+        # object — computed once, not per rq (this runs under the one
+        # lock every worker must pass through).
+        if quotas:
+            used = sum(c for ns, _, _, c in reserved
+                       if ns == job.metadata.namespace)
+            for other in all_jobs:
+                if other.metadata.namespace != job.metadata.namespace \
+                        or other.metadata.name == job.metadata.name:
                     continue
-                if other.status.phase in (
-                    "Scheduling", "Starting", "Running", "Restarting"
-                ):
+                if other.status.phase in self.IN_USE_PHASES:
                     try:
                         used += (
                             get_slice(other.spec.slice_type).num_chips
@@ -228,23 +299,26 @@ class TpuJobController(Controller):
                         )
                     except KeyError:
                         pass
-            if used + chips > hard:
-                return (
-                    "QuotaExceeded",
-                    f"needs {chips} chips, {hard - used} available in quota",
-                )
+            for rq in quotas:
+                hard = int(rq.hard.get("google.com/tpu", "0") or 0)
+                if used + chips > hard:
+                    return (
+                        "QuotaExceeded",
+                        f"needs {chips} chips, {hard - used} available "
+                        "in quota",
+                    )
         # Cluster slice capacity.
         if self.capacity is not None:
             cap = self.capacity.get(job.spec.slice_type, 0)
             in_use = sum(
                 o.spec.num_slices
-                for o in self.reader.list("TpuJob", copy=False)
+                for o in all_jobs
                 if o.metadata.uid != job.metadata.uid
                 and o.spec.slice_type == job.spec.slice_type
-                and o.status.phase in (
-                    "Scheduling", "Starting", "Running", "Restarting"
-                )
+                and o.status.phase in self.IN_USE_PHASES
             )
+            in_use += sum(n for _, s, n, _ in reserved
+                          if s == job.spec.slice_type)
             if in_use + job.spec.num_slices > cap:
                 return (
                     "InsufficientCapacity",

@@ -61,7 +61,7 @@ def build(args) -> Tuple[object, ControllerManager, AvailabilityProber,
     """Wire the manager; separated from run() so tests can pump manually."""
     registry = MetricsRegistry()
     api = build_backend(args)
-    manager = ControllerManager(api)
+    manager = ControllerManager(api, workers=getattr(args, "workers", 1))
     names = [c.strip() for c in args.components.split(",") if c.strip()]
     for name in names:
         cls = CONTROLLERS.get(name)
@@ -110,6 +110,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--probe-interval", type=float, default=30.0)
     p.add_argument("--metrics-port", type=int, default=9090,
                    help="-1 disables the metrics endpoint")
+    p.add_argument("--workers", type=int, default=1,
+                   help="reconcile worker-pool size (the "
+                        "MaxConcurrentReconciles analogue): distinct keys "
+                        "reconcile concurrently, a key never overlaps "
+                        "itself; 1 = strictly serial dispatch")
     return p
 
 

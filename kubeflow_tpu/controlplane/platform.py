@@ -65,7 +65,8 @@ _START_ORDER = {name: i for i, name in enumerate(DEFAULT_COMPONENTS)}
 
 class Platform:
     def __init__(self, registry: Optional[MetricsRegistry] = None,
-                 tracer: Optional[Tracer] = None):
+                 tracer: Optional[Tracer] = None,
+                 workers: Optional[int] = None):
         self.registry = registry or MetricsRegistry()
         # Per-platform tracer + registry on the apiserver and the manager
         # (not the process-global ones): `tpuctl metrics` renders THIS
@@ -74,8 +75,23 @@ class Platform:
         self.tracer = tracer or Tracer()
         self.api = InMemoryApiServer(registry=self.registry,
                                      tracer=self.tracer)
+        # ``workers`` sizes the manager's reconcile pool (default 1 =
+        # serial dispatch; per-key serialization holds at any size, so
+        # tpuctl --wait's run_until_idle drain stays deterministic).
+        # ``KFTPU_WORKERS`` overrides the default so every Platform
+        # entrypoint (tpuctl, bootstrap, CI) can run pooled without
+        # threading a flag through each subcommand.
+        if workers is None:
+            raw = os.environ.get("KFTPU_WORKERS", "1") or "1"
+            try:
+                workers = int(raw)
+            except ValueError:
+                raise ValueError(
+                    f"KFTPU_WORKERS must be an integer >= 1, got {raw!r}"
+                ) from None
         self.manager = ControllerManager(self.api, self.registry,
-                                         tracer=self.tracer)
+                                         tracer=self.tracer,
+                                         workers=workers)
         self.kfam: Optional[AccessManagement] = None
         self.jwa = None          # NotebookWebApp when enabled
         self.dashboard = None    # DashboardApi when enabled

@@ -469,6 +469,13 @@ class InMemoryApiServer:
                 out = [deepcopy(o) for o in out]
             return _sorted_objs(out)
 
+    def list_all(self) -> List[Any]:
+        """Every stored snapshot, all kinds, shared zero-copy (read-only by
+        contract) — the store-wide enumeration benches and state
+        fingerprints use instead of reaching into ``_objects``."""
+        with self._lock:
+            return list(self._objects.values())
+
     # ----------------- status + finalizer conveniences -----------------
 
     def update_status(self, obj: Any) -> Any:

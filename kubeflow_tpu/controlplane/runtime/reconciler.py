@@ -11,8 +11,10 @@ provides metrics and heartbeat for free (SURVEY.md §7.2).
 from __future__ import annotations
 
 import collections
+import concurrent.futures
 import dataclasses
 import heapq
+import queue as queue_mod
 import threading
 import time
 import traceback
@@ -76,35 +78,65 @@ class CachedReader:
         self._store: Dict[Tuple[str, str, str], Any] = {}
         self._by_kind: Dict[str, Dict[Tuple[str, str, str], Any]] = {}
         self._by_kind_ns: Dict[Tuple[str, str], Dict[Tuple[str, str, str], Any]] = {}
+        # Store lock: guards the local store + indexes only, held per-apply
+        # and per-lookup — never across a queue drain. Draining is
+        # serialized PER KIND (one lock per subscription), so concurrent
+        # reconciles reading different kinds never queue up behind an
+        # unrelated drain (the old single-lock sync() drained every
+        # subscription under one lock on every read).
         self._lock = threading.Lock()
+        self._drain_locks: Dict[str, threading.Lock] = {}
+        self._sub_lock = threading.Lock()      # _watches/_drain_locks registry
 
     def watch_kind(self, kind: str) -> None:
-        with self._lock:
+        with self._sub_lock:
             if kind in self._watches:
                 return
+            self._drain_locks[kind] = threading.Lock()
             self._watches[kind] = self.api.watch(kind)
 
     def caches(self, kind: str) -> bool:
         return kind in self._watches
 
+    def _apply_locked(self, ev: Any) -> None:
+        key = _key(ev.object)
+        if ev.type == "DELETED":
+            self._store.pop(key, None)
+            index_drop(self._by_kind, self._by_kind_ns, key)
+        else:
+            self._store[key] = ev.object
+            index_put(self._by_kind, self._by_kind_ns, key, ev.object)
+
+    def _sync_kind(self, kind: str) -> int:
+        """Drain one kind's subscription into the local store; returns
+        events applied. The drain lock is taken blocking: read-your-own-
+        writes freshness requires waiting for a drain already holding our
+        event, not skipping it. Events are collected first and applied
+        under one short store-lock acquisition."""
+        q = self._watches.get(kind)
+        lock = self._drain_locks.get(kind)
+        if q is None or lock is None:
+            return 0
+        with lock:
+            events: List[Any] = []
+            while True:
+                try:
+                    events.append(q.get(block=False))
+                except queue_mod.Empty:
+                    break
+            if not events:
+                return 0
+            with self._lock:
+                for ev in events:
+                    self._apply_locked(ev)
+        return len(events)
+
     def sync(self) -> int:
         """Drain every subscription into the local store; returns events
-        applied."""
-        n = 0
-        with self._lock:
-            for q in self._watches.values():
-                while not q.empty():
-                    ev = q.get()
-                    key = _key(ev.object)
-                    if ev.type == "DELETED":
-                        self._store.pop(key, None)
-                        index_drop(self._by_kind, self._by_kind_ns, key)
-                    else:
-                        self._store[key] = ev.object
-                        index_put(self._by_kind, self._by_kind_ns,
-                                  key, ev.object)
-                    n += 1
-        return n
+        applied. Hot-path reads use the per-kind drain instead."""
+        with self._sub_lock:
+            kinds = list(self._watches)
+        return sum(self._sync_kind(k) for k in kinds)
 
     # -- reads --
 
@@ -112,7 +144,7 @@ class CachedReader:
             copy: bool = True) -> Any:
         if not self.caches(kind):
             return self.api.get(kind, name, namespace, copy=copy)
-        self.sync()
+        self._sync_kind(kind)
         ns = "" if kind in CLUSTER_SCOPED else namespace
         with self._lock:
             obj = self._store.get((kind, ns, name))
@@ -137,7 +169,7 @@ class CachedReader:
     ) -> List[Any]:
         if not self.caches(kind):
             return self.api.list(kind, namespace, label_selector, copy=copy)
-        self.sync()
+        self._sync_kind(kind)
         with self._lock:
             out = list_bucket(self._by_kind, self._by_kind_ns,
                               kind, namespace, label_selector)
@@ -146,10 +178,12 @@ class CachedReader:
         return _sorted_objs(out)
 
     def close(self) -> None:
-        with self._lock:
+        with self._sub_lock:
             for q in self._watches.values():
                 self.api.stop_watch(q)
             self._watches.clear()
+            self._drain_locks.clear()
+        with self._lock:
             self._store.clear()
             self._by_kind.clear()
             self._by_kind_ns.clear()
@@ -215,6 +249,18 @@ class ControllerManager:
       consistent assertions but without sleeps.
     - ``start()/stop()``: background thread pumping the same loop, for
       long-running services.
+
+    ``workers`` (default 1, preserving strictly-serial dispatch) sizes a
+    reconcile worker pool with client-go workqueue semantics
+    (the ``MaxConcurrentReconciles`` analogue):
+
+    - distinct keys reconcile concurrently, up to ``workers`` at a time;
+    - a key is NEVER reconciled concurrently with itself — dequeued keys
+      enter an in-flight set, and enqueues for an in-flight key mark it
+      *dirty* instead of queueing a duplicate;
+    - a dirty key re-enqueues exactly once when its reconcile completes,
+      so events arriving mid-reconcile are neither lost nor duplicated
+      (client-go's dirty-set-checked-in-Done contract).
     """
 
     #: Consecutive conflicts on one key retried immediately (the standard
@@ -236,9 +282,14 @@ class ControllerManager:
         limiter: Optional[ExponentialBackoffLimiter] = None,
         use_cache: Optional[bool] = None,
         tracer: Tracer = global_tracer,
+        workers: int = 1,
     ):
+        if workers < 1:
+            raise ValueError(f"workers must be >= 1, got {workers}")
         self.api = api
         self.tracer = tracer
+        self.workers = int(workers)
+        self._executor: Optional[concurrent.futures.ThreadPoolExecutor] = None
         self.controllers: List[Controller] = []
         self.limiter = limiter or ExponentialBackoffLimiter()
         self._queues: List[Any] = []
@@ -266,6 +317,17 @@ class ControllerManager:
             Tuple[Controller, Tuple[str, str]],
             Tuple[float, List[SpanContext]],
         ] = {}
+        # Per-key serialization state (client-go workqueue semantics):
+        # keys currently executing in the worker pool, and keys that
+        # received an enqueue while in flight (value: earliest-arrival
+        # monotonic time + causal links of the collapsed events) —
+        # re-enqueued exactly once at completion.
+        self._inflight: set = set()
+        self._dirty: Dict[Tuple[Controller, Tuple[str, str]],
+                          Tuple[float, List[SpanContext]]] = {}
+        # Backoff/requeue timers keyed on the MONOTONIC clock: wall-clock
+        # (time.time) deadlines misfire every parked timer on an NTP step
+        # backward and stall them all on a jump forward.
         self._timers: List[Tuple[float, int, Controller, Tuple[str, str]]] = []
         self._timer_seq = 0
         self._thread: Optional[threading.Thread] = None
@@ -298,6 +360,11 @@ class ControllerManager:
             "kftpu_workqueue_failing_keys",
             "Keys with a nonzero failure count in the backoff limiter",
             fn=_of_manager(lambda m: float(m.limiter.tracked_keys())),
+        )
+        registry.gauge(
+            "kftpu_workqueue_inflight",
+            "Reconciles currently executing in the worker pool",
+            fn=_of_manager(lambda m: float(len(m._inflight))),
         )
         # Latency decomposition (ISSUE 4): where a key's end-to-end time
         # goes — write → watch delivery → queue wait → reconcile. Queue
@@ -352,6 +419,8 @@ class ControllerManager:
                                  if c is not ctl}
             self._pending_meta = {pk: m for pk, m in self._pending_meta.items()
                                   if pk[0] is not ctl}
+            self._dirty = {pk: m for pk, m in self._dirty.items()
+                           if pk[0] is not ctl}
             self._timers = [t for t in self._timers if t[2] is not ctl]
             heapq.heapify(self._timers)
         ctl.reader = ctl.api
@@ -370,6 +439,9 @@ class ControllerManager:
             self.unregister(ctl)
         if self._cache is not None:
             self._cache.close()
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None
 
     # ------------- queue pumping -------------
 
@@ -377,8 +449,13 @@ class ControllerManager:
         n = 0
         now = time.monotonic()
         for ctl, primary, q in self._queues:
-            while not q.empty():
-                ev = q.get()
+            while True:
+                # Non-blocking get: empty()-then-get() wedges a drainer
+                # that races another consumer for the last event.
+                try:
+                    ev = q.get(block=False)
+                except queue_mod.Empty:
+                    break
                 n += 1
                 if ev.ts_mono > 0:
                     # Write-time → drain-time lag; under chaos watch-lag
@@ -401,6 +478,17 @@ class ControllerManager:
             # caller already tore down.
             return
         pkey = (ctl, key)
+        if pkey in self._inflight:
+            # The key is reconciling right now: mark it dirty so it
+            # re-enqueues exactly once on completion. Queueing it again
+            # here would let a second worker reconcile it concurrently
+            # with itself; dropping it would lose the event. The arrival
+            # time rides along so the queue-wait histogram counts the
+            # whole wait, not just the post-completion sliver.
+            entry = self._dirty.setdefault(pkey, (time.monotonic(), []))
+            if link is not None and len(entry[1]) < self.MAX_LINKS_PER_KEY:
+                entry[1].append(link)
+            return
         if pkey not in self._pending_set:
             self._pending_set.add(pkey)
             self._pending.append(pkey)
@@ -421,7 +509,11 @@ class ControllerManager:
             self._pending_add_locked(ctl, key, link)
 
     def _due_timers(self) -> None:
-        now = time.time()
+        # Monotonic deadlines: queue-wait/backoff math must not misfire
+        # (clock stepped back) or stall (stepped forward) on a wall-clock
+        # jump — timers used to mix time.time() here with time.monotonic()
+        # on the queue-wait side.
+        now = time.monotonic()
         with self._lock:
             while self._timers and self._timers[0][0] <= now:
                 _, _, ctl, key = heapq.heappop(self._timers)
@@ -431,16 +523,65 @@ class ControllerManager:
         with self._lock:
             self._timer_seq += 1
             heapq.heappush(
-                self._timers, (time.time() + after, self._timer_seq, ctl, key)
+                self._timers,
+                (time.monotonic() + after, self._timer_seq, ctl, key),
             )
+
+    def _take_locked(self) -> Optional[Tuple[Controller, Tuple[str, str], Any]]:
+        """Pop the next pending key and mark it in flight (caller holds
+        the lock). Every key in ``_pending`` is by construction NOT in
+        flight — enqueues for in-flight keys divert to the dirty set — so
+        whatever this returns is safe to reconcile concurrently with
+        every other dequeued key."""
+        if not self._pending:
+            return None
+        ctl, key = self._pending.popleft()
+        self._pending_set.discard((ctl, key))
+        meta = self._pending_meta.pop((ctl, key), None)
+        self._inflight.add((ctl, key))
+        return (ctl, key, meta)
+
+    def _finish_key(self, ctl: Controller, key: Tuple[str, str]) -> None:
+        """Retire an in-flight key; a key marked dirty while reconciling
+        re-enqueues exactly once, carrying the collapsed events' causal
+        links (client-go's Done())."""
+        with self._lock:
+            pkey = (ctl, key)
+            self._inflight.discard(pkey)
+            entry = self._dirty.pop(pkey, None)
+            if entry is not None:
+                dirty_since, links = entry
+                self._pending_add_locked(ctl, key)
+                meta = self._pending_meta.get(pkey)
+                if meta is not None:
+                    # Queue wait starts at the event's ARRIVAL, not at
+                    # this completion — the coalesced event waited the
+                    # whole reconcile out.
+                    self._pending_meta[pkey] = (
+                        dirty_since,
+                        meta[1] + links[:self.MAX_LINKS_PER_KEY],
+                    )
 
     def _process_one(self) -> bool:
         with self._lock:
-            if not self._pending:
-                return False
-            ctl, key = self._pending.popleft()
-            self._pending_set.discard((ctl, key))
-            meta = self._pending_meta.pop((ctl, key), None)
+            item = self._take_locked()
+        if item is None:
+            return False
+        self._execute(*item)
+        return True
+
+    def _execute(self, ctl: Controller, key: Tuple[str, str],
+                 meta: Optional[Tuple[float, List[SpanContext]]]) -> None:
+        try:
+            self._reconcile_once(ctl, key, meta)
+        finally:
+            # The in-flight reservation MUST release even on an exception
+            # escaping the handler ladder (BaseException), or the key
+            # wedges un-reconcilable forever.
+            self._finish_key(ctl, key)
+
+    def _reconcile_once(self, ctl: Controller, key: Tuple[str, str],
+                        meta: Optional[Tuple[float, List[SpanContext]]]) -> None:
         links: List[SpanContext] = []
         if meta is not None:
             self.metrics_queue_wait.observe(
@@ -505,38 +646,97 @@ class ControllerManager:
         self.metrics_reconcile_latency.observe(
             span.duration_s, controller=ctl.NAME, result=outcome)
         ctl.heartbeat.beat()
-        return True
+
+    # ------------- worker-pool dispatch -------------
+
+    def _ensure_executor(self) -> concurrent.futures.ThreadPoolExecutor:
+        if self._executor is None:
+            self._executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=self.workers,
+                thread_name_prefix="kftpu-reconcile",
+            )
+        return self._executor
+
+    def _process_batch(self) -> int:
+        """One dispatch round: drain the pending queue through the worker
+        pool, at most ``workers`` keys in flight at a time, until both the
+        queue and the pool are empty. Returns reconciles executed.
+
+        The sliding window (take-as-slots-free, not take-everything-up-
+        front) matters twice: the ``kftpu_workqueue_inflight`` gauge
+        reads keys actually EXECUTING (its documented triage meaning),
+        and events for keys still waiting in pending coalesce into the
+        queued entry instead of dirty-diverting into a wasted second
+        reconcile. Mid-round enqueues (dirty completions, conflict
+        retries) are picked up in the same round; growth is bounded —
+        watch events only drain between rounds and repeated conflicts
+        park on the backoff limiter — so the round terminates."""
+        ex: Optional[concurrent.futures.ThreadPoolExecutor] = None
+        futures: set = set()
+        done = 0
+        while True:
+            while len(futures) < self.workers:
+                with self._lock:
+                    item = self._take_locked()
+                if item is None:
+                    break
+                if ex is None:
+                    ex = self._ensure_executor()
+                futures.add(ex.submit(self._execute, *item))
+            if not futures:
+                return done
+            finished, futures = concurrent.futures.wait(
+                futures, return_when=concurrent.futures.FIRST_COMPLETED)
+            for f in finished:
+                f.result()
+                done += 1
 
     def is_idle(self) -> bool:
-        """No queued reconciles and no undrained watch events — used by the
-        availability prober: a stale heartbeat is only a wedge when there is
-        work waiting."""
+        """No queued reconciles, nothing executing or dirty in the worker
+        pool, and no undrained watch events — used by the availability
+        prober: a stale heartbeat is only a wedge when there is work
+        waiting."""
         with self._lock:
-            if self._pending:
+            if self._pending or self._inflight or self._dirty:
                 return False
         return all(q.empty() for _, _, q in self._queues)
+
+    def _fast_forward_timers(self, within: float) -> None:
+        with self._lock:
+            while self._timers and (
+                self._timers[0][0] - time.monotonic() <= within
+            ):
+                _, _, ctl, key = heapq.heappop(self._timers)
+                self._pending_add_locked(ctl, key)
 
     def run_until_idle(self, max_iterations: int = 10000, include_timers_within: float = 0.0) -> int:
         """Drain watches + queue until no immediate work remains. Returns the
         number of reconciles executed. Timers due within
         ``include_timers_within`` seconds are fast-forwarded (lets tests
-        exercise requeue-after logic without sleeping)."""
+        exercise requeue-after logic without sleeping).
+
+        With ``workers > 1`` each drain round dispatches every pending key
+        concurrently (deterministic final state — the store converges to
+        the same fixpoint — though reconcile interleavings, and hence the
+        exact reconcile count, may vary run to run)."""
         done = 0
         for _ in range(max_iterations):
             self._drain_watches()
             self._due_timers()
             if include_timers_within > 0:
-                with self._lock:
-                    while self._timers and (
-                        self._timers[0][0] - time.time() <= include_timers_within
-                    ):
-                        _, _, ctl, key = heapq.heappop(self._timers)
-                        self._pending_add_locked(ctl, key)
-            if not self._process_one():
+                self._fast_forward_timers(include_timers_within)
+            n = self._process_batch() if self.workers > 1 \
+                else int(self._process_one())
+            if n == 0:
                 if self._drain_watches() == 0:
                     return done
                 continue
-            done += 1
+            done += n
+        # Serial mode budgets reconciles (one per loop pass); batch mode
+        # budgets dispatch ROUNDS — cumulative reconciles may legitimately
+        # exceed max_iterations there (dirty re-enqueues cost extra
+        # passes), and a livelock still shows up as endless nonzero
+        # rounds, so only round exhaustion raises.
         raise RuntimeError(
             f"run_until_idle did not converge in {max_iterations} iterations "
             "(reconcile livelock — controllers keep producing events)"
@@ -553,7 +753,9 @@ class ControllerManager:
             while not self._stop.is_set():
                 self._drain_watches()
                 self._due_timers()
-                if not self._process_one():
+                n = self._process_batch() if self.workers > 1 \
+                    else int(self._process_one())
+                if n == 0:
                     time.sleep(0.01)
 
         self._thread = threading.Thread(target=loop, daemon=True)

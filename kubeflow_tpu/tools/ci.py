@@ -20,11 +20,16 @@ Stages (any failure exits non-zero — the merge gate contract):
    preemption; fails when any TpuJob is stuck in a non-terminal phase,
    the manager won't go idle, or availability doesn't recover to 1.
    ``--chaos-latency-s`` additionally runs the latency soak profile
-   (per-verb injected API latency; docs/chaos.md).
+   (per-verb injected API latency; docs/chaos.md); ``--chaos-workers``
+   (default 4) adds a **chaos-parallel-smoke** stage running the same
+   seeded soak through the reconcile worker pool, so injected faults
+   race concurrent reconciles.
 6. **cp-bench-smoke**: a small (N=50) control-plane sweep
    (kubeflow_tpu.controlplane.benchmark) gated on the *deterministic*
    copies-per-list counter: a namespaced list must deepcopy exactly its
-   matches, never the store (count-based, not wall-clock — cannot flake).
+   matches, never the store (count-based, not wall-clock — cannot flake);
+   plus a ``workers=4`` re-run gated on final-state equality with the
+   serial sweep (the per-object phase signature — counts again).
 7. **obs-smoke**: scrape a live MetricsHttpServer during a small fleet
    sweep; assert the exposition parses (histograms included) and that
    one reconcile span + one histogram observation exists per reconcile
@@ -59,28 +64,32 @@ def _stage(name: str):
     print(f"[ci] {name} ...", flush=True)
 
 
-def run_chaos_smoke(seed: int = 20260803, latency_s: float = 0.0) -> None:
+def run_chaos_smoke(seed: int = 20260803, latency_s: float = 0.0,
+                    workers: int = 1) -> None:
     """Seeded soak with a fixed budget; raises GateFailure on any job
     stuck non-terminal, a non-idle manager, or degraded availability.
     ``latency_s`` > 0 selects the latency soak profile (every chaos-visible
-    verb sleeps that long before executing)."""
+    verb sleeps that long before executing); ``workers`` > 1 runs the
+    soak against the reconcile worker pool — per-key serialization and
+    dirty-requeue must hold while faults race concurrent reconciles."""
     from kubeflow_tpu.chaos import run_soak
 
+    tag = f"seed={seed}, workers={workers}"
     rep = run_soak(num_jobs=4, seed=seed, conflict_rate=0.3,
                    transient_rate=0.05, preempt_every=3, fault_rounds=9,
-                   max_rounds=40, latency_s=latency_s)
+                   max_rounds=40, latency_s=latency_s, workers=workers)
     if not rep.converged:
         raise GateFailure(
-            f"chaos smoke (seed={seed}): stuck jobs after {rep.rounds} "
+            f"chaos smoke ({tag}): stuck jobs after {rep.rounds} "
             f"rounds: {rep.stuck_jobs()}"
         )
     if not rep.all_succeeded:
         raise GateFailure(
-            f"chaos smoke (seed={seed}): jobs failed: {rep.phases}"
+            f"chaos smoke ({tag}): jobs failed: {rep.phases}"
         )
     if rep.availability != 1.0:
         raise GateFailure(
-            f"chaos smoke (seed={seed}): availability "
+            f"chaos smoke ({tag}): availability "
             f"{rep.availability} != 1.0 after faults stopped"
         )
 
@@ -148,11 +157,15 @@ def run_obs_smoke(num_jobs: int = 10, num_namespaces: int = 2) -> None:
         )
 
 
-def run_cp_bench_smoke(num_jobs: int = 50, num_namespaces: int = 5) -> None:
+def run_cp_bench_smoke(num_jobs: int = 50, num_namespaces: int = 5,
+                       workers: int = 4) -> None:
     """Small control-plane sweep gated on the deterministic copy counter:
     the probe list must deepcopy exactly its matches (O(matches)), and the
-    fleet must fully converge. Counter-based, so it cannot flake on a slow
-    CI host the way a wall-clock threshold would."""
+    fleet must fully converge. ``workers`` > 1 additionally re-runs the
+    sweep through the reconcile worker pool and gates on final-state
+    equality with the serial run (the per-(kind, namespace, name, phase)
+    signature — counts, never wall-clock, so it cannot flake on a slow
+    CI host the way a speedup threshold would)."""
     from kubeflow_tpu.controlplane.benchmark import run_controlplane_sweep
 
     rep = run_controlplane_sweep(num_jobs=num_jobs,
@@ -169,11 +182,36 @@ def run_cp_bench_smoke(num_jobs: int = 50, num_namespaces: int = 5) -> None:
             f"(store holds {rep.store_objects}); the read path is back "
             "to O(store)"
         )
+    if workers > 1:
+        par = run_controlplane_sweep(num_jobs=num_jobs,
+                                     num_namespaces=num_namespaces,
+                                     workers=workers)
+        if not par.all_succeeded:
+            raise GateFailure(
+                f"cp-bench-smoke: workers={workers} sweep did not "
+                f"converge: {par.phases}"
+            )
+        if par.state_signature != rep.state_signature:
+            raise GateFailure(
+                f"cp-bench-smoke: workers={workers} converged to a "
+                f"DIFFERENT world than serial dispatch — "
+                f"{par.final_state} vs {rep.final_state}; per-key "
+                "serialization or dirty-requeue semantics regressed"
+            )
+        if not par.copies_scale_with_matches:
+            raise GateFailure(
+                f"cp-bench-smoke: copies-per-list regressed UNDER "
+                f"workers={workers} — list({par.probe_namespace!r}) "
+                f"copied {par.list_copies} objects for "
+                f"{par.list_matches} matches; the concurrent read path "
+                "is back to O(store)"
+            )
 
 
 def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
              skip_smoke: bool = False, skip_chaos: bool = False,
              chaos_seed: int = 20260803, chaos_latency_s: float = 0.0,
+             chaos_workers: int = 4,
              skip_cp_bench: bool = False,
              skip_obs: bool = False) -> List[str]:
     """Run all stages; returns the list of passed stages, raises
@@ -247,6 +285,10 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
         _stage("chaos-smoke")
         run_chaos_smoke(seed=chaos_seed)
         passed.append("chaos-smoke")
+        if chaos_workers > 1:
+            _stage("chaos-parallel-smoke")
+            run_chaos_smoke(seed=chaos_seed, workers=chaos_workers)
+            passed.append("chaos-parallel-smoke")
         if chaos_latency_s > 0:
             _stage("chaos-latency-smoke")
             run_chaos_smoke(seed=chaos_seed, latency_s=chaos_latency_s)
@@ -297,6 +339,10 @@ def main(argv=None) -> int:
     g.add_argument("--chaos-latency-s", type=float, default=0.0,
                    help="also run the latency soak profile with this "
                         "per-verb injected API latency (0 = skip)")
+    g.add_argument("--chaos-workers", type=int, default=4,
+                   help="worker-pool size for the chaos-parallel-smoke "
+                        "stage (1 = skip it; faults then race concurrent "
+                        "reconciles)")
     g.add_argument("--skip-cp-bench", action="store_true",
                    help="skip the control-plane copy-counter smoke")
     g.add_argument("--skip-obs", action="store_true",
@@ -310,6 +356,7 @@ def main(argv=None) -> int:
             skip_chaos=args.skip_chaos,
             chaos_seed=args.chaos_seed,
             chaos_latency_s=args.chaos_latency_s,
+            chaos_workers=args.chaos_workers,
             skip_cp_bench=args.skip_cp_bench,
             skip_obs=args.skip_obs,
         )

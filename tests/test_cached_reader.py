@@ -132,6 +132,63 @@ class TestCachedReader:
         assert cached.metadata.resource_version == \
             live.metadata.resource_version
 
+    def test_reads_not_serialized_behind_unrelated_kind_drain(self):
+        """ISSUE 5 satellite: the drain is split per kind. A reader of
+        TpuJob must complete even while another thread holds Pod's drain
+        (the old sync() drained EVERY subscription under one lock on
+        every read — an unrelated slow drain serialized all readers)."""
+        api, reader = self._reader()
+        reader.watch_kind("Pod")
+        api.create(_job("a"))
+        api.create(Pod(metadata=ObjectMeta(name="p", namespace="u")))
+        # Simulate a stuck/slow Pod drain: hold its drain lock.
+        assert reader._drain_locks["Pod"].acquire(timeout=1)
+        try:
+            assert reader.get("TpuJob", "a", "u").metadata.name == "a"
+            assert [o.metadata.name
+                    for o in reader.list("TpuJob", "u")] == ["a"]
+        finally:
+            reader._drain_locks["Pod"].release()
+        # Pod reads catch up once the drain frees.
+        assert reader.get("Pod", "p", "u").metadata.name == "p"
+
+    def test_concurrent_readers_of_distinct_kinds(self):
+        """Per-kind drains + short store-lock holds: concurrent readers
+        over different kinds converge on the live state under a write
+        storm (the worker-pool read pattern)."""
+        import threading
+
+        api, reader = self._reader()
+        reader.watch_kind("Pod")
+        api.create(_job("a"))
+        api.create(Pod(metadata=ObjectMeta(name="p", namespace="u")))
+        errors = []
+
+        def read_loop(kind, name):
+            try:
+                for _ in range(200):
+                    assert reader.get(kind, name, "u",
+                                      copy=False) is not None
+            except Exception as e:          # pragma: no cover - fail path
+                errors.append(e)
+
+        def write_loop():
+            for i in range(200):
+                live = api.get("TpuJob", "a", "u")
+                live.status.phase = f"w{i}"
+                api.update_status(live)
+
+        threads = [threading.Thread(target=read_loop, args=("TpuJob", "a")),
+                   threading.Thread(target=read_loop, args=("Pod", "p")),
+                   threading.Thread(target=write_loop)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        live = api.get("TpuJob", "a", "u", copy=False)
+        assert reader.get("TpuJob", "a", "u", copy=False) is live
+
 
 class _Echo(Controller):
     NAME = "echo-cache"

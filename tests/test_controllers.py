@@ -202,6 +202,57 @@ class TestTpuJobGang:
         assert {c.type: c for c in b.status.conditions}[
             "Admitted"].reason == "QuotaExceeded"
 
+    def test_concurrent_admission_cannot_overadmit(self):
+        """ISSUE 5: the capacity gate is a cross-key check-then-act —
+        with a reconcile worker pool, two Pending jobs checking at once
+        used to BOTH see in_use=0 and both admit past cap (no conflict
+        fires: each writes only its own status). The admission lock +
+        reservation must admit exactly one."""
+        import threading
+
+        api = InMemoryApiServer()
+        reg = MetricsRegistry()
+        ctl = TpuJobController(api, reg, capacity={"v5e-16": 1},
+                               hbm_check=False)
+        jobs = [api.create(_job(n)) for n in ("a", "b", "c")]
+        from kubeflow_tpu.topology import get_slice
+
+        st = get_slice("v5e-16")
+        barrier = threading.Barrier(len(jobs))
+        results = {}
+
+        def admit(job):
+            barrier.wait()
+            results[job.metadata.name] = ctl._admission_blocked(job, st)
+
+        threads = [threading.Thread(target=admit, args=(j,)) for j in jobs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        admitted = [n for n, blocked in results.items() if blocked is None]
+        assert len(admitted) == 1, results
+
+    def test_admission_reservation_released_on_terminal(self):
+        """A reserved-but-then-terminal job frees its capacity for the
+        next admission pass (and an in-use job's reservation collapses
+        into its store phase instead of double-counting)."""
+        api = InMemoryApiServer()
+        reg = MetricsRegistry()
+        ctl = TpuJobController(api, reg, capacity={"v5e-16": 1},
+                               hbm_check=False)
+        from kubeflow_tpu.topology import get_slice
+
+        st = get_slice("v5e-16")
+        a = api.create(_job("a"))
+        b = api.create(_job("b"))
+        assert ctl._admission_blocked(a, st) is None      # a reserves
+        assert ctl._admission_blocked(b, st) is not None  # b blocked by it
+        a.status.phase = "Failed"
+        api.update_status(a)
+        b = api.get("TpuJob", "b", "team-a")
+        assert ctl._admission_blocked(b, st) is None      # freed
+
     def test_delete_cascades_pods(self):
         api, mgr, _ = make_world()
         api.create(_job())

@@ -75,6 +75,58 @@ class TestControlPlaneSweep:
             ci.run_cp_bench_smoke(num_jobs=4, num_namespaces=2)
 
 
+class TestWorkerPoolSweep:
+    """ISSUE 5: the ``--workers`` scaling sweep's correctness half —
+    worker-pool and serial dispatch must converge to the IDENTICAL world
+    (count-based state signature), with the O(matches) copy contract
+    intact under concurrency."""
+
+    def test_final_state_identical_across_worker_counts(self):
+        serial = run_controlplane_sweep(num_jobs=20, num_namespaces=4)
+        for workers in (2, 4):
+            par = run_controlplane_sweep(num_jobs=20, num_namespaces=4,
+                                         workers=workers)
+            assert par.all_succeeded, par.phases
+            assert par.workers == workers
+            assert par.state_signature == serial.state_signature, (
+                par.final_state, serial.final_state)
+            assert par.copies_scale_with_matches
+
+    def test_signature_detects_divergence(self):
+        """The gate actually discriminates: a different fleet produces a
+        different signature."""
+        a = run_controlplane_sweep(num_jobs=8, num_namespaces=2)
+        b = run_controlplane_sweep(num_jobs=9, num_namespaces=2)
+        assert a.state_signature != b.state_signature
+
+    def test_rtt_profile_converges_with_workers(self):
+        """The scaling sweep's measurement profile (modeled per-verb API
+        RTT) through the pool: semantics unchanged, state identical to
+        the zero-RTT serial world."""
+        base = run_controlplane_sweep(num_jobs=8, num_namespaces=2)
+        rep = run_controlplane_sweep(num_jobs=8, num_namespaces=2,
+                                     workers=4, rtt_s=0.0002)
+        assert rep.all_succeeded, rep.phases
+        assert rep.state_signature == base.state_signature
+
+    def test_ci_cp_bench_smoke_includes_workers_gate(self, monkeypatch):
+        from kubeflow_tpu.tools import ci
+
+        real = run_controlplane_sweep
+
+        def diverging(**kw):
+            rep = real(**kw)
+            if kw.get("workers", 1) > 1:
+                rep.state_signature = "deadbeef"
+            return rep
+
+        monkeypatch.setattr(
+            "kubeflow_tpu.controlplane.benchmark.run_controlplane_sweep",
+            diverging)
+        with pytest.raises(GateFailure, match="DIFFERENT world"):
+            ci.run_cp_bench_smoke(num_jobs=6, num_namespaces=2, workers=2)
+
+
 class TestLatencySoakProfile:
     def test_latency_soak_converges(self):
         """The ROADMAP follow-up made tier-1: per-verb injected latency —

@@ -254,3 +254,54 @@ class TestValueFormatting:
         assert "kftpu_bad +Inf" in reg.render()
         g.set(float("nan"))
         assert "kftpu_bad NaN" in reg.render()
+
+
+class TestThreadSafety:
+    """ISSUE 5 satellite: the reconcile worker pool observes histograms
+    and bumps labeled counters from N threads at once — no update may be
+    lost and the cumulative-bucket invariants must hold."""
+
+    def test_histogram_observe_under_concurrent_observers(self):
+        import threading
+
+        from kubeflow_tpu.utils.monitoring import Histogram
+
+        h = Histogram("kftpu_t", "t", label_names=("controller",),
+                      buckets=(0.001, 0.01, 0.1, 1.0))
+        per_thread, threads = 2000, 8
+
+        def observe(i):
+            for j in range(per_thread):
+                h.observe((j % 7) * 0.005, controller=f"c{i % 2}")
+
+        ts = [threading.Thread(target=observe, args=(i,))
+              for i in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        total = threads * per_thread
+        assert h.count(controller="c0") + h.count(controller="c1") == total
+        # +Inf bucket == _count for every labelset (cumulative invariant).
+        for name, labels, v in h.samples():
+            if name.endswith("_bucket") and dict(labels)["le"] == "+Inf":
+                assert v == total / 2
+
+    def test_labeled_counter_under_concurrent_incrementers(self):
+        import threading
+
+        reg = MetricsRegistry()
+        c = reg.counter("kftpu_tc", "t", labels=("result",))
+        per_thread, threads = 5000, 8
+
+        def inc(i):
+            for _ in range(per_thread):
+                c.inc(result="ok" if i % 2 else "err")
+
+        ts = [threading.Thread(target=inc, args=(i,)) for i in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert c.value(result="ok") == threads // 2 * per_thread
+        assert c.value(result="err") == threads // 2 * per_thread

@@ -1,3 +1,6 @@
+import threading
+import time
+
 import pytest
 
 from kubeflow_tpu.controlplane.api import (
@@ -155,3 +158,159 @@ class TestReconcilerKernel:
         api.create(_job())
         with pytest.raises(RuntimeError, match="livelock"):
             mgr.run_until_idle(max_iterations=50)
+
+
+class TestMonotonicTimers:
+    """ISSUE 5 satellite: requeue/backoff timers key on time.monotonic().
+    They used to mix wall-clock deadlines (_schedule/_due_timers) with
+    monotonic queue-wait math — an NTP step fired or stalled every parked
+    backoff timer."""
+
+    def test_wall_clock_jump_does_not_fire_timers(self, monkeypatch):
+        api, mgr, ctl = _mk()
+        mgr._schedule(ctl, ("u", "j1"), after=30.0)
+        # Jump the wall clock a year forward; the timer is 30 monotonic
+        # seconds out and must stay parked.
+        real_time = time.time
+        monkeypatch.setattr(time, "time", lambda: real_time() + 3.15e7)
+        mgr._due_timers()
+        assert not mgr._pending
+        assert len(mgr._timers) == 1
+
+    def test_timers_fire_on_monotonic_deadline(self):
+        api, mgr, ctl = _mk()
+        mgr._schedule(ctl, ("u", "j1"), after=0.0)
+        mgr._due_timers()
+        assert len(mgr._pending) == 1
+        assert not mgr._timers
+
+
+class _Sentinel(Controller):
+    """Reconcile body that records overlap of the SAME key with itself —
+    the per-key serialization contract a worker pool must keep."""
+
+    NAME = "sentinel"
+    WATCH_KINDS = ("TpuJob",)
+
+    def __init__(self, api, registry, dwell_s=0.0):
+        super().__init__(api, registry=registry)
+        self.dwell_s = dwell_s
+        self.lock = threading.Lock()
+        self.in_flight = {}
+        self.overlaps = []
+        self.counts = {}
+
+    def reconcile(self, namespace, name):
+        with self.lock:
+            self.in_flight[name] = self.in_flight.get(name, 0) + 1
+            if self.in_flight[name] > 1:
+                self.overlaps.append(name)
+            self.counts[name] = self.counts.get(name, 0) + 1
+        if self.dwell_s:
+            time.sleep(self.dwell_s)
+        with self.lock:
+            self.in_flight[name] -= 1
+        return Result()
+
+
+class TestWorkerPool:
+    """ISSUE 5 tentpole: ControllerManager(workers=N) — client-go
+    workqueue semantics under concurrent dispatch."""
+
+    def test_workers_must_be_positive(self):
+        with pytest.raises(ValueError, match="workers"):
+            ControllerManager(InMemoryApiServer(), workers=0)
+
+    def test_parallel_drain_converges_like_serial(self):
+        api = InMemoryApiServer()
+        mgr = ControllerManager(api, workers=4)
+        ctl = EchoServiceController(api, registry=MetricsRegistry())
+        mgr.register(ctl)
+        for i in range(12):
+            api.create(_job(f"j{i}"))
+        mgr.run_until_idle()
+        for i in range(12):
+            assert api.try_get("Service", f"j{i}-svc", "u") is not None
+        assert mgr.is_idle()
+        mgr.close()
+
+    def test_same_key_never_overlaps_itself(self):
+        """Stress: a writer thread hammers updates into the watch stream
+        while four workers drain — two reconciles of one key must never
+        run concurrently (the in-flight set), and no update may be lost
+        (the dirty set re-enqueues)."""
+        api = InMemoryApiServer()
+        reg = MetricsRegistry()
+        mgr = ControllerManager(api, reg, workers=4)
+        ctl = _Sentinel(api, reg, dwell_s=0.001)
+        mgr.register(ctl)
+        names = [f"j{i}" for i in range(6)]
+        for n in names:
+            api.create(_job(n))
+
+        done = threading.Event()
+
+        def hammer():
+            # Bounded: an open-ended writer would keep run_until_idle
+            # legitimately busy forever.
+            for i in range(300):
+                name = names[i % len(names)]
+                try:
+                    live = api.get("TpuJob", name, "u")
+                    live.status.phase = f"w{i}"
+                    api.update_status(live)
+                except Exception:
+                    pass
+            done.set()
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        try:
+            while not done.is_set():
+                mgr.run_until_idle(max_iterations=100000)
+        finally:
+            t.join()
+        mgr.run_until_idle(max_iterations=100000)
+        assert ctl.overlaps == []
+        # No event lost: every key reconciled at least once and the
+        # manager drained clean.
+        assert set(ctl.counts) == set(names)
+        assert mgr.is_idle()
+        mgr.close()
+
+    def test_dirty_while_in_flight_requeues_exactly_once(self):
+        """Events arriving for an in-flight key coalesce into ONE
+        follow-up reconcile — not zero (lost) and not one per event
+        (duplicated)."""
+        api = InMemoryApiServer()
+        reg = MetricsRegistry()
+        mgr = ControllerManager(api, reg)
+        seen = []
+
+        class Dirtying(Controller):
+            NAME = "dirtying"
+            WATCH_KINDS = ("TpuJob",)
+
+            def reconcile(self, namespace, name):
+                seen.append(name)
+                if len(seen) == 1:
+                    # Simulate three watch deliveries for OUR OWN key
+                    # landing mid-reconcile: the key is in flight, so all
+                    # three must collapse into exactly one dirty requeue.
+                    for _ in range(3):
+                        mgr._enqueue(self, (namespace, name))
+                return Result()
+
+        ctl = Dirtying(api, registry=reg)
+        mgr.register(ctl)
+        api.create(_job())
+        mgr.run_until_idle()
+        assert seen == ["j1", "j1"]
+        mgr.close()
+
+    def test_inflight_gauge_registered(self):
+        reg = MetricsRegistry()
+        mgr = ControllerManager(InMemoryApiServer(), reg, workers=2)
+        g = reg.get("kftpu_workqueue_inflight")
+        assert g is not None and g.value() == 0.0
+        mgr.close()
