@@ -11,10 +11,15 @@ This package makes failure a first-class, *seeded* test input instead:
 - :class:`SlicePreemptor` — marks TPU slices preempted (the dominant TPU
   failure mode), failing their worker pods and optionally reclaiming
   schedulable capacity so gangs must land on surviving slices.
+- :class:`ShardPreemptor` — SIGKILLs a whole control-plane shard process
+  and restarts it, proving the WAL crash-replay + watch-resync path is
+  the recovery mechanism (ISSUE 6).
 - :class:`BackendFlapper` — flaps serving LB backends to prove request
   failover is client-invisible.
 - :func:`run_soak` — the seeded convergence soak shared by tier-1 tests
   and the CI ``chaos-smoke`` stage.
+- :func:`run_sharded_soak` — the soak across N shard processes with a
+  mid-soak whole-shard kill (the CI ``shard-smoke`` stage).
 
 See docs/chaos.md for the injection points and knobs.
 """
@@ -25,15 +30,23 @@ from kubeflow_tpu.chaos.api import (
     TransientApiError,
 )
 from kubeflow_tpu.chaos.flapper import BackendFlapper
-from kubeflow_tpu.chaos.preemptor import SlicePreemptor
-from kubeflow_tpu.chaos.soak import SoakReport, run_soak
+from kubeflow_tpu.chaos.preemptor import ShardPreemptor, SlicePreemptor
+from kubeflow_tpu.chaos.soak import (
+    ShardedSoakReport,
+    SoakReport,
+    run_sharded_soak,
+    run_soak,
+)
 
 __all__ = [
     "BackendFlapper",
     "ChaosApiServer",
     "FaultSpec",
+    "ShardPreemptor",
+    "ShardedSoakReport",
     "SlicePreemptor",
     "SoakReport",
     "TransientApiError",
+    "run_sharded_soak",
     "run_soak",
 ]

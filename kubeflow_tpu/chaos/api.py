@@ -226,8 +226,10 @@ class ChaosApiServer:
 
     # ----------------- watch (lag injection point) -----------------
 
-    def watch(self, kind: Optional[str] = None):
-        q = self.inner.watch(kind)
+    def watch(self, kind: Optional[str] = None, **kw):
+        # Bookmark/resume kwargs pass straight through: watches are never
+        # faulted (see module docstring), only delayed.
+        q = self.inner.watch(kind, **kw)
         if self.watch_lag_s <= 0:
             return q
         return _LaggedQueue(
@@ -321,9 +323,14 @@ class ChaosApiServer:
         label_selector: Optional[Dict[str, str]] = None,
         *,
         copy: bool = True,
+        limit: Optional[int] = None,
+        continue_: Optional[str] = None,
     ) -> List[Any]:
+        # One fault roll per PAGE, like a real apiserver: every page is
+        # its own request, and each can fail independently.
         self._maybe_inject("list", kind, namespace or "")
-        return self.inner.list(kind, namespace, label_selector, copy=copy)
+        return self.inner.list(kind, namespace, label_selector, copy=copy,
+                               limit=limit, continue_=continue_)
 
     # Everything else (register_mutator, internals the CI gate inspects)
     # passes straight through. Watches never DROP events — a real informer

@@ -133,3 +133,57 @@ class SlicePreemptor:
                 self.capacity[st] += n
         self._reclaimed.clear()
         return restored
+
+
+class ShardPreemptor:
+    """Process-level fault injector for the SHARDED control plane
+    (ISSUE 6): where :class:`SlicePreemptor` takes out one ICI domain's
+    pods, this takes out an entire shard *process* — SIGKILL, no flush,
+    no goodbye — and (optionally) restarts it.
+
+    Recovery is NOT a special case: the restarted shard replays its WAL
+    to the exact pre-crash store and its manager resyncs through the
+    normal watch-replay/bookmark path. ``replay_identical`` records
+    whether every kill so far replayed to a byte-identical per-shard
+    ``state_fingerprint()`` — the property the CI ``shard-smoke`` stage
+    gates on.
+    """
+
+    def __init__(self, plane, *, seed: int = 0,
+                 registry: MetricsRegistry = global_registry):
+        self.plane = plane          # a ShardedControlPlane
+        self.rng = random.Random(seed)
+        self.kills = 0
+        self.replay_identical = True
+        self.metrics_kills = registry.counter(
+            "kftpu_chaos_shard_kills_total",
+            "Whole-shard process kills injected",
+        )
+
+    def kill_random(self, *, restart: bool = True) -> Optional[int]:
+        """SIGKILL one seeded-random live shard; with ``restart`` the
+        shard is respawned immediately (WAL replay) and the pre/post
+        fingerprints compared. Returns the shard id, or None when no
+        shard is alive."""
+        alive = self.plane.alive()
+        if not alive:
+            return None
+        victim = alive[self.rng.randrange(len(alive))]
+        # The shard is idle between parent commands, so the pre-kill
+        # fingerprint is exact — byte-identical replay is then a hard
+        # gate, not a heuristic.
+        pre = self.plane.shard_fingerprint(victim)
+        self.plane.kill(victim)
+        self.kills += 1
+        self.metrics_kills.inc()
+        if restart:
+            self.plane.restart(victim)
+            post = self.plane.shard_fingerprint(victim)
+            if post != pre:
+                self.replay_identical = False
+                log.error("shard replay diverged", kv={
+                    "shard": victim, "pre": pre[1], "post": post[1],
+                })
+        log.warning("shard preempted", kv={"shard": victim,
+                                           "restarted": restart})
+        return victim

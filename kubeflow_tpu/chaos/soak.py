@@ -236,3 +236,150 @@ def run_soak(
         "preemptions": report.preemptions,
     })
     return report
+
+
+# --------------------------------------------------------------------------
+# Sharded soak (ISSUE 6): chaos + a whole-shard process kill mid-soak
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ShardedSoakReport:
+    converged: bool                  # every job terminal on every shard
+    all_succeeded: bool
+    rounds: int
+    shards: int
+    jobs: int
+    phases: Dict[str, int]           # phase -> job count (union)
+    shard_kills: int                 # whole-shard SIGKILLs injected
+    replay_identical: bool           # every kill replayed byte-identically
+    slice_preemptions: int           # in-shard slice preemptions injected
+    injected: Dict[str, int]         # union fault tally across shards
+    leader_epochs: int               # election epochs (>1 iff leader moved)
+    state_signature: str             # union fingerprint at soak end
+
+
+def run_sharded_soak(
+    *,
+    num_jobs: int = 4,
+    shards: int = 2,
+    seed: int = 0,
+    conflict_rate: float = 0.3,
+    transient_rate: float = 0.05,
+    preempt_every: int = 3,
+    kill_shard_round: int = 4,       # 0 disables the whole-shard kill
+    fault_rounds: int = 9,
+    max_rounds: int = 40,
+    work_ticks: int = 6,
+    workers: int = 1,
+    slice_type: str = "v5e-16",
+    state_dir: str = "",             # "" = private temp dir (WAL home)
+) -> ShardedSoakReport:
+    """The chaos soak, horizontally sharded (ISSUE 6): the fleet is routed
+    across ``shards`` shard processes, every shard injects seeded
+    conflicts/transients into its own controllers and suffers slice
+    preemptions — and at ``kill_shard_round`` one seeded-random shard is
+    SIGKILLed outright and restarted. Recovery is the WAL replay +
+    watch-resync path, nothing soak-specific, and the report's
+    ``replay_identical`` asserts the restarted shard came back with a
+    byte-identical per-shard fingerprint. Leadership (singleton
+    controllers) moves iff the killed shard held the lease.
+    """
+    import random
+    import shutil
+    import tempfile
+
+    from kubeflow_tpu.chaos.preemptor import ShardPreemptor
+    from kubeflow_tpu.controlplane.shard import (
+        ShardedControlPlane,
+        ShardRouter,
+    )
+
+    own_state = not state_dir
+    if own_state:
+        state_dir = tempfile.mkdtemp(prefix="kftpu-sharded-soak-")
+    rng = random.Random(seed + 7)
+
+    # Route the fleet FIRST so each shard's admission capacity matches
+    # exactly the jobs it will host (the per-shard slice ledger).
+    router = ShardRouter(shards)
+    docs = []
+    per_shard_jobs: Dict[int, int] = {}
+    for i in range(num_jobs):
+        ns = f"chaos-{i:02d}"
+        docs.append({
+            "kind": "TpuJob",
+            "metadata": {"name": f"soak-{i:02d}", "namespace": ns},
+            "spec": {"sliceType": slice_type, "mesh": {"dp": -1},
+                     "backoffSeconds": 0.0, "maxRestarts": 3,
+                     "preemptionPolicy": "restart"},
+        })
+        sid = router.route("TpuJob", ns)
+        per_shard_jobs[sid] = per_shard_jobs.get(sid, 0) + 1
+    capacity_by_shard = {sid: {slice_type: n}
+                         for sid, n in per_shard_jobs.items()}
+
+    cp = ShardedControlPlane(
+        shards, workers=workers, state_dir=state_dir, seed=seed,
+        conflict_rate=conflict_rate, transient_rate=transient_rate,
+        work_ticks=work_ticks, capacity_by_shard=capacity_by_shard,
+    )
+    shard_killer = ShardPreemptor(cp, seed=seed + 11)
+    slice_preemptions = 0
+    faulting = True
+    rounds = 0
+    try:
+        cp.create(docs)
+        fault_window, drain_window = 2.0, 120.0
+        for r in range(max_rounds):
+            rounds = r + 1
+            window = fault_window if faulting else drain_window
+            res = cp.round(window)
+            if faulting and preempt_every and r > 0 \
+                    and r % preempt_every == 0:
+                alive = cp.alive()
+                victim = alive[rng.randrange(len(alive))]
+                if cp.preempt(victim):
+                    slice_preemptions += 1
+            if faulting and kill_shard_round and rounds == kill_shard_round:
+                # The process-level fault: SIGKILL + restart, WAL replay.
+                shard_killer.kill_random(restart=True)
+            if faulting and rounds >= fault_rounds:
+                cp.quiesce()
+                faulting = False
+            if not faulting and all(x["terminal"] for x in res.values()):
+                break
+        injected: Dict[str, int] = {}
+        for info in cp.info().values():
+            for k, v in info["injected"].items():
+                injected[k] = injected.get(k, 0) + v
+        counts, signature = cp.fingerprint()
+        phases = dict(counts.get("TpuJob", {}))
+        converged = sum(phases.values()) == num_jobs and all(
+            p in TERMINAL for p in phases
+        )
+        epochs = cp.epoch
+    finally:
+        cp.close()
+        if own_state:
+            shutil.rmtree(state_dir, ignore_errors=True)
+    report = ShardedSoakReport(
+        converged=converged,
+        all_succeeded=phases.get("Succeeded", 0) == num_jobs,
+        rounds=rounds,
+        shards=shards,
+        jobs=num_jobs,
+        phases=phases,
+        shard_kills=shard_killer.kills,
+        replay_identical=shard_killer.replay_identical,
+        slice_preemptions=slice_preemptions,
+        injected=injected,
+        leader_epochs=epochs,
+        state_signature=signature,
+    )
+    log.info("sharded soak done", kv={
+        "converged": converged, "rounds": rounds, "shards": shards,
+        "kills": report.shard_kills,
+        "replay_identical": report.replay_identical,
+    })
+    return report

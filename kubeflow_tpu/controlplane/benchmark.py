@@ -111,28 +111,45 @@ class ControlPlaneReport:
         }
 
 
-def state_fingerprint(objs) -> tuple:
-    """(per-kind phase counts, sha256 signature) over the given stored
-    objects (``api.list_all()``). The signature covers every
-    (kind, namespace, name, phase) — Events excluded: they are uuid-named
-    and their count varies with reconcile interleaving by design — so it
-    is identical across worker counts iff the sweeps converged to the
-    same world. Counts, never wall-clock: the CI gate built on this
-    cannot flake."""
+def state_rows(objs) -> list:
+    """The fingerprintable rows of a store: one
+    ``(kind, namespace, name, phase)`` tuple per stored object, Events
+    excluded (uuid-named byproducts whose count varies with reconcile
+    interleaving by design). Shard workers ship their rows over the pipe
+    and the parent fingerprints the UNION — same rows, same hash, whether
+    the world lived in one process or N."""
     rows = []
-    counts: Dict[str, Dict[str, int]] = {}
     for obj in objs:
         if obj.kind == "Event":
             continue
         phase = str(getattr(getattr(obj, "status", None), "phase", "") or "")
         rows.append((obj.kind, obj.metadata.namespace or "",
                      obj.metadata.name, phase))
-        counts.setdefault(obj.kind, {})
-        counts[obj.kind][phase or "-"] = counts[obj.kind].get(phase or "-", 0) + 1
+    return rows
+
+
+def signature_of_rows(rows) -> tuple:
+    """(per-kind phase counts, sha256 signature) over fingerprint rows.
+    Order-independent (rows are sorted before hashing), so a union of
+    per-shard row lists fingerprints identically to one store's rows."""
+    counts: Dict[str, Dict[str, int]] = {}
+    for kind, _ns, _name, phase in rows:
+        counts.setdefault(kind, {})
+        counts[kind][phase or "-"] = counts[kind].get(phase or "-", 0) + 1
     digest = hashlib.sha256(
-        "\n".join("|".join(r) for r in sorted(rows)).encode()
+        "\n".join("|".join(r) for r in sorted(tuple(r) for r in rows)).encode()
     ).hexdigest()
     return counts, digest
+
+
+def state_fingerprint(objs) -> tuple:
+    """(per-kind phase counts, sha256 signature) over the given stored
+    objects (``api.list_all()``). The signature covers every
+    (kind, namespace, name, phase) — Events excluded — so it is identical
+    across worker counts AND across shard layouts iff the sweeps
+    converged to the same world. Counts, never wall-clock: the CI gate
+    built on this cannot flake."""
+    return signature_of_rows(state_rows(objs))
 
 
 def run_controlplane_sweep(

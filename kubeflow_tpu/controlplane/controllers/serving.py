@@ -53,7 +53,6 @@ from kubeflow_tpu.controlplane.runtime import (
     Result,
     create_or_update,
 )
-from kubeflow_tpu.models import list_models
 from kubeflow_tpu.topology import get_slice
 from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
 
@@ -211,6 +210,12 @@ class ServingController(Controller):
         return Result(requeue_after=requeue)
 
     def _validate(self, sv: Serving) -> str:
+        # Imported at first validation, not module import: the registry
+        # pulls in every model family (and JAX behind them) — dead weight
+        # for control-plane processes (shard workers, tpuctl) that never
+        # see a Serving CR.
+        from kubeflow_tpu.models import list_models
+
         if sv.spec.model not in list_models():
             return (f"unknown model {sv.spec.model!r}; known: "
                     f"{sorted(list_models())}")

@@ -96,8 +96,21 @@ class Platform:
         self.jwa = None          # NotebookWebApp when enabled
         self.dashboard = None    # DashboardApi when enabled
         self.prober = None       # AvailabilityProber when enabled
+        self.wal = None          # WriteAheadLog when attached
         self.components: List[str] = []
         self._config: Optional[PlatformConfig] = None
+
+    def attach_wal(self, state_dir: str, *, fsync: bool = True):
+        """Journal every committed API write to ``<state_dir>/wal.jsonl``
+        (fsync'd per record, before the write's watch event is visible) so
+        a crash between ``save()`` calls replays to its exact pre-crash
+        state. ``save()`` compacts the log behind the snapshot it wrote."""
+        from kubeflow_tpu.controlplane.wal import WriteAheadLog, wal_path
+
+        os.makedirs(state_dir, exist_ok=True)
+        self.wal = WriteAheadLog(wal_path(state_dir), fsync=fsync)
+        self.wal.attach(self.api)
+        return self.wal
 
     # ------------- component wiring -------------
 
@@ -328,16 +341,37 @@ class Platform:
     def save(self, state_dir: str) -> str:
         os.makedirs(state_dir, exist_ok=True)
         path = os.path.join(state_dir, "state.yaml")
-        docs = []
-        for key in sorted(self.api._objects):
-            docs.append(to_dict(self.api._objects[key]))
+        # Capture the object set and the rv counter under the store lock:
+        # they must be ATOMIC with each other, or a write committing
+        # between them lands inside saved_rv yet outside the snapshot —
+        # and wal.compact(saved_rv) below would then delete its journal
+        # record too, losing the write entirely. Serialization stays
+        # outside the lock (stored objects are immutable snapshots, so
+        # the captured references cannot change under us).
+        with self.api._lock:
+            objs = [self.api._objects[key]
+                    for key in sorted(self.api._objects)]
+            saved_rv = self.api._rv
+        docs = [to_dict(obj) for obj in objs]
         meta = {
             "kind": "PlatformState",
             "components": self.components,
-            "resourceVersionCounter": self.api._rv,
+            "resourceVersionCounter": saved_rv,
         }
-        with open(path, "w") as f:
+        # Write-to-temp + atomic rename: a kill mid-dump used to leave a
+        # truncated state.yaml — the next load would come up EMPTY and a
+        # subsequent save would bury the loss. os.replace is atomic on
+        # POSIX, so readers only ever see the old or the new snapshot.
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
             yaml.safe_dump_all([meta] + docs, f, sort_keys=False)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        if self.wal is not None:
+            # The snapshot covers everything up to saved_rv: compact the
+            # WAL down to the (normally empty) newer tail.
+            self.wal.compact(saved_rv)
         # Append spans recorded since the last save so `tpuctl trace` can
         # reconstruct causal timelines across tpuctl invocations; the file
         # is trimmed to its newest half past 4 MB (the ring is bounded,
@@ -349,22 +383,37 @@ class Platform:
 
     @classmethod
     def load(cls, state_dir: str) -> "Platform":
+        from kubeflow_tpu.controlplane.wal import wal_path
+
         path = os.path.join(state_dir, "state.yaml")
         platform = cls()
-        if not os.path.exists(path):
+        has_wal = os.path.exists(wal_path(state_dir))
+        if os.path.exists(path):
+            with open(path) as f:
+                docs = list(yaml.safe_load_all(f))
+            if docs:
+                meta, resources = docs[0], docs[1:]
+                # Restore resources first (no mutators registered yet:
+                # stored pods were already mutated at original create
+                # time).
+                for data in resources:
+                    platform.api.load_snapshot(object_from_dict(data))
+                platform.api._rv = int(
+                    meta.get("resourceVersionCounter", 0))
+        elif not has_wal:
             return platform
-        with open(path) as f:
-            docs = list(yaml.safe_load_all(f))
-        if not docs:
-            return platform
-        meta, resources = docs[0], docs[1:]
-        # Restore resources first (no mutators registered yet: stored pods
-        # were already mutated at original create time).
-        from kubeflow_tpu.controlplane.api.serde import from_dict as _fd
-
-        for data in resources:
-            platform.api.load_snapshot(object_from_dict(data))
-        platform.api._rv = int(meta.get("resourceVersionCounter", 0))
+        if has_wal:
+            # WAL replay is PREFERRED over the snapshot when both exist:
+            # the log carries every fsync'd write since the snapshot was
+            # taken (a crash between saves), so the replayed tail — not
+            # the snapshot — is the true latest state. Attaching keeps
+            # journaling subsequent writes, and the next save() compacts.
+            wal = platform.attach_wal(state_dir)
+            replayed = wal.replay(platform.api)
+            if replayed:
+                log.info("wal replayed", kv={
+                    "records": replayed, "rv": platform.api._rv,
+                })
         # Re-start components per stored PlatformConfig.
         pcs = platform.api.list("PlatformConfig")
         if pcs:

@@ -1,7 +1,9 @@
 from kubeflow_tpu.controlplane.runtime.apiserver import (
     ApiError,
     ConflictError,
+    ContinueExpiredError,
     InMemoryApiServer,
+    ListPage,
     NotFoundError,
     WatchEvent,
 )
@@ -20,6 +22,8 @@ from kubeflow_tpu.controlplane.runtime.events import EventRecorder
 __all__ = [
     "ApiError",
     "ConflictError",
+    "ContinueExpiredError",
+    "ListPage",
     "ExponentialBackoffLimiter",
     "InMemoryApiServer",
     "NotFoundError",

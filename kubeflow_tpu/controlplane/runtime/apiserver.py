@@ -43,12 +43,37 @@ informer cache contract). Read-path copies are tallied per verb in
 ``kftpu_apiserver_objects_copied_total{verb}`` so benches and the CI
 ``cp-bench-smoke`` stage can assert the O(matches) property by counting,
 not timing.
+
+Scale semantics (ISSUE 6 — the sharded control plane's API contract):
+
+- ``list(limit=, continue_=)`` paginates: the first page pins the sorted
+  query result as a **snapshot** at the current resource version and
+  returns an opaque continue token; every subsequent page walks that
+  snapshot, so a ``limit`` walk enumerates EXACTLY the unpaginated list
+  as of the walk's start, regardless of concurrent writes (the etcd
+  paginate-at-one-revision contract). Abandoned walks are LRU-evicted;
+  continuing one raises :class:`ContinueExpiredError` (K8s' 410 Gone).
+- ``watch(bookmarks=True)`` opts a subscription into **BOOKMARK** events:
+  one immediately after replay carrying the snapshot resource version,
+  then periodically as writes advance the store. A consumer that persists
+  the last bookmark rv can resubscribe with ``watch(resume_rv=rv)`` and
+  receive only the events it missed (served from a bounded event log)
+  instead of an O(store) ADDED replay — the restart path sharded managers
+  and ``CachedReader`` use. Replay work is tallied in ``self.replayed``
+  per mode (``full`` / ``resume``), counts again, so resync tests gate on
+  numbers rather than timing.
+- ``set_journal(fn)`` installs a write-ahead hook called under the store
+  lock for every committed write, in commit order, *before* the watch
+  notify — the seam ``controlplane/wal.py`` uses to make a shard's state
+  replayable after a crash.
 """
 
 from __future__ import annotations
 
+import base64
 import collections
 import dataclasses
+import json
 import queue
 import threading
 import time
@@ -78,16 +103,52 @@ class ConflictError(ApiError):
     pass
 
 
+class ContinueExpiredError(ApiError):
+    """The continue token's pinned snapshot was evicted (too many
+    concurrent walks, or the walk was abandoned and later resumed) — the
+    K8s 410 Gone analogue. Restart the walk from the first page."""
+
+
 @dataclasses.dataclass
 class WatchEvent:
-    type: str          # ADDED | MODIFIED | DELETED
-    object: Any
+    type: str          # ADDED | MODIFIED | DELETED | BOOKMARK | RELIST
+    object: Any        # None for BOOKMARK/RELIST events
     # Observability stamps, set at notify time (zero-cost to consumers
     # that ignore them): when the event was enqueued (monotonic — the
     # watch-delivery-lag measurement point) and the span context of the
     # write that produced it (the write-RV → reconcile trace link).
     ts_mono: float = 0.0
     span_ctx: Optional[SpanContext] = None
+    # Store resource version as of this event (stamped under the store
+    # lock). BOOKMARK events carry ONLY this: "you have seen everything
+    # up to rv" — the resume point for watch(resume_rv=...).
+    rv: int = 0
+
+
+@dataclasses.dataclass
+class ListPage:
+    """One page of a paginated ``list``: the items, the opaque token for
+    the next page (``""`` when the walk is complete), and the resource
+    version the whole walk is pinned to."""
+
+    items: List[Any]
+    continue_: str
+    resource_version: int
+
+
+def _encode_continue(snap_id: int, offset: int, rv: int) -> str:
+    payload = json.dumps({"id": snap_id, "off": offset, "rv": rv},
+                         separators=(",", ":"))
+    return base64.urlsafe_b64encode(payload.encode()).decode()
+
+
+def _decode_continue(token: str) -> Dict[str, int]:
+    try:
+        data = json.loads(base64.urlsafe_b64decode(token.encode()).decode())
+        return {"id": int(data["id"]), "off": int(data["off"]),
+                "rv": int(data["rv"])}
+    except Exception:
+        raise ApiError(f"malformed continue token {token!r}") from None
 
 
 Key = Tuple[str, str, str]  # (kind, namespace, name)
@@ -187,8 +248,15 @@ class _VerbSpan:
 
 
 class InMemoryApiServer:
+    #: Pinned pagination snapshots kept at once; the least recently started
+    #: walk is evicted first (its continue token then raises
+    #: ContinueExpiredError). Completed walks free their snapshot eagerly.
+    MAX_PAGE_SNAPSHOTS = 64
+
     def __init__(self, registry: MetricsRegistry = global_registry,
-                 tracer: Tracer = global_tracer) -> None:
+                 tracer: Tracer = global_tracer, *,
+                 bookmark_interval: int = 50,
+                 event_log_size: int = 4096) -> None:
         self._objects: Dict[Key, Any] = {}
         # Secondary indexes (all under self._lock, all holding the same
         # snapshot references as self._objects — replaced together on
@@ -198,18 +266,45 @@ class InMemoryApiServer:
         self._by_owner: Dict[str, Dict[Key, Any]] = {}   # owner uid -> deps
         self._rv = 0
         self._lock = threading.RLock()
-        self._watchers: List[Tuple[Optional[str], "queue.Queue[WatchEvent]"]] = []
+        # (kind filter, queue, wants_bookmarks)
+        self._watchers: List[
+            Tuple[Optional[str], "queue.Queue[WatchEvent]", bool]
+        ] = []
         # Admission mutators run on create (the PodDefault webhook seam,
         # admission-webhook/main.go:389-470).
         self._mutators: List[Callable[[Any], Any]] = []
+        # Bounded recent-event log (shared event objects — no copies):
+        # what watch(resume_rv=...) serves its delta replay from. rvs in
+        # the log are contiguous (every rv bump emits exactly one event).
+        self._event_log: "collections.deque[WatchEvent]" = collections.deque(
+            maxlen=max(1, int(event_log_size)))
+        # Periodic BOOKMARK cadence, counted in writes since the last one.
+        self.bookmark_interval = max(1, int(bookmark_interval))
+        self._writes_since_bookmark = 0
+        # Pinned pagination snapshots: id -> (rv, sorted shared snapshots).
+        self._page_snapshots: "collections.OrderedDict[int, Tuple[int, List[Any]]]" = \
+            collections.OrderedDict()
+        self._page_seq = 0
+        # Write-ahead journal hook (controlplane/wal.py): called under the
+        # store lock, in commit order, before the watch notify.
+        self._journal: Optional[Callable[[str, Any, int], None]] = None
         # Read-path deepcopy tally, per verb ("get"/"list"). Deterministic
         # (a pure function of the call sequence), so benches and CI gate on
         # counts instead of wall-clock.
         self.copied: Dict[str, int] = {}
+        # Watch replay tally, per mode: "full" counts objects replayed by
+        # O(bucket) ADDED replay, "resume" counts delta events served from
+        # the event log. Deterministic, so resync tests gate on counts.
+        self.replayed: Dict[str, int] = {}
         self.metrics_copied = registry.counter(
             "kftpu_apiserver_objects_copied_total",
             "Objects deep-copied on the API server read path",
             labels=("verb",),
+        )
+        self.metrics_replayed = registry.counter(
+            "kftpu_apiserver_watch_replayed_total",
+            "Objects/events replayed to new watch subscriptions",
+            labels=("mode",),
         )
         self.tracer = tracer
         self.metrics_latency = registry.histogram(
@@ -232,6 +327,29 @@ class InMemoryApiServer:
 
     def copied_total(self) -> int:
         return sum(self.copied.values())
+
+    def _count_replayed(self, mode: str, n: int) -> None:
+        if n <= 0:
+            return
+        self.replayed[mode] = self.replayed.get(mode, 0) + n
+        self.metrics_replayed.inc(n, mode=mode)
+
+    def set_journal(self, fn: Optional[Callable[[str, Any, int], None]]) -> None:
+        """Install the write-ahead hook: ``fn(op, payload, rv)`` with
+        ``op`` in {"put", "del"}; payload is the stored snapshot for puts
+        and the ``(kind, namespace, name)`` key for dels. Called under the
+        store lock in commit order, BEFORE the watch notify — a record is
+        durable before its event is visible."""
+        with self._lock:
+            self._journal = fn
+
+    def _journal_put(self, obj: Any) -> None:
+        if self._journal is not None:
+            self._journal("put", obj, self._rv)
+
+    def _journal_del(self, key: Key) -> None:
+        if self._journal is not None:
+            self._journal("del", key, self._rv)
 
     def _verb_span(self, verb: str, kind: str, name: str = "",
                    namespace: str = "") -> "_VerbSpan":
@@ -278,6 +396,8 @@ class InMemoryApiServer:
         # from write to status update).
         event.ts_mono = time.monotonic()
         event.span_ctx = self.tracer.current_context()
+        event.rv = self._rv
+        self._event_log.append(event)
         # ONE event object shared by every subscriber: the payload is the
         # stored snapshot, which is immutable by contract, so per-watcher
         # deep copies bought nothing but O(watchers) deepcopy per write.
@@ -285,9 +405,23 @@ class InMemoryApiServer:
         # order — the invariant last-wins consumers (CachedReader) rely on;
         # notifying outside the lock let two racing writers enqueue their
         # events in the wrong order and wedge a cache stale forever.
-        for kind, q in list(self._watchers):
+        for kind, q, _bm in list(self._watchers):
             if kind is None or kind == event.object.kind:
                 q.put(event)
+        # Periodic BOOKMARK to opted-in subscribers: "you have seen
+        # everything through rv" — what lets a restarted consumer resync
+        # with watch(resume_rv=rv) instead of an O(store) relist.
+        self._writes_since_bookmark += 1
+        if self._writes_since_bookmark >= self.bookmark_interval:
+            self._emit_bookmark_locked()
+
+    def _emit_bookmark_locked(self) -> None:
+        self._writes_since_bookmark = 0
+        bm = WatchEvent("BOOKMARK", None, ts_mono=time.monotonic(),
+                        rv=self._rv)
+        for _kind, q, bookmarks in list(self._watchers):
+            if bookmarks:
+                q.put(bm)
 
     def register_mutator(self, fn: Callable[[Any], Any]) -> None:
         with self._lock:
@@ -300,6 +434,17 @@ class InMemoryApiServer:
         leave the secondary indexes empty.)"""
         with self._lock:
             self._store(_key(obj), obj)
+
+    def drop_snapshot(self, kind: str, name: str, namespace: str = "") -> None:
+        """Remove a restored object verbatim: no events, no finalizer
+        semantics, no cascade — the WAL ``del``-record replay seam
+        (``delete()`` would re-run lifecycle logic that already ran before
+        the crash). Missing objects are ignored."""
+        with self._lock:
+            ns = "" if kind in CLUSTER_SCOPED else namespace
+            key = (kind, ns, name)
+            if key in self._objects:
+                self._remove(key)
 
     # ----------------- CRUD -----------------
 
@@ -323,6 +468,7 @@ class InMemoryApiServer:
             sp.attrs["rv"] = obj.metadata.resource_version
             obj.metadata.generation = 1
             self._store(key, obj)
+            self._journal_put(obj)
             out = deepcopy(obj)
             self._notify(WatchEvent("ADDED", obj))
         return out
@@ -377,9 +523,11 @@ class InMemoryApiServer:
                 # Last finalizer cleared: the update completes the delete —
                 # don't pay a _store index add just to tear it down again.
                 self._remove(key)
+                self._journal_del(key)
                 self._notify(WatchEvent("DELETED", obj))
             else:
                 self._store(key, obj)
+                self._journal_put(obj)
                 self._notify(WatchEvent("MODIFIED", obj))
             out = deepcopy(obj)
         if removed:
@@ -416,9 +564,16 @@ class InMemoryApiServer:
                     cur.metadata.deletion_timestamp = time.time()
                     cur.metadata.resource_version = self._next_rv()
                     self._store(key, cur)
+                    self._journal_put(cur)
                     self._notify(WatchEvent("MODIFIED", cur))
                 return None
             self._remove(key)
+            # A hard delete consumes a resource version of its own (the
+            # etcd convention): the DELETED event then has a unique rv, so
+            # a resume_rv replay can never skip past a deletion that
+            # shares its predecessor's version.
+            self._next_rv()
+            self._journal_del(key)
             self._notify(WatchEvent("DELETED", cur))
             return cur
 
@@ -450,12 +605,25 @@ class InMemoryApiServer:
         label_selector: Optional[Dict[str, str]] = None,
         *,
         copy: bool = True,
-    ) -> List[Any]:
+        limit: Optional[int] = None,
+        continue_: Optional[str] = None,
+    ):
         """Index-resolved list: touches only the (kind) or (kind, namespace)
         bucket, so cost is O(bucket) and copy count (``copy=True``) is
         O(matches) — never O(store). ``copy=False`` returns the shared
-        snapshots (read-only by contract)."""
+        snapshots (read-only by contract).
+
+        With ``limit`` (and then ``continue_``) the result is a
+        :class:`ListPage` instead of a plain list: the first page pins the
+        sorted result as a snapshot at the current resource version, and
+        the opaque token walks that snapshot — the whole walk enumerates
+        exactly the store as of its first page, no matter what writes land
+        in between. Copy counts are O(page) per call."""
         with self._verb_span("list", kind, namespace=namespace or ""):
+            if limit is not None or continue_ is not None:
+                return self._list_page(kind, namespace, label_selector,
+                                       copy=copy, limit=limit,
+                                       continue_=continue_)
             with self._lock:
                 out = list_bucket(self._by_kind, self._by_kind_ns,
                                   kind, namespace, label_selector)
@@ -468,6 +636,71 @@ class InMemoryApiServer:
                 # loop.
                 out = [deepcopy(o) for o in out]
             return _sorted_objs(out)
+
+    def _list_page(
+        self,
+        kind: str,
+        namespace: Optional[str],
+        label_selector: Optional[Dict[str, str]],
+        *,
+        copy: bool,
+        limit: Optional[int],
+        continue_: Optional[str],
+    ) -> ListPage:
+        if limit is not None and limit < 1:
+            # Validated on EVERY page: a continuation with limit<=0 would
+            # return an empty page whose token never advances, spinning a
+            # standard `while page.continue_` walk forever.
+            raise ApiError(f"list limit must be >= 1, got {limit}")
+        if continue_:
+            tok = _decode_continue(continue_)
+            with self._lock:
+                snap = self._page_snapshots.get(tok["id"])
+                if snap is None or snap[0] != tok["rv"]:
+                    raise ContinueExpiredError(
+                        f"continue token for {kind} expired "
+                        "(snapshot evicted) — restart the walk"
+                    )
+                # Touch the walk so eviction is genuinely LRU: without
+                # this, an ACTIVE walk ages by start time and gets
+                # evicted under newer walks mid-pagination.
+                self._page_snapshots.move_to_end(tok["id"])
+                rv, objs = snap
+            offset = tok["off"]
+            snap_id = tok["id"]
+        else:
+            if limit is None:
+                raise ApiError("paginated list requires a limit")
+            with self._lock:
+                rv = self._rv
+                objs = _sorted_objs(list_bucket(
+                    self._by_kind, self._by_kind_ns,
+                    kind, namespace, label_selector,
+                ))
+                self._page_seq += 1
+                snap_id = self._page_seq
+                # The snapshot holds SHARED references to immutable stored
+                # snapshots — pinning a walk costs one list of pointers,
+                # never a copy, and keeps deleted objects alive only until
+                # the walk finishes or is evicted.
+                self._page_snapshots[snap_id] = (rv, objs)
+                while len(self._page_snapshots) > self.MAX_PAGE_SNAPSHOTS:
+                    self._page_snapshots.popitem(last=False)
+            offset = 0
+        end = len(objs) if limit is None else min(offset + int(limit),
+                                                  len(objs))
+        page = objs[offset:end]
+        if end >= len(objs):
+            token = ""
+            with self._lock:
+                self._page_snapshots.pop(snap_id, None)
+        else:
+            token = _encode_continue(snap_id, end, rv)
+        if copy:
+            with self._lock:
+                self._count_copies("list", len(page))
+            page = [deepcopy(o) for o in page]
+        return ListPage(items=page, continue_=token, resource_version=rv)
 
     def list_all(self) -> List[Any]:
         """Every stored snapshot, all kinds, shared zero-copy (read-only by
@@ -491,28 +724,85 @@ class InMemoryApiServer:
             new.metadata.resource_version = self._next_rv()
             sp.attrs["rv"] = new.metadata.resource_version
             self._store(key, new)
+            self._journal_put(new)
             out = deepcopy(new)
             self._notify(WatchEvent("MODIFIED", new))
         return out
 
     # ----------------- watch -----------------
 
-    def watch(self, kind: Optional[str] = None) -> "queue.Queue[WatchEvent]":
+    def watch(self, kind: Optional[str] = None, *,
+              resume_rv: Optional[int] = None,
+              bookmarks: bool = False) -> "queue.Queue[WatchEvent]":
+        """Subscribe to events for ``kind`` (None = all kinds).
+
+        ``bookmarks=True`` opts in to BOOKMARK events: one immediately
+        after replay carrying the snapshot resource version, then
+        periodically as writes land (consumers must skip events whose
+        ``object`` is None). ``resume_rv`` (implies bookmarks) resumes
+        from a previously bookmarked version: when the bounded event log
+        still covers it, only the missed events are replayed — the
+        O(delta) resync path — otherwise a RELIST sentinel is emitted
+        (seeded consumers must drop their preloaded state: the replay is
+        a replacement, not a delta) followed by the full O(bucket) ADDED
+        replay."""
         q: "queue.Queue[WatchEvent]" = queue.Queue()
+        now = time.monotonic()
         with self._lock:
-            # Replay current state so late watchers converge (informer-
-            # style). Replay shares the stored snapshots: the old
-            # deepcopy-the-store-under-the-lock stalled every writer for
-            # the whole copy.
-            if kind is None:
-                replay: Iterator[Any] = iter(self._objects.values())
-            else:
-                replay = iter(self._by_kind.get(kind, {}).values())
-            for obj in replay:
-                q.put(WatchEvent("ADDED", obj, ts_mono=time.monotonic()))
-            self._watchers.append((kind, q))
+            if resume_rv is not None:
+                bookmarks = True
+                # The log covers the resume point iff its oldest entry is
+                # no newer than the first event we'd need (rvs in the log
+                # are contiguous — every rv bump emits exactly one event).
+                covered = resume_rv >= self._rv or (
+                    bool(self._event_log)
+                    and self._event_log[0].rv <= resume_rv + 1
+                )
+                if covered:
+                    n = 0
+                    for ev in self._event_log:
+                        if ev.rv > resume_rv and (
+                                kind is None or ev.object.kind == kind):
+                            q.put(ev)
+                            n += 1
+                    self._count_replayed("resume", n)
+                else:
+                    # Missed events already evicted: the resume point is
+                    # too old, fall back to a full replay. The RELIST
+                    # sentinel tells a seeded consumer the replay is a
+                    # REPLACEMENT, not a delta — without it, an object
+                    # deleted while the consumer was down (its DELETED
+                    # event evicted from the log) would survive in the
+                    # seed forever, since full replay only emits ADDED
+                    # for objects that still exist.
+                    q.put(WatchEvent("RELIST", None, ts_mono=now, rv=0))
+                    resume_rv = None
+            if resume_rv is None:
+                # Replay current state so late watchers converge (informer-
+                # style). Replay shares the stored snapshots — resolved
+                # from the per-kind index bucket for kind-scoped
+                # subscriptions, never the whole store — and the old
+                # deepcopy-the-store-under-the-lock stalled every writer
+                # for the whole copy.
+                if kind is None:
+                    replay: Iterator[Any] = iter(self._objects.values())
+                else:
+                    replay = iter(self._by_kind.get(kind, {}).values())
+                n = 0
+                for obj in replay:
+                    q.put(WatchEvent("ADDED", obj, ts_mono=now,
+                                     rv=obj.metadata.resource_version))
+                    n += 1
+                self._count_replayed("full", n)
+            if bookmarks:
+                # Initial bookmark: the snapshot resource version this
+                # subscription is consistent with — persist it and pass it
+                # back as resume_rv to resync without a relist.
+                q.put(WatchEvent("BOOKMARK", None, ts_mono=now,
+                                 rv=self._rv))
+            self._watchers.append((kind, q, bookmarks))
         return q
 
     def stop_watch(self, q: "queue.Queue[WatchEvent]") -> None:
         with self._lock:
-            self._watchers = [(k, w) for (k, w) in self._watchers if w is not q]
+            self._watchers = [w for w in self._watchers if w[1] is not q]

@@ -69,7 +69,13 @@ class CachedReader:
       the cache for everything that actually leaves the informer;
     - freshness: events are enqueued synchronously at write time and
       drained on every read (``sync``), so in-process reads always observe
-      their own writes.
+      their own writes;
+    - resync: subscriptions opt into watch BOOKMARK events, and the last
+      bookmarked resource version per kind is tracked (``resume_rv``). A
+      restarted reader seeded from persisted state resubscribes with
+      ``watch_kind(kind, resume_rv=..., seed=...)`` and receives only the
+      events it missed — never an O(store) relist (the client-go
+      reflector's resumeRV path; satellite of ISSUE 6).
     """
 
     def __init__(self, api: Any):
@@ -78,6 +84,9 @@ class CachedReader:
         self._store: Dict[Tuple[str, str, str], Any] = {}
         self._by_kind: Dict[str, Dict[Tuple[str, str, str], Any]] = {}
         self._by_kind_ns: Dict[Tuple[str, str], Dict[Tuple[str, str, str], Any]] = {}
+        # Last seen resource version per kind (bookmarks + events), under
+        # self._lock: what a restart passes back as resume_rv.
+        self._resume_rv: Dict[str, int] = {}
         # Store lock: guards the local store + indexes only, held per-apply
         # and per-lookup — never across a queue drain. Draining is
         # serialized PER KIND (one lock per subscription), so concurrent
@@ -88,17 +97,70 @@ class CachedReader:
         self._drain_locks: Dict[str, threading.Lock] = {}
         self._sub_lock = threading.Lock()      # _watches/_drain_locks registry
 
-    def watch_kind(self, kind: str) -> None:
+    def watch_kind(self, kind: str, *, resume_rv: Optional[int] = None,
+                   seed: Tuple[Any, ...] = ()) -> None:
+        """Subscribe to ``kind``. ``seed`` preloads the local store with
+        objects restored from persisted state (shared references, no
+        copies); ``resume_rv`` asks the server to replay only events newer
+        than that version — together they are the restart path: seed from
+        the snapshot/WAL, resume from the last bookmark, skip the relist."""
         with self._sub_lock:
             if kind in self._watches:
                 return
             self._drain_locks[kind] = threading.Lock()
-            self._watches[kind] = self.api.watch(kind)
+            # The seed is only sound on the resume path: a full ADDED
+            # replay (resume_rv=None, or a backend without resume
+            # support) has no RELIST sentinel, so a seeded object that
+            # was deleted while the reader was down would never be
+            # removed — the replay rebuilds the full state anyway, so
+            # the seed buys nothing there.
+            if seed and resume_rv is not None:
+                with self._lock:
+                    for obj in seed:
+                        key = _key(obj)
+                        self._store[key] = obj
+                        index_put(self._by_kind, self._by_kind_ns, key, obj)
+            try:
+                q = self.api.watch(kind, resume_rv=resume_rv,
+                                   bookmarks=True)
+            except TypeError:
+                # Backends predating bookmark support (duck-typed fakes,
+                # the kubectl adapter): plain subscription, full replay —
+                # drop any seeded state for the ghost-object reason above.
+                with self._lock:
+                    for key in list(self._by_kind.get(kind, {})):
+                        self._store.pop(key, None)
+                        index_drop(self._by_kind, self._by_kind_ns, key)
+                q = self.api.watch(kind)
+            self._watches[kind] = q
+
+    def resume_rv(self, kind: str) -> Optional[int]:
+        """The last resource version this cache is known consistent with
+        for ``kind`` (from bookmarks and applied events) — persist it and
+        hand it back to ``watch_kind(resume_rv=...)`` after a restart."""
+        self._sync_kind(kind)
+        with self._lock:
+            return self._resume_rv.get(kind)
 
     def caches(self, kind: str) -> bool:
         return kind in self._watches
 
-    def _apply_locked(self, ev: Any) -> None:
+    def _apply_locked(self, ev: Any, kind: str) -> None:
+        if ev.rv:
+            self._resume_rv[kind] = ev.rv
+        if getattr(ev, "type", None) == "RELIST":
+            # The server could not honor our resume_rv: the ADDED events
+            # that follow are a REPLACEMENT for this kind, so the seeded
+            # store must be dropped first — an object deleted while we
+            # were down is in the seed but not in the replay, and nothing
+            # else would ever remove it.
+            for key in list(self._by_kind.get(kind, {})):
+                self._store.pop(key, None)
+                index_drop(self._by_kind, self._by_kind_ns, key)
+            return
+        if ev.object is None:
+            # BOOKMARK: nothing to store, only the rv watermark above.
+            return
         key = _key(ev.object)
         if ev.type == "DELETED":
             self._store.pop(key, None)
@@ -128,8 +190,10 @@ class CachedReader:
                 return 0
             with self._lock:
                 for ev in events:
-                    self._apply_locked(ev)
-        return len(events)
+                    self._apply_locked(ev, kind)
+        # Bookmarks advance the rv watermark but carry no object; the
+        # returned count keeps its meaning of "state changes applied".
+        return sum(1 for ev in events if ev.object is not None)
 
     def sync(self) -> int:
         """Drain every subscription into the local store; returns events
@@ -166,7 +230,17 @@ class CachedReader:
         label_selector: Optional[Dict[str, str]] = None,
         *,
         copy: bool = True,
+        limit: Optional[int] = None,
+        continue_: Optional[str] = None,
     ) -> List[Any]:
+        if limit is not None or continue_ is not None:
+            # Paginated walks need the server's snapshot-pinned continue
+            # tokens; the local cache has no snapshot registry. (client-go
+            # informers likewise serve full lists only — paginated reads
+            # go to the apiserver.)
+            return self.api.list(kind, namespace, label_selector,
+                                 copy=copy, limit=limit,
+                                 continue_=continue_)
         if not self.caches(kind):
             return self.api.list(kind, namespace, label_selector, copy=copy)
         self._sync_kind(kind)
@@ -457,6 +531,9 @@ class ControllerManager:
                 except queue_mod.Empty:
                     break
                 n += 1
+                if ev.object is None:
+                    # BOOKMARK (a bookmark-opted backend): no key to map.
+                    continue
                 if ev.ts_mono > 0:
                     # Write-time → drain-time lag; under chaos watch-lag
                     # injection this provably includes the injected delay.

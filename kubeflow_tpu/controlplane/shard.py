@@ -1,0 +1,724 @@
+"""Horizontally sharded control plane (ISSUE 6).
+
+PR 5's reconcile worker pool bought 2.0×, and docs/controlplane-perf.md
+is explicit about why it stops there: the GIL serializes the pure-Python
+reconcile bodies, so zero-RTT throughput is pinned to one core no matter
+how many workers overlap API round trips. This module breaks that ceiling
+the way real control planes do — **horizontally**: N shard *processes*,
+each running its own ``InMemoryApiServer`` + ``ControllerManager`` (plus
+worker pool), with a deterministic router assigning every object to
+exactly one shard. This is the coordination-layer limit of
+arxiv 2011.03641 ("Exploring the limits of Concurrency in ML Training on
+Google TPUs") attacked at the layer the paper names.
+
+Pieces:
+
+- :class:`ShardRouter` — pure function ``route(kind, namespace) → shard``.
+  **Contract:** namespaced kinds hash the NAMESPACE alone (the kind does
+  not enter the hash), so a TpuJob and every dependent it spawns — gang
+  pods, services, events — land on the SAME shard and its controllers
+  never need a cross-shard read. Cluster-scoped kinds hash the kind to a
+  deterministic HOME shard for fingerprint accounting, and are replicated
+  to every shard at create time so the lease holder's singleton
+  controllers see them locally wherever the lease lands. The hash is
+  blake2s — stable across processes, machines and Python runs (never
+  ``hash()``, which is salted per process).
+- shard worker processes (:func:`_shard_worker`) — each builds the full
+  single-shard stack (apiserver, manager, TpuJob controller, kubelet,
+  optional chaos proxy, optional WAL) and serves a small command protocol
+  over a pipe. A worker journals every committed write through the WAL
+  (fsync'd, in commit order), so SIGKILL at any point replays to the
+  exact pre-crash state on restart.
+- :class:`ShardedControlPlane` — the parent-side handle: routes object
+  creation, drives reconcile rounds on ALL shards concurrently (each
+  round executes in N processes in parallel — this is where the
+  horizontal speedup comes from), unions per-shard fingerprints, and
+  owns **leader election**: exactly one live shard holds the lease and
+  runs the singleton controllers (the admission-ledger / defrag-style
+  loops that must not run twice). The election is epoch-numbered; a
+  killed leader's lease moves to the lowest-numbered survivor, and a
+  restarted ex-leader comes back as a follower.
+- :func:`run_sharded_sweep` — the bench driver behind
+  ``bench.py controlplane --shards N``: the same fleet the serial sweep
+  drives, routed across shards, hard-gated (by the caller) on
+  cross-shard union ``state_fingerprint()`` equality with the serial
+  run.
+
+Failure/recovery contract (proved by the sharded chaos soak): a shard
+killed mid-soak replays its WAL to the exact pre-crash store, its manager
+resubscribes (``CachedReader`` seeding + watch bookmarks), and the fleet
+converges with a byte-identical union fingerprint — recovery IS the
+normal resync path, not a special case.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import multiprocessing
+import os
+import signal
+import time
+import traceback
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from kubeflow_tpu.controlplane.benchmark import (
+    signature_of_rows,
+    state_rows,
+)
+from kubeflow_tpu.controlplane.runtime.apiserver import CLUSTER_SCOPED
+from kubeflow_tpu.utils import get_logger
+
+log = get_logger("shard")
+
+SHARD_DIR_FMT = "shard-{:02d}"
+
+
+# --------------------------------------------------------------------------
+# Router
+# --------------------------------------------------------------------------
+
+
+class ShardRouter:
+    """Deterministic ``(kind, namespace) → shard`` routing.
+
+    Namespaced kinds route by namespace ONLY — colocation is the whole
+    contract: every object a controller reads or writes while reconciling
+    a key lives in that key's namespace, so per-namespace placement makes
+    each shard's store closed under reconciliation. Cluster-scoped kinds
+    (no namespace to hash) route by kind, giving each cluster-scoped
+    family a single deterministic home shard — the shard that REPORTS
+    them in fingerprint rows; the objects themselves are replicated to
+    every shard by :meth:`ShardedControlPlane.create` so singleton
+    controllers can read them on whichever shard holds the lease.
+    """
+
+    def __init__(self, num_shards: int):
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        self.num_shards = int(num_shards)
+
+    @staticmethod
+    def _bucket(token: str) -> int:
+        h = hashlib.blake2s(token.encode(), digest_size=8).digest()
+        return int.from_bytes(h, "big")
+
+    def route(self, kind: str, namespace: str = "") -> int:
+        if self.num_shards == 1:
+            return 0
+        if kind in CLUSTER_SCOPED or not namespace:
+            return self._bucket(f"kind:{kind}") % self.num_shards
+        return self._bucket(f"ns:{namespace}") % self.num_shards
+
+    def route_doc(self, doc: Dict[str, Any]) -> int:
+        meta = doc.get("metadata") or {}
+        return self.route(doc.get("kind", ""), meta.get("namespace", ""))
+
+
+# --------------------------------------------------------------------------
+# Shard worker (child process)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ShardSpec:
+    """Everything a shard process needs to build itself — plain picklable
+    data, identical across restarts of the same shard (the restart path
+    relies on the WAL living at the same spec-derived location)."""
+
+    shard_id: int
+    num_shards: int
+    workers: int = 1
+    rtt_us: int = 0                  # modeled per-verb API RTT
+    state_dir: str = ""              # "" = no WAL (pure-perf bench mode)
+    seed: int = 0
+    conflict_rate: float = 0.0
+    transient_rate: float = 0.0
+    work_ticks: int = 0              # 0 = pods succeed on first tick
+    capacity: Optional[Dict[str, int]] = None
+    wal_fsync: bool = True
+    bookmark_interval: int = 50
+
+
+class ShardSingleton:
+    """The singleton-controller stand-in registered on the LEADER shard
+    only: represents the loops that must run exactly once platform-wide
+    (a cross-shard admission ledger, defrag-style background sweeps).
+    Running two of these would double-admit / double-migrate — which is
+    precisely why the sharded plane needs leader election at all."""
+
+    NAME = "shard-singleton"
+
+
+def _wal_dir(spec: ShardSpec) -> str:
+    return os.path.join(spec.state_dir, SHARD_DIR_FMT.format(spec.shard_id))
+
+
+def _shard_worker(conn, spec: ShardSpec) -> None:
+    """Child-process body: build one complete control-plane shard and
+    serve commands until "stop" (or the parent goes away)."""
+    # Imports INSIDE the worker keep the module import cheap for the
+    # parent and make spawn-started children pay only for what they use.
+    from kubeflow_tpu.chaos.api import ChaosApiServer, FaultSpec
+    from kubeflow_tpu.chaos.preemptor import SlicePreemptor
+    from kubeflow_tpu.controlplane.api import object_from_dict
+    from kubeflow_tpu.controlplane.controllers.podrunner import FakeKubelet
+    from kubeflow_tpu.controlplane.controllers.tpujob import TpuJobController
+    from kubeflow_tpu.controlplane.runtime import (
+        ControllerManager,
+        ExponentialBackoffLimiter,
+        InMemoryApiServer,
+    )
+    from kubeflow_tpu.controlplane.runtime.reconciler import Controller
+    from kubeflow_tpu.controlplane.wal import WriteAheadLog, wal_path
+    from kubeflow_tpu.utils.monitoring import MetricsRegistry
+    from kubeflow_tpu.utils.tracing import Tracer
+
+    registry = MetricsRegistry()
+    tracer = Tracer()
+    api = InMemoryApiServer(registry=registry, tracer=tracer,
+                            bookmark_interval=spec.bookmark_interval)
+
+    wal = None
+    wal_replayed = 0
+    if spec.state_dir:
+        sdir = _wal_dir(spec)
+        os.makedirs(sdir, exist_ok=True)
+        wal = WriteAheadLog(wal_path(sdir), fsync=spec.wal_fsync)
+        # Restart path: replay the fsync'd record stream BEFORE attaching
+        # the journal (replay must not re-journal) and before any
+        # controller subscribes (their watch replay then sees the
+        # recovered store).
+        wal_replayed = wal.replay(api)
+        wal.attach(api)
+
+    front: Any = api
+    chaos = None
+    rtt_s = spec.rtt_us * 1e-6
+    if spec.conflict_rate > 0 or spec.transient_rate > 0:
+        rules = {
+            "update:*": FaultSpec(conflict_rate=spec.conflict_rate,
+                                  transient_rate=spec.transient_rate,
+                                  latency_s=rtt_s),
+            "update_status:*": FaultSpec(conflict_rate=spec.conflict_rate,
+                                         transient_rate=spec.transient_rate,
+                                         latency_s=rtt_s),
+            "create:*": FaultSpec(transient_rate=spec.transient_rate,
+                                  latency_s=rtt_s),
+            "delete:*": FaultSpec(transient_rate=spec.transient_rate,
+                                  latency_s=rtt_s),
+            "list:*": FaultSpec(transient_rate=spec.transient_rate,
+                                latency_s=rtt_s),
+        }
+        chaos = ChaosApiServer(api, seed=spec.seed + spec.shard_id,
+                               registry=registry, rules=rules)
+        front = chaos
+    elif rtt_s > 0:
+        # Latency-only proxy: the modeled apiserver round trip every real
+        # control plane pays (same shape as the serial bench's rtt_s).
+        chaos = ChaosApiServer(api, seed=spec.seed + spec.shard_id,
+                               registry=registry,
+                               rules={"*:*": FaultSpec(latency_s=rtt_s)})
+        front = chaos
+
+    mgr = ControllerManager(
+        front, registry, tracer=tracer, workers=spec.workers,
+        limiter=ExponentialBackoffLimiter(seed=spec.seed + 101
+                                          + spec.shard_id),
+    )
+    capacity = dict(spec.capacity) if spec.capacity else None
+    job_ctl = TpuJobController(front, registry, capacity=capacity,
+                               hbm_check=False)
+    mgr.register(job_ctl)
+
+    seen: Dict[str, int] = {}
+    if spec.work_ticks > 0:
+        def outcome(name: str) -> Optional[str]:
+            seen[name] = seen.get(name, 0) + 1
+            return "Succeeded" if seen[name] >= spec.work_ticks else None
+    else:
+        def outcome(name: str) -> Optional[str]:
+            return "Succeeded"
+
+    kubelet = FakeKubelet(front, registry, outcome=outcome)
+    mgr.register(kubelet)
+    # Slice preemption models hardware and targets the RAW store.
+    preemptor = SlicePreemptor(api, seed=spec.seed + 202 + spec.shard_id,
+                               capacity=capacity, registry=registry)
+
+    class _Singleton(Controller):
+        NAME = ShardSingleton.NAME
+        WATCH_KINDS = ("PlatformConfig",)
+
+        def reconcile(self, namespace, name):
+            return None
+
+    singleton: Optional[Controller] = None
+    leading = False
+
+    def handle(msg: Tuple) -> Any:
+        nonlocal singleton, leading
+        cmd = msg[0]
+        if cmd == "create":
+            n = 0
+            for doc in msg[1]:
+                api.create(object_from_dict(doc))
+                n += 1
+            return n
+        if cmd == "round":
+            window = float(msg[1])
+            n = mgr.run_until_idle(max_iterations=500000,
+                                   include_timers_within=window)
+            kubelet.tick()
+            n += mgr.run_until_idle(max_iterations=500000,
+                                    include_timers_within=window)
+            phases: Dict[str, int] = {}
+            terminal = True
+            for j in api.list("TpuJob", copy=False):
+                p = j.status.phase or "-"
+                phases[p] = phases.get(p, 0) + 1
+                if p not in ("Succeeded", "Failed"):
+                    terminal = False
+            return {"reconciles": n, "terminal": terminal,
+                    "phases": phases}
+        if cmd == "fingerprint":
+            # Cluster-scoped kinds are REPLICATED to every shard (so the
+            # lease holder's singleton controllers see them locally, on
+            # whichever shard the lease lands) — only their HOME shard
+            # reports them, so the cross-shard union counts each exactly
+            # once and stays byte-comparable to a serial world.
+            router = ShardRouter(spec.num_shards)
+            rows = state_rows(api.list_all())
+            return [r for r in rows
+                    if r[0] not in CLUSTER_SCOPED
+                    or router.route(r[0]) == spec.shard_id]
+        if cmd == "quiesce":
+            if chaos is not None:
+                chaos.quiesce()
+            preemptor.restore_capacity()
+            return None
+        if cmd == "preempt":
+            return preemptor.preempt_random()
+        if cmd == "lead":
+            want = bool(msg[1])
+            if want and singleton is None:
+                singleton = _Singleton(front, registry)
+                mgr.register(singleton)
+            elif not want and singleton is not None:
+                mgr.unregister(singleton)
+                singleton = None
+            leading = want
+            return leading
+        if cmd == "info":
+            return {
+                "shard_id": spec.shard_id,
+                "leading": leading,
+                "controllers": [c.NAME for c in mgr.controllers],
+                "workers": spec.workers,
+                "wal_appended": wal.appended if wal else 0,
+                "wal_replayed": wal_replayed,
+                "store_objects": len(api.list_all()),
+                "injected": dict(chaos.injected) if chaos else {},
+                "replayed": dict(api.replayed),
+            }
+        raise ValueError(f"unknown shard command {cmd!r}")
+
+    try:
+        while True:
+            try:
+                msg = conn.recv()
+            except EOFError:
+                break           # parent went away: shut down quietly
+            if msg[0] == "stop":
+                conn.send(("ok", None))
+                break
+            try:
+                conn.send(("ok", handle(msg)))
+            except BaseException:
+                conn.send(("err", traceback.format_exc()))
+    finally:
+        mgr.close()
+        if wal is not None:
+            wal.close()
+
+
+# --------------------------------------------------------------------------
+# Parent-side handle
+# --------------------------------------------------------------------------
+
+
+class ShardError(RuntimeError):
+    pass
+
+
+class ShardedControlPlane:
+    """Parent-side handle over N shard processes.
+
+    Reconcile rounds are dispatched to every live shard before any reply
+    is awaited, so the shards' rounds execute concurrently — N stores, N
+    GILs, N worker pools. Leader election: the lease sits with the
+    lowest-numbered LIVE shard; every membership change (kill, restart)
+    re-runs the election, bumps the epoch, and pushes the lead/follow
+    verdict to every survivor (the restarted ex-leader explicitly comes
+    back as a follower).
+    """
+
+    def __init__(
+        self,
+        num_shards: int,
+        *,
+        workers: int = 1,
+        rtt_us: int = 0,
+        state_dir: str = "",
+        seed: int = 0,
+        conflict_rate: float = 0.0,
+        transient_rate: float = 0.0,
+        work_ticks: int = 0,
+        capacity_by_shard: Optional[Dict[int, Dict[str, int]]] = None,
+        wal_fsync: bool = True,
+        start_method: str = "fork",
+    ):
+        self.router = ShardRouter(num_shards)
+        self.num_shards = int(num_shards)
+        self._base = dict(
+            workers=workers, rtt_us=rtt_us, state_dir=state_dir, seed=seed,
+            conflict_rate=conflict_rate, transient_rate=transient_rate,
+            work_ticks=work_ticks, wal_fsync=wal_fsync,
+        )
+        self._capacity_by_shard = dict(capacity_by_shard or {})
+        if start_method not in multiprocessing.get_all_start_methods():
+            start_method = "spawn"
+        self._ctx = multiprocessing.get_context(start_method)
+        self._procs: Dict[int, Any] = {}
+        self._conns: Dict[int, Any] = {}
+        self._dead: set = set()
+        self.leader_id: Optional[int] = None
+        self.epoch = 0
+        for i in range(self.num_shards):
+            self._spawn(i)
+        self._elect()
+
+    # ----------------- lifecycle -----------------
+
+    def _spec(self, shard_id: int) -> ShardSpec:
+        return ShardSpec(shard_id=shard_id, num_shards=self.num_shards,
+                         capacity=self._capacity_by_shard.get(shard_id),
+                         **self._base)
+
+    def _spawn(self, shard_id: int) -> None:
+        parent_conn, child_conn = self._ctx.Pipe()
+        proc = self._ctx.Process(
+            target=_shard_worker, args=(child_conn, self._spec(shard_id)),
+            daemon=True, name=f"kftpu-shard-{shard_id}",
+        )
+        proc.start()
+        child_conn.close()
+        self._procs[shard_id] = proc
+        self._conns[shard_id] = parent_conn
+        self._dead.discard(shard_id)
+
+    def alive(self) -> List[int]:
+        return [i for i in sorted(self._procs)
+                if i not in self._dead and self._procs[i].is_alive()]
+
+    def kill(self, shard_id: int) -> None:
+        """SIGKILL the shard process — the process-level fault the chaos
+        layer injects. No flush, no goodbye: exactly what the WAL's
+        fsync-per-record discipline exists to survive."""
+        proc = self._procs[shard_id]
+        if proc.is_alive():
+            os.kill(proc.pid, signal.SIGKILL)
+        proc.join(timeout=10)
+        try:
+            self._conns[shard_id].close()
+        except OSError:
+            pass
+        self._dead.add(shard_id)
+        self._elect()
+        log.warning("shard killed", kv={"shard": shard_id,
+                                        "leader": self.leader_id})
+
+    def restart(self, shard_id: int) -> None:
+        """Respawn a killed shard. The fresh process replays the shard's
+        WAL before serving — rejoining with its exact pre-crash state —
+        and the election runs again (a restarted ex-leader follows)."""
+        if shard_id not in self._dead:
+            raise ShardError(f"shard {shard_id} is not dead")
+        self._spawn(shard_id)
+        self._elect()
+
+    def close(self) -> None:
+        for i in self.alive():
+            try:
+                self._call(i, "stop")
+            except (ShardError, OSError, EOFError):
+                pass
+        for i, proc in self._procs.items():
+            proc.join(timeout=10)
+            if proc.is_alive():
+                proc.terminate()
+                proc.join(timeout=5)
+        for conn in self._conns.values():
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # ----------------- command plumbing -----------------
+
+    def _call(self, shard_id: int, *msg) -> Any:
+        conn = self._conns[shard_id]
+        conn.send(msg)
+        status, payload = conn.recv()
+        if status == "err":
+            raise ShardError(f"shard {shard_id}: {payload}")
+        return payload
+
+    def _broadcast(self, *msg) -> Dict[int, Any]:
+        """Send to every live shard FIRST, then collect replies: the
+        shards execute the command concurrently — this is the horizontal
+        parallelism (each round runs in N processes at once). EVERY reply
+        is drained before an error is raised — bailing on the first
+        ``err`` would leave later shards' replies in their pipes, and the
+        next command on those connections would read a stale payload as
+        its answer."""
+        ids = self.alive()
+        for i in ids:
+            self._conns[i].send(msg)
+        out: Dict[int, Any] = {}
+        errors: List[str] = []
+        for i in ids:
+            status, payload = self._conns[i].recv()
+            if status == "err":
+                errors.append(f"shard {i}: {payload}")
+            else:
+                out[i] = payload
+        if errors:
+            raise ShardError("; ".join(errors))
+        return out
+
+    # ----------------- leader election -----------------
+
+    def _elect(self) -> None:
+        alive = self.alive()
+        if self.leader_id is not None and self.leader_id in alive:
+            # Lease renewal: the incumbent holds the lease while alive. A
+            # restarted ex-leader must NOT steal it back — leadership only
+            # moves when the holder dies (otherwise every crash-replay
+            # restart would flap the singleton controllers twice).
+            new_leader: Optional[int] = self.leader_id
+        else:
+            new_leader = min(alive) if alive else None
+        if new_leader != self.leader_id:
+            self.epoch += 1
+            log.info("leader elected", kv={
+                "leader": new_leader, "epoch": self.epoch,
+            })
+        self.leader_id = new_leader
+        for i in alive:
+            self._call(i, "lead", i == new_leader)
+
+    # ----------------- operations -----------------
+
+    def create(self, docs: Iterable[Dict[str, Any]]) -> Dict[int, int]:
+        """Route manifests to their shards and create them; returns
+        objects created per shard. Cluster-scoped kinds are REPLICATED to
+        every shard: the lease can land on any shard, and the singleton
+        controllers running there must see cluster-scoped state in their
+        local store (the ``fingerprint`` command counts each replica set
+        once, at its home shard). Singleton WRITES to cluster-scoped
+        objects still need the cross-shard service the ROADMAP defers —
+        a local write would only update one replica."""
+        by_shard: Dict[int, List[Dict[str, Any]]] = {}
+        for doc in docs:
+            if doc.get("kind", "") in CLUSTER_SCOPED:
+                for shard_id in range(self.num_shards):
+                    by_shard.setdefault(shard_id, []).append(doc)
+            else:
+                by_shard.setdefault(self.router.route_doc(doc),
+                                    []).append(doc)
+        out = {}
+        for shard_id, batch in sorted(by_shard.items()):
+            if shard_id in self._dead:
+                raise ShardError(
+                    f"cannot create on dead shard {shard_id}")
+            out[shard_id] = self._call(shard_id, "create", batch)
+        return out
+
+    def round(self, window: float = 30.0) -> Dict[int, Dict[str, Any]]:
+        """One reconcile round on every live shard, concurrently."""
+        return self._broadcast("round", window)
+
+    def quiesce(self) -> None:
+        self._broadcast("quiesce")
+
+    def preempt(self, shard_id: int) -> Optional[str]:
+        return self._call(shard_id, "preempt")
+
+    def info(self) -> Dict[int, Dict[str, Any]]:
+        return {i: self._call(i, "info") for i in self.alive()}
+
+    def shard_rows(self, shard_id: int) -> List[Tuple[str, str, str, str]]:
+        return [tuple(r) for r in self._call(shard_id, "fingerprint")]
+
+    def shard_fingerprint(self, shard_id: int) -> tuple:
+        return signature_of_rows(self.shard_rows(shard_id))
+
+    def fingerprint(self) -> tuple:
+        """(per-kind phase counts, signature) over the UNION of every live
+        shard's store — directly comparable to a serial run's
+        ``state_fingerprint()``."""
+        rows: List[Tuple[str, str, str, str]] = []
+        for shard_id, shard in self._broadcast("fingerprint").items():
+            rows.extend(tuple(r) for r in shard)
+        return signature_of_rows(rows)
+
+    def __enter__(self) -> "ShardedControlPlane":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# --------------------------------------------------------------------------
+# Sharded sweep (the bench driver)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ShardedSweepReport:
+    jobs: int
+    shards: int
+    workers: int
+    rtt_s: float
+    reconciles: int
+    wall_s: float
+    reconciles_per_sec: float
+    all_succeeded: bool
+    rounds: int
+    jobs_per_shard: Dict[int, int]
+    final_state: Dict[str, Dict[str, int]]
+    state_signature: str
+
+    def summary(self) -> Dict[str, object]:
+        return {
+            "jobs": self.jobs,
+            "shards": self.shards,
+            "workers": self.workers,
+            "rtt_s": self.rtt_s,
+            "reconciles": self.reconciles,
+            "sweep_wall_s": round(self.wall_s, 3),
+            "reconciles_per_sec": round(self.reconciles_per_sec, 1),
+            "rounds": self.rounds,
+            "jobs_per_shard": dict(self.jobs_per_shard),
+            "final_state": {k: dict(v) for k, v in self.final_state.items()},
+            "state_signature": self.state_signature,
+        }
+
+
+def fleet_docs(num_jobs: int, num_namespaces: int,
+               slice_type: str = "v5e-16") -> List[Dict[str, Any]]:
+    """The bench fleet as manifest dicts — byte-identical to the objects
+    ``run_controlplane_sweep`` creates, so the sharded union fingerprint
+    is directly comparable to the serial one."""
+    return [
+        {
+            "kind": "TpuJob",
+            "metadata": {"name": f"job-{i:04d}",
+                         "namespace": f"ns-{i % num_namespaces:02d}"},
+            "spec": {"sliceType": slice_type, "mesh": {"dp": -1},
+                     "backoffSeconds": 0.0},
+        }
+        for i in range(num_jobs)
+    ]
+
+
+def host_cpu_headroom(sample_s: float = 0.5) -> float:
+    """Measured aggregate multi-process CPU headroom of THIS host: the
+    ratio of 2-process to 1-process spin throughput (1.0 = one effective
+    core, 2.0 = two clean cores). Shared/throttled CI hosts commonly
+    measure well under their advertised core count; the sharded bench
+    records this next to its speedup so the number can be read against
+    the ceiling the host actually offers."""
+    import multiprocessing as mp
+    import time as _time
+
+    def spin(v):
+        t0 = _time.perf_counter()
+        x = 0
+        while _time.perf_counter() - t0 < sample_s:
+            x += 1
+        v.value = x
+
+    def run(nprocs: int) -> float:
+        ctx = mp.get_context("fork" if "fork" in
+                             mp.get_all_start_methods() else "spawn")
+        vals = [ctx.Value("q", 0) for _ in range(nprocs)]
+        procs = [ctx.Process(target=spin, args=(v,)) for v in vals]
+        for p in procs:
+            p.start()
+        for p in procs:
+            p.join()
+        return float(sum(v.value for v in vals))
+
+    solo = run(1)
+    duo = run(2)
+    return duo / solo if solo > 0 else 1.0
+
+
+def run_sharded_sweep(
+    *,
+    num_jobs: int = 1000,
+    num_namespaces: int = 20,
+    shards: int = 4,
+    workers: int = 1,
+    rtt_s: float = 0.0,
+    slice_type: str = "v5e-16",
+    max_rounds: int = 12,
+    state_dir: str = "",
+    seed: int = 0,
+    start_method: str = "fork",
+) -> ShardedSweepReport:
+    """Drive the standard bench fleet across ``shards`` shard processes to
+    convergence. Fleet creation happens before the clock starts (matching
+    the serial sweep, which also times only the reconcile phase).
+    ``state_dir`` enables the per-shard WAL (off by default: the bench
+    measures dispatch, the soak proves durability)."""
+    if num_jobs < 1 or num_namespaces < 1:
+        raise ValueError("num_jobs and num_namespaces must be >= 1")
+    num_namespaces = min(num_namespaces, num_jobs)
+    docs = fleet_docs(num_jobs, num_namespaces, slice_type)
+    cp = ShardedControlPlane(
+        shards, workers=workers, rtt_us=int(round(rtt_s * 1e6)),
+        state_dir=state_dir, seed=seed, start_method=start_method,
+    )
+    try:
+        created = cp.create(docs)
+        reconciles = 0
+        rounds = 0
+        t0 = time.perf_counter()
+        for _ in range(max_rounds):
+            rounds += 1
+            res = cp.round(30.0)
+            reconciles += sum(r["reconciles"] for r in res.values())
+            if all(r["terminal"] for r in res.values()):
+                break
+        wall = time.perf_counter() - t0
+        counts, signature = cp.fingerprint()
+    finally:
+        cp.close()
+    job_phases = counts.get("TpuJob", {})
+    return ShardedSweepReport(
+        jobs=num_jobs,
+        shards=shards,
+        workers=workers,
+        rtt_s=rtt_s,
+        reconciles=reconciles,
+        wall_s=wall,
+        reconciles_per_sec=reconciles / wall if wall > 0 else 0.0,
+        all_succeeded=job_phases.get("Succeeded", 0) == num_jobs,
+        rounds=rounds,
+        jobs_per_shard=created,
+        final_state=counts,
+        state_signature=signature,
+    )

@@ -24,12 +24,18 @@ Stages (any failure exits non-zero — the merge gate contract):
    (default 4) adds a **chaos-parallel-smoke** stage running the same
    seeded soak through the reconcile worker pool, so injected faults
    race concurrent reconciles.
+5b. **shard-smoke**: the seeded chaos soak across 2 control-plane shard
+   processes with a whole-shard SIGKILL mid-soak (ISSUE 6) — fails unless
+   the fleet converges all-Succeeded AND the killed shard replayed its
+   WAL to a byte-identical per-shard state fingerprint (``--skip-shard``).
 6. **cp-bench-smoke**: a small (N=50) control-plane sweep
    (kubeflow_tpu.controlplane.benchmark) gated on the *deterministic*
    copies-per-list counter: a namespaced list must deepcopy exactly its
    matches, never the store (count-based, not wall-clock — cannot flake);
    plus a ``workers=4`` re-run gated on final-state equality with the
-   serial sweep (the per-object phase signature — counts again).
+   serial sweep (the per-object phase signature — counts again); plus a
+   ``shards=2`` leg gated on cross-shard UNION fingerprint equality with
+   the serial world.
 7. **obs-smoke**: scrape a live MetricsHttpServer during a small fleet
    sweep; assert the exposition parses (histograms included) and that
    one reconcile span + one histogram observation exists per reconcile
@@ -157,15 +163,54 @@ def run_obs_smoke(num_jobs: int = 10, num_namespaces: int = 2) -> None:
         )
 
 
+def run_shard_smoke(seed: int = 20260803, shards: int = 2) -> None:
+    """Sharded-control-plane smoke (ISSUE 6): the seeded chaos soak across
+    ``shards`` shard processes with a whole-shard SIGKILL mid-soak.
+    Gates — counts and fingerprints, never wall-clock:
+
+    - every job terminal and Succeeded across the shard union;
+    - the killed shard replayed its WAL to a byte-identical per-shard
+      ``state_fingerprint()`` (``replay_identical``);
+    - exactly the expected kill was injected, and leadership moved only
+      through the election (epoch accounting).
+    """
+    from kubeflow_tpu.chaos import run_sharded_soak
+
+    rep = run_sharded_soak(num_jobs=4, shards=shards, seed=seed,
+                           conflict_rate=0.3, transient_rate=0.05,
+                           preempt_every=3, kill_shard_round=4,
+                           fault_rounds=8, max_rounds=40)
+    tag = f"seed={seed}, shards={shards}"
+    if not rep.converged:
+        raise GateFailure(
+            f"shard smoke ({tag}): fleet not terminal after "
+            f"{rep.rounds} rounds: {rep.phases}"
+        )
+    if not rep.all_succeeded:
+        raise GateFailure(f"shard smoke ({tag}): jobs failed: {rep.phases}")
+    if rep.shard_kills != 1:
+        raise GateFailure(
+            f"shard smoke ({tag}): expected exactly 1 shard kill, "
+            f"injected {rep.shard_kills}"
+        )
+    if not rep.replay_identical:
+        raise GateFailure(
+            f"shard smoke ({tag}): killed shard did NOT replay its WAL "
+            "to a byte-identical fingerprint — crash recovery regressed"
+        )
+
+
 def run_cp_bench_smoke(num_jobs: int = 50, num_namespaces: int = 5,
-                       workers: int = 4) -> None:
+                       workers: int = 4, shards: int = 2) -> None:
     """Small control-plane sweep gated on the deterministic copy counter:
     the probe list must deepcopy exactly its matches (O(matches)), and the
     fleet must fully converge. ``workers`` > 1 additionally re-runs the
     sweep through the reconcile worker pool and gates on final-state
     equality with the serial run (the per-(kind, namespace, name, phase)
     signature — counts, never wall-clock, so it cannot flake on a slow
-    CI host the way a speedup threshold would)."""
+    CI host the way a speedup threshold would). ``shards`` > 1 adds the
+    horizontal leg: the same fleet across shard processes, gated on
+    cross-shard UNION fingerprint equality with the serial world."""
     from kubeflow_tpu.controlplane.benchmark import run_controlplane_sweep
 
     rep = run_controlplane_sweep(num_jobs=num_jobs,
@@ -206,6 +251,24 @@ def run_cp_bench_smoke(num_jobs: int = 50, num_namespaces: int = 5,
                 f"{par.list_matches} matches; the concurrent read path "
                 "is back to O(store)"
             )
+    if shards > 1:
+        from kubeflow_tpu.controlplane.shard import run_sharded_sweep
+
+        sharded = run_sharded_sweep(num_jobs=num_jobs,
+                                    num_namespaces=num_namespaces,
+                                    shards=shards, workers=1)
+        if not sharded.all_succeeded:
+            raise GateFailure(
+                f"cp-bench-smoke: shards={shards} sweep did not "
+                f"converge: {sharded.final_state}"
+            )
+        if sharded.state_signature != rep.state_signature:
+            raise GateFailure(
+                f"cp-bench-smoke: shards={shards} union fingerprint "
+                f"differs from the serial world — {sharded.final_state} "
+                f"vs {rep.final_state}; the router/colocation contract "
+                "regressed"
+            )
 
 
 def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
@@ -213,7 +276,8 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
              chaos_seed: int = 20260803, chaos_latency_s: float = 0.0,
              chaos_workers: int = 4,
              skip_cp_bench: bool = False,
-             skip_obs: bool = False) -> List[str]:
+             skip_obs: bool = False,
+             skip_shard: bool = False) -> List[str]:
     """Run all stages; returns the list of passed stages, raises
     GateFailure on the first failing one."""
     passed: List[str] = []
@@ -294,9 +358,16 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
             run_chaos_smoke(seed=chaos_seed, latency_s=chaos_latency_s)
             passed.append("chaos-latency-smoke")
 
+    if not skip_shard:
+        _stage("shard-smoke")
+        run_shard_smoke(seed=chaos_seed)
+        passed.append("shard-smoke")
+
     if not skip_cp_bench:
         _stage("cp-bench-smoke")
-        run_cp_bench_smoke()
+        # --skip-shard exists for hosts where shard processes cannot run
+        # at all, so it must also drop this stage's sharded leg.
+        run_cp_bench_smoke(shards=1 if skip_shard else 2)
         passed.append("cp-bench-smoke")
 
     if not skip_obs:
@@ -347,6 +418,8 @@ def main(argv=None) -> int:
                    help="skip the control-plane copy-counter smoke")
     g.add_argument("--skip-obs", action="store_true",
                    help="skip the observability scrape/trace smoke")
+    g.add_argument("--skip-shard", action="store_true",
+                   help="skip the sharded-control-plane kill/replay smoke")
     args = p.parse_args(argv)
     try:
         passed = run_gate(
@@ -359,6 +432,7 @@ def main(argv=None) -> int:
             chaos_workers=args.chaos_workers,
             skip_cp_bench=args.skip_cp_bench,
             skip_obs=args.skip_obs,
+            skip_shard=args.skip_shard,
         )
     except GateFailure as e:
         print(f"[ci] FAIL: {e}", file=sys.stderr)

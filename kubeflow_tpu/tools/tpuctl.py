@@ -58,6 +58,18 @@ def _kubectl_api(args):
     return build_backend(args)
 
 
+def _load_platform(args) -> Platform:
+    """Load the state dir, honoring the global ``--wal`` flag for EVERY
+    subcommand (load() itself re-attaches when wal.jsonl already
+    exists): from here on each committed write is fsync'd to
+    <state-dir>/wal.jsonl before its watch event is visible, and the
+    next load() replays the log past the last snapshot."""
+    platform = Platform.load(args.state_dir)
+    if getattr(args, "wal", False) and platform.wal is None:
+        platform.attach_wal(args.state_dir)
+    return platform
+
+
 def cmd_apply(args) -> int:
     docs = _load_docs(args.filename)
     # PlatformConfigs first (components must exist before CRs reconcile).
@@ -76,7 +88,7 @@ def cmd_apply(args) -> int:
                 api.update(live)
             print(f"applied {obj.kind}/{obj.metadata.name}")
         return 0
-    platform = Platform.load(args.state_dir)
+    platform = _load_platform(args)
     applied = []
     for d in docs:
         obj = platform.apply_resource(d)
@@ -196,7 +208,7 @@ def cmd_get(args) -> int:
     if args.backend == "kubectl":
         objs = _kubectl_api(args).list(args.kind, namespace=args.namespace)
     else:
-        platform = Platform.load(args.state_dir)
+        platform = _load_platform(args)
         objs = platform.api.list(args.kind, namespace=args.namespace,
                                  copy=False)
     if args.output == "yaml":
@@ -220,7 +232,7 @@ def cmd_status(args) -> int:
         print("status is a state-backend command (in-cluster controllers "
               "own platform state)", file=sys.stderr)
         return 2
-    platform = Platform.load(args.state_dir)
+    platform = _load_platform(args)
     out = {
         "components": platform.components,
         "resources": {},
@@ -260,7 +272,7 @@ def cmd_delete(args) -> int:
                 print(f"error deleting {kind}/{name}: {e}", file=sys.stderr)
                 return 1
         return 0
-    platform = Platform.load(args.state_dir)
+    platform = _load_platform(args)
     for kind, name, ns in targets:
         try:
             platform.api.delete(kind, name, ns)
@@ -282,6 +294,8 @@ def cmd_trace(args) -> int:
     The tentpole's reading surface: where `tpuctl metrics` says how MANY
     reconciles ran, `trace` says where the time between a write and its
     convergence went."""
+    import glob as _glob
+
     from kubeflow_tpu.controlplane.platform import TRACE_FILE
     from kubeflow_tpu.utils.tracing import Tracer, assemble_trace
 
@@ -289,12 +303,21 @@ def cmd_trace(args) -> int:
         print("trace target must be <kind>/<name>", file=sys.stderr)
         return 2
     kind, name = args.target.split("/", 1)
-    path = os.path.join(args.state_dir, TRACE_FILE)
-    if not os.path.exists(path):
+    # Shard-aware: a sharded state dir keeps one trace file per shard
+    # (shard-NN/trace.jsonl). The object lives on exactly one shard (the
+    # router's colocation contract), so merging the files cannot splice
+    # two different objects' timelines together.
+    paths = [os.path.join(args.state_dir, TRACE_FILE)] + sorted(
+        _glob.glob(os.path.join(args.state_dir, "shard-*", TRACE_FILE))
+    )
+    paths = [p for p in paths if os.path.exists(p)]
+    if not paths:
         print(f"no trace recorded under {args.state_dir} "
               "(state-backend commands record one on save)", file=sys.stderr)
         return 1
-    spans = Tracer.load_jsonl(path)
+    spans = []
+    for p in paths:
+        spans.extend(Tracer.load_jsonl(p))
     if not args.namespace:
         # Without -n the reference filter matches every namespace; two
         # same-named objects would silently merge into one timeline whose
@@ -383,25 +406,33 @@ def _hist_series(samples, base: str, label: str):
 
 
 def cmd_top(args) -> int:
-    """Per-controller latency summary from a LIVE /metrics scrape — the
+    """Per-controller latency summary from LIVE /metrics scrapes — the
     operator's `kubectl top` analogue for reconcile loops. Percentiles are
     estimated from the exposition's histogram buckets with the same
-    interpolation the in-process benches use."""
+    interpolation the in-process benches use.
+
+    Shard-aware: pass ``--url`` once per shard and the scrapes AGGREGATE —
+    bucket counts sum across shards, which is sound because every series
+    of one histogram family shares identical bucket bounds, so the
+    percentiles printed are fleet-wide, not per-process."""
     from kubeflow_tpu.utils.monitoring import (
         parse_exposition,
         quantile_from_buckets,
     )
 
-    try:
-        text = _scrape(args.url)
-    except Exception as e:
-        print(f"scrape {args.url} failed: {e}", file=sys.stderr)
-        return 1
-    try:
-        samples = parse_exposition(text)
-    except ValueError as e:
-        print(f"unparseable exposition: {e}", file=sys.stderr)
-        return 1
+    samples = []
+    for url in args.url:
+        try:
+            text = _scrape(url)
+        except Exception as e:
+            print(f"scrape {url} failed: {e}", file=sys.stderr)
+            return 1
+        try:
+            samples.extend(parse_exposition(text))
+        except ValueError as e:
+            print(f"unparseable exposition from {url}: {e}",
+                  file=sys.stderr)
+            return 1
     recon = _hist_series(samples, "kftpu_reconcile_duration_seconds",
                          "controller")
     qwait = _hist_series(samples, "kftpu_workqueue_wait_seconds",
@@ -438,7 +469,7 @@ def cmd_metrics(args) -> int:
     if args.backend == "kubectl":
         print("metrics is a state-backend command", file=sys.stderr)
         return 2
-    platform = Platform.load(args.state_dir)
+    platform = _load_platform(args)
     platform.reconcile()
     sys.stdout.write(platform.registry.render())
     return 0
@@ -493,7 +524,7 @@ def cmd_logs(args) -> int:
                 print(f"(logs unavailable: {e})")
                 rc = 1
         return rc
-    platform = Platform.load(args.state_dir)
+    platform = _load_platform(args)
     pod = platform.api.try_get("Pod", args.name, ns)
     if pod is not None:
         pods = [pod]
@@ -528,6 +559,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpuctl",
                                 description="TPU-native Kubeflow control CLI")
     p.add_argument("--state-dir", default=".tpuctl")
+    p.add_argument("--wal", action="store_true",
+                   help="journal every write to <state-dir>/wal.jsonl "
+                        "(fsync'd write-ahead log; load replays it past "
+                        "the last snapshot after a crash)")
     p.add_argument("--backend", choices=("state", "kubectl"), default="state")
     p.add_argument("--kubectl-bin", default="kubectl")
     p.add_argument("--context", default="")
@@ -578,10 +613,13 @@ def build_parser() -> argparse.ArgumentParser:
     tp.set_defaults(fn=cmd_trace)
 
     top = sub.add_parser(
-        "top", help="per-controller reconcile latency percentiles from a "
-                    "live /metrics scrape")
-    top.add_argument("--url", required=True,
-                     help="metrics endpoint, e.g. http://127.0.0.1:9090/")
+        "top", help="per-controller reconcile latency percentiles from "
+                    "live /metrics scrapes (repeat --url to aggregate "
+                    "across shards)")
+    top.add_argument("--url", required=True, action="append",
+                     help="metrics endpoint, e.g. http://127.0.0.1:9090/; "
+                          "repeatable — multiple scrapes aggregate into "
+                          "fleet-wide percentiles")
     top.set_defaults(fn=cmd_top)
 
     lp = sub.add_parser("logs", help="worker logs for a pod / TpuJob gang")

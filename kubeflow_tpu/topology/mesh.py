@@ -26,13 +26,20 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Dict, List, Optional, Sequence, Tuple
-
-import jax
-import numpy as np
-from jax.sharding import Mesh
+from typing import TYPE_CHECKING, Dict, List, Optional, Sequence, Tuple
 
 from kubeflow_tpu.topology.slices import SliceType, get_slice
+
+if TYPE_CHECKING:  # pragma: no cover
+    import jax
+    from jax.sharding import Mesh
+
+# jax/numpy are imported lazily inside the mesh-MATERIALISING functions:
+# planning (plan_mesh/AxisSpec) is pure math, and the control plane — in
+# particular every sharded shard process (controlplane/shard.py) — imports
+# this module only to plan and validate. Keeping jax off that path cuts a
+# shard's cold start from ~4s to well under a second, which is what makes
+# crash-replay restarts and per-(kind, namespace) shard processes cheap.
 
 # Canonical logical axis order: outermost (cheapest collectives / DCN-ok)
 # first, innermost (latency-critical) last. This is also the mesh-axis order
@@ -185,6 +192,10 @@ def make_mesh(
     physical coordinates and keeps mesh-adjacent devices ICI-adjacent. On CPU
     (tests, dryrun) a plain reshape is used.
     """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
     if devices is None:
         devices = jax.devices()
     ndev = len(devices)
@@ -224,6 +235,10 @@ def make_multislice_mesh(
     ``mesh_utils.create_hybrid_device_mesh`` (reads device.slice_index);
     on CPU (tests/dryrun) contiguous device blocks emulate slices.
     """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
     if dcn_axis not in ("dp", "pp"):
         raise ValueError(
             f"dcn_axis must be 'dp' or 'pp' (latency-tolerant collectives); "
@@ -267,6 +282,10 @@ def make_multislice_mesh(
 def make_host_local_mesh(axes: AxisSpec) -> Mesh:
     """Convenience: build a mesh over whatever devices this process sees
     (single-host dev loop / unit tests)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
     ndev = len(jax.devices())
     resolved = axes.resolve(ndev)
     shape = tuple(resolved.as_dict()[a] for a in AXIS_ORDER)

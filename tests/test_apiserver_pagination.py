@@ -1,0 +1,175 @@
+"""Paginated list semantics (ISSUE 6): snapshot-pinned continue tokens.
+
+The contract under test: a ``limit``/``continue_`` walk enumerates
+EXACTLY the unpaginated list as of the walk's first page — same objects,
+same order — no matter what writes land mid-walk. Plus the failure
+modes: evicted snapshots raise ContinueExpiredError (410 Gone), and
+copy counting stays O(page).
+"""
+
+import random
+
+import pytest
+
+from kubeflow_tpu.controlplane.api.meta import ObjectMeta
+from kubeflow_tpu.controlplane.api.types import TpuJob, TpuJobSpec
+from kubeflow_tpu.controlplane.runtime import (
+    ApiError,
+    ContinueExpiredError,
+    InMemoryApiServer,
+    ListPage,
+)
+
+
+def _job(name, ns="ns", labels=None):
+    return TpuJob(
+        metadata=ObjectMeta(name=name, namespace=ns,
+                            labels=dict(labels or {})),
+        spec=TpuJobSpec(slice_type="v5e-16"),
+    )
+
+
+def _walk(api, limit, **query):
+    """Full paginated walk; returns (items, resource_version)."""
+    page = api.list("TpuJob", limit=limit, **query)
+    assert isinstance(page, ListPage)
+    items, rv = list(page.items), page.resource_version
+    while page.continue_:
+        page = api.list("TpuJob", limit=limit, continue_=page.continue_,
+                        **query)
+        assert page.resource_version == rv
+        items.extend(page.items)
+    return items, rv
+
+
+class TestPagination:
+    def test_every_limit_enumerates_the_unpaginated_list(self):
+        api = InMemoryApiServer()
+        for i in range(23):
+            api.create(_job(f"j{i:02d}", ns=f"ns-{i % 3}"))
+        full = [o.metadata.name for o in api.list("TpuJob", copy=False)]
+        for limit in (1, 2, 3, 7, 22, 23, 100):
+            items, _ = _walk(api, limit)
+            assert [o.metadata.name for o in items] == full, limit
+
+    def test_walk_is_snapshot_consistent_under_concurrent_writes(self):
+        """Property test: random creates/deletes/updates land between
+        pages; the walk must still enumerate exactly the list captured at
+        its first page (the paginate-at-one-revision contract)."""
+        rng = random.Random(0)
+        for trial in range(5):
+            api = InMemoryApiServer()
+            names = [f"j{i:02d}" for i in range(rng.randrange(5, 30))]
+            for n in names:
+                api.create(_job(n))
+            frozen = [o.metadata.name for o in api.list("TpuJob",
+                                                        copy=False)]
+            limit = rng.randrange(1, 6)
+            page = api.list("TpuJob", limit=limit)
+            items = list(page.items)
+            extra = 0
+            while page.continue_:
+                # Chaos between pages: create, delete, update.
+                op = rng.random()
+                if op < 0.4:
+                    api.create(_job(f"mid-{trial}-{extra}"))
+                    extra += 1
+                elif op < 0.7 and names:
+                    victim = names.pop(rng.randrange(len(names)))
+                    api.delete("TpuJob", victim, "ns")
+                elif names:
+                    obj = api.get("TpuJob", rng.choice(names), "ns")
+                    obj.spec.max_restarts += 1
+                    api.update(obj)
+                page = api.list("TpuJob", limit=limit,
+                                continue_=page.continue_)
+                items.extend(page.items)
+            assert [o.metadata.name for o in items] == frozen
+
+    def test_completed_walk_frees_its_snapshot(self):
+        api = InMemoryApiServer()
+        for i in range(6):
+            api.create(_job(f"j{i}"))
+        _walk(api, 2)
+        assert not api._page_snapshots
+
+    def test_evicted_snapshot_raises_continue_expired(self):
+        api = InMemoryApiServer()
+        for i in range(4):
+            api.create(_job(f"j{i}"))
+        page = api.list("TpuJob", limit=1)
+        stale = page.continue_
+        # Open (and abandon) enough concurrent walks to evict the first.
+        for _ in range(InMemoryApiServer.MAX_PAGE_SNAPSHOTS + 1):
+            api.list("TpuJob", limit=1)
+        with pytest.raises(ContinueExpiredError):
+            api.list("TpuJob", limit=1, continue_=stale)
+
+    def test_malformed_token_raises_api_error(self):
+        api = InMemoryApiServer()
+        api.create(_job("j0"))
+        with pytest.raises(ApiError):
+            api.list("TpuJob", limit=1, continue_="not-a-token")
+        with pytest.raises(ApiError):
+            api.list("TpuJob", limit=0)
+
+    def test_nonpositive_limit_rejected_mid_walk(self):
+        """limit is validated on EVERY page: a continuation with
+        limit<=0 would return an empty page whose token never advances,
+        spinning a standard `while page.continue_` walk forever."""
+        api = InMemoryApiServer()
+        for i in range(4):
+            api.create(_job(f"j{i}"))
+        page = api.list("TpuJob", limit=2)
+        assert page.continue_
+        for bad in (0, -1):
+            with pytest.raises(ApiError):
+                api.list("TpuJob", limit=bad, continue_=page.continue_)
+        # The walk itself is unharmed — and continuing WITHOUT a limit
+        # drains the rest of the pinned snapshot in one page.
+        rest = api.list("TpuJob", continue_=page.continue_)
+        assert [o.metadata.name for o in page.items + rest.items] == \
+            [f"j{i}" for i in range(4)]
+        assert rest.continue_ == ""
+
+    def test_copy_count_is_per_page(self):
+        """The O(matches) discipline extends to pages: each page deepcopies
+        exactly the objects it returns; copy=False pages copy nothing."""
+        api = InMemoryApiServer()
+        for i in range(10):
+            api.create(_job(f"j{i}"))
+        api.copied = {}
+        page = api.list("TpuJob", limit=4)
+        assert api.copied.get("list", 0) == 4
+        api.list("TpuJob", limit=4, continue_=page.continue_)
+        assert api.copied.get("list", 0) == 8
+        api.copied = {}
+        zero = api.list("TpuJob", limit=4, copy=False)
+        assert api.copied.get("list", 0) == 0
+        # Zero-copy pages ARE the stored snapshots.
+        assert zero.items[0] is api.get("TpuJob", "j0", "ns", copy=False)
+
+    def test_label_selector_pins_with_the_snapshot(self):
+        api = InMemoryApiServer()
+        for i in range(8):
+            api.create(_job(f"j{i}", labels={"team": "x" if i % 2 else "y"}))
+        want = [o.metadata.name
+                for o in api.list("TpuJob", label_selector={"team": "x"},
+                                  copy=False)]
+        page = api.list("TpuJob", label_selector={"team": "x"}, limit=2)
+        items = list(page.items)
+        api.create(_job("late", labels={"team": "x"}))
+        while page.continue_:
+            page = api.list("TpuJob", limit=2, continue_=page.continue_)
+            items.extend(page.items)
+        assert [o.metadata.name for o in items] == want
+
+    def test_chaos_proxy_passes_pagination_through(self):
+        from kubeflow_tpu.chaos.api import ChaosApiServer
+
+        api = InMemoryApiServer()
+        for i in range(5):
+            api.create(_job(f"j{i}"))
+        chaos = ChaosApiServer(api, seed=0)
+        items, _ = _walk(chaos, 2)
+        assert len(items) == 5

@@ -190,6 +190,84 @@ class TestCachedReader:
         assert reader.get("TpuJob", "a", "u", copy=False) is live
 
 
+class TestBookmarkResync:
+    """ISSUE 6 satellite: watch bookmarks + resume. A restarted reader
+    seeded from persisted state resyncs from its last bookmarked resource
+    version — the server replays only the missed delta, never an O(store)
+    ADDED replay and never a copying relist (gated on the deterministic
+    ``api.replayed`` / ``api.copied`` tallies)."""
+
+    def test_initial_bookmark_carries_snapshot_rv(self):
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        api.create(_job("a"))
+        reader = CachedReader(api)
+        reader.watch_kind("TpuJob")
+        assert reader.resume_rv("TpuJob") == api._rv
+
+    def test_periodic_bookmarks_advance_the_watermark(self):
+        api = InMemoryApiServer(registry=MetricsRegistry(),
+                                bookmark_interval=3)
+        reader = CachedReader(api)
+        reader.watch_kind("TpuJob")
+        # Writes of an UNWATCHED kind still advance the store version;
+        # only the periodic bookmark can tell the TpuJob reader so.
+        for i in range(6):
+            api.create(Pod(metadata=ObjectMeta(name=f"p{i}",
+                                               namespace="u")))
+        assert reader.resume_rv("TpuJob") == api._rv
+
+    def test_restarted_reader_resyncs_without_relist(self):
+        api = InMemoryApiServer(registry=MetricsRegistry())
+        for i in range(40):
+            api.create(_job(f"j{i:02d}"))
+        reader = CachedReader(api)
+        reader.watch_kind("TpuJob")
+        rv = reader.resume_rv("TpuJob")
+        seed = tuple(reader.list("TpuJob", copy=False))
+        reader.close()                         # the "crash"
+
+        # Writes landing while the reader is down — the missed delta.
+        api.create(_job("late"))
+        live = api.get("TpuJob", "j00", "u")
+        live.status.phase = "Running"
+        api.update_status(live)
+        api.delete("TpuJob", "j01", "u")
+
+        full_before = api.replayed.get("full", 0)
+        resume_before = api.replayed.get("resume", 0)
+        copied_before = dict(api.copied)
+        restarted = CachedReader(api)
+        restarted.watch_kind("TpuJob", resume_rv=rv, seed=seed)
+        # No O(store) replay, and no copying relist anywhere on the path.
+        assert api.replayed.get("full", 0) == full_before
+        assert api.copied == copied_before
+        # Exactly the three missed events were replayed.
+        assert api.replayed.get("resume", 0) - resume_before == 3
+        # ... and the reader converged to the live world.
+        assert restarted.get("TpuJob", "late", "u",
+                             copy=False) is not None
+        assert restarted.get("TpuJob", "j00", "u",
+                             copy=False).status.phase == "Running"
+        assert restarted.try_get("TpuJob", "j01", "u") is None
+        assert len(restarted.list("TpuJob", copy=False)) == 40
+        assert restarted.resume_rv("TpuJob") == api._rv
+
+    def test_resume_too_old_falls_back_to_full_replay(self):
+        """A resume point the bounded event log no longer covers must NOT
+        silently lose events — the server falls back to the full replay."""
+        api = InMemoryApiServer(registry=MetricsRegistry(),
+                                event_log_size=4)
+        api.create(_job("old"))
+        rv = api._rv
+        for i in range(10):                      # evicts rv+1 from the log
+            api.create(_job(f"j{i}"))
+        restarted = CachedReader(api)
+        full_before = api.replayed.get("full", 0)
+        restarted.watch_kind("TpuJob", resume_rv=rv)
+        assert api.replayed.get("full", 0) - full_before == 11
+        assert len(restarted.list("TpuJob", copy=False)) == 11
+
+
 class _Echo(Controller):
     NAME = "echo-cache"
     WATCH_KINDS = ("TpuJob",)

@@ -1,0 +1,198 @@
+"""Horizontally sharded control plane (ISSUE 6): router contract, shard
+processes, leader election, WAL crash-replay under SIGKILL, and the
+cross-shard union fingerprint gate.
+
+Every gate here is counts/fingerprints, never wall-clock — the same
+discipline as the rest of the CI stages — so the tests cannot flake on a
+loaded host. The fleets are tiny (shard processes are real OS processes).
+"""
+
+import pytest
+
+from kubeflow_tpu.controlplane.benchmark import (
+    run_controlplane_sweep,
+    signature_of_rows,
+    state_rows,
+)
+from kubeflow_tpu.controlplane.shard import (
+    ShardedControlPlane,
+    ShardRouter,
+    fleet_docs,
+    run_sharded_sweep,
+)
+
+
+class TestShardRouter:
+    def test_deterministic_and_in_range(self):
+        r = ShardRouter(5)
+        for i in range(50):
+            ns = f"ns-{i}"
+            assert 0 <= r.route("TpuJob", ns) < 5
+            assert r.route("TpuJob", ns) == ShardRouter(5).route("TpuJob", ns)
+
+    def test_namespace_colocation_contract(self):
+        """Everything a controller touches while reconciling a key lives
+        in that key's namespace — so all kinds in one namespace MUST land
+        on one shard (the router hashes the namespace alone)."""
+        r = ShardRouter(4)
+        for ns in ("team-a", "ns-00", "kubeflow-ci"):
+            shards = {r.route(kind, ns)
+                      for kind in ("TpuJob", "Pod", "Service", "Event")}
+            assert len(shards) == 1, (ns, shards)
+
+    def test_cluster_scoped_kinds_have_a_deterministic_home(self):
+        r = ShardRouter(4)
+        assert r.route("Profile", "") == r.route("Profile", "ignored-ns")
+        assert 0 <= r.route("PlatformConfig", "") < 4
+
+    def test_single_shard_short_circuits(self):
+        assert ShardRouter(1).route("TpuJob", "anything") == 0
+
+    def test_cluster_scoped_replicated_but_fingerprinted_once(self):
+        """Cluster-scoped kinds live on EVERY shard (the lease holder's
+        singleton controllers read them locally, wherever the lease
+        lands) while the union fingerprint counts them once, at their
+        home shard — so it still matches a serial world's."""
+        from kubeflow_tpu.controlplane.runtime import InMemoryApiServer
+        from kubeflow_tpu.controlplane.api import object_from_dict
+
+        doc = {"kind": "PlatformConfig",
+               "metadata": {"name": "platform"},
+               "spec": {"components": []}}
+        cp = ShardedControlPlane(3, seed=5)
+        try:
+            created = cp.create([doc])
+            assert created == {0: 1, 1: 1, 2: 1}, created
+            for info in cp.info().values():
+                assert info["store_objects"] == 1, info
+            counts, signature = cp.fingerprint()
+        finally:
+            cp.close()
+        assert counts.get("PlatformConfig", {}).get("-", 0) == 1, counts
+        serial = InMemoryApiServer()
+        serial.create(object_from_dict(doc))
+        assert (counts, signature) == \
+            signature_of_rows(state_rows(serial.list_all()))
+
+    def test_route_doc(self):
+        r = ShardRouter(3)
+        doc = {"kind": "TpuJob", "metadata": {"namespace": "ns-7",
+                                              "name": "x"}}
+        assert r.route_doc(doc) == r.route("TpuJob", "ns-7")
+
+    def test_rejects_zero_shards(self):
+        with pytest.raises(ValueError):
+            ShardRouter(0)
+
+
+class TestShardedSweep:
+    def test_union_fingerprint_equals_serial_world(self):
+        """The tentpole gate: N stores + N GILs must converge to the
+        byte-identical world one store does (per-(kind, ns, name, phase)
+        union signature)."""
+        serial = run_controlplane_sweep(num_jobs=18, num_namespaces=6)
+        sharded = run_sharded_sweep(num_jobs=18, num_namespaces=6,
+                                    shards=2, workers=1)
+        assert sharded.all_succeeded, sharded.final_state
+        assert sharded.state_signature == serial.state_signature, (
+            sharded.final_state, serial.final_state)
+        # Work actually spread: more than one shard hosted jobs.
+        assert len(sharded.jobs_per_shard) > 1, sharded.jobs_per_shard
+
+    def test_rows_signature_is_order_independent(self):
+        rows = [("TpuJob", "a", "x", "Succeeded"),
+                ("Pod", "a", "y", "Running")]
+        assert signature_of_rows(rows) == \
+            signature_of_rows(list(reversed(rows)))
+
+    def test_worker_pools_compose_with_shards(self):
+        serial = run_controlplane_sweep(num_jobs=12, num_namespaces=4)
+        sharded = run_sharded_sweep(num_jobs=12, num_namespaces=4,
+                                    shards=2, workers=2)
+        assert sharded.all_succeeded
+        assert sharded.state_signature == serial.state_signature
+
+
+class TestLeaderElectionAndCrashReplay:
+    def test_kill_replay_election_cycle(self, tmp_path):
+        """One flow, every claim: exactly one leader runs the singleton;
+        a SIGKILLed shard replays its WAL byte-identically; the lease
+        moves on leader death and is NOT stolen back on restart; the
+        fleet still converges after the crash."""
+        cp = ShardedControlPlane(3, state_dir=str(tmp_path), seed=13)
+        try:
+            assert cp.leader_id == 0 and cp.epoch == 1
+            info = cp.info()
+            leaders = [i for i, x in info.items() if x["leading"]]
+            assert leaders == [0]
+            assert "shard-singleton" in info[0]["controllers"]
+            for i in (1, 2):
+                assert "shard-singleton" not in info[i]["controllers"]
+
+            cp.create(fleet_docs(9, 6))
+            cp.round(30.0)
+
+            victim = cp.leader_id
+            pre = cp.shard_fingerprint(victim)
+            cp.kill(victim)
+            assert victim not in cp.alive()
+            assert cp.leader_id == 1 and cp.epoch == 2
+            info = cp.info()
+            assert [i for i, x in info.items() if x["leading"]] == [1]
+            assert "shard-singleton" in info[1]["controllers"]
+
+            cp.restart(victim)
+            # Byte-identical WAL replay (the crash-recovery hard gate)...
+            assert cp.shard_fingerprint(victim) == pre
+            info = cp.info()
+            assert info[victim]["wal_replayed"] > 0
+            # ... and the restarted ex-leader FOLLOWS (no lease theft).
+            assert cp.leader_id == 1
+            assert not info[victim]["leading"]
+
+            for _ in range(10):
+                res = cp.round(120.0)
+                if all(r["terminal"] for r in res.values()):
+                    break
+            counts, _sig = cp.fingerprint()
+            assert counts["TpuJob"].get("Succeeded") == 9, counts
+        finally:
+            cp.close()
+
+    def test_sharded_soak_with_shard_kill(self):
+        """The chaos integration: conflicts/transients + slice preemption
+        inside every shard, one whole-shard SIGKILL mid-soak — converges
+        all-Succeeded with a byte-identical replay."""
+        from kubeflow_tpu.chaos import run_sharded_soak
+
+        rep = run_sharded_soak(num_jobs=4, shards=2, seed=3,
+                               kill_shard_round=4, fault_rounds=8,
+                               max_rounds=40)
+        assert rep.converged, rep.phases
+        assert rep.all_succeeded, rep.phases
+        assert rep.shard_kills == 1
+        assert rep.replay_identical
+        assert sum(rep.injected.values()) > 0     # chaos actually fired
+
+    def test_ci_shard_smoke_stage(self):
+        from kubeflow_tpu.tools.ci import run_shard_smoke
+
+        run_shard_smoke(seed=20260803)
+
+    def test_ci_cp_bench_smoke_sharded_leg_detects_divergence(self, monkeypatch):
+        from kubeflow_tpu.tools import ci
+        from kubeflow_tpu.controlplane import shard as shard_mod
+        from kubeflow_tpu.tools.ci import GateFailure
+
+        real = shard_mod.run_sharded_sweep
+
+        def diverging(**kw):
+            rep = real(**kw)
+            rep.state_signature = "deadbeef"
+            return rep
+
+        monkeypatch.setattr(
+            "kubeflow_tpu.controlplane.shard.run_sharded_sweep", diverging)
+        with pytest.raises(GateFailure, match="union fingerprint"):
+            ci.run_cp_bench_smoke(num_jobs=8, num_namespaces=4,
+                                  workers=1, shards=2)
