@@ -827,6 +827,108 @@ def bench_controlplane(args) -> None:
     )
 
 
+def bench_serve(args) -> None:
+    """Serving data-plane overload bench (ISSUE 7): the open-loop
+    generator (fixed arrival rate — requests fire on schedule whether or
+    not earlier ones finished, the way real traffic does) at 2x one
+    replica's ANALYTIC capacity (SimServingReplica: max_batch slots x a
+    fixed service time, so capacity is max_batch/service_time_s QPS by
+    construction, not a hardware measurement), through the real
+    ServingLoadBalancer and, in the third run, the real ServingAutoscaler
+    reconciling a real Serving CR.
+
+    Three runs answer the overload question:
+
+    1. **no-shed baseline** — the pre-ISSUE-7 plane (unbounded engine
+       queue, no LB watermark): the backlog grows without bound and the
+       offered excess turns into client timeouts.
+    2. **shed** — bounded admission (429 + Retry-After) + LB watermark
+       shedding (503 + Retry-After): admitted work keeps a bounded p99,
+       goodput holds near capacity, zero timeouts.
+    3. **shed + autoscale** — the ServingAutoscaler scales replicas
+       toward max_replicas off the scraped queue waits: goodput climbs
+       past one replica's capacity toward the offered load.
+
+    Hard gates (count-based, raise — python -O must not skip them):
+    request accounting sums exactly in every run, every shed carries
+    Retry-After, shedding holds goodput >= 0.7x capacity with zero
+    timeouts, and the autoscaler reaches max_replicas."""
+    from kubeflow_tpu.tools.loadtest import run_serve_bench
+
+    service_time_s = 0.05
+    max_batch = 2
+    max_queue = 6
+    duration_s = args.duration_s
+    capacity_qps = max_batch / service_time_s          # one replica
+    rate_qps = 2.0 * capacity_qps                      # 2x overload
+    common = dict(
+        rate_qps=rate_qps, duration_s=duration_s, replicas=1,
+        max_batch=max_batch, max_queue=max_queue,
+        service_time_s=service_time_s, client_timeout_s=1.5,
+    )
+
+    noshed = run_serve_bench(shed=False, autoscale=False, **common)
+    shed = run_serve_bench(shed=True, autoscale=False, **common)
+    scaled = run_serve_bench(
+        shed=True, autoscale=True, max_replicas=2,
+        target_queue_wait_s=service_time_s, scrape_interval_s=0.2,
+        **common)
+
+    for tag, rep in (("noshed", noshed), ("shed", shed),
+                     ("autoscale", scaled)):
+        if not rep["accounting_ok"]:
+            raise SystemExit(
+                f"serve[{tag}]: accounting broken — offered "
+                f"{rep['offered']} != ok {rep['ok']} + shed {rep['shed']} "
+                f"+ timeouts {rep['timeouts']} + errors {rep['errors']}"
+            )
+        if rep["errors"]:
+            raise SystemExit(f"serve[{tag}]: {rep['errors']} non-shed "
+                             "errors")
+        if rep["shed_with_retry_after"] != rep["shed"]:
+            raise SystemExit(
+                f"serve[{tag}]: {rep['shed'] - rep['shed_with_retry_after']}"
+                f" of {rep['shed']} shed responses missing Retry-After"
+            )
+    for tag, rep in (("shed", shed), ("autoscale", scaled)):
+        if rep["timeouts"]:
+            raise SystemExit(
+                f"serve[{tag}]: {rep['timeouts']} client timeouts with "
+                "shedding ON — overload leaked past admission control"
+            )
+        if rep["goodput_qps"] < 0.7 * capacity_qps:
+            raise SystemExit(
+                f"serve[{tag}]: goodput {rep['goodput_qps']} qps < 0.7x "
+                f"capacity ({capacity_qps} qps) under 2x overload"
+            )
+    if not noshed["timeouts"]:
+        raise SystemExit(
+            "serve[noshed]: baseline shows no timeout churn at 2x "
+            "overload — the collapse this bench exists to contrast "
+            "against did not reproduce (load too low?)"
+        )
+    if scaled["replicas_end"] != scaled["max_replicas"]:
+        raise SystemExit(
+            f"serve[autoscale]: stopped at {scaled['replicas_end']}/"
+            f"{scaled['max_replicas']} replicas under 2x overload"
+        )
+
+    _emit(
+        "serving_overload_goodput_vs_capacity",
+        scaled["goodput_vs_capacity"], "x one-replica capacity",
+        # Baseline = the no-shed plane's goodput fraction: vs_baseline is
+        # the goodput factor shedding+autoscaling buys at 2x overload.
+        max(noshed["goodput_vs_capacity"], 1e-9),
+        capacity_qps=capacity_qps,
+        rate_qps=rate_qps,
+        duration_s=duration_s,
+        goodput_floor_vs_capacity=0.7,
+        noshed=noshed,
+        shed=shed,
+        autoscale=scaled,
+    )
+
+
 def bench_longctx(args) -> None:
     """Long-context variant of config 2 on ONE chip. Defaults encode the
     MEASURED per-length recipe (BASELINE.md context ladder, 2k→64k):
@@ -1010,7 +1112,8 @@ def main() -> None:
     p.add_argument("which", nargs="?", default="train",
                    choices=["train", "serving", "serving8b", "resnet",
                             "vit", "mixtral", "hpo", "hpo-platform",
-                            "controlplane", "longctx", "sp-crossover"])
+                            "controlplane", "serve", "longctx",
+                            "sp-crossover"])
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)
     # Default is per-bench (train 12, serving 16, resnet 256, vit 64,
@@ -1041,6 +1144,9 @@ def main() -> None:
                         "API RTT in microseconds, paid by BOTH runs "
                         "(default 500; 0 = in-process zero-RTT, where the "
                         "GIL — not the dispatcher — is what's measured)")
+    p.add_argument("--duration-s", type=float, default=5.0,
+                   help="serve bench: open-loop generator duration per "
+                        "run (offered = 2x capacity x duration)")
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--gen-len", type=int, default=128)
     p.add_argument("--decode-chunk", type=int, default=32)
@@ -1122,6 +1228,7 @@ def main() -> None:
         "hpo": bench_hpo,
         "hpo-platform": bench_hpo_platform,
         "controlplane": bench_controlplane,
+        "serve": bench_serve,
         "longctx": bench_longctx,
         "sp-crossover": bench_sp_crossover,
     }[args.which](args)

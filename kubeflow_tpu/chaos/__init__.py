@@ -16,6 +16,9 @@ This package makes failure a first-class, *seeded* test input instead:
   the recovery mechanism (ISSUE 6).
 - :class:`BackendFlapper` — flaps serving LB backends to prove request
   failover is client-invisible.
+- :func:`run_serving_soak` — the serving DATA-plane soak (ISSUE 7):
+  backends flap/drain/saturate mid-traffic; gates on zero requests
+  routed to excluded backends and Retry-After on every shed.
 - :func:`run_soak` — the seeded convergence soak shared by tier-1 tests
   and the CI ``chaos-smoke`` stage.
 - :func:`run_sharded_soak` — the soak across N shard processes with a
@@ -31,6 +34,10 @@ from kubeflow_tpu.chaos.api import (
 )
 from kubeflow_tpu.chaos.flapper import BackendFlapper
 from kubeflow_tpu.chaos.preemptor import ShardPreemptor, SlicePreemptor
+from kubeflow_tpu.chaos.serving_soak import (
+    ServingSoakReport,
+    run_serving_soak,
+)
 from kubeflow_tpu.chaos.soak import (
     ShardedSoakReport,
     SoakReport,
@@ -42,11 +49,13 @@ __all__ = [
     "BackendFlapper",
     "ChaosApiServer",
     "FaultSpec",
+    "ServingSoakReport",
     "ShardPreemptor",
     "ShardedSoakReport",
     "SlicePreemptor",
     "SoakReport",
     "TransientApiError",
+    "run_serving_soak",
     "run_sharded_soak",
     "run_soak",
 ]

@@ -1,0 +1,236 @@
+"""Serving data-plane soak: backends flap and drain mid-traffic while
+the LB sheds (the Serving/Notebook drain-path follow-up open since PR 2).
+
+The control-plane soak (:func:`kubeflow_tpu.chaos.run_soak`) proves the
+reconcile layer converges under injected faults; this one proves the
+SERVING data plane's routing invariants hold while its backend set churns
+under load:
+
+1. **Exclusion**: a request is never routed to a backend the LB knows is
+   draining or unhealthy. The soak changes topology only between rounds
+   (no burst in flight while a backend's eligibility flips), so one
+   request landing on an excluded backend is a real dispatch bug, not an
+   in-flight race being miscounted.
+2. **Honest shedding**: every shed response — LB saturation 503, no-
+   healthy-backend 503, relayed engine 429 — carries Retry-After. A shed
+   without a backoff hint converts overload into a client retry storm.
+3. **Accounting**: every request in every round is counted exactly once
+   (ok + shed == sent); a lost request is a hung client.
+
+Each round the seeded RNG picks one action — flap a backend (unhealthy,
+the between-health-checks death), drain one (``set_backends`` scale-down
+with the address's stub still running), saturate the fleet (every backend
+reports ``queued >= max_queue`` through ``/healthz`` so the LB's
+watermark shedding fires), heal, or restore — then fires a burst of
+concurrent requests through the LB front door and tallies the outcome.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import threading
+import urllib.error
+import urllib.request
+from typing import Dict, List
+
+from kubeflow_tpu.serving.lb import ServingLoadBalancer
+from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.webapps.router import JsonHttpServer, Request, Router
+
+log = get_logger("chaos-serving-soak")
+
+
+class _SoakBackend:
+    """Stub serving replica that KNOWS when it must not be receiving
+    traffic: the soak sets ``excluded`` in the same between-rounds window
+    it flips the LB state, so any request arriving while the flag is up
+    is a routing violation, counted in ``misrouted``."""
+
+    def __init__(self, name: str, *, max_queue: int = 4):
+        self.name = name
+        self.max_queue = max_queue
+        self.excluded = False
+        self.reported_queued = 0      # what /healthz claims is queued
+        self.requests = 0
+        self.misrouted = 0
+        self._lock = threading.Lock()
+        r = Router()
+        r.post("/v1/generate", self._generate)
+        r.get("/healthz", self._healthz)
+        self._srv = JsonHttpServer(r, port=0).start()
+        self.addr = f"127.0.0.1:{self._srv.port}"
+
+    def _generate(self, q: Request):
+        with self._lock:
+            self.requests += 1
+            if self.excluded:
+                self.misrouted += 1
+        return {"tokens": [1], "backend": self.name}
+
+    def _healthz(self, q: Request):
+        # Saturation is injected through the load REPORT, not by real
+        # queue pressure: the LB must shed on what the fleet tells it.
+        return {"ok": True, "load": {
+            "queued": self.reported_queued,
+            "free_slots": 0,
+            "max_queue": self.max_queue,
+            "p50_queue_wait_s": 0.05,
+        }}
+
+    def stop(self):
+        self._srv.stop()
+
+
+@dataclasses.dataclass
+class ServingSoakReport:
+    rounds: int = 0
+    sent: int = 0
+    ok: int = 0
+    shed: int = 0                     # 429/503 responses
+    shed_with_retry_after: int = 0
+    errors: int = 0                   # anything else (must stay 0)
+    misrouted: int = 0                # requests that hit excluded backends
+    flaps: int = 0
+    drains: int = 0
+    saturations: int = 0
+    served_by: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    @property
+    def accounting_ok(self) -> bool:
+        return self.ok + self.shed + self.errors == self.sent
+
+    @property
+    def clean(self) -> bool:
+        """The soak's pass condition: no misroutes, no unexplained errors,
+        every shed honest, nothing lost."""
+        return (self.misrouted == 0 and self.errors == 0
+                and self.shed_with_retry_after == self.shed
+                and self.accounting_ok)
+
+
+def run_serving_soak(
+    *,
+    backends: int = 3,
+    rounds: int = 10,
+    requests_per_round: int = 6,
+    seed: int = 20260803,
+) -> ServingSoakReport:
+    """Seeded drain/flap/saturation soak against a live LB + stub fleet.
+    Deterministic in its action SCHEDULE (the RNG); request interleaving
+    within a burst is free — the invariants asserted don't depend on it."""
+    rng = random.Random(seed)
+    fleet = [_SoakBackend(f"b{i}") for i in range(backends)]
+    all_addrs = [b.addr for b in fleet]
+    lb = ServingLoadBalancer(list(all_addrs), retry_after_s=1.0)
+    front = JsonHttpServer(lb.router(), port=0).start()
+    url = f"http://127.0.0.1:{front.port}/v1/generate"
+    rep = ServingSoakReport()
+    body = json.dumps({"tokens": [1]}).encode()
+
+    def fire(results: List[tuple]):
+        try:
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                out = json.load(r)
+            results.append(("ok", out.get("backend", ""), ""))
+        except urllib.error.HTTPError as e:
+            e.read()
+            if e.code in (429, 503):
+                results.append(
+                    ("shed", "", e.headers.get("Retry-After") or ""))
+            else:
+                results.append(("error", "", str(e.code)))
+        except Exception as e:  # noqa: BLE001 — every outcome counted
+            results.append(("error", "", repr(e)))
+
+    def sync_excluded():
+        """Stamp each stub with whether the LB may route to it — called
+        between rounds, never with a burst in flight."""
+        snap = {b["addr"]: b for b in lb.backends()}
+        for b in fleet:
+            s = snap.get(b.addr)
+            b.excluded = s is None or (not s["healthy"]) or s["draining"]
+
+    drained: List[str] = []
+    saturated = False
+    try:
+        for rnd in range(rounds):
+            action = rng.choice(
+                ["flap", "drain", "saturate", "heal", "restore"])
+            if action == "flap":
+                live = [b["addr"] for b in lb.backends()
+                        if b["healthy"] and not b["draining"]]
+                if len(live) > 1:
+                    lb.set_backend_health(
+                        live[rng.randrange(len(live))], False,
+                        "chaos: injected flap")
+                    rep.flaps += 1
+            elif action == "drain":
+                current = [b["addr"] for b in lb.backends()
+                           if not b["draining"]]
+                if len(current) > 1:
+                    victim = current[rng.randrange(len(current))]
+                    lb.set_backends([a for a in current if a != victim])
+                    drained.append(victim)
+                    rep.drains += 1
+            elif action == "saturate":
+                for b in fleet:
+                    b.reported_queued = b.max_queue + 2
+                saturated = True
+                rep.saturations += 1
+            elif action == "heal":
+                for b in fleet:
+                    b.reported_queued = 0
+                saturated = False
+                # health_check below re-probes flapped backends (their
+                # stubs still answer /healthz) and ingests load reports.
+            elif action == "restore":
+                lb.set_backends(list(all_addrs))
+                drained.clear()
+            if action == "heal":
+                lb.health_check()
+            else:
+                # Ingest the (possibly saturated) load reports WITHOUT
+                # recovering flapped backends: probe success flips
+                # healthy, so re-flap the chaos victims after.
+                down = [b["addr"] for b in lb.backends()
+                        if not b["healthy"]]
+                lb.health_check()
+                for addr in down:
+                    lb.set_backend_health(addr, False,
+                                          "chaos: still flapped")
+            sync_excluded()
+
+            results: List[tuple] = []
+            threads = [threading.Thread(target=fire, args=(results,))
+                       for _ in range(requests_per_round)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            rep.rounds += 1
+            rep.sent += requests_per_round
+            for kind, backend, extra in results:
+                if kind == "ok":
+                    rep.ok += 1
+                    rep.served_by[backend] = (
+                        rep.served_by.get(backend, 0) + 1)
+                elif kind == "shed":
+                    rep.shed += 1
+                    if extra:
+                        rep.shed_with_retry_after += 1
+                else:
+                    rep.errors += 1
+            log.info("soak round", kv={
+                "round": rnd, "action": action, "ok": rep.ok,
+                "shed": rep.shed, "saturated": saturated})
+    finally:
+        front.stop()
+        for b in fleet:
+            b.stop()
+    rep.misrouted = sum(b.misrouted for b in fleet)
+    return rep

@@ -249,6 +249,19 @@ class Tensorboard:
 # --------------------------------------------------------------------------
 
 @dataclasses.dataclass
+class AutoscaleSpec:
+    """Latency-driven horizontal autoscaling (ServingAutoscaler): keep
+    scraped engine queue wait at ``target_queue_wait_s`` by scaling
+    ``spec.replicas`` inside [min_replicas, max_replicas]. Scale-up is
+    fast (every scrape over target); scale-down waits out a stabilization
+    window (hysteresis) so a traffic dip can't thrash the fleet."""
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_queue_wait_s: float = 0.5
+
+
+@dataclasses.dataclass
 class ServingSpec:
     """Inference deployment surface (reference: TF-Serving deployments
     probed by testing/test_tf_serving.py:60-156). The pod runs
@@ -266,8 +279,14 @@ class ServingSpec:
     # testing/test_tf_serving.py:60-100). Scale-down drains: excess
     # replicas leave status.endpoints first, then get deleted.
     replicas: int = 1
+    # Latency-driven replica autoscaling (None = fixed spec.replicas).
+    autoscale: Optional[AutoscaleSpec] = None
     max_batch: int = 8
     max_len: int = 1024
+    # Bounded admission: engine queue depth past which submit sheds with
+    # 429 + Retry-After (0 = unbounded, the pre-PR-7 behaviour). The
+    # depth watermark the LB's saturation shedding keys off.
+    max_queue: int = 64
     decode_chunk: int = 8               # tokens per device dispatch
     # Engine compute/memory knobs (serving.engine.ServingConfig): int8
     # weight-only quantization is what lets an 8B model fit a 16G chip.

@@ -5,6 +5,7 @@ from kubeflow_tpu.controlplane.controllers.tensorboard import TensorboardControl
 from kubeflow_tpu.controlplane.controllers.podrunner import FakeKubelet
 from kubeflow_tpu.controlplane.controllers.studyjob import StudyJobController
 from kubeflow_tpu.controlplane.controllers.serving import ServingController
+from kubeflow_tpu.controlplane.controllers.autoscaler import ServingAutoscaler
 from kubeflow_tpu.controlplane.webhook.poddefault import (
     PodDefaultMutator,
     mutate_pod,
@@ -18,6 +19,7 @@ __all__ = [
     "FakeKubelet",
     "StudyJobController",
     "ServingController",
+    "ServingAutoscaler",
     "PodDefaultMutator",
     "mutate_pod",
 ]

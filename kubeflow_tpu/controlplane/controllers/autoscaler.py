@@ -1,0 +1,194 @@
+"""ServingAutoscaler: queue-wait-driven replica scaling for Serving CRs.
+
+Closes the loop the PR-4 observability layer opened: the serving engines
+already export queue-wait percentiles (``ServingEngine.load`` via
+``/healthz``; ``kftpu_serving_queue_wait_seconds``), but nothing actuated
+on them — replicas were whatever ``spec.replicas`` said when the CR was
+applied. This controller reconciles ``Serving.spec.autoscale{min_replicas,
+max_replicas, target_queue_wait_s}`` against scraped per-replica load and
+rewrites ``spec.replicas``; the ServingController then creates/drains pods
+and the LB follows ``status.endpoints`` — observe → decide → actuate, the
+dynamic-scheduling shape of arxiv 1908.08082 applied to the serving fleet.
+
+Control law (deliberately asymmetric — overload hurts immediately,
+idle capacity only costs money):
+
+- **Scale-up, fast**: any scrape whose worst replica p95 queue wait
+  exceeds the target scales up proportionally
+  (``ceil(replicas * wait / target)``, at least +1, clamped to max) —
+  one decision per scrape interval, no damping.
+- **Scale-down, slow**: the signal must sit below half the target (the
+  hysteresis band) with idle queues for a full
+  ``scale_down_stabilization_s`` window before ONE replica is removed,
+  and the window restarts after every step — a traffic dip can't thrash
+  the fleet through drain/recreate cycles.
+- **Bounds always win**: replicas outside [min, max] are clamped even
+  when the latency signal is quiet (reasons ``min-replicas`` /
+  ``max-replicas``).
+
+Every decision emits one ``autoscale.decision`` span LINKED to the
+``autoscale.scrape`` span that triggered it (the same causal-link pattern
+the reconcile kernel uses for write→reconcile edges), plus a
+``kftpu_autoscaler_replicas{reason}`` counter of replicas added/removed;
+the controller's reconcile histograms surface in ``tpuctl top`` like any
+other controller's.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+import urllib.request
+from typing import Callable, Dict, Optional, Tuple
+
+from kubeflow_tpu.controlplane.runtime import (
+    Controller,
+    EventRecorder,
+    InMemoryApiServer,
+    Result,
+)
+from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
+from kubeflow_tpu.utils.tracing import Tracer, global_tracer
+
+#: Scale-down hysteresis: the signal must sit below this fraction of the
+#: target (with empty queues) for the whole stabilization window.
+SCALE_DOWN_BAND = 0.5
+
+
+class ServingAutoscaler(Controller):
+    NAME = "serving-autoscaler"
+    WATCH_KINDS = ("Serving",)
+
+    def __init__(
+        self,
+        api: InMemoryApiServer,
+        registry: MetricsRegistry = global_registry,
+        *,
+        tracer: Tracer = global_tracer,
+        interval_s: float = 10.0,
+        scale_down_stabilization_s: float = 60.0,
+        scrape: Optional[Callable[[str], dict]] = None,
+        health_timeout_s: float = 2.0,
+    ):
+        super().__init__(api, registry)
+        self.tracer = tracer
+        self.interval_s = interval_s
+        self.scale_down_stabilization_s = scale_down_stabilization_s
+        self.health_timeout_s = health_timeout_s
+        # Injectable scrape (addr -> engine load dict, {} on failure):
+        # tests and the in-process bench bypass HTTP; production scrapes
+        # each replica's /healthz.
+        self.scrape = scrape or self._scrape_http
+        self.recorder = EventRecorder(api, self.NAME)
+        self.metrics_decisions = registry.counter(
+            "kftpu_autoscaler_replicas",
+            "Replicas added/removed by autoscale decisions",
+            labels=("reason",),
+        )
+        # (namespace, name) -> monotonic time the signal first sat inside
+        # the scale-down band; cleared by any non-quiet scrape.
+        self._below_since: Dict[Tuple[str, str], float] = {}
+
+    # ------------- scrape -------------
+
+    def _scrape_http(self, addr: str) -> dict:
+        """One replica's engine load snapshot via its /healthz ("load"
+        key, ServingEngine.load). {} on any failure — an unreachable
+        replica contributes no signal rather than a fake zero."""
+        try:
+            with urllib.request.urlopen(
+                f"http://{addr}/healthz", timeout=self.health_timeout_s
+            ) as r:
+                body = json.load(r)
+        except Exception:  # noqa: BLE001 — scrape failure = no signal
+            return {}
+        load = body.get("load")
+        return load if isinstance(load, dict) else {}
+
+    # ------------- reconcile -------------
+
+    def reconcile(self, namespace: str, name: str) -> Result:
+        key = (namespace, name)
+        sv = self.api.try_get("Serving", name, namespace)
+        if sv is None or sv.metadata.deletion_timestamp is not None:
+            self._below_since.pop(key, None)
+            return Result()
+        a = sv.spec.autoscale
+        if a is None:
+            self._below_since.pop(key, None)
+            return Result()
+
+        lo = max(1, a.min_replicas)
+        hi = max(lo, a.max_replicas)
+        cur = max(1, sv.spec.replicas)
+
+        with self.tracer.span(
+            "autoscale.scrape",
+            attrs={"kind": "Serving", "namespace": namespace, "name": name,
+                   "endpoints": len(sv.status.endpoints)},
+        ) as scrape_span:
+            loads = [l for l in (self.scrape(ep)
+                                 for ep in sv.status.endpoints) if l]
+            wait = max(
+                (float(l.get("p95_queue_wait_s",
+                             l.get("p50_queue_wait_s", 0.0)))
+                 for l in loads), default=0.0)
+            queued = sum(int(l.get("queued", 0)) for l in loads)
+            scrape_span.attrs["replicas_reporting"] = len(loads)
+            scrape_span.attrs["p95_queue_wait_s"] = round(wait, 6)
+            scrape_span.attrs["queued"] = queued
+
+        want, reason = cur, ""
+        now = time.monotonic()
+        if cur < lo:
+            want, reason = lo, "min-replicas"
+        elif cur > hi:
+            want, reason = hi, "max-replicas"
+        elif loads and wait > a.target_queue_wait_s:
+            # Overload: proportional scale-up, at least one replica, now.
+            want = min(hi, max(
+                cur + 1,
+                int(math.ceil(cur * wait / a.target_queue_wait_s))))
+            reason = "queue-wait-above-target"
+            self._below_since.pop(key, None)
+        elif loads and wait < SCALE_DOWN_BAND * a.target_queue_wait_s \
+                and queued == 0:
+            # Quiet: start (or continue) the stabilization clock; only a
+            # full uninterrupted window earns ONE replica of scale-down.
+            since = self._below_since.setdefault(key, now)
+            if cur > lo and now - since >= self.scale_down_stabilization_s:
+                want, reason = cur - 1, "queue-wait-below-target"
+                self._below_since[key] = now   # window restarts per step
+        else:
+            # In-band (or no signal): neither direction, clock reset.
+            self._below_since.pop(key, None)
+
+        if want != cur:
+            with self.tracer.span(
+                "autoscale.decision",
+                attrs={"kind": "Serving", "namespace": namespace,
+                       "name": name, "from": cur, "to": want,
+                       "reason": reason,
+                       "p95_queue_wait_s": round(wait, 6),
+                       "queued": queued},
+                links=[scrape_span.context],
+            ):
+                live = self.api.try_get("Serving", name, namespace)
+                if live is None:
+                    return Result()
+                live.spec.replicas = want
+                self.api.update(live)
+            self.metrics_decisions.inc(abs(want - cur), reason=reason)
+            self.recorder.event(
+                sv, "Normal", "Scaled",
+                f"replicas {cur} -> {want} ({reason}, "
+                f"p95_queue_wait={wait:.3f}s target="
+                f"{a.target_queue_wait_s}s queued={queued})")
+            self.log.info("autoscale decision", kv={
+                "serving": f"{namespace}/{name}", "from": cur, "to": want,
+                "reason": reason, "p95_queue_wait_s": round(wait, 4)})
+
+        # Keep polling: latency pressure changes without API writes, so
+        # the controller re-arms its own scrape timer.
+        return Result(requeue_after=self.interval_s)

@@ -236,6 +236,19 @@ class ServingController(Controller):
                     "supported: '', 'int8'")
         if sv.spec.pipeline_depth < 0:
             return f"pipeline_depth must be >= 0, got {sv.spec.pipeline_depth}"
+        if sv.spec.max_queue < 0:
+            return f"max_queue must be >= 0, got {sv.spec.max_queue}"
+        a = sv.spec.autoscale
+        if a is not None:
+            if a.min_replicas < 1:
+                return (f"autoscale.min_replicas must be >= 1, "
+                        f"got {a.min_replicas}")
+            if a.max_replicas < a.min_replicas:
+                return (f"autoscale.max_replicas {a.max_replicas} < "
+                        f"min_replicas {a.min_replicas}")
+            if a.target_queue_wait_s <= 0:
+                return (f"autoscale.target_queue_wait_s must be > 0, "
+                        f"got {a.target_queue_wait_s}")
         if any(b <= 0 for b in sv.spec.prefill_buckets):
             return f"prefill_buckets must be positive: {sv.spec.prefill_buckets}"
         return ""
@@ -274,6 +287,13 @@ class ServingController(Controller):
             EnvVar("KFTPU_SERVING_MAX_LEN", str(sv.spec.max_len)),
             EnvVar("KFTPU_SERVING_DECODE_CHUNK", str(sv.spec.decode_chunk)),
         ]
+        # Bounded admission (ISSUE 7): the engine's queue cap rides the
+        # env contract so the replica sheds with 429 + Retry-After at
+        # spec.max_queue waiting requests — and /healthz reports the
+        # bound as the LB's saturation watermark. 0 = unbounded.
+        if sv.spec.max_queue:
+            env.append(EnvVar("KFTPU_SERVING_MAX_QUEUE",
+                              str(sv.spec.max_queue)))
         # Engine knobs ride the env contract only when set so existing
         # pods (and their drift contract) are untouched by the defaults.
         if sv.spec.quantize:

@@ -50,6 +50,7 @@ DEFAULT_COMPONENTS = (
     "profile-controller",
     "tensorboard-controller",
     "serving-controller",    # inference deployments (TF-Serving equivalent)
+    "serving-autoscaler",    # latency-driven replica scaling for Servings
     "poddefault-webhook",
     "kfam",
     "jupyter-web-app",       # L3 spawner REST backend
@@ -218,6 +219,19 @@ class Platform:
         elif name == "serving-controller":
             self.manager.register(ServingController(
                 self.api, reg, istio_gateway=cfg.spec.istio_gateway,
+            ))
+        elif name == "serving-autoscaler":
+            from kubeflow_tpu.controlplane.controllers import (
+                ServingAutoscaler,
+            )
+
+            # The platform's own tracer so autoscale.scrape/decision spans
+            # land next to the reconcile spans `tpuctl trace` renders.
+            self.manager.register(ServingAutoscaler(
+                self.api, reg, tracer=self.tracer,
+                interval_s=float(params.get("intervalSeconds", 10)),
+                scale_down_stabilization_s=float(
+                    params.get("scaleDownStabilizationSeconds", 60)),
             ))
         elif name == "poddefault-webhook":
             self.api.register_mutator(PodDefaultMutator(self.api))

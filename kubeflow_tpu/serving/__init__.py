@@ -8,6 +8,7 @@ batch, per-slot KV-cache indices, bucketed prefill compiles.
 """
 
 from kubeflow_tpu.serving.engine import (
+    EngineOverloaded,
     GenerationRequest,
     GenerationResult,
     ServingConfig,
@@ -17,6 +18,7 @@ from kubeflow_tpu.serving.lb import ServingLBServer, ServingLoadBalancer
 from kubeflow_tpu.serving.server import ServingServer
 
 __all__ = [
+    "EngineOverloaded",
     "GenerationRequest",
     "GenerationResult",
     "ServingConfig",

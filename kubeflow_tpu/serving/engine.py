@@ -46,8 +46,42 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from kubeflow_tpu.parallel.context import parallel_context
 from kubeflow_tpu.parallel.sharding import DEFAULT_RULES, Rules, param_shardings
 from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.utils.monitoring import (
+    MetricsRegistry,
+    global_registry,
+    nearest_rank_quantile,
+)
 
 log = get_logger("serving")
+
+#: Serving-path latency buckets (seconds): queue waits and TTFTs live in
+#: the 1ms–10s band on real chips (wider than the control-plane defaults,
+#: which top out at 5s — an overloaded queue wait must not saturate into
+#: +Inf before the load balancer can see it move).
+SERVING_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+#: Only queue waits observed inside this window feed the load() p50/p95:
+#: staleness past it means the engine is idle, not still overloaded.
+LOAD_WINDOW_S = 60.0
+
+
+class EngineOverloaded(RuntimeError):
+    """submit() refused: the request queue is at ``ServingConfig.max_queue``.
+
+    Bounded admission is the engine half of overload safety: a full queue
+    fails FAST at the front door (the server maps this to HTTP 429 +
+    Retry-After) instead of stacking unbounded work behind already-admitted
+    requests until every latency SLO is blown. ``retry_after_s`` is the
+    engine's own estimate of one queue-drain (recent p50 queue wait,
+    floored at 1s) — the honest backoff hint for clients."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = max(1.0, float(retry_after_s))
 
 
 @dataclasses.dataclass
@@ -115,6 +149,14 @@ class ServingConfig:
     # computed within it). Keeps the decode step ONE compiled program with
     # static shapes — the TPU answer to per-request dynamic vocab sorts.
     sample_candidates: int = 64
+    # Bounded admission: submit() raises EngineOverloaded once this many
+    # requests wait in the queue (0 = unbounded, the pre-PR-7 behaviour —
+    # benches that batch-submit their whole workload up front keep it).
+    # Production servers set a bound (Serving.spec.max_queue /
+    # KFTPU_SERVING_MAX_QUEUE): an unbounded queue converts overload into
+    # unbounded latency for EVERY request; a bounded one converts it into
+    # fast 429s for the excess only.
+    max_queue: int = 0
     # Per-token logprob reporting (GenerationResult.logprobs, the
     # /v1/generate "logprobs" field). OFF by default: the extra
     # logsumexp + gather gives the [B, V] decode logits extra consumers
@@ -244,6 +286,7 @@ class ServingEngine:
         *,
         mesh: Optional[Mesh] = None,
         rules: Rules = DEFAULT_RULES,
+        registry: MetricsRegistry = global_registry,
     ):
         if model.cfg.max_seq_len < cfg.max_len:
             raise ValueError(
@@ -275,6 +318,36 @@ class ServingEngine:
         self._results: Dict[int, GenerationResult] = {}
         self._req_ids = itertools.count()
         self._rng = jax.random.PRNGKey(0)
+        # Serving-path observability (kftpu_serving_*): queue wait
+        # (submit→admission), TTFT (submit→first token) and per-token
+        # decode time land in shared registry histograms for scraping;
+        # a small PER-ENGINE ring of recent queue waits backs load()
+        # percentiles so two engines in one process (tests, multi-replica
+        # benches) never read each other's tail.
+        self.registry = registry
+        self.metrics_queue_wait = registry.histogram(
+            "kftpu_serving_queue_wait_seconds",
+            "Request wait between submit and slot admission",
+            buckets=SERVING_LATENCY_BUCKETS,
+        )
+        self.metrics_ttft = registry.histogram(
+            "kftpu_serving_ttft_seconds",
+            "Time to first generated token (includes queue wait)",
+            buckets=SERVING_LATENCY_BUCKETS,
+        )
+        self.metrics_per_token = registry.histogram(
+            "kftpu_serving_per_token_seconds",
+            "Mean decode time per generated token after the first",
+            buckets=SERVING_LATENCY_BUCKETS,
+        )
+        self.metrics_requests = registry.counter(
+            "kftpu_serving_requests_total",
+            "Engine admission outcomes",
+            labels=("outcome",),
+        )
+        # (monotonic ts, wait) pairs; see _queue_wait_quantile's window.
+        self._recent_queue_waits: Deque[tuple] = collections.deque(maxlen=256)
+        self.shed_total = 0
 
         # Accept params straight from model.init (boxed with flax logical-
         # partitioning metadata), already-unboxed trees, or a zero-arg
@@ -493,6 +566,18 @@ class ServingEngine:
                 f"prompt length {len(prompt)} > limit {limit} "
                 f"(max_len {self.cfg.max_len} needs one decode slot)"
             )
+        # Bounded admission AFTER validation (a rejected-invalid request
+        # is a 400, not engine pressure) and BEFORE the queue append, so
+        # an overflow can never disturb already-admitted work.
+        if self.cfg.max_queue and len(self._queue) >= self.cfg.max_queue:
+            self.shed_total += 1
+            self.metrics_requests.inc(outcome="shed")
+            raise EngineOverloaded(
+                f"engine queue full ({len(self._queue)}/"
+                f"{self.cfg.max_queue} waiting)",
+                retry_after_s=self._queue_wait_quantile(0.5) or 1.0,
+            )
+        self.metrics_requests.inc(outcome="admitted")
         self._queue.append(GenerationRequest(
             prompt=list(prompt), request_id=rid, submitted_at=time.time(), **kw
         ))
@@ -569,6 +654,36 @@ class ServingEngine:
     @property
     def queued(self) -> int:
         return len(self._queue)
+
+    def _queue_wait_quantile(self, q: float) -> float:
+        """q-quantile of THIS engine's recent queue waits (the load()
+        ring, not the registry histogram — which may be shared by other
+        engines in-process). Entries older than ``LOAD_WINDOW_S`` are
+        ignored: without the window an idle engine would report its last
+        burst's tail forever, and the autoscaler — whose scale-down
+        branch needs the signal to go quiet — could never release the
+        replicas the burst bought. 0.0 with no recent observations."""
+        cutoff = time.monotonic() - LOAD_WINDOW_S
+        waits = [w for t, w in self._recent_queue_waits if t >= cutoff]
+        return nearest_rank_quantile(waits, q)
+
+    def load(self) -> dict:
+        """Point-in-time load snapshot: what /healthz exposes so the load
+        balancer's health checks double as load reports (queue-depth-aware
+        dispatch + shedding) and the ServingAutoscaler can actuate on
+        queue-wait pressure. Reads are GIL-atomic ints/deque snapshots —
+        safe from HTTP threads while the driver thread runs the engine."""
+        active = self.active_slots
+        return {
+            "queued": len(self._queue),
+            "active_slots": active,
+            "free_slots": self.cfg.max_batch - active,
+            "max_batch": self.cfg.max_batch,
+            "max_queue": self.cfg.max_queue,
+            "shed_total": self.shed_total,
+            "p50_queue_wait_s": round(self._queue_wait_quantile(0.5), 6),
+            "p95_queue_wait_s": round(self._queue_wait_quantile(0.95), 6),
+        }
 
     def warmup(self, prompt_len: int) -> None:
         """Compile-and-execute the decode step and every k-bucket prefill
@@ -657,11 +772,15 @@ class ServingEngine:
         # collapses up-to-max_batch host->device round trips into one —
         # the dominant prefill cost through a remote/tunneled TPU.
         admissions: List[tuple] = []   # (slot_idx, req)
+        now = time.time()
         for i, slot in enumerate(self._slots):
             if slot is not None or not self._queue:
                 continue
             req = self._queue.popleft()
             self._slots[i] = _Slot(req)
+            wait = max(0.0, now - req.submitted_at)
+            self.metrics_queue_wait.observe(wait)
+            self._recent_queue_waits.append((time.monotonic(), wait))
             admissions.append((i, req))
         by_bucket: Dict[int, List[tuple]] = {}
         for i, req in admissions:
@@ -1148,6 +1267,13 @@ class ServingEngine:
         done_cap = slot.pos >= self.cfg.max_len - 1
         if done_eos or done_len or done_cap:
             now = time.time()
+            ttft = (slot.first_token_at or now) - req.submitted_at
+            self.metrics_ttft.observe(max(0.0, ttft))
+            if len(slot.generated) > 1 and slot.first_token_at is not None:
+                self.metrics_per_token.observe(
+                    max(0.0, now - slot.first_token_at)
+                    / (len(slot.generated) - 1)
+                )
             self._results[req.request_id] = GenerationResult(
                 request_id=req.request_id,
                 tokens=list(slot.generated),

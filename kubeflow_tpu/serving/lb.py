@@ -8,12 +8,25 @@ serving, where one request can hold a stream open for seconds while
 another finishes in milliseconds — so the platform ships an L7 balancer
 that dispatches on live per-replica load:
 
-- **Least-loaded dispatch**: each backend tracks in-flight requests; a new
-  request goes to the healthy, non-draining backend with the fewest.
+- **Queue-depth-aware dispatch**: each backend tracks LB-side in-flight
+  requests AND the engine-side load snapshot its ``/healthz`` reports
+  (queued, free slots, max_queue — see ServingEngine.load): a new request
+  goes to the healthy, non-draining backend with the lowest
+  in_flight + reported-queue score.
+- **Load shedding**: once EVERY live backend is past its depth watermark
+  (estimated engine queue >= its reported ``max_queue`` bound, or the
+  LB-level ``queue_watermark`` override), new requests shed with 503 +
+  Retry-After instead of stacking timeouts behind saturated engines —
+  goodput-first overload handling: the work already admitted finishes
+  inside its SLO, the excess fails fast with an honest backoff hint.
 - **Health**: a failed dispatch marks the backend unhealthy immediately;
   ``health_check()`` (called by the background loop and on demand) probes
   ``/healthz`` to recover it. No healthy backend -> 503, the signal the
   availability prober and clients retry on.
+- **Circuit breaking**: ``failure_threshold`` consecutive transport
+  failures open a per-backend circuit for ``breaker_cooldown_s`` — the
+  backend is held out of dispatch even if a probe succeeds mid-window, so
+  a flapping replica can't absorb (and fail) a retry storm.
 - **Drain on scale-down**: ``set_backends`` never yanks a live backend —
   a removed address stops receiving NEW requests and is dropped once its
   in-flight count reaches zero. Pairs with the Serving controller, which
@@ -55,10 +68,43 @@ class Backend:
         self.draining = False
         self.last_error = ""
         self.requests_total = 0
+        # Engine load snapshot from the last /healthz report (see
+        # ServingEngine.load): the queue-aware half of dispatch.
+        self.queued = 0                     # reported engine queue depth
+        self.free_slots = 0
+        self.max_queue = 0                  # reported admission bound
+        self.p50_queue_wait_s = 0.0
+        self.has_load_report = False
+        # Requests dispatched since that report: the live correction to
+        # the stale snapshot (each one is presumed to land in the
+        # engine's queue/slots until the next report re-baselines).
+        self.sent_since_report = 0
+        # Circuit breaker state.
+        self.consecutive_failures = 0
+        self.circuit_open_until = 0.0       # monotonic deadline
 
     @property
     def url(self) -> str:
         return f"http://{self.addr}"
+
+    def score(self) -> int:
+        """Dispatch preference: live LB in-flight plus last-reported
+        engine queue — lower is better."""
+        return self.in_flight + self.queued
+
+    def saturated(self, watermark_override: Optional[int]) -> bool:
+        """Past the depth watermark: the estimated engine queue (last
+        report + requests sent since) has consumed both the reported free
+        slots and the admission bound. Backends that never reported load
+        (stubs, pre-PR-7 servers) have no watermark and never saturate —
+        shedding activates only on load-reporting fleets."""
+        watermark = watermark_override
+        if watermark is None:
+            watermark = self.max_queue if self.has_load_report else 0
+        if watermark <= 0:
+            return False
+        est_queue = self.queued + self.sent_since_report
+        return est_queue >= watermark + self.free_slots
 
     def snapshot(self) -> dict:
         return {
@@ -68,6 +114,12 @@ class Backend:
             "in_flight": self.in_flight,
             "requests_total": self.requests_total,
             "last_error": self.last_error,
+            "queued": self.queued,
+            "free_slots": self.free_slots,
+            "max_queue": self.max_queue,
+            "sent_since_report": self.sent_since_report,
+            "consecutive_failures": self.consecutive_failures,
+            "circuit_open": time.monotonic() < self.circuit_open_until,
         }
 
 
@@ -83,6 +135,9 @@ class ServingLoadBalancer:
         request_timeout_s: float = 300.0,
         health_timeout_s: float = 2.0,
         retry_after_s: Optional[float] = None,
+        queue_watermark: Optional[int] = None,
+        failure_threshold: int = 3,
+        breaker_cooldown_s: float = 5.0,
     ):
         self.connect_timeout_s = connect_timeout_s
         self.request_timeout_s = request_timeout_s
@@ -92,6 +147,16 @@ class ServingLoadBalancer:
         # derives it from its sync interval; standalone use defaults to
         # the health probe timeout.
         self.retry_after_s = retry_after_s
+        # Shed watermark override: None derives each backend's watermark
+        # from its reported max_queue (the engine's own admission bound);
+        # an int forces one LB-level depth cap per backend.
+        self.queue_watermark = queue_watermark
+        # Circuit breaker: this many CONSECUTIVE transport failures hold
+        # the backend out of dispatch for the cooldown, probe or no probe.
+        self.failure_threshold = max(1, failure_threshold)
+        self.breaker_cooldown_s = breaker_cooldown_s
+        self.shed_total = 0                 # saturation 503s served
+        self.breaker_trips = 0
         self._backends: Dict[str, Backend] = {}
         self._lock = threading.Lock()
         if backends:
@@ -132,31 +197,53 @@ class ServingLoadBalancer:
 
     # ------------- dispatch -------------
 
-    def _retry_after(self) -> str:
+    def _retry_after(self, drain_estimate_s: float = 0.0) -> str:
         """Retry-After seconds (integer, >= 1) derived from the
         health-check cadence — clients back off for one recovery window
-        instead of hammering."""
+        instead of hammering. Saturation sheds pass the backends' own
+        queue-drain estimate, which wins when it is the longer wait."""
         interval = self.retry_after_s
         if interval is None:
             interval = self.health_timeout_s
-        return str(max(1, int(math.ceil(interval))))
+        return str(max(1, int(math.ceil(max(interval, drain_estimate_s)))))
 
     def _acquire(self) -> Backend:
         with self._lock:
+            now = time.monotonic()
             live = [b for b in self._backends.values()
-                    if b.healthy and not b.draining]
+                    if b.healthy and not b.draining
+                    and now >= b.circuit_open_until]
             if not live:
                 raise RestError(503, "no healthy serving backend",
                                 headers={"Retry-After": self._retry_after()})
-            b = min(live, key=lambda b: b.in_flight)
+            ready = [b for b in live
+                     if not b.saturated(self.queue_watermark)]
+            if not ready:
+                # Every live backend is past its depth watermark: shed.
+                # Admitted work keeps its SLO; the excess fails fast with
+                # the backends' own queue-drain estimate as the backoff.
+                self.shed_total += 1
+                drain = max(
+                    (b.p50_queue_wait_s for b in live), default=0.0)
+                raise RestError(
+                    503, "all serving backends saturated; shedding",
+                    headers={"Retry-After": self._retry_after(drain)})
+            b = min(ready, key=lambda b: b.score())
             b.in_flight += 1
+            b.sent_since_report += 1
             b.requests_total += 1
             return b
 
     def _release(self, b: Backend) -> None:
         with self._lock:
-            b.in_flight -= 1
-            if b.draining and b.in_flight == 0:
+            if b.in_flight > 0:
+                b.in_flight -= 1
+            # Identity check before popping: a STALE release (a handle
+            # acquired before this address was dropped and re-added)
+            # must never delete the new, healthy Backend that now owns
+            # the address — only the exact draining object it holds.
+            if (b.draining and b.in_flight == 0
+                    and self._backends.get(b.addr) is b):
                 self._backends.pop(b.addr, None)
                 log.info("drained backend", kv={"addr": b.addr})
 
@@ -164,7 +251,24 @@ class ServingLoadBalancer:
         with self._lock:
             b.healthy = False
             b.last_error = err
+            b.consecutive_failures += 1
+            tripped = b.consecutive_failures >= self.failure_threshold
+            if tripped:
+                b.circuit_open_until = (
+                    time.monotonic() + self.breaker_cooldown_s)
+                self.breaker_trips += 1
         log.warning("backend unhealthy", kv={"addr": b.addr, "err": err})
+        if tripped:
+            log.warning("backend circuit opened", kv={
+                "addr": b.addr, "failures": b.consecutive_failures,
+                "cooldown_s": self.breaker_cooldown_s})
+
+    def _mark_ok(self, b: Backend) -> None:
+        """A successful dispatch closes the failure streak (and any open
+        circuit ends at its deadline, not early — a lone success inside
+        the cooldown shouldn't re-arm a flapping backend)."""
+        with self._lock:
+            b.consecutive_failures = 0
 
     def set_backend_health(self, addr: str, healthy: bool,
                            err: str = "") -> bool:
@@ -180,8 +284,11 @@ class ServingLoadBalancer:
         return True
 
     def health_check(self) -> int:
-        """Probe every backend's /healthz; flips healthy both ways.
-        Returns the number of healthy backends."""
+        """Probe every backend's /healthz; flips healthy both ways and
+        ingests the engine load snapshot each report carries (the
+        queue-aware dispatch input). Returns the number of healthy
+        backends. A backend whose circuit is open stays OUT of dispatch
+        until the cooldown passes even when its probe succeeds."""
         with self._lock:
             snapshot = list(self._backends.values())
         n = 0
@@ -190,16 +297,27 @@ class ServingLoadBalancer:
                 with urllib.request.urlopen(
                     f"{b.url}/healthz", timeout=self.health_timeout_s
                 ) as r:
-                    ok = bool(json.load(r).get("ok"))
+                    body = json.load(r)
+                    ok = bool(body.get("ok"))
             except Exception as e:  # noqa: BLE001 — any failure = unhealthy
                 with self._lock:
                     b.healthy = False
                     b.last_error = repr(e)
                 continue
+            load = body.get("load") or {}
             with self._lock:
                 b.healthy = ok
                 if ok:
                     b.last_error = ""
+                if isinstance(load, dict) and load:
+                    b.queued = int(load.get("queued", 0))
+                    b.free_slots = int(load.get("free_slots", 0))
+                    b.max_queue = int(load.get("max_queue", 0))
+                    b.p50_queue_wait_s = float(
+                        load.get("p50_queue_wait_s", 0.0))
+                    b.has_load_report = True
+                    # Fresh report re-baselines the stale-window estimate.
+                    b.sent_since_report = 0
             n += ok
         return n
 
@@ -227,13 +345,25 @@ class ServingLoadBalancer:
                 )
             except urllib.error.HTTPError as e:
                 # Upstream spoke HTTP: the backend is alive; relay the
-                # application error (400 bad prompt etc.) untouched.
+                # application error (400 bad prompt, 429 engine
+                # admission) untouched — Retry-After included, so an
+                # engine-level shed keeps its backoff hint through the LB.
                 payload = e.read()
                 self._release(b)
+                self._mark_ok(b)
                 try:
-                    return e.code, json.loads(payload)
+                    body = json.loads(payload)
                 except json.JSONDecodeError:
-                    return e.code, {"error": payload.decode(errors="replace")}
+                    body = {"error": payload.decode(errors="replace")}
+                retry = (e.headers.get("Retry-After")
+                         if e.headers is not None else None)
+                if retry:
+                    raise RestError(
+                        e.code,
+                        str(body.get("error", body)) if isinstance(body, dict)
+                        else str(body),
+                        headers={"Retry-After": retry})
+                return e.code, body
             except Exception as e:  # noqa: BLE001 — connect/transport error
                 self._mark_unhealthy(b, repr(e))
                 self._release(b)
@@ -242,6 +372,7 @@ class ServingLoadBalancer:
                                          f"(last: {b.addr}: {e!r})")
                 continue
             if stream:
+                self._mark_ok(b)
                 return NdjsonStream(self._relay_stream(b, resp))
             try:
                 out = json.load(resp)
@@ -251,6 +382,7 @@ class ServingLoadBalancer:
             finally:
                 resp.close()
                 self._release(b)
+            self._mark_ok(b)
             return out
 
     def _relay_stream(self, b: Backend, resp):
@@ -288,8 +420,14 @@ class ServingLoadBalancer:
 
     def _healthz(self, req: Request):
         backs = self.backends()
-        ok = any(b["healthy"] and not b["draining"] for b in backs)
-        payload = {"ok": ok, "backends": backs}
+        # A backend with an open circuit is out of dispatch no matter
+        # what its probe says — an all-circuits-open fleet serves nothing
+        # and must NOT report a green front door.
+        ok = any(b["healthy"] and not b["draining"]
+                 and not b["circuit_open"] for b in backs)
+        payload = {"ok": ok, "backends": backs,
+                   "shed_total": self.shed_total,
+                   "breaker_trips": self.breaker_trips}
         return payload if ok else (503, payload)
 
     def router(self) -> Router:

@@ -36,7 +36,11 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
-from kubeflow_tpu.serving.engine import ServingConfig, ServingEngine
+from kubeflow_tpu.serving.engine import (
+    EngineOverloaded,
+    ServingConfig,
+    ServingEngine,
+)
 from kubeflow_tpu.utils import get_logger
 from kubeflow_tpu.webapps.router import (
     JsonHttpServer,
@@ -112,6 +116,14 @@ class ServingServer:
                         rid = self.engine.submit(prompt, **kw)
                         holder["rid"] = rid
                         self._events[rid] = ev
+                    except EngineOverloaded as e:
+                        # Bounded admission: overload is NOT a client
+                        # error — surface 429 + Retry-After so clients
+                        # back off for one queue-drain instead of
+                        # hammering a full queue.
+                        holder["overloaded"] = str(e)
+                        holder["retry_after_s"] = e.retry_after_s
+                        ev.set()
                     except ValueError as e:
                         holder["error"] = str(e)
                         ev.set()
@@ -182,12 +194,14 @@ class ServingServer:
             self._submissions.put((tokens, kw, holder, ev))
             if not holder["submitted"].wait(self.request_timeout_s):
                 raise RestError(504, "generation timed out")
+            self._raise_if_overloaded(holder)
             if "error" in holder:
                 raise RestError(400, holder["error"])
             return NdjsonStream(self._stream_chunks(holder["rid"], ev))
         self._submissions.put((tokens, kw, holder, ev))
         if not ev.wait(self.request_timeout_s):
             raise RestError(504, "generation timed out")
+        self._raise_if_overloaded(holder)
         if "error" in holder:
             raise RestError(400, holder["error"])
         res = self.engine.result(holder["rid"])
@@ -205,6 +219,20 @@ class ServingServer:
         if self.tokenizer is not None:
             out["text"] = self.tokenizer.decode(res.tokens)
         return out
+
+    @staticmethod
+    def _raise_if_overloaded(holder: Dict[str, Any]) -> None:
+        """EngineOverloaded → HTTP 429 with Retry-After (integer seconds,
+        >= 1): the engine's own queue-drain estimate, so shed clients back
+        off for one recovery window instead of retrying into the same
+        full queue."""
+        if "overloaded" not in holder:
+            return
+        import math
+
+        retry = max(1, int(math.ceil(holder.get("retry_after_s", 1.0))))
+        raise RestError(429, holder["overloaded"],
+                        headers={"Retry-After": str(retry)})
 
     def _stream_chunks(self, rid: int, ev: threading.Event):
         """NDJSON token streaming: emits {"tokens": [...]} deltas as the
@@ -269,6 +297,10 @@ class ServingServer:
             "active": self.engine.active_slots,
             "queued": self.engine.queued,
             "tokens_generated": self.engine.tokens_generated,
+            # Load snapshot: the LB's health checks double as load
+            # reports (queue-depth-aware dispatch + shedding) and the
+            # ServingAutoscaler scrapes the queue-wait percentiles.
+            "load": self.engine.load(),
         }
         if self.error:
             payload["error"] = self.error
@@ -289,6 +321,9 @@ def env_config() -> dict:
         "host": os.environ.get("KFTPU_SERVING_HOST", "0.0.0.0"),
         "max_batch": int(os.environ.get("KFTPU_SERVING_MAX_BATCH", "8")),
         "max_len": int(os.environ.get("KFTPU_SERVING_MAX_LEN", "1024")),
+        # Bounded admission (0 = unbounded): the controller injects the
+        # Serving.spec.max_queue bound here.
+        "max_queue": int(os.environ.get("KFTPU_SERVING_MAX_QUEUE", "0")),
         "decode_chunk": int(
             os.environ.get("KFTPU_SERVING_DECODE_CHUNK", "8")),
         # Engine compute/memory knobs (ServingConfig): int8 weight-only
@@ -426,6 +461,8 @@ def build_server(cfg: dict) -> ServingServer:
             )["params"]}
     scfg_kw = dict(max_batch=cfg["max_batch"], max_len=cfg["max_len"],
                    decode_chunk=cfg["decode_chunk"])
+    if cfg.get("max_queue"):
+        scfg_kw["max_queue"] = cfg["max_queue"]
     if cfg.get("quantize"):
         scfg_kw["quantize"] = cfg["quantize"]
     if cfg.get("param_dtype"):

@@ -40,7 +40,13 @@ Stages (any failure exits non-zero — the merge gate contract):
    sweep; assert the exposition parses (histograms included) and that
    one reconcile span + one histogram observation exists per reconcile
    executed — count-based, no wall-clock flake (docs/observability.md).
-8. **bench-gate**: if --bench-json is given, require
+8. **serve-bench-smoke** / **serving-soak-smoke**: the serving data
+   plane under 2x open-loop overload (ISSUE 7) — request accounting sums
+   exactly (ok + shed + timeouts + errors == offered), every shed carries
+   Retry-After, the ServingAutoscaler reaches max_replicas; then the
+   seeded drain/flap soak — zero requests routed to draining/unhealthy
+   backends (``--skip-serve``).
+9. **bench-gate**: if --bench-json is given, require
    ``vs_baseline >= --min-vs-baseline`` for every record — the perf
    regression gate SURVEY §7.8 prescribes.
 """
@@ -200,6 +206,77 @@ def run_shard_smoke(seed: int = 20260803, shards: int = 2) -> None:
         )
 
 
+def run_serve_bench_smoke(rate_qps: float = 60.0,
+                          duration_s: float = 2.0) -> None:
+    """Serving data-plane smoke (ISSUE 7): a small open-loop run at ~2x
+    the starting replica's capacity with shedding + the REAL
+    ServingAutoscaler in the loop. Gates are counts, never wall-clock:
+
+    - **request accounting**: ok + shed + timeouts + errors == offered —
+      no request lost or double-counted;
+    - **honest shedding**: every shed response carried Retry-After;
+    - **actuation**: the autoscaler reached max_replicas (the latency
+      signal at 2x overload must drive scale-up) and goodput is non-zero;
+    - **no timeout churn**: with shedding on, zero client timeouts — the
+      no-shed failure mode must not reappear.
+    """
+    from kubeflow_tpu.tools.loadtest import run_serve_bench
+
+    rep = run_serve_bench(
+        rate_qps=rate_qps, duration_s=duration_s,
+        replicas=1, max_replicas=2, max_batch=2, max_queue=4,
+        service_time_s=0.05, shed=True, autoscale=True,
+        # Well below the inevitable slot wait at 2x overload (~one
+        # service time): watermark shedding keeps the queue SHORT, so a
+        # target near the equilibrium wait would make scale-up a coin
+        # flip; this smoke asserts the loop closes, not a threshold.
+        target_queue_wait_s=0.02, client_timeout_s=2.0,
+    )
+    if not rep["accounting_ok"]:
+        raise GateFailure(
+            f"serve-bench-smoke: request accounting broken — offered "
+            f"{rep['offered']} != ok {rep['ok']} + shed {rep['shed']} + "
+            f"timeouts {rep['timeouts']} + errors {rep['errors']}"
+        )
+    if rep["errors"]:
+        raise GateFailure(
+            f"serve-bench-smoke: {rep['errors']} non-shed errors")
+    if rep["timeouts"]:
+        raise GateFailure(
+            f"serve-bench-smoke: {rep['timeouts']} client timeouts with "
+            "shedding ON — overload is leaking past admission control"
+        )
+    if rep["shed_with_retry_after"] != rep["shed"]:
+        raise GateFailure(
+            f"serve-bench-smoke: {rep['shed'] - rep['shed_with_retry_after']}"
+            f" of {rep['shed']} shed responses missing Retry-After"
+        )
+    if rep["replicas_end"] != rep["max_replicas"]:
+        raise GateFailure(
+            f"serve-bench-smoke: autoscaler stopped at "
+            f"{rep['replicas_end']}/{rep['max_replicas']} replicas under "
+            "2x overload — the observe->actuate loop is not closing"
+        )
+    if rep["ok"] == 0:
+        raise GateFailure("serve-bench-smoke: zero goodput")
+
+
+def run_serving_soak_smoke(seed: int = 20260803) -> None:
+    """Drain-path chaos smoke: backends flap/drain/saturate mid-traffic
+    while the LB sheds; fails on any request routed to a draining or
+    unhealthy backend, any shed without Retry-After, or lost requests."""
+    from kubeflow_tpu.chaos import run_serving_soak
+
+    rep = run_serving_soak(seed=seed)
+    if not rep.clean:
+        raise GateFailure(
+            f"serving-soak-smoke (seed={seed}): misrouted={rep.misrouted} "
+            f"errors={rep.errors} shed={rep.shed} "
+            f"shed_with_retry_after={rep.shed_with_retry_after} "
+            f"sent={rep.sent} ok={rep.ok}"
+        )
+
+
 def run_cp_bench_smoke(num_jobs: int = 50, num_namespaces: int = 5,
                        workers: int = 4, shards: int = 2) -> None:
     """Small control-plane sweep gated on the deterministic copy counter:
@@ -277,7 +354,8 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
              chaos_workers: int = 4,
              skip_cp_bench: bool = False,
              skip_obs: bool = False,
-             skip_shard: bool = False) -> List[str]:
+             skip_shard: bool = False,
+             skip_serve: bool = False) -> List[str]:
     """Run all stages; returns the list of passed stages, raises
     GateFailure on the first failing one."""
     passed: List[str] = []
@@ -375,6 +453,14 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
         run_obs_smoke()
         passed.append("obs-smoke")
 
+    if not skip_serve:
+        _stage("serve-bench-smoke")
+        run_serve_bench_smoke()
+        passed.append("serve-bench-smoke")
+        _stage("serving-soak-smoke")
+        run_serving_soak_smoke(seed=chaos_seed)
+        passed.append("serving-soak-smoke")
+
     if bench_json:
         _stage("bench-gate")
         with open(bench_json) as f:
@@ -420,6 +506,9 @@ def main(argv=None) -> int:
                    help="skip the observability scrape/trace smoke")
     g.add_argument("--skip-shard", action="store_true",
                    help="skip the sharded-control-plane kill/replay smoke")
+    g.add_argument("--skip-serve", action="store_true",
+                   help="skip the serving data-plane open-loop bench and "
+                        "drain-path soak smokes")
     args = p.parse_args(argv)
     try:
         passed = run_gate(
@@ -433,6 +522,7 @@ def main(argv=None) -> int:
             skip_cp_bench=args.skip_cp_bench,
             skip_obs=args.skip_obs,
             skip_shard=args.skip_shard,
+            skip_serve=args.skip_serve,
         )
     except GateFailure as e:
         print(f"[ci] FAIL: {e}", file=sys.stderr)

@@ -11,6 +11,13 @@ and cheap enough to pin in tests (tests/test_loadtest.py).
 Usage:
   python -m kubeflow_tpu.tools.loadtest --notebooks 500 --jobs 100
 Prints one JSON line: objects, wall seconds, objects/sec, reconcile loops.
+
+ISSUE 7 adds the serving DATA-plane side: ``run_serve_bench`` (and
+``--serve``) drives an open-loop fixed-arrival-rate generator through
+the real ServingLoadBalancer over ``SimServingReplica`` HTTP doubles —
+optionally with the real ServingAutoscaler actuating a Serving CR —
+reporting goodput, shed rate, and p50/p95/p99 latency with exact
+request accounting (docs/serving-perf.md).
 """
 
 from __future__ import annotations
@@ -18,7 +25,7 @@ from __future__ import annotations
 import argparse
 import json
 import time
-from typing import Dict
+from typing import Dict, List
 
 from kubeflow_tpu.controlplane.api import (
     Notebook,
@@ -196,6 +203,339 @@ def run_serving_lb_load(
     }
 
 
+class SimServingReplica:
+    """One serving replica as an HTTP process double: ServingEngine's
+    admission semantics (``max_batch`` concurrent slots, a bounded wait
+    queue that sheds with 429 + Retry-After at ``max_queue``, /healthz
+    carrying the ``ServingEngine.load()`` snapshot shape) over a
+    deterministic synthetic engine — every admitted request costs exactly
+    ``service_time_s`` of slot time. That makes capacity analytic
+    (``max_batch / service_time_s`` QPS per replica), so the open-loop
+    bench can assert goodput against a known ceiling instead of a
+    hardware-dependent measurement, and no JAX/model load is needed to
+    drive the data plane at 2x overload in CI."""
+
+    def __init__(self, *, max_batch: int = 2, max_queue: int = 8,
+                 service_time_s: float = 0.05):
+        import collections
+        import threading as _threading
+
+        from kubeflow_tpu.webapps.router import (
+            JsonHttpServer,
+            Request,
+            RestError,
+            Router,
+        )
+
+        self.max_batch = max_batch
+        self.max_queue = max_queue
+        self.service_time_s = service_time_s
+        self._lock = _threading.Lock()
+        self._slots = _threading.Semaphore(max_batch)
+        self._queued = 0                 # admitted, waiting for a slot
+        self._active = 0                 # holding a slot
+        self.served = 0
+        self.shed = 0                    # engine-level 429s
+        self._waits = collections.deque(maxlen=256)
+
+        def generate(q: Request):
+            t0 = time.monotonic()
+            with self._lock:
+                # Bounded admission BEFORE joining the queue, exactly like
+                # ServingEngine.submit: overflow sheds fast with the
+                # engine's own drain estimate as the backoff hint.
+                if self.max_queue and self._queued >= self.max_queue:
+                    self.shed += 1
+                    raise RestError(
+                        429, "engine queue full",
+                        headers={"Retry-After": str(max(
+                            1, int(self.max_queue * self.service_time_s
+                                   / max(1, self.max_batch) + 1)))})
+                self._queued += 1
+            self._slots.acquire()
+            with self._lock:
+                self._queued -= 1
+                self._active += 1
+                self._waits.append(time.monotonic() - t0)
+            try:
+                time.sleep(self.service_time_s)
+            finally:
+                with self._lock:
+                    self._active -= 1
+                    self.served += 1
+                self._slots.release()
+            return {"tokens": [1]}
+
+        def healthz(q: Request):
+            return {"ok": True, "load": self.load()}
+
+        r = Router()
+        r.post("/v1/generate", generate)
+        r.get("/healthz", healthz)
+        self._srv = JsonHttpServer(r, port=0).start()
+        self.addr = f"127.0.0.1:{self._srv.port}"
+
+    def _quantile(self, q: float) -> float:
+        from kubeflow_tpu.utils.monitoring import nearest_rank_quantile
+
+        return nearest_rank_quantile(list(self._waits), q)
+
+    def load(self) -> dict:
+        """The ServingEngine.load() shape: what the LB's health checks
+        ingest for queue-aware dispatch and the autoscaler scrapes."""
+        with self._lock:
+            return {
+                "queued": self._queued,
+                "active_slots": self._active,
+                "free_slots": max(0, self.max_batch - self._active),
+                "max_batch": self.max_batch,
+                "max_queue": self.max_queue,
+                "shed_total": self.shed,
+                "p50_queue_wait_s": round(self._quantile(0.5), 6),
+                "p95_queue_wait_s": round(self._quantile(0.95), 6),
+            }
+
+    def stop(self) -> None:
+        self._srv.stop()
+
+
+def run_serve_bench(
+    *,
+    rate_qps: float = 80.0,
+    duration_s: float = 2.0,
+    replicas: int = 1,
+    max_replicas: int = 1,
+    max_batch: int = 2,
+    max_queue: int = 6,
+    service_time_s: float = 0.05,
+    shed: bool = True,
+    autoscale: bool = False,
+    target_queue_wait_s: float = 0.08,
+    scrape_interval_s: float = 0.15,
+    client_timeout_s: float = 1.5,
+) -> Dict[str, float]:
+    """Open-loop serving bench: fixed-ARRIVAL-rate traffic (requests fire
+    on schedule whether or not earlier ones finished — the "millions of
+    users" model; a closed loop self-throttles and hides overload) through
+    the real ServingLoadBalancer over ``SimServingReplica`` backends, with
+    the REAL ``ServingAutoscaler`` reconciling a Serving CR when
+    ``autoscale=True`` (the bench stands in for ServingController+kubelet:
+    it starts a sim replica per spec.replicas increment and republishes
+    status.endpoints).
+
+    Three configurations answer the overload question:
+
+    - ``shed=False``: the pre-ISSUE-7 data plane (unbounded engine queues,
+      no watermark) — at 2x capacity every queue grows without bound and
+      requests die as client timeouts (goodput collapse, unbounded p99).
+    - ``shed=True``: bounded admission + LB saturation shedding — admitted
+      work keeps a bounded p99; the excess fails FAST with Retry-After.
+    - ``shed=True, autoscale=True``: shedding buys the time, the
+      autoscaler buys the capacity — goodput climbs toward offered load
+      as replicas scale to ``max_replicas``.
+
+    Every client outcome is counted exactly once (ok / shed / timeout /
+    error), so ``accounting_ok`` is a count-based CI gate: offered ==
+    ok + shed + timeouts + errors, no request lost or double-counted.
+    """
+    import queue as _queuemod
+    import socket
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from kubeflow_tpu.serving.lb import ServingLoadBalancer
+    from kubeflow_tpu.webapps.router import JsonHttpServer
+
+    sims: List[SimServingReplica] = []
+    sims_lock = threading.Lock()
+
+    def add_replica() -> SimServingReplica:
+        sim = SimServingReplica(
+            max_batch=max_batch,
+            max_queue=max_queue if shed else 0,
+            service_time_s=service_time_s)
+        with sims_lock:
+            sims.append(sim)
+        return sim
+
+    for _ in range(replicas):
+        add_replica()
+
+    lb = ServingLoadBalancer(
+        [s.addr for s in sims],
+        retry_after_s=scrape_interval_s,
+        # shed=False also disables the LB watermark: the pure pre-ISSUE-7
+        # baseline (backends report max_queue=0, so None would already
+        # never saturate — this just makes the contract explicit).
+        queue_watermark=None if shed else 0,
+    )
+    front = JsonHttpServer(lb.router(), port=0).start()
+    url = f"http://127.0.0.1:{front.port}/v1/generate"
+
+    # --- the real autoscaler against a real Serving CR ----------------
+    api = autoscaler = None
+    ns, name = "bench", "serve"
+    if autoscale:
+        from kubeflow_tpu.controlplane.api import (
+            AutoscaleSpec,
+            ObjectMeta,
+            Serving,
+            ServingSpec,
+        )
+        from kubeflow_tpu.controlplane.controllers import ServingAutoscaler
+        from kubeflow_tpu.controlplane.runtime import InMemoryApiServer
+        from kubeflow_tpu.utils.monitoring import MetricsRegistry
+        from kubeflow_tpu.utils.tracing import Tracer
+
+        api = InMemoryApiServer()
+        api.create(Serving(
+            metadata=ObjectMeta(name=name, namespace=ns),
+            spec=ServingSpec(
+                model="llama-tiny", replicas=replicas,
+                max_batch=max_batch, max_queue=max_queue,
+                autoscale=AutoscaleSpec(
+                    min_replicas=replicas, max_replicas=max_replicas,
+                    target_queue_wait_s=target_queue_wait_s)),
+        ))
+        autoscaler = ServingAutoscaler(
+            api, MetricsRegistry(), tracer=Tracer(),
+            interval_s=scrape_interval_s,
+            # Scale-down never fires inside a bench run: the claim under
+            # test is the up direction; hysteresis gets its own unit test.
+            scale_down_stabilization_s=3600.0,
+        )
+
+    stop = threading.Event()
+
+    def control_loop():
+        """The observe->decide->actuate cadence: republish endpoints,
+        scrape+reconcile the autoscaler, actuate spec.replicas deltas as
+        new sim replicas, and run the LB health check that ingests each
+        backend's load report (the shedding watermark input)."""
+        while not stop.is_set():
+            if autoscaler is not None:
+                sv = api.get("Serving", name, ns)
+                with sims_lock:
+                    addrs = [s.addr for s in sims]
+                if sv.status.endpoints != addrs:
+                    sv.status.endpoints = addrs
+                    api.update_status(sv)
+                autoscaler.reconcile(ns, name)
+                want = api.get("Serving", name, ns).spec.replicas
+                while len(sims) < min(want, max_replicas):
+                    add_replica()
+            with sims_lock:
+                lb.set_backends([s.addr for s in sims])
+            lb.health_check()
+            stop.wait(scrape_interval_s)
+
+    ctl = threading.Thread(target=control_loop, daemon=True)
+    ctl.start()
+
+    # --- open-loop client ---------------------------------------------
+    offered = max(1, int(rate_qps * duration_s))
+    body = json.dumps({"tokens": [1, 2, 3]}).encode()
+    outcomes: "_queuemod.Queue[tuple]" = _queuemod.Queue()
+
+    def fire(i: int):
+        t0 = time.monotonic()
+        try:
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=client_timeout_s) as r:
+                r.read()
+            outcomes.put(("ok", time.monotonic() - t0, ""))
+        except urllib.error.HTTPError as e:
+            e.read()
+            if e.code in (429, 503):
+                outcomes.put(("shed", time.monotonic() - t0,
+                              e.headers.get("Retry-After") or ""))
+            else:
+                outcomes.put(("error", time.monotonic() - t0, str(e.code)))
+        except (socket.timeout, TimeoutError):
+            outcomes.put(("timeout", time.monotonic() - t0, ""))
+        except urllib.error.URLError as e:
+            if isinstance(e.reason, (socket.timeout, TimeoutError)):
+                outcomes.put(("timeout", time.monotonic() - t0, ""))
+            else:
+                outcomes.put(("error", time.monotonic() - t0, repr(e)))
+        except Exception as e:  # noqa: BLE001 — every outcome is counted
+            outcomes.put(("error", time.monotonic() - t0, repr(e)))
+
+    threads = []
+    t_start = time.monotonic()
+    for i in range(offered):
+        # Open loop: arrival i fires at t_start + i/rate regardless of
+        # completions — lateness in the generator itself would throttle
+        # the offered load and mask the overload under test.
+        delay = t_start + i / rate_qps - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        t = threading.Thread(target=fire, args=(i,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=client_timeout_s + 10)
+    elapsed = time.monotonic() - t_start
+    stop.set()
+    ctl.join(timeout=5)
+
+    ok_lat: List[float] = []
+    counts = {"ok": 0, "shed": 0, "timeout": 0, "error": 0}
+    shed_with_retry_after = 0
+    while not outcomes.empty():
+        kind, lat, extra = outcomes.get()
+        counts[kind] += 1
+        if kind == "ok":
+            ok_lat.append(lat)
+        elif kind == "shed" and extra:
+            shed_with_retry_after += 1
+
+    from kubeflow_tpu.utils.monitoring import nearest_rank_quantile
+
+    def pct(q: float) -> float:
+        return round(nearest_rank_quantile(ok_lat, q), 4)
+
+    capacity_qps = replicas * max_batch / service_time_s
+    with sims_lock:
+        replica_count = len(sims)
+        engine_shed = sum(s.shed for s in sims)
+        served = sum(s.served for s in sims)
+    out = {
+        "offered": offered,
+        "rate_qps": rate_qps,
+        "duration_s": duration_s,
+        "elapsed_s": round(elapsed, 3),
+        "ok": counts["ok"],
+        "shed": counts["shed"],
+        "timeouts": counts["timeout"],
+        "errors": counts["error"],
+        "accounting_ok": (counts["ok"] + counts["shed"]
+                          + counts["timeout"] + counts["error"]) == offered,
+        "shed_with_retry_after": shed_with_retry_after,
+        "engine_shed": engine_shed,
+        "lb_shed": lb.shed_total,
+        "served_by_backends": served,
+        "goodput_qps": round(counts["ok"] / elapsed, 1) if elapsed else 0.0,
+        "capacity_qps": round(capacity_qps, 1),
+        "goodput_vs_capacity": round(
+            counts["ok"] / elapsed / capacity_qps, 3) if elapsed else 0.0,
+        "latency_ok_s": {"p50": pct(0.5), "p95": pct(0.95), "p99": pct(0.99)},
+        "replicas_start": replicas,
+        "replicas_end": replica_count,
+        "max_replicas": max_replicas,
+        "shed_enabled": shed,
+        "autoscale_enabled": autoscale,
+    }
+    front.stop()
+    with sims_lock:
+        for s in sims:
+            s.stop()
+    return out
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="kftpu-loadtest")
     p.add_argument("--notebooks", type=int, default=100)
@@ -203,7 +543,26 @@ def main(argv=None) -> int:
     p.add_argument("--profiles", type=int, default=10)
     p.add_argument("--serving-lb", action="store_true",
                    help="also measure L7 balancer requests/sec")
+    p.add_argument("--serve", action="store_true",
+                   help="ONLY run the open-loop serving bench "
+                        "(goodput/shed/latency under overload)")
+    p.add_argument("--rate-qps", type=float, default=80.0)
+    p.add_argument("--duration-s", type=float, default=2.0)
+    p.add_argument("--no-shed", action="store_true",
+                   help="serve bench: pre-ISSUE-7 baseline (unbounded "
+                        "queues, no watermark)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="serve bench: run the ServingAutoscaler loop")
+    p.add_argument("--max-replicas", type=int, default=1)
     args = p.parse_args(argv)
+    if args.serve:
+        out = run_serve_bench(
+            rate_qps=args.rate_qps, duration_s=args.duration_s,
+            shed=not args.no_shed, autoscale=args.autoscale,
+            max_replicas=args.max_replicas,
+        )
+        print(json.dumps(out))
+        return 0 if out["accounting_ok"] else 1
     out = run_load(
         notebooks=args.notebooks, jobs=args.jobs, profiles=args.profiles
     )

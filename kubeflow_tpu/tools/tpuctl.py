@@ -462,6 +462,19 @@ def cmd_top(args) -> int:
           f"{'P95(ms)':>8} {'P99(ms)':>8} {'QWAIT95':>8} {'WLAG95':>8}")
     for ctl, count, p50, p95, p99, qw, wl in rows:
         print(f"{ctl:24} {count:>10} {p50} {p95} {p99} {qw} {wl}")
+    # ServingAutoscaler actuation (ISSUE 7): replicas added/removed per
+    # decision reason, summed across scrapes/shards. Printed only when
+    # the counter exists so plain control planes keep the bare table.
+    scaled = {}
+    for name, labels, value in samples:
+        if name == "kftpu_autoscaler_replicas" and "reason" in labels:
+            scaled[labels["reason"]] = (
+                scaled.get(labels["reason"], 0.0) + value)
+    if scaled:
+        print()
+        print(f"{'AUTOSCALE REASON':24} {'REPLICAS +/-':>12}")
+        for reason in sorted(scaled):
+            print(f"{reason:24} {int(scaled[reason]):>12}")
     return 0
 
 

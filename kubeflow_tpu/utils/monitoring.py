@@ -348,6 +348,17 @@ class Histogram:
         return out
 
 
+def nearest_rank_quantile(values: Sequence[float], q: float) -> float:
+    """Nearest-rank q-quantile of raw observations (0.0 when empty).
+    The list-based sibling of :func:`quantile_from_buckets`, shared by
+    the serving engine's load() ring and the serve-bench reporters so
+    the index convention lives in one place."""
+    if not values:
+        return 0.0
+    xs = sorted(values)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
 def quantile_from_buckets(
     pairs: Sequence[Tuple[float, float]], q: float
 ) -> Optional[float]:

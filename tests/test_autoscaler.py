@@ -1,0 +1,263 @@
+"""ServingAutoscaler (ISSUE 7): latency-driven replica scaling with
+hysteresis — scale-up fast on queue-wait pressure, scale-down only after
+an uninterrupted stabilization window, bounds always clamped, every
+decision traced and counted.
+
+The scrape is injected (addr -> ServingEngine.load()-shaped dict) so the
+control law is tested deterministically; the HTTP scrape path and the
+closed loop against live replicas are covered by the serve bench
+(tools/loadtest.run_serve_bench) and the CI serve-bench-smoke stage.
+"""
+
+import time
+
+from kubeflow_tpu.controlplane.api import (
+    AutoscaleSpec,
+    ObjectMeta,
+    Serving,
+    ServingSpec,
+)
+from kubeflow_tpu.controlplane.controllers import (
+    ServingAutoscaler,
+    ServingController,
+)
+from kubeflow_tpu.controlplane.runtime import (
+    ControllerManager,
+    InMemoryApiServer,
+)
+from kubeflow_tpu.utils.monitoring import MetricsRegistry
+from kubeflow_tpu.utils.tracing import Tracer
+
+
+def make_world(*, autoscale=None, replicas=1, endpoints=("e0:80",),
+               stabilization_s=3600.0, scrape=None):
+    """Api + autoscaler with an injected scrape. Default stabilization is
+    effectively infinite so scale-down tests opt in explicitly."""
+    api = InMemoryApiServer()
+    reg = MetricsRegistry()
+    tracer = Tracer()
+    loads = {}
+
+    def default_scrape(addr):
+        return loads.get(addr, {})
+
+    asc = ServingAutoscaler(
+        api, reg, tracer=tracer, interval_s=5.0,
+        scale_down_stabilization_s=stabilization_s,
+        scrape=scrape or default_scrape,
+    )
+    api.create(Serving(
+        metadata=ObjectMeta(name="llm", namespace="team-a"),
+        spec=ServingSpec(model="llama-tiny", replicas=replicas,
+                         autoscale=autoscale),
+    ))
+    sv = api.get("Serving", "llm", "team-a")
+    sv.status.endpoints = list(endpoints)
+    api.update_status(sv)
+    return api, asc, tracer, loads
+
+
+def busy(p95):
+    return {"queued": 3, "p95_queue_wait_s": p95, "p50_queue_wait_s": p95}
+
+
+QUIET = {"queued": 0, "p95_queue_wait_s": 0.0, "p50_queue_wait_s": 0.0}
+
+
+class TestScaleUp:
+    def test_proportional_scale_up_over_target(self):
+        api, asc, tracer, loads = make_world(
+            autoscale=AutoscaleSpec(min_replicas=1, max_replicas=8,
+                                    target_queue_wait_s=0.1))
+        loads["e0:80"] = busy(0.4)
+        res = asc.reconcile("team-a", "llm")
+        sv = api.get("Serving", "llm", "team-a")
+        assert sv.spec.replicas == 4            # ceil(1 * 0.4 / 0.1)
+        assert res.requeue_after == asc.interval_s
+        assert asc.metrics_decisions.value(
+            reason="queue-wait-above-target") == 3.0
+
+    def test_scale_up_at_least_one_step(self):
+        """Barely over target still adds a replica — overload must never
+        round down to a no-op."""
+        api, asc, _, loads = make_world(
+            autoscale=AutoscaleSpec(min_replicas=1, max_replicas=8,
+                                    target_queue_wait_s=0.1),
+            replicas=2, endpoints=("e0:80", "e1:80"))
+        loads["e0:80"] = busy(0.11)
+        loads["e1:80"] = QUIET                  # WORST replica drives
+        asc.reconcile("team-a", "llm")
+        assert api.get("Serving", "llm", "team-a").spec.replicas == 3
+
+    def test_scale_up_clamps_to_max(self):
+        api, asc, _, loads = make_world(
+            autoscale=AutoscaleSpec(min_replicas=1, max_replicas=3,
+                                    target_queue_wait_s=0.05))
+        loads["e0:80"] = busy(5.0)              # 100x over target
+        asc.reconcile("team-a", "llm")
+        assert api.get("Serving", "llm", "team-a").spec.replicas == 3
+
+    def test_no_signal_no_action(self):
+        """Unreachable replicas contribute no signal: replicas hold."""
+        api, asc, _, loads = make_world(
+            autoscale=AutoscaleSpec(min_replicas=1, max_replicas=8,
+                                    target_queue_wait_s=0.1),
+            replicas=2, endpoints=("e0:80",))
+        # scrape returns {} (default) -> no loads at all
+        asc.reconcile("team-a", "llm")
+        assert api.get("Serving", "llm", "team-a").spec.replicas == 2
+
+    def test_no_autoscale_spec_is_inert(self):
+        api, asc, _, loads = make_world(autoscale=None, replicas=2)
+        loads["e0:80"] = busy(9.0)
+        asc.reconcile("team-a", "llm")
+        assert api.get("Serving", "llm", "team-a").spec.replicas == 2
+
+
+class TestScaleDownHysteresis:
+    def test_scale_down_waits_out_stabilization_window(self):
+        api, asc, _, loads = make_world(
+            autoscale=AutoscaleSpec(min_replicas=1, max_replicas=8,
+                                    target_queue_wait_s=0.1),
+            replicas=3, stabilization_s=0.2)
+        loads["e0:80"] = dict(QUIET)
+        asc.reconcile("team-a", "llm")          # clock starts
+        assert api.get("Serving", "llm", "team-a").spec.replicas == 3
+        time.sleep(0.25)
+        asc.reconcile("team-a", "llm")          # window elapsed: ONE step
+        assert api.get("Serving", "llm", "team-a").spec.replicas == 2
+        asc.reconcile("team-a", "llm")          # window restarted: hold
+        assert api.get("Serving", "llm", "team-a").spec.replicas == 2
+        assert asc.metrics_decisions.value(
+            reason="queue-wait-below-target") == 1.0
+
+    def test_busy_scrape_resets_the_window(self):
+        api, asc, _, loads = make_world(
+            autoscale=AutoscaleSpec(min_replicas=1, max_replicas=8,
+                                    target_queue_wait_s=0.1),
+            replicas=2, stabilization_s=0.2)
+        loads["e0:80"] = dict(QUIET)
+        asc.reconcile("team-a", "llm")          # clock starts
+        time.sleep(0.12)
+        loads["e0:80"] = {"queued": 1, "p95_queue_wait_s": 0.06,
+                          "p50_queue_wait_s": 0.06}   # in-band: reset
+        asc.reconcile("team-a", "llm")
+        loads["e0:80"] = dict(QUIET)
+        time.sleep(0.12)                        # 0.24s since FIRST quiet,
+        asc.reconcile("team-a", "llm")          # but only 0.12 since reset
+        assert api.get("Serving", "llm", "team-a").spec.replicas == 2
+
+    def test_scale_down_stops_at_min(self):
+        api, asc, _, loads = make_world(
+            autoscale=AutoscaleSpec(min_replicas=2, max_replicas=8,
+                                    target_queue_wait_s=0.1),
+            replicas=2, stabilization_s=0.0)
+        loads["e0:80"] = dict(QUIET)
+        asc.reconcile("team-a", "llm")
+        asc.reconcile("team-a", "llm")
+        assert api.get("Serving", "llm", "team-a").spec.replicas == 2
+
+
+class TestBounds:
+    def test_below_min_clamps_up_even_when_quiet(self):
+        api, asc, _, loads = make_world(
+            autoscale=AutoscaleSpec(min_replicas=3, max_replicas=8,
+                                    target_queue_wait_s=0.1))
+        loads["e0:80"] = dict(QUIET)
+        asc.reconcile("team-a", "llm")
+        assert api.get("Serving", "llm", "team-a").spec.replicas == 3
+        assert asc.metrics_decisions.value(reason="min-replicas") == 2.0
+
+    def test_above_max_clamps_down(self):
+        api, asc, _, loads = make_world(
+            autoscale=AutoscaleSpec(min_replicas=1, max_replicas=2,
+                                    target_queue_wait_s=0.1),
+            replicas=5)
+        asc.reconcile("team-a", "llm")
+        assert api.get("Serving", "llm", "team-a").spec.replicas == 2
+        assert asc.metrics_decisions.value(reason="max-replicas") == 3.0
+
+
+class TestObservability:
+    def test_decision_span_links_to_scrape_span(self):
+        """One autoscale.decision span per scale step, LINKED to the
+        autoscale.scrape span that triggered it — the same causal-link
+        pattern as write->reconcile edges, renderable by tpuctl trace."""
+        api, asc, tracer, loads = make_world(
+            autoscale=AutoscaleSpec(min_replicas=1, max_replicas=8,
+                                    target_queue_wait_s=0.1))
+        loads["e0:80"] = busy(0.3)
+        asc.reconcile("team-a", "llm")
+        scrapes = tracer.spans("autoscale.scrape")
+        decisions = tracer.spans("autoscale.decision")
+        assert len(scrapes) == 1 and len(decisions) == 1
+        assert decisions[0].links == [scrapes[0].context]
+        assert decisions[0].attrs["reason"] == "queue-wait-above-target"
+        assert decisions[0].attrs["from"] == 1
+        assert decisions[0].attrs["to"] == 3
+        # no-op reconciles emit a scrape span but no decision span
+        loads["e0:80"] = {"queued": 0, "p95_queue_wait_s": 0.08,
+                          "p50_queue_wait_s": 0.08}   # in-band
+        asc.reconcile("team-a", "llm")
+        assert len(tracer.spans("autoscale.decision")) == 1
+        assert len(tracer.spans("autoscale.scrape")) == 2
+
+    def test_scaled_event_recorded(self):
+        api, asc, _, loads = make_world(
+            autoscale=AutoscaleSpec(min_replicas=1, max_replicas=4,
+                                    target_queue_wait_s=0.1))
+        loads["e0:80"] = busy(0.3)
+        asc.reconcile("team-a", "llm")
+        evs = [e for e in api.list("Event", namespace="team-a")
+               if e.reason == "Scaled"]
+        assert len(evs) == 1
+        assert "1 -> 3" in evs[0].message
+
+
+class TestClosedLoopWithServingController:
+    def test_autoscaler_drives_pod_creation(self):
+        """End to end through the manager: pressure -> autoscaler rewrites
+        spec.replicas -> ServingController creates the pods -> endpoints
+        grow. The observe->actuate loop the PR-4 layer was missing."""
+        from kubeflow_tpu.controlplane.controllers import FakeKubelet
+
+        api = InMemoryApiServer()
+        reg = MetricsRegistry()
+        mgr = ControllerManager(api)
+        mgr.register(FakeKubelet(api, reg, outcome="running"))
+        mgr.register(ServingController(api, reg))
+        loads = {}
+        asc = ServingAutoscaler(api, reg, tracer=Tracer(),
+                                scrape=lambda a: dict(loads))
+        mgr.register(asc)
+        api.create(Serving(
+            metadata=ObjectMeta(name="llm", namespace="team-a"),
+            spec=ServingSpec(
+                model="llama-tiny", replicas=1,
+                autoscale=AutoscaleSpec(min_replicas=1, max_replicas=3,
+                                        target_queue_wait_s=0.1)),
+        ))
+        mgr.run_until_idle()
+        sv = api.get("Serving", "llm", "team-a")
+        assert len(sv.status.endpoints) == 1
+        loads.update(busy(0.35))                # every endpoint overloaded
+        asc.reconcile("team-a", "llm")
+        mgr.run_until_idle()
+        sv = api.get("Serving", "llm", "team-a")
+        assert sv.spec.replicas == 3
+        assert len(sv.status.endpoints) == 3
+        pods = api.list("Pod", namespace="team-a")
+        assert len(pods) == 3
+        mgr.close()
+
+    def test_deleted_serving_clears_hysteresis_state(self):
+        api, asc, _, loads = make_world(
+            autoscale=AutoscaleSpec(min_replicas=1, max_replicas=4,
+                                    target_queue_wait_s=0.1),
+            replicas=2, stabilization_s=0.2)
+        loads["e0:80"] = dict(QUIET)
+        asc.reconcile("team-a", "llm")
+        assert ("team-a", "llm") in asc._below_since
+        api.delete("Serving", "llm", "team-a")
+        asc.reconcile("team-a", "llm")
+        assert ("team-a", "llm") not in asc._below_since

@@ -564,6 +564,47 @@ class TestServing:
         assert sv.status.phase == "Failed"
         assert "quantize" in sv.status.conditions[-1].message
 
+    def test_max_queue_rides_env_contract(self):
+        """ISSUE 7: spec.max_queue reaches the replica pod env — the
+        engine's bounded-admission cap AND the watermark its /healthz
+        reports to the LB. 0 (unbounded) stays off the env."""
+        api, mgr, _ = self._world()
+        api.create(self._serving(name="bounded", max_queue=17))
+        api.create(self._serving(name="unbounded", max_queue=0))
+        mgr.run_until_idle()
+        pod = api.get("Pod", "bounded-serving-0", "team-a")
+        env = {e.name: e.value for e in pod.spec.containers[0].env}
+        assert env["KFTPU_SERVING_MAX_QUEUE"] == "17"
+        pod = api.get("Pod", "unbounded-serving-0", "team-a")
+        env = {e.name: e.value for e in pod.spec.containers[0].env}
+        assert "KFTPU_SERVING_MAX_QUEUE" not in env
+
+    def test_negative_max_queue_rejected(self):
+        api, mgr, _ = self._world()
+        api.create(self._serving(name="badmq", max_queue=-1))
+        mgr.run_until_idle()
+        sv = api.get("Serving", "badmq", "team-a")
+        assert sv.status.phase == "Failed"
+        assert "max_queue" in sv.status.conditions[-1].message
+
+    def test_invalid_autoscale_specs_rejected(self):
+        from kubeflow_tpu.controlplane.api import AutoscaleSpec
+
+        cases = {
+            "as-min": AutoscaleSpec(min_replicas=0, max_replicas=2),
+            "as-max": AutoscaleSpec(min_replicas=3, max_replicas=2),
+            "as-tgt": AutoscaleSpec(min_replicas=1, max_replicas=2,
+                                    target_queue_wait_s=0.0),
+        }
+        api, mgr, _ = self._world()
+        for name, a in cases.items():
+            api.create(self._serving(name=name, autoscale=a))
+        mgr.run_until_idle()
+        for name in cases:
+            sv = api.get("Serving", name, "team-a")
+            assert sv.status.phase == "Failed", name
+            assert "autoscale" in sv.status.conditions[-1].message
+
     def _replica_world(self, drain_grace_s=0.0):
         from kubeflow_tpu.controlplane.controllers import ServingController
 

@@ -44,3 +44,66 @@ class TestServingLbLoad:
         spread = out["lb_backend_spread"]
         assert sum(spread) == out["lb_requests"]
         assert min(spread) > 0                       # both backends worked
+
+
+class TestServeBench:
+    """Open-loop serving bench (ISSUE 7): fixed-arrival-rate traffic
+    through the real LB over SimServingReplica backends. Counts are the
+    contract — every request lands in exactly one outcome bucket; rates
+    and latencies are reported, not pinned (CI machines vary)."""
+
+    def test_shed_run_accounts_every_request(self):
+        from kubeflow_tpu.tools.loadtest import run_serve_bench
+
+        out = run_serve_bench(
+            rate_qps=60.0, duration_s=1.0, replicas=1, max_batch=2,
+            max_queue=4, service_time_s=0.05, shed=True, autoscale=False,
+            client_timeout_s=3.0)
+        assert out["accounting_ok"], out
+        assert out["offered"] == 60
+        # 1.5x overload: the excess MUST shed, the rest MUST succeed
+        assert out["ok"] > 0 and out["shed"] > 0
+        assert out["shed_with_retry_after"] == out["shed"]
+        assert out["timeouts"] == 0 and out["errors"] == 0
+        # sheds split between engine 429s and LB watermark 503s; together
+        # they are exactly the client-visible shed count
+        assert out["engine_shed"] + out["lb_shed"] == out["shed"]
+        assert out["served_by_backends"] == out["ok"]
+
+    def test_autoscale_run_reaches_max_replicas(self):
+        from kubeflow_tpu.tools.loadtest import run_serve_bench
+
+        out = run_serve_bench(
+            rate_qps=80.0, duration_s=1.5, replicas=1, max_replicas=2,
+            max_batch=2, max_queue=4, service_time_s=0.05, shed=True,
+            autoscale=True, target_queue_wait_s=0.02,
+            scrape_interval_s=0.1, client_timeout_s=3.0)
+        assert out["accounting_ok"], out
+        assert out["replicas_end"] == 2          # pressure drove scale-up
+        assert out["ok"] > 0
+
+    def test_noshed_baseline_counts_timeouts(self):
+        """The pre-ISSUE-7 configuration: unbounded queues, no watermark.
+        At 3x capacity with a tight client budget the backlog converts
+        into client timeouts — and the accounting still sums exactly."""
+        from kubeflow_tpu.tools.loadtest import run_serve_bench
+
+        out = run_serve_bench(
+            rate_qps=120.0, duration_s=1.0, replicas=1, max_batch=2,
+            max_queue=4, service_time_s=0.05, shed=False, autoscale=False,
+            client_timeout_s=0.6)
+        assert out["accounting_ok"], out
+        assert out["shed"] == 0                  # nothing sheds...
+        assert out["timeouts"] > 0               # ...so clients die waiting
+
+
+class TestServeCiSmokes:
+    def test_ci_serve_bench_smoke_stage(self):
+        from kubeflow_tpu.tools.ci import run_serve_bench_smoke
+
+        run_serve_bench_smoke(rate_qps=60.0, duration_s=1.5)
+
+    def test_ci_serving_soak_smoke_stage(self):
+        from kubeflow_tpu.tools.ci import run_serving_soak_smoke
+
+        run_serving_soak_smoke(seed=20260803)

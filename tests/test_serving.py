@@ -1158,3 +1158,160 @@ class TestDecodeStaging:
             ServingEngine(m, params,
                           ServingConfig(max_batch=2, max_len=64,
                                         decode_chunk=4))
+
+
+class TestBoundedAdmission:
+    """ISSUE 7: bounded engine admission. A full queue fails FAST at
+    submit (EngineOverloaded -> HTTP 429 + Retry-After) and never
+    disturbs requests already admitted or queued."""
+
+    def test_max_queue_overflow_raises(self, model_and_params):
+        from kubeflow_tpu.serving import EngineOverloaded
+
+        from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+        model, params = model_and_params
+        eng = ServingEngine(model, params,
+                            ServingConfig(max_batch=1, max_len=128,
+                                          max_queue=2),
+                            registry=MetricsRegistry())
+        eng.submit([1, 2, 3], max_new_tokens=4)
+        eng.submit([4, 5, 6], max_new_tokens=4)
+        with pytest.raises(EngineOverloaded) as ei:
+            eng.submit([7, 8, 9], max_new_tokens=4)
+        assert ei.value.retry_after_s >= 1.0
+        assert eng.shed_total == 1
+        assert eng.metrics_requests.value(outcome="shed") == 1.0
+        assert eng.metrics_requests.value(outcome="admitted") == 2.0
+
+    def test_overflow_never_poisons_admitted_requests(self, model_and_params):
+        """The two admitted requests must decode token-exact despite the
+        overflow between them and the run."""
+        from kubeflow_tpu.serving import EngineOverloaded
+
+        model, params = model_and_params
+        eng = ServingEngine(model, params,
+                            ServingConfig(max_batch=2, max_len=128,
+                                          max_queue=2))
+        # NOTE: [3, 14, 15] is unusable here — its first decode step has
+        # an exact bf16 logit tie (tokens 157/215) that the engine and the
+        # full-reforward reference break differently.
+        prompts = [[4, 5, 6, 7], [50, 60, 70]]
+        rids = [eng.submit(p, max_new_tokens=4) for p in prompts]
+        with pytest.raises(EngineOverloaded):
+            eng.submit([9, 9, 9], max_new_tokens=4)
+        results = {r.request_id: r.tokens for r in eng.run()}
+        assert len(results) == 2
+        for rid, p in zip(rids, prompts):
+            assert results[rid] == greedy_reference(model, params, p, 4)
+        # queue drained: the engine sheds nothing at rest
+        assert eng.queued == 0
+        assert eng.load()["queued"] == 0
+
+    def test_zero_max_queue_is_unbounded(self, model_and_params):
+        model, params = model_and_params
+        eng = ServingEngine(model, params,
+                            ServingConfig(max_batch=1, max_len=128))
+        for i in range(20):                  # far past any plausible bound
+            eng.submit([i + 1], max_new_tokens=1)
+        assert eng.queued == 20
+
+    def test_load_snapshot_shape(self, model_and_params):
+        from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+        model, params = model_and_params
+        eng = ServingEngine(model, params,
+                            ServingConfig(max_batch=2, max_len=128,
+                                          max_queue=8),
+                            registry=MetricsRegistry())
+        eng.submit([1, 2], max_new_tokens=2)
+        load = eng.load()
+        assert load["queued"] == 1
+        assert load["active_slots"] == 0
+        assert load["free_slots"] == 2
+        assert load["max_batch"] == 2 and load["max_queue"] == 8
+        eng.run()
+        load = eng.load()
+        assert load["queued"] == 0
+        # queue waits observed at admission feed the percentiles
+        assert load["p50_queue_wait_s"] >= 0.0
+        assert eng.metrics_queue_wait.count() == 1
+
+    def test_server_maps_overload_to_429_with_retry_after(
+            self, model_and_params):
+        """Slot held + queue full -> a third HTTP request gets 429 and a
+        Retry-After hint; the held and queued requests still finish."""
+        import threading
+
+        model, params = model_and_params
+        engine = ServingEngine(model, params,
+                               ServingConfig(max_batch=1, max_len=128,
+                                             max_queue=1))
+        server = ServingServer(engine, model_name="llama-test").start()
+        base = f"http://127.0.0.1:{server.port}"
+
+        def fire(prompt, out):
+            req = urllib.request.Request(
+                f"{base}/v1/generate",
+                data=json.dumps({"tokens": prompt,
+                                 "max_new_tokens": 120}).encode(),
+                headers={"Content-Type": "application/json"})
+            out.append(json.load(urllib.request.urlopen(req, timeout=120)))
+
+        import time as _time
+        a_out, b_out = [], []
+        try:
+            ta = threading.Thread(target=fire, args=([3, 14, 15], a_out))
+            ta.start()
+            deadline = _time.time() + 30
+            while engine.active_slots < 1:       # A holds the only slot
+                assert _time.time() < deadline
+                _time.sleep(0.002)
+            tb = threading.Thread(target=fire, args=([4, 5, 6], b_out))
+            tb.start()
+            while engine.queued < 1:             # B waits in the queue
+                assert _time.time() < deadline
+                _time.sleep(0.002)
+            # C: queue full -> 429, Retry-After integer >= 1
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                req = urllib.request.Request(
+                    f"{base}/v1/generate",
+                    data=json.dumps({"tokens": [7, 8, 9],
+                                     "max_new_tokens": 4}).encode(),
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 429
+            assert int(ei.value.headers["Retry-After"]) >= 1
+            assert "full" in json.load(ei.value)["error"]
+            # the shed request poisoned nothing: A and B complete
+            ta.join(timeout=120)
+            tb.join(timeout=120)
+            assert len(a_out) == 1 and len(b_out) == 1
+            assert len(a_out[0]["tokens"]) == 120
+            assert len(b_out[0]["tokens"]) == 120
+            # /healthz carries the load snapshot the LB/autoscaler read
+            health = json.load(urllib.request.urlopen(f"{base}/healthz"))
+            assert health["load"]["queued"] == 0
+            assert health["load"]["max_queue"] == 1
+            assert health["load"]["shed_total"] == 1
+        finally:
+            server.stop()
+
+    def test_load_percentiles_decay_when_idle(self, model_and_params):
+        """The load() ring is time-windowed: an idle engine must stop
+        reporting its last burst's tail, or the autoscaler could never
+        scale the burst's replicas back down (the quiet branch needs the
+        signal to actually go quiet)."""
+        import time as _time
+
+        from kubeflow_tpu.serving.engine import LOAD_WINDOW_S
+
+        model, params = model_and_params
+        eng = ServingEngine(model, params,
+                            ServingConfig(max_batch=1, max_len=128,
+                                          max_queue=4))
+        now = _time.monotonic()
+        eng._recent_queue_waits.append((now - LOAD_WINDOW_S - 1.0, 0.5))
+        assert eng.load()["p95_queue_wait_s"] == 0.0   # stale: ignored
+        eng._recent_queue_waits.append((now, 0.25))
+        assert eng.load()["p95_queue_wait_s"] == 0.25  # fresh: counted

@@ -388,3 +388,309 @@ class TestLBServer:
         srv.tick()
         assert {b["addr"] for b in lb.backends()} == {b0.addr}
         srv.stop()
+
+
+class LoadStubBackend(StubBackend):
+    """StubBackend whose /healthz carries a controllable engine load
+    snapshot (the ServingEngine.load shape) — the input to queue-aware
+    dispatch, watermark shedding, and the autoscaler scrape."""
+
+    def __init__(self, name: str, **load):
+        self.load = {
+            "queued": 0, "active_slots": 0, "free_slots": 2,
+            "max_batch": 2, "max_queue": 4, "shed_total": 0,
+            "p50_queue_wait_s": 0.0, "p95_queue_wait_s": 0.0, **load,
+        }
+        super().__init__(name)
+
+    def _healthz(self, q: Request):
+        if not self.ok:
+            return (503, {"ok": False})
+        return {"ok": True, "load": dict(self.load)}
+
+
+@pytest.fixture()
+def load_backends():
+    b = [LoadStubBackend("b0"), LoadStubBackend("b1")]
+    yield b
+    for x in b:
+        x.stop()
+
+
+class TestQueueAwareDispatch:
+    def test_dispatch_prefers_lower_reported_queue(self, load_backends):
+        """With zero LB in-flight everywhere, the backend whose engine
+        reports the shorter queue wins — depth-aware, not just
+        least-in-flight."""
+        b0, b1 = load_backends
+        b0.load["queued"] = 5
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        assert lb.health_check() == 2
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        try:
+            for _ in range(3):
+                out = json.load(_post(
+                    f"http://127.0.0.1:{srv.port}/v1/generate",
+                    {"tokens": [1]}))
+                assert out["backend"] == "b1"
+        finally:
+            srv.stop()
+
+    def test_health_check_ingests_load_report(self, load_backends):
+        b0, b1 = load_backends
+        b0.load.update(queued=3, free_slots=1, p50_queue_wait_s=0.25)
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        lb.health_check()
+        snap = {b["addr"]: b for b in lb.backends()}
+        assert snap[b0.addr]["queued"] == 3
+        assert snap[b0.addr]["free_slots"] == 1
+        assert snap[b0.addr]["max_queue"] == 4
+        assert snap[b1.addr]["queued"] == 0
+
+    def test_sent_since_report_rebaselines_on_fresh_report(
+            self, load_backends):
+        """Requests dispatched between health checks count against the
+        stale snapshot; a fresh report resets the correction."""
+        b0, b1 = load_backends
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        lb.health_check()
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        try:
+            for _ in range(4):
+                _post(f"http://127.0.0.1:{srv.port}/v1/generate",
+                      {"tokens": [1]}).read()
+            assert sum(b["sent_since_report"]
+                       for b in lb.backends()) == 4
+            lb.health_check()
+            assert all(b["sent_since_report"] == 0
+                       for b in lb.backends())
+        finally:
+            srv.stop()
+
+
+class TestLoadShedding:
+    def test_sheds_503_when_all_backends_saturated(self, load_backends):
+        """Every backend past its reported watermark -> 503 with a
+        Retry-After at least the fleet's own queue-drain estimate."""
+        b0, b1 = load_backends
+        for b in (b0, b1):
+            b.load.update(queued=6, free_slots=0, p50_queue_wait_s=7.2)
+        lb = ServingLoadBalancer([b0.addr, b1.addr], retry_after_s=1.0)
+        lb.health_check()
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"http://127.0.0.1:{srv.port}/v1/generate",
+                      {"tokens": [1]})
+            assert ei.value.code == 503
+            assert "saturated" in json.load(ei.value)["error"]
+            assert int(ei.value.headers["Retry-After"]) >= 8  # ceil(7.2)
+            assert lb.shed_total == 1
+            # shed is visible on the LB's own /healthz
+            body = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz"))
+            assert body["shed_total"] == 1
+            # neither stub saw the shed request
+            assert b0.requests == 0 and b1.requests == 0
+        finally:
+            srv.stop()
+
+    def test_one_unsaturated_backend_absorbs(self, load_backends):
+        b0, b1 = load_backends
+        b0.load.update(queued=6, free_slots=0)     # saturated
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        lb.health_check()
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        try:
+            out = json.load(_post(
+                f"http://127.0.0.1:{srv.port}/v1/generate",
+                {"tokens": [1]}))
+            assert out["backend"] == "b1"
+            assert lb.shed_total == 0
+        finally:
+            srv.stop()
+
+    def test_no_load_report_never_saturates(self, backends):
+        """Pre-ISSUE-7 backends (plain {"ok": true} health) have no
+        watermark: the LB must keep dispatching, not shed."""
+        b0, b1 = backends
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        lb.health_check()
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        try:
+            out = json.load(_post(
+                f"http://127.0.0.1:{srv.port}/v1/generate",
+                {"tokens": [1]}))
+            assert out["backend"] in ("b0", "b1")
+            assert lb.shed_total == 0
+        finally:
+            srv.stop()
+
+    def test_queue_watermark_override(self, load_backends):
+        """An explicit LB-level watermark sheds even when the engines'
+        own max_queue would not."""
+        b0, b1 = load_backends
+        for b in (b0, b1):
+            b.load.update(queued=2, free_slots=0, max_queue=0)
+        lb = ServingLoadBalancer([b0.addr, b1.addr], queue_watermark=2)
+        lb.health_check()
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"http://127.0.0.1:{srv.port}/v1/generate",
+                      {"tokens": [1]})
+            assert ei.value.code == 503
+            assert lb.shed_total == 1
+        finally:
+            srv.stop()
+
+    def test_relayed_engine_429_keeps_retry_after(self, load_backends):
+        """An engine-level admission shed (HTTP 429 from the backend)
+        relays through the LB with its Retry-After intact."""
+        b0, b1 = load_backends
+
+        def overloaded(q):
+            raise RestError(429, "engine queue full",
+                            headers={"Retry-After": "5"})
+        # rebuild b0's router with an overloaded generate
+        b0._srv.stop()
+        r = Router()
+        r.post("/v1/generate", overloaded)
+        r.get("/healthz", b0._healthz)
+        b0._srv = JsonHttpServer(r, port=0).start()
+        b0.addr = f"127.0.0.1:{b0._srv.port}"
+        lb = ServingLoadBalancer([b0.addr])
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"http://127.0.0.1:{srv.port}/v1/generate",
+                      {"tokens": [1]})
+            assert ei.value.code == 429
+            assert ei.value.headers["Retry-After"] == "5"
+            # a 429 is the backend SPEAKING http: stays healthy, streak 0
+            snap = lb.backends()[0]
+            assert snap["healthy"] and snap["consecutive_failures"] == 0
+        finally:
+            srv.stop()
+
+
+class TestCircuitBreaker:
+    def test_breaker_opens_after_consecutive_failures(self, backends):
+        """failure_threshold transport failures open the circuit: the
+        backend is held out of dispatch for the cooldown even though its
+        /healthz probe succeeds, then rejoins after it."""
+        b0, b1 = backends
+        lb = ServingLoadBalancer([b0.addr, b1.addr],
+                                 failure_threshold=2,
+                                 breaker_cooldown_s=0.3)
+        back = {b.addr: b for b in lb._backends.values()}
+        victim = back[b0.addr]
+        lb._mark_unhealthy(victim, "boom-1")
+        assert not lb.backends()[0]["circuit_open"] or lb.breaker_trips == 0
+        lb._mark_unhealthy(victim, "boom-2")
+        assert lb.breaker_trips == 1
+        # probe succeeds (stub is fine) -> healthy again, but the open
+        # circuit still holds it out of dispatch
+        assert lb.health_check() == 2
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        try:
+            for _ in range(4):
+                out = json.load(_post(
+                    f"http://127.0.0.1:{srv.port}/v1/generate",
+                    {"tokens": [1]}))
+                assert out["backend"] == b1.name
+            time.sleep(0.35)                    # cooldown passes
+            served = set()
+            for _ in range(8):
+                out = json.load(_post(
+                    f"http://127.0.0.1:{srv.port}/v1/generate",
+                    {"tokens": [1]}))
+                served.add(out["backend"])
+            assert b0.name in served            # rejoined dispatch
+        finally:
+            srv.stop()
+
+    def test_success_resets_failure_streak(self, backends):
+        b0, b1 = backends
+        lb = ServingLoadBalancer([b0.addr], failure_threshold=3)
+        victim = next(iter(lb._backends.values()))
+        lb._mark_unhealthy(victim, "boom")
+        lb._mark_unhealthy(victim, "boom")
+        lb.health_check()
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        try:
+            out = json.load(_post(
+                f"http://127.0.0.1:{srv.port}/v1/generate",
+                {"tokens": [1]}))
+        finally:
+            srv.stop()
+        assert out["backend"] == b0.name
+        assert lb.backends()[0]["consecutive_failures"] == 0
+        # two MORE failures do not trip: the streak restarted at 0
+        lb._mark_unhealthy(victim, "boom")
+        lb._mark_unhealthy(victim, "boom")
+        assert lb.breaker_trips == 0
+
+    def test_healthz_not_ok_while_every_circuit_open(self, backends):
+        """An all-circuits-open fleet serves nothing: the LB's own
+        /healthz must go red even though every backend probe succeeds."""
+        b0, _ = backends
+        lb = ServingLoadBalancer([b0.addr], failure_threshold=1,
+                                 breaker_cooldown_s=0.3)
+        victim = next(iter(lb._backends.values()))
+        lb._mark_unhealthy(victim, "boom")
+        assert lb.health_check() == 1          # probe succeeds...
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/healthz")
+            assert ei.value.code == 503        # ...but the front is down
+            assert json.load(ei.value)["ok"] is False
+            time.sleep(0.35)                   # cooldown passes
+            body = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz"))
+            assert body["ok"] is True
+        finally:
+            srv.stop()
+
+
+class TestDrainRaceRegression:
+    def test_stale_release_cannot_delete_readded_backend(self, backends):
+        """ISSUE 7 satellite: an address whose draining Backend completed
+        its drain (popped) and was then re-added gets a NEW Backend
+        object. A stale release still holding the OLD draining object
+        must not delete the new owner of the address."""
+        b0, b1 = backends
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        old = lb._backends[b0.addr]
+        old.in_flight = 1                      # a request in flight
+        lb.set_backends([b1.addr])             # scale-down: b0 drains
+        assert old.draining
+        # drain completes normally: release pops the old object
+        lb._release(old)
+        assert b0.addr not in lb._backends
+        # address re-added: a fresh Backend owns it now
+        lb.set_backends([b0.addr, b1.addr])
+        fresh = lb._backends[b0.addr]
+        assert fresh is not old
+        # the STALE release fires (old object: draining, in_flight 0):
+        # pre-fix this popped b0.addr and deleted the healthy backend
+        lb._release(old)
+        assert lb._backends.get(b0.addr) is fresh
+        # and in_flight never goes negative on double release
+        assert old.in_flight == 0
+
+    def test_release_after_drain_revert_keeps_backend(self, backends):
+        """Re-added while draining WITH requests in flight: same object,
+        draining reverted — the eventual release must keep it."""
+        b0, b1 = backends
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        b = lb._backends[b0.addr]
+        b.in_flight = 1
+        lb.set_backends([b1.addr])             # drains b0
+        lb.set_backends([b0.addr, b1.addr])    # reverted before release
+        assert not b.draining
+        lb._release(b)
+        assert b0.addr in lb._backends
+        assert lb._backends[b0.addr] is b

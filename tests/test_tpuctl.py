@@ -280,6 +280,62 @@ class TestTpuctlTop:
         rc = main(["top", "--url", "http://127.0.0.1:1/metrics"])
         assert rc == 1
 
+    def test_top_shows_autoscaler_decisions(self, capsys):
+        """A scrape carrying kftpu_autoscaler_replicas{reason} gets the
+        autoscale actuation section appended to the table (ISSUE 7)."""
+        from kubeflow_tpu.controlplane.api import (
+            AutoscaleSpec,
+            ObjectMeta,
+            Serving,
+            ServingSpec,
+        )
+        from kubeflow_tpu.controlplane.controllers import ServingAutoscaler
+        from kubeflow_tpu.controlplane.runtime import (
+            ControllerManager,
+            InMemoryApiServer,
+        )
+        from kubeflow_tpu.utils.monitoring import (
+            MetricsHttpServer,
+            MetricsRegistry,
+        )
+        from kubeflow_tpu.utils.tracing import Tracer
+
+        api = InMemoryApiServer()
+        reg = MetricsRegistry()
+        mgr = ControllerManager(api, reg)
+        asc = ServingAutoscaler(
+            api, reg, tracer=Tracer(),
+            scrape=lambda a: {"queued": 2, "p95_queue_wait_s": 0.9,
+                              "p50_queue_wait_s": 0.9})
+        mgr.register(asc)
+        api.create(Serving(
+            metadata=ObjectMeta(name="llm", namespace="team-a"),
+            spec=ServingSpec(model="llama-tiny", replicas=1,
+                             autoscale=AutoscaleSpec(
+                                 min_replicas=1, max_replicas=4,
+                                 target_queue_wait_s=0.1))))
+        sv = api.get("Serving", "llm", "team-a")
+        sv.status.endpoints = ["e0:80"]
+        api.update_status(sv)
+        # through the manager so the reconcile-duration histogram the
+        # top table keys on records alongside the decision counter
+        mgr.run_until_idle()
+        mgr.close()
+        srv = MetricsHttpServer(reg, port=0, host="127.0.0.1")
+        try:
+            rc, out = _run(
+                ["top", "--url", f"http://127.0.0.1:{srv.port}/metrics"],
+                capsys)
+        finally:
+            srv.stop()
+        assert rc == 0
+        assert "AUTOSCALE REASON" in out
+        assert "queue-wait-above-target" in out
+        # 1 -> 4 replicas: 3 added under that reason
+        line = [l for l in out.splitlines()
+                if l.startswith("queue-wait-above-target")][0]
+        assert line.split()[-1] == "3"
+
 
 class TestTpuctlLogs:
     def test_logs_for_job_gang(self, tmp_path, capsys):
