@@ -7,6 +7,7 @@ flagship Llama training-throughput bench the driver runs every round.
   python bench.py mixtral      # config 3: MoE train tokens/s/chip
   python bench.py hpo          # config 4: in-process sweep trials/hour
   python bench.py controlplane # reconciles/s + copy-counter O(matches) proof
+  python bench.py schedule     # gang-scheduler storm: FIFO vs priority
 
 Each invocation prints ONE JSON line:
 {"metric", "value", "unit", "vs_baseline", ...extras}.
@@ -827,6 +828,66 @@ def bench_controlplane(args) -> None:
     )
 
 
+def bench_schedule(args) -> None:
+    """Gang-scheduler storm (ISSUE 8): the SAME seeded mixed-priority
+    arrival storm through the real control plane twice — FIFO
+    (head-of-line, no preemption: the arxiv 1908.08082 baseline) vs the
+    topology-aware priority scheduler (bin-packing + backfill +
+    minimal-set preemption + background defrag) — on the SAME fleet.
+    Logical-tick time, so every number is seed-deterministic.
+
+    Hard gates (raise, not assert): exact gang accounting
+    (placed + preempted + pending == submitted) and zero priority
+    inversions in BOTH runs; both storms converge; the scheduler beats
+    FIFO on fleet utilization AND on high-priority p95
+    time-to-placement. The comparative gates assume the default
+    CONTENDED storm (60 gangs on 8 slices): an under-loaded
+    ``--requests`` (fleet rarely full) can legitimately fail them —
+    preemption buys nothing when nobody queues."""
+    from kubeflow_tpu.scheduler.benchmark import (
+        check_storm_gates,
+        run_schedule_storm,
+    )
+
+    jobs = args.requests or 60
+    fleet = {
+        k: int(v) for k, v in (
+            kv.split("=") for kv in args.fleet.split(","))
+    }
+    common = dict(
+        num_jobs=jobs, fleet_capacity=fleet, pool_size=args.pool_size,
+        seed=args.seed,
+    )
+    fifo = run_schedule_storm(policy="fifo", **common)
+    sched = run_schedule_storm(policy="priority", **common)
+    for rep in (fifo, sched):
+        check_storm_gates(rep)
+        if not rep.converged:
+            raise SystemExit(
+                f"[{rep.policy}] storm did not converge in {rep.ticks} "
+                f"ticks: {rep.succeeded}+{rep.failed} terminal of "
+                f"{rep.submitted}")
+    fifo_p95 = fifo.ttp_ticks["high"]["p95"]
+    sched_p95 = sched.ttp_ticks["high"]["p95"]
+    if sched.utilization <= fifo.utilization:
+        raise SystemExit(
+            f"scheduler did not beat FIFO on fleet utilization: "
+            f"{sched.utilization:.4f} <= {fifo.utilization:.4f}")
+    if sched_p95 >= fifo_p95:
+        raise SystemExit(
+            f"scheduler did not beat FIFO on high-priority p95 "
+            f"time-to-placement: {sched_p95} >= {fifo_p95} ticks")
+    _emit(
+        "scheduler_fleet_utilization",
+        sched.utilization, "fraction",
+        fifo.utilization,              # baseline = the FIFO run
+        p95_ttp_high_ticks=sched_p95,
+        fifo_p95_ttp_high_ticks=fifo_p95,
+        fifo=fifo.summary(),
+        **sched.summary(),
+    )
+
+
 def bench_serve(args) -> None:
     """Serving data-plane overload bench (ISSUE 7): the open-loop
     generator (fixed arrival rate — requests fire on schedule whether or
@@ -1112,7 +1173,7 @@ def main() -> None:
     p.add_argument("which", nargs="?", default="train",
                    choices=["train", "serving", "serving8b", "resnet",
                             "vit", "mixtral", "hpo", "hpo-platform",
-                            "controlplane", "serve", "longctx",
+                            "controlplane", "serve", "schedule", "longctx",
                             "sp-crossover"])
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)
@@ -1124,7 +1185,15 @@ def main() -> None:
                    choices=["full", "flash", "ring", "ulysses"])
     p.add_argument("--requests", type=int, default=None,
                    help="serving requests (default 48) / hpo trials (16) "
-                        "/ controlplane jobs (1000)")
+                        "/ controlplane jobs (1000) / schedule storm "
+                        "jobs (60)")
+    p.add_argument("--fleet", default="v5e-16=8",
+                   help="schedule bench: fleet spec slice_type=count[,..]")
+    p.add_argument("--pool-size", type=int, default=4,
+                   help="schedule bench: slices per DCN pool")
+    p.add_argument("--seed", type=int, default=1,
+                   help="schedule bench: storm seed (arrivals, widths, "
+                        "priorities, durations)")
     p.add_argument("--namespaces", type=int, default=20,
                    help="controlplane bench: namespaces the job fleet is "
                         "spread across (exercises the per-ns index)")
@@ -1228,6 +1297,7 @@ def main() -> None:
         "hpo": bench_hpo,
         "hpo-platform": bench_hpo_platform,
         "controlplane": bench_controlplane,
+        "schedule": bench_schedule,
         "serve": bench_serve,
         "longctx": bench_longctx,
         "sp-crossover": bench_sp_crossover,

@@ -23,17 +23,16 @@ from __future__ import annotations
 import random
 from typing import Dict, List, Optional
 
-from kubeflow_tpu.controlplane.controllers.tpujob import (
-    JOB_LABEL,
-    PREEMPTION_MESSAGE,
-)
 from kubeflow_tpu.controlplane.runtime import InMemoryApiServer
+from kubeflow_tpu.scheduler.preempt import (
+    PREEMPTIBLE_PHASES,
+    active_slice_groups,
+    preempt_slice_group,
+)
 from kubeflow_tpu.utils import get_logger
 from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
 
 log = get_logger("chaos-preemptor")
-
-PREEMPTIBLE_PHASES = ("Starting", "Running")
 
 
 class SlicePreemptor:
@@ -70,30 +69,22 @@ class SlicePreemptor:
     # ----------------- injection -----------------
 
     def preempt(self, job, slice_id: Optional[int] = None) -> int:
-        """Preempt one slice of ``job``'s gang; returns pods preempted."""
+        """Preempt one slice of ``job``'s gang; returns pods preempted.
+
+        Selection stays seeded (chaos chooses WHICH slice dies); the
+        eviction itself is ``scheduler.preempt.preempt_slice_group`` —
+        the SAME code path the gang scheduler's priority preemption and
+        the defragmenter use, so fault injection can never drift from
+        production eviction semantics."""
         ns, name = job.metadata.namespace, job.metadata.name
-        pods = self.api.list("Pod", namespace=ns,
-                             label_selector={JOB_LABEL: name})
-        groups = sorted({
-            p.spec.scheduler_hints.get("slice-group", "")
-            for p in pods if p.status.phase not in ("Succeeded", "Failed")
-        })
+        groups = active_slice_groups(self.api, job)
         if not groups:
             return 0
         if slice_id is None:
             group = groups[self.rng.randrange(len(groups))]
         else:
             group = f"{name}-{slice_id}"
-        hit = 0
-        for p in pods:
-            if p.spec.scheduler_hints.get("slice-group", "") != group:
-                continue
-            if p.status.phase in ("Succeeded", "Failed"):
-                continue
-            p.status.phase = "Failed"
-            p.status.message = PREEMPTION_MESSAGE
-            self.api.update_status(p)
-            hit += 1
+        hit = preempt_slice_group(self.api, job, group)
         if hit:
             self.total += 1
             self._reclaim(job.spec.slice_type)

@@ -83,10 +83,34 @@ class TpuJobController(Controller):
         capacity: Optional[Dict[str, int]] = None,
         # Per-chip HBM fit check at admission (topology/capacity.py).
         hbm_check: bool = True,
+        # Topology-aware gang scheduler (scheduler.GangScheduler). When
+        # set, it owns status.slice_assignment for the slice types its
+        # fleet manages: placement, priority preemption and restart
+        # adoption all go through it; the admission ledger stays the
+        # quota gate (and the capacity gate for unmanaged types).
+        scheduler=None,
+        # Cross-shard admission ledger client (controlplane.ledger): the
+        # CLUSTER capacity authority behind the leader lease. When set,
+        # slice-capacity reservations route through it instead of the
+        # local capacity map, so two shards cannot double-admit.
+        ledger=None,
+        # How long a blocked (quota/capacity/unschedulable) gang parks
+        # before retrying. Logical-time drivers (the schedule storm) park
+        # effectively forever and retry via ControllerManager.kick_timers
+        # — real-time park timers maturing INSIDE a long drain would
+        # treadmill it.
+        requeue_pending_s: float = 5.0,
     ):
         super().__init__(api, registry)
         self.capacity = capacity
         self.hbm_check = hbm_check
+        self.scheduler = scheduler
+        self.ledger = ledger
+        self.requeue_pending_s = requeue_pending_s
+        # (namespace, name) -> uid of gangs that hold scheduler units or
+        # ledger reservations — releases must survive object deletion,
+        # when reconcile only has the key.
+        self._gang_uids: Dict[Tuple[str, str], str] = {}
         # (model, slice, slices, mesh, batch, seq, mu, model_kw) -> verdict;
         # reconcile re-enters constantly, eval_shape only needs to run once
         # per distinct spec.
@@ -122,10 +146,13 @@ class TpuJobController(Controller):
     def reconcile(self, namespace: str, name: str) -> Result:
         job = self.api.try_get("TpuJob", name, namespace)
         if job is None:
+            self._release_gang_key((namespace, name))
             return Result()  # cascade GC removed dependents
         if job.metadata.deletion_timestamp is not None:
+            self._release_gang(job)
             return Result()
         if job.status.phase in ("Succeeded", "Failed"):
+            self._release_gang(job)
             return Result()
 
         # 1. Validate the topology request.
@@ -154,6 +181,19 @@ class TpuJobController(Controller):
 
         # 2. Quota + capacity gates (gang admission: all or nothing).
         blocked = self._admission_blocked(job, st)
+        # 2b. Placement (ISSUE 8): the admission ledger said "may run";
+        # the scheduler decides WHERE — a concrete slice set — and may
+        # preempt lower-priority gangs to make room. A gang that cannot
+        # place parks Pending exactly like a capacity-blocked one.
+        if blocked is None and self.scheduler is not None \
+                and self.scheduler.manages(job.spec.slice_type):
+            blocked = self._schedule_gang(job)
+            if blocked is not None:
+                # A parked gang must not keep holding admission capacity
+                # it cannot use (units stay free for placeable peers).
+                self._drop_reservation(job.metadata.uid)
+                if self.ledger is not None:
+                    self.ledger.release(job.metadata.uid)
         if blocked:
             import copy
 
@@ -166,7 +206,7 @@ class TpuJobController(Controller):
             )
             if job.status != prev:
                 self.api.update_status(job)
-            return Result(requeue_after=5.0)
+            return Result(requeue_after=self.requeue_pending_s)
 
         n_hosts = st.num_hosts * job.spec.num_slices
 
@@ -233,7 +273,7 @@ class TpuJobController(Controller):
                                           copy=False)
             if int(rq.hard.get("google.com/tpu", "0") or 0) > 0
         ]
-        if not quotas and self.capacity is None:
+        if not quotas and self.capacity is None and self.ledger is None:
             # No gate configured (the unbounded dev/bench path): skip the
             # lock, the cluster-wide job list and the ledger — otherwise
             # every reconcile across the worker pool serializes here for
@@ -250,7 +290,30 @@ class TpuJobController(Controller):
                 # A blocked job parks Pending: it must not keep holding
                 # capacity it admitted for in an earlier pass.
                 self._admission_reserved.pop(job.metadata.uid, None)
-            return blocked
+        if blocked is None and self.ledger is not None and not (
+                self.scheduler is not None
+                and self.scheduler.manages(job.spec.slice_type)):
+            # Cluster slice capacity through the cross-shard ledger (the
+            # leader-lease authority): OUTSIDE the local lock — the
+            # ledger serializes itself, and a slow leader failover must
+            # stall only this key, not every admission in the process.
+            # Scheduler-managed types skip the ledger exactly like the
+            # local capacity count above: the fleet's unit accounting is
+            # the capacity gate there, and a ledger reservation held by
+            # every running victim would block the preemption path
+            # before the scheduler ever saw the high-priority gang.
+            self._remember_gang((job.metadata.namespace,
+                                 job.metadata.name), job.metadata.uid)
+            verdict = self.ledger.try_reserve(
+                job.metadata.uid, job.spec.slice_type, job.spec.num_slices)
+            if verdict is not None:
+                self._drop_reservation(job.metadata.uid)
+                blocked = ("InsufficientCapacity", verdict)
+        return blocked
+
+    def _drop_reservation(self, uid: str) -> None:
+        with self._admission_lock:
+            self._admission_reserved.pop(uid, None)
 
     def _admission_blocked_locked(self, job: TpuJob, chips: int,
                                   quotas: List) -> Optional[tuple]:
@@ -307,8 +370,14 @@ class TpuJobController(Controller):
                         f"needs {chips} chips, {hard - used} available "
                         "in quota",
                     )
-        # Cluster slice capacity.
-        if self.capacity is not None:
+        # Cluster slice capacity. Skipped for slice types the gang
+        # scheduler's fleet manages: there the fleet's unit accounting IS
+        # the capacity gate (counting here too would deadlock preemption
+        # — evicted victims still sit in an in-use phase while the
+        # higher-priority gang admits into their freed units).
+        if self.capacity is not None and not (
+                self.scheduler is not None
+                and self.scheduler.manages(job.spec.slice_type)):
             cap = self.capacity.get(job.spec.slice_type, 0)
             in_use = sum(
                 o.spec.num_slices
@@ -325,6 +394,91 @@ class TpuJobController(Controller):
                     f"{in_use}/{cap} {job.spec.slice_type} slices in use",
                 )
         return None
+
+    # ------------- scheduling (ISSUE 8) -------------
+
+    def _schedule_gang(self, job: TpuJob) -> Optional[tuple]:
+        """Hand the admitted gang to the scheduler. Returns None when the
+        gang holds (or just received) a slice set, else the
+        ``(reason, message)`` that parks it Pending."""
+        import copy
+
+        uid = job.metadata.uid
+        self._remember_gang((job.metadata.namespace, job.metadata.name),
+                            uid)
+        if self.scheduler.assignment_of(uid) is not None:
+            return None
+        if job.status.slice_assignment:
+            # Restart adoption: a controller-manager restart (snapshot
+            # load / WAL replay) must re-pin the EXACT recorded units,
+            # never migrate.
+            if self.scheduler.adopt(job) is not None:
+                return None
+            # Units gone or taken: an evicted gang whose preemption
+            # branch has not cleared status yet. If its pods carry the
+            # failure evidence, let the failure path run — re-placing a
+            # failed gang here would race its own teardown.
+            pods = self.reader.list(
+                "Pod", namespace=job.metadata.namespace,
+                label_selector={JOB_LABEL: job.metadata.name},
+                copy=False,
+            )
+            if any(p.status.phase == "Failed" for p in pods):
+                return None
+        rendered, blocked = self.scheduler.assign(
+            job,
+            jobs=self.reader.list("TpuJob", copy=False),
+            api=self.api,
+            recorder=self.recorder,
+        )
+        if blocked is not None:
+            return blocked
+        prev = copy.deepcopy(job.status)
+        job.status.slice_assignment = rendered
+        if job.status.phase in ("", "Pending"):
+            job.status.phase = "Scheduling"
+        job.status.conditions = set_condition(
+            job.status.conditions,
+            Condition(type="Admitted", status="True", reason="Scheduled",
+                      message=rendered),
+        )
+        if job.status != prev:
+            self.api.update_status(job)
+        return None
+
+    def _release_uid(self, uid: str) -> None:
+        """THE one release sequence: admission reservation, scheduler
+        units, ledger reservation. Idempotent."""
+        self._drop_reservation(uid)
+        if self.scheduler is not None:
+            self.scheduler.release(uid)
+        if self.ledger is not None:
+            self.ledger.release(uid)
+
+    def _remember_gang(self, key: Tuple[str, str], uid: str) -> None:
+        """Track key -> uid for release-after-deletion. A DIFFERENT uid
+        already remembered under the key means the object was deleted
+        and recreated between reconciles (the workqueue coalesced both
+        events, so the job-is-None release never ran): free everything
+        the ghost uid still holds before it leaks."""
+        old = self._gang_uids.get(key)
+        if old is not None and old != uid:
+            self._release_uid(old)
+        self._gang_uids[key] = uid
+
+    def _release_gang(self, job: TpuJob) -> None:
+        """Free everything a finished/removed gang holds."""
+        self._gang_uids.pop(
+            (job.metadata.namespace, job.metadata.name), None)
+        self._release_uid(job.metadata.uid)
+
+    def _release_gang_key(self, key: Tuple[str, str]) -> None:
+        """Release by (namespace, name) after the object is gone —
+        reconcile then only has the key; the uid was remembered when the
+        gang admitted."""
+        uid = self._gang_uids.pop(key, None)
+        if uid is not None:
+            self._release_uid(uid)
 
     # ------------- pod template -------------
 
@@ -451,9 +605,13 @@ class TpuJobController(Controller):
                 except (ValueError, AttributeError):
                     pass
         job.status.coordinator_address = coordinator
-        job.status.slice_assignment = (
-            f"{job.spec.slice_type}x{job.spec.num_slices}"
-        )
+        if not (self.scheduler is not None
+                and self.scheduler.manages(job.spec.slice_type)):
+            # Legacy shape-only assignment; with a scheduler the field
+            # carries the concrete slice set _schedule_gang placed.
+            job.status.slice_assignment = (
+                f"{job.spec.slice_type}x{job.spec.num_slices}"
+            )
 
         phases = list(states.values())
         n_running = sum(1 for p in phases if p == "Running")
@@ -490,6 +648,13 @@ class TpuJobController(Controller):
                 # budget (the gang re-enters admission, so a reclaimed
                 # slice parks it Pending until capacity returns).
                 job.status.preemptions += 1
+                # The old slice set is gone (reclaimed by hardware, the
+                # scheduler, or the defragmenter): clear the assignment
+                # so the restart re-places instead of re-pinning.
+                if self.scheduler is not None \
+                        and self.scheduler.manages(job.spec.slice_type):
+                    job.status.slice_assignment = ""
+                    self.scheduler.release(job.metadata.uid)
                 self._commit_restart_status(job)
                 self.metrics_restarts.inc(reason="preempted")
                 self.recorder.event(

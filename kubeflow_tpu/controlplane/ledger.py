@@ -1,0 +1,485 @@
+"""Cross-shard admission ledger behind the leader lease (ISSUE 8, the
+PR-6 follow-up).
+
+The sharded control plane routes TpuJobs by namespace, so two shards
+each see only their own jobs — a per-shard ``capacity`` map lets both
+admit "the last v5e-16 slice" at once (double-admit). This module makes
+slice-capacity reservations a SINGLETON service owned by whichever
+shard holds the leader lease:
+
+- :class:`CapacityLedger` — the authoritative ledger: capacity map plus
+  ``uid -> (slice_type, num_slices)`` reservations. A gang holds its
+  reservation from admission until the owning controller releases it
+  (terminal phase / deletion / parked). Reserve is idempotent per uid.
+- :class:`LedgerService` — a thread the LEASE-HOLDING shard runs: it
+  answers requests arriving on its serve pipe against the authoritative
+  ledger. Every mutation is journaled (fsync'd jsonl) when a journal
+  path is given, so the NEXT leader replays to the exact reservation
+  state after a failover — the same WAL discipline the store uses.
+- :class:`LedgerClient` — the :class:`TpuJobController` hook
+  (``ledger=``): ``try_reserve`` / ``release`` over the shard's pipe,
+  request-id-matched (stale replies dropped), with a timeout verdict
+  that fails CLOSED (the gang parks Pending and retries; an unreachable
+  ledger must never admit).
+- :class:`LedgerRelay` — the parent-process transport thread: forwards
+  each shard's requests to the current leader's serve pipe. Pure
+  routing, no ledger state — the authority stays behind the lease.
+
+Why pipes + a relay instead of one shared ``mp.Queue``: a queue's
+reader lock is held WHILE blocked in ``get``, so SIGKILLing the leader
+mid-poll leaves the lock owned by a dead process and deadlocks every
+future leader. Pipe ends are single-process; a killed peer can at worst
+leave its own stream torn, which the relay absorbs as a timeout — and a
+timeout is exactly the fail-closed path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from multiprocessing.connection import wait as conn_wait
+from typing import Dict, Optional, Tuple
+
+from kubeflow_tpu.utils import get_logger
+
+log = get_logger("ledger")
+
+LEDGER_JOURNAL = "ledger.jsonl"
+
+#: client_id the parent's own diagnostic client uses with the relay.
+PARENT_CLIENT = -1
+
+
+def ledger_journal_path(state_dir: str) -> str:
+    return os.path.join(state_dir, LEDGER_JOURNAL)
+
+
+class CapacityLedger:
+    """Authoritative slice-capacity reservations. Thread-safe."""
+
+    def __init__(self, capacity: Dict[str, int]):
+        self._capacity = {k: int(v) for k, v in capacity.items()}
+        self._held: Dict[str, Tuple[str, int]] = {}
+        self._lock = threading.Lock()
+
+    def reserve(self, uid: str, slice_type: str,
+                num_slices: int) -> Tuple[Optional[str], bool]:
+        """``(verdict, changed)``: verdict None = reserved (idempotent
+        per uid — re-admitting the same gang re-checks against everyone
+        else), else the blocking reason. ``changed`` is False when the
+        call left the ledger exactly as it was (the steady-state
+        re-reserve every reconcile performs) — the journal skips those,
+        or it would fsync one redundant record per reconcile per job."""
+        with self._lock:
+            cap = self._capacity.get(slice_type, 0)
+            in_use = sum(
+                n for held_uid, (st, n) in self._held.items()
+                if st == slice_type and held_uid != uid
+            )
+            if in_use + num_slices > cap:
+                # A blocked gang must not keep an older reservation.
+                dropped = self._held.pop(uid, None) is not None
+                return (f"{in_use}/{cap} {slice_type} slices reserved "
+                        "cluster-wide", dropped)
+            want = (slice_type, int(num_slices))
+            changed = self._held.get(uid) != want
+            self._held[uid] = want
+            return (None, changed)
+
+    def try_reserve(self, uid: str, slice_type: str,
+                    num_slices: int) -> Optional[str]:
+        return self.reserve(uid, slice_type, num_slices)[0]
+
+    def release(self, uid: str) -> bool:
+        with self._lock:
+            return self._held.pop(uid, None) is not None
+
+    def held_uids(self) -> list:
+        with self._lock:
+            return sorted(self._held)
+
+    def records(self) -> list:
+        """The live reservations as journal records — what a compacted
+        journal contains."""
+        with self._lock:
+            return [
+                {"op": "reserve", "uid": uid, "slice_type": st,
+                 "num_slices": n}
+                for uid, (st, n) in sorted(self._held.items())
+            ]
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            in_use: Dict[str, int] = {}
+            for st, n in self._held.values():
+                in_use[st] = in_use.get(st, 0) + n
+            return {
+                "capacity": dict(self._capacity),
+                "in_use": in_use,
+                "reservations": len(self._held),
+            }
+
+
+class _Journal:
+    def __init__(self, path: str, fsync: bool):
+        self.path = path
+        self.fsync = fsync
+        self._f = None
+
+    def replay_into(self, ledger: CapacityLedger) -> int:
+        if not self.path or not os.path.exists(self.path):
+            return 0
+        n = 0
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    break       # torn tail record: crash mid-append
+                if rec.get("op") == "reserve":
+                    ledger.try_reserve(rec["uid"], rec["slice_type"],
+                                       rec["num_slices"])
+                elif rec.get("op") == "release":
+                    ledger.release(rec["uid"])
+                n += 1
+        return n
+
+    def append(self, rec: dict) -> None:
+        if not self.path:
+            return
+        if self._f is None:
+            self._f = open(self.path, "a")
+        self._f.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._f.flush()
+        if self.fsync:
+            os.fsync(self._f.fileno())
+
+    def rewrite(self, records: list) -> None:
+        """Compact: replace the log with exactly the live reservations
+        (atomic temp+rename, same discipline as Platform.save) — the
+        replay-everything cost of a failover stays bounded by live
+        reservations, not by history."""
+        if not self.path:
+            return
+        self.close()
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            for rec in records:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class LedgerService:
+    """The leader-side half: answers ``(req_id, op, args)`` requests on
+    ``serve_conn`` against the authoritative :class:`CapacityLedger`.
+    ``start()`` replays the journal first — a new leader resumes the OLD
+    leader's reservation state, which is what makes failover safe rather
+    than a fresh double-admit window."""
+
+    def __init__(self, capacity: Dict[str, int], serve_conn, *,
+                 journal_path: str = "", fsync: bool = True):
+        self.ledger = CapacityLedger(capacity)
+        self.serve_conn = serve_conn
+        self.journal = _Journal(journal_path, fsync)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.served = 0
+
+    def start(self) -> "LedgerService":
+        replayed = self.journal.replay_into(self.ledger)
+        if replayed:
+            log.info("ledger journal replayed", kv={
+                "records": replayed,
+                "reservations": self.ledger.snapshot()["reservations"],
+            })
+            # Compact behind the replay: the next failover replays only
+            # the live reservations, never the whole reserve/release
+            # history.
+            self.journal.rewrite(self.ledger.records())
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="kftpu-ledger")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.journal.close()
+
+    def handle(self, op: str, args: tuple):
+        """One ledger operation (journal included) — the serve loop's
+        body, also callable directly by a leader-local client."""
+        if op == "reserve":
+            uid, slice_type, num_slices = args
+            verdict, changed = self.ledger.reserve(uid, slice_type,
+                                                   num_slices)
+            # Journal only MUTATIONS: the steady-state idempotent
+            # re-reserve (every reconcile of every running gang) must
+            # not fsync a record. A denial that dropped a stale hold is
+            # a mutation too — journal the release so replay converges.
+            if changed:
+                if verdict is None:
+                    self.journal.append({"op": "reserve", "uid": uid,
+                                         "slice_type": slice_type,
+                                         "num_slices": num_slices})
+                else:
+                    self.journal.append({"op": "release", "uid": uid})
+            return verdict
+        if op == "release":
+            (uid,) = args
+            if self.ledger.release(uid):
+                self.journal.append({"op": "release", "uid": uid})
+            return None
+        if op == "prune":
+            # Anti-entropy GC (operator/parent-driven): drop every
+            # reservation whose gang is no longer alive anywhere — the
+            # leak path is a gang deleted while its owning controller
+            # was down (nobody left to release by uid).
+            (live_uids,) = args
+            live = set(live_uids)
+            dropped = [uid for uid in self.ledger.held_uids()
+                       if uid not in live]
+            for uid in dropped:
+                if self.ledger.release(uid):
+                    self.journal.append({"op": "release", "uid": uid})
+            if dropped:
+                log.warning("ledger pruned orphan reservations",
+                            kv={"dropped": len(dropped)})
+            return dropped
+        if op == "snapshot":
+            return self.ledger.snapshot()
+        return f"unknown ledger op {op!r}"
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if not self.serve_conn.poll(0.05):
+                    continue
+                req_id, op, args = self.serve_conn.recv()
+                payload = self.handle(op, args)
+                self.served += 1
+                self.serve_conn.send((req_id, payload))
+            except (EOFError, OSError):
+                return          # transport gone: leadership moved on
+            except Exception as e:  # noqa: BLE001 — service must survive
+                log.error("ledger request failed", kv={"err": repr(e)})
+
+
+class LedgerClient:
+    """The shard-side handle the TpuJobController admission path calls.
+    Fails CLOSED: a timeout (leader dead, election in flight) reports
+    the gang blocked — it parks Pending and retries on its admission
+    requeue, which is exactly the window a failover needs."""
+
+    UNAVAILABLE = ("admission ledger unavailable (leader failover in "
+                   "progress); retrying")
+
+    def __init__(self, conn, *, timeout_s: float = 5.0):
+        self.conn = conn
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def _call(self, op: str, args: tuple):
+        import time as _time
+
+        with self._lock:
+            self._seq += 1
+            req_id = self._seq
+            try:
+                self.conn.send((req_id, op, args))
+            except (OSError, ValueError):
+                raise TimeoutError
+            t0 = _time.monotonic()
+            while True:
+                remaining = self.timeout_s - (_time.monotonic() - t0)
+                if remaining <= 0 or not self.conn.poll(remaining):
+                    raise TimeoutError
+                try:
+                    got_id, payload = self.conn.recv()
+                except (EOFError, OSError):
+                    raise TimeoutError
+                if got_id == req_id:
+                    return payload
+                # Stale reply from a timed-out earlier call: drop it —
+                # matching on req_id keeps a late answer from being read
+                # as the verdict of a NEWER question.
+
+    def try_reserve(self, uid: str, slice_type: str,
+                    num_slices: int) -> Optional[str]:
+        try:
+            return self._call("reserve", (uid, slice_type, num_slices))
+        except TimeoutError:
+            return self.UNAVAILABLE
+
+    def release(self, uid: str) -> None:
+        try:
+            self._call("release", (uid,))
+        except TimeoutError:
+            pass    # the journal replay / later reconcile releases it
+
+    def snapshot(self) -> Optional[Dict[str, object]]:
+        try:
+            return self._call("snapshot", ())
+        except TimeoutError:
+            return None
+
+
+class LocalLedgerClient:
+    """In-process client for a single-process deployment (or tests):
+    same interface, no transport."""
+
+    def __init__(self, service: LedgerService):
+        self.service = service
+
+    def try_reserve(self, uid, slice_type, num_slices):
+        return self.service.handle("reserve", (uid, slice_type,
+                                               num_slices))
+
+    def release(self, uid) -> None:
+        self.service.handle("release", (uid,))
+
+    def snapshot(self):
+        return self.service.handle("snapshot", ())
+
+
+class LedgerRelay:
+    """Parent-side transport: forwards each client pipe's requests to
+    the CURRENT leader's serve pipe and routes the answer back. Holds NO
+    ledger state — a relay restart loses nothing, and a dead leader
+    surfaces as a timeout (the client's fail-closed path). ``leader_of``
+    is read per request, so an election immediately redirects traffic."""
+
+    def __init__(self, client_conns: Dict[int, object],
+                 serve_conns: Dict[int, object], leader_of,
+                 *, leader_timeout_s: float = 5.0):
+        self.client_conns = dict(client_conns)
+        self.serve_conns = dict(serve_conns)
+        self.leader_of = leader_of          # () -> Optional[int]
+        self.leader_timeout_s = leader_timeout_s
+        self._conn_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.forwarded = 0
+        # Relay-global forward ids: per-CLIENT req_ids collide across
+        # clients (every LedgerClient counts from 1), so a late reply to
+        # shard A's timed-out request could otherwise be matched to
+        # shard B's next forward carrying the same number.
+        self._fwd_seq = 0
+
+    def replace(self, client_id: int, client_conn, serve_conn) -> None:
+        """Swap in FRESH pipes for a (re)spawned shard, closing the old
+        ones. A shard SIGKILLed mid-send leaves a torn pickle frame in
+        its old pipe that no amount of recv() resynchronizes — the
+        respawn must start on clean streams."""
+        with self._conn_lock:
+            old_client = self.client_conns.get(client_id)
+            old_serve = self.serve_conns.get(client_id)
+            self.client_conns[client_id] = client_conn
+            self.serve_conns[client_id] = serve_conn
+        for old in (old_client, old_serve):
+            if old is not None:
+                try:
+                    old.close()
+                except OSError:
+                    pass
+
+    def start(self) -> "LedgerRelay":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="kftpu-ledger-relay")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _forward(self, client_id: int, msg) -> None:
+        import time as _time
+
+        req_id, op, args = msg
+        leader = self.leader_of()
+        reply = (req_id,
+                 LedgerClient.UNAVAILABLE if op == "reserve" else None)
+        if leader is not None:
+            with self._conn_lock:
+                conn = self.serve_conns.get(leader)
+            if conn is not None:
+                # Re-tag with a relay-global id and match the answer to
+                # THIS forward: a delayed reply to an earlier timed-out
+                # forward (possibly from a DIFFERENT client whose own
+                # req_id happens to collide) must be dropped, never
+                # delivered as this request's verdict — mis-delivering a
+                # 'reserved' is exactly the double-admit this service
+                # exists to prevent.
+                self._fwd_seq += 1
+                fwd_id = self._fwd_seq
+                try:
+                    conn.send((fwd_id, op, args))
+                    deadline = _time.monotonic() + self.leader_timeout_s
+                    while True:
+                        remaining = deadline - _time.monotonic()
+                        if remaining <= 0 or not conn.poll(remaining):
+                            break
+                        got_id, payload = conn.recv()
+                        if got_id == fwd_id:
+                            reply = (req_id, payload)
+                            break
+                except (EOFError, OSError):
+                    pass        # leader died mid-request: fail closed
+        try:
+            self.client_conns[client_id].send(reply)
+            self.forwarded += 1
+        except (EOFError, OSError):
+            pass                # requester died: nothing to answer
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            # Snapshot per pass: `replace` swaps in fresh pipes when a
+            # shard respawns (old ends are closed — wait() then drops
+            # them here rather than erroring forever).
+            with self._conn_lock:
+                conns = {id(c): (cid, c)
+                         for cid, c in self.client_conns.items()}
+            if not conns:
+                self._stop.wait(0.05)
+                continue
+            try:
+                ready = conn_wait([c for _, c in conns.values()],
+                                  timeout=0.05)
+            except OSError:
+                continue        # a conn closed mid-wait: re-snapshot
+            for conn in ready:
+                cid, _ = conns[id(conn)]
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    # Dead shard: its end hit EOF, which wait() reports
+                    # as forever-readable — retire the conn or this loop
+                    # busy-spins until the respawn swaps in fresh pipes.
+                    with self._conn_lock:
+                        if self.client_conns.get(cid) is conn:
+                            del self.client_conns[cid]
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    continue
+                except Exception:   # torn pickle from a mid-send kill
+                    continue
+                self._forward(cid, msg)

@@ -94,6 +94,7 @@ class Platform:
                                          tracer=self.tracer,
                                          workers=workers)
         self.kfam: Optional[AccessManagement] = None
+        self.scheduler = None    # GangScheduler when a fleet is configured
         self.jwa = None          # NotebookWebApp when enabled
         self.dashboard = None    # DashboardApi when enabled
         self.prober = None       # AvailabilityProber when enabled
@@ -193,8 +194,42 @@ class Platform:
                         kv.split("=") for kv in params["capacity"].split(",")
                     )
                 }
+            scheduler = None
+            if "fleet" in params:
+                # Topology-aware gang scheduler (ISSUE 8): a fleet spec
+                # like "v5e-16=8,v5e-32=4" builds slice pools with DCN
+                # adjacency; the scheduler then owns slice_assignment
+                # for those types and a DefragController consolidates
+                # free slices in the background.
+                from kubeflow_tpu.scheduler import (
+                    DefragController,
+                    Fleet,
+                    GangScheduler,
+                )
+
+                fleet_cap = {
+                    k: int(v) for k, v in (
+                        kv.split("=") for kv in params["fleet"].split(",")
+                    )
+                }
+                fleet = Fleet.from_capacity(
+                    fleet_cap,
+                    pool_size=int(params.get("poolSize", 8)))
+                scheduler = GangScheduler(
+                    fleet, registry=reg, tracer=self.tracer,
+                    policy=params.get("schedulerPolicy", "priority"))
+                self.scheduler = scheduler
+                if params.get("defrag", "true") != "false":
+                    self.manager.register(DefragController(
+                        self.api, reg, scheduler=scheduler,
+                        tracer=self.tracer,
+                        threshold=float(params.get("defragThreshold", 0.5)),
+                        interval_s=float(
+                            params.get("defragIntervalSeconds", 30)),
+                    ))
             self.manager.register(TpuJobController(self.api, reg,
-                                                   capacity=capacity))
+                                                   capacity=capacity,
+                                                   scheduler=scheduler))
         elif name == "studyjob-controller":
             self.manager.register(StudyJobController(self.api, reg))
         elif name == "notebook-controller":

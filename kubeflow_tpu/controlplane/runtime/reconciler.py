@@ -786,6 +786,15 @@ class ControllerManager:
                 _, _, ctl, key = heapq.heappop(self._timers)
                 self._pending_add_locked(ctl, key)
 
+    def kick_timers(self, within: float) -> None:
+        """Fire every parked requeue timer due within ``within`` seconds
+        EXACTLY ONCE (enqueue its key now). The storm/soak drivers' tick
+        primitive: ``run_until_idle(include_timers_within=W)`` with W
+        past a park interval re-fires a still-parked key every drain pass
+        (the documented spin), while one kick before a narrow-window
+        drain retries each parked gang once per tick."""
+        self._fast_forward_timers(within)
+
     def run_until_idle(self, max_iterations: int = 10000, include_timers_within: float = 0.0) -> int:
         """Drain watches + queue until no immediate work remains. Returns the
         number of reconciles executed. Timers due within

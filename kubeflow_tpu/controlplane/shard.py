@@ -138,6 +138,15 @@ class ShardSpec:
     capacity: Optional[Dict[str, int]] = None
     wal_fsync: bool = True
     bookmark_interval: int = 50
+    # Cross-shard admission ledger (ISSUE 8): CLUSTER slice capacity,
+    # served by the lease-holding shard. Mutually exclusive with the
+    # per-shard ``capacity`` map — that one is exactly the double-admit
+    # hazard the ledger exists to close. ``ledger_conn`` is this shard's
+    # client pipe to the parent relay; ``ledger_serve_conn`` is the pipe
+    # the shard answers on WHEN it holds the lease.
+    global_capacity: Optional[Dict[str, int]] = None
+    ledger_conn: Any = None
+    ledger_serve_conn: Any = None
 
 
 class ShardSingleton:
@@ -227,8 +236,17 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
                                           + spec.shard_id),
     )
     capacity = dict(spec.capacity) if spec.capacity else None
+    ledger_client = None
+    if spec.global_capacity is not None:
+        from kubeflow_tpu.controlplane.ledger import LedgerClient
+
+        # Slice capacity is CLUSTER state: reservations route (via the
+        # parent relay) to the lease holder's LedgerService, never a
+        # per-shard map — a local map on two shards is exactly the
+        # double-admit the PR-6 follow-up left open.
+        ledger_client = LedgerClient(spec.ledger_conn)
     job_ctl = TpuJobController(front, registry, capacity=capacity,
-                               hbm_check=False)
+                               hbm_check=False, ledger=ledger_client)
     mgr.register(job_ctl)
 
     seen: Dict[str, int] = {}
@@ -255,6 +273,33 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
 
     singleton: Optional[Controller] = None
     leading = False
+    ledger_service = None
+
+    def _set_leading(want: bool) -> None:
+        nonlocal ledger_service
+        if spec.global_capacity is None:
+            return
+        from kubeflow_tpu.controlplane.ledger import (
+            LedgerService,
+            ledger_journal_path,
+        )
+
+        if want and ledger_service is None:
+            # The lease holder serves the cluster ledger. The journal
+            # lives at the state-dir ROOT (not per-shard): the lease
+            # moves, and the next leader must replay the SAME
+            # reservation history or the failover reopens the
+            # double-admit window.
+            ledger_service = LedgerService(
+                spec.global_capacity,
+                spec.ledger_serve_conn,
+                journal_path=(ledger_journal_path(spec.state_dir)
+                              if spec.state_dir else ""),
+                fsync=spec.wal_fsync,
+            ).start()
+        elif not want and ledger_service is not None:
+            ledger_service.stop()
+            ledger_service = None
 
     def handle(msg: Tuple) -> Any:
         nonlocal singleton, leading
@@ -267,6 +312,12 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
             return n
         if cmd == "round":
             window = float(msg[1])
+            # Optional third field: fire parked requeue timers due within
+            # that many seconds ONCE before draining — the retry
+            # primitive for capacity/ledger-parked gangs (a drain window
+            # wider than the 5s park interval would spin instead).
+            if len(msg) > 2 and msg[2]:
+                mgr.kick_timers(float(msg[2]))
             n = mgr.run_until_idle(max_iterations=500000,
                                    include_timers_within=window)
             kubelet.tick()
@@ -307,8 +358,23 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
             elif not want and singleton is not None:
                 mgr.unregister(singleton)
                 singleton = None
+            _set_leading(want)
             leading = want
             return leading
+        if cmd == "ledger":
+            return (ledger_service.ledger.snapshot()
+                    if ledger_service is not None else None)
+        if cmd == "ledger_prune":
+            # Anti-entropy GC, leader only: drop reservations whose gang
+            # exists on NO shard (deleted while its owning controller
+            # was down — nobody left to release by uid).
+            if ledger_service is None:
+                return None
+            return ledger_service.handle("prune", (msg[1],))
+        if cmd == "job_uids":
+            return [j.metadata.uid
+                    for j in api.list("TpuJob", copy=False)
+                    if j.status.phase not in ("Succeeded", "Failed")]
         if cmd == "info":
             return {
                 "shard_id": spec.shard_id,
@@ -338,6 +404,8 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
                 conn.send(("err", traceback.format_exc()))
     finally:
         mgr.close()
+        if ledger_service is not None:
+            ledger_service.stop()
         if wal is not None:
             wal.close()
 
@@ -375,6 +443,7 @@ class ShardedControlPlane:
         transient_rate: float = 0.0,
         work_ticks: int = 0,
         capacity_by_shard: Optional[Dict[int, Dict[str, int]]] = None,
+        global_capacity: Optional[Dict[str, int]] = None,
         wal_fsync: bool = True,
         start_method: str = "fork",
     ):
@@ -389,6 +458,28 @@ class ShardedControlPlane:
         if start_method not in multiprocessing.get_all_start_methods():
             start_method = "spawn"
         self._ctx = multiprocessing.get_context(start_method)
+        # Cross-shard admission ledger plumbing (ISSUE 8): per-shard
+        # client and serve PIPES plus a parent-side relay thread that
+        # forwards every request to the current lease holder. Pipes, not
+        # a shared queue: a queue's reader lock is held while blocked in
+        # get, so SIGKILLing the leader mid-poll would leave the lock
+        # owned by a corpse and deadlock every future leader; pipe ends
+        # are single-process and a dead peer degrades to a timeout —
+        # the fail-closed path. Each (re)spawn mints FRESH pipes (see
+        # _spawn): a shard killed mid-send leaves a torn pickle frame no
+        # recv() can resynchronize, so the respawn must not re-inherit
+        # the old stream.
+        self._global_capacity = (dict(global_capacity)
+                                 if global_capacity is not None else None)
+        self._ledger_child_conns: Dict[int, Any] = {}
+        self._ledger_serve_child: Dict[int, Any] = {}
+        self._ledger_relay = None
+        if self._global_capacity is not None:
+            from kubeflow_tpu.controlplane.ledger import LedgerRelay
+
+            self._ledger_relay = LedgerRelay(
+                {}, {}, leader_of=lambda: self.leader_id,
+            ).start()
         self._procs: Dict[int, Any] = {}
         self._conns: Dict[int, Any] = {}
         self._dead: set = set()
@@ -403,9 +494,23 @@ class ShardedControlPlane:
     def _spec(self, shard_id: int) -> ShardSpec:
         return ShardSpec(shard_id=shard_id, num_shards=self.num_shards,
                          capacity=self._capacity_by_shard.get(shard_id),
+                         global_capacity=self._global_capacity,
+                         ledger_conn=self._ledger_child_conns.get(shard_id),
+                         ledger_serve_conn=(
+                             self._ledger_serve_child.get(shard_id)),
                          **self._base)
 
     def _spawn(self, shard_id: int) -> None:
+        if self._ledger_relay is not None:
+            # Fresh ledger pipes for every (re)spawn: the relay swaps
+            # them in and closes the previous pair, so a stream torn by
+            # a mid-send SIGKILL dies with the process that tore it.
+            client_parent, client_child = self._ctx.Pipe()
+            serve_parent, serve_child = self._ctx.Pipe()
+            self._ledger_child_conns[shard_id] = client_child
+            self._ledger_serve_child[shard_id] = serve_child
+            self._ledger_relay.replace(shard_id, client_parent,
+                                       serve_parent)
         parent_conn, child_conn = self._ctx.Pipe()
         proc = self._ctx.Process(
             target=_shard_worker, args=(child_conn, self._spec(shard_id)),
@@ -413,6 +518,11 @@ class ShardedControlPlane:
         )
         proc.start()
         child_conn.close()
+        if self._ledger_relay is not None:
+            # The child inherited its ledger ends at fork; drop the
+            # parent's copies (the relay holds the parent-side ends).
+            self._ledger_child_conns.pop(shard_id).close()
+            self._ledger_serve_child.pop(shard_id).close()
         self._procs[shard_id] = proc
         self._conns[shard_id] = parent_conn
         self._dead.discard(shard_id)
@@ -448,6 +558,8 @@ class ShardedControlPlane:
         self._elect()
 
     def close(self) -> None:
+        if self._ledger_relay is not None:
+            self._ledger_relay.stop()
         for i in self.alive():
             try:
                 self._call(i, "stop")
@@ -545,9 +657,15 @@ class ShardedControlPlane:
             out[shard_id] = self._call(shard_id, "create", batch)
         return out
 
-    def round(self, window: float = 30.0) -> Dict[int, Dict[str, Any]]:
-        """One reconcile round on every live shard, concurrently."""
-        return self._broadcast("round", window)
+    def round(self, window: float = 30.0,
+              kick: float = 0.0) -> Dict[int, Dict[str, Any]]:
+        """One reconcile round on every live shard, concurrently.
+        ``kick`` > 0 first fires parked requeue timers due within that
+        many seconds exactly once (see ``ControllerManager.kick_timers``)
+        so capacity-parked gangs retry each round without the drain
+        window having to exceed — and then spin on — their park
+        interval."""
+        return self._broadcast("round", window, kick)
 
     def quiesce(self) -> None:
         self._broadcast("quiesce")
@@ -557,6 +675,28 @@ class ShardedControlPlane:
 
     def info(self) -> Dict[int, Dict[str, Any]]:
         return {i: self._call(i, "info") for i in self.alive()}
+
+    def ledger_snapshot(self) -> Optional[Dict[str, Any]]:
+        """The leader's admission-ledger state (None when no global
+        capacity is configured or no leader is alive)."""
+        if self.leader_id is None:
+            return None
+        return self._call(self.leader_id, "ledger")
+
+    def ledger_gc(self) -> Optional[list]:
+        """Anti-entropy for the admission ledger: collect every live
+        (non-terminal) TpuJob uid across ALL shards and have the leader
+        drop reservations held by gangs that exist nowhere — the leak
+        path is a gang deleted while its owning controller was down.
+        Returns the pruned uids (None without a configured ledger).
+        Call from a quiesced plane: a uid snapshot racing an in-flight
+        admission could prune a reservation whose gang is mid-create."""
+        if self.leader_id is None or self._global_capacity is None:
+            return None
+        live: List[str] = []
+        for uids in self._broadcast("job_uids").values():
+            live.extend(uids)
+        return self._call(self.leader_id, "ledger_prune", live)
 
     def shard_rows(self, shard_id: int) -> List[Tuple[str, str, str, str]]:
         return [tuple(r) for r in self._call(shard_id, "fingerprint")]

@@ -1,0 +1,43 @@
+"""Topology-aware gang scheduler (ISSUE 8).
+
+The placement half the admission ledger never had: a fleet model with
+DCN-adjacency coordinates (``fleet``), a bin-packing placement engine
+(``placement``), preemption as policy through the one shared eviction
+path (``preempt``), the ``GangScheduler`` decision core (``core``), the
+background defragmenter (``defrag``) and the mixed-priority arrival
+storm bench driver (``benchmark``). See docs/scheduler.md.
+"""
+
+from kubeflow_tpu.scheduler.core import GangScheduler
+from kubeflow_tpu.scheduler.defrag import DefragController
+from kubeflow_tpu.scheduler.fleet import Fleet, SlicePool, SliceUnit
+from kubeflow_tpu.scheduler.placement import (
+    Placement,
+    PlacementEngine,
+    parse_assignment,
+)
+from kubeflow_tpu.scheduler.preempt import (
+    PREEMPTIBLE_PHASES,
+    active_slice_groups,
+    is_restartable_victim,
+    preempt_gang,
+    preempt_slice_group,
+    select_victims,
+)
+
+__all__ = [
+    "DefragController",
+    "Fleet",
+    "GangScheduler",
+    "PREEMPTIBLE_PHASES",
+    "Placement",
+    "PlacementEngine",
+    "SlicePool",
+    "SliceUnit",
+    "active_slice_groups",
+    "is_restartable_victim",
+    "parse_assignment",
+    "preempt_gang",
+    "preempt_slice_group",
+    "select_victims",
+]

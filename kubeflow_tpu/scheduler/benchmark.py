@@ -1,0 +1,394 @@
+"""Mixed-priority arrival storm: the ``bench.py schedule`` driver.
+
+Drives a seeded storm of TpuJobs — three priority classes, mixed gang
+widths, seeded arrival ticks and durations — through the REAL control
+plane (apiserver, reconciler kernel, TpuJobController, FakeKubelet) under
+two scheduling policies on the SAME fleet:
+
+- ``fifo``: strict arrival order with head-of-line blocking, no
+  preemption — the baseline the dynamic-DL-scheduling paper
+  (arxiv 1908.08082) measures against;
+- ``priority``: best-fit bin-packing with backfill, minimal-set
+  preemption of lower-priority restartable gangs, and (optionally) the
+  background defragmenter.
+
+Time is LOGICAL (driver ticks, sleep-free): a gang's time-to-placement
+is ``placed_tick - arrival_tick`` and utilization is the mean assigned
+fraction per tick — deterministic for a given seed, so the CI
+``schedule-smoke`` gates on exact counts, never wall-clock.
+
+Hard invariants every run must satisfy (the bench raises otherwise):
+
+- **exact gang accounting**: placed + preempted-awaiting-replacement +
+  never-placed == submitted, each gang in exactly one bucket;
+- **zero priority inversions**: no eviction of a gang at >= the
+  requester's priority (checked against the scheduler's decision log
+  AND its inversion counter).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional
+
+from kubeflow_tpu.controlplane.api.meta import ObjectMeta
+from kubeflow_tpu.controlplane.api.types import (
+    MeshAxesSpec,
+    TpuJob,
+    TpuJobSpec,
+)
+from kubeflow_tpu.controlplane.controllers.podrunner import FakeKubelet
+from kubeflow_tpu.controlplane.controllers.tpujob import TpuJobController
+from kubeflow_tpu.controlplane.runtime import (
+    ControllerManager,
+    InMemoryApiServer,
+)
+from kubeflow_tpu.scheduler.core import GangScheduler
+from kubeflow_tpu.scheduler.defrag import DefragController
+from kubeflow_tpu.scheduler.fleet import Fleet
+from kubeflow_tpu.utils.monitoring import (
+    MetricsRegistry,
+    nearest_rank_quantile,
+)
+from kubeflow_tpu.utils.tracing import Tracer
+
+#: Priority classes of the storm (name, spec.priority, arrival weight).
+PRIORITY_CLASSES = (("high", 10, 0.10), ("normal", 5, 0.20),
+                    ("batch", 0, 0.70))
+
+STORM_NAMESPACE = "storm"
+
+
+@dataclasses.dataclass
+class StormJob:
+    name: str
+    priority: int
+    klass: str                   # "high" | "normal" | "batch"
+    num_slices: int
+    arrival_tick: int
+    duration_ticks: int
+
+
+def make_storm(
+    num_jobs: int,
+    *,
+    seed: int = 0,
+    arrival_span: int = 12,
+    slice_widths=((1, 0.60), (2, 0.25), (4, 0.15)),
+    min_duration: int = 2,
+    max_duration: int = 6,
+) -> List[StormJob]:
+    """The seeded storm manifest: same seed, same storm — both policies
+    replay the identical arrival sequence."""
+    rng = random.Random(seed)
+    jobs = []
+    for i in range(num_jobs):
+        roll = rng.random()
+        acc = 0.0
+        klass, priority = "batch", 0
+        for name, prio, weight in PRIORITY_CLASSES:
+            acc += weight
+            if roll < acc:
+                klass, priority = name, prio
+                break
+        roll = rng.random()
+        acc = 0.0
+        width = slice_widths[-1][0]
+        for w, weight in slice_widths:
+            acc += weight
+            if roll < acc:
+                width = w
+                break
+        jobs.append(StormJob(
+            name=f"job-{i:03d}",
+            priority=priority,
+            klass=klass,
+            num_slices=width,
+            arrival_tick=rng.randrange(arrival_span),
+            duration_ticks=rng.randint(min_duration, max_duration),
+        ))
+    return jobs
+
+
+@dataclasses.dataclass
+class StormReport:
+    policy: str
+    submitted: int
+    ticks: int                   # makespan (ticks until all gangs ended)
+    converged: bool              # every gang reached a terminal phase
+    # Final-state buckets (the exact-accounting gate).
+    placed: int                  # placed at least once, ended/holding
+    preempted_waiting: int       # evicted and still awaiting re-placement
+    never_placed: int
+    succeeded: int
+    failed: int
+    # Quality.
+    utilization: float           # mean assigned fraction per tick
+    ttp_ticks: Dict[str, Dict[str, float]]   # class -> p50/p95/max/count
+    preemptions: int             # scheduler policy evictions
+    chaos_preemptions: int       # injected SlicePreemptor evictions
+    defrag_migrations: int
+    spilled_placements: int      # DCN-far (cross-pool) slice sets
+    inversions: int              # MUST be 0
+    reconciles: int
+
+    @property
+    def accounting_exact(self) -> bool:
+        return (self.placed + self.preempted_waiting + self.never_placed
+                == self.submitted)
+
+    def summary(self) -> Dict[str, object]:
+        return {
+            "policy": self.policy,
+            "submitted": self.submitted,
+            "ticks": self.ticks,
+            "converged": self.converged,
+            "placed": self.placed,
+            "preempted_waiting": self.preempted_waiting,
+            "never_placed": self.never_placed,
+            "accounting_exact": self.accounting_exact,
+            "succeeded": self.succeeded,
+            "failed": self.failed,
+            "utilization": round(self.utilization, 4),
+            "ttp_ticks": {k: dict(v) for k, v in self.ttp_ticks.items()},
+            "preemptions": self.preemptions,
+            "chaos_preemptions": self.chaos_preemptions,
+            "defrag_migrations": self.defrag_migrations,
+            "spilled_placements": self.spilled_placements,
+            "inversions": self.inversions,
+            "reconciles": self.reconciles,
+        }
+
+
+def run_schedule_storm(
+    *,
+    num_jobs: int = 60,
+    policy: str = "priority",
+    fleet_capacity: Optional[Dict[str, int]] = None,
+    slice_type: str = "v5e-16",
+    pool_size: int = 4,
+    seed: int = 0,
+    arrival_span: int = 12,
+    max_ticks: int = 400,
+    defrag: bool = True,
+    defrag_threshold: float = 0.4,
+    # Mid-storm chaos: at this tick, inject `chaos_preempts` seeded slice
+    # preemptions (the schedule-smoke stage's preemption burst). None =
+    # no chaos.
+    chaos_at_tick: Optional[int] = None,
+    chaos_preempts: int = 0,
+    registry: Optional[MetricsRegistry] = None,
+) -> StormReport:
+    fleet_capacity = dict(fleet_capacity or {slice_type: 8})
+    storm = make_storm(num_jobs, seed=seed, arrival_span=arrival_span)
+    registry = registry or MetricsRegistry()
+    tracer = Tracer()
+    api = InMemoryApiServer(registry=registry, tracer=tracer)
+    mgr = ControllerManager(api, registry, tracer=tracer)
+    fleet = Fleet.from_capacity(fleet_capacity, pool_size=pool_size)
+    scheduler = GangScheduler(fleet, policy=policy, registry=registry,
+                              tracer=tracer)
+    # Logical-time storm: parked gangs are retried by the per-tick
+    # kick_timers call below, never by wall-clock maturation (a real-time
+    # park interval shorter than a slow host's drain would treadmill the
+    # drain — matured parks refilling the loop that is draining them).
+    job_ctl = TpuJobController(api, registry, hbm_check=False,
+                               scheduler=scheduler,
+                               requeue_pending_s=3600.0)
+    mgr.register(job_ctl)
+    defrag_ctl = None
+    if defrag and policy == "priority":
+        defrag_ctl = DefragController(
+            api, registry, scheduler=scheduler, tracer=tracer,
+            threshold=defrag_threshold, interval_s=0.0,
+        )
+        mgr.register(defrag_ctl)
+
+    by_name = {j.name: j for j in storm}
+    # A gang runs for duration_ticks ticks of full placement, then its
+    # pods report Succeeded on the next kubelet status sync.
+    work_done: Dict[str, int] = {}
+    finished: set = set()
+
+    def outcome(pod_name: str) -> Optional[str]:
+        job_name = pod_name.rsplit("-worker-", 1)[0]
+        return "Succeeded" if job_name in finished else None
+
+    kubelet = FakeKubelet(api, registry, outcome=outcome)
+    mgr.register(kubelet)
+
+    chaos_total = 0
+    preemptor = None
+    if chaos_at_tick is not None and chaos_preempts > 0:
+        from kubeflow_tpu.chaos.preemptor import SlicePreemptor
+
+        # capacity=None: the slice comes BACK (preempt-and-return) — the
+        # fleet's units are physical and the scheduler re-places onto
+        # them; modeling permanently lost units is the elastic-gang
+        # story (ROADMAP item 3), not this bench's.
+        preemptor = SlicePreemptor(api, seed=seed + 7, registry=registry)
+
+    arrival_tick = {j.name: j.arrival_tick for j in storm}
+    placed_tick: Dict[str, int] = {}
+    uid_to_name: Dict[str, str] = {}
+    reconciles = 0
+    util_sum = 0.0
+    util_ticks = 0
+    total_units = fleet.total()
+    ticks = 0
+
+    def drain() -> int:
+        # Kick parked admission/backoff requeues ONCE per tick, then
+        # drain with a ZERO fast-forward window: immediate (0-delay)
+        # requeues still fire inside the drain, but a parked gang's 5s
+        # timer cannot re-fire until the next tick's kick. A positive
+        # window here is a livelock on slow hosts — when one drain takes
+        # longer than the park interval, matured park timers keep
+        # refilling the very drain that is too slow to finish them.
+        mgr.kick_timers(2 * 3600.0)
+        return mgr.run_until_idle(max_iterations=200000)
+
+    for t in range(max_ticks):
+        ticks = t + 1
+        for j in storm:
+            if j.arrival_tick == t:
+                api.create(TpuJob(
+                    metadata=ObjectMeta(name=j.name,
+                                        namespace=STORM_NAMESPACE),
+                    spec=TpuJobSpec(
+                        slice_type=slice_type,
+                        num_slices=j.num_slices,
+                        mesh=MeshAxesSpec(dp=-1),
+                        priority=j.priority,
+                        backoff_seconds=0.0,
+                        preemption_policy="restart",
+                    ),
+                ))
+        reconciles += drain()
+        if preemptor is not None and t == chaos_at_tick:
+            for _ in range(chaos_preempts):
+                if preemptor.preempt_random() is not None:
+                    chaos_total += 1
+            reconciles += drain()
+        kubelet.tick()
+        reconciles += drain()
+
+        # Placement bookkeeping out of the scheduler's decision log —
+        # survives same-tick place-then-finish races.
+        for entry in scheduler.placement_log:
+            uid_to_name[entry["uid"]] = entry["job"]
+            placed_tick.setdefault(entry["uid"], t)
+
+        # Work accounting: a fully-Running placed gang earns one tick.
+        jobs_now = {j.metadata.name: j
+                    for j in api.list("TpuJob", copy=False)}
+        for name, job in jobs_now.items():
+            if job.status.phase == "Running" \
+                    and scheduler.assignment_of(job.metadata.uid):
+                work_done[name] = work_done.get(name, 0) + 1
+                if work_done[name] >= by_name[name].duration_ticks:
+                    finished.add(name)
+        util_sum += 1.0 - len(fleet.free()) / total_units
+        util_ticks += 1
+        if len(jobs_now) == num_jobs and all(
+                j.status.phase in ("Succeeded", "Failed")
+                for j in jobs_now.values()):
+            break
+
+    # ----------------- final accounting -----------------
+
+    jobs_final = {j.metadata.name: j
+                  for j in api.list("TpuJob", copy=False)}
+    converged = all(j.status.phase in ("Succeeded", "Failed")
+                    for j in jobs_final.values())
+    placed_names = {uid_to_name[uid] for uid in placed_tick}
+    evicted_names = (
+        {e["victim"] for e in scheduler.preemption_log}
+        | {e["victim"] for e in scheduler.defrag_log}
+    )
+    placed = preempted_waiting = never_placed = 0
+    succeeded = failed = 0
+    for j in storm:
+        job = jobs_final.get(j.name)
+        phase = job.status.phase if job is not None else "?"
+        if phase == "Succeeded":
+            succeeded += 1
+        elif phase == "Failed":
+            failed += 1
+        holding = (job is not None
+                   and scheduler.assignment_of(job.metadata.uid))
+        if j.name in placed_names and (
+                holding or phase in ("Succeeded", "Failed")):
+            placed += 1
+        elif j.name in placed_names or (
+                job is not None and job.status.preemptions > 0):
+            # Placed once (or chaos-evicted) and currently without a
+            # slice set: awaiting re-placement.
+            preempted_waiting += 1
+        elif j.name not in placed_names:
+            never_placed += 1
+
+    ttp: Dict[str, Dict[str, float]] = {}
+    for klass, _prio, _w in PRIORITY_CLASSES:
+        waits = [
+            float(placed_tick[uid] - arrival_tick[uid_to_name[uid]])
+            for uid in placed_tick
+            if by_name[uid_to_name[uid]].klass == klass
+        ]
+        if waits:
+            ttp[klass] = {
+                "p50": nearest_rank_quantile(waits, 0.50),
+                "p95": nearest_rank_quantile(waits, 0.95),
+                "max": max(waits),
+                "count": float(len(waits)),
+            }
+        else:
+            ttp[klass] = {"p50": 0.0, "p95": 0.0, "max": 0.0,
+                          "count": 0.0}
+
+    inversions = int(
+        registry.get("kftpu_scheduler_priority_inversions_total").value()
+    ) + sum(
+        1 for e in scheduler.preemption_log
+        if e["victim_priority"] >= e["requester_priority"]
+    )
+    report = StormReport(
+        policy=policy,
+        submitted=num_jobs,
+        ticks=ticks,
+        converged=converged,
+        placed=placed,
+        preempted_waiting=preempted_waiting,
+        never_placed=never_placed,
+        succeeded=succeeded,
+        failed=failed,
+        utilization=util_sum / util_ticks if util_ticks else 0.0,
+        ttp_ticks=ttp,
+        preemptions=len(scheduler.preemption_log),
+        chaos_preemptions=chaos_total,
+        defrag_migrations=len(scheduler.defrag_log),
+        spilled_placements=sum(
+            1 for e in scheduler.placement_log if e["spilled"]),
+        inversions=inversions,
+        reconciles=reconciles,
+    )
+    mgr.close()
+    return report
+
+
+def check_storm_gates(report: StormReport) -> None:
+    """The hard gates (raise, not assert — python -O must not skip):
+    exact gang accounting and priority-inversion freedom."""
+    if not report.accounting_exact:
+        raise SystemExit(
+            f"[{report.policy}] gang accounting broken: "
+            f"placed={report.placed} + preempted={report.preempted_waiting}"
+            f" + pending={report.never_placed} != "
+            f"submitted={report.submitted}"
+        )
+    if report.inversions:
+        raise SystemExit(
+            f"[{report.policy}] {report.inversions} priority inversions — "
+            "a lower-priority gang displaced a higher one"
+        )

@@ -1,0 +1,186 @@
+"""Background defragmentation: migrate restartable gangs to heal holes.
+
+Slices free in the wrong places are capacity a multislice gang cannot
+use: after a day of arrivals and departures a fleet can be 30% free yet
+place nothing wider than x1. The defragmenter watches the fleet's
+fragmentation metric (``1 - largest contiguous free block / free``,
+per slice type) and, above a threshold, migrates the cheapest
+restartable gang whose move measurably consolidates free capacity —
+eviction through the SAME code path chaos and priority preemption use
+(``scheduler/preempt.py``), so a migration is just a preemption the
+platform already knows how to survive: restart from checkpoint, no
+restart budget consumed.
+
+Guard rails against thrash:
+- at most ``max_migrations_per_pass`` per sweep, sweeps at least
+  ``interval_s`` apart;
+- a sweep never starts while a previous migration is still in flight
+  (the evicted gang has not re-placed);
+- a move must improve fragmentation by ``min_gain`` — simulated against
+  the fleet BEFORE any pod is touched; migrations that merely shuffle
+  are rejected.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Set
+
+from kubeflow_tpu.controlplane.runtime import EventRecorder, Result
+from kubeflow_tpu.controlplane.runtime.reconciler import Controller
+from kubeflow_tpu.scheduler import preempt as preempt_mod
+from kubeflow_tpu.scheduler.core import GangScheduler
+from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
+from kubeflow_tpu.utils.tracing import Tracer, global_tracer
+
+
+class DefragController(Controller):
+    NAME = "defrag"
+    WATCH_KINDS = ("TpuJob",)
+
+    def __init__(
+        self,
+        api,
+        registry: MetricsRegistry = global_registry,
+        *,
+        scheduler: GangScheduler,
+        tracer: Tracer = global_tracer,
+        threshold: float = 0.5,
+        min_gain: float = 0.05,
+        interval_s: float = 30.0,
+        max_migrations_per_pass: int = 1,
+    ):
+        super().__init__(api, registry)
+        self.scheduler = scheduler
+        self.tracer = tracer
+        self.threshold = threshold
+        self.min_gain = min_gain
+        self.interval_s = interval_s
+        self.max_migrations_per_pass = max_migrations_per_pass
+        self.recorder = EventRecorder(api, self.NAME)
+        self.metrics_migrations = registry.counter(
+            "kftpu_scheduler_defrag_migrations_total",
+            "Restartable gangs migrated to consolidate free slices",
+        )
+        self._last_pass = 0.0            # monotonic; 0 = never
+        self._migrating: Set[str] = set()  # job uids evicted, not yet back
+
+    def map_to_primary(self, obj):
+        # Any TpuJob transition may change fragmentation; reconcile under
+        # the object's own key (the sweep itself is fleet-global and
+        # debounced by interval_s).
+        return (obj.metadata.namespace, obj.metadata.name)
+
+    # ----------------- the sweep -----------------
+
+    def reconcile(self, namespace: str, name: str) -> Result:
+        now = time.monotonic()
+        if self._last_pass and self.interval_s > 0 \
+                and now - self._last_pass < self.interval_s:
+            return Result(requeue_after=self.interval_s)
+        self._last_pass = now
+        self.sweep()
+        # interval_s <= 0 (logical-time drivers): sweeps ride on TpuJob
+        # watch events only — a zero-delay requeue would self-sustain
+        # and the manager's drain loop could never go idle.
+        if self.interval_s > 0:
+            return Result(requeue_after=self.interval_s)
+        return Result()
+
+    def _settle_migrations(self, jobs) -> None:
+        """Drop in-flight markers for gangs that re-placed or ended."""
+        by_uid = {j.metadata.uid: j for j in jobs}
+        for uid in list(self._migrating):
+            job = by_uid.get(uid)
+            if job is None or job.status.phase in ("Succeeded", "Failed"):
+                self._migrating.discard(uid)
+            elif self.scheduler.assignment_of(uid) is not None:
+                self._migrating.discard(uid)
+
+    def sweep(self) -> int:
+        """One defragmentation pass; returns gangs migrated."""
+        jobs = self.reader.list("TpuJob", copy=False)
+        self._settle_migrations(jobs)
+        if self._migrating:
+            return 0            # let the previous move land first
+        migrated = 0
+        for slice_type in self.scheduler.fleet.slice_types():
+            if migrated >= self.max_migrations_per_pass:
+                break
+            frag = self.scheduler.fleet.fragmentation(slice_type)
+            if frag <= self.threshold:
+                continue
+            move = self._pick_migration(jobs, slice_type, frag)
+            if move is None:
+                continue
+            victim, gain = move
+            hit = preempt_mod.preempt_gang(self.api, victim)
+            if hit == 0:
+                continue        # gang mid-transition; next sweep retries
+            self.scheduler.release(victim.metadata.uid)
+            self._migrating.add(victim.metadata.uid)
+            self.metrics_migrations.inc()
+            self.scheduler._append(self.scheduler.defrag_log, {
+                "victim": victim.metadata.name,
+                "victim_uid": victim.metadata.uid,
+                "slice_type": slice_type,
+                "fragmentation_before": round(frag, 4),
+                "expected_gain": round(gain, 4),
+                "pods": hit, "reason": "defrag",
+            })
+            with self.tracer.span(
+                "schedule.defrag",
+                attrs={
+                    "victim": (f"{victim.metadata.namespace}/"
+                               f"{victim.metadata.name}"),
+                    "slice_type": slice_type,
+                    "fragmentation": round(frag, 4),
+                    "expected_gain": round(gain, 4),
+                    "pods": hit,
+                },
+            ):
+                pass
+            self.recorder.event(
+                victim, "Normal", "DefragMigration",
+                f"migrating to consolidate {slice_type} free slices "
+                f"(fragmentation {frag:.2f}, expected gain {gain:.2f}); "
+                "resuming from checkpoint",
+            )
+            migrated += 1
+        return migrated
+
+    # ----------------- simulation -----------------
+
+    def _pick_migration(self, jobs, slice_type: str,
+                        frag: float) -> Optional[tuple]:
+        """The cheapest restartable gang whose best-fit re-placement
+        improves fragmentation by at least ``min_gain``. Candidates in
+        eviction-cost order (lowest priority, smallest gang) — defrag
+        must never move the most important work first."""
+        fleet = self.scheduler.fleet
+        candidates: List = [
+            j for j in jobs
+            if j.spec.slice_type == slice_type
+            and j.spec.preemption_policy == "restart"
+            and j.status.phase in preempt_mod.PREEMPTIBLE_PHASES
+            and fleet.assignment(j.metadata.uid)
+        ]
+        candidates.sort(key=lambda j: (
+            j.spec.priority,
+            len(fleet.assignment(j.metadata.uid) or []),
+            j.metadata.namespace, j.metadata.name,
+        ))
+        for job in candidates:
+            held = set(fleet.assignment(job.metadata.uid) or [])
+            target = self.scheduler.engine.find(
+                slice_type, job.spec.num_slices, extra_free=held)
+            if target is None:
+                continue
+            new_units = set(target.unit_uids)
+            if new_units == held:
+                continue        # best fit IS its current home
+            new_frag = fleet.fragmentation(
+                slice_type, freed=held, taken=new_units)
+            if frag - new_frag >= self.min_gain:
+                return (job, frag - new_frag)
+        return None

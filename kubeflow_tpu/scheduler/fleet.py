@@ -1,0 +1,267 @@
+"""Fleet model: schedulable slices with DCN-adjacency coordinates.
+
+The admission ledger answers "may this gang run?"; this module gives the
+platform the vocabulary to answer "where?". A fleet is a set of
+:class:`SliceUnit` — each one physical TPU slice (the atom a TpuJob gang
+lands on) — grouped into :class:`SlicePool` blocks. Within a pool, units
+carry grid coordinates derived from the slice's own ``SliceTopology``:
+a pool of v5e-16 (4x4) slices is modeled as the larger contiguous block
+those slices are carved from, so two units at Manhattan distance 1 share
+a DCN domain wall the way adjacent slices of one v5e-256 pod do. Cross-
+pool traffic is the expensive DCN hop multislice jobs want to avoid
+(arxiv 2009.09523's placement abstraction: decouple the gang from the
+hardware, but keep the hardware's adjacency visible to the placer).
+
+The fleet is pure bookkeeping — deterministic, lock-guarded, no API
+calls — so the placement engine, the preemption policy and the
+defragmenter can all simulate "what if" against it cheaply.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from kubeflow_tpu.topology import get_slice
+
+Coord = Tuple[int, ...]
+
+
+@dataclasses.dataclass
+class SliceUnit:
+    """One schedulable slice: the unit of gang placement."""
+
+    uid: str                  # e.g. "v5e-16/p00/u03" — stable across restarts
+    slice_type: str
+    pool: str                 # pool id, e.g. "p00"
+    coord: Coord              # grid position inside the pool
+    job: Optional[str] = None  # assigned TpuJob uid (None = free)
+
+    @property
+    def free(self) -> bool:
+        return self.job is None
+
+
+def _grid_dims(count: int, rank: int) -> Coord:
+    """Arrange ``count`` units into a near-square grid of ``rank`` axes —
+    the pool's DCN coordinate system. Deterministic: factor the count
+    greedily from the largest axis down (8 units, rank 2 -> (2, 4))."""
+    if rank <= 1:
+        return (count,)
+    dims = [1] * rank
+    remaining = count
+    # Peel the largest factor <= sqrt-ish off per axis, last axis takes
+    # the rest; non-factorable counts degrade to a 1-D line, which keeps
+    # adjacency meaningful (|i - j| = DCN hops) without inventing holes.
+    for axis in range(rank - 1):
+        best = 1
+        f = 2
+        while f * f <= remaining:
+            if remaining % f == 0:
+                best = f
+            f += 1
+        dims[axis] = best
+        remaining //= best
+    dims[rank - 1] = remaining
+    return tuple(dims)
+
+
+def _grid_coords(dims: Coord) -> List[Coord]:
+    coords = [()]
+    for d in dims:
+        coords = [c + (i,) for c in coords for i in range(d)]
+    return sorted(coords)
+
+
+def manhattan(a: Coord, b: Coord) -> int:
+    return sum(abs(x - y) for x, y in zip(a, b))
+
+
+class SlicePool:
+    """A contiguous block of same-type slices sharing a DCN domain."""
+
+    def __init__(self, pool_id: str, slice_type: str, count: int):
+        if count < 1:
+            raise ValueError(f"pool {pool_id}: count must be >= 1")
+        st = get_slice(slice_type)        # validates the type
+        self.pool_id = pool_id
+        self.slice_type = slice_type
+        self.dims = _grid_dims(count, st.topology.rank)
+        coords = _grid_coords(self.dims)[:count]
+        self.units: List[SliceUnit] = [
+            SliceUnit(
+                uid=f"{slice_type}/{pool_id}/u{i:02d}",
+                slice_type=slice_type,
+                pool=pool_id,
+                coord=coord,
+            )
+            for i, coord in enumerate(coords)
+        ]
+
+    def free_units(self) -> List[SliceUnit]:
+        return [u for u in self.units if u.free]
+
+
+def largest_connected(coords: Sequence[Coord]) -> int:
+    """Size of the largest Manhattan-adjacent connected component — the
+    biggest contiguous block a multislice gang could still land on."""
+    remaining = set(coords)
+    best = 0
+    while remaining:
+        stack = [remaining.pop()]
+        size = 0
+        while stack:
+            c = stack.pop()
+            size += 1
+            for other in list(remaining):
+                if manhattan(c, other) == 1:
+                    remaining.discard(other)
+                    stack.append(other)
+        best = max(best, size)
+    return best
+
+
+class Fleet:
+    """All pools, plus the assignment map. Thread-safe: controllers,
+    the defragmenter and tpuctl all read it concurrently."""
+
+    def __init__(self, pools: Iterable[SlicePool]):
+        self._lock = threading.RLock()
+        self.pools: List[SlicePool] = sorted(
+            pools, key=lambda p: (p.slice_type, p.pool_id))
+        self._by_uid: Dict[str, SliceUnit] = {}
+        for pool in self.pools:
+            for u in pool.units:
+                if u.uid in self._by_uid:
+                    raise ValueError(f"duplicate unit uid {u.uid}")
+                self._by_uid[u.uid] = u
+        # job uid -> unit uids it holds (insertion-ordered).
+        self._assignments: Dict[str, List[str]] = {}
+
+    @classmethod
+    def from_capacity(cls, capacity: Dict[str, int],
+                      pool_size: int = 8) -> "Fleet":
+        """Build a fleet from the admission ledger's vocabulary
+        (slice_type -> total slices), split into pools of at most
+        ``pool_size`` units — the DCN-domain granularity."""
+        if pool_size < 1:
+            raise ValueError("pool_size must be >= 1")
+        pools = []
+        for slice_type in sorted(capacity):
+            total = int(capacity[slice_type])
+            if total < 0:
+                raise ValueError(
+                    f"capacity for {slice_type} must be >= 0, got {total}")
+            i = 0
+            while total > 0:
+                n = min(pool_size, total)
+                pools.append(SlicePool(f"p{i:02d}", slice_type, n))
+                total -= n
+                i += 1
+        return cls(pools)
+
+    # ----------------- queries -----------------
+
+    def manages(self, slice_type: str) -> bool:
+        return any(p.slice_type == slice_type for p in self.pools)
+
+    def slice_types(self) -> List[str]:
+        return sorted({p.slice_type for p in self.pools})
+
+    def pools_of(self, slice_type: str) -> List[SlicePool]:
+        return [p for p in self.pools if p.slice_type == slice_type]
+
+    def unit(self, uid: str) -> SliceUnit:
+        return self._by_uid[uid]
+
+    def total(self, slice_type: Optional[str] = None) -> int:
+        return sum(
+            len(p.units) for p in self.pools
+            if slice_type is None or p.slice_type == slice_type
+        )
+
+    def free(self, slice_type: Optional[str] = None) -> List[SliceUnit]:
+        with self._lock:
+            return [
+                u for p in self.pools for u in p.units
+                if u.free and (slice_type is None
+                               or p.slice_type == slice_type)
+            ]
+
+    def assignment(self, job_uid: str) -> Optional[List[str]]:
+        with self._lock:
+            units = self._assignments.get(job_uid)
+            return list(units) if units is not None else None
+
+    def assignments(self) -> Dict[str, List[str]]:
+        with self._lock:
+            return {k: list(v) for k, v in self._assignments.items()}
+
+    def utilization(self) -> float:
+        with self._lock:
+            total = sum(len(p.units) for p in self.pools)
+            busy = sum(
+                1 for p in self.pools for u in p.units if not u.free)
+            return busy / total if total else 0.0
+
+    def fragmentation(self, slice_type: str,
+                      freed: Optional[Set[str]] = None,
+                      taken: Optional[Set[str]] = None) -> float:
+        """0.0 = the largest contiguous free block is as wide as the
+        free capacity could possibly offer; 1.0-ward = free slices are
+        shattered into holes no multislice gang can use. Defined as
+        ``1 - largest_contiguous_free_block / min(free, largest_pool)``
+        — normalized by the widest placement a pool could ever host, so
+        an empty multi-pool fleet reads 0 (pool walls are DCN topology,
+        not fragmentation). 0 when free <= 1 (nothing to consolidate).
+
+        ``freed``/``taken`` overlay a hypothetical world (units treated
+        as free / as occupied) — the defragmenter's what-if, computed by
+        the SAME formula as the live gauge it gates on."""
+        freed = freed or set()
+        taken = taken or set()
+        with self._lock:
+            pools = self.pools_of(slice_type)
+            free_total = 0
+            best_block = 0
+            for pool in pools:
+                coords = [
+                    u.coord for u in pool.units
+                    if (u.free or u.uid in freed) and u.uid not in taken
+                ]
+                free_total += len(coords)
+                if coords:
+                    best_block = max(best_block, largest_connected(coords))
+            if free_total <= 1:
+                return 0.0
+            widest = min(free_total,
+                         max(len(p.units) for p in pools))
+            return 1.0 - best_block / widest
+
+    # ----------------- mutation -----------------
+
+    def allocate(self, job_uid: str, unit_uids: Sequence[str]) -> None:
+        with self._lock:
+            units = [self._by_uid[u] for u in unit_uids]
+            for u in units:
+                if u.job is not None and u.job != job_uid:
+                    raise ValueError(
+                        f"unit {u.uid} already assigned to {u.job}")
+            if job_uid in self._assignments:
+                raise ValueError(f"job {job_uid} already holds an "
+                                 "assignment; release it first")
+            for u in units:
+                u.job = job_uid
+            self._assignments[job_uid] = [u.uid for u in units]
+
+    def release(self, job_uid: str) -> List[str]:
+        """Free the job's units (idempotent: unknown uid releases
+        nothing). Returns the unit uids freed."""
+        with self._lock:
+            unit_uids = self._assignments.pop(job_uid, [])
+            for uid in unit_uids:
+                u = self._by_uid.get(uid)
+                if u is not None and u.job == job_uid:
+                    u.job = None
+            return unit_uids

@@ -1,0 +1,179 @@
+"""Gang placement: bin-pack TpuJob gangs onto adjacent slice sets.
+
+Policy (deterministic; ties broken by sorted ids so the same fleet state
+always yields the same placement):
+
+- **Single-slice gangs** best-fit: land in the pool with the FEWEST free
+  units that still fits (tightest pool first), lowest-coordinate unit
+  within it. Packing tightly keeps whole pools empty for the multislice
+  gangs that need them — the bin-packing half of the fragmentation story.
+- **Multislice gangs** prefer one pool (DCN-proximal): among pools with
+  enough free units, grow a Manhattan-adjacent region from each candidate
+  seed and take the tightest result (smallest spread score, then fewest
+  free units left behind). Only when NO single pool fits does the gang
+  spill across pools of the same slice type — the assignment is then
+  marked ``spilled`` so operators (and the bench) can see DCN-far
+  placements happen.
+
+``extra_free`` lets the preemption policy ask "would this gang fit if
+these victims' units were freed?" without mutating the fleet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Set, Tuple
+
+from kubeflow_tpu.scheduler.fleet import (
+    Coord,
+    Fleet,
+    SlicePool,
+    SliceUnit,
+    manhattan,
+)
+
+
+@dataclasses.dataclass
+class Placement:
+    """A concrete slice set for one gang."""
+
+    slice_type: str
+    unit_uids: List[str]
+    pools: List[str]
+    spilled: bool = False         # True = crosses a DCN pool boundary
+    spread: int = 0               # sum of pairwise Manhattan distances
+
+    def render(self) -> str:
+        """The ``status.slice_assignment`` string. Parse with
+        :func:`parse_assignment`; stable across controller restarts."""
+        return (f"{self.slice_type}x{len(self.unit_uids)} @ "
+                + ",".join(self.unit_uids))
+
+
+def parse_assignment(s: str) -> Optional[List[str]]:
+    """Unit uids out of a rendered assignment; None for legacy or empty
+    strings (pre-scheduler ``slice_assignment`` was ``v5e-16x2`` with no
+    placement — those jobs simply re-place)."""
+    if " @ " not in s:
+        return None
+    _, _, units = s.partition(" @ ")
+    parsed = [u for u in units.split(",") if u]
+    return parsed or None
+
+
+def _spread(coords: Sequence[Coord]) -> int:
+    return sum(
+        manhattan(a, b)
+        for i, a in enumerate(coords)
+        for b in coords[i + 1:]
+    )
+
+
+class PlacementEngine:
+    def __init__(self, fleet: Fleet):
+        self.fleet = fleet
+
+    # ----------------- region growth -----------------
+
+    @staticmethod
+    def _grow_region(free: List[SliceUnit], seed: SliceUnit,
+                     n: int) -> Optional[List[SliceUnit]]:
+        """Greedy adjacent-region growth: start at ``seed``, repeatedly
+        add the free unit closest to the region (preferring true
+        adjacency), until ``n`` units. Returns None when the pool's free
+        set cannot reach n."""
+        if len(free) < n:
+            return None
+        region = [seed]
+        pool_free = [u for u in free if u.uid != seed.uid]
+        while len(region) < n:
+            best: Optional[Tuple[int, str, SliceUnit]] = None
+            for u in pool_free:
+                d = min(manhattan(u.coord, r.coord) for r in region)
+                key = (d, u.uid)
+                if best is None or key < (best[0], best[1]):
+                    best = (d, u.uid, u)
+            if best is None:
+                return None
+            region.append(best[2])
+            pool_free = [u for u in pool_free if u.uid != best[1]]
+        return region
+
+    def _fit_in_pool(self, pool: SlicePool, n: int,
+                     extra_free: Set[str]) -> Optional[List[SliceUnit]]:
+        free = sorted(
+            (u for u in pool.units
+             if u.free or u.uid in extra_free),
+            key=lambda u: u.uid,
+        )
+        if len(free) < n:
+            return None
+        if n == 1:
+            return [free[0]]
+        best: Optional[Tuple[int, List[SliceUnit]]] = None
+        for seed in free:
+            region = self._grow_region(free, seed, n)
+            if region is None:
+                continue
+            score = _spread([u.coord for u in region])
+            if best is None or score < best[0]:
+                best = (score, region)
+        return best[1] if best else None
+
+    # ----------------- the placer -----------------
+
+    def find(self, slice_type: str, num_slices: int,
+             extra_free: Optional[Set[str]] = None) -> Optional[Placement]:
+        """A slice set for the gang, or None when nothing fits.
+        ``extra_free`` treats those unit uids as free (preemption
+        what-if); the fleet itself is never mutated here."""
+        extra = extra_free or set()
+        pools = self.fleet.pools_of(slice_type)
+        if not pools or num_slices < 1:
+            return None
+
+        def free_count(pool: SlicePool) -> int:
+            return sum(1 for u in pool.units
+                       if u.free or u.uid in extra)
+
+        # Tightest-pool-first best fit: fewest free units that still fit.
+        fitting = sorted(
+            (p for p in pools if free_count(p) >= num_slices),
+            key=lambda p: (free_count(p), p.pool_id),
+        )
+        for pool in fitting:
+            region = self._fit_in_pool(pool, num_slices, extra)
+            if region is not None:
+                return Placement(
+                    slice_type=slice_type,
+                    unit_uids=[u.uid for u in region],
+                    pools=[pool.pool_id],
+                    spilled=False,
+                    spread=_spread([u.coord for u in region]),
+                )
+
+        # Spill: no single pool fits. Take the fullest free pools first
+        # (fewest fragments crossed), in deterministic order.
+        all_free = sorted(
+            (u for p in pools for u in p.units
+             if u.free or u.uid in extra),
+            key=lambda u: u.uid,
+        )
+        if len(all_free) < num_slices:
+            return None
+        by_pool = sorted(
+            pools, key=lambda p: (-free_count(p), p.pool_id))
+        chosen: List[SliceUnit] = []
+        for pool in by_pool:
+            for u in sorted(pool.units, key=lambda u: u.uid):
+                if (u.free or u.uid in extra) and len(chosen) < num_slices:
+                    chosen.append(u)
+            if len(chosen) >= num_slices:
+                break
+        return Placement(
+            slice_type=slice_type,
+            unit_uids=[u.uid for u in chosen],
+            pools=sorted({u.pool for u in chosen}),
+            spilled=True,
+            spread=_spread([u.coord for u in chosen]),
+        )

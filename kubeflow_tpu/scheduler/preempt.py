@@ -1,0 +1,132 @@
+"""Preemption as policy: the ONE eviction code path.
+
+PR 2 taught the platform to *survive* slice preemption (the chaos
+``SlicePreemptor``); this module promotes that eviction into production
+code the scheduler uses on purpose. Both callers — chaos injecting a
+reclaimed slice, and the scheduler evicting a lower-priority gang to
+make room — mark victim pods through :func:`preempt_slice_group`, so the
+TpuJobController's restart-vs-fail policy, budget accounting and events
+CANNOT drift between "fault" and "policy" (the satellite contract, with
+a test asserting identical status/event transitions).
+
+Victim selection implements the preemption-minimality rule from the
+dynamic-DL-scheduling blueprint (arxiv 1908.08082): evict the MINIMAL
+set of strictly-lower-priority restartable gangs that lets the blocked
+gang place, preferring the lowest-priority victims. A gang whose
+``preemption_policy`` is ``fail`` is never chosen — policy eviction must
+cost a reschedule, not a job.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Set
+
+from kubeflow_tpu.controlplane.controllers.tpujob import (
+    JOB_LABEL,
+    PREEMPTION_MESSAGE,
+)
+
+#: Job phases a slice preemption (chaos or policy) can hit: the gang is
+#: on hardware. Shared with chaos.SlicePreemptor.
+PREEMPTIBLE_PHASES = ("Starting", "Running")
+
+
+def active_slice_groups(api, job) -> List[str]:
+    """The job's live slice groups (pods not already terminal), sorted —
+    the selection domain both chaos and the scheduler draw from."""
+    pods = api.list("Pod", namespace=job.metadata.namespace,
+                    label_selector={JOB_LABEL: job.metadata.name})
+    return sorted({
+        p.spec.scheduler_hints.get("slice-group", "")
+        for p in pods if p.status.phase not in ("Succeeded", "Failed")
+    })
+
+
+def preempt_slice_group(api, job, group: str) -> int:
+    """Mark every live worker pod of ``group`` Failed with the
+    preemption marker — the exact transition a reclaimed TPU slice
+    produces, and the ONLY way platform code evicts a slice. The
+    TpuJobController keys its preemption policy (restart without
+    consuming max_restarts, or fail) off the marker; emitting it here
+    keeps chaos and scheduler eviction byte-identical downstream."""
+    pods = api.list("Pod", namespace=job.metadata.namespace,
+                    label_selector={JOB_LABEL: job.metadata.name})
+    hit = 0
+    for p in pods:
+        if p.spec.scheduler_hints.get("slice-group", "") != group:
+            continue
+        if p.status.phase in ("Succeeded", "Failed"):
+            continue
+        p.status.phase = "Failed"
+        p.status.message = PREEMPTION_MESSAGE
+        api.update_status(p)
+        hit += 1
+    return hit
+
+
+def preempt_gang(api, job) -> int:
+    """Evict the WHOLE gang (every live slice group): the scheduler's
+    reclaim — it takes the job's entire slice set, not one ICI domain.
+    Returns pods marked; 0 means the gang had no live pods (caller must
+    then treat the eviction as a no-op and keep the victim's units)."""
+    hit = 0
+    for group in active_slice_groups(api, job):
+        hit += preempt_slice_group(api, job, group)
+    return hit
+
+
+def is_restartable_victim(job, *, below_priority: int) -> bool:
+    """May ``job`` be evicted to make room for a gang at
+    ``below_priority``? STRICTLY lower priority (the no-inversion
+    invariant the bench hard-gates), restart policy (eviction costs a
+    reschedule, never the job), and on hardware."""
+    return (
+        job.spec.priority < below_priority
+        and job.spec.preemption_policy == "restart"
+        and job.status.phase in PREEMPTIBLE_PHASES
+    )
+
+
+def select_victims(
+    candidates: Sequence,
+    *,
+    fits,                    # Callable[[Set[str]], bool]: extra-free -> fit?
+    units_of,                # Callable[[job], List[str]]: held unit uids
+) -> Optional[List]:
+    """The minimal victim set whose freed units make the blocked gang
+    place. ``candidates`` must already be filtered through
+    :func:`is_restartable_victim`.
+
+    Greedy from the cheapest eviction up — lowest priority first, then
+    smallest gang, then name — adding victims until ``fits`` says the
+    gang places; then an inclusion-prune drops every victim whose units
+    turn out unnecessary (re-testing the fit without them), so no gang
+    is evicted that the placement did not need. Returns None when even
+    evicting every candidate cannot make room."""
+    ordered = sorted(
+        candidates,
+        key=lambda j: (j.spec.priority, len(units_of(j)),
+                       j.metadata.namespace, j.metadata.name),
+    )
+    chosen: List = []
+    freed: Set[str] = set()
+    for job in ordered:
+        if fits(freed):
+            break
+        chosen.append(job)
+        freed.update(units_of(job))
+    if not fits(freed):
+        return None
+    # Inclusion-prune, most expensive victims first: keep the set minimal.
+    for job in sorted(
+        chosen,
+        key=lambda j: (-j.spec.priority, -len(units_of(j)),
+                       j.metadata.namespace, j.metadata.name),
+    ):
+        trial = [j for j in chosen if j is not job]
+        still: Set[str] = set()
+        for j in trial:
+            still.update(units_of(j))
+        if fits(still):
+            chosen = trial
+    return chosen

@@ -46,6 +46,10 @@ Stages (any failure exits non-zero — the merge gate contract):
    Retry-After, the ServingAutoscaler reaches max_replicas; then the
    seeded drain/flap soak — zero requests routed to draining/unhealthy
    backends (``--skip-serve``).
+8b. **schedule-smoke**: the gang-scheduler mixed-priority storm with a
+   mid-storm slice-preemption burst (ISSUE 8) — exact gang accounting
+   (placed + preempted + pending == submitted), zero priority
+   inversions, all gangs converge Succeeded (``--skip-schedule``).
 9. **bench-gate**: if --bench-json is given, require
    ``vs_baseline >= --min-vs-baseline`` for every record — the perf
    regression gate SURVEY §7.8 prescribes.
@@ -348,6 +352,45 @@ def run_cp_bench_smoke(num_jobs: int = 50, num_namespaces: int = 5,
             )
 
 
+def run_schedule_smoke(seed: int = 20260803, num_jobs: int = 30) -> None:
+    """Gang-scheduler smoke (ISSUE 8): a small seeded mixed-priority
+    storm through the priority scheduler WITH a mid-storm SlicePreemptor
+    burst (preemption as fault racing preemption as policy). Gates —
+    all counts, never wall-clock:
+
+    - exact gang accounting: placed + preempted-awaiting + never-placed
+      == submitted;
+    - priority-inversion freedom: zero evictions of a gang at >= the
+      requester's priority (counter + decision log);
+    - convergence: every gang terminal, all Succeeded (restart policy —
+      neither chaos nor policy eviction may consume a job)."""
+    from kubeflow_tpu.scheduler.benchmark import (
+        check_storm_gates,
+        run_schedule_storm,
+    )
+
+    rep = run_schedule_storm(
+        num_jobs=num_jobs, policy="priority", seed=seed,
+        fleet_capacity={"v5e-16": 8}, pool_size=4,
+        chaos_at_tick=6, chaos_preempts=3,
+    )
+    try:
+        check_storm_gates(rep)
+    except SystemExit as e:
+        raise GateFailure(f"schedule-smoke: {e}") from None
+    if not rep.converged or rep.succeeded != rep.submitted:
+        raise GateFailure(
+            f"schedule-smoke: storm did not converge all-Succeeded: "
+            f"{rep.succeeded} succeeded / {rep.failed} failed of "
+            f"{rep.submitted} in {rep.ticks} ticks"
+        )
+    if rep.chaos_preemptions == 0:
+        raise GateFailure(
+            "schedule-smoke: the mid-storm preemption burst hit nothing "
+            "— the chaos leg is vacuous"
+        )
+
+
 def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
              skip_smoke: bool = False, skip_chaos: bool = False,
              chaos_seed: int = 20260803, chaos_latency_s: float = 0.0,
@@ -355,7 +398,8 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
              skip_cp_bench: bool = False,
              skip_obs: bool = False,
              skip_shard: bool = False,
-             skip_serve: bool = False) -> List[str]:
+             skip_serve: bool = False,
+             skip_schedule: bool = False) -> List[str]:
     """Run all stages; returns the list of passed stages, raises
     GateFailure on the first failing one."""
     passed: List[str] = []
@@ -453,6 +497,11 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
         run_obs_smoke()
         passed.append("obs-smoke")
 
+    if not skip_schedule:
+        _stage("schedule-smoke")
+        run_schedule_smoke(seed=chaos_seed)
+        passed.append("schedule-smoke")
+
     if not skip_serve:
         _stage("serve-bench-smoke")
         run_serve_bench_smoke()
@@ -509,6 +558,8 @@ def main(argv=None) -> int:
     g.add_argument("--skip-serve", action="store_true",
                    help="skip the serving data-plane open-loop bench and "
                         "drain-path soak smokes")
+    g.add_argument("--skip-schedule", action="store_true",
+                   help="skip the gang-scheduler storm smoke")
     args = p.parse_args(argv)
     try:
         passed = run_gate(
@@ -523,6 +574,7 @@ def main(argv=None) -> int:
             skip_obs=args.skip_obs,
             skip_shard=args.skip_shard,
             skip_serve=args.skip_serve,
+            skip_schedule=args.skip_schedule,
         )
     except GateFailure as e:
         print(f"[ci] FAIL: {e}", file=sys.stderr)

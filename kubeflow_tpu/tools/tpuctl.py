@@ -13,6 +13,8 @@ Usage:
   tpuctl apply  -f platform.yaml [-f job.yaml ...] --state-dir .tpuctl
   tpuctl get    <kind> [-n NAMESPACE] --state-dir .tpuctl
   tpuctl status --state-dir .tpuctl
+  tpuctl queue  [-n ns] [-o json] --state-dir .tpuctl  (pending gangs:
+                priority, slices, blocking reason, time-in-queue)
   tpuctl delete -f job.yaml | --kind TpuJob --name x -n ns  --state-dir .tpuctl
   tpuctl metrics --state-dir .tpuctl
   tpuctl logs   <pod | tpujob> -n ns   (gang logs; kubectl logs passthrough)
@@ -247,6 +249,58 @@ def cmd_status(args) -> int:
                 for o in objs
             }
     print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_queue(args) -> int:
+    """Pending gangs: priority, requested slices, blocking reason,
+    time-in-queue — the operator view of the scheduler's wait line
+    (docs/scheduler.md). Sorted the way the priority policy drains it:
+    highest priority first, then longest-waiting."""
+    import time as _time
+
+    if args.backend == "kubectl":
+        api = _kubectl_api(args)
+        jobs = api.list("TpuJob", namespace=args.namespace)
+    else:
+        platform = _load_platform(args)
+        jobs = platform.api.list("TpuJob", namespace=args.namespace,
+                                 copy=False)
+    now = _time.time()
+    rows = []
+    for job in jobs:
+        if job.status.phase not in ("Pending", "Restarting"):
+            continue
+        reason, message, since = "", "", job.metadata.creation_timestamp
+        for c in job.status.conditions:
+            if c.type == "Admitted" and c.status == "False":
+                reason, message = c.reason, c.message
+                since = c.last_transition_time or since
+        rows.append({
+            "namespace": job.metadata.namespace,
+            "name": job.metadata.name,
+            "priority": job.spec.priority,
+            "slices": f"{job.spec.slice_type}x{job.spec.num_slices}",
+            "phase": job.status.phase,
+            "reason": reason or job.status.phase,
+            "message": message,
+            "queued_seconds": round(max(0.0, now - since), 1),
+        })
+    rows.sort(key=lambda r: (-r["priority"], -r["queued_seconds"],
+                             r["namespace"], r["name"]))
+    if args.output == "json":
+        print(json.dumps(rows, indent=2))
+        return 0
+    if not rows:
+        print("queue empty: no pending gangs")
+        return 0
+    fmt = "{:<12} {:<16} {:>8} {:<12} {:>9} {:<22} {}"
+    print(fmt.format("NAMESPACE", "NAME", "PRIORITY", "SLICES",
+                     "QUEUED_S", "REASON", "MESSAGE"))
+    for r in rows:
+        print(fmt.format(r["namespace"], r["name"], r["priority"],
+                         r["slices"], r["queued_seconds"], r["reason"],
+                         r["message"]))
     return 0
 
 
@@ -605,6 +659,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     st = sub.add_parser("status", help="platform summary")
     st.set_defaults(fn=cmd_status)
+
+    qp = sub.add_parser(
+        "queue", help="pending gangs: priority, requested slices, "
+                      "blocking reason, time-in-queue")
+    qp.add_argument("-n", "--namespace", default=None)
+    qp.add_argument("-o", "--output", choices=("table", "json"),
+                    default="table")
+    qp.set_defaults(fn=cmd_queue)
 
     dp = sub.add_parser("delete", help="delete resources")
     dp.add_argument("-f", "--filename", action="append")

@@ -196,3 +196,91 @@ class TestLeaderElectionAndCrashReplay:
         with pytest.raises(GateFailure, match="union fingerprint"):
             ci.run_cp_bench_smoke(num_jobs=8, num_namespaces=4,
                                   workers=1, shards=2)
+
+
+class TestCrossShardAdmissionLedger:
+    """ISSUE 8 satellite (PR-6 follow-up): slice-capacity reservations
+    route through the lease-holding shard's LedgerService, so two shards
+    can no longer double-admit against the same capacity map."""
+
+    @staticmethod
+    def _split_namespaces(router):
+        ns_by_shard = {}
+        for i in range(64):
+            ns = f"ns-{i:02d}"
+            ns_by_shard.setdefault(router.route("TpuJob", ns), ns)
+            if len(ns_by_shard) == 2:
+                return ns_by_shard
+        raise AssertionError("no namespace split found")
+
+    def _docs(self, router):
+        ns_by_shard = self._split_namespaces(router)
+        return [
+            {"kind": "TpuJob",
+             "metadata": {"name": f"job-{shard}", "namespace": ns},
+             "spec": {"sliceType": "v5e-16", "mesh": {"dp": -1},
+                      "backoffSeconds": 0.0}}
+            for shard, ns in sorted(ns_by_shard.items())
+        ]
+
+    def test_two_shard_race_cannot_double_admit(self):
+        """Two jobs on DIFFERENT shards racing for ONE global slice:
+        without the ledger each shard's local view would admit both; the
+        leader's ledger serializes them — at most one gang in an in-use
+        phase after any round, and both still complete (sequentially)."""
+        cp = ShardedControlPlane(2, work_ticks=3,
+                                 global_capacity={"v5e-16": 1})
+        try:
+            cp.create(self._docs(cp.router))
+            in_use_phases = ("Scheduling", "Starting", "Running",
+                            "Restarting")
+            max_in_use = 0
+            phases = {}
+            for _ in range(20):
+                res = cp.round(2.0, kick=10.0)
+                counts, _sig = cp.fingerprint()
+                phases = counts.get("TpuJob", {})
+                max_in_use = max(max_in_use, sum(
+                    v for p, v in phases.items() if p in in_use_phases))
+                if all(r["terminal"] for r in res.values()):
+                    break
+            assert max_in_use <= 1, (
+                f"double-admit: {max_in_use} gangs held the single "
+                f"v5e-16 slice concurrently")
+            assert phases.get("Succeeded", 0) == 2
+            # All reservations returned once both gangs finished.
+            snap = cp.ledger_snapshot()
+            assert snap is not None and snap["reservations"] == 0
+        finally:
+            cp.close()
+
+    def test_leader_failover_keeps_ledger_state(self, tmp_path):
+        """Kill the lease holder mid-flight: the new leader replays the
+        ledger journal, so reservations survive the failover and the
+        blocked gang still parks (fail-closed) until capacity frees."""
+        cp = ShardedControlPlane(2, work_ticks=4,
+                                 global_capacity={"v5e-16": 1},
+                                 state_dir=str(tmp_path))
+        try:
+            cp.create(self._docs(cp.router))
+            cp.round(2.0, kick=10.0)
+            counts, _sig = cp.fingerprint()
+            in_use = sum(v for p, v in counts.get("TpuJob", {}).items()
+                         if p in ("Scheduling", "Starting", "Running"))
+            assert in_use == 1
+            leader = cp.leader_id
+            cp.kill(leader)
+            cp.restart(leader)
+            # The NEW leader (the survivor) serves a replayed ledger:
+            # exactly the pre-crash reservation, not an empty map.
+            snap = cp.ledger_snapshot()
+            assert snap is not None
+            assert snap["in_use"] == {"v5e-16": 1}
+            for _ in range(24):
+                res = cp.round(2.0, kick=10.0)
+                if all(r["terminal"] for r in res.values()):
+                    break
+            counts, _sig = cp.fingerprint()
+            assert counts.get("TpuJob", {}).get("Succeeded", 0) == 2
+        finally:
+            cp.close()

@@ -375,3 +375,93 @@ class TestTpuctlLogs:
         rc, _ = _run(["--state-dir", state, "logs", "nope", "-n", "ml"],
                      capsys)
         assert rc == 1
+
+
+SCHED_PLATFORM_YAML = """
+apiVersion: tpu.kubeflow.org/v1alpha1
+kind: PlatformConfig
+metadata:
+  name: kubeflow-tpu
+spec:
+  components:
+    - name: tpujob-controller
+      params:
+        fleet: "v5e-16=1"
+    - name: fake-kubelet
+"""
+
+HI_JOB_YAML = """
+apiVersion: tpu.kubeflow.org/v1alpha1
+kind: TpuJob
+metadata:
+  name: running
+  namespace: ml
+spec:
+  sliceType: v5e-16
+  priority: 10
+"""
+
+QUEUED_JOB_YAML = """
+apiVersion: tpu.kubeflow.org/v1alpha1
+kind: TpuJob
+metadata:
+  name: waiting
+  namespace: ml
+spec:
+  sliceType: v5e-16
+  priority: 3
+"""
+
+
+class TestTpuctlQueue:
+    """`tpuctl queue` (ISSUE 8): pending gangs with priority, requested
+    slices, blocking reason and time-in-queue."""
+
+    def _setup(self, tmp_path, capsys):
+        state = str(tmp_path / "state")
+        pf = _write(tmp_path, "platform.yaml", SCHED_PLATFORM_YAML)
+        hi = _write(tmp_path, "hi.yaml", HI_JOB_YAML)
+        lo = _write(tmp_path, "lo.yaml", QUEUED_JOB_YAML)
+        # The priority-10 gang takes the single slice (it applies first);
+        # the priority-3 gang parks Unschedulable — it may NOT preempt a
+        # higher-priority gang.
+        rc, _ = _run(["--state-dir", state, "apply", "-f", pf, "-f", hi],
+                     capsys)
+        assert rc == 0
+        rc, _ = _run(["--state-dir", state, "apply", "-f", lo], capsys)
+        assert rc == 0
+        return state
+
+    def test_queue_table(self, tmp_path, capsys):
+        state = self._setup(tmp_path, capsys)
+        rc, out = _run(["--state-dir", state, "queue"], capsys)
+        assert rc == 0
+        assert "NAME" in out and "PRIORITY" in out and "REASON" in out
+        assert "waiting" in out and "running" not in out
+        assert "Unschedulable" in out and "v5e-16x1" in out
+
+    def test_queue_json(self, tmp_path, capsys):
+        state = self._setup(tmp_path, capsys)
+        rc, out = _run(["--state-dir", state, "queue", "-o", "json"],
+                       capsys)
+        assert rc == 0
+        rows = json.loads(out)
+        assert len(rows) == 1
+        row = rows[0]
+        assert row["name"] == "waiting"
+        assert row["priority"] == 3
+        assert row["slices"] == "v5e-16x1"
+        assert row["reason"] == "Unschedulable"
+        assert "no adjacent" in row["message"]
+        assert row["queued_seconds"] >= 0.0
+
+    def test_queue_empty(self, tmp_path, capsys):
+        state = str(tmp_path / "state")
+        pf = _write(tmp_path, "platform.yaml", SCHED_PLATFORM_YAML)
+        _run(["--state-dir", state, "apply", "-f", pf], capsys)
+        rc, out = _run(["--state-dir", state, "queue"], capsys)
+        assert rc == 0
+        assert "queue empty" in out
+        rc, out = _run(["--state-dir", state, "queue", "-o", "json"],
+                       capsys)
+        assert json.loads(out) == []
