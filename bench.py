@@ -856,17 +856,21 @@ def bench_schedule(args) -> None:
     }
     common = dict(
         num_jobs=jobs, fleet_capacity=fleet, pool_size=args.pool_size,
-        seed=args.seed,
+        seed=args.seed, ckpt_every_ticks=args.ckpt_every,
     )
     fifo = run_schedule_storm(policy="fifo", **common)
     sched = run_schedule_storm(policy="priority", **common)
     for rep in (fifo, sched):
-        check_storm_gates(rep)
+        check_storm_gates(rep)      # accounting + inversions + goodput
         if not rep.converged:
             raise SystemExit(
                 f"[{rep.policy}] storm did not converge in {rep.ticks} "
                 f"ticks: {rep.succeeded}+{rep.failed} terminal of "
                 f"{rep.submitted}")
+        if rep.queue_age_count == 0:
+            raise SystemExit(
+                f"[{rep.policy}] kftpu_scheduler_queue_age_seconds is "
+                "empty — the contended storm must observe queue ages")
     fifo_p95 = fifo.ttp_ticks["high"]["p95"]
     sched_p95 = sched.ttp_ticks["high"]["p95"]
     if sched.utilization <= fifo.utilization:
@@ -877,6 +881,25 @@ def bench_schedule(args) -> None:
         raise SystemExit(
             f"scheduler did not beat FIFO on high-priority p95 "
             f"time-to-placement: {sched_p95} >= {fifo_p95} ticks")
+    if args.goodput_out:
+        # The utilization win re-expressed as attributed slice-seconds:
+        # the priority scheduler converts queue_wait into productive
+        # time on the SAME storm, conservation-gated in both runs.
+        with open(args.goodput_out, "w") as f:
+            json.dump({
+                "bench": "schedule-goodput",
+                "storm": {"jobs": jobs, "seed": args.seed,
+                          "fleet": fleet, "pool_size": args.pool_size,
+                          "ckpt_every_ticks": args.ckpt_every},
+                "fifo": fifo.goodput,
+                "priority": sched.goodput,
+                "goodput_ratio_win": round(
+                    sched.goodput["goodput_ratio"]
+                    / max(fifo.goodput["goodput_ratio"], 1e-9), 3),
+                "utilization": {"fifo": round(fifo.utilization, 4),
+                                "priority": round(sched.utilization, 4)},
+            }, f, indent=1, sort_keys=True)
+            f.write("\n")
     _emit(
         "scheduler_fleet_utilization",
         sched.utilization, "fraction",
@@ -1194,6 +1217,14 @@ def main() -> None:
     p.add_argument("--seed", type=int, default=1,
                    help="schedule bench: storm seed (arrivals, widths, "
                         "priorities, durations)")
+    p.add_argument("--ckpt-every", type=int, default=3,
+                   help="schedule bench: checkpoint cadence in productive "
+                        "ticks (the goodput ledger's rollback model; 0 = "
+                        "continuous checkpointing, no work ever lost)")
+    p.add_argument("--goodput-out", default="",
+                   help="schedule bench: also write the FIFO-vs-priority "
+                        "goodput ledgers (attributed slice-seconds) to "
+                        "this JSON file (the GOODPUT_r10.json record)")
     p.add_argument("--namespaces", type=int, default=20,
                    help="controlplane bench: namespaces the job fleet is "
                         "spread across (exercises the per-ns index)")

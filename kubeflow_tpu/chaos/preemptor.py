@@ -146,10 +146,17 @@ class ShardPreemptor:
         self.rng = random.Random(seed)
         self.kills = 0
         self.replay_identical = True
+        # Goodput ledger replay (ISSUE 10): True while every killed
+        # shard's accountant came back byte-identical from its journal.
+        self.goodput_replay_identical = True
         self.metrics_kills = registry.counter(
             "kftpu_chaos_shard_kills_total",
             "Whole-shard process kills injected",
         )
+
+    def _goodput_fp(self, shard_id: int):
+        fp = getattr(self.plane, "shard_goodput_fingerprint", None)
+        return fp(shard_id) if fp is not None else None
 
     def kill_random(self, *, restart: bool = True) -> Optional[int]:
         """SIGKILL one seeded-random live shard; with ``restart`` the
@@ -164,6 +171,7 @@ class ShardPreemptor:
         # fingerprint is exact — byte-identical replay is then a hard
         # gate, not a heuristic.
         pre = self.plane.shard_fingerprint(victim)
+        pre_goodput = self._goodput_fp(victim)
         self.plane.kill(victim)
         self.kills += 1
         self.metrics_kills.inc()
@@ -174,6 +182,13 @@ class ShardPreemptor:
                 self.replay_identical = False
                 log.error("shard replay diverged", kv={
                     "shard": victim, "pre": pre[1], "post": post[1],
+                })
+            post_goodput = self._goodput_fp(victim)
+            if pre_goodput is not None and post_goodput != pre_goodput:
+                self.goodput_replay_identical = False
+                log.error("goodput ledger replay diverged", kv={
+                    "shard": victim, "pre": pre_goodput,
+                    "post": post_goodput,
                 })
         log.warning("shard preempted", kv={"shard": victim,
                                            "restarted": restart})

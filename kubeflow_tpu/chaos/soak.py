@@ -56,6 +56,10 @@ class SoakReport:
     queue_wait_s: Dict[str, float] = dataclasses.field(default_factory=dict)
     watch_lag_s: Dict[str, float] = dataclasses.field(default_factory=dict)
     workers: int = 1                 # reconcile worker-pool size
+    # Goodput ledger (ISSUE 10): per-category slice-tick attribution of
+    # the soak's tracked capacity, conservation-checked exactly. Empty
+    # when the soak runs unconstrained (no capacity to attribute).
+    goodput: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     def stuck_jobs(self) -> Dict[str, str]:
         return {n: p for n, p in self.phases.items() if p not in TERMINAL}
@@ -143,6 +147,19 @@ def run_soak(
     # and SLO measurement are not themselves subject to API chaos.
     preemptor = SlicePreemptor(inner, seed=seed + 2, capacity=capacity,
                                registry=registry)
+    # Goodput ledger (ISSUE 10): watches the raw store's event stream —
+    # the same transitions controllers consume — and attributes every
+    # tracked slice-tick (one tick per soak round) to exactly one
+    # category. track_rollback=False: the soak's work model never loses
+    # progress (kubelet outcome counts survive restarts), i.e. it
+    # checkpoints continuously.
+    goodput_acc = None
+    if capacity is not None:
+        from kubeflow_tpu.obs.goodput import GoodputAccountant
+
+        goodput_acc = GoodputAccountant.from_capacity(
+            dict(capacity), registry=registry, track_rollback=False)
+        goodput_acc.attach(inner)
     prober = AvailabilityProber({}, registry, interval_s=1e9)
     prober.add_target("tpujob-controller",
                       controller_target(mgr, job_ctl), registry)
@@ -197,6 +214,12 @@ def run_soak(
         if chaos.enabled and rounds >= fault_rounds:
             chaos.quiesce()
             preemptor.restore_capacity()
+        if goodput_acc is not None:
+            # Reclaimed slices stop being "offered" capacity; restores
+            # re-track them. Then attribute this round's slice-ticks.
+            goodput_acc.set_capacity(dict(capacity))
+            goodput_acc.pump()
+            goodput_acc.tick(rounds)
         phases = {j.metadata.name: j.status.phase
                   for j in inner.list("TpuJob", copy=False)}
         if not chaos.enabled and all(p in TERMINAL for p in phases.values()):
@@ -229,7 +252,10 @@ def run_soak(
         watch_lag_s=registry.percentiles(
             "kftpu_watch_delivery_lag_seconds"),
         workers=workers,
+        goodput=goodput_acc.snapshot() if goodput_acc is not None else {},
     )
+    if goodput_acc is not None:
+        goodput_acc.close()
     log.info("soak done", kv={
         "converged": converged, "rounds": rounds,
         "injected": sum(report.injected.values()),
@@ -257,6 +283,10 @@ class ShardedSoakReport:
     injected: Dict[str, int]         # union fault tally across shards
     leader_epochs: int               # election epochs (>1 iff leader moved)
     state_signature: str             # union fingerprint at soak end
+    # Goodput ledger (ISSUE 10): per-shard accountants unioned.
+    goodput_conserved: bool = True   # exact per-shard AND union
+    goodput_replay_identical: bool = True  # journal replay across kills
+    goodput: Dict[str, object] = dataclasses.field(default_factory=dict)
 
 
 def run_sharded_soak(
@@ -353,6 +383,7 @@ def run_sharded_soak(
         for info in cp.info().values():
             for k, v in info["injected"].items():
                 injected[k] = injected.get(k, 0) + v
+        goodput_union = cp.goodput_union() or {}
         counts, signature = cp.fingerprint()
         phases = dict(counts.get("TpuJob", {}))
         converged = sum(phases.values()) == num_jobs and all(
@@ -376,6 +407,9 @@ def run_sharded_soak(
         injected=injected,
         leader_epochs=epochs,
         state_signature=signature,
+        goodput_conserved=goodput_union.get("conserved", True),
+        goodput_replay_identical=shard_killer.goodput_replay_identical,
+        goodput=goodput_union,
     )
     log.info("sharded soak done", kv={
         "converged": converged, "rounds": rounds, "shards": shards,

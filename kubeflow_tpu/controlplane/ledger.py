@@ -188,10 +188,19 @@ class LedgerService:
     than a fresh double-admit window."""
 
     def __init__(self, capacity: Dict[str, int], serve_conn, *,
-                 journal_path: str = "", fsync: bool = True):
+                 journal_path: str = "", fsync: bool = True,
+                 tracer=None):
         self.ledger = CapacityLedger(capacity)
         self.serve_conn = serve_conn
         self.journal = _Journal(journal_path, fsync)
+        # Cross-shard trace stitching (ISSUE 10): requests carry the
+        # caller's (trace_id, span_id); with a tracer the service
+        # records one `ledger.<op>` span PER request that adopts the
+        # caller's trace id and links back to the calling span — the
+        # gang's `tpuctl trace` timeline then includes its cross-shard
+        # reserve round-trip instead of an orphan span on the
+        # lease-holding shard.
+        self.tracer = tracer
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.served = 0
@@ -219,9 +228,28 @@ class LedgerService:
             self._thread = None
         self.journal.close()
 
-    def handle(self, op: str, args: tuple):
+    def handle(self, op: str, args: tuple, ctx=None):
         """One ledger operation (journal included) — the serve loop's
-        body, also callable directly by a leader-local client."""
+        body, also callable directly by a leader-local client. ``ctx``
+        is the caller's span context: the operation is recorded as a
+        span in the CALLER's trace (id adopted, link back)."""
+        if self.tracer is not None and ctx:
+            ctx = (str(ctx[0]), str(ctx[1]))
+            with self.tracer.span(f"ledger.{op}", links=[ctx],
+                                  trace_id=ctx[0]) as sp:
+                payload = self._handle(op, args)
+                if op == "reserve":
+                    sp.attrs.update({
+                        "uid": args[0], "slice_type": args[1],
+                        "num_slices": args[2],
+                        "verdict": payload or "reserved",
+                    })
+                elif op == "release":
+                    sp.attrs["uid"] = args[0]
+                return payload
+        return self._handle(op, args)
+
+    def _handle(self, op: str, args: tuple):
         if op == "reserve":
             uid, slice_type, num_slices = args
             verdict, changed = self.ledger.reserve(uid, slice_type,
@@ -268,8 +296,12 @@ class LedgerService:
             try:
                 if not self.serve_conn.poll(0.05):
                     continue
-                req_id, op, args = self.serve_conn.recv()
-                payload = self.handle(op, args)
+                msg = self.serve_conn.recv()
+                # 4-tuples carry the caller's span context; 3-tuples
+                # (pre-stitching peers) still serve.
+                req_id, op, args = msg[0], msg[1], msg[2]
+                ctx = msg[3] if len(msg) > 3 else None
+                payload = self.handle(op, args, ctx)
                 self.served += 1
                 self.serve_conn.send((req_id, payload))
             except (EOFError, OSError):
@@ -296,11 +328,19 @@ class LedgerClient:
     def _call(self, op: str, args: tuple):
         import time as _time
 
+        from kubeflow_tpu.utils.tracing import current_span
+
+        # Carry the calling span's context over the pipe (the reconcile
+        # span of the admitting controller): the leader-side service
+        # records the operation INTO that trace, so `tpuctl trace`
+        # stitches the cross-shard round-trip into one timeline.
+        span = current_span()
+        ctx = (span.trace_id, span.span_id) if span is not None else None
         with self._lock:
             self._seq += 1
             req_id = self._seq
             try:
-                self.conn.send((req_id, op, args))
+                self.conn.send((req_id, op, args, ctx))
             except (OSError, ValueError):
                 raise TimeoutError
             t0 = _time.monotonic()
@@ -345,12 +385,19 @@ class LocalLedgerClient:
     def __init__(self, service: LedgerService):
         self.service = service
 
+    @staticmethod
+    def _ctx():
+        from kubeflow_tpu.utils.tracing import current_span
+
+        span = current_span()
+        return (span.trace_id, span.span_id) if span is not None else None
+
     def try_reserve(self, uid, slice_type, num_slices):
         return self.service.handle("reserve", (uid, slice_type,
-                                               num_slices))
+                                               num_slices), self._ctx())
 
     def release(self, uid) -> None:
-        self.service.handle("release", (uid,))
+        self.service.handle("release", (uid,), self._ctx())
 
     def snapshot(self):
         return self.service.handle("snapshot", ())
@@ -412,7 +459,10 @@ class LedgerRelay:
     def _forward(self, client_id: int, msg) -> None:
         import time as _time
 
-        req_id, op, args = msg
+        # 4th element (when present) is the caller's span context — pure
+        # passthrough: the relay neither opens spans nor rewrites it.
+        req_id, op, args = msg[0], msg[1], msg[2]
+        ctx = msg[3] if len(msg) > 3 else None
         leader = self.leader_of()
         reply = (req_id,
                  LedgerClient.UNAVAILABLE if op == "reserve" else None)
@@ -430,7 +480,7 @@ class LedgerRelay:
                 self._fwd_seq += 1
                 fwd_id = self._fwd_seq
                 try:
-                    conn.send((fwd_id, op, args))
+                    conn.send((fwd_id, op, args, ctx))
                     deadline = _time.monotonic() + self.leader_timeout_s
                     while True:
                         remaining = deadline - _time.monotonic()

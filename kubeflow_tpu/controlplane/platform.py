@@ -13,7 +13,9 @@ compose across processes without a running cluster.
 
 from __future__ import annotations
 
+import json
 import os
+import time
 from typing import Dict, List, Optional
 
 import yaml
@@ -35,6 +37,7 @@ from kubeflow_tpu.controlplane.runtime import (
     ControllerManager,
     InMemoryApiServer,
 )
+from kubeflow_tpu.obs.goodput import GOODPUT_STATE
 from kubeflow_tpu.utils import get_logger
 from kubeflow_tpu.utils.monitoring import MetricsRegistry
 from kubeflow_tpu.utils.tracing import Tracer
@@ -95,6 +98,7 @@ class Platform:
                                          workers=workers)
         self.kfam: Optional[AccessManagement] = None
         self.scheduler = None    # GangScheduler when a fleet is configured
+        self.goodput = None      # GoodputAccountant when capacity is known
         self.jwa = None          # NotebookWebApp when enabled
         self.dashboard = None    # DashboardApi when enabled
         self.prober = None       # AvailabilityProber when enabled
@@ -230,6 +234,22 @@ class Platform:
             self.manager.register(TpuJobController(self.api, reg,
                                                    capacity=capacity,
                                                    scheduler=scheduler))
+            # Fleet goodput ledger (ISSUE 10): tracked whenever the
+            # platform knows its offered capacity (a scheduler fleet's
+            # concrete units, else the capacity map's synthetic slots).
+            # Live runs attribute monotonic nanoseconds; conservation
+            # stays integer-exact. Surfaced by `tpuctl goodput`.
+            from kubeflow_tpu.obs.goodput import GoodputAccountant
+
+            if scheduler is not None:
+                self.goodput = GoodputAccountant.from_fleet(
+                    scheduler.fleet, registry=reg, tick_seconds=1e-9)
+            elif capacity:
+                self.goodput = GoodputAccountant.from_capacity(
+                    capacity, registry=reg, tick_seconds=1e-9)
+            if self.goodput is not None:
+                self.goodput.attach(self.api)
+                self.goodput.reset_clock(time.monotonic_ns())
         elif name == "studyjob-controller":
             self.manager.register(StudyJobController(self.api, reg))
         elif name == "notebook-controller":
@@ -351,6 +371,9 @@ class Platform:
         n = self.manager.run_until_idle(include_timers_within=0.2)
         if self.prober is not None:
             self.prober.maybe_probe()
+        if self.goodput is not None:
+            self.goodput.pump()
+            self.goodput.tick(time.monotonic_ns())
         return n
 
     def substrate_spec(self, name: str):
@@ -422,12 +445,24 @@ class Platform:
             # WAL down to the (normally empty) newer tail.
             self.wal.compact(saved_rv)
         # Append spans recorded since the last save so `tpuctl trace` can
-        # reconstruct causal timelines across tpuctl invocations; the file
-        # is trimmed to its newest half past 4 MB (the ring is bounded,
-        # the state dir must be too).
+        # reconstruct causal timelines across tpuctl invocations; past
+        # the byte cap the file rolls to trace.jsonl.1 (single
+        # generation — the ring is bounded, the state dir must be too)
+        # and `tpuctl trace` reads both generations.
         trace_path = os.path.join(state_dir, TRACE_FILE)
         self.tracer.export_new_jsonl(trace_path)
-        self.tracer.trim_jsonl(trace_path)
+        self.tracer.rotate_jsonl(trace_path)
+        if self.goodput is not None:
+            # Goodput ledger totals persist across tpuctl invocations
+            # (integer tallies — the time BETWEEN processes is not
+            # platform time and is deliberately not counted).
+            with open(os.path.join(state_dir, GOODPUT_STATE + ".tmp"),
+                      "w") as f:
+                json.dump(self.goodput.dump_state(), f, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(os.path.join(state_dir, GOODPUT_STATE + ".tmp"),
+                       os.path.join(state_dir, GOODPUT_STATE))
         return path
 
     @classmethod
@@ -467,4 +502,11 @@ class Platform:
         pcs = platform.api.list("PlatformConfig")
         if pcs:
             platform.apply_config(pcs[0])
+        gp_path = os.path.join(state_dir, GOODPUT_STATE)
+        if platform.goodput is not None and os.path.exists(gp_path):
+            # Resume the goodput ledger's integer tallies; the clock
+            # baseline was just reset, so inter-invocation wall time
+            # contributes nothing.
+            with open(gp_path) as f:
+                platform.goodput.load_state(json.load(f))
         return platform

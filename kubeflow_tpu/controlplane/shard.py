@@ -264,6 +264,35 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
     preemptor = SlicePreemptor(api, seed=spec.seed + 202 + spec.shard_id,
                                capacity=capacity, registry=registry)
 
+    # Per-shard goodput ledger (ISSUE 10): tick-driven (one tick per
+    # parent "round"), journaled under the shard dir with the same
+    # fsync discipline as the WAL, unit ids shard-prefixed so rows
+    # union like state_fingerprint() rows. A SIGKILLed shard rebuilds
+    # its ledger by replaying the journal through the same application
+    # path — byte-identical accounting, gated by shard-smoke.
+    goodput_acc = None
+    goodput_tick = 0
+    if spec.capacity:
+        from kubeflow_tpu.obs.goodput import (
+            GOODPUT_JOURNAL,
+            GoodputAccountant,
+        )
+
+        gp_journal = (os.path.join(_wal_dir(spec), GOODPUT_JOURNAL)
+                      if spec.state_dir else "")
+        goodput_acc = GoodputAccountant.from_capacity(
+            spec.capacity,
+            unit_prefix=f"sh{spec.shard_id:02d}:",
+            registry=registry, track_rollback=False,
+            journal_path=gp_journal, fsync=spec.wal_fsync)
+        if gp_journal and os.path.exists(gp_journal):
+            goodput_acc.replay_from(gp_journal)
+            goodput_tick = goodput_acc.last_tick()
+        # Attach AFTER WAL replay: the initial watch sync baselines the
+        # job table at the recovered store (replayed restart counters
+        # must not read as fresh interruptions).
+        goodput_acc.attach(api)
+
     class _Singleton(Controller):
         NAME = ShardSingleton.NAME
         WATCH_KINDS = ("PlatformConfig",)
@@ -296,13 +325,18 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
                 journal_path=(ledger_journal_path(spec.state_dir)
                               if spec.state_dir else ""),
                 fsync=spec.wal_fsync,
+                # The shard's tracer: ledger.<op> spans adopt the
+                # requesting shard's trace id, land in THIS shard's
+                # trace.jsonl, and shard-aware `tpuctl trace` stitches
+                # the cross-shard round-trip into one timeline.
+                tracer=tracer,
             ).start()
         elif not want and ledger_service is not None:
             ledger_service.stop()
             ledger_service = None
 
     def handle(msg: Tuple) -> Any:
-        nonlocal singleton, leading
+        nonlocal singleton, leading, goodput_tick
         cmd = msg[0]
         if cmd == "create":
             n = 0
@@ -323,6 +357,24 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
             kubelet.tick()
             n += mgr.run_until_idle(max_iterations=500000,
                                     include_timers_within=window)
+            if goodput_acc is not None:
+                # Reclaimed slices stop being offered capacity; then
+                # attribute this round's slice-ticks.
+                goodput_acc.set_capacity(dict(capacity or {}))
+                goodput_acc.pump()
+                goodput_tick += 1
+                goodput_acc.tick(goodput_tick)
+            if spec.state_dir:
+                # Spans (reconciles, ledger round-trips) land in the
+                # shard's trace file so shard-aware `tpuctl trace` can
+                # stitch cross-shard timelines; rotated past the byte
+                # cap like the Platform file (trace readers merge both
+                # generations).
+                from kubeflow_tpu.utils.tracing import Tracer
+
+                trace_path = os.path.join(_wal_dir(spec), "trace.jsonl")
+                tracer.export_new_jsonl(trace_path)
+                Tracer.rotate_jsonl(trace_path)
             phases: Dict[str, int] = {}
             terminal = True
             for j in api.list("TpuJob", copy=False):
@@ -375,6 +427,18 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
             return [j.metadata.uid
                     for j in api.list("TpuJob", copy=False)
                     if j.status.phase not in ("Succeeded", "Failed")]
+        if cmd == "goodput":
+            if goodput_acc is None:
+                return None
+            cats, digest = goodput_acc.fingerprint()
+            return {
+                "rows": goodput_acc.rows(),
+                "fingerprint": digest,
+                "categories_ticks": cats,
+                "conserved": goodput_acc.conservation()["exact"],
+                "summary": goodput_acc.snapshot(),
+                "tick": goodput_tick,
+            }
         if cmd == "info":
             return {
                 "shard_id": spec.shard_id,
@@ -697,6 +761,46 @@ class ShardedControlPlane:
         for uids in self._broadcast("job_uids").values():
             live.extend(uids)
         return self._call(self.leader_id, "ledger_prune", live)
+
+    def shard_goodput(self, shard_id: int) -> Optional[Dict[str, Any]]:
+        """One shard's goodput ledger payload (rows + fingerprint +
+        conservation verdict); None when the shard tracks no capacity."""
+        return self._call(shard_id, "goodput")
+
+    def shard_goodput_fingerprint(self, shard_id: int) -> Optional[str]:
+        payload = self.shard_goodput(shard_id)
+        return payload["fingerprint"] if payload else None
+
+    def goodput_union(self) -> Optional[Dict[str, Any]]:
+        """The fleet goodput ledger as the UNION of every live shard's
+        rows — unit ids are shard-prefixed, so the union digests exactly
+        like ``fingerprint()`` does for object state. Conservation must
+        hold per shard AND for the union (sums of exact sums)."""
+        from kubeflow_tpu.obs.goodput import goodput_rows_digest
+
+        rows: List[Tuple] = []
+        cats: Dict[str, int] = {}
+        tracked = 0
+        conserved = True
+        any_payload = False
+        for shard_id, payload in self._broadcast("goodput").items():
+            if payload is None:
+                continue
+            any_payload = True
+            rows.extend(tuple(r) for r in payload["rows"])
+            conserved = conserved and payload["conserved"]
+            for cat, n in payload["categories_ticks"].items():
+                cats[cat] = cats.get(cat, 0) + n
+            tracked += payload["summary"]["tracked_ticks"]
+        if not any_payload:
+            return None
+        conserved = conserved and sum(cats.values()) == tracked
+        return {
+            "categories_ticks": dict(sorted(cats.items())),
+            "tracked_ticks": tracked,
+            "conserved": conserved,
+            "fingerprint": goodput_rows_digest(rows),
+        }
 
     def shard_rows(self, shard_id: int) -> List[Tuple[str, str, str, str]]:
         return [tuple(r) for r in self._call(shard_id, "fingerprint")]

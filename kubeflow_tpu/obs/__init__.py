@@ -1,0 +1,18 @@
+"""Observability subsystems that sit ABOVE the span/metric primitives:
+`utils/tracing.py` and `utils/monitoring.py` record what happened;
+modules here turn those streams into operator-facing accounts (the
+fleet goodput ledger first — ISSUE 10)."""
+
+from kubeflow_tpu.obs.goodput import (
+    CATEGORIES,
+    GoodputAccountant,
+    chaos_policy_parity_report,
+    goodput_rows_digest,
+)
+
+__all__ = [
+    "CATEGORIES",
+    "GoodputAccountant",
+    "chaos_policy_parity_report",
+    "goodput_rows_digest",
+]

@@ -1,0 +1,933 @@
+"""Fleet goodput ledger: slice-second attribution with conservation-gated
+accounting (ISSUE 10).
+
+Of every slice-second the hardware offered, how many were productive and
+where did the rest go? The :class:`GoodputAccountant` watches the same
+event stream controllers do (``api.watch()`` — TpuJob phase transitions,
+``status.slice_assignment`` assign/clear, preemption/defrag/checkpoint
+events) and decomposes every tracked slice's timeline into exclusive,
+exhaustive categories:
+
+- ``productive`` — held by a gang whose workers are all Running (outside
+  checkpoint-save windows);
+- ``queue_wait`` — free while at least one gang queues (Admitted=False /
+  unplaced): capacity the scheduler could not hand to waiting demand;
+- ``restart_rollback`` — held by a gang between an interruption and full
+  resume (preempt → re-place → resume, spin-up included), PLUS the
+  productive seconds re-done after the restart (work since the last
+  checkpoint save is moved productive → restart_rollback when the
+  interruption lands — recompute is rollback, not goodput);
+- ``migration`` — the same window when the interruption was a defrag
+  migration (the ``DefragMigration`` event names the cause BEFORE the
+  eviction's status bump arrives);
+- ``checkpoint_overhead`` — held by a Running gang inside a declared
+  checkpoint-save window;
+- ``idle_free`` — free with no queued demand.
+
+**Conservation invariant** (the hard gate, never approximate): per slice
+and per fleet, attributed time sums EXACTLY to tracked capacity-time.
+All arithmetic is integer — logical ticks in the benches/soaks,
+``time.monotonic_ns()`` in live runs — so the invariant is bit-exact and
+a bookkeeping bug trips the gate instead of rounding away. CI gates are
+tick/count-based, never wall-clock.
+
+Chaos-vs-policy parity: both a chaos slice preemption and a scheduler
+priority eviction reach the job as the SAME transition (the PR-8 seam —
+``scheduler.preempt.preempt_gang`` marks the pods, the controller bumps
+``status.preemptions``), and the accountant classifies off that bump, so
+injected and policy preemptions attribute identically by construction
+(:func:`chaos_policy_parity_report` proves it on twin worlds).
+
+Rebuild contract: every attribution is journaled (fsync'd jsonl, the WAL
+discipline) and :meth:`replay_from` re-applies the records through the
+same code path the live ledger used — a SIGKILLed shard's accountant
+comes back byte-identical (``fingerprint()`` equality, gated by the CI
+``shard-smoke`` stage). Per-shard accountants' :meth:`rows` union like
+``state_fingerprint()`` rows: globally-unique unit ids, order-independent
+digest (:func:`goodput_rows_digest`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+log = get_logger("goodput")
+
+#: The exclusive, exhaustive attribution categories (docs/observability.md).
+CATEGORIES = (
+    "productive",
+    "queue_wait",
+    "restart_rollback",
+    "migration",
+    "checkpoint_overhead",
+    "idle_free",
+)
+
+#: Phases during which a gang holds (synthetic) capacity when the
+#: scheduler does not pin concrete units.
+ASSIGNED_PHASES = ("Scheduling", "Starting", "Running", "Restarting")
+TERMINAL_PHASES = ("Succeeded", "Failed")
+
+GOODPUT_JOURNAL = "goodput.jsonl"
+GOODPUT_STATE = "goodput.json"
+
+
+def goodput_rows_digest(rows: Iterable[Tuple]) -> str:
+    """Order-independent sha256 over ledger rows — per-shard accountants'
+    rows union exactly like ``state_fingerprint()`` rows (unit ids are
+    globally unique, so the union digest is layout-independent)."""
+    joined = sorted("|".join(str(c) for c in r) for r in rows)
+    return hashlib.sha256("\n".join(joined).encode()).hexdigest()
+
+
+class _Journal:
+    """fsync'd jsonl appender with torn-tail-tolerant replay (the same
+    discipline as ``controlplane/ledger.py``)."""
+
+    def __init__(self, path: str, fsync: bool):
+        self.path = path
+        self.fsync = fsync
+        self._f = None
+
+    def append(self, rec: dict) -> None:
+        if not self.path:
+            return
+        if self._f is None:
+            self._f = open(self.path, "a")
+        self._f.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._f.flush()
+        if self.fsync:
+            os.fsync(self._f.fileno())
+
+    @staticmethod
+    def read(path: str) -> List[dict]:
+        out: List[dict] = []
+        if not path or not os.path.exists(path):
+            return out
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    break       # torn tail record: crash mid-append
+        return out
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class _JobTrack:
+    """The accountant's view of one TpuJob, built from watch events."""
+
+    __slots__ = (
+        "uid", "name", "namespace", "slice_type", "num_slices", "phase",
+        "admitted", "assignment", "preemptions", "restarts",
+        "interruption", "checkpointing", "deleted",
+    )
+
+    def __init__(self, uid: str, name: str, namespace: str,
+                 slice_type: str, num_slices: int):
+        self.uid = uid
+        self.name = name
+        self.namespace = namespace
+        self.slice_type = slice_type
+        self.num_slices = num_slices
+        self.phase = ""
+        self.admitted = True
+        self.assignment = ""
+        self.preemptions = 0
+        self.restarts = 0
+        self.interruption: Optional[str] = None  # "preempt"|"migration"|...
+        self.checkpointing = False
+        self.deleted = False
+
+    @property
+    def live(self) -> bool:
+        return not self.deleted and self.phase not in TERMINAL_PHASES
+
+
+class GoodputAccountant:
+    """Per-slice goodput ledger over a fixed unit set.
+
+    Time is an opaque monotone integer; ``tick_seconds`` scales it to
+    seconds for reporting only (1.0 for logical-tick drivers, 1e-9 for
+    ``time.monotonic_ns()`` live runs). All ledger arithmetic stays in
+    integers so conservation is exact, never approximate.
+    """
+
+    def __init__(
+        self,
+        units: Dict[str, List[str]],      # slice_type -> ordered unit uids
+        *,
+        tick_seconds: float = 1.0,
+        registry: Optional[MetricsRegistry] = None,
+        journal_path: str = "",
+        fsync: bool = True,
+        explicit_assignments: bool = False,
+        track_rollback: bool = True,
+    ):
+        self._lock = threading.RLock()
+        self.tick_seconds = float(tick_seconds)
+        # ``explicit_assignments``: a GangScheduler fleet pins concrete
+        # unit uids into status.slice_assignment — the accountant then
+        # NEVER synthesizes an allocation (a preempted gang holds
+        # nothing until re-placed). Without a scheduler, gangs hold
+        # shape-only assignments and the accountant allocates sticky
+        # synthetic units per phase.
+        self.explicit_assignments = explicit_assignments
+        # ``track_rollback=False`` models continuous checkpointing (the
+        # sleep-free sims where finished work is never lost): no
+        # productive second is ever reclassified on an interruption.
+        self.track_rollback = track_rollback
+        self._order: Dict[str, List[str]] = {
+            st: list(us) for st, us in sorted(units.items())
+        }
+        self._unit_type: Dict[str, str] = {}
+        for st, us in self._order.items():
+            for u in us:
+                if u in self._unit_type:
+                    raise ValueError(f"duplicate goodput unit {u!r}")
+                self._unit_type[u] = st
+        # The ledger proper: integer category tallies per unit, plus an
+        # INDEPENDENTLY accumulated tracked total — conservation compares
+        # the two, so a missed or double attribution trips the gate
+        # instead of vanishing into a derived sum.
+        self._cats: Dict[str, Dict[str, int]] = {
+            u: {} for u in self._unit_type
+        }
+        self._tracked: Dict[str, int] = {u: 0 for u in self._unit_type}
+        self._active: Set[str] = set(self._unit_type)
+        # Per-job ledger (uid-keyed; queue_wait here is demand-side:
+        # seconds x requested slices while the gang waited).
+        self._job_cats: Dict[str, Dict[str, int]] = {}
+        self._job_meta: Dict[str, Tuple[str, str]] = {}
+        self._unsaved: Dict[str, int] = {}
+        self.interruptions: Dict[str, int] = {
+            "preempt": 0, "migration": 0, "restart": 0,
+        }
+        # Event-stream state.
+        self._jobs: Dict[str, _JobTrack] = {}
+        self._pending_migration: Set[str] = set()
+        self._alloc: Dict[str, List[str]] = {}
+        self._unit_job: Dict[str, str] = {}
+        self._last = 0
+        self._api = None
+        self._queue = None
+        self._journal = _Journal(journal_path, fsync)
+        self._replaying = False
+        self.metrics_seconds = None
+        self.metrics_ratio = None
+        if registry is not None:
+            self.metrics_seconds = registry.counter(
+                "kftpu_goodput_slice_seconds_total",
+                "Attributed slice-seconds by goodput category",
+                labels=("category",),
+            )
+            self.metrics_ratio = registry.gauge(
+                "kftpu_job_goodput_ratio",
+                "Productive fraction of each job's attributed "
+                "slice-seconds",
+                labels=("namespace", "name"),
+            )
+
+    # ----------------- construction -----------------
+
+    @classmethod
+    def from_capacity(cls, capacity: Dict[str, int], *,
+                      unit_prefix: str = "", **kw) -> "GoodputAccountant":
+        """Synthetic units out of the admission ledger's vocabulary
+        (slice_type -> count). ``unit_prefix`` namespaces the unit ids so
+        per-shard accountants' rows stay globally unique and union like
+        ``state_fingerprint()`` rows."""
+        units = {
+            st: [f"{unit_prefix}{st}/s{i:03d}" for i in range(int(n))]
+            for st, n in sorted(capacity.items())
+        }
+        return cls(units, **kw)
+
+    @classmethod
+    def from_fleet(cls, fleet, **kw) -> "GoodputAccountant":
+        """Track a GangScheduler fleet's REAL unit uids; assignments then
+        come verbatim from ``status.slice_assignment``."""
+        units: Dict[str, List[str]] = {}
+        for pool in fleet.pools:
+            for u in pool.units:
+                units.setdefault(u.slice_type, []).append(u.uid)
+        kw.setdefault("explicit_assignments", True)
+        return cls(units, **kw)
+
+    # ----------------- event stream -----------------
+
+    def attach(self, api) -> "GoodputAccountant":
+        """Subscribe to the SAME watch stream controllers consume. One
+        kind=None subscription (not one queue per kind): commit order
+        across kinds is what lets a DefragMigration event name the cause
+        of the preemption bump that follows it."""
+        self._api = api
+        self._queue = api.watch(None)
+        return self
+
+    def detach(self) -> None:
+        if self._api is not None and self._queue is not None:
+            try:
+                self._api.stop_watch(self._queue)
+            except AttributeError:
+                pass
+            self._queue = None
+
+    def pump(self) -> int:
+        """Drain and apply every pending watch event (non-blocking)."""
+        if self._queue is None:
+            return 0
+        import queue as _queue
+
+        n = 0
+        while True:
+            try:
+                ev = self._queue.get_nowait()
+            except _queue.Empty:
+                return n
+            self.apply_event(ev)
+            n += 1
+
+    def apply_event(self, ev) -> None:
+        obj = getattr(ev, "object", None)
+        if obj is None:             # BOOKMARK / RELIST sentinels
+            return
+        kind = getattr(obj, "kind", "")
+        with self._lock:
+            if kind == "TpuJob":
+                self._apply_job(ev.type, obj)
+            elif kind == "Event":
+                self._apply_platform_event(obj)
+
+    def _apply_job(self, ev_type: str, job) -> None:
+        uid = job.metadata.uid
+        if ev_type == "DELETED":
+            j = self._jobs.get(uid)
+            if j is not None:
+                j.deleted = True
+            return
+        j = self._jobs.get(uid)
+        if j is None:
+            j = self._jobs[uid] = _JobTrack(
+                uid, job.metadata.name, job.metadata.namespace,
+                job.spec.slice_type, job.spec.num_slices,
+            )
+            # Baseline the restart counters at first sight: an accountant
+            # attached to an already-replayed store (restart path) must
+            # not read history as fresh interruptions.
+            j.preemptions = job.status.preemptions
+            j.restarts = job.status.restarts
+            self._job_meta[uid] = (job.metadata.namespace,
+                                   job.metadata.name)
+        j.slice_type = job.spec.slice_type
+        j.num_slices = job.spec.num_slices
+        j.phase = job.status.phase or ""
+        j.assignment = job.status.slice_assignment or ""
+        j.admitted = True
+        for c in job.status.conditions:
+            if c.type == "Admitted":
+                j.admitted = c.status != "False"
+        if job.status.preemptions > j.preemptions:
+            cause = ("migration" if uid in self._pending_migration
+                     else "preempt")
+            self._pending_migration.discard(uid)
+            self._begin_interruption(j, cause)
+        if job.status.restarts > j.restarts:
+            self._begin_interruption(j, "restart")
+        j.preemptions = job.status.preemptions
+        j.restarts = job.status.restarts
+        if j.phase == "Running":
+            j.interruption = None
+
+    def _apply_platform_event(self, ev) -> None:
+        if getattr(ev, "involved_kind", "") != "TpuJob":
+            return
+        uid = None
+        for j in self._jobs.values():
+            if (j.namespace == ev.involved_namespace
+                    and j.name == ev.involved_name and j.live):
+                uid = j.uid
+                break
+        if uid is None:
+            return
+        if ev.reason == "DefragMigration":
+            self._pending_migration.add(uid)
+        elif ev.reason == "CheckpointSaved":
+            self.checkpoint_saved(uid)
+
+    # ----------------- explicit driver hooks -----------------
+
+    def checkpoint_saved(self, uid: str) -> None:
+        """A checkpoint covering all productive work so far was durably
+        saved: work before this point can no longer be lost to rollback."""
+        with self._lock:
+            rec = {"op": "ckpt", "job": uid}
+            self._journal_rec(rec)
+            self._apply_ckpt(rec)
+
+    def set_checkpointing(self, uid: str, saving: bool) -> None:
+        """Mark a Running gang as inside a checkpoint-save window — its
+        slice-time attributes to ``checkpoint_overhead`` until cleared.
+        (Classification input only: the per-tick journal records the
+        resulting categories, so this flag itself needs no record.)"""
+        with self._lock:
+            j = self._jobs.get(uid)
+            if j is not None:
+                j.checkpointing = saving
+
+    def set_capacity(self, capacity: Dict[str, int]) -> None:
+        """Reflect offered-capacity changes (chaos reclaim / restore):
+        the first N units of each type stay tracked, the rest stop
+        accumulating — hardware that is not offered has no slice-seconds
+        to attribute."""
+        with self._lock:
+            resolved = {}
+            for st, n in sorted(capacity.items()):
+                if st in self._order:
+                    resolved[st] = max(0, min(int(n), len(self._order[st])))
+            active = set(self._active)
+            for st, n in resolved.items():
+                order = self._order[st]
+                active -= set(order)
+                active |= set(order[:n])
+            if active == self._active:
+                return
+            rec = {"op": "cap", "c": resolved}
+            self._journal_rec(rec)
+            self._apply_cap(rec)
+
+    # ----------------- interruption / rollback -----------------
+
+    def _begin_interruption(self, j: _JobTrack, cause: str) -> None:
+        j.interruption = cause
+        j.checkpointing = False
+        moves: Dict[str, List] = {}
+        unsaved = self._unsaved.get(j.uid, 0)
+        units = self._alloc.get(j.uid, [])
+        target = "migration" if cause == "migration" else "restart_rollback"
+        if self.track_rollback and unsaved > 0 and units:
+            # Recompute-from-checkpoint: the productive seconds since the
+            # last save will be re-done — move them to the interruption's
+            # category, split evenly over the units that earned them
+            # (clamped so a unit can never go negative: conservation is
+            # a MOVE, amounts included in the journal record verbatim).
+            q, r = divmod(unsaved, len(units))
+            for i, u in enumerate(units):
+                share = q + (1 if i < r else 0)
+                share = min(share, self._cats[u].get("productive", 0))
+                if share > 0:
+                    moves[u] = ["productive", target, share]
+        rec = {"op": "int", "job": j.uid, "cause": cause, "moves": moves}
+        self._journal_rec(rec)
+        self._apply_int(rec)
+
+    # ----------------- the tick -----------------
+
+    def tick(self, now: int) -> None:
+        """Attribute the interval since the previous tick: every tracked
+        unit's elapsed time lands in exactly one category (the state as
+        classified NOW, after :meth:`pump` applied pending events)."""
+        with self._lock:
+            now = int(now)
+            dt = now - self._last
+            if dt <= 0:
+                return
+            states = self._classify()
+            queued = self._queued_demand()
+            rec = {
+                "op": "tick", "t": now, "dt": dt,
+                "s": {u: [cat, job] for u, (cat, job) in states.items()},
+                "q": queued,
+            }
+            self._journal_rec(rec)
+            self._apply_tick(rec)
+
+    def _classify(self) -> Dict[str, Tuple[str, str]]:
+        """{unit: (category, job_uid or "")} over the active units."""
+        self._refresh_allocations()
+        # Queued demand PER SLICE TYPE: a free v5e-16 cannot serve a
+        # queued v4-8 gang, so cross-type demand must not relabel it
+        # queue_wait — that would read a type-mismatched idle fleet as
+        # demand-starved.
+        demand_by_type: Dict[str, int] = {}
+        for uid, n in self._queued_demand().items():
+            j = self._jobs.get(uid)
+            if j is not None:
+                demand_by_type[j.slice_type] = (
+                    demand_by_type.get(j.slice_type, 0) + n)
+        out: Dict[str, Tuple[str, str]] = {}
+        free_by_type: Dict[str, List[str]] = {}
+        for st in self._order:
+            for u in self._order[st]:
+                if u not in self._active:
+                    continue
+                uid = self._unit_job.get(u)
+                j = self._jobs.get(uid) if uid else None
+                if j is not None:
+                    if j.checkpointing and j.phase == "Running":
+                        cat = "checkpoint_overhead"
+                    elif j.phase == "Running":
+                        cat = "productive"
+                    elif j.interruption == "migration":
+                        cat = "migration"
+                    else:
+                        cat = "restart_rollback"
+                    out[u] = (cat, uid)
+                else:
+                    free_by_type.setdefault(st, []).append(u)
+        # Supply-side queue_wait: free capacity while SAME-TYPE demand
+        # queues. The lowest-ordered free units absorb it; the rest are
+        # genuinely idle.
+        for st, frees in free_by_type.items():
+            demand = demand_by_type.get(st, 0)
+            for i, u in enumerate(frees):
+                out[u] = ("queue_wait" if i < demand else "idle_free", "")
+        return out
+
+    def _queued_demand(self) -> Dict[str, int]:
+        """{job_uid: num_slices} for gangs waiting without capacity —
+        Admitted=False, or parked un-placed (phase Pending/empty)."""
+        out: Dict[str, int] = {}
+        for uid, j in self._jobs.items():
+            if not j.live or self._alloc.get(uid):
+                continue
+            if not j.admitted or j.phase in ("", "Pending"):
+                out[uid] = j.num_slices
+        return out
+
+    def _refresh_allocations(self) -> None:
+        from kubeflow_tpu.scheduler.placement import parse_assignment
+
+        for uid, j in sorted(
+                self._jobs.items(),
+                key=lambda kv: (kv[1].namespace, kv[1].name, kv[0])):
+            desired: List[str] = []
+            if j.live:
+                explicit = parse_assignment(j.assignment)
+                if explicit:
+                    desired = [u for u in explicit if u in self._unit_type]
+                elif (not self.explicit_assignments
+                      and j.phase in ASSIGNED_PHASES):
+                    # Sticky synthetic allocation: the lowest free units
+                    # of the job's type, kept until the gang lets go.
+                    held = self._alloc.get(uid, [])
+                    if len(held) == j.num_slices and all(
+                            self._unit_type.get(u) == j.slice_type
+                            for u in held):
+                        desired = held
+                    else:
+                        desired = list(held)
+                        free = [
+                            u for u in self._order.get(j.slice_type, [])
+                            if self._unit_job.get(u) in (None, uid)
+                            and u not in desired
+                        ]
+                        while len(desired) < j.num_slices and free:
+                            desired.append(free.pop(0))
+                        desired = desired[:j.num_slices]
+            self._set_alloc(uid, desired)
+        # Jobs gone from the table entirely keep nothing.
+        for uid in list(self._alloc):
+            if uid not in self._jobs:
+                self._set_alloc(uid, [])
+
+    def _set_alloc(self, uid: str, units: List[str]) -> None:
+        for u in self._alloc.get(uid, []):
+            if self._unit_job.get(u) == uid:
+                del self._unit_job[u]
+        if units:
+            self._alloc[uid] = list(units)
+            for u in units:
+                self._unit_job[u] = uid
+        else:
+            self._alloc.pop(uid, None)
+
+    # ----------------- record application (live AND replay) -----------------
+
+    def _journal_rec(self, rec: dict) -> None:
+        if not self._replaying:
+            self._journal.append(rec)
+
+    def _apply_record(self, rec: dict) -> None:
+        op = rec.get("op")
+        if op == "tick":
+            self._apply_tick(rec)
+        elif op == "int":
+            self._apply_int(rec)
+        elif op == "ckpt":
+            self._apply_ckpt(rec)
+        elif op == "cap":
+            self._apply_cap(rec)
+        elif op == "state":
+            # A compacted journal's head: the full ledger state at
+            # compaction time (see replay_from).
+            self.load_state(rec["state"])
+            self._last = int(rec["t"])
+
+    def _apply_tick(self, rec: dict) -> None:
+        dt = int(rec["dt"])
+        cat_totals: Dict[str, int] = {}
+        for u, (cat, uid) in rec["s"].items():
+            cats = self._cats.get(u)
+            if cats is None:
+                continue
+            cats[cat] = cats.get(cat, 0) + dt
+            self._tracked[u] = self._tracked.get(u, 0) + dt
+            cat_totals[cat] = cat_totals.get(cat, 0) + dt
+            if uid:
+                jc = self._job_cats.setdefault(uid, {})
+                jc[cat] = jc.get(cat, 0) + dt
+                if cat == "productive":
+                    self._unsaved[uid] = self._unsaved.get(uid, 0) + dt
+        for uid, n in rec.get("q", {}).items():
+            jc = self._job_cats.setdefault(uid, {})
+            jc["queue_wait"] = jc.get("queue_wait", 0) + dt * int(n)
+        self._last = int(rec["t"])
+        if self.metrics_seconds is not None:
+            for cat, n in sorted(cat_totals.items()):
+                self.metrics_seconds.inc(n * self.tick_seconds,
+                                         category=cat)
+        if self.metrics_ratio is not None:
+            for uid, jc in self._job_cats.items():
+                meta = self._job_meta.get(uid)
+                total = sum(jc.values())
+                if meta is not None and total > 0:
+                    self.metrics_ratio.set(
+                        jc.get("productive", 0) / total,
+                        namespace=meta[0], name=meta[1])
+
+    def _apply_int(self, rec: dict) -> None:
+        cause = rec["cause"]
+        self.interruptions[cause] = self.interruptions.get(cause, 0) + 1
+        uid = rec["job"]
+        moved_total = 0
+        target = None
+        for u, (frm, to, amount) in rec.get("moves", {}).items():
+            amount = int(amount)
+            cats = self._cats.get(u)
+            if cats is None:
+                continue
+            cats[frm] = cats.get(frm, 0) - amount
+            cats[to] = cats.get(to, 0) + amount
+            moved_total += amount
+            target = to
+        if moved_total and target is not None:
+            jc = self._job_cats.setdefault(uid, {})
+            jc["productive"] = jc.get("productive", 0) - moved_total
+            jc[target] = jc.get(target, 0) + moved_total
+        self._unsaved[uid] = 0
+
+    def _apply_ckpt(self, rec: dict) -> None:
+        self._unsaved[rec["job"]] = 0
+
+    def _apply_cap(self, rec: dict) -> None:
+        for st, n in rec["c"].items():
+            order = self._order.get(st, [])
+            self._active -= set(order)
+            self._active |= set(order[:int(n)])
+
+    # ----------------- replay / persistence -----------------
+
+    def replay_from(self, journal_path: str) -> int:
+        """Rebuild the ledger by re-applying the journal through the SAME
+        application path the live accountant used — byte-identical by
+        construction. When replaying our OWN journal, the log is then
+        compacted to one state record (the ledger.jsonl discipline): a
+        respawn's replay cost stays bounded by ledger size, not by how
+        many ticks the previous incarnations lived. Returns records
+        applied."""
+        recs = _Journal.read(journal_path)
+        with self._lock:
+            self._replaying = True
+            try:
+                for rec in recs:
+                    self._apply_record(rec)
+            finally:
+                self._replaying = False
+            if recs and journal_path == self._journal.path:
+                self._journal.close()
+                tmp = journal_path + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(json.dumps(
+                        {"op": "state", "t": self._last,
+                         "state": self.dump_state()},
+                        sort_keys=True) + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, journal_path)
+        if recs:
+            log.info("goodput journal replayed", kv={
+                "records": len(recs), "last_tick": self._last,
+            })
+        return len(recs)
+
+    def last_tick(self) -> int:
+        return self._last
+
+    def reset_clock(self, now: int) -> None:
+        """Establish the attribution baseline WITHOUT attributing —
+        process start / state-restore time is not platform time."""
+        with self._lock:
+            self._last = int(now)
+
+    def close(self) -> None:
+        self.detach()
+        self._journal.close()
+
+    def dump_state(self) -> dict:
+        """Ledger totals as plain JSON (Platform persistence across
+        tpuctl invocations — the timeline between processes is not
+        platform time and is deliberately not counted)."""
+        with self._lock:
+            return {
+                "units": {
+                    u: {"cats": dict(self._cats[u]),
+                        "tracked": self._tracked[u]}
+                    for u in sorted(self._unit_type)
+                },
+                "jobs": {uid: dict(c)
+                         for uid, c in sorted(self._job_cats.items())},
+                "meta": {uid: list(m)
+                         for uid, m in sorted(self._job_meta.items())},
+                "unsaved": {uid: n for uid, n in sorted(
+                    self._unsaved.items()) if n},
+                "interruptions": dict(self.interruptions),
+                "active": sorted(self._active),
+                "tick_seconds": self.tick_seconds,
+            }
+
+    def load_state(self, state: dict) -> None:
+        with self._lock:
+            for u, rec in state.get("units", {}).items():
+                if u in self._cats:
+                    self._cats[u] = {k: int(v)
+                                     for k, v in rec["cats"].items()}
+                    self._tracked[u] = int(rec["tracked"])
+            self._job_cats = {
+                uid: {k: int(v) for k, v in c.items()}
+                for uid, c in state.get("jobs", {}).items()
+            }
+            for uid, m in state.get("meta", {}).items():
+                self._job_meta.setdefault(uid, (m[0], m[1]))
+            self._unsaved = {uid: int(n)
+                             for uid, n in state.get("unsaved", {}).items()}
+            for k, v in state.get("interruptions", {}).items():
+                self.interruptions[k] = int(v)
+            if "active" in state:
+                self._active = {u for u in state["active"]
+                                if u in self._unit_type}
+
+    # ----------------- read surfaces -----------------
+
+    def conservation(self) -> Dict[str, Any]:
+        """The invariant, checked exactly: per unit AND per fleet, the
+        category sum equals the independently-accumulated tracked total
+        (ints — equality, never tolerance). Negative tallies are
+        violations too (a bad move)."""
+        with self._lock:
+            violations = []
+            for u in self._unit_type:
+                cats = self._cats[u]
+                if sum(cats.values()) != self._tracked[u] or any(
+                        v < 0 for v in cats.values()):
+                    violations.append(u)
+            total_cats = sum(sum(c.values()) for c in self._cats.values())
+            total_tracked = sum(self._tracked.values())
+            return {
+                "exact": not violations and total_cats == total_tracked,
+                "violations": violations,
+                "attributed": total_cats,
+                "tracked": total_tracked,
+            }
+
+    def rows(self) -> List[Tuple[str, str, str, str]]:
+        """The fingerprintable ledger rows; per-shard accountants' rows
+        union into one fleet digest (ids are globally unique)."""
+        with self._lock:
+            rows: List[Tuple[str, str, str, str]] = []
+            for u in sorted(self._unit_type):
+                for cat, n in sorted(self._cats[u].items()):
+                    rows.append(("unit", u, cat, str(n)))
+                rows.append(("tracked", u, "", str(self._tracked[u])))
+            for uid in sorted(self._job_cats):
+                for cat, n in sorted(self._job_cats[uid].items()):
+                    rows.append(("job", uid, cat, str(n)))
+            for uid in sorted(self._unsaved):
+                if self._unsaved[uid]:
+                    rows.append(("unsaved", uid, "", str(self._unsaved[uid])))
+            for cause in sorted(self.interruptions):
+                rows.append(("interruptions", cause, "",
+                             str(self.interruptions[cause])))
+            return rows
+
+    def fingerprint(self) -> Tuple[Dict[str, int], str]:
+        """(fleet category totals, order-independent digest) — the
+        byte-identical-across-SIGKILL gate compares these."""
+        with self._lock:
+            totals: Dict[str, int] = {}
+            for cats in self._cats.values():
+                for cat, n in cats.items():
+                    totals[cat] = totals.get(cat, 0) + n
+        return totals, goodput_rows_digest(self.rows())
+
+    def comparable(self) -> Dict[str, Any]:
+        """Uid-independent view for A/B parity: fleet category totals,
+        interruption tallies, and per-job ledgers keyed by ns/name."""
+        with self._lock:
+            totals: Dict[str, int] = {}
+            for cats in self._cats.values():
+                for cat, n in cats.items():
+                    totals[cat] = totals.get(cat, 0) + n
+            jobs = {}
+            for uid, jc in self._job_cats.items():
+                meta = self._job_meta.get(uid, ("", uid))
+                jobs[f"{meta[0]}/{meta[1]}"] = dict(sorted(jc.items()))
+            return {
+                "categories_ticks": dict(sorted(totals.items())),
+                "interruptions": dict(sorted(self.interruptions.items())),
+                "jobs": dict(sorted(jobs.items())),
+            }
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The report/CLI surface: integer tick tallies (what CI gates
+        on), scaled seconds, ratios, per-job drill-down."""
+        with self._lock:
+            cons = self.conservation()
+            totals: Dict[str, int] = {c: 0 for c in CATEGORIES}
+            for cats in self._cats.values():
+                for cat, n in cats.items():
+                    totals[cat] = totals.get(cat, 0) + n
+            tracked = sum(self._tracked.values())
+            ts = self.tick_seconds
+            jobs: Dict[str, Dict[str, Any]] = {}
+            for uid, jc in sorted(self._job_cats.items()):
+                meta = self._job_meta.get(uid, ("", uid))
+                total = sum(jc.values())
+                jobs[f"{meta[0]}/{meta[1]}"] = {
+                    "categories_ticks": dict(sorted(jc.items())),
+                    "categories_s": {c: round(n * ts, 6)
+                                     for c, n in sorted(jc.items())},
+                    "slice_seconds": round(total * ts, 6),
+                    "goodput_ratio": round(
+                        jc.get("productive", 0) / total, 6) if total else 0.0,
+                }
+            return {
+                "tick_seconds": ts,
+                "units": len(self._unit_type),
+                "active_units": len(self._active),
+                "categories_ticks": {c: totals.get(c, 0)
+                                     for c in CATEGORIES},
+                "tracked_ticks": tracked,
+                "categories_s": {c: round(totals.get(c, 0) * ts, 6)
+                                 for c in CATEGORIES},
+                "tracked_slice_seconds": round(tracked * ts, 6),
+                "goodput_ratio": round(
+                    totals.get("productive", 0) / tracked, 6)
+                if tracked else 0.0,
+                "conserved": cons["exact"],
+                "interruptions": dict(sorted(self.interruptions.items())),
+                "jobs": jobs,
+            }
+
+
+# --------------------------------------------------------------------------
+# Chaos-vs-policy attribution parity
+# --------------------------------------------------------------------------
+
+
+def chaos_policy_parity_report(*, seed: int = 0,
+                               ticks_before: int = 3,
+                               ticks_after: int = 4) -> Dict[str, Any]:
+    """Twin single-gang worlds, identical except for WHO evicts the
+    slice: the chaos :class:`SlicePreemptor` vs the scheduler's policy
+    seam (``scheduler.preempt.preempt_gang`` — the one eviction path of
+    PR 8). Both accountants must produce IDENTICAL ledgers (category
+    totals, interruption tallies, per-job drill-downs): injected faults
+    and policy decisions may never drift apart in goodput terms."""
+    from kubeflow_tpu.controlplane.api.meta import ObjectMeta
+    from kubeflow_tpu.controlplane.api.types import (
+        MeshAxesSpec,
+        TpuJob,
+        TpuJobSpec,
+    )
+    from kubeflow_tpu.controlplane.controllers.podrunner import FakeKubelet
+    from kubeflow_tpu.controlplane.controllers.tpujob import TpuJobController
+    from kubeflow_tpu.controlplane.runtime import (
+        ControllerManager,
+        InMemoryApiServer,
+    )
+
+    def world(evict) -> GoodputAccountant:
+        registry = MetricsRegistry()
+        api = InMemoryApiServer(registry=registry)
+        mgr = ControllerManager(api, registry)
+        mgr.register(TpuJobController(api, registry, hbm_check=False,
+                                      capacity={"v5e-16": 1}))
+        kubelet = FakeKubelet(api, registry, outcome=lambda name: None)
+        mgr.register(kubelet)
+        acc = GoodputAccountant.from_capacity({"v5e-16": 1})
+        acc.attach(api)
+        api.create(TpuJob(
+            metadata=ObjectMeta(name="parity", namespace="obs"),
+            spec=TpuJobSpec(slice_type="v5e-16", mesh=MeshAxesSpec(dp=-1),
+                            backoff_seconds=0.0, max_restarts=3,
+                            preemption_policy="restart"),
+        ))
+        tick = 0
+
+        def step():
+            nonlocal tick
+            mgr.run_until_idle(max_iterations=50000,
+                               include_timers_within=120.0)
+            kubelet.tick()
+            mgr.run_until_idle(max_iterations=50000,
+                               include_timers_within=120.0)
+            acc.pump()
+            tick += 1
+            acc.tick(tick)
+
+        for _ in range(ticks_before):
+            step()
+        job = api.get("TpuJob", "parity", "obs")
+        evict(api, job)
+        for _ in range(ticks_after):
+            step()
+        mgr.close()
+        acc.detach()
+        return acc
+
+    def chaos_evict(api, job):
+        from kubeflow_tpu.chaos.preemptor import SlicePreemptor
+
+        SlicePreemptor(api, seed=seed).preempt(job)
+
+    def policy_evict(api, job):
+        from kubeflow_tpu.scheduler import preempt as preempt_mod
+
+        preempt_mod.preempt_gang(api, job)
+
+    chaos_acc = world(chaos_evict)
+    policy_acc = world(policy_evict)
+    a, b = chaos_acc.comparable(), policy_acc.comparable()
+    return {
+        "identical": a == b,
+        "conserved": (chaos_acc.conservation()["exact"]
+                      and policy_acc.conservation()["exact"]),
+        "preemptions_attributed": a["interruptions"].get("preempt", 0),
+        "chaos": a,
+        "policy": b,
+    }

@@ -132,6 +132,14 @@ class StormReport:
     spilled_placements: int      # DCN-far (cross-pool) slice sets
     inversions: int              # MUST be 0
     reconciles: int
+    # Goodput ledger (ISSUE 10): the storm's slice-ticks attributed to
+    # exclusive categories, conservation-checked exactly (check gated by
+    # check_storm_gates). The FIFO-vs-priority utilization win
+    # re-expressed as attributed slice-seconds.
+    goodput: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # kftpu_scheduler_queue_age_seconds observations (the aging surface
+    # — asserted non-empty by the contended storm bench).
+    queue_age_count: int = 0
 
     @property
     def accounting_exact(self) -> bool:
@@ -158,6 +166,8 @@ class StormReport:
             "spilled_placements": self.spilled_placements,
             "inversions": self.inversions,
             "reconciles": self.reconciles,
+            "goodput": dict(self.goodput),
+            "queue_age_count": self.queue_age_count,
         }
 
 
@@ -178,6 +188,15 @@ def run_schedule_storm(
     # no chaos.
     chaos_at_tick: Optional[int] = None,
     chaos_preempts: int = 0,
+    # Checkpoint cadence model (ISSUE 10): > 0 makes gangs save every
+    # `ckpt_every_ticks` productive ticks, each save occupying
+    # `ckpt_cost_ticks` during which training does not advance
+    # (attributed checkpoint_overhead) — and a preemption rolls work
+    # back to the last save (the lost ticks re-attributed
+    # restart_rollback by the goodput ledger). 0 keeps the PR-8 storm
+    # byte-identical: work is never lost (continuous checkpointing).
+    ckpt_every_ticks: int = 0,
+    ckpt_cost_ticks: int = 1,
     registry: Optional[MetricsRegistry] = None,
 ) -> StormReport:
     fleet_capacity = dict(fleet_capacity or {slice_type: 8})
@@ -205,11 +224,27 @@ def run_schedule_storm(
         )
         mgr.register(defrag_ctl)
 
+    # Goodput ledger over the fleet's REAL unit uids: the accountant
+    # consumes the storm's watch stream like any controller and
+    # attributes every slice-tick; conservation is gated by
+    # check_storm_gates. Rollback tracking only makes sense when the
+    # checkpoint model is on — otherwise the sim checkpoints
+    # continuously and no finished work is ever lost.
+    from kubeflow_tpu.obs.goodput import GoodputAccountant
+
+    accountant = GoodputAccountant.from_fleet(
+        fleet, registry=registry, track_rollback=ckpt_every_ticks > 0)
+    accountant.attach(api)
+
     by_name = {j.name: j for j in storm}
     # A gang runs for duration_ticks ticks of full placement, then its
     # pods report Succeeded on the next kubelet status sync.
     work_done: Dict[str, int] = {}
     finished: set = set()
+    # Checkpoint-model state (ckpt_every_ticks > 0).
+    last_saved: Dict[str, int] = {}
+    saving: Dict[str, int] = {}
+    seen_bumps: Dict[str, int] = {}
 
     def outcome(pod_name: str) -> Optional[str]:
         job_name = pod_name.rsplit("-worker-", 1)[0]
@@ -281,14 +316,56 @@ def run_schedule_storm(
             placed_tick.setdefault(entry["uid"], t)
 
         # Work accounting: a fully-Running placed gang earns one tick.
+        # With the checkpoint model on, a gang periodically spends
+        # ckpt_cost_ticks saving (no training progress, attributed
+        # checkpoint_overhead) and a preemption rolls its work back to
+        # the last completed save.
         jobs_now = {j.metadata.name: j
                     for j in api.list("TpuJob", copy=False)}
+        completed_saves: List[str] = []
         for name, job in jobs_now.items():
-            if job.status.phase == "Running" \
-                    and scheduler.assignment_of(job.metadata.uid):
-                work_done[name] = work_done.get(name, 0) + 1
-                if work_done[name] >= by_name[name].duration_ticks:
-                    finished.add(name)
+            uid = job.metadata.uid
+            if ckpt_every_ticks > 0:
+                bumps = job.status.preemptions + job.status.restarts
+                if bumps > seen_bumps.get(name, 0):
+                    seen_bumps[name] = bumps
+                    work_done[name] = last_saved.get(name, 0)
+                    saving.pop(name, None)
+                    accountant.set_checkpointing(uid, False)
+            if job.status.phase != "Running" \
+                    or not scheduler.assignment_of(uid):
+                continue
+            if saving.get(name, 0) > 0:
+                saving[name] -= 1
+                if saving[name] <= 0:
+                    saving.pop(name)
+                    last_saved[name] = work_done.get(name, 0)
+                    completed_saves.append(uid)
+                continue
+            done = work_done.get(name, 0)
+            if (ckpt_every_ticks > 0 and done < by_name[name].duration_ticks
+                    and done - last_saved.get(name, 0) >= ckpt_every_ticks):
+                # Begin a save: this tick (and the next cost-1 ticks)
+                # are overhead, not progress.
+                accountant.set_checkpointing(uid, True)
+                remaining = ckpt_cost_ticks - 1
+                if remaining <= 0:
+                    last_saved[name] = done
+                    completed_saves.append(uid)
+                else:
+                    saving[name] = remaining
+                continue
+            work_done[name] = done + 1
+            if work_done[name] >= by_name[name].duration_ticks:
+                finished.add(name)
+        # Attribute this tick AFTER the checkpoint flags settle; saves
+        # complete (resetting the rollback window) once their final
+        # overhead tick has been attributed.
+        accountant.pump()
+        accountant.tick(t + 1)
+        for uid in completed_saves:
+            accountant.checkpoint_saved(uid)
+            accountant.set_checkpointing(uid, False)
         util_sum += 1.0 - len(fleet.free()) / total_units
         util_ticks += 1
         if len(jobs_now) == num_jobs and all(
@@ -353,6 +430,8 @@ def run_schedule_storm(
         1 for e in scheduler.preemption_log
         if e["victim_priority"] >= e["requester_priority"]
     )
+    accountant.pump()           # drain the final status transitions
+    queue_age = registry.get("kftpu_scheduler_queue_age_seconds")
     report = StormReport(
         policy=policy,
         submitted=num_jobs,
@@ -372,14 +451,19 @@ def run_schedule_storm(
             1 for e in scheduler.placement_log if e["spilled"]),
         inversions=inversions,
         reconciles=reconciles,
+        goodput=accountant.snapshot(),
+        queue_age_count=queue_age.count() if queue_age is not None else 0,
     )
+    accountant.close()
     mgr.close()
     return report
 
 
 def check_storm_gates(report: StormReport) -> None:
     """The hard gates (raise, not assert — python -O must not skip):
-    exact gang accounting and priority-inversion freedom."""
+    exact gang accounting, priority-inversion freedom, and goodput
+    conservation (attributed slice-ticks sum EXACTLY to tracked
+    capacity-ticks — integer equality, never tolerance)."""
     if not report.accounting_exact:
         raise SystemExit(
             f"[{report.policy}] gang accounting broken: "
@@ -392,3 +476,12 @@ def check_storm_gates(report: StormReport) -> None:
             f"[{report.policy}] {report.inversions} priority inversions — "
             "a lower-priority gang displaced a higher one"
         )
+    g = report.goodput
+    if g:
+        attributed = sum(g["categories_ticks"].values())
+        if not g["conserved"] or attributed != g["tracked_ticks"]:
+            raise SystemExit(
+                f"[{report.policy}] goodput conservation broken: "
+                f"{attributed} attributed slice-ticks != "
+                f"{g['tracked_ticks']} tracked"
+            )

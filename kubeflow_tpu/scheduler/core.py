@@ -46,6 +46,15 @@ POLICIES = ("priority", "fifo")
 #: Phases that no longer hold (or want) slices.
 _TERMINAL = ("Succeeded", "Failed")
 
+#: Queue-age bands (seconds since Admitted=False): a gang legitimately
+#: waits minutes-to-hours behind a full fleet, so the bands run from
+#: sub-second (uncontended) out to hours — the starvation/aging signal
+#: the ROADMAP's FIFO-vs-priority follow-up will gate on.
+QUEUE_AGE_BUCKETS = (
+    0.001, 0.01, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0,
+    900.0, 1800.0, 3600.0, 7200.0, 14400.0,
+)
+
 
 def _arrival_key(job) -> Tuple[float, str, str]:
     return (job.metadata.creation_timestamp, job.metadata.namespace,
@@ -94,6 +103,12 @@ class GangScheduler:
         self.metrics_ttp = registry.histogram(
             "kftpu_scheduler_time_to_place_seconds",
             "Pending-to-placed latency per gang",
+        )
+        self.metrics_queue_age = registry.histogram(
+            "kftpu_scheduler_queue_age_seconds",
+            "Age of still-waiting gangs (time since Admitted=False), "
+            "observed on every blocked placement attempt",
+            buckets=QUEUE_AGE_BUCKETS,
         )
         self.metrics_utilization = registry.gauge(
             "kftpu_scheduler_fleet_utilization",
@@ -197,6 +212,8 @@ class GangScheduler:
             if self.policy == "fifo":
                 blocked = self._fifo_blocked(job, jobs or [])
                 if blocked is not None:
+                    self.metrics_queue_age.observe(
+                        now - self._pending_since[uid])
                     return (None, blocked)
 
             placement = self.engine.find(st, n)
@@ -205,6 +222,12 @@ class GangScheduler:
                 placement, victims = self._try_preempt(job, jobs or [],
                                                        api, recorder)
             if placement is None:
+                # Queue-age surface: every blocked attempt observes how
+                # long this gang has already waited — the aging signal
+                # `tpuctl queue` summarizes and the storm bench gates
+                # non-empty.
+                self.metrics_queue_age.observe(
+                    now - self._pending_since[uid])
                 self.metrics_placements.inc(outcome="no_fit")
                 frag = self.fleet.fragmentation(st)
                 free = len(self.fleet.free(st))

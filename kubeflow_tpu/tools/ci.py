@@ -27,7 +27,9 @@ Stages (any failure exits non-zero — the merge gate contract):
 5b. **shard-smoke**: the seeded chaos soak across 2 control-plane shard
    processes with a whole-shard SIGKILL mid-soak (ISSUE 6) — fails unless
    the fleet converges all-Succeeded AND the killed shard replayed its
-   WAL to a byte-identical per-shard state fingerprint (``--skip-shard``).
+   WAL to a byte-identical per-shard state fingerprint AND its goodput
+   ledger rebuilt byte-identically from its journal with the shard-union
+   conservation invariant intact (``--skip-shard``).
 6. **cp-bench-smoke**: a small (N=50) control-plane sweep
    (kubeflow_tpu.controlplane.benchmark) gated on the *deterministic*
    copies-per-list counter: a namespaced list must deepcopy exactly its
@@ -40,6 +42,11 @@ Stages (any failure exits non-zero — the merge gate contract):
    sweep; assert the exposition parses (histograms included) and that
    one reconcile span + one histogram observation exists per reconcile
    executed — count-based, no wall-clock flake (docs/observability.md).
+   Then the goodput-ledger gates (ISSUE 10) on the seeded chaos soak:
+   attributed slice-ticks sum EXACTLY (integer equality) to tracked
+   capacity-ticks, every injected preemption is attributed, and
+   chaos-vs-policy preemption eviction produces IDENTICAL ledgers on
+   twin worlds (``--skip-obs`` skips both halves).
 8. **serve-bench-smoke** / **serving-soak-smoke**: the serving data
    plane under 2x open-loop overload (ISSUE 7) — request accounting sums
    exactly (ok + shed + timeouts + errors == offered), every shed carries
@@ -49,7 +56,10 @@ Stages (any failure exits non-zero — the merge gate contract):
 8b. **schedule-smoke**: the gang-scheduler mixed-priority storm with a
    mid-storm slice-preemption burst (ISSUE 8) — exact gang accounting
    (placed + preempted + pending == submitted), zero priority
-   inversions, all gangs converge Succeeded (``--skip-schedule``).
+   inversions, all gangs converge Succeeded. Runs with the ISSUE-10
+   checkpoint-cadence model on, adding: goodput conservation (exact),
+   non-vacuous rollback attribution, and a non-empty
+   kftpu_scheduler_queue_age_seconds histogram (``--skip-schedule``).
 9. **bench-gate**: if --bench-json is given, require
    ``vs_baseline >= --min-vs-baseline`` for every record — the perf
    regression gate SURVEY §7.8 prescribes.
@@ -173,6 +183,60 @@ def run_obs_smoke(num_jobs: int = 10, num_namespaces: int = 2) -> None:
         )
 
 
+def run_goodput_smoke(seed: int = 20260803) -> None:
+    """Goodput-ledger gates (ISSUE 10), riding the obs-smoke stage.
+    All counts and integer tick sums — never wall-clock:
+
+    - **conservation** on the seeded chaos soak: attributed slice-ticks
+      per category sum EXACTLY (integer equality) to tracked
+      capacity-ticks;
+    - **attribution**: every preemption the soak injected shows up as a
+      `preempt` interruption in the ledger (none laundered into other
+      causes, none dropped);
+    - **chaos-vs-policy parity**: twin worlds, one slice eviction each —
+      injected by the chaos SlicePreemptor vs the scheduler's policy
+      seam — must produce IDENTICAL ledgers.
+    """
+    from kubeflow_tpu.chaos import run_soak
+    from kubeflow_tpu.obs.goodput import chaos_policy_parity_report
+
+    rep = run_soak(num_jobs=4, seed=seed, conflict_rate=0.3,
+                   transient_rate=0.05, preempt_every=3, fault_rounds=9,
+                   max_rounds=40)
+    g = rep.goodput
+    if not g:
+        raise GateFailure("goodput-smoke: soak report has no goodput "
+                          "ledger (capacity-constrained soak expected)")
+    attributed = sum(g["categories_ticks"].values())
+    if not g["conserved"] or attributed != g["tracked_ticks"]:
+        raise GateFailure(
+            f"goodput-smoke: conservation broken — {attributed} "
+            f"attributed slice-ticks != {g['tracked_ticks']} tracked "
+            f"({g['categories_ticks']})"
+        )
+    if g["interruptions"].get("preempt", 0) != rep.job_preemption_restarts:
+        raise GateFailure(
+            f"goodput-smoke: {rep.job_preemption_restarts} job "
+            f"preemptions in the soak but the ledger attributed "
+            f"{g['interruptions'].get('preempt', 0)}"
+        )
+    parity = chaos_policy_parity_report(seed=seed)
+    if not parity["conserved"]:
+        raise GateFailure("goodput-smoke: parity worlds broke "
+                          "conservation")
+    if not parity["identical"]:
+        raise GateFailure(
+            "goodput-smoke: chaos vs policy preemption attributed "
+            f"DIFFERENTLY — chaos={parity['chaos']} "
+            f"policy={parity['policy']}"
+        )
+    if parity["preemptions_attributed"] != 1:
+        raise GateFailure(
+            "goodput-smoke: parity worlds attributed "
+            f"{parity['preemptions_attributed']} preemptions, expected 1"
+        )
+
+
 def run_shard_smoke(seed: int = 20260803, shards: int = 2) -> None:
     """Sharded-control-plane smoke (ISSUE 6): the seeded chaos soak across
     ``shards`` shard processes with a whole-shard SIGKILL mid-soak.
@@ -207,6 +271,16 @@ def run_shard_smoke(seed: int = 20260803, shards: int = 2) -> None:
         raise GateFailure(
             f"shard smoke ({tag}): killed shard did NOT replay its WAL "
             "to a byte-identical fingerprint — crash recovery regressed"
+        )
+    if not rep.goodput_replay_identical:
+        raise GateFailure(
+            f"shard smoke ({tag}): the killed shard's goodput ledger "
+            "did NOT rebuild byte-identically from its journal"
+        )
+    if not rep.goodput_conserved:
+        raise GateFailure(
+            f"shard smoke ({tag}): goodput conservation broken across "
+            f"the shard union: {rep.goodput}"
         )
 
 
@@ -373,9 +447,14 @@ def run_schedule_smoke(seed: int = 20260803, num_jobs: int = 30) -> None:
         num_jobs=num_jobs, policy="priority", seed=seed,
         fleet_capacity={"v5e-16": 8}, pool_size=4,
         chaos_at_tick=6, chaos_preempts=3,
+        # The checkpoint-cadence model ON (ISSUE 10): saves cost ticks
+        # and preemptions roll work back, so the goodput conservation
+        # gate inside check_storm_gates covers rollback reclassification
+        # too, not just steady-state attribution.
+        ckpt_every_ticks=3,
     )
     try:
-        check_storm_gates(rep)
+        check_storm_gates(rep)      # accounting + inversions + goodput
     except SystemExit as e:
         raise GateFailure(f"schedule-smoke: {e}") from None
     if not rep.converged or rep.succeeded != rep.submitted:
@@ -388,6 +467,18 @@ def run_schedule_smoke(seed: int = 20260803, num_jobs: int = 30) -> None:
         raise GateFailure(
             "schedule-smoke: the mid-storm preemption burst hit nothing "
             "— the chaos leg is vacuous"
+        )
+    if rep.queue_age_count == 0:
+        raise GateFailure(
+            "schedule-smoke: kftpu_scheduler_queue_age_seconds is empty "
+            "— a contended storm must observe queue ages"
+        )
+    g = rep.goodput
+    if g["categories_ticks"]["restart_rollback"] == 0:
+        raise GateFailure(
+            "schedule-smoke: a storm with preemptions + rollback model "
+            "attributed zero restart_rollback slice-ticks — the "
+            "recompute attribution is vacuous"
         )
 
 
@@ -495,6 +586,8 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
     if not skip_obs:
         _stage("obs-smoke")
         run_obs_smoke()
+        _stage("obs-smoke (goodput conservation)")
+        run_goodput_smoke(seed=chaos_seed)
         passed.append("obs-smoke")
 
     if not skip_schedule:

@@ -17,6 +17,9 @@ Usage:
                 priority, slices, blocking reason, time-in-queue)
   tpuctl delete -f job.yaml | --kind TpuJob --name x -n ns  --state-dir .tpuctl
   tpuctl metrics --state-dir .tpuctl
+  tpuctl goodput [-o json] --state-dir .tpuctl  (fleet goodput
+                scoreboard: slice-seconds by category, conservation-
+                gated, with a per-job drill-down)
   tpuctl logs   <pod | tpujob> -n ns   (gang logs; kubectl logs passthrough)
   tpuctl trace  <kind>/<name> [-n ns]  (causal write->watch->reconcile
                 timeline from the state dir's recorded spans)
@@ -301,7 +304,59 @@ def cmd_queue(args) -> int:
         print(fmt.format(r["namespace"], r["name"], r["priority"],
                          r["slices"], r["queued_seconds"], r["reason"],
                          r["message"]))
+    # Queue-age summary (the starvation/aging surface — the histogram
+    # twin is kftpu_scheduler_queue_age_seconds on /metrics).
+    from kubeflow_tpu.utils.monitoring import nearest_rank_quantile
+
+    ages = [r["queued_seconds"] for r in rows]
+    print(f"QUEUE AGE: {len(ages)} pending, "
+          f"p50 {nearest_rank_quantile(ages, 0.50):.1f}s, "
+          f"max {max(ages):.1f}s")
     return 0
+
+
+def cmd_goodput(args) -> int:
+    """Fleet goodput scoreboard (ISSUE 10): of every slice-second the
+    hardware offered, how many were productive and where did the rest
+    go — per category fleet-wide, with a per-job drill-down. The ledger
+    is conservation-gated: attributed time sums EXACTLY to tracked
+    capacity-time, and the footer says so (a mismatch is a bug, never
+    rounding)."""
+    if args.backend == "kubectl":
+        print("goodput is a state-backend command (the ledger lives "
+              "with the embedded platform)", file=sys.stderr)
+        return 2
+    platform = _load_platform(args)
+    platform.reconcile()
+    acc = platform.goodput
+    if acc is None:
+        print("goodput tracking is off: configure tpujob-controller "
+              "capacity or a fleet (params: capacity=/fleet=) so the "
+              "platform knows what the hardware offers", file=sys.stderr)
+        return 1
+    snap = acc.snapshot()
+    if args.output == "json":
+        print(json.dumps(snap, indent=2, sort_keys=True))
+        return 0 if snap["conserved"] else 3
+    total = snap["tracked_slice_seconds"] or 1.0
+    print(f"FLEET GOODPUT — {snap['units']} slices tracked "
+          f"({snap['active_units']} offered), "
+          f"{snap['tracked_slice_seconds']:.3f} slice-seconds")
+    print(f"{'CATEGORY':<20} {'SLICE_S':>12} {'SHARE':>7}")
+    for cat, secs in snap["categories_s"].items():
+        print(f"{cat:<20} {secs:>12.3f} {secs / total:>6.1%}")
+    print(f"goodput ratio {snap['goodput_ratio']:.3f}  "
+          f"interruptions {snap['interruptions']}  "
+          f"conservation {'OK' if snap['conserved'] else 'BROKEN'}")
+    if snap["jobs"]:
+        print()
+        print(f"{'JOB':<28} {'SLICE_S':>10} {'RATIO':>6}  CATEGORIES")
+        for key, j in sorted(snap["jobs"].items()):
+            cats = ",".join(f"{c}={s:.3f}s" for c, s in
+                            j["categories_s"].items())
+            print(f"{key:<28} {j['slice_seconds']:>10.3f} "
+                  f"{j['goodput_ratio']:>6.3f}  {cats}")
+    return 0 if snap["conserved"] else 3
 
 
 def cmd_delete(args) -> int:
@@ -358,13 +413,17 @@ def cmd_trace(args) -> int:
         return 2
     kind, name = args.target.split("/", 1)
     # Shard-aware: a sharded state dir keeps one trace file per shard
-    # (shard-NN/trace.jsonl). The object lives on exactly one shard (the
-    # router's colocation contract), so merging the files cannot splice
-    # two different objects' timelines together.
-    paths = [os.path.join(args.state_dir, TRACE_FILE)] + sorted(
+    # (shard-NN/trace.jsonl). The object's own lifecycle lives on one
+    # shard (the router's colocation contract); cross-shard spans (the
+    # admission ledger's reserve round-trip) carry the object's trace id
+    # and stitch in from the lease holder's file. Each file may have a
+    # rotated generation (trace.jsonl.1) — both are read, oldest first.
+    bases = [os.path.join(args.state_dir, TRACE_FILE)] + sorted(
         _glob.glob(os.path.join(args.state_dir, "shard-*", TRACE_FILE))
     )
-    paths = [p for p in paths if os.path.exists(p)]
+    paths = []
+    for base in bases:
+        paths.extend(Tracer.generations(base))
     if not paths:
         print(f"no trace recorded under {args.state_dir} "
               "(state-backend commands record one on save)", file=sys.stderr)
@@ -677,6 +736,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     mp = sub.add_parser("metrics", help="dump platform metrics")
     mp.set_defaults(fn=cmd_metrics)
+
+    gd = sub.add_parser(
+        "goodput", help="fleet goodput scoreboard: slice-seconds by "
+                        "category (conservation-gated) + per-job "
+                        "drill-down")
+    gd.add_argument("-o", "--output", choices=("table", "json"),
+                    default="table")
+    gd.set_defaults(fn=cmd_goodput)
 
     tp = sub.add_parser(
         "trace", help="causal write->watch->reconcile timeline for one "

@@ -243,6 +243,29 @@ class Tracer:
         return len(out)
 
     @staticmethod
+    def rotate_jsonl(path: str, max_bytes: int = 4 << 20) -> bool:
+        """Single-generation rollover for an append-accumulated span
+        file: past ``max_bytes`` the file moves to ``<path>.1`` (atomic
+        rename, replacing any previous generation) and appends restart
+        on a fresh file. Unlike :meth:`trim_jsonl` this never rewrites
+        or drops the newest spans mid-file — readers (`tpuctl trace`)
+        load both generations, so a rollover between two commands can't
+        amputate the causal record they straddle."""
+        try:
+            if os.path.getsize(path) <= max_bytes:
+                return False
+        except OSError:
+            return False
+        os.replace(path, path + ".1")
+        return True
+
+    @staticmethod
+    def generations(path: str) -> List[str]:
+        """The on-disk generations of a rotated span file, oldest first
+        (``<path>.1`` then ``<path>``), existing files only."""
+        return [p for p in (path + ".1", path) if os.path.exists(p)]
+
+    @staticmethod
     def trim_jsonl(path: str, max_bytes: int = 4 << 20) -> None:
         """Bound an append-accumulated span file: when it outgrows
         ``max_bytes``, keep the newest half (whole lines). The in-memory

@@ -234,7 +234,7 @@ class TestReviewHardening:
         serve_child.send((999, None))
 
         def leader():
-            fwd_id, op, args = serve_child.recv()
+            fwd_id, op, args, _ctx = serve_child.recv()
             assert op == "reserve"
             serve_child.send((fwd_id, "1/1 v5e-16 slices reserved "
                                       "cluster-wide"))
@@ -245,3 +245,78 @@ class TestReviewHardening:
         req_id, payload = client_child.recv()
         assert req_id == 1
         assert payload is not None and "1/1" in payload     # NOT the stale None
+
+
+class TestTraceStitching:
+    """Cross-shard trace stitching (ISSUE 10): the ledger pipe-RPC
+    carries the caller's (trace_id, span_id), and the leader-side
+    service records each operation as a span IN the caller's trace —
+    one trace id end to end, so `tpuctl trace` includes the reserve
+    round-trip instead of an orphan span on the lease-holding shard."""
+
+    def test_one_trace_id_client_to_relay_to_service(self):
+        from kubeflow_tpu.utils.tracing import Tracer
+
+        client_parent, client_child = multiprocessing.Pipe()
+        serve_parent, serve_child = multiprocessing.Pipe()
+        relay = LedgerRelay({0: client_parent}, {0: serve_parent},
+                            leader_of=lambda: 0).start()
+        leader_tracer = Tracer()
+        svc = LedgerService({"v5e-16": 1}, serve_child,
+                            tracer=leader_tracer).start()
+        caller_tracer = Tracer()
+        cli = LedgerClient(client_child, timeout_s=5.0)
+        try:
+            with caller_tracer.span("reconcile") as caller_span:
+                assert cli.try_reserve("gang-a", "v5e-16", 1) is None
+            spans = leader_tracer.spans("ledger.reserve")
+            assert len(spans) == 1
+            served = spans[0]
+            # Same trace id end to end + a causal link back to the
+            # calling span.
+            assert served.trace_id == caller_span.trace_id
+            assert tuple(served.links[0]) == caller_span.context
+            assert served.attrs["uid"] == "gang-a"
+            assert served.attrs["verdict"] == "reserved"
+        finally:
+            relay.stop()
+            svc.stop()
+
+    def test_denied_reserve_span_carries_verdict(self):
+        from kubeflow_tpu.utils.tracing import Tracer
+
+        tracer = Tracer()
+        client_end, serve_end = multiprocessing.Pipe()
+        svc = LedgerService({"v5e-16": 1}, serve_end,
+                            tracer=tracer).start()
+        cli = LedgerClient(client_end, timeout_s=5.0)
+        try:
+            caller = Tracer()
+            with caller.span("reconcile"):
+                assert cli.try_reserve("a", "v5e-16", 1) is None
+                assert cli.try_reserve("b", "v5e-16", 1) is not None
+            verdicts = [s.attrs["verdict"]
+                        for s in tracer.spans("ledger.reserve")]
+            assert verdicts[0] == "reserved" and "1/1" in verdicts[1]
+        finally:
+            svc.stop()
+
+    def test_spanless_caller_and_legacy_3_tuple_still_serve(self):
+        from kubeflow_tpu.utils.tracing import Tracer
+
+        tracer = Tracer()
+        client_end, serve_end = multiprocessing.Pipe()
+        svc = LedgerService({"v5e-16": 1}, serve_end,
+                            tracer=tracer).start()
+        cli = LedgerClient(client_end, timeout_s=5.0)
+        try:
+            # No span open on the caller: ctx=None, no span recorded.
+            assert cli.try_reserve("a", "v5e-16", 1) is None
+            assert tracer.spans("ledger.reserve") == []
+            # A pre-stitching peer sends 3-tuples: still answered.
+            client_end.send((99, "snapshot", ()))
+            assert client_end.poll(5)
+            req_id, payload = client_end.recv()
+            assert req_id == 99 and payload["reservations"] == 1
+        finally:
+            svc.stop()

@@ -465,3 +465,215 @@ class TestTpuctlQueue:
         rc, out = _run(["--state-dir", state, "queue", "-o", "json"],
                        capsys)
         assert json.loads(out) == []
+
+
+class TestCrossShardQuantileMerge:
+    """`tpuctl top --url` sums histogram buckets across shard scrapes.
+    Regression (ISSUE 10): quantiles computed from the SUMMED buckets
+    must equal `quantile_from_buckets` over one merged exposition — and
+    both must match a single histogram that saw every observation."""
+
+    def _scrape(self, observations):
+        from kubeflow_tpu.utils.monitoring import (
+            MetricsRegistry,
+            parse_exposition,
+        )
+
+        registry = MetricsRegistry()
+        h = registry.histogram("kftpu_reconcile_duration_seconds",
+                               "d", labels=("controller", "result"))
+        for ctl, v in observations:
+            h.observe(v, controller=ctl, result="ok")
+        return parse_exposition(registry.render())
+
+    def test_summed_buckets_match_merged_exposition(self):
+        from kubeflow_tpu.tools.tpuctl import _hist_series
+        from kubeflow_tpu.utils.monitoring import (
+            MetricsRegistry,
+            quantile_from_buckets,
+        )
+
+        shard_a = [("tpujob", v) for v in
+                   (0.0001, 0.0002, 0.004, 0.04, 0.9)]
+        shard_b = [("tpujob", v) for v in (0.0003, 0.02, 0.02, 2.0)]
+        samples = self._scrape(shard_a) + self._scrape(shard_b)
+        merged = _hist_series(samples, "kftpu_reconcile_duration_seconds",
+                              "controller")["tpujob"]
+        # Ground truth: ONE histogram that saw every observation.
+        truth_reg = MetricsRegistry()
+        truth = truth_reg.histogram("t", "t")
+        for _, v in shard_a + shard_b:
+            truth.observe(v)
+        assert merged[-1][1] == len(shard_a) + len(shard_b)
+        for q in (0.5, 0.9, 0.95, 0.99):
+            assert quantile_from_buckets(merged, q) == pytest.approx(
+                truth.quantile(q))
+
+    def test_single_shard_merge_is_identity(self):
+        from kubeflow_tpu.tools.tpuctl import _hist_series
+        from kubeflow_tpu.utils.monitoring import (
+            MetricsRegistry,
+            quantile_from_buckets,
+        )
+
+        obs = [("tpujob", 0.003), ("tpujob", 0.05)]
+        samples = self._scrape(obs)
+        merged = _hist_series(samples, "kftpu_reconcile_duration_seconds",
+                              "controller")["tpujob"]
+        # One scrape "merged" must be the identity: every quantile
+        # equals the source histogram's own estimate exactly.
+        truth = MetricsRegistry().histogram("t", "t")
+        for _, v in obs:
+            truth.observe(v)
+        for q in (0.25, 0.5, 0.75, 0.95):
+            assert quantile_from_buckets(merged, q) == truth.quantile(q)
+        assert merged[-1][1] == 2
+
+    def test_empty_bucket_and_zero_observation_shards(self):
+        from kubeflow_tpu.tools.tpuctl import _hist_series
+        from kubeflow_tpu.utils.monitoring import quantile_from_buckets
+
+        # One shard observed nothing (no series at all), another one
+        # value far into the tail: empty interleaved bands must not
+        # corrupt the estimate.
+        samples = self._scrape([]) + self._scrape([("tpujob", 1.7)])
+        series = _hist_series(samples, "kftpu_reconcile_duration_seconds",
+                              "controller")
+        merged = series["tpujob"]
+        assert merged[-1][1] == 1
+        v = quantile_from_buckets(merged, 0.95)
+        assert 1.0 <= v <= 2.5          # inside the containing band
+        # Aggregating across DIFFERENT controllers never mixes rows.
+        samples = self._scrape([("a", 0.001)]) + self._scrape(
+            [("b", 4.0)])
+        series = _hist_series(samples, "kftpu_reconcile_duration_seconds",
+                              "controller")
+        assert quantile_from_buckets(series["a"], 0.5) < 0.01
+        assert quantile_from_buckets(series["b"], 0.5) > 1.0
+
+
+class TestTraceRotation:
+    """trace.jsonl rotation (ISSUE 10): Platform.save rolls the span
+    file to trace.jsonl.1 past the byte cap, and `tpuctl trace` reads
+    both generations."""
+
+    def test_rotate_then_trace_reads_both_generations(self, tmp_path,
+                                                      capsys):
+        from kubeflow_tpu.controlplane.platform import TRACE_FILE
+        from kubeflow_tpu.utils.tracing import Tracer
+
+        state = str(tmp_path / "state")
+        pf = _write(tmp_path, "platform.yaml", PLATFORM_YAML)
+        prof = _write(tmp_path, "profile.yaml", PROFILE_YAML)
+        job = _write(tmp_path, "job.yaml", JOB_YAML)
+        rc, _ = _run(["--state-dir", state, "apply", "-f", pf, "-f", prof,
+                      "-f", job], capsys)
+        assert rc == 0
+        trace_path = os.path.join(state, TRACE_FILE)
+        spans_before = len(Tracer.load_jsonl(trace_path))
+        assert spans_before > 0
+        # Force a rollover: cap far below the current size.
+        assert Tracer.rotate_jsonl(trace_path, max_bytes=64)
+        assert os.path.exists(trace_path + ".1")
+        assert not os.path.exists(trace_path)
+        # The next save appends to a FRESH current generation.
+        rc, _ = _run(["--state-dir", state, "status"], capsys)
+        # (status doesn't save; run a no-op apply which does)
+        rc, _ = _run(["--state-dir", state, "apply", "-f", pf], capsys)
+        assert rc == 0
+        # Both generations feed one timeline.
+        rc, out = _run(["--state-dir", state, "trace", "TpuJob/train1",
+                        "-n", "ml"], capsys)
+        assert rc == 0
+        assert "create TpuJob ml/train1" in out      # lives in .1 now
+
+    def test_rotate_keeps_single_generation(self, tmp_path):
+        from kubeflow_tpu.utils.tracing import Tracer
+
+        p = str(tmp_path / "t.jsonl")
+        for gen in ("one", "two", "three"):
+            with open(p, "w") as f:
+                f.write(json.dumps({"gen": gen}) * 40 + "\n")
+            assert Tracer.rotate_jsonl(p, max_bytes=16)
+        # Only .1 survives — single-generation rollover, bounded disk.
+        assert sorted(os.listdir(tmp_path)) == ["t.jsonl.1"]
+        with open(p + ".1") as f:
+            assert "three" in f.read()
+        assert Tracer.generations(p) == [p + ".1"]
+        # Under the cap: no-op.
+        with open(p, "w") as f:
+            f.write("{}\n")
+        assert not Tracer.rotate_jsonl(p, max_bytes=1 << 20)
+
+
+class TestTpuctlGoodput:
+    """`tpuctl goodput` (ISSUE 10): the fleet scoreboard with per-job
+    drill-down, conservation-gated."""
+
+    def _apply(self, tmp_path, capsys):
+        state = str(tmp_path / "state")
+        pf = _write(tmp_path, "platform.yaml", SCHED_PLATFORM_YAML)
+        hi = _write(tmp_path, "hi.yaml", HI_JOB_YAML)
+        rc, _ = _run(["--state-dir", state, "apply", "-f", pf, "-f", hi],
+                     capsys)
+        assert rc == 0
+        return state
+
+    def test_goodput_table_and_json(self, tmp_path, capsys):
+        state = self._apply(tmp_path, capsys)
+        rc, out = _run(["--state-dir", state, "goodput"], capsys)
+        assert rc == 0
+        assert "FLEET GOODPUT" in out
+        assert "productive" in out and "idle_free" in out
+        assert "conservation OK" in out
+        assert "ml/running" in out
+        rc, out = _run(["--state-dir", state, "goodput", "-o", "json"],
+                       capsys)
+        assert rc == 0
+        snap = json.loads(out)
+        assert snap["conserved"] is True
+        assert (sum(snap["categories_ticks"].values())
+                == snap["tracked_ticks"])
+        assert snap["tracked_ticks"] > 0
+        assert "ml/running" in snap["jobs"]
+
+    def test_goodput_accumulates_across_invocations(self, tmp_path,
+                                                    capsys):
+        state = self._apply(tmp_path, capsys)
+        rc, out = _run(["--state-dir", state, "goodput", "-o", "json"],
+                       capsys)
+        first = json.loads(out)["tracked_ticks"]
+        # goodput doesn't save; apply does — persist, then read again.
+        pf = _write(tmp_path, "platform.yaml", SCHED_PLATFORM_YAML)
+        rc, _ = _run(["--state-dir", state, "apply", "-f", pf], capsys)
+        assert rc == 0
+        rc, out = _run(["--state-dir", state, "goodput", "-o", "json"],
+                       capsys)
+        again = json.loads(out)
+        # The persisted ledger carried over and kept growing; the gap
+        # BETWEEN invocations contributed nothing is implied by both
+        # stints being millisecond-scale (vs a multi-second test run).
+        assert again["tracked_ticks"] > 0
+        assert again["conserved"] is True
+        assert first > 0
+
+    def test_goodput_off_without_capacity(self, tmp_path, capsys):
+        state = str(tmp_path / "state")
+        pf = _write(tmp_path, "platform.yaml", PLATFORM_YAML)
+        _run(["--state-dir", state, "apply", "-f", pf], capsys)
+        rc = main(["--state-dir", state, "goodput"])
+        assert rc == 1
+
+
+class TestQueueAgeFooter:
+    def test_queue_table_has_age_footer(self, tmp_path, capsys):
+        state = str(tmp_path / "state")
+        pf = _write(tmp_path, "platform.yaml", SCHED_PLATFORM_YAML)
+        hi = _write(tmp_path, "hi.yaml", HI_JOB_YAML)
+        lo = _write(tmp_path, "lo.yaml", QUEUED_JOB_YAML)
+        _run(["--state-dir", state, "apply", "-f", pf, "-f", hi], capsys)
+        _run(["--state-dir", state, "apply", "-f", lo], capsys)
+        rc, out = _run(["--state-dir", state, "queue"], capsys)
+        assert rc == 0
+        assert "QUEUE AGE: 1 pending" in out
+        assert "p50" in out and "max" in out
