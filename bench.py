@@ -854,6 +854,8 @@ def bench_schedule(args) -> None:
         k: int(v) for k, v in (
             kv.split("=") for kv in args.fleet.split(","))
     }
+    if args.elastic:
+        return bench_schedule_elastic(args, jobs, fleet)
     common = dict(
         num_jobs=jobs, fleet_capacity=fleet, pool_size=args.pool_size,
         seed=args.seed, ckpt_every_ticks=args.ckpt_every,
@@ -908,6 +910,103 @@ def bench_schedule(args) -> None:
         fifo_p95_ttp_high_ticks=fifo_p95,
         fifo=fifo.summary(),
         **sched.summary(),
+    )
+
+
+def bench_schedule_elastic(args, jobs: int, fleet: dict) -> None:
+    """Elastic A/B (ISSUE 11): the SAME seeded mixed-priority storm under
+    capacity oscillation (a seeded slice-preemption burst every 5 ticks)
+    twice on one fleet — elastic gangs (shrink on preemption, grow on
+    freed capacity, both zero-downtime resizes) vs restart-only — over a
+    FIXED horizon so both runs attribute identical tracked slice-ticks.
+    Work is width-proportional (a shrunk gang progresses at its current
+    width) and every restart re-pays a cold spin-up window (the
+    jax.distributed re-init an elastic resize keeps warm: VirtualFlow's
+    decoupling, arxiv 2009.09523).
+
+    Hard gates (raise, not assert):
+    - goodput conservation EXACT (bit equality) in BOTH runs, zero
+      priority inversions, exact gang accounting (check_storm_gates);
+    - the elastic run attributes STRICTLY MORE ``productive`` and
+      STRICTLY LESS ``restart_rollback + migration`` slice-ticks than
+      restart-only on the same storm;
+    - the elastic run actually resized (shrinks AND grows > 0) and
+      consumed ZERO restart budget doing so."""
+    from kubeflow_tpu.scheduler.benchmark import (
+        check_storm_gates,
+        run_schedule_storm,
+    )
+
+    common = dict(
+        num_jobs=jobs, fleet_capacity=fleet, pool_size=args.pool_size,
+        seed=args.seed, arrival_span=30, max_ticks=100,
+        # Fixed cadence 2/1: the A/B's checkpoint model (a tighter
+        # cadence than the FIFO bench's 3 — oscillation every 5 ticks
+        # makes saves the difference between a cheap and a total roll).
+        ckpt_every_ticks=2,
+        chaos_at_tick=5, chaos_preempts=3, chaos_every=5,
+        restart_spinup_ticks=2, width_scaled_work=True,
+        stop_when_done=False,
+    )
+    el = run_schedule_storm(policy="priority", elastic=True, **common)
+    ro = run_schedule_storm(policy="priority", elastic=False, **common)
+    for rep in (el, ro):
+        check_storm_gates(rep)      # accounting + inversions + goodput
+    ge = el.goodput["categories_ticks"]
+    gr = ro.goodput["categories_ticks"]
+    el_rollback = ge["restart_rollback"] + ge["migration"]
+    ro_rollback = gr["restart_rollback"] + gr["migration"]
+    if el.goodput["tracked_ticks"] != ro.goodput["tracked_ticks"]:
+        raise SystemExit(
+            f"elastic A/B horizons diverged: {el.goodput['tracked_ticks']}"
+            f" vs {ro.goodput['tracked_ticks']} tracked slice-ticks — "
+            "the comparison is not apples-to-apples")
+    if ge["productive"] <= gr["productive"]:
+        raise SystemExit(
+            f"elastic did not beat restart-only on productive "
+            f"slice-ticks: {ge['productive']} <= {gr['productive']}")
+    if el_rollback >= ro_rollback:
+        raise SystemExit(
+            f"elastic did not beat restart-only on rollback slice-ticks:"
+            f" {el_rollback} >= {ro_rollback}")
+    if el.shrinks == 0 or el.grows == 0:
+        raise SystemExit(
+            f"elastic storm is vacuous: shrinks={el.shrinks} "
+            f"grows={el.grows} — no resize lifecycle exercised")
+    if ro.resizes != 0:
+        raise SystemExit(
+            f"restart-only twin recorded {ro.resizes} resizes — the "
+            "baseline is contaminated")
+    out = args.elastic_out or args.goodput_out
+    if out:
+        with open(out, "w") as f:
+            json.dump({
+                "bench": "schedule-elastic",
+                "storm": {"jobs": jobs, "seed": args.seed, "fleet": fleet,
+                          "pool_size": args.pool_size,
+                          "arrival_span": 30, "max_ticks": 100,
+                          "ckpt_every_ticks": common["ckpt_every_ticks"],
+                          "chaos": {"at_tick": 5, "preempts": 3,
+                                    "every": 5},
+                          "restart_spinup_ticks": 2,
+                          "width_scaled_work": True},
+                "elastic": el.summary(),
+                "restart_only": ro.summary(),
+                "productive_win_ticks": ge["productive"]
+                - gr["productive"],
+                "rollback_saved_ticks": ro_rollback - el_rollback,
+                "queue_wait": {"elastic": ge["queue_wait"],
+                               "restart_only": gr["queue_wait"]},
+            }, f, indent=1, sort_keys=True)
+            f.write("\n")
+    _emit(
+        "elastic_productive_slice_ticks",
+        float(ge["productive"]), "slice-ticks",
+        float(gr["productive"]),   # baseline = the restart-only twin
+        rollback_ticks=el_rollback,
+        restart_only_rollback_ticks=ro_rollback,
+        restart_only=ro.summary(),
+        **el.summary(),
     )
 
 
@@ -1225,6 +1324,17 @@ def main() -> None:
                    help="schedule bench: also write the FIFO-vs-priority "
                         "goodput ledgers (attributed slice-seconds) to "
                         "this JSON file (the GOODPUT_r10.json record)")
+    p.add_argument("--elastic", action="store_true",
+                   help="schedule bench: run the ELASTIC A/B instead — "
+                        "the same seeded storm under capacity "
+                        "oscillation twice, elastic resize vs "
+                        "restart-only, hard-gated on conservation AND "
+                        "elastic beating restart on productive vs "
+                        "restart_rollback slice-seconds")
+    p.add_argument("--elastic-out", default="",
+                   help="schedule --elastic: write the A/B goodput "
+                        "ledgers to this JSON file (the ELASTIC_r11.json "
+                        "record)")
     p.add_argument("--namespaces", type=int, default=20,
                    help="controlplane bench: namespaces the job fleet is "
                         "spread across (exercises the per-ns index)")

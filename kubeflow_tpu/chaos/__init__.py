@@ -39,8 +39,10 @@ from kubeflow_tpu.chaos.serving_soak import (
     run_serving_soak,
 )
 from kubeflow_tpu.chaos.soak import (
+    ElasticSoakReport,
     ShardedSoakReport,
     SoakReport,
+    run_elastic_soak,
     run_sharded_soak,
     run_soak,
 )
@@ -48,6 +50,7 @@ from kubeflow_tpu.chaos.soak import (
 __all__ = [
     "BackendFlapper",
     "ChaosApiServer",
+    "ElasticSoakReport",
     "FaultSpec",
     "ServingSoakReport",
     "ShardPreemptor",
@@ -55,6 +58,7 @@ __all__ = [
     "SlicePreemptor",
     "SoakReport",
     "TransientApiError",
+    "run_elastic_soak",
     "run_serving_soak",
     "run_sharded_soak",
     "run_soak",

@@ -15,6 +15,7 @@ reproducible.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, Optional
 
 from kubeflow_tpu.chaos.api import ChaosApiServer, FaultSpec
@@ -260,6 +261,249 @@ def run_soak(
         "converged": converged, "rounds": rounds,
         "injected": sum(report.injected.values()),
         "preemptions": report.preemptions,
+    })
+    return report
+
+
+# --------------------------------------------------------------------------
+# Elastic soak (ISSUE 11): capacity oscillation against elastic gangs
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ElasticSoakReport:
+    converged: bool                  # every job terminal, manager idle
+    all_succeeded: bool
+    phases: Dict[str, str]
+    rounds: int
+    bursts: int                      # slice-preemption bursts injected
+    resizes: int                     # sum status.resizes
+    shrinks: int                     # scheduler partial releases
+    grows: int                       # scheduler partial grows
+    restarts_consumed: int           # sum status.restarts (MUST be 0)
+    preemption_restarts: int         # sum status.preemptions (MUST be 0:
+                                     # every burst became a resize)
+    checkpoint_steps_monotone: bool  # resumed_from_step never regressed
+    final_steps: Dict[str, int]      # job -> newest complete step on disk
+    min_width_observed: int          # narrowest width any gang ran at
+    goodput_conserved: bool
+    goodput: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+    def stuck_jobs(self) -> Dict[str, str]:
+        return {n: p for n, p in self.phases.items() if p not in TERMINAL}
+
+
+def run_elastic_soak(
+    *,
+    num_jobs: int = 2,
+    width: int = 2,                  # spec/max width per gang (min = 1)
+    fleet_units: int = 4,
+    seed: int = 0,
+    burst_every: int = 3,            # rounds between preemption bursts
+    fault_rounds: int = 12,          # rounds before bursts stop (reclaim)
+    max_rounds: int = 60,
+    work_rounds: int = 10,           # Running rounds to finish a job
+    ckpt_every: int = 2,             # save a checkpoint step every N
+    state_dir: str = "",             # "" = private temp (checkpoint dirs)
+) -> ElasticSoakReport:
+    """Seeded capacity-oscillation soak (ISSUE 11): elastic gangs on a
+    real scheduler fleet while a preemptor takes single slices out in
+    bursts (capacity lost) and the ElasticController grows gangs back as
+    units free (capacity reclaimed). Jobs write REAL orbax-layout step
+    directories under their ``spec.checkpoint_dir`` (integer step
+    subdirs — what ``ckpt_catalog.latest_complete_step`` reads), so the
+    resize path's resume-from-catalog contract is exercised end to end.
+
+    The gates a caller (CI ``elastic-smoke``) asserts:
+    - every gang converges Succeeded with the manager idle;
+    - ZERO restart budget consumed and ZERO preemption-restarts — every
+      injected burst became a shrink (a resize), never a restart;
+    - the gangs actually oscillated (shrinks AND grows non-zero);
+    - checkpoint steps advance monotonically: ``resumed_from_step``
+      never regresses and every job ends with a newer complete step on
+      disk than it ever resumed from;
+    - the goodput ledger stays conservation-exact (resize recompute is a
+      MOVE, never invented or dropped time).
+    """
+    import random
+    import shutil
+    import tempfile
+
+    from kubeflow_tpu.controlplane.api.types import ElasticSpec
+    from kubeflow_tpu.controlplane.ckpt_catalog import latest_complete_step
+    from kubeflow_tpu.elastic import (
+        ElasticController,
+        RollbackTracker,
+        shrink_counts,
+    )
+    from kubeflow_tpu.obs.goodput import GoodputAccountant
+    from kubeflow_tpu.scheduler import Fleet, GangScheduler
+
+    registry = MetricsRegistry()
+    api = InMemoryApiServer(registry=registry)
+    mgr = ControllerManager(api, registry)
+    fleet = Fleet.from_capacity({"v5e-16": fleet_units},
+                                pool_size=fleet_units)
+    scheduler = GangScheduler(fleet, policy="priority", registry=registry)
+    mgr.register(TpuJobController(api, registry, hbm_check=False,
+                                  scheduler=scheduler,
+                                  requeue_pending_s=3600.0))
+    mgr.register(ElasticController(api, registry, scheduler=scheduler,
+                                   interval_s=0.0))
+    accountant = GoodputAccountant.from_fleet(fleet, registry=registry)
+    accountant.attach(api)
+
+    own_state = not state_dir
+    if own_state:
+        state_dir = tempfile.mkdtemp(prefix="kftpu-elastic-soak-")
+    rng = random.Random(seed + 3)
+    preemptor = SlicePreemptor(api, seed=seed + 5, registry=registry)
+
+    # Work/checkpoint model: a job advances one step per Running round,
+    # saves a REAL step directory every `ckpt_every` steps, and a resize
+    # rolls it back to its newest complete step (the resume contract).
+    work: Dict[str, int] = {}
+    saved: Dict[str, int] = {}
+    rollback_tracker = RollbackTracker()
+    finished: set = set()
+
+    def outcome(pod_name: str) -> Optional[str]:
+        return ("Succeeded"
+                if pod_name.rsplit("-worker-", 1)[0] in finished else None)
+
+    kubelet = FakeKubelet(api, registry, outcome=outcome)
+    mgr.register(kubelet)
+
+    names = [f"el-{i:02d}" for i in range(num_jobs)]
+    ckpt_dirs = {}
+    for name in names:
+        d = f"{state_dir}/{name}"
+        ckpt_dirs[name] = d
+        os.makedirs(d, exist_ok=True)
+        api.create(TpuJob(
+            metadata=ObjectMeta(name=name, namespace="elastic"),
+            spec=TpuJobSpec(
+                slice_type="v5e-16", num_slices=width,
+                mesh=MeshAxesSpec(dp=-1), backoff_seconds=0.0,
+                max_restarts=3, preemption_policy="restart",
+                checkpoint_dir=d,
+                elastic=ElasticSpec(min_slices=1, max_slices=width),
+            ),
+        ))
+
+    def drain():
+        mgr.kick_timers(2 * 3600.0)
+        mgr.run_until_idle(max_iterations=100000)
+
+    bursts = 0
+    rounds = 0
+    monotone = True
+    last_resumed: Dict[str, int] = {}
+    min_width = width
+    try:
+        for r in range(max_rounds):
+            rounds = r + 1
+            drain()
+            faulting = rounds <= fault_rounds
+            if faulting and burst_every and r > 0 \
+                    and r % burst_every == 0:
+                # Burst: take one slice of a seeded-random gang that can
+                # still shrink (width above its floor).
+                victims = [
+                    j for j in api.list("TpuJob", copy=False)
+                    if j.status.phase in ("Starting", "Running")
+                    and len(scheduler.assignment_of(j.metadata.uid) or [])
+                    > j.spec.elastic.min_slices
+                ]
+                if victims:
+                    victim = victims[rng.randrange(len(victims))]
+                    if preemptor.preempt(victim) > 0:
+                        bursts += 1
+                    drain()
+            kubelet.tick()
+            drain()
+            # Work + real checkpoint-step model. Rollback triggers are
+            # the shared elastic.rollback contract: restarts and SHRINK
+            # resize events (counted from the scheduler's log — a
+            # shrink+grow pair inside one drain still pays); grows
+            # broadcast live state and lose nothing.
+            shrinks_now = shrink_counts(scheduler.resize_log)
+            for job in api.list("TpuJob", copy=False):
+                name = job.metadata.name
+                if rollback_tracker.should_rollback(job, shrinks_now):
+                    work[name] = saved.get(name, 0)
+                if job.status.resumed_from_step >= 0:
+                    if job.status.resumed_from_step \
+                            < last_resumed.get(name, -1):
+                        monotone = False
+                    last_resumed[name] = job.status.resumed_from_step
+                if job.status.phase != "Running" or name in finished:
+                    continue
+                work[name] = work.get(name, 0) + 1
+                if work[name] - saved.get(name, 0) >= ckpt_every:
+                    step_dir = os.path.join(ckpt_dirs[name],
+                                            str(work[name]))
+                    os.makedirs(step_dir, exist_ok=True)
+                    saved[name] = work[name]
+                    accountant.checkpoint_saved(job.metadata.uid)
+                if work[name] >= work_rounds:
+                    finished.add(name)
+            accountant.pump()
+            accountant.tick(rounds)
+            phases = {j.metadata.name: j.status.phase
+                      for j in api.list("TpuJob", copy=False)}
+            if not faulting and all(p in TERMINAL
+                                    for p in phases.values()):
+                break
+        phases = {j.metadata.name: j.status.phase
+                  for j in api.list("TpuJob", copy=False)}
+        jobs_final = api.list("TpuJob", copy=False)
+        # Narrowest width any gang actually ran at, from the scheduler's
+        # resize decisions (sampling live widths would miss a shrink the
+        # ElasticController undoes within the same round).
+        for e in scheduler.resize_log:
+            if e["direction"] == "shrink":
+                min_width = min(min_width, len(e["kept"]))
+        final_steps = {
+            name: (latest_complete_step(ckpt_dirs[name]) or 0)
+            for name in names
+        }
+        # Monotone progress also means the disk ends AHEAD of the last
+        # resume point: the gang always re-earned past its rollback.
+        for name in names:
+            if final_steps[name] < last_resumed.get(name, 0):
+                monotone = False
+        accountant.pump()
+        report = ElasticSoakReport(
+            converged=all(p in TERMINAL for p in phases.values())
+            and mgr.is_idle(),
+            all_succeeded=all(p == "Succeeded" for p in phases.values()),
+            phases=phases,
+            rounds=rounds,
+            bursts=bursts,
+            resizes=sum(j.status.resizes for j in jobs_final),
+            shrinks=sum(1 for e in scheduler.resize_log
+                        if e["direction"] == "shrink"),
+            grows=sum(1 for e in scheduler.resize_log
+                      if e["direction"] == "grow"),
+            restarts_consumed=sum(j.status.restarts for j in jobs_final),
+            preemption_restarts=sum(j.status.preemptions
+                                    for j in jobs_final),
+            checkpoint_steps_monotone=monotone,
+            final_steps=final_steps,
+            min_width_observed=min_width,
+            goodput_conserved=accountant.conservation()["exact"],
+            goodput=accountant.snapshot(),
+        )
+    finally:
+        accountant.close()
+        mgr.close()
+        if own_state:
+            shutil.rmtree(state_dir, ignore_errors=True)
+    log.info("elastic soak done", kv={
+        "converged": report.converged, "rounds": report.rounds,
+        "bursts": report.bursts, "resizes": report.resizes,
+        "shrinks": report.shrinks, "grows": report.grows,
     })
     return report
 

@@ -45,9 +45,34 @@ class MeshAxesSpec:
 
 
 @dataclasses.dataclass
+class ElasticSpec:
+    """Elastic gang bounds (VirtualFlow, arxiv 2009.09523): decouple the
+    gang's logical size from the hardware it happens to hold. A TpuJob
+    carrying this spec may RESIZE instead of restarting or failing:
+
+    - on slice preemption the gang **shrinks** onto its surviving units
+      (down to ``min_slices``) and resumes from the newest complete
+      checkpoint — a resize (``status.resizes``), not a restart: no
+      ``max_restarts`` consumed, no re-admission queueing, no backoff;
+    - when the scheduler frees capacity the ElasticController **grows**
+      the gang back toward ``max_slices`` (priority-ordered, never while
+      same-type gangs queue unplaced);
+    - initial placement shrinks to fit: a contended fleet places the
+      gang at the widest width in [min_slices, num_slices] that fits.
+
+    ``num_slices`` stays the preferred width and must sit inside
+    [min_slices, max_slices]."""
+
+    min_slices: int = 1
+    max_slices: int = 1
+
+
+@dataclasses.dataclass
 class TpuJobSpec:
     slice_type: str = "v5e-16"
     num_slices: int = 1                 # >1 => multislice over DCN
+    # Elastic bounds (None = fixed-size gang, the pre-elastic contract).
+    elastic: Optional[ElasticSpec] = None
     mesh: MeshAxesSpec = dataclasses.field(default_factory=MeshAxesSpec)
     attn_impl: str = "full"             # full | flash | ring | ulysses | sp_auto
     # Workload: either a registry model (framework-run) or a custom image.
@@ -76,12 +101,25 @@ class TpuJobSpec:
 
 @dataclasses.dataclass
 class TpuJobStatus:
-    phase: str = "Pending"  # Pending|Scheduling|Starting|Running|Restarting|Succeeded|Failed
+    # Pending|Scheduling|Starting|Running|Restarting|Resizing|Succeeded|Failed
+    phase: str = "Pending"
     conditions: List[Condition] = dataclasses.field(default_factory=list)
     restarts: int = 0
     # Gang restarts caused by slice preemption — tracked separately from
     # ``restarts`` because they do not consume the max_restarts budget.
     preemptions: int = 0
+    # Elastic resizes (shrink on preemption / grow on freed capacity) —
+    # tracked next to ``preemptions``: a resize is a zero-downtime event,
+    # not a restart, and consumes neither budget.
+    resizes: int = 0
+    # Elastic gangs: the logical width the gang currently runs at
+    # (0 = spec.num_slices, the fixed-size contract).
+    current_slices: int = 0
+    # Pod names a committed resize still owes deletion (cleared once the
+    # teardown completes). The ledger that lets the idempotent Resizing
+    # re-entry tell ITS stale pods from a fresh eviction racing the
+    # resize — fresh failures are classified, never swallowed.
+    resize_doomed: List[str] = dataclasses.field(default_factory=list)
     # Final metrics reported by worker-0 via its termination message
     # (the K8s terminationMessagePath channel; consumed by the StudyJob
     # controller as the trial objective).

@@ -18,20 +18,50 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, List, Optional
 
-__all__ = ["list_checkpoints", "resolve_checkpoint"]
+__all__ = ["latest_complete_step", "list_checkpoints", "resolve_checkpoint"]
 
 
-def _latest_step(directory: str) -> Optional[int]:
+def _torn_save(step_dir: str) -> bool:
+    """True when a step directory is only PARTIALLY committed: a save
+    torn by SIGKILL can leave the orbax in-progress marker *inside* the
+    already-renamed step directory (the atomic-rename happened but the
+    commit marker removal did not). Such a step must never be reported
+    complete — a resized/restarted gang restoring it would read a torn
+    tree. Markers recognized: any entry naming an orbax tmp/in-progress
+    sentinel (``.orbax-checkpoint-tmp-*``, ``.orbax-in-progress``...)."""
+    try:
+        entries = os.listdir(step_dir)
+    except OSError:
+        return True     # unreadable = not restorable = not complete
+    for e in entries:
+        low = e.lower()
+        if "orbax" in low and ("tmp" in low or "in-progress" in low
+                               or "in_progress" in low):
+            return True
+    return False
+
+
+def latest_complete_step(directory: str) -> Optional[int]:
     """Newest COMPLETE step in an orbax CheckpointManager directory (step
-    subdirs are plain integers; in-progress saves carry a .orbax-* marker
-    suffix and never parse as int)."""
+    subdirs are plain integers; in-progress saves normally carry a
+    .orbax-* marker suffix and never parse as int). A step subdirectory
+    whose in-progress marker lives *inside* it — a partially-committed
+    save torn by SIGKILL — is skipped too (:func:`_torn_save`): the
+    catalog only ever names steps a consumer can actually restore."""
     try:
         entries = os.listdir(directory)
     except OSError:
         return None
-    steps = [int(e) for e in entries
-             if e.isdigit() and os.path.isdir(os.path.join(directory, e))]
+    steps = [
+        int(e) for e in entries
+        if e.isdigit() and os.path.isdir(os.path.join(directory, e))
+        and not _torn_save(os.path.join(directory, e))
+    ]
     return max(steps) if steps else None
+
+
+#: Backwards-compatible private alias (pre-elastic callers).
+_latest_step = latest_complete_step
 
 
 def list_checkpoints(api, namespace: str) -> List[Dict[str, Any]]:

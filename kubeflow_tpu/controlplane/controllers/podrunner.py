@@ -55,11 +55,21 @@ class FakeKubelet(Controller):
         # e.g. compute a loss from the pod's KFTPU_HPARAMS env).
         termination: Optional[Callable[[Any], str]] = None,
         auto_run: bool = True,
+        # Cold-start model (ISSUE 11): a Pending pod stays Pending for
+        # this many kubelet observations before Running — the gang
+        # spin-up window (jax.distributed.initialize, compile, restore)
+        # a restart pays. Pods labeled warm-start: "true" (created into
+        # an elastic gang mid-resize, whose world stays initialized —
+        # the VirtualFlow contract) skip it. 0 = immediate, the
+        # pre-elastic behaviour everywhere.
+        warmup_ticks: int = 0,
     ):
         super().__init__(api, registry)
         self.outcome = outcome
         self.termination = termination
         self.auto_run = auto_run
+        self.warmup_ticks = warmup_ticks
+        self._warm_seen: Dict[str, int] = {}   # pod uid -> observations
 
     def map_to_primary(self, obj):
         return (obj.metadata.namespace, obj.metadata.name)
@@ -76,6 +86,14 @@ class FakeKubelet(Controller):
             pods = self.reader.list("Pod", copy=False)
         except ApiError:
             return  # status sync skipped this pass; next tick retries
+        if self._warm_seen:
+            # Prune warmup counters of pods deleted mid-warmup (torn
+            # down while still Pending) — long oscillation soaks would
+            # otherwise accumulate one stale uid per interrupted
+            # cold-start.
+            live = {p.metadata.uid for p in pods}
+            self._warm_seen = {u: n for u, n in self._warm_seen.items()
+                               if u in live}
         for pod in pods:
             try:
                 self.reconcile(pod.metadata.namespace, pod.metadata.name)
@@ -90,6 +108,14 @@ class FakeKubelet(Controller):
         if pod is None:
             return Result()
         if pod.status.phase == "Pending" and self.auto_run:
+            if self.warmup_ticks > 0 and \
+                    pod.metadata.labels.get("warm-start") != "true":
+                uid = pod.metadata.uid
+                seen = self._warm_seen.get(uid, 0) + 1
+                self._warm_seen[uid] = seen
+                if seen <= self.warmup_ticks:
+                    return Result()     # still cold-initializing
+                self._warm_seen.pop(uid, None)
             pod = self.api.try_get("Pod", name, namespace)
             if pod is None or pod.status.phase != "Pending":
                 return Result()

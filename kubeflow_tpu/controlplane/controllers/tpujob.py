@@ -130,6 +130,11 @@ class TpuJobController(Controller):
         self.metrics_restarts = registry.counter(
             "kftpu_tpujob_gang_restarts_total", "Gang restarts", ("reason",)
         )
+        self.metrics_resizes = registry.counter(
+            "kftpu_tpujob_gang_resizes_total",
+            "Elastic gang resizes (zero-downtime shrink/grow, "
+            "no restart budget)", ("direction",)
+        )
 
     # ------------- naming -------------
 
@@ -140,6 +145,15 @@ class TpuJobController(Controller):
     @staticmethod
     def service_name(job: str) -> str:
         return f"{job}-workers"
+
+    @staticmethod
+    def _replica_index(pod) -> int:
+        """The worker's gang index from its REPLICA_LABEL; -1 for a pod
+        the label does not place (never ours / corrupted)."""
+        try:
+            return int(pod.metadata.labels.get(REPLICA_LABEL, "-1"))
+        except ValueError:
+            return -1
 
     # ------------- reconcile -------------
 
@@ -166,6 +180,28 @@ class TpuJobController(Controller):
             )
         except (KeyError, ValueError) as e:
             return self._fail_invalid(job, str(e))
+
+        # 1a. Elastic bounds (ISSUE 11): a resize contract that cannot
+        # hold is a permanent spec error, rejected at admission like a
+        # bad mesh — never discovered mid-shrink.
+        el = job.spec.elastic
+        if el is not None:
+            if not (1 <= el.min_slices <= job.spec.num_slices
+                    <= el.max_slices):
+                return self._fail_invalid(
+                    job,
+                    f"elastic bounds must satisfy 1 <= min_slices "
+                    f"({el.min_slices}) <= num_slices "
+                    f"({job.spec.num_slices}) <= max_slices "
+                    f"({el.max_slices})",
+                    reason="InvalidElasticSpec")
+            if job.spec.preemption_policy != "restart":
+                return self._fail_invalid(
+                    job,
+                    "elastic gangs require preemption_policy=restart "
+                    "(shrink-instead-of-restart contradicts "
+                    f"{job.spec.preemption_policy!r})",
+                    reason="InvalidElasticSpec")
 
         # 1b. HBM fit gate: a registry-model job whose state + activations
         # can't fit the slice's per-chip HBM is rejected NOW (permanent
@@ -208,7 +244,11 @@ class TpuJobController(Controller):
                 self.api.update_status(job)
             return Result(requeue_after=self.requeue_pending_s)
 
-        n_hosts = st.num_hosts * job.spec.num_slices
+        # Elastic gangs run at status.current_slices (resized width);
+        # fixed gangs at spec.num_slices. Every pod-facing computation
+        # below — world size, worker count, coordinator env — follows the
+        # CURRENT width, republished on every resize.
+        n_hosts = st.num_hosts * self._gang_width(job)
 
         # 3. Headless service for gang DNS (worker-0 is the coordinator;
         # the reference used one headless service per TFJob replica).
@@ -255,7 +295,8 @@ class TpuJobController(Controller):
     # ------------- admission -------------
 
     #: Phases that hold slice capacity / chip quota.
-    IN_USE_PHASES = ("Scheduling", "Starting", "Running", "Restarting")
+    IN_USE_PHASES = ("Scheduling", "Starting", "Running", "Restarting",
+                     "Resizing")
 
     def _admission_blocked(self, job: TpuJob, st) -> Optional[tuple]:
         """Gang admission (all or nothing). The whole check-then-reserve
@@ -395,6 +436,18 @@ class TpuJobController(Controller):
                 )
         return None
 
+    # ------------- elastic width -------------
+
+    @staticmethod
+    def _gang_width(job: TpuJob) -> int:
+        """The gang's CURRENT logical width: elastic gangs run at
+        ``status.current_slices`` once set (shrunk/grown/shrink-to-fit
+        placed); everything else — and a not-yet-placed elastic gang —
+        at ``spec.num_slices``."""
+        if job.spec.elastic is not None and job.status.current_slices > 0:
+            return job.status.current_slices
+        return job.spec.num_slices
+
     # ------------- scheduling (ISSUE 8) -------------
 
     def _schedule_gang(self, job: TpuJob) -> Optional[tuple]:
@@ -435,6 +488,16 @@ class TpuJobController(Controller):
             return blocked
         prev = copy.deepcopy(job.status)
         job.status.slice_assignment = rendered
+        if job.spec.elastic is not None:
+            # Shrink-to-fit placement: the scheduler may have placed the
+            # gang below spec.num_slices (down to min_slices); the
+            # current width IS the placed width (the ElasticController
+            # grows it back toward max_slices as capacity frees).
+            from kubeflow_tpu.scheduler.placement import parse_assignment
+
+            units = parse_assignment(rendered) or []
+            if units:
+                job.status.current_slices = len(units)
         if job.status.phase in ("", "Pending"):
             job.status.phase = "Scheduling"
         job.status.conditions = set_condition(
@@ -531,15 +594,22 @@ class TpuJobController(Controller):
                 "memory": "64Gi",
             },
         )
+        labels = {
+            JOB_LABEL: name,
+            REPLICA_LABEL: str(index),
+            "restart-generation": str(generation),
+        }
+        if job.status.phase == "Resizing":
+            # Elastic resize: the gang's world never cold-restarted —
+            # workers (re)created mid-resize join an already-initialized
+            # world (the VirtualFlow virtual-node handoff) and skip the
+            # kubelet's cold-start warmup model.
+            labels["warm-start"] = "true"
         return Pod(
             metadata=ObjectMeta(
                 name=self.worker_name(name, index),
                 namespace=job.metadata.namespace,
-                labels={
-                    JOB_LABEL: name,
-                    REPLICA_LABEL: str(index),
-                    "restart-generation": str(generation),
-                },
+                labels=labels,
                 owner_references=[self._owner_ref(job)],
             ),
             spec=PodSpec(
@@ -608,9 +678,10 @@ class TpuJobController(Controller):
         if not (self.scheduler is not None
                 and self.scheduler.manages(job.spec.slice_type)):
             # Legacy shape-only assignment; with a scheduler the field
-            # carries the concrete slice set _schedule_gang placed.
+            # carries the concrete slice set _schedule_gang placed. The
+            # CURRENT width — an elastic resize republishes it.
             job.status.slice_assignment = (
-                f"{job.spec.slice_type}x{job.spec.num_slices}"
+                f"{job.spec.slice_type}x{self._gang_width(job)}"
             )
 
         phases = list(states.values())
@@ -635,6 +706,29 @@ class TpuJobController(Controller):
                 # teardown was interrupted — finish it without
                 # re-counting (idempotent re-entry).
                 return self._teardown_gang(job, pods, stale_only=True)
+            if job.status.phase == "Resizing":
+                doomed = set(job.status.resize_doomed)
+                stale = [p for p in pods if p.metadata.name in doomed]
+                fresh = [p for p in pods
+                         if p.status.phase == "Failed"
+                         and p.metadata.name not in doomed]
+                if stale or not fresh:
+                    # Resize accounting already committed (the resize
+                    # status write IS the commit point); finish clearing
+                    # the stale pods without re-counting.
+                    return self._teardown_resize(job, pods)
+                # The owed teardown is done but NEW failures arrived
+                # mid-resize (an eviction racing the republish): phase
+                # Resizing is no shield — fall through and classify
+                # them like any other failure.
+            if crash_failures == 0 and job.spec.elastic is not None:
+                # Elastic shrink (ISSUE 11): keep the surviving slices,
+                # resize the gang instead of restarting it — as long as
+                # the survivors satisfy min_slices. Below that floor the
+                # preemption falls through to the ordinary restart path.
+                resized = self._resize_shrink(job, pods, n_hosts)
+                if resized is not None:
+                    return resized
             if crash_failures == 0 and job.spec.preemption_policy == "fail":
                 job.status.phase = "Failed"
                 job.status.completion_time = time.time()
@@ -655,6 +749,10 @@ class TpuJobController(Controller):
                         and self.scheduler.manages(job.spec.slice_type):
                     job.status.slice_assignment = ""
                     self.scheduler.release(job.metadata.uid)
+                # An elastic gang that fell below min_slices restarts
+                # like any other — and re-places from spec width again
+                # (shrink-to-fit decides the fresh current width).
+                job.status.current_slices = 0
                 self._commit_restart_status(job)
                 self.metrics_restarts.inc(reason="preempted")
                 self.recorder.event(
@@ -693,8 +791,11 @@ class TpuJobController(Controller):
                     job, "Normal", "GangRunning",
                     f"{n_hosts} workers on {job.status.slice_assignment}",
                 )
-        elif job.status.phase == "Restarting" and len(phases) < n_hosts:
+        elif job.status.phase in ("Restarting", "Resizing") \
+                and len(phases) < n_hosts:
             requeue = 0.5  # pods still terminating; recreate next pass
+        elif job.status.phase == "Resizing":
+            pass  # pods recreated at the new width; waiting for Running
         else:
             job.status.phase = "Starting"
 
@@ -731,6 +832,125 @@ class TpuJobController(Controller):
         job.status.phase = "Restarting"
         job.status.last_restart_time = time.time()
         self.api.update_status(job)
+
+    # ------------- elastic resize (ISSUE 11) -------------
+
+    def _resize_shrink(self, job: TpuJob, pods,
+                       n_hosts: int) -> Optional[Result]:
+        """Shrink the gang onto its surviving slices: a preemption hit
+        one or more slice groups of an elastic gang and enough survive to
+        satisfy ``min_slices``. The gang keeps its surviving units,
+        ``status.slice_assignment`` and the world size republish at the
+        new width, and the job resumes from the newest COMPLETE step in
+        the checkpoint catalog — a resize (``status.resizes``), never a
+        restart: no ``max_restarts`` or ``status.preemptions`` bump, no
+        re-admission queue, no backoff hold. Returns None when the
+        survivors fall below the floor (the ordinary restart path then
+        runs)."""
+        st = get_slice(job.spec.slice_type)
+        width = n_hosts // max(st.num_hosts, 1)
+        lost = set()
+        for p in pods:
+            if p.status.phase != "Failed" \
+                    or p.status.message != PREEMPTION_MESSAGE:
+                continue
+            idx = self._replica_index(p)
+            if 0 <= idx < n_hosts:
+                lost.add(idx // st.num_hosts)
+        keep = [g for g in range(width) if g not in lost]
+        if not lost or len(keep) < job.spec.elastic.min_slices:
+            return None
+        # Commit the resize BEFORE any pod is touched (the restart
+        # discipline of _commit_restart_status): a conflicting status
+        # write requeues with the world untouched, while a teardown
+        # interrupted AFTER the commit re-enters through the idempotent
+        # phase == "Resizing" path without re-counting.
+        job.status.resizes += 1
+        job.status.current_slices = len(keep)
+        rendered = None
+        if self.scheduler is not None \
+                and self.scheduler.manages(job.spec.slice_type):
+            from kubeflow_tpu.scheduler.placement import parse_assignment
+
+            units = parse_assignment(job.status.slice_assignment) or []
+            keep_units = [units[g] for g in keep if g < len(units)]
+            if keep_units:
+                rendered = self.scheduler.shrink(
+                    job.metadata.uid, keep_units)
+        job.status.slice_assignment = rendered or (
+            f"{job.spec.slice_type}x{len(keep)}")
+        step = self._catalog_step(job)
+        if step is not None:
+            job.status.resumed_from_step = step
+        job.status.phase = "Resizing"
+        # Record the owed teardown IN the commit: the Resizing re-entry
+        # deletes exactly these and can therefore tell a fresh eviction
+        # racing the resize from its own stale pods.
+        new_n_hosts = len(keep) * st.num_hosts
+        doomed = set(job.status.resize_doomed)
+        for p in pods:
+            idx = self._replica_index(p)
+            if p.status.phase == "Failed" or idx < 0 \
+                    or idx >= new_n_hosts:
+                doomed.add(p.metadata.name)
+        job.status.resize_doomed = sorted(doomed)
+        self.api.update_status(job)
+        self.metrics_resizes.inc(direction="shrink")
+        self.recorder.event(
+            job, "Warning", "ElasticShrink",
+            f"slice preempted; gang resized {width}->{len(keep)} slices "
+            f"(resize {job.status.resizes}), resuming from "
+            + (f"step {step}" if step is not None
+               else (job.spec.checkpoint_dir or "scratch")),
+        )
+        return self._teardown_resize(job, pods)
+
+    def _teardown_resize(self, job: TpuJob, pods) -> Result:
+        """Clear exactly the pods the committed resize owes
+        (``status.resize_doomed``: the preempted groups' Failed pods and
+        any survivor whose index fell off the renumbered world) plus any
+        out-of-range straggler. Survivors inside the new range are NOT
+        touched — that is the zero-downtime half of the resize contract.
+        Failed pods go last so an interrupted teardown keeps its
+        evidence (the ``_teardown_gang`` discipline); re-entry is keyed
+        off phase == "Resizing" and the doomed ledger, never
+        re-counted. Once every owed pod is gone the ledger clears, so a
+        LATER failure is classified as the fresh event it is."""
+        st = get_slice(job.spec.slice_type)
+        n_hosts = st.num_hosts * self._gang_width(job)
+        doomed_names = set(job.status.resize_doomed)
+        doomed = []
+        for p in pods:
+            idx = self._replica_index(p)
+            if p.metadata.name in doomed_names or idx < 0 \
+                    or idx >= n_hosts:
+                doomed.append(p)
+        for p in sorted(doomed, key=lambda p: p.status.phase == "Failed"):
+            try:
+                self.api.delete("Pod", p.metadata.name,
+                                p.metadata.namespace)
+            except NotFoundError:
+                pass
+        if job.status.resize_doomed:
+            # Every owed deletion issued: retire the ledger (a conflict
+            # here just retries — the deletes above are idempotent).
+            job.status.resize_doomed = []
+            self.api.update_status(job)
+        # Zero-downtime: recreate the renumbered world NOW (no backoff —
+        # the preemption cost a resize, not a restart window).
+        return Result(requeue_after=0.0)
+
+    def _catalog_step(self, job: TpuJob) -> Optional[int]:
+        """Newest COMPLETE step in the job's checkpoint catalog entry —
+        what a resized gang resumes from (torn/in-progress saves are
+        skipped by the catalog, ckpt_catalog.latest_complete_step)."""
+        if not job.spec.checkpoint_dir:
+            return None
+        from kubeflow_tpu.controlplane.ckpt_catalog import (
+            latest_complete_step,
+        )
+
+        return latest_complete_step(job.spec.checkpoint_dir)
 
     def _teardown_gang(self, job: TpuJob, pods, *,
                        stale_only: bool = False) -> Result:

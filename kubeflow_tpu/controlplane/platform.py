@@ -231,6 +231,19 @@ class Platform:
                         interval_s=float(
                             params.get("defragIntervalSeconds", 30)),
                     ))
+                if params.get("elastic", "true") != "false":
+                    # Elastic gangs (ISSUE 11): grows under-sized
+                    # elastic TpuJobs back toward max_slices when the
+                    # fleet frees units (the shrink half lives in the
+                    # TpuJobController's resize branch).
+                    from kubeflow_tpu.elastic import ElasticController
+
+                    self.manager.register(ElasticController(
+                        self.api, reg, scheduler=scheduler,
+                        tracer=self.tracer,
+                        interval_s=float(
+                            params.get("elasticIntervalSeconds", 15)),
+                    ))
             self.manager.register(TpuJobController(self.api, reg,
                                                    capacity=capacity,
                                                    scheduler=scheduler))

@@ -1,0 +1,30 @@
+"""Elastic TpuJobs (ISSUE 11): resize the gang instead of restarting it.
+
+A TpuJob declaring ``spec.elastic{min_slices, max_slices}`` decouples its
+logical gang size from the hardware it happens to hold (VirtualFlow,
+arxiv 2009.09523). The lifecycle verb every layer agrees on is RESIZE:
+
+- **shrink** — on slice preemption the TpuJobController keeps the
+  surviving units, republishes ``status.slice_assignment`` and the world
+  size, and the job resumes from the newest complete step in the
+  checkpoint catalog: ``status.resizes`` bumps, never ``max_restarts``
+  or the preemption/restart machinery (the controller's resize branch,
+  reached through the same PR-8 ``preempt_gang``/``preempt_slice_group``
+  eviction seam chaos and policy use);
+- **grow** — when the GangScheduler frees adjacent units, the
+  :class:`ElasticController` here grows under-sized gangs back toward
+  ``max_slices``, priority-ordered and never past fair placement (queued
+  gangs' claims beat every grower's);
+- the DefragController knows shrinking an elastic gang is a *cheaper*
+  alternative to migrating it (same simulated-gain what-if).
+
+The goodput ledger attributes a resize as recompute-only (productive
+ticks since the last save move to ``restart_rollback``) plus whatever
+brief ``Resizing`` window the gang spends republishing — never a restart
+window, never re-admission queue time. See docs/elastic.md.
+"""
+
+from kubeflow_tpu.elastic.controller import ElasticController
+from kubeflow_tpu.elastic.rollback import RollbackTracker, shrink_counts
+
+__all__ = ["ElasticController", "RollbackTracker", "shrink_counts"]

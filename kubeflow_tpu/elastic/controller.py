@@ -1,0 +1,167 @@
+"""ElasticController: grow under-sized elastic gangs back toward
+``max_slices`` when the scheduler frees capacity.
+
+The shrink half of elasticity lives in the TpuJobController's resize
+branch (a preemption arrives as failed pods; the controller keeps the
+survivors). Growth has no such trigger — freed units just sit there — so
+this controller sweeps the fleet the way the DefragController does:
+debounced by ``interval_s`` (or purely event-driven at ``interval_s <=
+0`` for logical-time drivers), bounded to ``max_grows_per_pass`` moves,
+in strict priority order (ties broken by arrival, then name).
+
+Fair-placement rule, enforced in ``GangScheduler.try_grow``: growth
+never outruns the queue — while any same-type gang waits unplaced, the
+free units are its claim, not a grower's. A grow is a RESIZE: the
+controller bumps ``status.resizes``, extends ``status.slice_assignment``
+with the new units, republishes the world size through phase
+``Resizing``, and the gang resumes from its newest complete checkpoint
+step — no restart budget, no re-admission.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from kubeflow_tpu.controlplane.runtime import EventRecorder, Result
+from kubeflow_tpu.controlplane.runtime.reconciler import Controller
+from kubeflow_tpu.scheduler.core import GangScheduler
+from kubeflow_tpu.scheduler.placement import parse_assignment
+from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
+from kubeflow_tpu.utils.tracing import Tracer, global_tracer
+
+log = get_logger("elastic")
+
+#: Phases an under-sized gang may grow from: it must be ON hardware and
+#: settled (mid-resize / mid-restart gangs first finish their move).
+GROWABLE_PHASES = ("Running",)
+
+
+class ElasticController(Controller):
+    NAME = "elastic"
+    WATCH_KINDS = ("TpuJob",)
+
+    def __init__(
+        self,
+        api,
+        registry: MetricsRegistry = global_registry,
+        *,
+        scheduler: GangScheduler,
+        tracer: Tracer = global_tracer,
+        interval_s: float = 15.0,
+        max_grows_per_pass: int = 1,
+    ):
+        super().__init__(api, registry)
+        self.scheduler = scheduler
+        self.tracer = tracer
+        self.interval_s = interval_s
+        self.max_grows_per_pass = max_grows_per_pass
+        self.recorder = EventRecorder(api, self.NAME)
+        self.metrics_grows = registry.counter(
+            "kftpu_elastic_grows_total",
+            "Elastic gangs grown back toward max_slices",
+        )
+        self._last_pass = 0.0            # monotonic; 0 = never
+
+    def map_to_primary(self, obj):
+        # Any TpuJob transition may free units or settle a resize;
+        # reconcile under the object's own key (the sweep itself is
+        # fleet-global and debounced by interval_s).
+        return (obj.metadata.namespace, obj.metadata.name)
+
+    # ----------------- the sweep -----------------
+
+    def reconcile(self, namespace: str, name: str) -> Result:
+        now = time.monotonic()
+        if self._last_pass and self.interval_s > 0 \
+                and now - self._last_pass < self.interval_s:
+            return Result(requeue_after=self.interval_s)
+        self._last_pass = now
+        self.sweep()
+        # interval_s <= 0 (logical-time drivers): sweeps ride on TpuJob
+        # watch events only — the DefragController discipline; a
+        # zero-delay requeue would self-sustain and the manager's drain
+        # loop could never go idle.
+        if self.interval_s > 0:
+            return Result(requeue_after=self.interval_s)
+        return Result()
+
+    def sweep(self) -> int:
+        """One growth pass; returns gangs grown. Priority-ordered: the
+        most important under-sized gang gets the freed capacity first."""
+        jobs = self.reader.list("TpuJob", copy=False)
+        candidates = []
+        for j in jobs:
+            el = j.spec.elastic
+            if el is None or j.status.phase not in GROWABLE_PHASES:
+                continue
+            if not self.scheduler.manages(j.spec.slice_type):
+                continue
+            held = self.scheduler.assignment_of(j.metadata.uid)
+            if held is None or len(held) >= el.max_slices:
+                continue
+            candidates.append(j)
+        candidates.sort(key=lambda j: (
+            -j.spec.priority, j.metadata.creation_timestamp,
+            j.metadata.namespace, j.metadata.name,
+        ))
+        grown = 0
+        for job in candidates:
+            if grown >= self.max_grows_per_pass:
+                break
+            rendered = self._repair_drift(job)
+            if rendered is None:
+                rendered = self.scheduler.try_grow(job, jobs=jobs)
+            if rendered is None:
+                continue
+            self._commit(job, rendered)
+            grown += 1
+        return grown
+
+    # ----------------- commit -----------------
+
+    def _repair_drift(self, job) -> Optional[str]:
+        """A grow whose status write conflicted leaves the fleet wider
+        than status records (the units are held; the gang does not know).
+        Re-render from the fleet instead of growing further — the commit
+        below then catches status up."""
+        held = self.scheduler.assignment_of(job.metadata.uid) or []
+        recorded = parse_assignment(job.status.slice_assignment) or []
+        if recorded and len(held) > len(recorded):
+            from kubeflow_tpu.scheduler.placement import Placement
+
+            return Placement.from_units(
+                self.scheduler.fleet, job.spec.slice_type, held).render()
+        return None
+
+    def _commit(self, job, rendered: str) -> None:
+        """Publish the grown world: bump ``resizes``, extend the
+        assignment, republish the world size through phase ``Resizing``
+        (the TpuJobController recreates the gang's pods at the new
+        width, warm-start labeled). A grow loses NO work: the joining
+        workers receive live state from the surviving replicas (the
+        elastic-DP rendezvous) — ``resumed_from_step`` is a shrink-path
+        field and stays untouched. Mutates a FRESH copy — the sweep's
+        list is the zero-copy store view."""
+        units: List[str] = parse_assignment(rendered) or []
+        fresh = self.api.get("TpuJob", job.metadata.name,
+                             job.metadata.namespace)
+        old_width = fresh.status.current_slices or fresh.spec.num_slices
+        fresh.status.resizes += 1
+        fresh.status.current_slices = len(units)
+        fresh.status.slice_assignment = rendered
+        fresh.status.phase = "Resizing"
+        self.api.update_status(fresh)
+        self.metrics_grows.inc()
+        self.recorder.event(
+            fresh, "Normal", "ElasticGrow",
+            f"gang grown {old_width}->{len(units)} slices toward "
+            f"max_slices={fresh.spec.elastic.max_slices} "
+            f"(resize {fresh.status.resizes}); joining workers receive "
+            "live state from the surviving replicas",
+        )
+        log.info("elastic grow", kv={
+            "job": f"{job.metadata.namespace}/{job.metadata.name}",
+            "width": len(units), "resizes": fresh.status.resizes,
+        })

@@ -1,0 +1,50 @@
+"""Shared rollback detection for elastic work models.
+
+The storm bench (`scheduler/benchmark.py`) and the elastic soak
+(`chaos/soak.py`) both simulate work that rolls back to the newest
+complete checkpoint when the gang is interrupted. The triggers must be
+identical in both drivers — and NOT derived from net width: a shrink
+followed by a grow-back inside one event-driven drain leaves
+``status.current_slices`` unchanged while the shrink's
+resume-from-last-save very much happened. So shrinks are counted from
+the scheduler's ``resize_log`` (every partial release is one event),
+and grows trigger nothing (live-state broadcast loses no work).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+def shrink_counts(resize_log: List[dict]) -> Dict[str, int]:
+    """{job uid: shrink events so far} out of a GangScheduler's
+    append-only ``resize_log``."""
+    out: Dict[str, int] = {}
+    for e in resize_log:
+        if e["direction"] == "shrink":
+            out[e["uid"]] = out.get(e["uid"], 0) + 1
+    return out
+
+
+class RollbackTracker:
+    """Per-driver bookkeeping: ``should_rollback(job, shrinks)`` is True
+    exactly when the job must resume from its last save — any
+    preemptions/restarts bump (a restart always re-loads the newest
+    complete step) or any NEW shrink event since the last check."""
+
+    def __init__(self) -> None:
+        self._seen_hard: Dict[str, int] = {}
+        self._seen_shrinks: Dict[str, int] = {}
+
+    def should_rollback(self, job, shrinks: Dict[str, int]) -> bool:
+        uid = job.metadata.uid
+        roll = False
+        hard = job.status.preemptions + job.status.restarts
+        if hard > self._seen_hard.get(uid, 0):
+            self._seen_hard[uid] = hard
+            roll = True
+        s = shrinks.get(uid, 0)
+        if s > self._seen_shrinks.get(uid, 0):
+            self._seen_shrinks[uid] = s
+            roll = True
+        return roll

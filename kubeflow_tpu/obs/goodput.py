@@ -72,7 +72,8 @@ CATEGORIES = (
 
 #: Phases during which a gang holds (synthetic) capacity when the
 #: scheduler does not pin concrete units.
-ASSIGNED_PHASES = ("Scheduling", "Starting", "Running", "Restarting")
+ASSIGNED_PHASES = ("Scheduling", "Starting", "Running", "Restarting",
+                   "Resizing")
 TERMINAL_PHASES = ("Succeeded", "Failed")
 
 GOODPUT_JOURNAL = "goodput.jsonl"
@@ -132,9 +133,9 @@ class _JobTrack:
     """The accountant's view of one TpuJob, built from watch events."""
 
     __slots__ = (
-        "uid", "name", "namespace", "slice_type", "num_slices", "phase",
-        "admitted", "assignment", "preemptions", "restarts",
-        "interruption", "checkpointing", "deleted",
+        "uid", "name", "namespace", "slice_type", "num_slices",
+        "alloc_slices", "phase", "admitted", "assignment", "preemptions",
+        "restarts", "resizes", "interruption", "checkpointing", "deleted",
     )
 
     def __init__(self, uid: str, name: str, namespace: str,
@@ -143,12 +144,14 @@ class _JobTrack:
         self.name = name
         self.namespace = namespace
         self.slice_type = slice_type
-        self.num_slices = num_slices
+        self.num_slices = num_slices      # spec width (the desired gang)
+        self.alloc_slices = num_slices    # current width (elastic resize)
         self.phase = ""
         self.admitted = True
         self.assignment = ""
         self.preemptions = 0
         self.restarts = 0
+        self.resizes = 0
         self.interruption: Optional[str] = None  # "preempt"|"migration"|...
         self.checkpointing = False
         self.deleted = False
@@ -214,8 +217,16 @@ class GoodputAccountant:
         self._job_cats: Dict[str, Dict[str, int]] = {}
         self._job_meta: Dict[str, Tuple[str, str]] = {}
         self._unsaved: Dict[str, int] = {}
+        # Elastic resize bookkeeping (ISSUE 11): per-job resize count and
+        # the counterfactual ledger — productive slice-ticks earned while
+        # the gang ran UNDER its spec width. A restart-only twin would
+        # have spent exactly those ticks queued for full capacity, so
+        # this is the "slice-seconds saved vs the restart counterfactual"
+        # surface tpuctl shows (docs/elastic.md).
+        self._job_resizes: Dict[str, int] = {}
+        self._job_degraded: Dict[str, int] = {}
         self.interruptions: Dict[str, int] = {
-            "preempt": 0, "migration": 0, "restart": 0,
+            "preempt": 0, "migration": 0, "restart": 0, "resize": 0,
         }
         # Event-stream state.
         self._jobs: Dict[str, _JobTrack] = {}
@@ -331,10 +342,15 @@ class GoodputAccountant:
             # not read history as fresh interruptions.
             j.preemptions = job.status.preemptions
             j.restarts = job.status.restarts
+            j.resizes = job.status.resizes
             self._job_meta[uid] = (job.metadata.namespace,
                                    job.metadata.name)
         j.slice_type = job.spec.slice_type
         j.num_slices = job.spec.num_slices
+        # Elastic gangs hold capacity at their CURRENT width, not the
+        # spec width (the synthetic-allocation path sizes off this).
+        prev_width = j.alloc_slices
+        j.alloc_slices = job.status.current_slices or job.spec.num_slices
         j.phase = job.status.phase or ""
         j.assignment = job.status.slice_assignment or ""
         j.admitted = True
@@ -348,8 +364,21 @@ class GoodputAccountant:
             self._begin_interruption(j, cause)
         if job.status.restarts > j.restarts:
             self._begin_interruption(j, "restart")
+        if job.status.resizes > j.resizes:
+            # Elastic resize (ISSUE 11). A SHRINK resumes from the last
+            # save: ONLY the recompute moves (productive-since-save ->
+            # restart_rollback) — no interruption window opens, the
+            # gang never left the hardware it keeps. A GROW costs
+            # nothing at all: surviving replicas broadcast live state
+            # to the joining workers (the elastic-DP rendezvous), so no
+            # work is lost and the unsaved window stays open.
+            if j.alloc_slices < prev_width:
+                self._begin_interruption(j, "resize")
+            else:
+                self._begin_grow(j)
         j.preemptions = job.status.preemptions
         j.restarts = job.status.restarts
+        j.resizes = job.status.resizes
         if j.phase == "Running":
             j.interruption = None
 
@@ -413,7 +442,12 @@ class GoodputAccountant:
     # ----------------- interruption / rollback -----------------
 
     def _begin_interruption(self, j: _JobTrack, cause: str) -> None:
-        j.interruption = cause
+        if cause != "resize":
+            # A resize opens NO interruption window: the gang keeps its
+            # surviving units and the brief Resizing republish (if any)
+            # classifies through the phase, not through this flag. Only
+            # the recompute moves below apply.
+            j.interruption = cause
         j.checkpointing = False
         moves: Dict[str, List] = {}
         unsaved = self._unsaved.get(j.uid, 0)
@@ -435,6 +469,15 @@ class GoodputAccountant:
         self._journal_rec(rec)
         self._apply_int(rec)
 
+    def _begin_grow(self, j: _JobTrack) -> None:
+        """A grow-resize: tallied like every resize, but it moves no
+        time and leaves the unsaved window open (live-state broadcast,
+        nothing to recompute)."""
+        rec = {"op": "int", "job": j.uid, "cause": "resize",
+               "moves": {}, "grow": 1}
+        self._journal_rec(rec)
+        self._apply_int(rec)
+
     # ----------------- the tick -----------------
 
     def tick(self, now: int) -> None:
@@ -448,11 +491,25 @@ class GoodputAccountant:
                 return
             states = self._classify()
             queued = self._queued_demand()
+            # Degraded-productive (the elastic counterfactual): units
+            # productive for a gang currently running BELOW its spec
+            # width. Computed here — not at apply time — so journal
+            # replay rebuilds it without needing the event stream.
+            degraded: Dict[str, int] = {}
+            for u, (cat, uid) in states.items():
+                if cat != "productive" or not uid:
+                    continue
+                j = self._jobs.get(uid)
+                if j is not None and \
+                        len(self._alloc.get(uid, [])) < j.num_slices:
+                    degraded[uid] = degraded.get(uid, 0) + 1
             rec = {
                 "op": "tick", "t": now, "dt": dt,
                 "s": {u: [cat, job] for u, (cat, job) in states.items()},
                 "q": queued,
             }
+            if degraded:
+                rec["dg"] = degraded
             self._journal_rec(rec)
             self._apply_tick(rec)
 
@@ -524,8 +581,10 @@ class GoodputAccountant:
                       and j.phase in ASSIGNED_PHASES):
                     # Sticky synthetic allocation: the lowest free units
                     # of the job's type, kept until the gang lets go.
+                    # Sized at the CURRENT width (elastic resizes shrink
+                    # or grow it; fixed gangs: alloc == spec).
                     held = self._alloc.get(uid, [])
-                    if len(held) == j.num_slices and all(
+                    if len(held) == j.alloc_slices and all(
                             self._unit_type.get(u) == j.slice_type
                             for u in held):
                         desired = held
@@ -536,9 +595,9 @@ class GoodputAccountant:
                             if self._unit_job.get(u) in (None, uid)
                             and u not in desired
                         ]
-                        while len(desired) < j.num_slices and free:
+                        while len(desired) < j.alloc_slices and free:
                             desired.append(free.pop(0))
-                        desired = desired[:j.num_slices]
+                        desired = desired[:j.alloc_slices]
             self._set_alloc(uid, desired)
         # Jobs gone from the table entirely keep nothing.
         for uid in list(self._alloc):
@@ -596,6 +655,9 @@ class GoodputAccountant:
         for uid, n in rec.get("q", {}).items():
             jc = self._job_cats.setdefault(uid, {})
             jc["queue_wait"] = jc.get("queue_wait", 0) + dt * int(n)
+        for uid, n in rec.get("dg", {}).items():
+            self._job_degraded[uid] = (
+                self._job_degraded.get(uid, 0) + dt * int(n))
         self._last = int(rec["t"])
         if self.metrics_seconds is not None:
             for cat, n in sorted(cat_totals.items()):
@@ -614,6 +676,8 @@ class GoodputAccountant:
         cause = rec["cause"]
         self.interruptions[cause] = self.interruptions.get(cause, 0) + 1
         uid = rec["job"]
+        if cause == "resize":
+            self._job_resizes[uid] = self._job_resizes.get(uid, 0) + 1
         moved_total = 0
         target = None
         for u, (frm, to, amount) in rec.get("moves", {}).items():
@@ -629,7 +693,10 @@ class GoodputAccountant:
             jc = self._job_cats.setdefault(uid, {})
             jc["productive"] = jc.get("productive", 0) - moved_total
             jc[target] = jc.get(target, 0) + moved_total
-        self._unsaved[uid] = 0
+        if not rec.get("grow"):
+            # Grows lose nothing: the unsaved window stays open for the
+            # next real interruption to reclassify.
+            self._unsaved[uid] = 0
 
     def _apply_ckpt(self, rec: dict) -> None:
         self._unsaved[rec["job"]] = 0
@@ -708,6 +775,10 @@ class GoodputAccountant:
                 "interruptions": dict(self.interruptions),
                 "active": sorted(self._active),
                 "tick_seconds": self.tick_seconds,
+                "resizes": {uid: n for uid, n in sorted(
+                    self._job_resizes.items()) if n},
+                "degraded": {uid: n for uid, n in sorted(
+                    self._job_degraded.items()) if n},
             }
 
     def load_state(self, state: dict) -> None:
@@ -730,6 +801,12 @@ class GoodputAccountant:
             if "active" in state:
                 self._active = {u for u in state["active"]
                                 if u in self._unit_type}
+            self._job_resizes = {
+                uid: int(n)
+                for uid, n in state.get("resizes", {}).items()}
+            self._job_degraded = {
+                uid: int(n)
+                for uid, n in state.get("degraded", {}).items()}
 
     # ----------------- read surfaces -----------------
 
@@ -769,6 +846,14 @@ class GoodputAccountant:
             for uid in sorted(self._unsaved):
                 if self._unsaved[uid]:
                     rows.append(("unsaved", uid, "", str(self._unsaved[uid])))
+            for uid in sorted(self._job_resizes):
+                if self._job_resizes[uid]:
+                    rows.append(("resizes", uid, "",
+                                 str(self._job_resizes[uid])))
+            for uid in sorted(self._job_degraded):
+                if self._job_degraded[uid]:
+                    rows.append(("degraded", uid, "",
+                                 str(self._job_degraded[uid])))
             for cause in sorted(self.interruptions):
                 rows.append(("interruptions", cause, "",
                              str(self.interruptions[cause])))
@@ -817,7 +902,7 @@ class GoodputAccountant:
             for uid, jc in sorted(self._job_cats.items()):
                 meta = self._job_meta.get(uid, ("", uid))
                 total = sum(jc.values())
-                jobs[f"{meta[0]}/{meta[1]}"] = {
+                entry = {
                     "categories_ticks": dict(sorted(jc.items())),
                     "categories_s": {c: round(n * ts, 6)
                                      for c, n in sorted(jc.items())},
@@ -825,6 +910,17 @@ class GoodputAccountant:
                     "goodput_ratio": round(
                         jc.get("productive", 0) / total, 6) if total else 0.0,
                 }
+                # Elastic drill-down (ISSUE 11): resize count and the
+                # restart counterfactual — productive slice-time earned
+                # while running under spec width, which a restart-only
+                # twin would have spent queued for full capacity.
+                if self._job_resizes.get(uid) or self._job_degraded.get(uid):
+                    entry["resizes"] = self._job_resizes.get(uid, 0)
+                    entry["degraded_productive_ticks"] = (
+                        self._job_degraded.get(uid, 0))
+                    entry["counterfactual_saved_s"] = round(
+                        self._job_degraded.get(uid, 0) * ts, 6)
+                jobs[f"{meta[0]}/{meta[1]}"] = entry
             return {
                 "tick_seconds": ts,
                 "units": len(self._unit_type),
@@ -840,6 +936,11 @@ class GoodputAccountant:
                 if tracked else 0.0,
                 "conserved": cons["exact"],
                 "interruptions": dict(sorted(self.interruptions.items())),
+                # Fleet-wide elastic counterfactual (docs/elastic.md).
+                "degraded_productive_ticks": sum(
+                    self._job_degraded.values()),
+                "counterfactual_saved_s": round(
+                    sum(self._job_degraded.values()) * ts, 6),
                 "jobs": jobs,
             }
 

@@ -34,6 +34,7 @@ from typing import Dict, List, Optional
 
 from kubeflow_tpu.controlplane.api.meta import ObjectMeta
 from kubeflow_tpu.controlplane.api.types import (
+    ElasticSpec,
     MeshAxesSpec,
     TpuJob,
     TpuJobSpec,
@@ -140,6 +141,13 @@ class StormReport:
     # kftpu_scheduler_queue_age_seconds observations (the aging surface
     # — asserted non-empty by the contended storm bench).
     queue_age_count: int = 0
+    # Elastic gangs (ISSUE 11): resize tallies. ``resizes`` sums
+    # status.resizes across the fleet; shrinks/grows split the
+    # scheduler's partial-release / partial-grow decisions.
+    elastic: bool = False
+    resizes: int = 0
+    shrinks: int = 0
+    grows: int = 0
 
     @property
     def accounting_exact(self) -> bool:
@@ -168,6 +176,10 @@ class StormReport:
             "reconciles": self.reconciles,
             "goodput": dict(self.goodput),
             "queue_age_count": self.queue_age_count,
+            "elastic": self.elastic,
+            "resizes": self.resizes,
+            "shrinks": self.shrinks,
+            "grows": self.grows,
         }
 
 
@@ -188,6 +200,34 @@ def run_schedule_storm(
     # no chaos.
     chaos_at_tick: Optional[int] = None,
     chaos_preempts: int = 0,
+    # Capacity oscillation (ISSUE 11): repeat the burst every
+    # `chaos_every` ticks from `chaos_at_tick` on — preemptor waves
+    # followed by reclaim, the spot/preemptible-fleet weather elastic
+    # gangs are built for. None keeps the single PR-8 burst.
+    chaos_every: Optional[int] = None,
+    # Elastic gangs (ISSUE 11): every multislice storm gang declares
+    # elastic{min_slices=1, max_slices=width} and the ElasticController
+    # rides along — preemptions shrink instead of restarting, freed
+    # capacity grows gangs back. False keeps the storm byte-identical
+    # to the PR-8/PR-10 record.
+    elastic: bool = False,
+    # Width-proportional work (the elastic A/B model): a gang's work is
+    # measured in SLICE-ticks (duration x spec width) and each Running
+    # tick advances it by the CURRENT width — a shrunk gang progresses
+    # slower, exactly the VirtualFlow contract. Checkpoint cadence
+    # scales the same way (a save every ckpt_every_ticks full-width
+    # steps). False keeps the gang-tick model byte-identical.
+    width_scaled_work: bool = False,
+    # False = run the FULL max_ticks horizon even after every gang ends
+    # (equal tracked slice-ticks across A/B twins — the elastic bench's
+    # apples-to-apples requirement). True = the PR-8 early stop.
+    stop_when_done: bool = True,
+    # Cold-start spin-up (ticks a freshly-created pod stays Pending
+    # before Running): the jax.distributed.initialize/compile/restore
+    # window every restart re-pays and an elastic resize does not
+    # (warm-start pods skip it). 0 keeps spin-up free — byte-identical
+    # to the PR-8/PR-10 storms.
+    restart_spinup_ticks: int = 0,
     # Checkpoint cadence model (ISSUE 10): > 0 makes gangs save every
     # `ckpt_every_ticks` productive ticks, each save occupying
     # `ckpt_cost_ticks` during which training does not advance
@@ -223,6 +263,15 @@ def run_schedule_storm(
             threshold=defrag_threshold, interval_s=0.0,
         )
         mgr.register(defrag_ctl)
+    if elastic:
+        from kubeflow_tpu.elastic import ElasticController
+
+        # Event-driven sweeps (interval_s=0): growth rides on TpuJob
+        # transitions, the same logical-time discipline as defrag.
+        mgr.register(ElasticController(
+            api, registry, scheduler=scheduler, tracer=tracer,
+            interval_s=0.0,
+        ))
 
     # Goodput ledger over the fleet's REAL unit uids: the accountant
     # consumes the storm's watch stream like any controller and
@@ -244,13 +293,19 @@ def run_schedule_storm(
     # Checkpoint-model state (ckpt_every_ticks > 0).
     last_saved: Dict[str, int] = {}
     saving: Dict[str, int] = {}
-    seen_bumps: Dict[str, int] = {}
+    from kubeflow_tpu.elastic.rollback import (
+        RollbackTracker,
+        shrink_counts,
+    )
+
+    rollback_tracker = RollbackTracker()
 
     def outcome(pod_name: str) -> Optional[str]:
         job_name = pod_name.rsplit("-worker-", 1)[0]
         return "Succeeded" if job_name in finished else None
 
-    kubelet = FakeKubelet(api, registry, outcome=outcome)
+    kubelet = FakeKubelet(api, registry, outcome=outcome,
+                          warmup_ticks=restart_spinup_ticks)
     mgr.register(kubelet)
 
     chaos_total = 0
@@ -298,10 +353,19 @@ def run_schedule_storm(
                         priority=j.priority,
                         backoff_seconds=0.0,
                         preemption_policy="restart",
+                        # Elastic storms: multislice gangs may shrink to
+                        # one slice and grow back to their spec width.
+                        elastic=(ElasticSpec(min_slices=1,
+                                             max_slices=j.num_slices)
+                                 if elastic and j.num_slices > 1
+                                 else None),
                     ),
                 ))
         reconciles += drain()
-        if preemptor is not None and t == chaos_at_tick:
+        if preemptor is not None and t >= chaos_at_tick and (
+                t == chaos_at_tick
+                or (chaos_every and
+                    (t - chaos_at_tick) % chaos_every == 0)):
             for _ in range(chaos_preempts):
                 if preemptor.preempt_random() is not None:
                     chaos_total += 1
@@ -323,18 +387,29 @@ def run_schedule_storm(
         jobs_now = {j.metadata.name: j
                     for j in api.list("TpuJob", copy=False)}
         completed_saves: List[str] = []
+        shrinks_now = shrink_counts(scheduler.resize_log)
         for name, job in jobs_now.items():
             uid = job.metadata.uid
             if ckpt_every_ticks > 0:
-                bumps = job.status.preemptions + job.status.restarts
-                if bumps > seen_bumps.get(name, 0):
-                    seen_bumps[name] = bumps
+                # Rollback triggers (elastic.rollback, shared with the
+                # soak): restarts/preemptions always roll work to the
+                # last save; SHRINK resizes too — counted by event, not
+                # net width, so a shrink+grow pair inside one drain
+                # still pays its recompute. Grows lose nothing.
+                if rollback_tracker.should_rollback(job, shrinks_now):
                     work_done[name] = last_saved.get(name, 0)
                     saving.pop(name, None)
                     accountant.set_checkpointing(uid, False)
-            if job.status.phase != "Running" \
-                    or not scheduler.assignment_of(uid):
+            held = scheduler.assignment_of(uid)
+            if job.status.phase != "Running" or not held:
                 continue
+            # Width-proportional model (elastic A/B): work and cadence
+            # in slice-ticks, progress at the CURRENT width. Default:
+            # the PR-8 gang-tick model, byte-identical.
+            scale = by_name[name].num_slices if width_scaled_work else 1
+            step = len(held) if width_scaled_work else 1
+            target = by_name[name].duration_ticks * scale
+            cadence = ckpt_every_ticks * scale
             if saving.get(name, 0) > 0:
                 saving[name] -= 1
                 if saving[name] <= 0:
@@ -343,8 +418,8 @@ def run_schedule_storm(
                     completed_saves.append(uid)
                 continue
             done = work_done.get(name, 0)
-            if (ckpt_every_ticks > 0 and done < by_name[name].duration_ticks
-                    and done - last_saved.get(name, 0) >= ckpt_every_ticks):
+            if (ckpt_every_ticks > 0 and done < target
+                    and done - last_saved.get(name, 0) >= cadence):
                 # Begin a save: this tick (and the next cost-1 ticks)
                 # are overhead, not progress.
                 accountant.set_checkpointing(uid, True)
@@ -355,8 +430,8 @@ def run_schedule_storm(
                 else:
                     saving[name] = remaining
                 continue
-            work_done[name] = done + 1
-            if work_done[name] >= by_name[name].duration_ticks:
+            work_done[name] = min(done + step, target)
+            if work_done[name] >= target:
                 finished.add(name)
         # Attribute this tick AFTER the checkpoint flags settle; saves
         # complete (resetting the rollback window) once their final
@@ -368,7 +443,7 @@ def run_schedule_storm(
             accountant.set_checkpointing(uid, False)
         util_sum += 1.0 - len(fleet.free()) / total_units
         util_ticks += 1
-        if len(jobs_now) == num_jobs and all(
+        if stop_when_done and len(jobs_now) == num_jobs and all(
                 j.status.phase in ("Succeeded", "Failed")
                 for j in jobs_now.values()):
             break
@@ -453,6 +528,12 @@ def run_schedule_storm(
         reconciles=reconciles,
         goodput=accountant.snapshot(),
         queue_age_count=queue_age.count() if queue_age is not None else 0,
+        elastic=elastic,
+        resizes=sum(j.status.resizes for j in jobs_final.values()),
+        shrinks=sum(1 for e in scheduler.resize_log
+                    if e["direction"] == "shrink"),
+        grows=sum(1 for e in scheduler.resize_log
+                  if e["direction"] == "grow"),
     )
     accountant.close()
     mgr.close()

@@ -81,11 +81,20 @@ class GangScheduler:
         # uid -> monotonic time the gang was first seen waiting; feeds
         # the time-to-placement histogram and `tpuctl queue`.
         self._pending_since: Dict[str, float] = {}
+        # uid -> width ceiling for elastic growth. Set by the
+        # defragmenter when it SHRINKS a gang to heal fragmentation:
+        # without the cap the ElasticController would grow the gang
+        # right back onto the freed unit and the pair would thrash
+        # shrink/grow forever, rolling the victim's unsaved work back
+        # every sweep. The defragmenter lifts the cap once a simulated
+        # re-grow no longer pushes fragmentation past its threshold.
+        self._grow_caps: Dict[str, int] = {}
         # Decision logs (bounded): the bench and tests read these for the
         # accounting / no-inversion gates. Each entry is a plain dict.
         self.placement_log: List[dict] = []
         self.preemption_log: List[dict] = []
         self.defrag_log: List[dict] = []
+        self.resize_log: List[dict] = []
         self._log_cap = 100_000
         self.metrics_placements = registry.counter(
             "kftpu_scheduler_placements_total",
@@ -99,6 +108,11 @@ class GangScheduler:
             "kftpu_scheduler_priority_inversions_total",
             "Evictions of a gang at >= the requester's priority "
             "(must stay 0)",
+        )
+        self.metrics_resizes = registry.counter(
+            "kftpu_scheduler_resizes_total",
+            "Elastic gang resizes executed by the fleet "
+            "(partial release / partial grow)", labels=("direction",),
         )
         self.metrics_ttp = registry.histogram(
             "kftpu_scheduler_time_to_place_seconds",
@@ -147,10 +161,27 @@ class GangScheduler:
         Idempotent."""
         with self._lock:
             self._pending_since.pop(job_uid, None)
+            self._grow_caps.pop(job_uid, None)
             freed = self.fleet.release(job_uid)
             if freed:
                 self._refresh_gauges()
             return freed
+
+    # ----------------- growth caps (defrag coordination) -----------------
+
+    def cap_growth(self, job_uid: str, width: int) -> None:
+        """Hold an elastic gang at <= ``width`` slices (the defragmenter
+        shrank it on purpose — regrowing would undo the heal)."""
+        with self._lock:
+            self._grow_caps[job_uid] = int(width)
+
+    def uncap_growth(self, job_uid: str) -> None:
+        with self._lock:
+            self._grow_caps.pop(job_uid, None)
+
+    def growth_cap(self, job_uid: str) -> Optional[int]:
+        with self._lock:
+            return self._grow_caps.get(job_uid)
 
     # ----------------- restart adoption -----------------
 
@@ -179,6 +210,108 @@ class GangScheduler:
             self._pending_since.pop(uid, None)
             self._refresh_gauges()
             return units
+
+    # ----------------- elastic resize (ISSUE 11) -----------------
+
+    def shrink(self, job_uid: str, keep_units: List[str]) -> str:
+        """Partial release — the fleet half of an elastic shrink: the
+        gang keeps exactly ``keep_units`` (its surviving slices) and
+        everything else it held goes free for waiting or growing peers.
+        Returns the rendered ``status.slice_assignment`` at the new
+        width. The caller (the TpuJobController's resize branch) owns
+        the status commit; this only moves fleet state."""
+        keep = set(keep_units)
+        with self._lock:
+            held = self.fleet.assignment(job_uid) or []
+            drop = [u for u in held if u not in keep]
+            freed = self.fleet.release_units(job_uid, drop) if drop else []
+            kept = self.fleet.assignment(job_uid) or list(keep_units)
+            self.metrics_resizes.inc(direction="shrink")
+            self._append(self.resize_log, {
+                "uid": job_uid, "direction": "shrink",
+                "kept": list(kept), "freed": list(freed),
+            })
+            self._refresh_gauges()
+            rendered = Placement.from_units(
+                self.fleet, self.fleet.unit(kept[0]).slice_type,
+                kept).render()
+        with self.tracer.span(
+            "schedule.shrink",
+            attrs={"job_uid": job_uid, "kept": len(kept),
+                   "freed": len(freed)},
+        ):
+            pass
+        return rendered
+
+    def try_grow(self, job, *, jobs: Optional[List] = None) -> Optional[str]:
+        """Partial grow — extend an under-sized elastic gang toward
+        ``max_slices`` out of free capacity. Fairness rule ("never past
+        fair placement"): growth never outruns same-or-higher-priority
+        queued demand — while a same-type gang at priority >= the
+        grower's waits unplaced, its claim on the free units wins. A
+        grower MAY grow past strictly-lower-priority queue (consistent
+        with the preemption order: the scheduler would hand it those
+        units by evicting the lower class anyway). Without a ``jobs``
+        list the check degrades to "any pending gang at all" (fail
+        closed). Returns the rendered assignment at the new width, or
+        None (nothing to grow / no fit / queue first)."""
+        el = getattr(job.spec, "elastic", None)
+        if el is None:
+            return None
+        uid = job.metadata.uid
+        st = job.spec.slice_type
+        with self._lock:
+            held = self.fleet.assignment(uid)
+            if not held:
+                return None
+            ceiling = el.max_slices
+            cap = self._grow_caps.get(uid)
+            if cap is not None:
+                ceiling = min(ceiling, cap)
+            want = ceiling - len(held)
+            if want <= 0:
+                return None
+            if jobs is None:
+                if self._pending_since:
+                    return None
+            else:
+                by_uid = {j.metadata.uid: j for j in jobs}
+                for pending_uid in self._pending_since:
+                    other = by_uid.get(pending_uid)
+                    if other is None:
+                        continue
+                    if other.status.phase in _TERMINAL:
+                        continue
+                    if other.spec.slice_type == st \
+                            and other.spec.priority >= job.spec.priority:
+                        return None
+            grown = None
+            for k in range(want, 0, -1):
+                grown = self.engine.find(st, k)
+                if grown is not None:
+                    break
+            if grown is None:
+                return None
+            self.fleet.extend(uid, grown.unit_uids)
+            all_units = self.fleet.assignment(uid) or []
+            self.metrics_resizes.inc(direction="grow")
+            self._append(self.resize_log, {
+                "uid": uid, "direction": "grow",
+                "added": list(grown.unit_uids), "kept": list(all_units),
+            })
+            self._refresh_gauges()
+            rendered = Placement.from_units(
+                self.fleet, st, all_units).render()
+        with self.tracer.span(
+            "schedule.grow",
+            attrs={
+                "job": f"{job.metadata.namespace}/{job.metadata.name}",
+                "added": len(grown.unit_uids), "width": len(all_units),
+                "max_slices": el.max_slices,
+            },
+        ):
+            pass
+        return rendered
 
     # ----------------- the decision -----------------
 
@@ -221,6 +354,18 @@ class GangScheduler:
             if placement is None and self.policy == "priority":
                 placement, victims = self._try_preempt(job, jobs or [],
                                                        api, recorder)
+            if placement is None and job.spec.elastic is not None:
+                # Shrink-to-fit placement (ISSUE 11): an elastic gang
+                # prefers running narrower NOW over queueing for its
+                # full width — take the widest width in
+                # [min_slices, num_slices) the free capacity offers.
+                # No preemption at reduced widths: eviction is only
+                # ever justified by the full request.
+                for w in range(n - 1,
+                               job.spec.elastic.min_slices - 1, -1):
+                    placement = self.engine.find(st, w)
+                    if placement is not None:
+                        break
             if placement is None:
                 # Queue-age surface: every blocked attempt observes how
                 # long this gang has already waited — the aging signal
@@ -407,4 +552,5 @@ class GangScheduler:
                 "placements": len(self.placement_log),
                 "preemptions": len(self.preemption_log),
                 "defrag_migrations": len(self.defrag_log),
+                "resizes": len(self.resize_log),
             }

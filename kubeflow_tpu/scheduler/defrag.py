@@ -19,12 +19,20 @@ Guard rails against thrash:
 - a move must improve fragmentation by ``min_gain`` — simulated against
   the fleet BEFORE any pod is touched; migrations that merely shuffle
   are rejected.
+
+Elastic gangs (ISSUE 11) offer a cheaper move: **shrinking** one —
+freeing its most fragmentation-relieving slice through the same eviction
+seam — costs the gang only the recompute since its last checkpoint save
+(a resize, zero-downtime), where migrating costs a full gang restart.
+``_pick_migration`` simulates both through the one
+``Fleet.fragmentation(freed=, taken=)`` what-if and prefers the shrink
+whenever it clears ``min_gain``.
 """
 
 from __future__ import annotations
 
 import time
-from typing import List, Optional, Set
+from typing import Dict, List, Optional
 
 from kubeflow_tpu.controlplane.runtime import EventRecorder, Result
 from kubeflow_tpu.controlplane.runtime.reconciler import Controller
@@ -62,8 +70,18 @@ class DefragController(Controller):
             "kftpu_scheduler_defrag_migrations_total",
             "Restartable gangs migrated to consolidate free slices",
         )
+        self.metrics_shrinks = registry.counter(
+            "kftpu_scheduler_defrag_shrinks_total",
+            "Elastic gangs shrunk (instead of migrated) to consolidate "
+            "free slices",
+        )
         self._last_pass = 0.0            # monotonic; 0 = never
-        self._migrating: Set[str] = set()  # job uids evicted, not yet back
+        # In-flight moves: job uid -> None for a migration (settles on
+        # any re-placement) or, for a shrink,
+        # (expected_width, lifecycle_events_at_mark) — the events half
+        # lets the marker settle even when the eviction resolved as a
+        # restart instead of the intended shrink.
+        self._migrating: Dict[str, Optional[tuple]] = {}
 
     def map_to_primary(self, obj):
         # Any TpuJob transition may change fragmentation; reconcile under
@@ -88,14 +106,31 @@ class DefragController(Controller):
         return Result()
 
     def _settle_migrations(self, jobs) -> None:
-        """Drop in-flight markers for gangs that re-placed or ended."""
+        """Drop in-flight markers for gangs whose move landed or that
+        ended. A migration (``expected is None``) settles on any
+        re-placement; a shrink settles when the assignment reaches the
+        expected width OR the gang's lifecycle counters moved past the
+        mark — the eviction may legitimately resolve as a restart
+        instead (coincident crash, survivors below min_slices), and a
+        marker that only ever waits for the shrunk width would wedge
+        the sweep for that job's lifetime."""
         by_uid = {j.metadata.uid: j for j in jobs}
-        for uid in list(self._migrating):
+        for uid, expected in list(self._migrating.items()):
             job = by_uid.get(uid)
             if job is None or job.status.phase in ("Succeeded", "Failed"):
-                self._migrating.discard(uid)
-            elif self.scheduler.assignment_of(uid) is not None:
-                self._migrating.discard(uid)
+                self._migrating.pop(uid, None)
+                continue
+            held = self.scheduler.assignment_of(uid)
+            if expected is None:
+                if held is not None:
+                    self._migrating.pop(uid, None)
+                continue
+            exp_width, marked_events = expected
+            events = (job.status.resizes + job.status.preemptions
+                      + job.status.restarts)
+            if events > marked_events or (
+                    held is not None and len(held) <= exp_width):
+                self._migrating.pop(uid, None)
 
     def sweep(self) -> int:
         """One defragmentation pass; returns gangs migrated."""
@@ -105,6 +140,7 @@ class DefragController(Controller):
             return 0            # let the previous move land first
         migrated = 0
         for slice_type in self.scheduler.fleet.slice_types():
+            self._maybe_uncap(jobs, slice_type)
             if migrated >= self.max_migrations_per_pass:
                 break
             frag = self.scheduler.fleet.fragmentation(slice_type)
@@ -113,20 +149,54 @@ class DefragController(Controller):
             move = self._pick_migration(jobs, slice_type, frag)
             if move is None:
                 continue
-            victim, gain = move
-            hit = preempt_mod.preempt_gang(self.api, victim)
-            if hit == 0:
-                continue        # gang mid-transition; next sweep retries
-            self.scheduler.release(victim.metadata.uid)
-            self._migrating.add(victim.metadata.uid)
-            self.metrics_migrations.inc()
+            victim, gain, kind, shrink_unit = move
+            held = self.scheduler.assignment_of(victim.metadata.uid) or []
+            if kind == "shrink":
+                # The cheaper move (ISSUE 11): free ONE slice of an
+                # elastic gang through the same eviction seam — the
+                # TpuJobController's resize branch turns the marked
+                # group into a zero-downtime shrink (a resize, only the
+                # recompute since the last save lost), where a
+                # migration costs the victim a full gang restart.
+                gidx = held.index(shrink_unit)
+                group = f"{victim.metadata.name}-{gidx}"
+                hit = preempt_mod.preempt_slice_group(
+                    self.api, victim, group)
+                if hit == 0:
+                    continue    # group mid-transition; next sweep retries
+                self._migrating[victim.metadata.uid] = (
+                    len(held) - 1,
+                    victim.status.resizes + victim.status.preemptions
+                    + victim.status.restarts,
+                )
+                # Hold the gang at the shrunk width: the
+                # ElasticController regrowing onto the freed unit would
+                # undo the heal and thrash the pair forever. Lifted by
+                # _maybe_uncap once a simulated regrow stays under the
+                # threshold.
+                self.scheduler.cap_growth(victim.metadata.uid,
+                                          len(held) - 1)
+                self.metrics_shrinks.inc()
+                reason, event_reason = "shrink", "DefragShrink"
+                detail = (f"shrinking (freeing {shrink_unit}) to "
+                          f"consolidate {slice_type} free slices")
+            else:
+                hit = preempt_mod.preempt_gang(self.api, victim)
+                if hit == 0:
+                    continue    # gang mid-transition; next sweep retries
+                self.scheduler.release(victim.metadata.uid)
+                self._migrating[victim.metadata.uid] = None
+                self.metrics_migrations.inc()
+                reason, event_reason = "defrag", "DefragMigration"
+                detail = (f"migrating to consolidate {slice_type} free "
+                          "slices")
             self.scheduler._append(self.scheduler.defrag_log, {
                 "victim": victim.metadata.name,
                 "victim_uid": victim.metadata.uid,
                 "slice_type": slice_type,
                 "fragmentation_before": round(frag, 4),
                 "expected_gain": round(gain, 4),
-                "pods": hit, "reason": "defrag",
+                "pods": hit, "reason": reason,
             })
             with self.tracer.span(
                 "schedule.defrag",
@@ -136,27 +206,72 @@ class DefragController(Controller):
                     "slice_type": slice_type,
                     "fragmentation": round(frag, 4),
                     "expected_gain": round(gain, 4),
-                    "pods": hit,
+                    "pods": hit, "move": reason,
                 },
             ):
                 pass
             self.recorder.event(
-                victim, "Normal", "DefragMigration",
-                f"migrating to consolidate {slice_type} free slices "
-                f"(fragmentation {frag:.2f}, expected gain {gain:.2f}); "
-                "resuming from checkpoint",
+                victim, "Normal", event_reason,
+                f"{detail} (fragmentation {frag:.2f}, expected gain "
+                f"{gain:.2f}); resuming from checkpoint",
             )
             migrated += 1
         return migrated
+
+    def _maybe_uncap(self, jobs, slice_type: str) -> None:
+        """Lift defrag growth caps whose reason has passed: a capped
+        gang may grow again once the units a regrow would take leave
+        fragmentation at or under the threshold (hysteresis — uncapping
+        on the raw gauge alone would re-shatter the heal and loop)."""
+        fleet = self.scheduler.fleet
+        for j in jobs:
+            el = j.spec.elastic
+            if el is None or j.spec.slice_type != slice_type:
+                continue
+            uid = j.metadata.uid
+            cap = self.scheduler.growth_cap(uid)
+            if cap is None:
+                continue
+            held = fleet.assignment(uid)
+            if held is None:
+                self.scheduler.uncap_growth(uid)  # released/restarted
+                continue
+            want = el.max_slices - len(held)
+            if want <= 0:
+                self.scheduler.uncap_growth(uid)
+                continue
+            sim = None
+            for k in range(want, 0, -1):
+                sim = self.scheduler.engine.find(slice_type, k)
+                if sim is not None:
+                    break
+            if sim is None:
+                continue        # nothing to take anyway; cap is idle
+            if fleet.fragmentation(
+                    slice_type,
+                    taken=set(sim.unit_uids)) <= self.threshold:
+                self.scheduler.uncap_growth(uid)
 
     # ----------------- simulation -----------------
 
     def _pick_migration(self, jobs, slice_type: str,
                         frag: float) -> Optional[tuple]:
-        """The cheapest restartable gang whose best-fit re-placement
-        improves fragmentation by at least ``min_gain``. Candidates in
+        """The cheapest move that improves fragmentation by at least
+        ``min_gain``, simulated through the one
+        ``Fleet.fragmentation(freed=, taken=)`` what-if. Candidates in
         eviction-cost order (lowest priority, smallest gang) — defrag
-        must never move the most important work first."""
+        must never move the most important work first. Per candidate,
+        two moves compete:
+
+        - **shrink** (elastic gangs above ``min_slices`` only): free the
+          single held unit whose release best heals the free space —
+          costs the gang a resize (recompute since last save, zero
+          downtime), so whenever it clears ``min_gain`` it wins;
+        - **migrate**: evict the whole gang to its best-fit re-placement
+          — a full restart from checkpoint.
+
+        Returns ``(job, gain, kind, shrink_unit)`` (``shrink_unit`` is
+        None for migrations) or None."""
         fleet = self.scheduler.fleet
         candidates: List = [
             j for j in jobs
@@ -164,16 +279,54 @@ class DefragController(Controller):
             and j.spec.preemption_policy == "restart"
             and j.status.phase in preempt_mod.PREEMPTIBLE_PHASES
             and fleet.assignment(j.metadata.uid)
+            # A growth-capped gang is defrag's OWN recent shrink —
+            # moving it again before the cap lifts is thrash by
+            # another name.
+            and self.scheduler.growth_cap(j.metadata.uid) is None
         ]
         candidates.sort(key=lambda j: (
             j.spec.priority,
             len(fleet.assignment(j.metadata.uid) or []),
             j.metadata.namespace, j.metadata.name,
         ))
+        # Pass 1 — the cheap verb: ANY elastic gang whose single-unit
+        # shrink clears min_gain beats every migration (recompute-only
+        # cost vs a full gang restart), so the shrink scan runs over
+        # all candidates before a single migration is considered.
         for job in candidates:
-            held = set(fleet.assignment(job.metadata.uid) or [])
-            target = self.scheduler.engine.find(
-                slice_type, job.spec.num_slices, extra_free=held)
+            el = job.spec.elastic
+            held_list = fleet.assignment(job.metadata.uid) or []
+            if el is None or len(held_list) <= el.min_slices:
+                continue
+            best_unit, best_gain = None, 0.0
+            for u in held_list:
+                gain = frag - fleet.fragmentation(slice_type, freed={u})
+                if gain > best_gain:
+                    best_unit, best_gain = u, gain
+            if best_unit is not None and best_gain >= self.min_gain:
+                return (job, best_gain, "shrink", best_unit)
+        # Pass 2 — migrations, cheapest victim first. The simulated
+        # re-placement mirrors what the restart path will ACTUALLY do:
+        # an evicted elastic gang resets to spec width and shrink-to-fit
+        # re-places (widest fit from num_slices down to min_slices), a
+        # fixed gang re-places at spec width — simulating the current
+        # (shrunk) width would under-count the units the move takes and
+        # could execute a negative-gain migration.
+        for job in candidates:
+            held_list = fleet.assignment(job.metadata.uid) or []
+            held = set(held_list)
+            el = job.spec.elastic
+            target = None
+            if el is not None:
+                for w in range(job.spec.num_slices,
+                               el.min_slices - 1, -1):
+                    target = self.scheduler.engine.find(
+                        slice_type, w, extra_free=held)
+                    if target is not None:
+                        break
+            else:
+                target = self.scheduler.engine.find(
+                    slice_type, job.spec.num_slices, extra_free=held)
             if target is None:
                 continue
             new_units = set(target.unit_uids)
@@ -182,5 +335,5 @@ class DefragController(Controller):
             new_frag = fleet.fragmentation(
                 slice_type, freed=held, taken=new_units)
             if frag - new_frag >= self.min_gain:
-                return (job, frag - new_frag)
+                return (job, frag - new_frag, "migrate", None)
         return None

@@ -265,3 +265,43 @@ class Fleet:
                 if u is not None and u.job == job_uid:
                     u.job = None
             return unit_uids
+
+    def release_units(self, job_uid: str,
+                      unit_uids: Sequence[str]) -> List[str]:
+        """Partial release (elastic shrink): free only ``unit_uids`` out
+        of the job's assignment, keeping the rest — the resize verb's
+        fleet half. Idempotent per unit; releasing everything a job holds
+        degrades to :meth:`release`. Returns the unit uids actually
+        freed."""
+        with self._lock:
+            held = self._assignments.get(job_uid)
+            if not held:
+                return []
+            drop = [u for u in unit_uids if u in held]
+            for uid in drop:
+                held.remove(uid)
+                u = self._by_uid.get(uid)
+                if u is not None and u.job == job_uid:
+                    u.job = None
+            if not held:
+                self._assignments.pop(job_uid, None)
+            return drop
+
+    def extend(self, job_uid: str, unit_uids: Sequence[str]) -> None:
+        """Partial allocate (elastic grow): append free units to an
+        EXISTING assignment. Raises when a unit is held by another job or
+        the job holds nothing to extend."""
+        with self._lock:
+            held = self._assignments.get(job_uid)
+            if held is None:
+                raise ValueError(
+                    f"job {job_uid} holds no assignment to extend")
+            units = [self._by_uid[u] for u in unit_uids]
+            for u in units:
+                if u.job is not None and u.job != job_uid:
+                    raise ValueError(
+                        f"unit {u.uid} already assigned to {u.job}")
+            for u in units:
+                u.job = job_uid
+                if u.uid not in held:
+                    held.append(u.uid)

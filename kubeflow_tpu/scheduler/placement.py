@@ -49,6 +49,20 @@ class Placement:
         return (f"{self.slice_type}x{len(self.unit_uids)} @ "
                 + ",".join(self.unit_uids))
 
+    @classmethod
+    def from_units(cls, fleet: "Fleet", slice_type: str,
+                   unit_uids: Sequence[str]) -> "Placement":
+        """Re-derive a Placement from a concrete unit set — the one
+        recipe for re-rendering a resized assignment (shrink, grow,
+        drift repair) so pool derivation can never diverge between
+        call sites."""
+        units = list(unit_uids)
+        return cls(
+            slice_type=slice_type,
+            unit_uids=units,
+            pools=sorted({fleet.unit(u).pool for u in units}),
+        )
+
 
 def parse_assignment(s: str) -> Optional[List[str]]:
     """Unit uids out of a rendered assignment; None for legacy or empty

@@ -60,6 +60,16 @@ Stages (any failure exits non-zero — the merge gate contract):
    checkpoint-cadence model on, adding: goodput conservation (exact),
    non-vacuous rollback attribution, and a non-empty
    kftpu_scheduler_queue_age_seconds histogram (``--skip-schedule``).
+8c. **elastic-smoke**: the seeded capacity-oscillation soak (ISSUE 11)
+   — preemptor bursts shrink elastic gangs, the ElasticController grows
+   them back as units free. Gates (counts, never wall-clock): every
+   gang converges Succeeded; ZERO restart budget and ZERO
+   preemption-restarts consumed (every burst became a resize); the
+   fleet actually oscillated (shrinks AND grows non-zero, width dropped
+   to the floor); checkpoint steps advance monotonically
+   (``resumed_from_step`` never regresses, disk ends ahead of the last
+   resume); goodput ledger conservation-exact with every resize
+   attributed (``--skip-elastic``).
 9. **bench-gate**: if --bench-json is given, require
    ``vs_baseline >= --min-vs-baseline`` for every record — the perf
    regression gate SURVEY §7.8 prescribes.
@@ -482,6 +492,52 @@ def run_schedule_smoke(seed: int = 20260803, num_jobs: int = 30) -> None:
         )
 
 
+def run_elastic_smoke(seed: int = 20260803) -> None:
+    """Elastic-gang smoke (ISSUE 11): the seeded capacity-oscillation
+    soak. All gates are counts and integer tick sums — never wall-clock
+    (see run_elastic_soak's contract)."""
+    from kubeflow_tpu.chaos import run_elastic_soak
+
+    rep = run_elastic_soak(seed=seed)
+    tag = f"seed={seed}"
+    if not rep.converged:
+        raise GateFailure(
+            f"elastic-smoke ({tag}): stuck jobs after {rep.rounds} "
+            f"rounds: {rep.stuck_jobs()}")
+    if not rep.all_succeeded:
+        raise GateFailure(
+            f"elastic-smoke ({tag}): jobs failed: {rep.phases}")
+    if rep.restarts_consumed or rep.preemption_restarts:
+        raise GateFailure(
+            f"elastic-smoke ({tag}): preemption bursts leaked into the "
+            f"restart machinery — restarts={rep.restarts_consumed} "
+            f"preemption_restarts={rep.preemption_restarts} (every "
+            "burst must become a resize)")
+    if rep.bursts == 0 or rep.shrinks == 0 or rep.grows == 0:
+        raise GateFailure(
+            f"elastic-smoke ({tag}): oscillation vacuous — "
+            f"bursts={rep.bursts} shrinks={rep.shrinks} "
+            f"grows={rep.grows}")
+    if rep.min_width_observed != 1:
+        raise GateFailure(
+            f"elastic-smoke ({tag}): gangs never shrank to the "
+            f"min_slices floor (narrowest width {rep.min_width_observed})")
+    if not rep.checkpoint_steps_monotone:
+        raise GateFailure(
+            f"elastic-smoke ({tag}): checkpoint steps regressed — "
+            f"resumed_from_step went backwards or disk ended behind the "
+            f"last resume ({rep.final_steps})")
+    if not rep.goodput_conserved:
+        raise GateFailure(
+            f"elastic-smoke ({tag}): goodput conservation broken: "
+            f"{rep.goodput}")
+    attributed = rep.goodput["interruptions"].get("resize", 0)
+    if attributed != rep.resizes:
+        raise GateFailure(
+            f"elastic-smoke ({tag}): {rep.resizes} resizes in status "
+            f"but the ledger attributed {attributed}")
+
+
 def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
              skip_smoke: bool = False, skip_chaos: bool = False,
              chaos_seed: int = 20260803, chaos_latency_s: float = 0.0,
@@ -490,7 +546,8 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
              skip_obs: bool = False,
              skip_shard: bool = False,
              skip_serve: bool = False,
-             skip_schedule: bool = False) -> List[str]:
+             skip_schedule: bool = False,
+             skip_elastic: bool = False) -> List[str]:
     """Run all stages; returns the list of passed stages, raises
     GateFailure on the first failing one."""
     passed: List[str] = []
@@ -595,6 +652,11 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
         run_schedule_smoke(seed=chaos_seed)
         passed.append("schedule-smoke")
 
+    if not skip_elastic:
+        _stage("elastic-smoke")
+        run_elastic_smoke(seed=chaos_seed)
+        passed.append("elastic-smoke")
+
     if not skip_serve:
         _stage("serve-bench-smoke")
         run_serve_bench_smoke()
@@ -653,6 +715,8 @@ def main(argv=None) -> int:
                         "drain-path soak smokes")
     g.add_argument("--skip-schedule", action="store_true",
                    help="skip the gang-scheduler storm smoke")
+    g.add_argument("--skip-elastic", action="store_true",
+                   help="skip the elastic capacity-oscillation soak smoke")
     args = p.parse_args(argv)
     try:
         passed = run_gate(
@@ -668,6 +732,7 @@ def main(argv=None) -> int:
             skip_shard=args.skip_shard,
             skip_serve=args.skip_serve,
             skip_schedule=args.skip_schedule,
+            skip_elastic=args.skip_elastic,
         )
     except GateFailure as e:
         print(f"[ci] FAIL: {e}", file=sys.stderr)

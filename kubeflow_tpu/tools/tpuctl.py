@@ -315,6 +315,65 @@ def cmd_queue(args) -> int:
     return 0
 
 
+def cmd_jobs(args) -> int:
+    """TpuJob fleet view with elastic drill-down (ISSUE 11): current vs
+    spec width, declared [min..max] bounds, resize/preemption/restart
+    tallies, and — when the goodput ledger runs — the slice-seconds each
+    elastic gang saved vs the restart counterfactual (productive work
+    done at reduced width that a restart-only job would have spent
+    queued; docs/elastic.md)."""
+    saved_by_job = {}
+    if args.backend == "kubectl":
+        jobs = _kubectl_api(args).list("TpuJob", namespace=args.namespace)
+    else:
+        platform = _load_platform(args)
+        platform.reconcile()
+        jobs = platform.api.list("TpuJob", namespace=args.namespace,
+                                 copy=False)
+        if platform.goodput is not None:
+            snap = platform.goodput.snapshot()
+            saved_by_job = {
+                key: (j.get("counterfactual_saved_s", 0.0),
+                      j.get("resizes", 0))
+                for key, j in snap.get("jobs", {}).items()
+            }
+    rows = []
+    for job in sorted(jobs, key=lambda j: (j.metadata.namespace,
+                                           j.metadata.name)):
+        el = job.spec.elastic
+        cur = job.status.current_slices or job.spec.num_slices
+        key = f"{job.metadata.namespace}/{job.metadata.name}"
+        rows.append({
+            "namespace": job.metadata.namespace,
+            "name": job.metadata.name,
+            "phase": job.status.phase,
+            "slices": (f"{cur}/{job.spec.num_slices}" if el is not None
+                       else str(job.spec.num_slices)),
+            "elastic": (f"{el.min_slices}..{el.max_slices}"
+                        if el is not None else "-"),
+            "resizes": job.status.resizes,
+            "preemptions": job.status.preemptions,
+            "restarts": job.status.restarts,
+            "resumed_step": job.status.resumed_from_step,
+            "saved_s": round(saved_by_job.get(key, (0.0, 0))[0], 3),
+            "assignment": job.status.slice_assignment,
+        })
+    if args.output == "json":
+        print(json.dumps(rows, indent=2))
+        return 0
+    if not rows:
+        print("no TpuJobs")
+        return 0
+    fmt = ("{:<12} {:<16} {:<10} {:>7} {:<8} {:>7} {:>8} {:>8} {:>8}")
+    print(fmt.format("NAMESPACE", "NAME", "PHASE", "SLICES", "ELASTIC",
+                     "RESIZES", "PREEMPT", "RESTARTS", "SAVED_S"))
+    for r in rows:
+        print(fmt.format(r["namespace"], r["name"], r["phase"],
+                         r["slices"], r["elastic"], r["resizes"],
+                         r["preemptions"], r["restarts"], r["saved_s"]))
+    return 0
+
+
 def cmd_goodput(args) -> int:
     """Fleet goodput scoreboard (ISSUE 10): of every slice-second the
     hardware offered, how many were productive and where did the rest
@@ -350,12 +409,14 @@ def cmd_goodput(args) -> int:
           f"conservation {'OK' if snap['conserved'] else 'BROKEN'}")
     if snap["jobs"]:
         print()
-        print(f"{'JOB':<28} {'SLICE_S':>10} {'RATIO':>6}  CATEGORIES")
+        print(f"{'JOB':<28} {'SLICE_S':>10} {'RATIO':>6} {'RESIZES':>7} "
+              f"{'SAVED_S':>8}  CATEGORIES")
         for key, j in sorted(snap["jobs"].items()):
             cats = ",".join(f"{c}={s:.3f}s" for c, s in
                             j["categories_s"].items())
             print(f"{key:<28} {j['slice_seconds']:>10.3f} "
-                  f"{j['goodput_ratio']:>6.3f}  {cats}")
+                  f"{j['goodput_ratio']:>6.3f} {j.get('resizes', 0):>7} "
+                  f"{j.get('counterfactual_saved_s', 0.0):>8.3f}  {cats}")
     return 0 if snap["conserved"] else 3
 
 
@@ -726,6 +787,15 @@ def build_parser() -> argparse.ArgumentParser:
     qp.add_argument("-o", "--output", choices=("table", "json"),
                     default="table")
     qp.set_defaults(fn=cmd_queue)
+
+    jp = sub.add_parser(
+        "jobs", help="TpuJob fleet view: elastic width (current/spec, "
+                     "min..max), resizes, and slice-seconds saved vs "
+                     "the restart counterfactual")
+    jp.add_argument("-n", "--namespace", default=None)
+    jp.add_argument("-o", "--output", choices=("table", "json"),
+                    default="table")
+    jp.set_defaults(fn=cmd_jobs)
 
     dp = sub.add_parser("delete", help="delete resources")
     dp.add_argument("-f", "--filename", action="append")
