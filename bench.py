@@ -1011,16 +1011,13 @@ def bench_schedule_elastic(args, jobs: int, fleet: dict) -> None:
 
 
 def bench_serve(args) -> None:
-    """Serving data-plane overload bench (ISSUE 7): the open-loop
-    generator (fixed arrival rate — requests fire on schedule whether or
-    not earlier ones finished, the way real traffic does) at 2x one
-    replica's ANALYTIC capacity (SimServingReplica: max_batch slots x a
-    fixed service time, so capacity is max_batch/service_time_s QPS by
-    construction, not a hardware measurement), through the real
-    ServingLoadBalancer and, in the third run, the real ServingAutoscaler
-    reconciling a real Serving CR.
+    """Serving data-plane overload bench (ISSUE 7 + ISSUE 12): the
+    open-loop generator (fixed arrival rate — requests fire on schedule
+    whether or not earlier ones finished, the way real traffic does) at
+    2x analytic capacity through the real ServingLoadBalancer.
 
-    Three runs answer the overload question:
+    The ISSUE-7 legs (classic fixed-service double, real ServingAutoscaler
+    in the third run) answer the overload question:
 
     1. **no-shed baseline** — the pre-ISSUE-7 plane (unbounded engine
        queue, no LB watermark): the backlog grows without bound and the
@@ -1032,11 +1029,54 @@ def bench_serve(args) -> None:
        toward max_replicas off the scraped queue waits: goodput climbs
        past one replica's capacity toward the offered load.
 
+    The ISSUE-12 legs run ONE seeded variable-length session trace at 2x
+    the DENSE-KV analytic capacity through three decode planes on the
+    same KV budget (token-model SimServingReplica + the production
+    KVBlockAllocator):
+
+    4. **stepbatch** — the pre-ISSUE-12 plane: admission at wave
+       boundaries, every sequence's KV reserved at max_len; batch
+       capacity sized by the longest sequence.
+    5. **continuous-dense** — mid-step admission alone (slots retire and
+       refill between decode chunks), KV still reserved at worst case.
+    6. **continuous-paged** — the full plane: paged block tables sized
+       by actual demand, so concurrency is bounded by total KV blocks
+       against real request sizes.
+
+    Plus the cache-affinity A/B (affine vs blind routing on the same
+    seeded session-replay trace; see run_affinity_bench).
+
     Hard gates (count-based, raise — python -O must not skip them):
-    request accounting sums exactly in every run, every shed carries
-    Retry-After, shedding holds goodput >= 0.7x capacity with zero
-    timeouts, and the autoscaler reaches max_replicas."""
-    from kubeflow_tpu.tools.loadtest import run_serve_bench
+    request accounting sums exactly in every leg; every shed carries
+    Retry-After; the KV-block conservation invariant holds in every
+    token leg (allocated == freed + live, pool exactly partitioned,
+    zero blocks leaked after drain); mid-step admissions are non-zero
+    in the continuous legs and exactly zero in stepbatch; the paged
+    plane beats stepbatch AND the recorded SERVE_BENCH_r07 shed leg
+    (0.961x goodput, 0.17 s p99) on goodput AND TTFT p99; the affinity
+    run shows a hit-rate-driven TTFT separation over blind routing."""
+    from kubeflow_tpu.tools.loadtest import (
+        run_affinity_bench,
+        run_continuous_bench,
+        run_serve_bench,
+    )
+
+    # The recorded ISSUE-7 shed leg (SERVE_BENCH_r07.json): the numbers
+    # the continuous-batching plane must beat on the same 2x-overload
+    # shape — goodput vs capacity AND admitted-tail latency.
+    R07_GOODPUT_VS_CAPACITY = 0.961
+    R07_P99_S = 0.17
+
+    if args.affinity_only:
+        aff = run_affinity_bench(duration_s=args.duration_s)
+        _check_affinity_gates(aff)
+        _emit(
+            "serving_affinity_hit_rate",
+            aff["affine"]["hit_rate"], "fraction",
+            max(aff["blind"]["hit_rate"], 1e-9),
+            **aff,
+        )
+        return
 
     service_time_s = 0.05
     max_batch = 2
@@ -1096,12 +1136,61 @@ def bench_serve(args) -> None:
             f"{scaled['max_replicas']} replicas under 2x overload"
         )
 
+    # --- ISSUE 12: continuous batching + paged KV on one KV budget ----
+    stepbatch = run_continuous_bench(
+        mode="stepbatch", dense_kv=True, duration_s=duration_s)
+    cont_dense = run_continuous_bench(
+        mode="continuous", dense_kv=True, duration_s=duration_s)
+    cont_paged = run_continuous_bench(
+        mode="continuous", dense_kv=False, duration_s=duration_s)
+    for tag, leg in (("stepbatch", stepbatch),
+                     ("continuous-dense", cont_dense),
+                     ("continuous-paged", cont_paged)):
+        _check_token_leg(tag, leg)
+    if stepbatch["midstep_admissions"] != 0:
+        raise SystemExit(
+            f"serve[stepbatch]: {stepbatch['midstep_admissions']} "
+            "mid-step admissions in the step-boundary baseline — the "
+            "contrast is contaminated"
+        )
+    for tag, leg in (("continuous-dense", cont_dense),
+                     ("continuous-paged", cont_paged)):
+        if leg["midstep_admissions"] == 0:
+            raise SystemExit(
+                f"serve[{tag}]: zero mid-step admissions — continuous "
+                "batching never engaged (vacuous run)"
+            )
+    paged_g = cont_paged["goodput_vs_dense_capacity"]
+    paged_p99 = cont_paged["ttft_ok_s"]["p99"]
+    if (paged_g <= stepbatch["goodput_vs_dense_capacity"]
+            or paged_p99 >= stepbatch["ttft_ok_s"]["p99"]):
+        raise SystemExit(
+            f"serve[continuous-paged]: did not beat stepbatch — goodput "
+            f"{paged_g} vs {stepbatch['goodput_vs_dense_capacity']}, "
+            f"ttft p99 {paged_p99} vs {stepbatch['ttft_ok_s']['p99']}"
+        )
+    if paged_g <= R07_GOODPUT_VS_CAPACITY or paged_p99 >= R07_P99_S:
+        raise SystemExit(
+            f"serve[continuous-paged]: did not beat the r07 record — "
+            f"goodput {paged_g} (need > {R07_GOODPUT_VS_CAPACITY}), "
+            f"ttft p99 {paged_p99} (need < {R07_P99_S})"
+        )
+
+    # --- ISSUE 12: cache-affine vs blind routing ----------------------
+    aff = run_affinity_bench(duration_s=duration_s)
+    _check_affinity_gates(aff)
+
     _emit(
         "serving_overload_goodput_vs_capacity",
-        scaled["goodput_vs_capacity"], "x one-replica capacity",
-        # Baseline = the no-shed plane's goodput fraction: vs_baseline is
-        # the goodput factor shedding+autoscaling buys at 2x overload.
-        max(noshed["goodput_vs_capacity"], 1e-9),
+        # Headline: the paged continuous plane's goodput on the dense
+        # plane's capacity denominator, against the recorded r07 shed
+        # leg — what continuous batching + paged KV buy from one KV
+        # budget at 2x overload.
+        cont_paged["goodput_vs_dense_capacity"],
+        "x dense-KV capacity",
+        R07_GOODPUT_VS_CAPACITY,
+        ttft_p99_s=paged_p99,
+        r07_p99_s=R07_P99_S,
         capacity_qps=capacity_qps,
         rate_qps=rate_qps,
         duration_s=duration_s,
@@ -1109,7 +1198,73 @@ def bench_serve(args) -> None:
         noshed=noshed,
         shed=shed,
         autoscale=scaled,
+        stepbatch=stepbatch,
+        continuous_dense=cont_dense,
+        continuous_paged=cont_paged,
+        affinity=aff,
     )
+
+
+def _check_token_leg(tag: str, leg: dict) -> None:
+    """Count gates every ISSUE-12 token leg must clear: exact request
+    accounting, honest sheds, zero errors/timeouts, and the KV-block
+    conservation invariant (raise, not assert)."""
+    if not leg["accounting_ok"]:
+        raise SystemExit(
+            f"serve[{tag}]: accounting broken — offered {leg['offered']}"
+            f" != ok {leg['ok']} + shed {leg['shed']} + timeouts "
+            f"{leg['timeouts']} + errors {leg['errors']}"
+        )
+    if leg["errors"] or leg["timeouts"]:
+        raise SystemExit(
+            f"serve[{tag}]: errors={leg['errors']} "
+            f"timeouts={leg['timeouts']} (must both be 0)"
+        )
+    if leg["shed_with_retry_after"] != leg["shed"]:
+        raise SystemExit(
+            f"serve[{tag}]: {leg['shed'] - leg['shed_with_retry_after']} "
+            f"of {leg['shed']} sheds missing Retry-After"
+        )
+    kv = leg["kv"]
+    if not kv["conservation_ok"] or kv["blocks_leaked"]:
+        raise SystemExit(
+            f"serve[{tag}]: KV-block conservation broken — "
+            f"conservation_ok={kv['conservation_ok']} "
+            f"leaked={kv['blocks_leaked']} "
+            f"(allocated {kv['blocks_allocated_total']} freed "
+            f"{kv['blocks_freed_total']})"
+        )
+
+
+def _check_affinity_gates(aff: dict) -> None:
+    """The cache-affinity A/B's hard gates: exact accounting and
+    conservation in both runs, a count-based hit-rate separation, and
+    the hit-rate-driven TTFT separation (p50: the prefill-skip signal —
+    tails are queue noise at sub-capacity rates)."""
+    for tag in ("affine", "blind"):
+        run = aff[tag]
+        if not run["accounting_ok"]:
+            raise SystemExit(f"affinity[{tag}]: accounting broken: {run}")
+        if run["errors"] or run["timeouts"]:
+            raise SystemExit(
+                f"affinity[{tag}]: errors={run['errors']} "
+                f"timeouts={run['timeouts']}")
+        if not run["kv_conservation_ok"]:
+            raise SystemExit(
+                f"affinity[{tag}]: KV-block conservation broken")
+    if aff["affine"]["hit_rate"] < aff["blind"]["hit_rate"] + 0.1:
+        raise SystemExit(
+            f"affinity: hit-rate separation vacuous — affine "
+            f"{aff['affine']['hit_rate']} vs blind "
+            f"{aff['blind']['hit_rate']} (need >= +0.1)"
+        )
+    if (aff["affine"]["ttft_ok_s"]["p50"]
+            >= aff["blind"]["ttft_ok_s"]["p50"]):
+        raise SystemExit(
+            f"affinity: no TTFT separation — affine p50 "
+            f"{aff['affine']['ttft_ok_s']['p50']} >= blind "
+            f"{aff['blind']['ttft_ok_s']['p50']}"
+        )
 
 
 def bench_longctx(args) -> None:
@@ -1357,6 +1512,11 @@ def main() -> None:
     p.add_argument("--duration-s", type=float, default=5.0,
                    help="serve bench: open-loop generator duration per "
                         "run (offered = 2x capacity x duration)")
+    p.add_argument("--affinity", dest="affinity_only",
+                   action="store_true",
+                   help="serve bench: run ONLY the cache-affinity A/B "
+                        "(affine vs blind routing on the seeded "
+                        "session-replay trace)")
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--gen-len", type=int, default=128)
     p.add_argument("--decode-chunk", type=int, default=32)

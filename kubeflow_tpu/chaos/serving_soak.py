@@ -55,6 +55,12 @@ class _SoakBackend:
         self.reported_queued = 0      # what /healthz claims is queued
         self.requests = 0
         self.misrouted = 0
+        # Sessions this stub has served: reported as resident_prefixes
+        # so the LB's cache-affine scoring runs against REAL hints while
+        # the soak churns the backend set — a stale affinity pin to a
+        # draining/unhealthy backend must lose to eligibility, or the
+        # misrouted counter catches it.
+        self.sessions_seen: List[str] = []
         self._lock = threading.Lock()
         r = Router()
         r.post("/v1/generate", self._generate)
@@ -67,16 +73,26 @@ class _SoakBackend:
             self.requests += 1
             if self.excluded:
                 self.misrouted += 1
+            session = (q.body or {}).get("session")
+            if isinstance(session, str) and session:
+                key = f"s:{session}"
+                if key in self.sessions_seen:
+                    self.sessions_seen.remove(key)
+                self.sessions_seen.append(key)
+                del self.sessions_seen[:-8]
         return {"tokens": [1], "backend": self.name}
 
     def _healthz(self, q: Request):
         # Saturation is injected through the load REPORT, not by real
         # queue pressure: the LB must shed on what the fleet tells it.
+        with self._lock:
+            resident = list(self.sessions_seen)
         return {"ok": True, "load": {
             "queued": self.reported_queued,
             "free_slots": 0,
             "max_queue": self.max_queue,
             "p50_queue_wait_s": 0.05,
+            "resident_prefixes": resident,
         }}
 
     def stop(self):
@@ -96,6 +112,11 @@ class ServingSoakReport:
     drains: int = 0
     saturations: int = 0
     served_by: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # Cache-affinity traffic (ISSUE 12): every soak request carries a
+    # session key, so the routing invariants above hold WHILE the LB's
+    # affinity map and resident-prefix hints chase a churning fleet.
+    affinity_hits: int = 0
+    affinity_rerouted: int = 0
 
     @property
     def accounting_ok(self) -> bool:
@@ -127,10 +148,11 @@ def run_serving_soak(
     front = JsonHttpServer(lb.router(), port=0).start()
     url = f"http://127.0.0.1:{front.port}/v1/generate"
     rep = ServingSoakReport()
-    body = json.dumps({"tokens": [1]}).encode()
 
-    def fire(results: List[tuple]):
+    def fire(results: List[tuple], session: str):
         try:
+            body = json.dumps({"tokens": [1],
+                               "session": session}).encode()
             req = urllib.request.Request(
                 url, data=body,
                 headers={"Content-Type": "application/json"})
@@ -206,8 +228,11 @@ def run_serving_soak(
             sync_excluded()
 
             results: List[tuple] = []
-            threads = [threading.Thread(target=fire, args=(results,))
-                       for _ in range(requests_per_round)]
+            # A small session pool: repeats within and across rounds, so
+            # the affinity map holds live pins while backends churn.
+            threads = [threading.Thread(
+                target=fire, args=(results, f"soak-{(rnd + i) % 4}"))
+                for i in range(requests_per_round)]
             for t in threads:
                 t.start()
             for t in threads:
@@ -233,4 +258,6 @@ def run_serving_soak(
         for b in fleet:
             b.stop()
     rep.misrouted = sum(b.misrouted for b in fleet)
+    rep.affinity_hits = lb.affinity_hits
+    rep.affinity_rerouted = lb.affinity_rerouted
     return rep

@@ -325,6 +325,14 @@ class ServingSpec:
     # 429 + Retry-After (0 = unbounded, the pre-PR-7 behaviour). The
     # depth watermark the LB's saturation shedding keys off.
     max_queue: int = 64
+    # Paged KV-cache slots (ISSUE 12, serving/blocks.py): block size in
+    # token positions and total pool size. 0 = engine defaults (block 16;
+    # pool = max_batch x ceil(max_len / block) — the dense equivalent).
+    # Sizing kv_blocks BELOW the dense equivalent oversubscribes slots
+    # against actual request demand: admission then throttles on the
+    # block free list instead of max_batch x max_len.
+    kv_block_size: int = 0
+    kv_blocks: int = 0
     decode_chunk: int = 8               # tokens per device dispatch
     # Engine compute/memory knobs (serving.engine.ServingConfig): int8
     # weight-only quantization is what lets an 8B model fit a 16G chip.

@@ -238,6 +238,19 @@ class ServingController(Controller):
             return f"pipeline_depth must be >= 0, got {sv.spec.pipeline_depth}"
         if sv.spec.max_queue < 0:
             return f"max_queue must be >= 0, got {sv.spec.max_queue}"
+        if sv.spec.kv_block_size < 0:
+            return (f"kv_block_size must be >= 0, "
+                    f"got {sv.spec.kv_block_size}")
+        if sv.spec.kv_blocks < 0:
+            return f"kv_blocks must be >= 0, got {sv.spec.kv_blocks}"
+        if sv.spec.kv_blocks:
+            block = sv.spec.kv_block_size or 16
+            if sv.spec.kv_blocks * block < sv.spec.max_len:
+                return (
+                    f"kv_blocks {sv.spec.kv_blocks} x block "
+                    f"{block} = {sv.spec.kv_blocks * block} tokens "
+                    f"cannot hold even one max_len={sv.spec.max_len} "
+                    "sequence — nothing could ever admit")
         a = sv.spec.autoscale
         if a is not None:
             if a.min_replicas < 1:
@@ -294,6 +307,14 @@ class ServingController(Controller):
         if sv.spec.max_queue:
             env.append(EnvVar("KFTPU_SERVING_MAX_QUEUE",
                               str(sv.spec.max_queue)))
+        # Paged KV-cache sizing (ISSUE 12): only when set, so existing
+        # pods keep the engine's dense-equivalent defaults untouched.
+        if sv.spec.kv_block_size:
+            env.append(EnvVar("KFTPU_SERVING_KV_BLOCK_SIZE",
+                              str(sv.spec.kv_block_size)))
+        if sv.spec.kv_blocks:
+            env.append(EnvVar("KFTPU_SERVING_KV_BLOCKS",
+                              str(sv.spec.kv_blocks)))
         # Engine knobs ride the env contract only when set so existing
         # pods (and their drift contract) are untouched by the defaults.
         if sv.spec.quantize:

@@ -7,6 +7,12 @@ code designed for TPU decode: one compiled decode step over a fixed slot
 batch, per-slot KV-cache indices, bucketed prefill compiles.
 """
 
+from kubeflow_tpu.serving.blocks import (
+    BlockAccountingError,
+    BlocksExhausted,
+    KVBlockAllocator,
+    prefix_key,
+)
 from kubeflow_tpu.serving.engine import (
     EngineOverloaded,
     GenerationRequest,
@@ -18,12 +24,16 @@ from kubeflow_tpu.serving.lb import ServingLBServer, ServingLoadBalancer
 from kubeflow_tpu.serving.server import ServingServer
 
 __all__ = [
+    "BlockAccountingError",
+    "BlocksExhausted",
     "EngineOverloaded",
     "GenerationRequest",
     "GenerationResult",
+    "KVBlockAllocator",
     "ServingConfig",
     "ServingEngine",
     "ServingLBServer",
     "ServingLoadBalancer",
     "ServingServer",
+    "prefix_key",
 ]

@@ -1,0 +1,264 @@
+"""Paged KV-cache block allocator: the capacity ledger of the decode loop.
+
+The engine's KV memory is carved into fixed-size blocks
+(``block_size`` token positions each). Every admitted sequence holds a
+**block table** — the list of block ids backing its KV rows — allocated
+from one free list at admission and returned at retirement. Batch
+capacity is therefore bounded by *total KV blocks against actual
+per-request demand* (prompt + requested decode length), not by
+``max_batch × max_len``: a fleet of short requests packs many sequences
+into the same block budget one long request would monopolise.
+
+Accounting follows the goodput-ledger discipline (obs/goodput.py): every
+count is an integer, and the conservation invariant
+
+    blocks_allocated_total == blocks_freed_total + blocks_live
+
+is checked structurally — ``check_conservation`` additionally proves the
+free list and the live tables partition the block id space exactly
+(no block leaked, none resident in two tables, none both free and live).
+A double free or a free of an unknown sequence raises
+``BlockAccountingError`` instead of silently corrupting the free list:
+use-after-free across the retire/admit race is an invariant violation,
+never a shrug.
+
+Shared by the real ``ServingEngine`` (admission gating + load reports)
+and the bench's ``SimServingReplica`` double (tools/loadtest.py), so the
+conservation gate in ``bench.py serve`` exercises the same ledger the
+production engine runs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, List, Optional, Sequence
+
+#: Token positions hashed into a prefix key: long enough to tell system
+#: prompts apart, short enough that one hash covers every turn of a
+#: session sharing the same preamble.
+PREFIX_KEY_TOKENS = 32
+
+
+def prefix_key(tokens: Sequence[int], n: int = PREFIX_KEY_TOKENS) -> str:
+    """Stable identity of a prompt's shared head (system prompt, session
+    preamble): the cache-affinity key the LB scores dispatch on and the
+    engine reports as a resident-prefix hint. Hashes the FIRST ``n``
+    token ids — two prompts sharing their head share the key, so a
+    routed repeat lands where those KV blocks already live."""
+    h = hashlib.sha1(
+        ",".join(str(int(t)) for t in tokens[:n]).encode()
+    ).hexdigest()
+    return f"p:{h[:12]}"
+
+
+def blocks_for_tokens(tokens: int, block_size: int) -> int:
+    """Blocks covering ``tokens`` KV positions (ceil division; a
+    zero-token request still pins one block — every admitted sequence
+    owns at least its first page). THE one sizing rule: pool sizing
+    (dense equivalents) and per-sequence accounting must round the same
+    way or capacity math drifts from the ledger."""
+    return max(1, -(-int(tokens) // int(block_size)))
+
+
+class BlockAccountingError(RuntimeError):
+    """A free-list invariant was violated (double free, unknown sequence,
+    conservation breach). Always a bug in the caller or the allocator —
+    never expected under load."""
+
+
+class BlocksExhausted(RuntimeError):
+    """alloc() refused: the free list cannot cover the request. Expected
+    under load — the admission layer's signal to keep the request
+    queued until a retirement returns blocks."""
+
+
+class KVBlockAllocator:
+    """Fixed-size KV block pool with per-sequence block tables and exact
+    alloc/free accounting. Thread-safe: the engine driver thread and the
+    HTTP/load-report threads may touch it concurrently."""
+
+    def __init__(self, total_blocks: int, block_size: int):
+        if total_blocks <= 0:
+            raise ValueError(f"total_blocks must be > 0, got {total_blocks}")
+        if block_size <= 0:
+            raise ValueError(f"block_size must be > 0, got {block_size}")
+        self.total_blocks = int(total_blocks)
+        self.block_size = int(block_size)
+        # LIFO free list: recently-freed blocks are re-used first (their
+        # rows are the ones most likely still warm in HBM/cache).
+        self._free: List[int] = list(range(self.total_blocks - 1, -1, -1))
+        self._tables: Dict[object, List[int]] = {}
+        self._lock = threading.Lock()
+        # Cumulative ledger counters (ints, monotone): the conservation
+        # invariant is allocated == freed + live at every instant.
+        self.blocks_allocated_total = 0
+        self.blocks_freed_total = 0
+        self.high_water_blocks = 0
+
+    # ------------- sizing -------------
+
+    def blocks_for_tokens(self, tokens: int) -> int:
+        """This pool's sizing of ``tokens`` positions (see the module
+        function)."""
+        return blocks_for_tokens(tokens, self.block_size)
+
+    # ------------- queries -------------
+
+    @property
+    def blocks_live(self) -> int:
+        with self._lock:
+            return sum(len(t) for t in self._tables.values())
+
+    @property
+    def blocks_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def sequences_live(self) -> int:
+        with self._lock:
+            return len(self._tables)
+
+    def table(self, seq_id) -> Optional[List[int]]:
+        with self._lock:
+            t = self._tables.get(seq_id)
+            return list(t) if t is not None else None
+
+    def can_alloc(self, tokens: int) -> bool:
+        with self._lock:
+            return self.blocks_for_tokens(tokens) <= len(self._free)
+
+    # ------------- mutation -------------
+
+    def alloc(self, seq_id, tokens: int) -> List[int]:
+        """Claim the blocks covering ``tokens`` positions for ``seq_id``.
+        Raises BlocksExhausted when the free list cannot cover it (the
+        request stays queued) and BlockAccountingError when the sequence
+        already holds a table (an admit/retire bookkeeping bug)."""
+        n = self.blocks_for_tokens(tokens)
+        with self._lock:
+            if seq_id in self._tables:
+                raise BlockAccountingError(
+                    f"sequence {seq_id!r} already holds "
+                    f"{len(self._tables[seq_id])} blocks — double alloc"
+                )
+            if n > len(self._free):
+                raise BlocksExhausted(
+                    f"need {n} blocks for {tokens} tokens, "
+                    f"{len(self._free)}/{self.total_blocks} free"
+                )
+            got = [self._free.pop() for _ in range(n)]
+            self._tables[seq_id] = got
+            self.blocks_allocated_total += n
+            live = self.total_blocks - len(self._free)
+            if live > self.high_water_blocks:
+                self.high_water_blocks = live
+            return list(got)
+
+    def extend(self, seq_id, total_tokens: int) -> List[int]:
+        """Grow ``seq_id``'s table to cover ``total_tokens`` positions;
+        returns the newly claimed block ids (empty when the table already
+        covers it). Raises BlocksExhausted when the pool cannot grow it
+        and BlockAccountingError for an unknown sequence."""
+        with self._lock:
+            t = self._tables.get(seq_id)
+            if t is None:
+                raise BlockAccountingError(
+                    f"extend of unknown sequence {seq_id!r} — "
+                    "use-after-free or never-admitted"
+                )
+            need = self.blocks_for_tokens(total_tokens) - len(t)
+            if need <= 0:
+                return []
+            if need > len(self._free):
+                raise BlocksExhausted(
+                    f"need {need} more blocks, {len(self._free)} free"
+                )
+            got = [self._free.pop() for _ in range(need)]
+            t.extend(got)
+            self.blocks_allocated_total += need
+            live = self.total_blocks - len(self._free)
+            if live > self.high_water_blocks:
+                self.high_water_blocks = live
+            return list(got)
+
+    def free(self, seq_id) -> int:
+        """Return every block ``seq_id`` holds to the free list; returns
+        the count. A second free of the same sequence (or a free of one
+        never admitted) raises — each block is freed exactly once."""
+        with self._lock:
+            t = self._tables.pop(seq_id, None)
+            if t is None:
+                raise BlockAccountingError(
+                    f"free of unknown sequence {seq_id!r} — double free "
+                    "or never-admitted"
+                )
+            self._free.extend(reversed(t))
+            self.blocks_freed_total += len(t)
+            return len(t)
+
+    # ------------- invariants -------------
+
+    def conservation_ok(self) -> bool:
+        with self._lock:
+            live = sum(len(t) for t in self._tables.values())
+            return (self.blocks_allocated_total
+                    == self.blocks_freed_total + live)
+
+    def check_conservation(self) -> None:
+        """Raise BlockAccountingError unless the full ledger invariant
+        holds: allocated == freed + live (integer-exact), free + live
+        == total, and the free list + live tables PARTITION the block id
+        space (every id exactly once across both)."""
+        with self._lock:
+            live_ids: List[int] = []
+            for t in self._tables.values():
+                live_ids.extend(t)
+            live = len(live_ids)
+            if self.blocks_allocated_total != self.blocks_freed_total + live:
+                raise BlockAccountingError(
+                    f"conservation broken: allocated "
+                    f"{self.blocks_allocated_total} != freed "
+                    f"{self.blocks_freed_total} + live {live}"
+                )
+            if len(self._free) + live != self.total_blocks:
+                raise BlockAccountingError(
+                    f"pool leak: free {len(self._free)} + live {live} "
+                    f"!= total {self.total_blocks}"
+                )
+            seen = set(self._free)
+            if len(seen) != len(self._free):
+                raise BlockAccountingError("free list holds duplicates")
+            for b in live_ids:
+                if b in seen:
+                    raise BlockAccountingError(
+                        f"block {b} is both free and live (or live in "
+                        "two tables)"
+                    )
+                seen.add(b)
+            if seen != set(range(self.total_blocks)):
+                raise BlockAccountingError(
+                    "free list + tables do not cover the block id space"
+                )
+
+    # ------------- reporting -------------
+
+    def snapshot(self) -> dict:
+        """Point-in-time ledger view (the engine load() / bench report
+        shape)."""
+        with self._lock:
+            live = sum(len(t) for t in self._tables.values())
+            return {
+                "kv_block_size": self.block_size,
+                "kv_blocks_total": self.total_blocks,
+                "kv_blocks_live": live,
+                "kv_blocks_free": len(self._free),
+                "kv_blocks_allocated_total": self.blocks_allocated_total,
+                "kv_blocks_freed_total": self.blocks_freed_total,
+                "kv_blocks_high_water": self.high_water_blocks,
+                "kv_sequences_live": len(self._tables),
+                "kv_conservation_ok": (
+                    self.blocks_allocated_total
+                    == self.blocks_freed_total + live),
+            }

@@ -34,6 +34,7 @@ import contextlib
 import dataclasses
 import functools
 import itertools
+import threading
 import time
 from typing import Deque, Dict, List, Optional
 
@@ -45,6 +46,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from kubeflow_tpu.parallel.context import parallel_context
 from kubeflow_tpu.parallel.sharding import DEFAULT_RULES, Rules, param_shardings
+from kubeflow_tpu.serving.blocks import (
+    BlocksExhausted,
+    KVBlockAllocator,
+    blocks_for_tokens,
+    prefix_key,
+)
 from kubeflow_tpu.utils import get_logger
 from kubeflow_tpu.utils.monitoring import (
     MetricsRegistry,
@@ -92,6 +99,12 @@ class GenerationRequest:
     top_k: int = 0                    # 0 => no top-k restriction
     top_p: float = 1.0                # 1.0 => no nucleus restriction
     eos_token: Optional[int] = None
+    # Client session id (multi-turn conversations): the cache-affinity
+    # key the LB routes on ("s:<id>") — carried here so the engine's
+    # resident-prefix hints can advertise the SESSION key too, not just
+    # the prompt-head hash, and an LB that lost its map (restart, LRU
+    # eviction) re-learns the pin from load reports.
+    session: str = ""
     request_id: int = 0
     submitted_at: float = 0.0
 
@@ -157,6 +170,21 @@ class ServingConfig:
     # unbounded latency for EVERY request; a bounded one converts it into
     # fast 429s for the excess only.
     max_queue: int = 0
+    # Paged KV-cache slots (serving/blocks.py): KV capacity is accounted
+    # in fixed-size blocks of this many token positions; every admitted
+    # sequence holds a block table covering its ACTUAL demand
+    # (prompt + max_new_tokens, capped at max_len) and returns it at
+    # retirement, mid-step — so batch capacity is bounded by total KV
+    # blocks against real request sizes, not by max_batch x max_len.
+    kv_block_size: int = 16
+    # Total KV blocks in the pool. 0 = the dense equivalent
+    # (max_batch x ceil(max_len / kv_block_size)), under which block
+    # gating can never refuse an admission a free slot would accept —
+    # the byte-compatible default. Sizing it BELOW the dense equivalent
+    # oversubscribes slots against typical (shorter-than-max) requests:
+    # admission then throttles on the block free list, exactly once
+    # actual KV demand — not the worst case — exhausts the budget.
+    kv_blocks: int = 0
     # Per-token logprob reporting (GenerationResult.logprobs, the
     # /v1/generate "logprobs" field). OFF by default: the extra
     # logsumexp + gather gives the [B, V] decode logits extra consumers
@@ -348,6 +376,46 @@ class ServingEngine:
         # (monotonic ts, wait) pairs; see _queue_wait_quantile's window.
         self._recent_queue_waits: Deque[tuple] = collections.deque(maxlen=256)
         self.shed_total = 0
+        # Paged KV-cache slots: the block allocator is the capacity
+        # ledger admission draws on — a queued request claims its block
+        # table (actual demand, not max_len) alongside a batch slot and
+        # returns it at retirement, mid-step.
+        blocks_per_slot = blocks_for_tokens(cfg.max_len, cfg.kv_block_size)
+        self.blocks = KVBlockAllocator(
+            cfg.kv_blocks or cfg.max_batch * blocks_per_slot,
+            cfg.kv_block_size,
+        )
+        self.metrics_kv_blocks_live = registry.gauge(
+            "kftpu_serving_kv_blocks_live",
+            "KV-cache blocks currently held by admitted sequences",
+        )
+        self.metrics_kv_blocks_total = registry.gauge(
+            "kftpu_serving_kv_blocks_total",
+            "KV-cache blocks in the pool",
+        )
+        self.metrics_kv_blocks_total.set(float(self.blocks.total_blocks))
+        self.metrics_admissions_midstep = registry.counter(
+            "kftpu_serving_admissions_midstep_total",
+            "Admissions that claimed a slot while other sequences were "
+            "mid-decode (continuous batching in action)",
+        )
+        self.admissions_midstep = 0
+        # Monotonic timestamps of slot retirements: the continuous-
+        # batching slot-free rate, which prices Retry-After hints
+        # (queued / rate = the honest drain estimate) and rides load().
+        self._recent_retires: Deque[float] = collections.deque(maxlen=256)
+        # Resident-prefix hints: prefix keys whose KV blocks live here
+        # (active slots) or did recently (LRU tail) — the engine half of
+        # cache-affine routing; load() reports them to the LB.
+        self._resident_prefixes: "collections.OrderedDict[str, float]" = \
+            collections.OrderedDict()
+        # Guards the two structures above: load()/slot_free_rate() run
+        # on HTTP threads and ITERATE them while the driver thread
+        # mutates (append / LRU reorder) — the GIL makes single ops
+        # atomic but iteration-during-mutation raises RuntimeError,
+        # which would 500 /healthz and fail a healthy replica out of
+        # dispatch.
+        self._load_lock = threading.Lock()
 
         # Accept params straight from model.init (boxed with flax logical-
         # partitioning metadata), already-unboxed trees, or a zero-arg
@@ -566,6 +634,15 @@ class ServingEngine:
                 f"prompt length {len(prompt)} > limit {limit} "
                 f"(max_len {self.cfg.max_len} needs one decode slot)"
             )
+        need = self.blocks.blocks_for_tokens(self._demand_tokens(
+            prompt, int(kw.get("max_new_tokens", 32))))
+        if need > self.blocks.total_blocks:
+            raise ValueError(
+                f"request KV demand ({need} blocks) exceeds the pool "
+                f"({self.blocks.total_blocks} x "
+                f"{self.cfg.kv_block_size}-token blocks) — it could "
+                "never admit"
+            )
         # Bounded admission AFTER validation (a rejected-invalid request
         # is a 400, not engine pressure) and BEFORE the queue append, so
         # an overflow can never disturb already-admitted work.
@@ -575,7 +652,7 @@ class ServingEngine:
             raise EngineOverloaded(
                 f"engine queue full ({len(self._queue)}/"
                 f"{self.cfg.max_queue} waiting)",
-                retry_after_s=self._queue_wait_quantile(0.5) or 1.0,
+                retry_after_s=self._drain_estimate_s(),
             )
         self.metrics_requests.inc(outcome="admitted")
         self._queue.append(GenerationRequest(
@@ -610,8 +687,11 @@ class ServingEngine:
             # dispatch would feed the new slot another request's token
             # stream. Draining first keeps continuous batching: a slot
             # freed by a drain is refilled on the next loop iteration, not
-            # after the whole batch finishes.
-            if self._queue and any(s is None for s in self._slots):
+            # after the whole batch finishes. The flush only pays off when
+            # the queue head can ACTUALLY admit (free slot AND its KV
+            # block table fits the free list) — flushing while the head
+            # waits on blocks would serialise every chunk for nothing.
+            if self._head_admissible():
                 while pending:
                     self._drain_decode(pending.popleft())
                 self._admit()
@@ -667,6 +747,52 @@ class ServingEngine:
         waits = [w for t, w in self._recent_queue_waits if t >= cutoff]
         return nearest_rank_quantile(waits, q)
 
+    def _head_admissible(self) -> bool:
+        """True when the queue head could claim a slot AND its block
+        table right now — the only time a pipeline flush buys anything."""
+        if not self._queue or not any(s is None for s in self._slots):
+            return False
+        head = self._queue[0]
+        return self.blocks.can_alloc(
+            self._demand_tokens(head.prompt, head.max_new_tokens))
+
+    def _demand_tokens(self, prompt: List[int], max_new_tokens: int) -> int:
+        """KV positions this request can ever hold: prompt plus requested
+        decode length, capped by the cache (done_cap retires at
+        max_len - 1). The block table covers THIS, not max_len — the
+        whole point of paged accounting."""
+        return min(len(prompt) + max(1, max_new_tokens), self.cfg.max_len)
+
+    def slot_free_rate(self) -> float:
+        """Recent slot retirements per second (the continuous-batching
+        refill rate). Retry-After hints divide queue depth by THIS — a
+        queue drains one retirement at a time, not one engine step at a
+        time, so the step-boundary estimate the hint used to carry
+        overestimated the wait. 0.0 with fewer than two recent
+        retirements (no honest rate exists yet)."""
+        cutoff = time.monotonic() - LOAD_WINDOW_S
+        with self._load_lock:
+            ts = [t for t in self._recent_retires if t >= cutoff]
+        if len(ts) < 2 or ts[-1] <= ts[0]:
+            return 0.0
+        return (len(ts) - 1) / (ts[-1] - ts[0])
+
+    def _drain_estimate_s(self) -> float:
+        """Seconds until the queue could drain: queued / slot-free rate
+        when a rate exists, else the recent p50 queue wait, else 1s."""
+        rate = self.slot_free_rate()
+        if rate > 0:
+            return max(1.0, len(self._queue) / rate)
+        return self._queue_wait_quantile(0.5) or 1.0
+
+    def _note_resident(self, key: str) -> None:
+        """LRU-bump a prefix key into the resident-hint set (bounded)."""
+        with self._load_lock:
+            self._resident_prefixes.pop(key, None)
+            self._resident_prefixes[key] = time.monotonic()
+            while len(self._resident_prefixes) > 32:
+                self._resident_prefixes.popitem(last=False)
+
     def load(self) -> dict:
         """Point-in-time load snapshot: what /healthz exposes so the load
         balancer's health checks double as load reports (queue-depth-aware
@@ -674,6 +800,7 @@ class ServingEngine:
         queue-wait pressure. Reads are GIL-atomic ints/deque snapshots —
         safe from HTTP threads while the driver thread runs the engine."""
         active = self.active_slots
+        blocks = self.blocks.snapshot()
         return {
             "queued": len(self._queue),
             "active_slots": active,
@@ -683,7 +810,18 @@ class ServingEngine:
             "shed_total": self.shed_total,
             "p50_queue_wait_s": round(self._queue_wait_quantile(0.5), 6),
             "p95_queue_wait_s": round(self._queue_wait_quantile(0.95), 6),
+            # Paged-KV occupancy + continuous-batching refill rate +
+            # resident-prefix hints: the cache-affine dispatch inputs.
+            "kv_blocks_live": blocks["kv_blocks_live"],
+            "kv_blocks_total": blocks["kv_blocks_total"],
+            "kv_block_size": blocks["kv_block_size"],
+            "slot_free_rate": round(self.slot_free_rate(), 4),
+            "resident_prefixes": self._resident_snapshot(),
         }
+
+    def _resident_snapshot(self) -> List[str]:
+        with self._load_lock:
+            return list(self._resident_prefixes)
 
     def warmup(self, prompt_len: int) -> None:
         """Compile-and-execute the decode step and every k-bucket prefill
@@ -773,15 +911,36 @@ class ServingEngine:
         # the dominant prefill cost through a remote/tunneled TPU.
         admissions: List[tuple] = []   # (slot_idx, req)
         now = time.time()
+        mid_step = any(s is not None for s in self._slots)
         for i, slot in enumerate(self._slots):
             if slot is not None or not self._queue:
                 continue
-            req = self._queue.popleft()
+            # A free slot is necessary but no longer sufficient: the
+            # request must also claim its KV block table. FIFO holds —
+            # when the head doesn't fit the free list, admission stops
+            # (no smaller request jumps it; its blocks arrive as running
+            # sequences retire mid-step).
+            req = self._queue[0]
+            try:
+                self.blocks.alloc(
+                    req.request_id,
+                    self._demand_tokens(req.prompt, req.max_new_tokens))
+            except BlocksExhausted:
+                break
+            self._queue.popleft()
             self._slots[i] = _Slot(req)
             wait = max(0.0, now - req.submitted_at)
             self.metrics_queue_wait.observe(wait)
             self._recent_queue_waits.append((time.monotonic(), wait))
+            self._note_resident(prefix_key(req.prompt))
+            if req.session:
+                self._note_resident(f"s:{req.session}")
+            if mid_step:
+                self.admissions_midstep += 1
+                self.metrics_admissions_midstep.inc()
             admissions.append((i, req))
+        if admissions:
+            self.metrics_kv_blocks_live.set(float(self.blocks.blocks_live))
         by_bucket: Dict[int, List[tuple]] = {}
         for i, req in admissions:
             if len(req.prompt) > self.cfg.prefill_buckets[-1]:
@@ -1284,3 +1443,11 @@ class ServingEngine:
                 logprobs=list(slot.logprobs),
             )
             self._slots[slot_idx] = None
+            # Mid-step retirement: the slot and its block table free NOW
+            # (between decode chunks), not at a batch boundary — the next
+            # _admit refills from the queue without a full re-forward of
+            # the survivors. The retire timestamp feeds slot_free_rate.
+            self.blocks.free(req.request_id)
+            with self._load_lock:
+                self._recent_retires.append(time.monotonic())
+            self.metrics_kv_blocks_live.set(float(self.blocks.blocks_live))

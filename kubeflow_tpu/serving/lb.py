@@ -12,7 +12,21 @@ that dispatches on live per-replica load:
   requests AND the engine-side load snapshot its ``/healthz`` reports
   (queued, free slots, max_queue — see ServingEngine.load): a new request
   goes to the healthy, non-draining backend with the lowest
-  in_flight + reported-queue score.
+  in_flight + reported-queue score (plus a KV-block occupancy fraction
+  as the tiebreak — a replica whose paged KV pool is nearly full is a
+  worse host for a new block table than its queue depth alone shows).
+- **Cache-affine dispatch**: a request carrying a session key (body
+  ``"session"``) or a prompt long enough to have a meaningful shared
+  head (>= ``PREFIX_KEY_MIN_TOKENS`` tokens) hashes to an affinity key
+  (serving.blocks.prefix_key). The LB remembers where each key last
+  landed AND ingests every backend's ``resident_prefixes`` hints from
+  its load report; dispatch subtracts ``affinity_weight`` from the
+  score of backends where the key's KV blocks already live, so a hot
+  prefix re-lands on its cache instead of re-prefilling elsewhere.
+  Affinity NEVER overrides health, draining, circuit state, or
+  saturation — it only biases the choice among backends that are
+  eligible anyway (``kftpu_lb_affinity_hits_total{outcome}`` tallies
+  hit / rerouted / new).
 - **Load shedding**: once EVERY live backend is past its depth watermark
   (estimated engine queue >= its reported ``max_queue`` bound, or the
   LB-level ``queue_watermark`` override), new requests shed with 503 +
@@ -40,6 +54,7 @@ that dispatches on live per-replica load:
 
 from __future__ import annotations
 
+import collections
 import json
 import math
 import threading
@@ -48,7 +63,9 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
+from kubeflow_tpu.serving.blocks import prefix_key
 from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
 from kubeflow_tpu.webapps.router import (
     JsonHttpServer,
     NdjsonStream,
@@ -58,6 +75,15 @@ from kubeflow_tpu.webapps.router import (
 )
 
 log = get_logger("serving-lb")
+
+#: Prompts shorter than this get NO prefix-derived affinity key: a
+#: three-token prompt has no shared head worth routing for, and the
+#: least-loaded contract must hold untouched for such traffic. Explicit
+#: session keys always count.
+PREFIX_KEY_MIN_TOKENS = 8
+
+#: LB-side affinity map capacity (key -> last backend address). LRU.
+AFFINITY_MAP_SIZE = 4096
 
 
 class Backend:
@@ -75,6 +101,13 @@ class Backend:
         self.max_queue = 0                  # reported admission bound
         self.p50_queue_wait_s = 0.0
         self.has_load_report = False
+        # Paged-KV / continuous-batching report fields (PR 12): block
+        # occupancy biases dispatch, the slot-free rate prices
+        # Retry-After, resident prefixes steer cache-affine routing.
+        self.kv_blocks_live = 0
+        self.kv_blocks_total = 0
+        self.slot_free_rate = 0.0
+        self.resident_prefixes: frozenset = frozenset()
         # Requests dispatched since that report: the live correction to
         # the stale snapshot (each one is presumed to land in the
         # engine's queue/slots until the next report re-baselines).
@@ -87,10 +120,27 @@ class Backend:
     def url(self) -> str:
         return f"http://{self.addr}"
 
-    def score(self) -> int:
+    def score(self) -> float:
         """Dispatch preference: live LB in-flight plus last-reported
-        engine queue — lower is better."""
-        return self.in_flight + self.queued
+        engine queue, plus the KV-block occupancy fraction as a
+        strictly-sub-request tiebreak (a replica whose paged pool is
+        nearly full is the worse host for a new block table when queue
+        depths are equal) — lower is better."""
+        pressure = (self.kv_blocks_live / self.kv_blocks_total
+                    if self.kv_blocks_total > 0 else 0.0)
+        return self.in_flight + self.queued + min(0.999, pressure)
+
+    def drain_estimate_s(self) -> float:
+        """Seconds until this backend frees capacity, priced from the
+        continuous-batching slot-free rate its load report carries (the
+        estimated queue drains one retirement at a time). Falls back to
+        the reported p50 queue wait for engines that report no rate —
+        the step-boundary estimate overestimated the wait, so the rate
+        wins whenever it exists."""
+        if self.slot_free_rate > 0:
+            return (self.queued + self.sent_since_report) \
+                / self.slot_free_rate
+        return self.p50_queue_wait_s
 
     def saturated(self, watermark_override: Optional[int]) -> bool:
         """Past the depth watermark: the estimated engine queue (last
@@ -120,6 +170,10 @@ class Backend:
             "sent_since_report": self.sent_since_report,
             "consecutive_failures": self.consecutive_failures,
             "circuit_open": time.monotonic() < self.circuit_open_until,
+            "kv_blocks_live": self.kv_blocks_live,
+            "kv_blocks_total": self.kv_blocks_total,
+            "slot_free_rate": self.slot_free_rate,
+            "resident_prefixes": len(self.resident_prefixes),
         }
 
 
@@ -138,6 +192,9 @@ class ServingLoadBalancer:
         queue_watermark: Optional[int] = None,
         failure_threshold: int = 3,
         breaker_cooldown_s: float = 5.0,
+        affinity: bool = True,
+        affinity_weight: float = 2.0,
+        registry: MetricsRegistry = global_registry,
     ):
         self.connect_timeout_s = connect_timeout_s
         self.request_timeout_s = request_timeout_s
@@ -157,10 +214,43 @@ class ServingLoadBalancer:
         self.breaker_cooldown_s = breaker_cooldown_s
         self.shed_total = 0                 # saturation 503s served
         self.breaker_trips = 0
+        # Cache-affine routing: the LB's own memory of where each
+        # prefix/session key last landed (LRU), corrected by the
+        # resident_prefixes hints load reports carry. The bonus only
+        # biases the choice among ELIGIBLE backends — health, draining,
+        # circuits and saturation always run first.
+        self.affinity = affinity
+        self.affinity_weight = affinity_weight
+        self._affinity: "collections.OrderedDict[str, str]" = \
+            collections.OrderedDict()
+        self.affinity_hits = 0              # routed onto resident blocks
+        self.affinity_rerouted = 0          # key known, landed elsewhere
+        self.affinity_new = 0               # first sighting of the key
+        self.metrics_affinity = registry.counter(
+            "kftpu_lb_affinity_hits_total",
+            "Cache-affinity dispatch outcomes",
+            labels=("outcome",),
+        )
         self._backends: Dict[str, Backend] = {}
         self._lock = threading.Lock()
         if backends:
             self.set_backends(backends)
+
+    @staticmethod
+    def affinity_key(body: dict) -> Optional[str]:
+        """The request's cache-affinity identity: an explicit session id
+        (multi-turn conversations) wins; otherwise the prompt's prefix
+        hash — but only for prompts long enough that a shared head is
+        worth routing for. None = route purely on load."""
+        session = body.get("session")
+        if isinstance(session, str) and session:
+            return f"s:{session}"
+        tokens = body.get("tokens")
+        if (isinstance(tokens, list)
+                and len(tokens) >= PREFIX_KEY_MIN_TOKENS
+                and all(isinstance(t, int) for t in tokens)):
+            return prefix_key(tokens)
+        return None
 
     # ------------- backend set management -------------
 
@@ -207,7 +297,7 @@ class ServingLoadBalancer:
             interval = self.health_timeout_s
         return str(max(1, int(math.ceil(max(interval, drain_estimate_s)))))
 
-    def _acquire(self) -> Backend:
+    def _acquire(self, key: Optional[str] = None) -> Backend:
         with self._lock:
             now = time.monotonic()
             live = [b for b in self._backends.values()
@@ -221,14 +311,48 @@ class ServingLoadBalancer:
             if not ready:
                 # Every live backend is past its depth watermark: shed.
                 # Admitted work keeps its SLO; the excess fails fast with
-                # the backends' own queue-drain estimate as the backoff.
+                # an honest backoff: the SOONEST any backend's queue
+                # drains (continuous-batching slot-free rate when
+                # reported) — the client can be served by whichever
+                # frees first, so min, not max; the step-boundary
+                # estimate this replaces overestimated the wait.
                 self.shed_total += 1
-                drain = max(
-                    (b.p50_queue_wait_s for b in live), default=0.0)
+                ests = [e for e in (b.drain_estimate_s() for b in live)
+                        if e > 0]
+                drain = min(ests, default=0.0)
                 raise RestError(
                     503, "all serving backends saturated; shedding",
                     headers={"Retry-After": self._retry_after(drain)})
-            b = min(ready, key=lambda b: b.score())
+            resident = None
+            if self.affinity and key is not None:
+                target = self._affinity.get(key)
+                resident = [b for b in ready
+                            if key in b.resident_prefixes
+                            or b.addr == target]
+                known = target is not None or any(
+                    key in b.resident_prefixes for b in live)
+                bonus = {id(b): self.affinity_weight for b in resident}
+                b = min(ready, key=lambda b: b.score()
+                        - bonus.get(id(b), 0.0))
+                if resident and b in resident:
+                    self.affinity_hits += 1
+                    outcome = "hit"
+                elif known or resident:
+                    # The key's blocks live somewhere, but that backend
+                    # was drained/unhealthy/saturated or simply too
+                    # loaded: affinity yields to eligibility and load.
+                    self.affinity_rerouted += 1
+                    outcome = "rerouted"
+                else:
+                    self.affinity_new += 1
+                    outcome = "new"
+                self.metrics_affinity.inc(outcome=outcome)
+                self._affinity.pop(key, None)
+                self._affinity[key] = b.addr
+                while len(self._affinity) > AFFINITY_MAP_SIZE:
+                    self._affinity.popitem(last=False)
+            else:
+                b = min(ready, key=lambda b: b.score())
             b.in_flight += 1
             b.sent_since_report += 1
             b.requests_total += 1
@@ -315,6 +439,14 @@ class ServingLoadBalancer:
                     b.max_queue = int(load.get("max_queue", 0))
                     b.p50_queue_wait_s = float(
                         load.get("p50_queue_wait_s", 0.0))
+                    b.kv_blocks_live = int(load.get("kv_blocks_live", 0))
+                    b.kv_blocks_total = int(load.get("kv_blocks_total", 0))
+                    b.slot_free_rate = float(
+                        load.get("slot_free_rate", 0.0))
+                    rp = load.get("resident_prefixes")
+                    if isinstance(rp, list):
+                        b.resident_prefixes = frozenset(
+                            k for k in rp if isinstance(k, str))
                     b.has_load_report = True
                     # Fresh report re-baselines the stale-window estimate.
                     b.sent_since_report = 0
@@ -326,6 +458,7 @@ class ServingLoadBalancer:
     def _generate(self, req: Request):
         body = json.dumps(req.body).encode()
         stream = bool(req.body.get("stream", False))
+        key = self.affinity_key(req.body)
         # Failover: a backend that dies between health checks should cost
         # the client nothing — retry the next-least-loaded until none left.
         # Streams only fail over before the first upstream byte.
@@ -333,7 +466,7 @@ class ServingLoadBalancer:
         with self._lock:
             max_tries = max(1, len(self._backends))
         while True:
-            b = self._acquire()
+            b = self._acquire(key)
             tried += 1
             upstream = urllib.request.Request(
                 f"{b.url}/v1/generate", data=body,
@@ -427,7 +560,10 @@ class ServingLoadBalancer:
                  and not b["circuit_open"] for b in backs)
         payload = {"ok": ok, "backends": backs,
                    "shed_total": self.shed_total,
-                   "breaker_trips": self.breaker_trips}
+                   "breaker_trips": self.breaker_trips,
+                   "affinity_hits": self.affinity_hits,
+                   "affinity_rerouted": self.affinity_rerouted,
+                   "affinity_new": self.affinity_new}
         return payload if ok else (503, payload)
 
     def router(self) -> Router:

@@ -182,6 +182,11 @@ class ServingServer:
             kw["top_p"] = float(req.body["top_p"])
         if "eos_token" in req.body:
             kw["eos_token"] = int(req.body["eos_token"])
+        if isinstance(req.body.get("session"), str) and req.body["session"]:
+            # Cache-affinity session key (ISSUE 12): recorded into the
+            # engine's resident-prefix hints so load reports advertise
+            # the session's residency back to the LB.
+            kw["session"] = req.body["session"]
         stream = bool(req.body.get("stream", False))
         holder: Dict[str, Any] = {}
         ev = threading.Event()
@@ -324,6 +329,12 @@ def env_config() -> dict:
         # Bounded admission (0 = unbounded): the controller injects the
         # Serving.spec.max_queue bound here.
         "max_queue": int(os.environ.get("KFTPU_SERVING_MAX_QUEUE", "0")),
+        # Paged KV-cache sizing (ISSUE 12): Serving.spec.kv_block_size /
+        # kv_blocks; 0 falls through to the engine defaults (dense-
+        # equivalent pool).
+        "kv_block_size": int(
+            os.environ.get("KFTPU_SERVING_KV_BLOCK_SIZE", "0")),
+        "kv_blocks": int(os.environ.get("KFTPU_SERVING_KV_BLOCKS", "0")),
         "decode_chunk": int(
             os.environ.get("KFTPU_SERVING_DECODE_CHUNK", "8")),
         # Engine compute/memory knobs (ServingConfig): int8 weight-only
@@ -463,6 +474,10 @@ def build_server(cfg: dict) -> ServingServer:
                    decode_chunk=cfg["decode_chunk"])
     if cfg.get("max_queue"):
         scfg_kw["max_queue"] = cfg["max_queue"]
+    if cfg.get("kv_block_size"):
+        scfg_kw["kv_block_size"] = cfg["kv_block_size"]
+    if cfg.get("kv_blocks"):
+        scfg_kw["kv_blocks"] = cfg["kv_blocks"]
     if cfg.get("quantize"):
         scfg_kw["quantize"] = cfg["quantize"]
     if cfg.get("param_dtype"):

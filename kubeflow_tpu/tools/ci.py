@@ -47,12 +47,16 @@ Stages (any failure exits non-zero — the merge gate contract):
    capacity-ticks, every injected preemption is attributed, and
    chaos-vs-policy preemption eviction produces IDENTICAL ledgers on
    twin worlds (``--skip-obs`` skips both halves).
-8. **serve-bench-smoke** / **serving-soak-smoke**: the serving data
-   plane under 2x open-loop overload (ISSUE 7) — request accounting sums
-   exactly (ok + shed + timeouts + errors == offered), every shed carries
-   Retry-After, the ServingAutoscaler reaches max_replicas; then the
-   seeded drain/flap soak — zero requests routed to draining/unhealthy
-   backends (``--skip-serve``).
+8. **serve-bench-smoke** / **affinity-smoke** / **serving-soak-smoke**:
+   the serving data plane under 2x open-loop overload (ISSUE 7) —
+   request accounting sums exactly (ok + shed + timeouts + errors ==
+   offered), every shed carries Retry-After, the ServingAutoscaler
+   reaches max_replicas — plus the ISSUE-12 continuous-batching leg
+   (exact accounting, KV-block conservation, non-vacuous mid-step
+   admissions) and the seeded session-replay affinity A/B (hit-rate
+   separation between affine and blind routing, conservation in both
+   runs); then the seeded drain/flap soak — zero requests routed to
+   draining/unhealthy backends (``--skip-serve``).
 8b. **schedule-smoke**: the gang-scheduler mixed-priority storm with a
    mid-storm slice-preemption burst (ISSUE 8) — exact gang accounting
    (placed + preempted + pending == submitted), zero priority
@@ -347,6 +351,75 @@ def run_serve_bench_smoke(rate_qps: float = 60.0,
         )
     if rep["ok"] == 0:
         raise GateFailure("serve-bench-smoke: zero goodput")
+
+    # ISSUE 12: the continuous-batching leg — one seeded token-model run
+    # through the paged-KV plane. Gates are counts, never wall-clock:
+    # exact accounting, the KV-block conservation invariant (allocated ==
+    # freed + live, zero blocks leaked after drain), and a non-vacuous
+    # mid-step admission count (continuous batching actually engaged).
+    from kubeflow_tpu.tools.loadtest import run_continuous_bench
+
+    cont = run_continuous_bench(
+        mode="continuous", dense_kv=False, duration_s=duration_s)
+    if not cont["accounting_ok"]:
+        raise GateFailure(
+            f"serve-bench-smoke[continuous]: accounting broken — "
+            f"offered {cont['offered']} != ok {cont['ok']} + shed "
+            f"{cont['shed']} + timeouts {cont['timeouts']} + errors "
+            f"{cont['errors']}"
+        )
+    if cont["errors"] or cont["timeouts"]:
+        raise GateFailure(
+            f"serve-bench-smoke[continuous]: errors={cont['errors']} "
+            f"timeouts={cont['timeouts']}")
+    if cont["shed_with_retry_after"] != cont["shed"]:
+        raise GateFailure(
+            f"serve-bench-smoke[continuous]: "
+            f"{cont['shed'] - cont['shed_with_retry_after']} of "
+            f"{cont['shed']} sheds missing Retry-After")
+    kv = cont["kv"]
+    if not kv["conservation_ok"] or kv["blocks_leaked"]:
+        raise GateFailure(
+            f"serve-bench-smoke[continuous]: KV-block conservation "
+            f"broken — conservation_ok={kv['conservation_ok']} "
+            f"leaked={kv['blocks_leaked']}")
+    if cont["midstep_admissions"] == 0:
+        raise GateFailure(
+            "serve-bench-smoke[continuous]: zero mid-step admissions — "
+            "continuous batching never engaged")
+
+
+def run_affinity_smoke(seed: int = 12) -> None:
+    """Cache-affinity smoke (ISSUE 12): the seeded session-replay A/B
+    (affine vs blind routing over prefix-caching replicas). Gates are
+    counts: exact accounting and KV-block conservation in BOTH runs, and
+    the affine run's replica-counted hit rate strictly separating from
+    blind's — the signal the TTFT win rides on."""
+    from kubeflow_tpu.tools.loadtest import run_affinity_bench
+
+    aff = run_affinity_bench(duration_s=2.0, seed=seed)
+    for tag in ("affine", "blind"):
+        run = aff[tag]
+        if not run["accounting_ok"]:
+            raise GateFailure(
+                f"affinity-smoke[{tag}]: accounting broken: "
+                f"ok {run['ok']} shed {run['shed']} timeouts "
+                f"{run['timeouts']} errors {run['errors']} of "
+                f"{run['offered']}")
+        if run["errors"] or run["timeouts"]:
+            raise GateFailure(
+                f"affinity-smoke[{tag}]: errors={run['errors']} "
+                f"timeouts={run['timeouts']} (must both be 0)")
+        if not run["kv_conservation_ok"]:
+            raise GateFailure(
+                f"affinity-smoke[{tag}]: KV-block conservation broken")
+    if aff["affine"]["hit_rate"] <= aff["blind"]["hit_rate"]:
+        raise GateFailure(
+            f"affinity-smoke: no hit-rate separation — affine "
+            f"{aff['affine']['hit_rate']} <= blind "
+            f"{aff['blind']['hit_rate']}")
+    if aff["affine"]["prefix_hits"] == 0:
+        raise GateFailure("affinity-smoke: zero prefix hits — vacuous")
 
 
 def run_serving_soak_smoke(seed: int = 20260803) -> None:
@@ -661,6 +734,9 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
         _stage("serve-bench-smoke")
         run_serve_bench_smoke()
         passed.append("serve-bench-smoke")
+        _stage("affinity-smoke")
+        run_affinity_smoke()
+        passed.append("affinity-smoke")
         _stage("serving-soak-smoke")
         run_serving_soak_smoke(seed=chaos_seed)
         passed.append("serving-soak-smoke")

@@ -25,7 +25,8 @@ from __future__ import annotations
 import argparse
 import json
 import time
-from typing import Dict, List
+from itertools import count as itertools_count
+from typing import Dict, List, Optional
 
 from kubeflow_tpu.controlplane.api import (
     Notebook,
@@ -208,18 +209,58 @@ class SimServingReplica:
     admission semantics (``max_batch`` concurrent slots, a bounded wait
     queue that sheds with 429 + Retry-After at ``max_queue``, /healthz
     carrying the ``ServingEngine.load()`` snapshot shape) over a
-    deterministic synthetic engine — every admitted request costs exactly
-    ``service_time_s`` of slot time. That makes capacity analytic
-    (``max_batch / service_time_s`` QPS per replica), so the open-loop
-    bench can assert goodput against a known ceiling instead of a
-    hardware-dependent measurement, and no JAX/model load is needed to
-    drive the data plane at 2x overload in CI."""
+    deterministic synthetic engine. Three engine models:
+
+    - ``engine="classic"`` (default, the ISSUE-7 double): every admitted
+      request costs exactly ``service_time_s`` of slot time — capacity is
+      the analytic ``max_batch / service_time_s`` QPS.
+    - ``engine="continuous"`` (ISSUE 12): a token-level model — requests
+      carry ``prompt_tokens``/``gen_tokens`` and cost
+      ``prefill + gen_tokens x token_time_s``. Slots AND paged KV blocks
+      (the same ``KVBlockAllocator`` the real engine runs) free the
+      instant a sequence finishes, and the FIFO head admits mid-step the
+      moment a slot + its block table fit — continuous batching.
+    - ``engine="stepbatch"``: the pre-ISSUE-12 static batcher — requests
+      join a forming wave, the wave seals (full, or ``batch_linger_s``
+      with no joiner), every member's slots and blocks are held until
+      the LONGEST member finishes, and only then does the next wave
+      admit. Batch capacity sized by the longest sequence: the plane
+      the continuous engine exists to beat.
+
+    Token engines take ``dense_kv=True`` to reserve every sequence at
+    the worst case (``max_len`` positions — the pre-paged sizing) or
+    ``False`` to reserve ACTUAL demand (prompt + gen): with the same
+    ``kv_blocks`` budget, dense concurrency is ``kv_blocks /
+    blocks(max_len)`` while paged concurrency is bounded by real
+    request sizes — the vLLM argument, made count-exact by the block
+    ledger (``blocks.check_conservation()`` gates every bench leg).
+
+    ``prefix_cache_size`` > 0 keeps an LRU of affinity keys whose KV
+    blocks this replica (recently) held; a request whose key hits pays
+    ``prefill_hit_time_s`` instead of ``prefill_time_s`` — the engine
+    side of cache-affine routing, with per-replica hit/miss counts as
+    the bench's ground truth."""
 
     def __init__(self, *, max_batch: int = 2, max_queue: int = 8,
-                 service_time_s: float = 0.05):
+                 service_time_s: float = 0.05,
+                 engine: str = "classic",
+                 token_time_s: float = 0.005,
+                 prefill_time_s: float = 0.01,
+                 prefill_hit_time_s: float = 0.0,
+                 max_len: int = 256,
+                 kv_block_size: int = 16,
+                 kv_blocks: int = 0,
+                 dense_kv: bool = False,
+                 batch_linger_s: float = 0.02,
+                 prefix_cache_size: int = 0,
+                 name: str = ""):
         import collections
         import threading as _threading
 
+        from kubeflow_tpu.serving.blocks import (
+            KVBlockAllocator,
+            blocks_for_tokens,
+        )
         from kubeflow_tpu.webapps.router import (
             JsonHttpServer,
             Request,
@@ -227,53 +268,318 @@ class SimServingReplica:
             Router,
         )
 
+        if engine not in ("classic", "continuous", "stepbatch"):
+            raise ValueError(f"unknown sim engine {engine!r}")
+        self.engine = engine
+        self.name = name
         self.max_batch = max_batch
         self.max_queue = max_queue
         self.service_time_s = service_time_s
+        self.token_time_s = token_time_s
+        self.prefill_time_s = prefill_time_s
+        self.prefill_hit_time_s = prefill_hit_time_s
+        self.max_len = max_len
+        self.dense_kv = dense_kv
+        self.batch_linger_s = batch_linger_s
         self._lock = _threading.Lock()
-        self._slots = _threading.Semaphore(max_batch)
+        self._cond = _threading.Condition(self._lock)
+        self._slots = _threading.Semaphore(max_batch)   # classic path
         self._queued = 0                 # admitted, waiting for a slot
         self._active = 0                 # holding a slot
         self.served = 0
         self.shed = 0                    # engine-level 429s
-        self._waits = collections.deque(maxlen=256)
+        self.midstep_admissions = 0
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self._waits = collections.deque(maxlen=512)
+        self._retires = collections.deque(maxlen=512)
+        self._stopping = False
+        # The SAME allocator class the real engine runs: the bench's
+        # conservation gate exercises production accounting code.
+        blocks_per_seq = blocks_for_tokens(max_len, kv_block_size)
+        self.blocks = KVBlockAllocator(
+            kv_blocks or max_batch * blocks_per_seq, kv_block_size)
+        self._tickets = itertools_count()
+        self._fifo: collections.deque = collections.deque()
+        # stepbatch wave state
+        self._wave: set = set()
+        self._wave_state = "forming"
+        self._wave_size = 0
+        self._wave_done = 0
+        self._wave_formed_at = 0.0
+        # resident affinity keys (LRU), newest last
+        self._resident: "collections.OrderedDict[str, float]" = \
+            collections.OrderedDict()
+        self._prefix_cache_size = prefix_cache_size
+
+        handler = {"classic": self._generate_classic,
+                   "continuous": self._generate_continuous,
+                   "stepbatch": self._generate_stepbatch}[engine]
 
         def generate(q: Request):
-            t0 = time.monotonic()
-            with self._lock:
-                # Bounded admission BEFORE joining the queue, exactly like
-                # ServingEngine.submit: overflow sheds fast with the
-                # engine's own drain estimate as the backoff hint.
-                if self.max_queue and self._queued >= self.max_queue:
-                    self.shed += 1
-                    raise RestError(
-                        429, "engine queue full",
-                        headers={"Retry-After": str(max(
-                            1, int(self.max_queue * self.service_time_s
-                                   / max(1, self.max_batch) + 1)))})
-                self._queued += 1
-            self._slots.acquire()
-            with self._lock:
-                self._queued -= 1
-                self._active += 1
-                self._waits.append(time.monotonic() - t0)
-            try:
-                time.sleep(self.service_time_s)
-            finally:
-                with self._lock:
-                    self._active -= 1
-                    self.served += 1
-                self._slots.release()
-            return {"tokens": [1]}
+            return handler(q)
 
         def healthz(q: Request):
             return {"ok": True, "load": self.load()}
 
+        self._RestError = RestError
         r = Router()
         r.post("/v1/generate", generate)
         r.get("/healthz", healthz)
         self._srv = JsonHttpServer(r, port=0).start()
         self.addr = f"127.0.0.1:{self._srv.port}"
+
+    # ------------- classic engine (ISSUE 7, unchanged) -------------
+
+    def _generate_classic(self, q):
+        t0 = time.monotonic()
+        with self._lock:
+            # Bounded admission BEFORE joining the queue, exactly like
+            # ServingEngine.submit: overflow sheds fast with the
+            # engine's own drain estimate as the backoff hint.
+            if self.max_queue and self._queued >= self.max_queue:
+                self.shed += 1
+                raise self._RestError(
+                    429, "engine queue full",
+                    headers={"Retry-After": str(max(
+                        1, int(self.max_queue * self.service_time_s
+                               / max(1, self.max_batch) + 1)))})
+            self._queued += 1
+        self._slots.acquire()
+        with self._lock:
+            self._queued -= 1
+            self._active += 1
+            self._waits.append(time.monotonic() - t0)
+        try:
+            time.sleep(self.service_time_s)
+        finally:
+            with self._lock:
+                self._active -= 1
+                self.served += 1
+                self._retires.append(time.monotonic())
+            self._slots.release()
+        return {"tokens": [1]}
+
+    # ------------- token-model shared pieces -------------
+
+    def _parse_token_req(self, q) -> tuple:
+        """(demand_tokens, gen_tokens, affinity_key) from the body.
+        ``prompt_tokens`` (int) wins; a real ``tokens`` list counts its
+        length. The affinity key mirrors the LB's derivation so replica
+        hit counts are ground truth for the routed key."""
+        from kubeflow_tpu.serving.lb import ServingLoadBalancer
+
+        body = q.body or {}
+        gen = max(1, int(body.get("gen_tokens", 1)))
+        prompt = body.get("prompt_tokens")
+        if prompt is None:
+            toks = body.get("tokens")
+            prompt = len(toks) if isinstance(toks, list) else 16
+        demand = min(int(prompt) + gen, self.max_len)
+        return demand, gen, ServingLoadBalancer.affinity_key(body)
+
+    def _kv_demand(self, demand_tokens: int) -> int:
+        """Positions reserved for a sequence: its actual demand under
+        paged accounting, the max_len worst case under dense (the
+        pre-ISSUE-12 sizing this bench's A/B contrasts)."""
+        return self.max_len if self.dense_kv else demand_tokens
+
+    def _shed_429(self):
+        self.shed += 1
+        rate = self._slot_free_rate_locked()
+        if rate > 0:
+            est = self._queued / rate
+        else:
+            est = self.max_queue * self.service_time_s / max(
+                1, self.max_batch)
+        raise self._RestError(
+            429, "engine queue full",
+            headers={"Retry-After": str(max(1, int(est + 1)))})
+
+    def _slot_free_rate_locked(self) -> float:
+        ts = list(self._retires)
+        if len(ts) < 2 or ts[-1] <= ts[0]:
+            return 0.0
+        return (len(ts) - 1) / (ts[-1] - ts[0])
+
+    def _prefix_lookup(self, key) -> bool:
+        """Hit test against the resident LRU (caller holds the lock)."""
+        if key is None or self._prefix_cache_size <= 0:
+            return False
+        if key in self._resident:
+            self._resident.pop(key)
+            self._resident[key] = time.monotonic()
+            return True
+        return False
+
+    def _prefix_note(self, key) -> None:
+        if key is None or self._prefix_cache_size <= 0:
+            return
+        self._resident.pop(key, None)
+        self._resident[key] = time.monotonic()
+        while len(self._resident) > self._prefix_cache_size:
+            self._resident.popitem(last=False)
+
+    def _sleep_tokens(self, hit: bool, gen: int) -> float:
+        """Prefill (cheap on a prefix hit) then the decode tokens;
+        returns TTFT relative to the call (prefill completes = first
+        token)."""
+        prefill = self.prefill_hit_time_s if hit else self.prefill_time_s
+        if prefill > 0:
+            time.sleep(prefill)
+        ttft_rel = prefill
+        decode = gen * self.token_time_s
+        if decode > 0:
+            time.sleep(decode)
+        return ttft_rel
+
+    # ------------- continuous engine (ISSUE 12) -------------
+
+    def _generate_continuous(self, q):
+        t0 = time.monotonic()
+        demand, gen, key = self._parse_token_req(q)
+        with self._cond:
+            if self.max_queue and self._queued >= self.max_queue:
+                self._shed_429()
+            ticket = next(self._tickets)
+            self._fifo.append(ticket)
+            self._queued += 1
+            deadline = t0 + 30.0
+            # FIFO continuous admission: the head claims a slot AND its
+            # block table the instant both fit — typically freed by a
+            # retirement in the middle of other sequences' decode.
+            while not (self._fifo and self._fifo[0] == ticket
+                       and self._active < self.max_batch
+                       and self.blocks.can_alloc(self._kv_demand(demand))):
+                if self._stopping or time.monotonic() > deadline:
+                    self._fifo.remove(ticket)
+                    self._queued -= 1
+                    raise self._RestError(503, "replica stopping")
+                self._cond.wait(0.05)
+            self._fifo.popleft()
+            self._queued -= 1
+            if self._active > 0:
+                self.midstep_admissions += 1
+            self._active += 1
+            self.blocks.alloc(ticket, self._kv_demand(demand))
+            hit = self._prefix_lookup(key)
+            if key is not None:
+                if hit:
+                    self.prefix_hits += 1
+                else:
+                    self.prefix_misses += 1
+            wait = time.monotonic() - t0
+            self._waits.append(wait)
+            self._cond.notify_all()     # new head may now be admissible
+        try:
+            ttft_rel = self._sleep_tokens(hit, gen)
+            ttft = wait + ttft_rel
+        finally:
+            with self._cond:
+                self._active -= 1
+                self.served += 1
+                self.blocks.free(ticket)
+                self._retires.append(time.monotonic())
+                self._prefix_note(key)
+                self._cond.notify_all()
+        return {"tokens": [1] * gen, "ttft_s": round(ttft, 6),
+                "prefix_hit": hit, "backend": self.name}
+
+    # ------------- stepbatch engine (the pre-ISSUE-12 baseline) ------
+
+    def _generate_stepbatch(self, q):
+        t0 = time.monotonic()
+        demand, gen, key = self._parse_token_req(q)
+        with self._cond:
+            if self.max_queue and self._queued >= self.max_queue:
+                self._shed_429()
+            ticket = next(self._tickets)
+            self._fifo.append(ticket)
+            self._queued += 1
+            deadline = t0 + 30.0
+            # Join phase: only while a wave is FORMING — a running wave
+            # admits nothing (admission between engine steps, the
+            # ISSUE-12 motivation).
+            while not (self._wave_state == "forming"
+                       and self._fifo and self._fifo[0] == ticket
+                       and len(self._wave) < self.max_batch
+                       and self.blocks.can_alloc(self._kv_demand(demand))):
+                if self._stopping or time.monotonic() > deadline:
+                    self._fifo.remove(ticket)
+                    self._queued -= 1
+                    raise self._RestError(503, "replica stopping")
+                self._cond.wait(self.batch_linger_s / 2)
+                self._maybe_seal_locked()
+            self._fifo.popleft()
+            self._queued -= 1
+            if not self._wave:
+                self._wave_formed_at = time.monotonic()
+            self._wave.add(ticket)
+            self.blocks.alloc(ticket, self._kv_demand(demand))
+            self._active += 1
+            if (len(self._wave) >= self.max_batch
+                    or not self._can_fifo_head_join_locked()):
+                self._seal_locked()
+            else:
+                self._cond.notify_all()
+            # Wait for the seal: the whole wave prefills together.
+            while self._wave_state != "running" or ticket not in self._wave:
+                if self._stopping:
+                    raise self._RestError(503, "replica stopping")
+                self._cond.wait(self.batch_linger_s / 2)
+                self._maybe_seal_locked()
+            hit = self._prefix_lookup(key)
+            if key is not None:
+                if hit:
+                    self.prefix_hits += 1
+                else:
+                    self.prefix_misses += 1
+            wait = time.monotonic() - t0
+            self._waits.append(wait)
+            wave_tickets = set(self._wave)
+        try:
+            ttft_rel = self._sleep_tokens(hit, gen)
+            ttft = wait + ttft_rel
+        finally:
+            with self._cond:
+                self._wave_done += 1
+                self.served += 1
+                self._prefix_note(key)
+                if self._wave_done >= self._wave_size:
+                    # The LONGEST member just finished: only now do the
+                    # wave's slots and block tables free — the capacity
+                    # cost of step-boundary batching.
+                    now = time.monotonic()
+                    for t in wave_tickets:
+                        self.blocks.free(t)
+                        self._retires.append(now)
+                        self._active -= 1
+                    self._wave = set()
+                    self._wave_done = 0
+                    self._wave_size = 0
+                    self._wave_state = "forming"
+                self._cond.notify_all()
+        return {"tokens": [1] * gen, "ttft_s": round(ttft, 6),
+                "prefix_hit": hit, "backend": self.name}
+
+    def _can_fifo_head_join_locked(self) -> bool:
+        """Could the current queue head still join the forming wave?"""
+        return bool(self._fifo) and self.blocks.blocks_free > 0
+
+    def _seal_locked(self) -> None:
+        self._wave_state = "running"
+        self._wave_size = len(self._wave)
+        self._cond.notify_all()
+
+    def _maybe_seal_locked(self) -> None:
+        """Seal a lingering partial wave: no joiner arrived within
+        ``batch_linger_s`` of the wave forming."""
+        if (self._wave_state == "forming" and self._wave
+                and time.monotonic() - self._wave_formed_at
+                >= self.batch_linger_s):
+            self._seal_locked()
+
+    # ------------- reporting -------------
 
     def _quantile(self, q: float) -> float:
         from kubeflow_tpu.utils.monitoring import nearest_rank_quantile
@@ -282,8 +588,10 @@ class SimServingReplica:
 
     def load(self) -> dict:
         """The ServingEngine.load() shape: what the LB's health checks
-        ingest for queue-aware dispatch and the autoscaler scrapes."""
+        ingest for queue-aware dispatch, watermark shedding, cache
+        affinity, and the autoscaler scrape."""
         with self._lock:
+            snap = self.blocks.snapshot()
             return {
                 "queued": self._queued,
                 "active_slots": self._active,
@@ -293,9 +601,17 @@ class SimServingReplica:
                 "shed_total": self.shed,
                 "p50_queue_wait_s": round(self._quantile(0.5), 6),
                 "p95_queue_wait_s": round(self._quantile(0.95), 6),
+                "kv_blocks_live": snap["kv_blocks_live"],
+                "kv_blocks_total": snap["kv_blocks_total"],
+                "kv_block_size": snap["kv_block_size"],
+                "slot_free_rate": round(self._slot_free_rate_locked(), 4),
+                "resident_prefixes": list(self._resident),
             }
 
     def stop(self) -> None:
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
         self._srv.stop()
 
 
@@ -536,6 +852,421 @@ def run_serve_bench(
     return out
 
 
+def gen_session_trace(
+    *,
+    sessions: int = 16,
+    rate_qps: float = 40.0,
+    duration_s: float = 4.0,
+    seed: int = 12,
+    system_tokens: int = 48,
+    user_tokens: int = 12,
+    gen_tokens_choices: tuple = (4, 8, 16, 24),
+    history_cap_tokens: int = 48,
+) -> List[dict]:
+    """Seeded session-replay trace: multi-turn conversations sharing a
+    per-session preamble (system prompt + growing history), arriving
+    open-loop at ``rate_qps``. Each event is one request body plus its
+    arrival offset:
+
+        {"t": seconds, "session": "sess-N",
+         "prompt_tokens": system + history, "gen_tokens": K}
+
+    Same seed -> byte-identical trace (arrival order, session
+    assignment, decode lengths), so an affine-vs-blind A/B replays the
+    EXACT same workload and any TTFT separation is routing, not luck.
+    Turn prompts grow with history (each turn appends the user message
+    and the previous reply), which is what makes prefix reuse worth
+    routing for."""
+    import random as _random
+
+    rng = _random.Random(seed)
+    n = max(1, int(rate_qps * duration_s))
+    turn_of: Dict[int, int] = {}
+    gen_hist: Dict[int, int] = {}
+    events: List[dict] = []
+    for i in range(n):
+        s = rng.randrange(sessions)
+        turn = turn_of.get(s, 0)
+        turn_of[s] = turn + 1
+        gen = int(rng.choice(gen_tokens_choices))
+        # History grows with the conversation but truncates at the cap —
+        # the usual sliding-context policy, which also keeps per-request
+        # KV demand bounded the way real serving stacks do.
+        history = min(history_cap_tokens,
+                      turn * user_tokens + gen_hist.get(s, 0))
+        prompt = system_tokens + history + user_tokens
+        gen_hist[s] = gen_hist.get(s, 0) + gen
+        events.append({
+            "t": round(i / rate_qps, 4),
+            "session": f"sess-{s}",
+            "prompt_tokens": int(prompt),
+            "gen_tokens": gen,
+        })
+    return events
+
+
+def _drive_trace(
+    url: str,
+    events: List[dict],
+    *,
+    client_timeout_s: float = 3.0,
+) -> Dict[str, object]:
+    """Open-loop replay of a trace against one endpoint: every event
+    fires at its scheduled offset regardless of completions; every
+    outcome lands in exactly one bucket. Returns counts + ok latency and
+    server-reported TTFT lists."""
+    import queue as _queuemod
+    import socket
+    import threading
+    import urllib.error
+    import urllib.request
+
+    outcomes: "_queuemod.Queue[tuple]" = _queuemod.Queue()
+
+    def fire(body: dict):
+        t0 = time.monotonic()
+        try:
+            req = urllib.request.Request(
+                url, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=client_timeout_s) as r:
+                out = json.load(r)
+            outcomes.put(("ok", time.monotonic() - t0,
+                          float(out.get("ttft_s", 0.0)),
+                          bool(out.get("prefix_hit", False)), ""))
+        except urllib.error.HTTPError as e:
+            e.read()
+            if e.code in (429, 503):
+                outcomes.put(("shed", time.monotonic() - t0, 0.0, False,
+                              e.headers.get("Retry-After") or ""))
+            else:
+                outcomes.put(("error", time.monotonic() - t0, 0.0, False,
+                              str(e.code)))
+        except (socket.timeout, TimeoutError):
+            outcomes.put(("timeout", time.monotonic() - t0, 0.0, False, ""))
+        except urllib.error.URLError as e:
+            if isinstance(e.reason, (socket.timeout, TimeoutError)):
+                outcomes.put(("timeout", time.monotonic() - t0, 0.0,
+                              False, ""))
+            else:
+                outcomes.put(("error", time.monotonic() - t0, 0.0, False,
+                              repr(e)))
+        except Exception as e:  # noqa: BLE001 — every outcome counted
+            outcomes.put(("error", time.monotonic() - t0, 0.0, False,
+                          repr(e)))
+
+    threads = []
+    t_start = time.monotonic()
+    for ev in events:
+        delay = t_start + ev["t"] - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        body = {k: v for k, v in ev.items() if k != "t"}
+        t = threading.Thread(target=fire, args=(body,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=client_timeout_s + 10)
+    elapsed = time.monotonic() - t_start
+
+    counts = {"ok": 0, "shed": 0, "timeout": 0, "error": 0}
+    ok_lat: List[float] = []
+    ok_ttft: List[float] = []
+    hits = 0
+    shed_with_retry_after = 0
+    while not outcomes.empty():
+        kind, lat, ttft, hit, extra = outcomes.get()
+        counts[kind] += 1
+        if kind == "ok":
+            ok_lat.append(lat)
+            ok_ttft.append(ttft)
+            hits += bool(hit)
+        elif kind == "shed" and extra:
+            shed_with_retry_after += 1
+    return {"counts": counts, "ok_lat": ok_lat, "ok_ttft": ok_ttft,
+            "client_hits": hits, "elapsed": elapsed,
+            "shed_with_retry_after": shed_with_retry_after}
+
+
+def _pctq(xs: List[float], q: float) -> float:
+    from kubeflow_tpu.utils.monitoring import nearest_rank_quantile
+
+    return round(nearest_rank_quantile(xs, q), 4)
+
+
+def run_continuous_bench(
+    *,
+    mode: str = "continuous",          # "continuous" | "stepbatch"
+    dense_kv: bool = True,
+    rate_qps: Optional[float] = None,
+    duration_s: float = 4.0,
+    replicas: int = 1,
+    max_batch: int = 8,
+    max_queue: int = 5,
+    token_time_s: float = 0.005,
+    prefill_time_s: float = 0.01,
+    max_len: int = 256,
+    kv_block_size: int = 16,
+    kv_blocks: int = 48,
+    seed: int = 12,
+    sessions: int = 16,
+    client_timeout_s: float = 3.0,
+    scrape_interval_s: float = 0.1,
+) -> Dict[str, object]:
+    """One leg of the continuous-batching A/B (ISSUE 12): a seeded
+    variable-length trace, open-loop, through the real LB over
+    token-model ``SimServingReplica`` doubles.
+
+    The capacity denominator in every leg is the DENSE plane's analytic
+    ceiling — ``kv_blocks / blocks(max_len)`` concurrent sequences (the
+    pre-paged KV sizing; ``dense_capacity_qps`` below) — so legs
+    compare apples-to-apples on one KV budget:
+
+    - ``mode="stepbatch", dense_kv=True``: the pre-ISSUE-12 plane.
+      Admission at wave boundaries, every sequence reserved at max_len.
+    - ``mode="continuous", dense_kv=True``: mid-step admission alone.
+    - ``mode="continuous", dense_kv=False``: the full plane — paged
+      block tables sized by actual demand, so concurrency (and
+      goodput) is bounded by real request sizes, not max_len.
+
+    Defaults offer 2x the dense capacity. Hard gates live in bench.py /
+    ci.py; this function reports counts plus the block-ledger
+    conservation verdict (checked on the production allocator class)."""
+    import threading
+
+    from kubeflow_tpu.serving.blocks import (
+        BlockAccountingError,
+        blocks_for_tokens,
+    )
+    from kubeflow_tpu.serving.lb import ServingLoadBalancer
+    from kubeflow_tpu.webapps.router import JsonHttpServer
+
+    if mode not in ("continuous", "stepbatch"):
+        raise ValueError(f"unknown mode {mode!r}")
+    trace = gen_session_trace(
+        sessions=sessions, rate_qps=rate_qps or 1.0, duration_s=duration_s,
+        seed=seed)
+    mean_gen = sum(e["gen_tokens"] for e in trace) / len(trace)
+    mean_service = prefill_time_s + mean_gen * token_time_s
+    blocks_per_dense_seq = blocks_for_tokens(max_len, kv_block_size)
+    dense_slots = max(1, kv_blocks // blocks_per_dense_seq)
+    dense_capacity_qps = replicas * dense_slots / mean_service
+    if rate_qps is None:
+        rate_qps = 2.0 * dense_capacity_qps
+        trace = gen_session_trace(
+            sessions=sessions, rate_qps=rate_qps, duration_s=duration_s,
+            seed=seed)
+
+    sims = [SimServingReplica(
+        engine=mode, dense_kv=dense_kv, max_batch=max_batch,
+        max_queue=max_queue, token_time_s=token_time_s,
+        prefill_time_s=prefill_time_s, max_len=max_len,
+        kv_block_size=kv_block_size, kv_blocks=kv_blocks,
+        name=f"r{i}") for i in range(replicas)]
+    lb = ServingLoadBalancer([s.addr for s in sims],
+                             retry_after_s=scrape_interval_s)
+    front = JsonHttpServer(lb.router(), port=0).start()
+    stop = threading.Event()
+
+    def health_loop():
+        while not stop.is_set():
+            lb.health_check()
+            stop.wait(scrape_interval_s)
+
+    hc = threading.Thread(target=health_loop, daemon=True)
+    hc.start()
+    lb.health_check()
+
+    res = _drive_trace(f"http://127.0.0.1:{front.port}/v1/generate",
+                       trace, client_timeout_s=client_timeout_s)
+    stop.set()
+    hc.join(timeout=5)
+
+    # Block-ledger gate inputs: conservation on the LIVE allocator and
+    # an all-freed pool once traffic drained.
+    conservation_ok = True
+    blocks_leaked = 0
+    for s in sims:
+        try:
+            s.blocks.check_conservation()
+        except BlockAccountingError:
+            conservation_ok = False
+        blocks_leaked += s.blocks.snapshot()["kv_blocks_live"]
+    counts = res["counts"]
+    offered = len(trace)
+    out = {
+        "mode": mode,
+        "dense_kv": dense_kv,
+        "offered": offered,
+        "rate_qps": round(rate_qps, 1),
+        "duration_s": duration_s,
+        "elapsed_s": round(res["elapsed"], 3),
+        "ok": counts["ok"],
+        "shed": counts["shed"],
+        "timeouts": counts["timeout"],
+        "errors": counts["error"],
+        "accounting_ok": sum(counts.values()) == offered,
+        "shed_with_retry_after": res["shed_with_retry_after"],
+        "goodput_qps": round(counts["ok"] / res["elapsed"], 1)
+        if res["elapsed"] else 0.0,
+        "dense_capacity_qps": round(dense_capacity_qps, 1),
+        "goodput_vs_dense_capacity": round(
+            counts["ok"] / res["elapsed"] / dense_capacity_qps, 3)
+        if res["elapsed"] and dense_capacity_qps else 0.0,
+        "ttft_ok_s": {"p50": _pctq(res["ok_ttft"], 0.5),
+                      "p95": _pctq(res["ok_ttft"], 0.95),
+                      "p99": _pctq(res["ok_ttft"], 0.99)},
+        "latency_ok_s": {"p50": _pctq(res["ok_lat"], 0.5),
+                         "p99": _pctq(res["ok_lat"], 0.99)},
+        "midstep_admissions": sum(s.midstep_admissions for s in sims),
+        "engine_shed": sum(s.shed for s in sims),
+        "lb_shed": lb.shed_total,
+        "served_by_backends": sum(s.served for s in sims),
+        "kv": {"block_size": kv_block_size, "blocks_total": kv_blocks,
+               "dense_slots_equiv": dense_slots,
+               "blocks_allocated_total": sum(
+                   s.blocks.blocks_allocated_total for s in sims),
+               "blocks_freed_total": sum(
+                   s.blocks.blocks_freed_total for s in sims),
+               "high_water": max(
+                   s.blocks.high_water_blocks for s in sims),
+               "conservation_ok": conservation_ok,
+               "blocks_leaked": blocks_leaked},
+        "mean_service_s": round(mean_service, 4),
+        "replicas": replicas,
+        "max_batch": max_batch,
+    }
+    front.stop()
+    for s in sims:
+        s.stop()
+    return out
+
+
+def run_affinity_bench(
+    *,
+    replicas: int = 3,
+    sessions: int = 18,
+    rate_qps: float = 55.0,
+    duration_s: float = 4.0,
+    seed: int = 12,
+    max_batch: int = 2,
+    max_queue: int = 16,
+    token_time_s: float = 0.004,
+    prefill_time_s: float = 0.04,
+    prefill_hit_time_s: float = 0.004,
+    max_len: int = 512,
+    kv_block_size: int = 16,
+    prefix_cache_size: Optional[int] = None,
+    client_timeout_s: float = 5.0,
+    scrape_interval_s: float = 0.1,
+) -> Dict[str, object]:
+    """Cache-affinity A/B (ISSUE 12): the SAME seeded session-replay
+    trace twice through the real LB over prefix-caching continuous
+    replicas — once cache-affine (the PR-12 dispatch), once blind
+    (affinity disabled, pure queue-depth scoring). A prefix hit skips
+    the long system-prompt prefill (``prefill_hit_time_s`` vs
+    ``prefill_time_s``), so the routed hit RATE — counted at the
+    replicas, the ground truth — is what drives any TTFT separation.
+    The arrival rate sits BELOW fleet capacity: the separation under
+    test is routing quality, not overload behaviour."""
+    import threading
+
+    from kubeflow_tpu.serving.blocks import BlockAccountingError
+    from kubeflow_tpu.serving.lb import ServingLoadBalancer
+    from kubeflow_tpu.webapps.router import JsonHttpServer
+
+    trace = gen_session_trace(
+        sessions=sessions, rate_qps=rate_qps, duration_s=duration_s,
+        seed=seed)
+    if prefix_cache_size is None:
+        # Residency models BOUNDED KV: one replica can keep roughly its
+        # fair share of the live sessions resident, plus a little slack.
+        # Blind scattering then thrashes every replica's LRU (each hosts
+        # a rotating superset it cannot hold), while affine routing
+        # partitions the sessions so each replica's share stays stable —
+        # the hit-rate mechanism the A/B exists to measure.
+        prefix_cache_size = max(2, sessions // replicas + 2)
+
+    def one_run(affine: bool) -> Dict[str, object]:
+        sims = [SimServingReplica(
+            engine="continuous", dense_kv=False, max_batch=max_batch,
+            max_queue=max_queue, token_time_s=token_time_s,
+            prefill_time_s=prefill_time_s,
+            prefill_hit_time_s=prefill_hit_time_s,
+            max_len=max_len, kv_block_size=kv_block_size,
+            prefix_cache_size=prefix_cache_size,
+            name=f"r{i}") for i in range(replicas)]
+        lb = ServingLoadBalancer([s.addr for s in sims],
+                                 retry_after_s=scrape_interval_s,
+                                 affinity=affine)
+        front = JsonHttpServer(lb.router(), port=0).start()
+        stop = threading.Event()
+
+        def health_loop():
+            while not stop.is_set():
+                lb.health_check()
+                stop.wait(scrape_interval_s)
+
+        hc = threading.Thread(target=health_loop, daemon=True)
+        hc.start()
+        lb.health_check()
+        res = _drive_trace(f"http://127.0.0.1:{front.port}/v1/generate",
+                           trace, client_timeout_s=client_timeout_s)
+        stop.set()
+        hc.join(timeout=5)
+        conservation_ok = True
+        for s in sims:
+            try:
+                s.blocks.check_conservation()
+            except BlockAccountingError:
+                conservation_ok = False
+        counts = res["counts"]
+        hits = sum(s.prefix_hits for s in sims)
+        misses = sum(s.prefix_misses for s in sims)
+        out = {
+            "affine": affine,
+            "offered": len(trace),
+            "ok": counts["ok"],
+            "shed": counts["shed"],
+            "timeouts": counts["timeout"],
+            "errors": counts["error"],
+            "accounting_ok": sum(counts.values()) == len(trace),
+            "prefix_hits": hits,
+            "prefix_misses": misses,
+            "hit_rate": round(hits / max(1, hits + misses), 3),
+            "ttft_ok_s": {"p50": _pctq(res["ok_ttft"], 0.5),
+                          "p95": _pctq(res["ok_ttft"], 0.95),
+                          "p99": _pctq(res["ok_ttft"], 0.99)},
+            "lb_affinity": {"hits": lb.affinity_hits,
+                            "rerouted": lb.affinity_rerouted,
+                            "new": lb.affinity_new},
+            "kv_conservation_ok": conservation_ok,
+        }
+        front.stop()
+        for s in sims:
+            s.stop()
+        return out
+
+    affine = one_run(True)
+    blind = one_run(False)
+    return {
+        "trace": {"sessions": sessions, "rate_qps": rate_qps,
+                  "duration_s": duration_s, "seed": seed,
+                  "requests": len(trace)},
+        "replicas": replicas,
+        "prefill_time_s": prefill_time_s,
+        "prefill_hit_time_s": prefill_hit_time_s,
+        "affine": affine,
+        "blind": blind,
+        "hit_rate_separation": round(
+            affine["hit_rate"] - blind["hit_rate"], 3),
+        "ttft_p50_separation_s": round(
+            blind["ttft_ok_s"]["p50"] - affine["ttft_ok_s"]["p50"], 4),
+        "ttft_p99_separation_s": round(
+            blind["ttft_ok_s"]["p99"] - affine["ttft_ok_s"]["p99"], 4),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="kftpu-loadtest")
     p.add_argument("--notebooks", type=int, default=100)
@@ -554,7 +1285,40 @@ def main(argv=None) -> int:
     p.add_argument("--autoscale", action="store_true",
                    help="serve bench: run the ServingAutoscaler loop")
     p.add_argument("--max-replicas", type=int, default=1)
+    p.add_argument("--continuous", action="store_true",
+                   help="ONLY run the continuous-batching token bench "
+                        "(stepbatch-dense vs continuous-dense vs "
+                        "continuous-paged on one seeded trace)")
+    p.add_argument("--affinity", action="store_true",
+                   help="ONLY run the cache-affinity A/B (affine vs "
+                        "blind routing on one seeded session trace)")
+    p.add_argument("--seed", type=int, default=12)
     args = p.parse_args(argv)
+    if args.continuous:
+        out = {
+            "stepbatch": run_continuous_bench(
+                mode="stepbatch", dense_kv=True,
+                duration_s=args.duration_s, seed=args.seed),
+            "continuous_dense": run_continuous_bench(
+                mode="continuous", dense_kv=True,
+                duration_s=args.duration_s, seed=args.seed),
+            "continuous_paged": run_continuous_bench(
+                mode="continuous", dense_kv=False,
+                duration_s=args.duration_s, seed=args.seed),
+        }
+        print(json.dumps(out))
+        return 0 if all(leg["accounting_ok"]
+                        and leg["kv"]["conservation_ok"]
+                        for leg in out.values()) else 1
+    if args.affinity:
+        out = run_affinity_bench(duration_s=args.duration_s,
+                                 seed=args.seed)
+        print(json.dumps(out))
+        ok = (out["affine"]["accounting_ok"]
+              and out["blind"]["accounting_ok"]
+              and out["affine"]["kv_conservation_ok"]
+              and out["blind"]["kv_conservation_ok"])
+        return 0 if ok else 1
     if args.serve:
         out = run_serve_bench(
             rate_qps=args.rate_qps, duration_s=args.duration_s,

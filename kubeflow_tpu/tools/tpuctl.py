@@ -649,6 +649,33 @@ def cmd_top(args) -> int:
         print(f"{'AUTOSCALE REASON':24} {'REPLICAS +/-':>12}")
         for reason in sorted(scaled):
             print(f"{reason:24} {int(scaled[reason]):>12}")
+    # Serving KV + cache-affinity surfaces (ISSUE 12): paged-block
+    # occupancy, mid-step admission count and affinity outcomes, summed
+    # across scrapes/shards; printed only when the series exist.
+    kv_live = kv_total = midstep = None
+    affinity = {}
+    for name, labels, value in samples:
+        if name == "kftpu_serving_kv_blocks_live":
+            kv_live = (kv_live or 0.0) + value
+        elif name == "kftpu_serving_kv_blocks_total":
+            kv_total = (kv_total or 0.0) + value
+        elif name == "kftpu_serving_admissions_midstep_total":
+            midstep = (midstep or 0.0) + value
+        elif (name == "kftpu_lb_affinity_hits_total"
+                and "outcome" in labels):
+            affinity[labels["outcome"]] = (
+                affinity.get(labels["outcome"], 0.0) + value)
+    if kv_total is not None or midstep is not None or affinity:
+        print()
+        print(f"{'SERVING KV/AFFINITY':24} {'VALUE':>12}")
+        if kv_total is not None:
+            print(f"{'kv blocks live/total':24} "
+                  f"{f'{int(kv_live or 0)}/{int(kv_total)}':>12}")
+        if midstep is not None:
+            print(f"{'mid-step admissions':24} {int(midstep):>12}")
+        for outcome in sorted(affinity):
+            print(f"{'affinity ' + outcome:24} "
+                  f"{int(affinity[outcome]):>12}")
     return 0
 
 

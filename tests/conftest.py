@@ -39,6 +39,14 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-second integration benches excluded from tier-1 "
+        "(-m 'not slow'); CI smoke stages cover their invariants",
+    )
+
+
 @pytest.fixture(scope="module", autouse=True)
 def _clear_jax_caches_per_module():
     """Drop compiled executables between test modules.

@@ -1,0 +1,205 @@
+"""Paged KV-cache block allocator (serving/blocks.py): exact alloc/free
+accounting under the goodput-ledger discipline — every block freed
+exactly once, no use-after-free across retire/admit churn, conservation
+(allocated == freed + live, pool exactly partitioned) after every
+operation. Plus the prefix-key derivation and the seeded session-replay
+trace generator the affinity bench drives."""
+
+import random
+
+import pytest
+
+from kubeflow_tpu.serving.blocks import (
+    BlockAccountingError,
+    BlocksExhausted,
+    KVBlockAllocator,
+    prefix_key,
+)
+
+
+class TestAllocFree:
+    def test_alloc_free_round_trip(self):
+        a = KVBlockAllocator(8, 16)
+        got = a.alloc("s1", 40)            # ceil(40/16) = 3 blocks
+        assert len(got) == 3
+        assert a.blocks_live == 3 and a.blocks_free == 5
+        assert a.table("s1") == got
+        assert a.free("s1") == 3
+        assert a.blocks_live == 0 and a.blocks_free == 8
+        assert a.blocks_allocated_total == 3
+        assert a.blocks_freed_total == 3
+        a.check_conservation()
+
+    def test_zero_token_request_pins_one_block(self):
+        a = KVBlockAllocator(4, 16)
+        assert a.blocks_for_tokens(0) == 1
+        assert len(a.alloc("s", 0)) == 1
+
+    def test_extend_grows_table(self):
+        a = KVBlockAllocator(8, 16)
+        a.alloc("s", 16)                    # 1 block
+        assert a.extend("s", 16) == []      # already covered
+        new = a.extend("s", 33)             # needs 3 total
+        assert len(new) == 2
+        assert len(a.table("s")) == 3
+        a.check_conservation()
+
+    def test_exhaustion_raises_and_changes_nothing(self):
+        a = KVBlockAllocator(2, 16)
+        a.alloc("big", 32)
+        with pytest.raises(BlocksExhausted):
+            a.alloc("more", 1)
+        with pytest.raises(BlocksExhausted):
+            a.extend("big", 48)
+        assert a.blocks_live == 2 and a.blocks_free == 0
+        assert a.table("more") is None
+        a.check_conservation()
+
+    def test_double_free_raises(self):
+        a = KVBlockAllocator(4, 16)
+        a.alloc("s", 16)
+        a.free("s")
+        with pytest.raises(BlockAccountingError, match="double free"):
+            a.free("s")
+        a.check_conservation()
+
+    def test_free_unknown_sequence_raises(self):
+        a = KVBlockAllocator(4, 16)
+        with pytest.raises(BlockAccountingError):
+            a.free("ghost")
+
+    def test_use_after_free_raises(self):
+        """A retired sequence's table is GONE: extend (the decode loop's
+        growth path) on it is an accounting error, never a silent
+        re-allocation over another sequence's rows."""
+        a = KVBlockAllocator(4, 16)
+        a.alloc("s", 16)
+        a.free("s")
+        with pytest.raises(BlockAccountingError, match="use-after-free"):
+            a.extend("s", 32)
+
+    def test_double_alloc_raises(self):
+        a = KVBlockAllocator(4, 16)
+        a.alloc("s", 16)
+        with pytest.raises(BlockAccountingError, match="double alloc"):
+            a.alloc("s", 16)
+
+    def test_freed_blocks_are_reusable_by_next_sequence(self):
+        """The retire/admit handoff: blocks freed by one sequence back a
+        fresh one immediately, and the id space never double-books."""
+        a = KVBlockAllocator(2, 16)
+        first = a.alloc("a", 32)
+        a.free("a")
+        second = a.alloc("b", 32)
+        assert sorted(first) == sorted(second)
+        a.check_conservation()
+
+
+class TestConservationUnderChurn:
+    def test_seeded_churn_conserves_after_every_op(self):
+        """Random admit/extend/retire storm: the invariant (allocated ==
+        freed + live, free list + tables partition the id space) must
+        hold after EVERY operation, and the final drain returns the pool
+        byte-exactly."""
+        rng = random.Random(20260804)
+        a = KVBlockAllocator(24, 8)
+        live = {}
+        for i in range(600):
+            op = rng.random()
+            if op < 0.45 or not live:
+                sid = f"s{i}"
+                tokens = rng.randrange(1, 80)
+                try:
+                    a.alloc(sid, tokens)
+                    live[sid] = tokens
+                except BlocksExhausted:
+                    pass
+            elif op < 0.70:
+                sid = rng.choice(list(live))
+                grown = live[sid] + rng.randrange(1, 32)
+                try:
+                    a.extend(sid, grown)
+                    live[sid] = grown
+                except BlocksExhausted:
+                    pass
+            else:
+                sid = rng.choice(list(live))
+                a.free(sid)
+                del live[sid]
+            a.check_conservation()
+        for sid in list(live):
+            a.free(sid)
+        a.check_conservation()
+        assert a.blocks_live == 0
+        assert a.blocks_free == a.total_blocks
+        assert a.blocks_allocated_total == a.blocks_freed_total
+        assert a.high_water_blocks <= a.total_blocks
+
+    def test_snapshot_shape(self):
+        a = KVBlockAllocator(4, 16)
+        a.alloc("s", 20)
+        snap = a.snapshot()
+        assert snap["kv_blocks_total"] == 4
+        assert snap["kv_blocks_live"] == 2
+        assert snap["kv_blocks_free"] == 2
+        assert snap["kv_conservation_ok"] is True
+        assert snap["kv_sequences_live"] == 1
+
+
+class TestPrefixKey:
+    def test_shared_head_shares_key(self):
+        sys_prompt = list(range(100, 164))
+        a = prefix_key(sys_prompt + [1, 2, 3])
+        b = prefix_key(sys_prompt + [9, 9])
+        assert a == b                       # same first 32 tokens
+        assert a != prefix_key(list(range(200, 264)))
+
+    def test_key_is_stable_and_tagged(self):
+        assert prefix_key([1, 2, 3]) == prefix_key([1, 2, 3])
+        assert prefix_key([1, 2, 3]).startswith("p:")
+
+
+class TestSessionTrace:
+    def test_same_seed_identical_trace(self):
+        from kubeflow_tpu.tools.loadtest import gen_session_trace
+
+        a = gen_session_trace(seed=7, rate_qps=20, duration_s=2.0)
+        b = gen_session_trace(seed=7, rate_qps=20, duration_s=2.0)
+        assert a == b
+        assert a != gen_session_trace(seed=8, rate_qps=20, duration_s=2.0)
+
+    def test_trace_shape_and_growth(self):
+        from kubeflow_tpu.tools.loadtest import gen_session_trace
+
+        trace = gen_session_trace(seed=3, sessions=4, rate_qps=30,
+                                  duration_s=2.0, system_tokens=48,
+                                  user_tokens=12)
+        assert len(trace) == 60
+        offsets = [e["t"] for e in trace]
+        assert offsets == sorted(offsets)   # open-loop schedule
+        by_session = {}
+        for e in trace:
+            assert e["gen_tokens"] >= 1
+            assert e["prompt_tokens"] >= 48 + 12
+            by_session.setdefault(e["session"], []).append(
+                e["prompt_tokens"])
+        assert len(by_session) == 4
+        # Multi-turn: some session's prompt grows with history, and the
+        # sliding-window cap bounds every prompt.
+        assert any(p[0] < p[-1] for p in by_session.values())
+        assert all(p <= 48 + 48 + 12 for ps in by_session.values()
+                   for p in ps)
+
+    def test_affinity_key_derivation_matches_lb(self):
+        """Session-keyed bodies and long prompts key; short keyless
+        prompts stay load-routed (the least-loaded contract holds for
+        trivial traffic)."""
+        from kubeflow_tpu.serving.lb import ServingLoadBalancer
+
+        key = ServingLoadBalancer.affinity_key
+        assert key({"session": "abc"}) == "s:abc"
+        assert key({"tokens": list(range(32))}) == prefix_key(
+            list(range(32)))
+        assert key({"tokens": [1, 2, 3]}) is None
+        assert key({"tokens": "nope"}) is None
+        assert key({}) is None

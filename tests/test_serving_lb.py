@@ -574,6 +574,182 @@ class TestLoadShedding:
             srv.stop()
 
 
+class TestCacheAffinity:
+    """ISSUE 12: cache-affine dispatch — session/prefix keys re-land on
+    the backend holding their KV blocks, WITHOUT ever overriding health,
+    draining, or saturation."""
+
+    def _front(self, lb):
+        return JsonHttpServer(lb.router(), port=0).start()
+
+    def test_session_sticks_to_one_backend(self, load_backends):
+        b0, b1 = load_backends
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        lb.health_check()
+        srv = self._front(lb)
+        try:
+            served = set()
+            for _ in range(4):
+                out = json.load(_post(
+                    f"http://127.0.0.1:{srv.port}/v1/generate",
+                    {"tokens": [1], "session": "conv-7"}))
+                served.add(out["backend"])
+            assert len(served) == 1         # pinned by the affinity map
+            assert lb.affinity_hits >= 3    # first is "new", rest hit
+            assert lb.affinity_new >= 1
+            assert lb.metrics_affinity.value(outcome="hit") >= 3
+            body = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz"))
+            assert body["affinity_hits"] == lb.affinity_hits
+        finally:
+            srv.stop()
+
+    def test_resident_prefix_hint_steers_first_dispatch(
+            self, load_backends):
+        """A key never seen by the LB but reported resident by a
+        backend's load report routes there — the engine-side hint path."""
+        b0, b1 = load_backends
+        b1.load["resident_prefixes"] = ["s:warm-sess"]
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        lb.health_check()
+        srv = self._front(lb)
+        try:
+            out = json.load(_post(
+                f"http://127.0.0.1:{srv.port}/v1/generate",
+                {"tokens": [1], "session": "warm-sess"}))
+            assert out["backend"] == "b1"
+            assert lb.affinity_hits == 1
+        finally:
+            srv.stop()
+
+    def test_affinity_never_overrides_saturation(self, load_backends):
+        """The pinned backend saturates -> the session REROUTES to the
+        other backend instead of queueing onto its cache."""
+        b0, b1 = load_backends
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        lb.health_check()
+        srv = self._front(lb)
+        try:
+            out = json.load(_post(
+                f"http://127.0.0.1:{srv.port}/v1/generate",
+                {"tokens": [1], "session": "conv-1"}))
+            pinned = out["backend"]
+            sat = b0 if pinned == "b0" else b1
+            other = "b1" if pinned == "b0" else "b0"
+            sat.load.update(queued=6, free_slots=0)     # past watermark
+            lb.health_check()
+            out = json.load(_post(
+                f"http://127.0.0.1:{srv.port}/v1/generate",
+                {"tokens": [1], "session": "conv-1"}))
+            assert out["backend"] == other
+            assert lb.affinity_rerouted >= 1
+        finally:
+            srv.stop()
+
+    def test_affinity_yields_to_drain_and_stale_pin_cannot_resurrect(
+            self, load_backends):
+        """The ISSUE-12 leg of the _release/set_backends drain race: a
+        session pinned to a backend that then drains must re-route (the
+        map entry is stale, not authoritative), and a stale release of
+        the drained Backend must not delete the re-added address the
+        affinity map now points at again."""
+        b0, b1 = load_backends
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        lb.health_check()
+        srv = self._front(lb)
+        try:
+            out = json.load(_post(
+                f"http://127.0.0.1:{srv.port}/v1/generate",
+                {"tokens": [1], "session": "conv-d"}))
+            pinned_name = out["backend"]
+            pinned = b0 if pinned_name == "b0" else b1
+            survivor = b1 if pinned is b0 else b0
+            old = lb._backends[pinned.addr]
+            old.in_flight = 1                  # a request still in flight
+            lb.set_backends([survivor.addr])   # scale-down: pinned drains
+            out = json.load(_post(
+                f"http://127.0.0.1:{srv.port}/v1/generate",
+                {"tokens": [1], "session": "conv-d"}))
+            assert out["backend"] == survivor.name   # re-routed, pinned
+            lb._release(old)                   # drain completes: popped
+            assert pinned.addr not in lb._backends
+            lb.set_backends([pinned.addr, survivor.addr])
+            fresh = lb._backends[pinned.addr]
+            assert fresh is not old
+            lb.health_check()
+            out = json.load(_post(
+                f"http://127.0.0.1:{srv.port}/v1/generate",
+                {"tokens": [1], "session": "conv-d"}))
+            assert out["backend"] in (pinned.name, survivor.name)
+            # the STALE release fires after the re-add: identity check
+            # keeps the fresh Backend (and its affinity pins) alive
+            lb._release(old)
+            assert lb._backends.get(pinned.addr) is fresh
+        finally:
+            srv.stop()
+
+    def test_affinity_disabled_ignores_keys(self, load_backends):
+        b0, b1 = load_backends
+        b0.load["queued"] = 3
+        lb = ServingLoadBalancer([b0.addr, b1.addr], affinity=False)
+        lb.health_check()
+        srv = self._front(lb)
+        try:
+            for _ in range(3):
+                out = json.load(_post(
+                    f"http://127.0.0.1:{srv.port}/v1/generate",
+                    {"tokens": [1], "session": "conv-x"}))
+                assert out["backend"] == "b1"   # pure load scoring
+            assert lb.affinity_hits == 0 and lb.affinity_new == 0
+        finally:
+            srv.stop()
+
+    def test_block_occupancy_breaks_score_ties(self, load_backends):
+        """Equal queues, different paged-KV occupancy: the emptier pool
+        wins the tie (strictly sub-request weight — it can never beat a
+        real queue-depth difference)."""
+        b0, b1 = load_backends
+        b0.load.update(kv_blocks_live=30, kv_blocks_total=32)
+        b1.load.update(kv_blocks_live=2, kv_blocks_total=32)
+        lb = ServingLoadBalancer([b0.addr, b1.addr])
+        lb.health_check()
+        srv = self._front(lb)
+        try:
+            out = json.load(_post(
+                f"http://127.0.0.1:{srv.port}/v1/generate",
+                {"tokens": [1]}))
+            assert out["backend"] == "b1"
+        finally:
+            srv.stop()
+
+
+class TestSlotFreeRateRetryAfter:
+    def test_shed_retry_after_uses_reported_slot_free_rate(
+            self, load_backends):
+        """ISSUE 12 satellite: saturated-fleet 503s price Retry-After
+        from the continuous-batching slot-free rate (queued / rate),
+        taking the MINIMUM across backends — the soonest any backend
+        frees capacity — instead of the step-boundary p50 estimate that
+        overestimated the wait."""
+        b0, b1 = load_backends
+        for b in (b0, b1):
+            b.load.update(queued=6, free_slots=0, p50_queue_wait_s=30.0)
+        b0.load["slot_free_rate"] = 2.0      # 6 queued / 2 per s = 3 s
+        b1.load["slot_free_rate"] = 0.5      # would be 12 s
+        lb = ServingLoadBalancer([b0.addr, b1.addr], retry_after_s=1.0)
+        lb.health_check()
+        srv = JsonHttpServer(lb.router(), port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"http://127.0.0.1:{srv.port}/v1/generate",
+                      {"tokens": [1]})
+            assert ei.value.code == 503
+            # min(3 s, 12 s) = 3 s, NOT the 30 s p50 fallback
+            assert int(ei.value.headers["Retry-After"]) == 3
+        finally:
+            srv.stop()
+
+
 class TestCircuitBreaker:
     def test_breaker_opens_after_consecutive_failures(self, backends):
         """failure_threshold transport failures open the circuit: the
