@@ -854,6 +854,8 @@ def bench_schedule(args) -> None:
         k: int(v) for k, v in (
             kv.split("=") for kv in args.fleet.split(","))
     }
+    if args.tenants:
+        return bench_schedule_tenants(args, jobs, fleet)
     if args.elastic:
         return bench_schedule_elastic(args, jobs, fleet)
     common = dict(
@@ -1010,6 +1012,92 @@ def bench_schedule_elastic(args, jobs: int, fleet: dict) -> None:
     )
 
 
+def bench_schedule_tenants(args, jobs: int, fleet: dict) -> None:
+    """Multi-tenant capacity-market A/B (ISSUE 13): the SAME seeded
+    multi-tenant storm — heavy-tailed per-tenant demand over the
+    DEFAULT_TENANT_SPECS tree, one tenant bursting 10x in high-priority
+    gangs — twice on one fleet: weighted-DRF enforcement vs the
+    raw-priority observe-only baseline (the tree attached, shares
+    logged, nothing enforced).
+
+    Hard gates (count-based, raise — python -O must not skip them):
+    - DRF leg: ZERO fairness violations — no gang of a tenant
+      at-or-below its weighted fair share evicted by a tenant above
+      fair share (check_tenant_gates; the ISSUE-13 acceptance gate),
+      non-vacuous preemptions, >= 2 tenant subtrees attributed;
+    - BOTH legs: exact gang accounting, zero priority inversions,
+      storm convergence, goodput-ledger conservation bit-exact;
+    - the baseline actually RECORDS violations (> 0) — otherwise the
+      A/B proves nothing about what enforcement prevents;
+    - the DRF leg's protection is non-vacuous: it refused at least one
+      eviction or yielded at least one admission."""
+    from kubeflow_tpu.scheduler.benchmark import (
+        DEFAULT_TENANT_SPECS,
+        check_storm_gates,
+        check_tenant_gates,
+        run_schedule_storm,
+    )
+
+    common = dict(
+        num_jobs=jobs, fleet_capacity=fleet, pool_size=args.pool_size,
+        seed=args.seed, ckpt_every_ticks=args.ckpt_every,
+        tenants=list(DEFAULT_TENANT_SPECS),
+    )
+    drf = run_schedule_storm(policy="priority", drf=True, **common)
+    base = run_schedule_storm(policy="priority", drf=False, **common)
+    check_tenant_gates(drf)
+    check_storm_gates(base)
+    for rep, tag in ((drf, "drf"), (base, "priority-only")):
+        if not rep.converged:
+            raise SystemExit(
+                f"tenants[{tag}]: storm did not converge in {rep.ticks} "
+                f"ticks: {rep.succeeded}+{rep.failed} terminal of "
+                f"{rep.submitted}")
+    if base.fairness_violations == 0:
+        raise SystemExit(
+            "tenants[priority-only]: baseline recorded ZERO fairness "
+            "violations — the burst never threatened anybody and the "
+            "A/B is vacuous (seed/contention too low?)")
+    if drf.tenant_protected == 0 and drf.tenant_yields == 0:
+        raise SystemExit(
+            "tenants[drf]: enforcement never engaged (zero protections "
+            "AND zero admission yields) — vacuous run")
+    out = args.tenants_out or args.goodput_out
+    if out:
+        with open(out, "w") as f:
+            json.dump({
+                "bench": "schedule-tenants",
+                "storm": {"jobs": jobs, "submitted": drf.submitted,
+                          "seed": args.seed, "fleet": fleet,
+                          "pool_size": args.pool_size,
+                          "ckpt_every_ticks": args.ckpt_every,
+                          "tenant_specs": list(DEFAULT_TENANT_SPECS),
+                          "burst_factor": 10},
+                "drf": drf.summary(),
+                "priority_only": base.summary(),
+                "fairness_violations": {
+                    "drf": drf.fairness_violations,
+                    "priority_only": base.fairness_violations,
+                },
+                "tenants": drf.goodput.get("tenants", {}),
+                "tenants_priority_only":
+                    base.goodput.get("tenants", {}),
+            }, f, indent=1, sort_keys=True)
+            f.write("\n")
+    # Headline as higher-is-better: the fraction of executed evictions
+    # that respected the fairness invariant (DRF: 1.0 by construction,
+    # count-gated; baseline: what raw priority actually did).
+    _emit(
+        "tenant_fair_preemption_fraction",
+        1.0 - drf.fairness_violations / max(1, drf.preemptions),
+        "fraction",
+        1.0 - base.fairness_violations / max(1, base.preemptions),
+        baseline_violations=base.fairness_violations,
+        priority_only=base.summary(),
+        **drf.summary(),
+    )
+
+
 def bench_serve(args) -> None:
     """Serving data-plane overload bench (ISSUE 7 + ISSUE 12): the
     open-loop generator (fixed arrival rate — requests fire on schedule
@@ -1058,6 +1146,7 @@ def bench_serve(args) -> None:
     from kubeflow_tpu.tools.loadtest import (
         run_affinity_bench,
         run_continuous_bench,
+        run_prefix_tree_bench,
         run_serve_bench,
     )
 
@@ -1070,10 +1159,13 @@ def bench_serve(args) -> None:
     if args.affinity_only:
         aff = run_affinity_bench(duration_s=args.duration_s)
         _check_affinity_gates(aff)
+        ptree = run_prefix_tree_bench(duration_s=args.duration_s)
+        _check_prefix_tree_gates(ptree)
         _emit(
             "serving_affinity_hit_rate",
             aff["affine"]["hit_rate"], "fraction",
             max(aff["blind"]["hit_rate"], 1e-9),
+            prefix_tree=ptree,
             **aff,
         )
         return
@@ -1180,6 +1272,10 @@ def bench_serve(args) -> None:
     aff = run_affinity_bench(duration_s=duration_s)
     _check_affinity_gates(aff)
 
+    # --- ISSUE 13: radix vs exact prefix matching ---------------------
+    ptree = run_prefix_tree_bench(duration_s=min(duration_s, 3.0))
+    _check_prefix_tree_gates(ptree)
+
     _emit(
         "serving_overload_goodput_vs_capacity",
         # Headline: the paged continuous plane's goodput on the dense
@@ -1202,6 +1298,7 @@ def bench_serve(args) -> None:
         continuous_dense=cont_dense,
         continuous_paged=cont_paged,
         affinity=aff,
+        prefix_tree=ptree,
     )
 
 
@@ -1234,6 +1331,17 @@ def _check_token_leg(tag: str, leg: dict) -> None:
             f"(allocated {kv['blocks_allocated_total']} freed "
             f"{kv['blocks_freed_total']})"
         )
+
+
+def _check_prefix_tree_gates(ptree: dict) -> None:
+    """The radix-vs-exact prefix-matching A/B's hard gates (ISSUE 13
+    satellite) — the one shared contract in
+    loadtest.prefix_tree_gate_failures, raised bench-style."""
+    from kubeflow_tpu.tools.loadtest import prefix_tree_gate_failures
+
+    failures = prefix_tree_gate_failures(ptree)
+    if failures:
+        raise SystemExit("; ".join(failures))
 
 
 def _check_affinity_gates(aff: dict) -> None:
@@ -1490,6 +1598,17 @@ def main() -> None:
                    help="schedule --elastic: write the A/B goodput "
                         "ledgers to this JSON file (the ELASTIC_r11.json "
                         "record)")
+    p.add_argument("--tenants", action="store_true",
+                   help="schedule bench: run the MULTI-TENANT storm A/B "
+                        "instead (ISSUE 13) — heavy-tailed per-tenant "
+                        "demand + a 10x high-priority burst tenant, "
+                        "weighted-DRF enforcement vs raw priority, "
+                        "count-gated on ZERO fairness violations under "
+                        "enforcement and conservation in both legs")
+    p.add_argument("--tenants-out", default="",
+                   help="schedule --tenants: write the A/B summaries + "
+                        "per-tenant scoreboard to this JSON file (the "
+                        "TENANT_r13.json record)")
     p.add_argument("--namespaces", type=int, default=20,
                    help="controlplane bench: namespaces the job fleet is "
                         "spread across (exercises the per-ns index)")

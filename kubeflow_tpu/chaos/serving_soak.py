@@ -261,3 +261,143 @@ def run_serving_soak(
     rep.affinity_hits = lb.affinity_hits
     rep.affinity_rerouted = lb.affinity_rerouted
     return rep
+
+
+# --------------------------------------------------------------------------
+# Tenant-weighted shedding soak (ISSUE 13)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TenantBurstReport:
+    """Two-tenant 2x-burst scenario, gated on EXACT per-tenant shed
+    accounting: the bursting tenant's sheds cover at least its overage
+    (arrivals beyond its weighted fair fraction), the in-share tenant
+    sheds ZERO, and every shed reconciles with the LB's ledger and
+    PR-7's exact-outcome accounting (ok + shed + errors == sent)."""
+
+    sent: Dict[str, int] = dataclasses.field(default_factory=dict)
+    ok: Dict[str, int] = dataclasses.field(default_factory=dict)
+    shed: Dict[str, int] = dataclasses.field(default_factory=dict)
+    errors: int = 0
+    shed_with_retry_after: int = 0
+    lb_tenants: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    lb_shed_total: int = 0
+    lb_shed_untenanted: int = 0
+    burst_tenant: str = ""
+    in_share_tenant: str = ""
+    burst_overage: float = 0.0
+
+    @property
+    def accounting_ok(self) -> bool:
+        total_sent = sum(self.sent.values())
+        return (sum(self.ok.values()) + sum(self.shed.values())
+                + self.errors == total_sent)
+
+    @property
+    def ledger_ok(self) -> bool:
+        """The LB's per-tenant shed ledger reconciles exactly: every
+        saturation shed charged to one bucket, client counts match."""
+        lb_sheds = {t: v.get("sheds", 0)
+                    for t, v in self.lb_tenants.items()}
+        return (sum(lb_sheds.values()) + self.lb_shed_untenanted
+                == self.lb_shed_total
+                and all(self.shed.get(t, 0) == lb_sheds.get(t, 0)
+                        for t in set(self.shed) | set(lb_sheds)))
+
+    @property
+    def clean(self) -> bool:
+        return (self.accounting_ok and self.ledger_ok
+                and self.errors == 0
+                and self.shed_with_retry_after == sum(self.shed.values())
+                and self.shed.get(self.in_share_tenant, 0) == 0
+                and self.shed.get(self.burst_tenant, 0)
+                >= self.burst_overage)
+
+
+def run_tenant_burst_soak(
+    *,
+    backends: int = 2,
+    warmup_rounds: int = 4,
+    burst_rounds: int = 8,
+    cooldown_rounds: int = 3,
+    burst_factor: int = 2,
+) -> TenantBurstReport:
+    """Deterministic two-tenant burst against a live LB + stub fleet:
+    equal-weight tenants send equal traffic (warmup), then the fleet
+    saturates (injected through the load reports, the run_serving_soak
+    discipline) while tenant-b bursts to ``burst_factor`` x tenant-a's
+    rate. Tenant-weighted shedding must charge the ENTIRE overage to
+    the burster: tenant-a's in-share traffic keeps dispatching, every
+    tenant-b request beyond its cumulative fair share sheds 503 with
+    Retry-After, and the per-tenant ledger on /healthz reconciles
+    exactly. Sequential requests — the invariants are count-exact, not
+    timing-dependent."""
+    ten_a, ten_b = "tenant-a", "tenant-b"
+    fleet = [_SoakBackend(f"b{i}") for i in range(backends)]
+    lb = ServingLoadBalancer([b.addr for b in fleet],
+                             retry_after_s=1.0,
+                             tenants={ten_a: 1.0, ten_b: 1.0})
+    front = JsonHttpServer(lb.router(), port=0).start()
+    url = f"http://127.0.0.1:{front.port}/v1/generate"
+    rep = TenantBurstReport(burst_tenant=ten_b, in_share_tenant=ten_a)
+
+    def fire(tenant: str) -> None:
+        rep.sent[tenant] = rep.sent.get(tenant, 0) + 1
+        body = json.dumps({"tokens": [1], "tenant": tenant}).encode()
+        try:
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                json.load(r)
+            rep.ok[tenant] = rep.ok.get(tenant, 0) + 1
+        except urllib.error.HTTPError as e:
+            e.read()
+            if e.code in (429, 503):
+                rep.shed[tenant] = rep.shed.get(tenant, 0) + 1
+                if e.headers.get("Retry-After"):
+                    rep.shed_with_retry_after += 1
+            else:
+                rep.errors += 1
+        except Exception:  # noqa: BLE001 — every outcome counted
+            rep.errors += 1
+
+    def set_saturated(on: bool) -> None:
+        for b in fleet:
+            b.reported_queued = (b.max_queue + 2) if on else 0
+        lb.health_check()
+
+    try:
+        set_saturated(False)
+        for _ in range(warmup_rounds):
+            fire(ten_a)
+            fire(ten_b)
+        set_saturated(True)
+        for _ in range(burst_rounds):
+            fire(ten_a)
+            for _ in range(burst_factor):
+                fire(ten_b)
+        set_saturated(False)
+        for _ in range(cooldown_rounds):
+            fire(ten_a)
+            fire(ten_b)
+        # The final ledger, read back over the same /healthz clients use.
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{front.port}/healthz", timeout=10) as r:
+            health = json.load(r)
+    finally:
+        front.stop()
+        for b in fleet:
+            b.stop()
+    rep.lb_tenants = health.get("tenants", {})
+    rep.lb_shed_total = int(health.get("shed_total", 0))
+    rep.lb_shed_untenanted = int(health.get("shed_untenanted", 0))
+    total = sum(rep.sent.values())
+    weights = {ten_a: 1.0, ten_b: 1.0}
+    fair_b = total * weights[ten_b] / sum(weights.values())
+    rep.burst_overage = rep.sent.get(ten_b, 0) - fair_b
+    log.info("tenant burst soak", kv={
+        "sent": rep.sent, "ok": rep.ok, "shed": rep.shed,
+        "overage": round(rep.burst_overage, 1), "clean": rep.clean})
+    return rep

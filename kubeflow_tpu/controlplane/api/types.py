@@ -201,10 +201,22 @@ class ProfilePluginSpec:
 class ProfileSpec:
     owner: str = ""                      # user email
     # TPU-chip quota (reference used generic ResourceQuotaSpec,
-    # profile_controller.go:240-256)
+    # profile_controller.go:240-256). With `parent` set this is one
+    # level of the HIERARCHICAL quota tree: a child's quota may never
+    # exceed its parent's; siblings may over-commit (flagged).
     tpu_chip_quota: int = 0
     resource_quota: Dict[str, str] = dataclasses.field(default_factory=dict)
     plugins: List[ProfilePluginSpec] = dataclasses.field(default_factory=list)
+    # Tenant tree (ISSUE 13): the parent Profile this tenant rolls up
+    # under (org -> team -> user chains; "" = a root tenant) and its
+    # fair-share weight among siblings — the weighted-DRF input the
+    # gang scheduler and the serving LB arbitrate on.
+    parent: str = ""
+    weight: float = 1.0
+    # Per-tenant goodput SLO (0 = none): the target productive fraction
+    # of the tenant's attributed slice-seconds. The goodput ledger's
+    # tenant rollup computes the burn rate `tpuctl tenants` alerts on.
+    goodput_slo: float = 0.0
 
 
 @dataclasses.dataclass

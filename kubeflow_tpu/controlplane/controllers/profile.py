@@ -13,6 +13,8 @@ that the TpuJob controller's gang admission enforces.
 
 from __future__ import annotations
 
+import dataclasses
+
 from kubeflow_tpu.controlplane.api.core import (
     AuthorizationPolicy,
     Namespace,
@@ -168,6 +170,68 @@ class ProfileController(Controller):
                 profile.metadata.finalizers.remove(PLUGIN_FINALIZER)
                 self.api.update(profile)
             return Result()
+        # Tenant-tree validation (ISSUE 13), top-down: spec
+        # contradictions (bad weight, self/cyclic parent, child quota
+        # exceeding the parent's) are permanent failures; an unknown
+        # parent parks and retries (apply ordering — the child may
+        # simply have landed first); children summing past this
+        # profile's quota is over-commit: allowed, flagged.
+        tenant_blocked = self._tenant_blocked(profile)
+        if tenant_blocked is not None:
+            reason, msg, requeue = tenant_blocked
+            if requeue is None:
+                # Permanent spec error: write only on real change — an
+                # unconditional write would emit MODIFIED every
+                # reconcile and livelock the watch loop.
+                prev_phase = profile.status.phase
+                prev = [dataclasses.replace(c)
+                        for c in profile.status.conditions]
+                profile.status.phase = "Failed"
+                profile.status.conditions = set_condition(
+                    profile.status.conditions,
+                    Condition(type="Ready", status="False",
+                              reason=reason, message=msg),
+                )
+                if any(c.type == "TenantTree"
+                       for c in profile.status.conditions):
+                    # A leftover transient flag (UnknownParent from an
+                    # earlier spec) must not outlive the spec that
+                    # caused it: point it at the ACTUAL error.
+                    profile.status.conditions = set_condition(
+                        profile.status.conditions,
+                        Condition(type="TenantTree", status="False",
+                                  reason=reason, message=msg),
+                    )
+                if prev_phase != "Failed" \
+                        or profile.status.conditions != prev:
+                    self.api.update_status(profile)
+                return Result()
+            prev = [dataclasses.replace(c)
+                    for c in profile.status.conditions]
+            profile.status.conditions = set_condition(
+                profile.status.conditions,
+                Condition(type="TenantTree", status="False",
+                          reason=reason, message=msg),
+            )
+            if profile.status.conditions != prev:
+                self.api.update_status(profile)
+            return Result(requeue_after=requeue)
+        if any(c.type == "TenantTree" and c.status == "False"
+               for c in profile.status.conditions):
+            # The parent arrived (or the spec was fixed): clear the flag.
+            profile.status.conditions = set_condition(
+                profile.status.conditions,
+                Condition(type="TenantTree", status="True",
+                          reason="Resolved",
+                          message=f"parent {profile.spec.parent or '-'} "
+                                  "resolved"),
+            )
+            self.api.update_status(profile)
+        self._refresh_overcommit(profile)
+        if profile.spec.parent:
+            parent_prof = self.api.try_get("Profile", profile.spec.parent)
+            if parent_prof is not None:
+                self._refresh_overcommit(parent_prof)
         owner = OwnerReference(kind="Profile", name=name,
                                uid=profile.metadata.uid)
 
@@ -285,6 +349,76 @@ class ProfileController(Controller):
             )
             self.api.update_status(profile)
         return Result()
+
+    # ------------- tenant tree (ISSUE 13) -------------
+
+    def _tenant_blocked(self, profile):
+        """Validate this profile's place in the tenant tree. Returns
+        None when valid, ``(reason, message, None)`` for a permanent
+        spec error (phase Failed) or ``(reason, message, requeue_s)``
+        for a transient block (unknown parent — apply ordering)."""
+        name = profile.metadata.name
+        if profile.spec.weight <= 0:
+            return ("InvalidTenantSpec",
+                    f"spec.weight must be > 0, got {profile.spec.weight}",
+                    None)
+        if not profile.spec.parent:
+            return None
+        if profile.spec.parent == name:
+            return ("InvalidTenantSpec",
+                    "spec.parent must not name the profile itself", None)
+        # Walk to the root: a missing link parks (the parent may apply
+        # later); a revisit is a cycle — permanent.
+        seen = {name}
+        cur = profile.spec.parent
+        while cur:
+            if cur in seen:
+                return ("InvalidTenantSpec",
+                        f"tenant parent cycle through {cur!r}", None)
+            seen.add(cur)
+            node = self.api.try_get("Profile", cur)
+            if node is None:
+                return ("UnknownParent",
+                        f"parent Profile {cur!r} does not exist (yet)",
+                        30.0)
+            cur = node.spec.parent
+        parent = self.api.get("Profile", profile.spec.parent)
+        if parent.spec.tpu_chip_quota > 0 and \
+                profile.spec.tpu_chip_quota > parent.spec.tpu_chip_quota:
+            return ("InvalidTenantSpec",
+                    f"tpu_chip_quota {profile.spec.tpu_chip_quota} exceeds "
+                    f"parent {profile.spec.parent!r} quota "
+                    f"{parent.spec.tpu_chip_quota} — a child can never "
+                    "out-quota its subtree's share", None)
+        return None
+
+    def _refresh_overcommit(self, profile) -> None:
+        """Flag (never forbid) over-commit: this profile's children
+        declaring more chips than its own quota covers. Written only on
+        change — the condition flips both ways as children come and go."""
+        quota = profile.spec.tpu_chip_quota
+        children = [p for p in self.reader.list("Profile", copy=False)
+                    if p.spec.parent == profile.metadata.name]
+        child_sum = sum(c.spec.tpu_chip_quota for c in children)
+        over = quota > 0 and bool(children) and child_sum > quota
+        have = next((c for c in profile.status.conditions
+                     if c.type == "QuotaOvercommitted"), None)
+        if not over and have is None:
+            return
+        if have is not None and (have.status == "True") == over:
+            return
+        profile.status.conditions = set_condition(
+            profile.status.conditions,
+            Condition(
+                type="QuotaOvercommitted",
+                status="True" if over else "False",
+                reason="ChildQuotaSum",
+                message=(f"children declare {child_sum} chips against a "
+                         f"quota of {quota}" if over else
+                         f"children within quota ({child_sum}/{quota})"),
+            ),
+        )
+        self.api.update_status(profile)
 
     @staticmethod
     def _ns_copy(live: Namespace, want: Namespace) -> bool:

@@ -384,6 +384,35 @@ class Platform:
         n = self.manager.run_until_idle(include_timers_within=0.2)
         if self.prober is not None:
             self.prober.maybe_probe()
+        # Tenant tree (ISSUE 13): rebuilt from live Profiles each pass —
+        # the scheduler's weighted-DRF decisions and the goodput
+        # ledger's tenant rollup (journaled "tn" records) both follow
+        # the org chart as it is NOW. No Profiles = tenant-blind, the
+        # pre-ISSUE-13 behaviour.
+        if self.goodput is not None or self.scheduler is not None:
+            profiles = self.api.list("Profile", copy=False)
+            # Rebuild only when a Profile actually changed (resource
+            # versions are the change signal): the tree is O(P log P)
+            # to build and tenancy targets thousands of tenants — the
+            # hot control loop must not pay that per pass.
+            key = tuple(sorted(
+                (p.metadata.name, p.metadata.resource_version)
+                for p in profiles))
+            if key != getattr(self, "_tenant_tree_key", object()):
+                self._tenant_tree_key = key
+                tree = None
+                if profiles:
+                    from kubeflow_tpu.tenancy import TenantTree
+
+                    tree = TenantTree.from_profiles(profiles)
+                # tree may be None: deleting the last Profile DETACHES
+                # the market — a stale org chart must not keep
+                # enforcing DRF or attributing tenants after the
+                # operator turned tenancy off.
+                if self.goodput is not None:
+                    self.goodput.set_tenants(tree)
+                if self.scheduler is not None:
+                    self.scheduler.tenants = tree
         if self.goodput is not None:
             self.goodput.pump()
             self.goodput.tick(time.monotonic_ns())

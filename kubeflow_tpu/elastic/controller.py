@@ -102,10 +102,24 @@ class ElasticController(Controller):
             if held is None or len(held) >= el.max_slices:
                 continue
             candidates.append(j)
-        candidates.sort(key=lambda j: (
-            -j.spec.priority, j.metadata.creation_timestamp,
-            j.metadata.namespace, j.metadata.name,
-        ))
+        # Tenancy (ISSUE 13): freed capacity grows the most-deficit
+        # tenant's gangs first (the same weighted-DRF deficits the
+        # scheduler admits and preempts by); priority still orders
+        # growth within a tenant. Without a tenant tree every deficit
+        # reads 0.0 and the sort is the pre-ISSUE-13 priority order.
+        shares = self.scheduler.tenant_shares(jobs)
+
+        def _grow_key(j):
+            deficit = 0.0
+            if shares is not None:
+                t = self.scheduler.tenant_of(j)
+                if t:
+                    deficit = shares.deficit(t)
+            return (-deficit, -j.spec.priority,
+                    j.metadata.creation_timestamp,
+                    j.metadata.namespace, j.metadata.name)
+
+        candidates.sort(key=_grow_key)
         grown = 0
         for job in candidates:
             if grown >= self.max_grows_per_pass:

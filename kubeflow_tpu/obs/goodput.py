@@ -180,6 +180,11 @@ class GoodputAccountant:
         fsync: bool = True,
         explicit_assignments: bool = False,
         track_rollback: bool = True,
+        # Tenant tree (ISSUE 13): when set, every job is attributed to
+        # its tenant path (namespace resolved through the tree) via a
+        # VERSIONED journal record ({"op": "tn", "v": 2, ...}) — old
+        # journals carry no such records and replay byte-identically.
+        tenants=None,
     ):
         self._lock = threading.RLock()
         self.tick_seconds = float(tick_seconds)
@@ -225,6 +230,10 @@ class GoodputAccountant:
         # surface tpuctl shows (docs/elastic.md).
         self._job_resizes: Dict[str, int] = {}
         self._job_degraded: Dict[str, int] = {}
+        # Tenant attribution (ISSUE 13): uid -> tenant path, journaled
+        # as versioned "tn" records so replay rebuilds the rollup.
+        self.tenants = tenants
+        self._job_tenant: Dict[str, str] = {}
         self.interruptions: Dict[str, int] = {
             "preempt": 0, "migration": 0, "restart": 0, "resize": 0,
         }
@@ -240,6 +249,8 @@ class GoodputAccountant:
         self._replaying = False
         self.metrics_seconds = None
         self.metrics_ratio = None
+        self.metrics_tenant_ratio = None
+        self.metrics_tenant_fair = None
         if registry is not None:
             self.metrics_seconds = registry.counter(
                 "kftpu_goodput_slice_seconds_total",
@@ -251,6 +262,18 @@ class GoodputAccountant:
                 "Productive fraction of each job's attributed "
                 "slice-seconds",
                 labels=("namespace", "name"),
+            )
+            self.metrics_tenant_ratio = registry.gauge(
+                "kftpu_tenant_goodput_ratio",
+                "Productive fraction of each tenant subtree's "
+                "attributed slice-seconds (ledger rollup)",
+                labels=("tenant",),
+            )
+            self.metrics_tenant_fair = registry.gauge(
+                "kftpu_tenant_fair_share",
+                "Each active tenant's weighted fair fraction of the "
+                "fleet (hierarchical split by Profile weight)",
+                labels=("tenant",),
             )
 
     # ----------------- construction -----------------
@@ -345,6 +368,7 @@ class GoodputAccountant:
             j.resizes = job.status.resizes
             self._job_meta[uid] = (job.metadata.namespace,
                                    job.metadata.name)
+            self._resolve_tenant(uid, job.metadata.namespace)
         j.slice_type = job.spec.slice_type
         j.num_slices = job.spec.num_slices
         # Elastic gangs hold capacity at their CURRENT width, not the
@@ -397,6 +421,40 @@ class GoodputAccountant:
             self._pending_migration.add(uid)
         elif ev.reason == "CheckpointSaved":
             self.checkpoint_saved(uid)
+
+    # ----------------- tenant attribution (ISSUE 13) -----------------
+
+    def _resolve_tenant(self, uid: str, namespace: str) -> None:
+        """Attribute a job to its tenant path through the tree; a
+        non-empty resolution is journaled as a VERSIONED record ("tn",
+        v=2) so replay rebuilds the rollup. A RE-parented Profile
+        re-resolves to its new path (journaled again, last record
+        wins), so the job's whole ledger moves with the org chart —
+        never a split where usage sits under the old path while fair
+        fractions follow the new tree. Pre-ISSUE-13 journals hold no
+        such records and replay byte-identically (the regression
+        test's contract)."""
+        if self.tenants is None:
+            return
+        path = self.tenants.resolve(namespace)
+        if not path or self._job_tenant.get(uid) == path:
+            return
+        rec = {"op": "tn", "v": 2, "job": uid, "tenant": path}
+        self._journal_rec(rec)
+        self._apply_tn(rec)
+
+    def set_tenants(self, tenants) -> None:
+        """(Re)attach the tenant tree — the platform rebuilds it from
+        Profiles each reconcile; already-known jobs resolve now."""
+        with self._lock:
+            self.tenants = tenants
+            if tenants is None:
+                return
+            for uid, (ns, _name) in sorted(self._job_meta.items()):
+                self._resolve_tenant(uid, ns)
+
+    def _apply_tn(self, rec: dict) -> None:
+        self._job_tenant[rec["job"]] = rec["tenant"]
 
     # ----------------- explicit driver hooks -----------------
 
@@ -631,6 +689,11 @@ class GoodputAccountant:
             self._apply_ckpt(rec)
         elif op == "cap":
             self._apply_cap(rec)
+        elif op == "tn":
+            # v2 record (ISSUE 13): tenant attribution. Unknown future
+            # versions of this record would land here too — applied by
+            # shape, never by guessing.
+            self._apply_tn(rec)
         elif op == "state":
             # A compacted journal's head: the full ledger state at
             # compaction time (see replay_from).
@@ -671,6 +734,19 @@ class GoodputAccountant:
                     self.metrics_ratio.set(
                         jc.get("productive", 0) / total,
                         namespace=meta[0], name=meta[1])
+        if self.metrics_tenant_ratio is not None and self._job_tenant:
+            leaf_cats = self._tenant_leaf_cats_locked(self._job_tenant)
+            for path, cats in sorted(leaf_cats.items()):
+                total = sum(cats.values())
+                if total > 0:
+                    self.metrics_tenant_ratio.set(
+                        cats.get("productive", 0) / total, tenant=path)
+            if self.tenants is not None:
+                active = {p.rsplit("/", 1)[-1] for p in leaf_cats}
+                for name, f in sorted(
+                        self.tenants.fair_fractions(active).items()):
+                    self.metrics_tenant_fair.set(
+                        f, tenant=self.tenants.resolve(name) or name)
 
     def _apply_int(self, rec: dict) -> None:
         cause = rec["cause"]
@@ -779,6 +855,8 @@ class GoodputAccountant:
                     self._job_resizes.items()) if n},
                 "degraded": {uid: n for uid, n in sorted(
                     self._job_degraded.items()) if n},
+                **({"job_tenants": dict(sorted(self._job_tenant.items()))}
+                   if self._job_tenant else {}),
             }
 
     def load_state(self, state: dict) -> None:
@@ -807,6 +885,8 @@ class GoodputAccountant:
             self._job_degraded = {
                 uid: int(n)
                 for uid, n in state.get("degraded", {}).items()}
+            for uid, path in state.get("job_tenants", {}).items():
+                self._job_tenant[uid] = str(path)
 
     # ----------------- read surfaces -----------------
 
@@ -854,6 +934,8 @@ class GoodputAccountant:
                 if self._job_degraded[uid]:
                     rows.append(("degraded", uid, "",
                                  str(self._job_degraded[uid])))
+            for uid in sorted(self._job_tenant):
+                rows.append(("tenant", uid, "", self._job_tenant[uid]))
             for cause in sorted(self.interruptions):
                 rows.append(("interruptions", cause, "",
                              str(self.interruptions[cause])))
@@ -885,6 +967,114 @@ class GoodputAccountant:
                 "categories_ticks": dict(sorted(totals.items())),
                 "interruptions": dict(sorted(self.interruptions.items())),
                 "jobs": dict(sorted(jobs.items())),
+            }
+
+    #: Categories during which a gang HOLDS capacity — the usage share
+    #: the fair-share scoreboard compares against fair fractions
+    #: (queue_wait is demand-side in the per-job ledger and idle_free
+    #: belongs to nobody).
+    HELD_CATEGORIES = ("productive", "restart_rollback", "migration",
+                       "checkpoint_overhead")
+
+    def _tenant_leaf_cats_locked(
+            self, job_tenant: Dict[str, str]) -> Dict[str, Dict[str, int]]:
+        """Leaf tenant path -> summed per-job category ticks (caller
+        holds the lock)."""
+        out: Dict[str, Dict[str, int]] = {}
+        for uid, jc in self._job_cats.items():
+            path = job_tenant.get(uid)
+            if not path:
+                continue
+            agg = out.setdefault(path, {})
+            for c, n in jc.items():
+                agg[c] = agg.get(c, 0) + n
+        return out
+
+    def tenant_snapshot(self, tree=None) -> Dict[str, Any]:
+        """The per-tenant scoreboard (ISSUE 13): the ledger's per-job
+        rows aggregated up the tenant tree. Every node of the hierarchy
+        gets the sum of its subtree's attributed slice-ticks, its usage
+        SHARE (held ticks / fleet tracked ticks), its weighted FAIR
+        fraction (hierarchical split among tenants with live usage or
+        queued demand), the DEFICIT between the two, its goodput ratio,
+        and — where the Profile declares ``goodput_slo`` — the
+        error-budget burn rate and alert state. ``tree`` overrides the
+        attached tree for read-only resolution (the tpuctl path, where
+        the tree is rebuilt from Profiles at command time). Same ledger
+        rows as :meth:`snapshot` — one source of truth."""
+        from kubeflow_tpu.tenancy.drf import slo_burn, slo_state
+
+        with self._lock:
+            tree = tree if tree is not None else self.tenants
+            job_tenant = dict(self._job_tenant)
+            if tree is not None:
+                for uid, (ns, _n) in self._job_meta.items():
+                    if uid not in job_tenant:
+                        path = tree.resolve(ns)
+                        if path:
+                            job_tenant[uid] = path
+            leaf_cats = self._tenant_leaf_cats_locked(job_tenant)
+            tracked = sum(self._tracked.values())
+            # Roll leaf ledgers up the tree: every prefix of a leaf
+            # path aggregates its subtree.
+            node_cats: Dict[str, Dict[str, int]] = {}
+            for path, cats in leaf_cats.items():
+                parts = path.split("/")
+                for i in range(len(parts)):
+                    node = "/".join(parts[:i + 1])
+                    agg = node_cats.setdefault(node, {})
+                    for c, n in cats.items():
+                        agg[c] = agg.get(c, 0) + n
+            node_fair: Dict[str, float] = {}
+            if tree is not None:
+                active = {p.rsplit("/", 1)[-1] for p in leaf_cats}
+                for name, f in tree.fair_fractions(active).items():
+                    path = tree.resolve(name) or name
+                    parts = path.split("/")
+                    for i in range(len(parts)):
+                        node = "/".join(parts[:i + 1])
+                        node_fair[node] = node_fair.get(node, 0.0) + f
+            ts = self.tick_seconds
+            tenants: Dict[str, Dict[str, Any]] = {}
+            for node in sorted(node_cats):
+                cats = node_cats[node]
+                total = sum(cats.values())
+                held = sum(cats.get(c, 0) for c in self.HELD_CATEGORIES)
+                share = held / tracked if tracked else 0.0
+                fair = node_fair.get(node, 0.0)
+                ratio = (cats.get("productive", 0) / total
+                         if total else 0.0)
+                entry: Dict[str, Any] = {
+                    "categories_ticks": dict(sorted(cats.items())),
+                    "slice_seconds": round(total * ts, 6),
+                    "held_ticks": held,
+                    "share": round(share, 6),
+                    "fair_share": round(fair, 6),
+                    "deficit": round(fair - share, 6),
+                    "goodput_ratio": round(ratio, 6),
+                    # True = jobs attribute DIRECTLY to this node (a
+                    # leaf path, or an org running its own workloads);
+                    # False = pure subtree rollup. Consumers computing
+                    # fair fractions (tpuctl queue) must count only
+                    # direct claimants — a rollup node is not one more
+                    # sibling competing with its own children.
+                    "direct": node in leaf_cats,
+                }
+                tnode = (tree.node(node.rsplit("/", 1)[-1])
+                         if tree is not None else None)
+                if tnode is not None:
+                    entry["weight"] = tnode.weight
+                    if tnode.goodput_slo > 0:
+                        burn = slo_burn(ratio, tnode.goodput_slo)
+                        entry["goodput_slo"] = tnode.goodput_slo
+                        entry["slo_burn"] = (round(burn, 4)
+                                             if burn is not None else None)
+                        entry["slo_state"] = slo_state(burn)
+                tenants[node] = entry
+            return {
+                "tracked_ticks": tracked,
+                "conserved": self.conservation()["exact"],
+                "tenants": tenants,
             }
 
     def snapshot(self) -> Dict[str, Any]:
@@ -942,6 +1132,11 @@ class GoodputAccountant:
                 "counterfactual_saved_s": round(
                     sum(self._job_degraded.values()) * ts, 6),
                 "jobs": jobs,
+                # Tenant rollup (ISSUE 13) — present only once tenant
+                # attribution exists, so pre-tenant reports keep their
+                # exact shape.
+                **({"tenants": self.tenant_snapshot()["tenants"]}
+                   if self._job_tenant else {}),
             }
 
 

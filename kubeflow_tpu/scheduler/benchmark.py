@@ -69,6 +69,80 @@ class StormJob:
     num_slices: int
     arrival_tick: int
     duration_ticks: int
+    # Multi-tenant storms (ISSUE 13): the namespace (== leaf tenant)
+    # the job belongs to. The single-tenant storms keep STORM_NAMESPACE.
+    namespace: str = STORM_NAMESPACE
+
+
+#: The default tenant tree of the multi-tenant storm: one org with two
+#: teams of unequal weight (and goodput SLOs), an independent startup,
+#: and the burst tenant — leaves are the namespaces jobs land in.
+DEFAULT_TENANT_SPECS = (
+    {"name": "acme", "weight": 2.0},
+    {"name": "ml-infra", "parent": "acme", "weight": 2.0,
+     "goodput_slo": 0.5},
+    {"name": "research", "parent": "acme", "weight": 1.0,
+     "goodput_slo": 0.4},
+    {"name": "startup", "weight": 1.0, "goodput_slo": 0.3},
+    {"name": "burst-co", "weight": 1.0},
+)
+
+#: Heavy-tailed per-tenant demand: most load from two tenants, a long
+#: tail, and the burst tenant nearly quiet — until it bursts.
+TENANT_DEMAND = (("ml-infra", 0.45), ("research", 0.25),
+                 ("startup", 0.20), ("burst-co", 0.10))
+
+
+def make_tenant_storm(
+    num_jobs: int,
+    *,
+    seed: int = 0,
+    arrival_span: int = 12,
+    burst_tenant: str = "burst-co",
+    burst_factor: int = 10,
+    burst_tick: int = 4,
+    slice_widths=((1, 0.60), (2, 0.25), (4, 0.15)),
+    min_duration: int = 2,
+    max_duration: int = 6,
+) -> List[StormJob]:
+    """The seeded multi-tenant storm (ISSUE 13): the base storm's
+    priority/width mix spread over leaf tenants by the heavy-tailed
+    demand table, PLUS one 10x burst — ``burst_tenant`` submits
+    ``burst_factor`` x its baseline job count in a three-tick window of
+    HIGH-priority gangs. Under raw priority that burst evicts whoever
+    is cheapest, below-fair-share tenants included (the violations the
+    baseline leg records); under weighted DRF the burster may only
+    displace tenants above their fair share."""
+    base = make_storm(num_jobs, seed=seed, arrival_span=arrival_span,
+                      slice_widths=slice_widths,
+                      min_duration=min_duration,
+                      max_duration=max_duration)
+    rng = random.Random(seed + 131)
+    baseline_burst = 0
+    for j in base:
+        roll = rng.random()
+        acc = 0.0
+        ns = TENANT_DEMAND[-1][0]
+        for tenant, weight in TENANT_DEMAND:
+            acc += weight
+            if roll < acc:
+                ns = tenant
+                break
+        j.namespace = ns
+        if ns == burst_tenant:
+            baseline_burst += 1
+    n_burst = max(1, baseline_burst) * (burst_factor - 1)
+    for i in range(n_burst):
+        base.append(StormJob(
+            name=f"burst-{i:03d}",
+            priority=10,
+            klass="high",
+            num_slices=1 if rng.random() < 0.7 else 2,
+            arrival_tick=burst_tick + rng.randrange(3),
+            duration_ticks=rng.randint(min_duration, max_duration),
+            namespace=burst_tenant,
+        ))
+    return base
 
 
 def make_storm(
@@ -148,6 +222,18 @@ class StormReport:
     resizes: int = 0
     shrinks: int = 0
     grows: int = 0
+    # Multi-tenant storm (ISSUE 13): weighted-DRF leg markers and the
+    # fairness ledger. ``fairness_violations`` counts executed evictions
+    # of an at-or-below-fair-share tenant's gang by an over-fair-share
+    # tenant (MUST be 0 under enforcement — the count gate);
+    # ``tenant_protected`` counts evictions the DRF policy refused;
+    # ``tenant_yields`` counts admissions deferred to a more-deficit
+    # tenant's placeable gang.
+    tenant_mode: bool = False
+    drf: bool = False
+    fairness_violations: int = 0
+    tenant_protected: int = 0
+    tenant_yields: int = 0
 
     @property
     def accounting_exact(self) -> bool:
@@ -180,6 +266,11 @@ class StormReport:
             "resizes": self.resizes,
             "shrinks": self.shrinks,
             "grows": self.grows,
+            "tenant_mode": self.tenant_mode,
+            "drf": self.drf,
+            "fairness_violations": self.fairness_violations,
+            "tenant_protected": self.tenant_protected,
+            "tenant_yields": self.tenant_yields,
         }
 
 
@@ -237,17 +328,37 @@ def run_schedule_storm(
     # byte-identical: work is never lost (continuous checkpointing).
     ckpt_every_ticks: int = 0,
     ckpt_cost_ticks: int = 1,
+    # Multi-tenant storm (ISSUE 13): a list of tenant spec dicts (see
+    # DEFAULT_TENANT_SPECS) switches the generator to make_tenant_storm
+    # (heavy-tailed per-tenant demand + the 10x high-priority burst)
+    # and roots a TenantTree in the scheduler and the goodput ledger.
+    # ``drf=True`` enforces weighted DRF; False runs the observe-only
+    # raw-priority baseline whose fairness_violations the A/B records.
+    tenants: Optional[List[dict]] = None,
+    drf: bool = True,
+    burst_factor: int = 10,
+    burst_tick: int = 4,
     registry: Optional[MetricsRegistry] = None,
 ) -> StormReport:
     fleet_capacity = dict(fleet_capacity or {slice_type: 8})
-    storm = make_storm(num_jobs, seed=seed, arrival_span=arrival_span)
+    tree = None
+    if tenants is not None:
+        from kubeflow_tpu.tenancy import TenantTree
+
+        tree = TenantTree.from_specs(tenants)
+        storm = make_tenant_storm(
+            num_jobs, seed=seed, arrival_span=arrival_span,
+            burst_factor=burst_factor, burst_tick=burst_tick)
+    else:
+        storm = make_storm(num_jobs, seed=seed, arrival_span=arrival_span)
+    total_jobs = len(storm)
     registry = registry or MetricsRegistry()
     tracer = Tracer()
     api = InMemoryApiServer(registry=registry, tracer=tracer)
     mgr = ControllerManager(api, registry, tracer=tracer)
     fleet = Fleet.from_capacity(fleet_capacity, pool_size=pool_size)
     scheduler = GangScheduler(fleet, policy=policy, registry=registry,
-                              tracer=tracer)
+                              tracer=tracer, tenants=tree, drf=drf)
     # Logical-time storm: parked gangs are retried by the per-tick
     # kick_timers call below, never by wall-clock maturation (a real-time
     # park interval shorter than a slow host's drain would treadmill the
@@ -282,7 +393,8 @@ def run_schedule_storm(
     from kubeflow_tpu.obs.goodput import GoodputAccountant
 
     accountant = GoodputAccountant.from_fleet(
-        fleet, registry=registry, track_rollback=ckpt_every_ticks > 0)
+        fleet, registry=registry, track_rollback=ckpt_every_ticks > 0,
+        tenants=tree)
     accountant.attach(api)
 
     by_name = {j.name: j for j in storm}
@@ -345,7 +457,7 @@ def run_schedule_storm(
             if j.arrival_tick == t:
                 api.create(TpuJob(
                     metadata=ObjectMeta(name=j.name,
-                                        namespace=STORM_NAMESPACE),
+                                        namespace=j.namespace),
                     spec=TpuJobSpec(
                         slice_type=slice_type,
                         num_slices=j.num_slices,
@@ -443,7 +555,7 @@ def run_schedule_storm(
             accountant.set_checkpointing(uid, False)
         util_sum += 1.0 - len(fleet.free()) / total_units
         util_ticks += 1
-        if stop_when_done and len(jobs_now) == num_jobs and all(
+        if stop_when_done and len(jobs_now) == total_jobs and all(
                 j.status.phase in ("Succeeded", "Failed")
                 for j in jobs_now.values()):
             break
@@ -509,7 +621,7 @@ def run_schedule_storm(
     queue_age = registry.get("kftpu_scheduler_queue_age_seconds")
     report = StormReport(
         policy=policy,
-        submitted=num_jobs,
+        submitted=total_jobs,
         ticks=ticks,
         converged=converged,
         placed=placed,
@@ -534,6 +646,16 @@ def run_schedule_storm(
                     if e["direction"] == "shrink"),
         grows=sum(1 for e in scheduler.resize_log
                   if e["direction"] == "grow"),
+        tenant_mode=tree is not None,
+        drf=drf and tree is not None,
+        fairness_violations=sum(
+            1 for e in scheduler.preemption_log
+            if e.get("fair_violation")),
+        tenant_protected=int(registry.get(
+            "kftpu_scheduler_tenant_protected_total").value()),
+        tenant_yields=int(registry.get(
+            "kftpu_scheduler_placements_total").value(
+                outcome="tenant_yield")),
     )
     accountant.close()
     mgr.close()
@@ -566,3 +688,29 @@ def check_storm_gates(report: StormReport) -> None:
                 f"{attributed} attributed slice-ticks != "
                 f"{g['tracked_ticks']} tracked"
             )
+
+
+def check_tenant_gates(report: StormReport) -> None:
+    """The multi-tenant storm's hard gates on top of check_storm_gates
+    (raise, not assert): under DRF enforcement ZERO executed evictions
+    of an at-or-below-fair-share tenant by an over-fair-share tenant
+    (count-gated against the scheduler's decision log), and the storm
+    must be non-vacuous — preemptions actually happened and the ledger
+    actually attributed more than one tenant."""
+    check_storm_gates(report)
+    if not report.tenant_mode:
+        raise SystemExit("tenant gates on a non-tenant storm")
+    if report.drf and report.fairness_violations:
+        raise SystemExit(
+            f"[drf] {report.fairness_violations} fairness violations — "
+            "a below-fair-share tenant lost units to one above fair "
+            "share under enforcement")
+    if report.preemptions == 0:
+        raise SystemExit(
+            "tenant storm is vacuous: zero preemptions — the fairness "
+            "invariant was never exercised")
+    tenants = report.goodput.get("tenants", {})
+    if len(tenants) < 2:
+        raise SystemExit(
+            f"tenant storm attributed only {len(tenants)} tenant "
+            "subtree(s) — the ledger rollup is vacuous")

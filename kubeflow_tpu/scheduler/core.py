@@ -69,6 +69,18 @@ class GangScheduler:
         policy: str = "priority",
         registry: MetricsRegistry = global_registry,
         tracer: Tracer = global_tracer,
+        # Multi-tenant capacity market (ISSUE 13): a TenantTree makes
+        # every decision tenant-aware — preemption/placement logs carry
+        # tenant shares, and with ``drf=True`` the weighted-DRF policy
+        # is ENFORCED: admission yields to more-deficit tenants'
+        # placeable gangs, and a tenant above its fair share can never
+        # evict one at-or-below (the protection invariant the tenant
+        # storm count-gates). ``drf=False`` keeps the raw-priority
+        # policy but still attributes shares in the logs — the bench's
+        # observe-only baseline. No tree = the pre-ISSUE-13 scheduler,
+        # byte-identical.
+        tenants=None,
+        drf: bool = True,
     ):
         if policy not in POLICIES:
             raise ValueError(
@@ -76,6 +88,9 @@ class GangScheduler:
         self.fleet = fleet
         self.engine = PlacementEngine(fleet)
         self.policy = policy
+        self.tenants = tenants
+        self.drf = drf
+        self._chips_cache: Dict[str, int] = {}
         self.tracer = tracer
         self._lock = threading.RLock()
         # uid -> monotonic time the gang was first seen waiting; feeds
@@ -113,6 +128,17 @@ class GangScheduler:
             "kftpu_scheduler_resizes_total",
             "Elastic gang resizes executed by the fleet "
             "(partial release / partial grow)", labels=("direction",),
+        )
+        self.metrics_tenant_protected = registry.counter(
+            "kftpu_scheduler_tenant_protected_total",
+            "Evictions refused because the victim's tenant sat "
+            "at-or-below its weighted fair share while the requester's "
+            "sat above (the DRF protection invariant)",
+        )
+        self.metrics_tenant_violations = registry.counter(
+            "kftpu_scheduler_tenant_fairness_violations_total",
+            "Evictions of an at-or-below-fair-share tenant's gang by an "
+            "above-fair-share tenant (must stay 0 under DRF enforcement)",
         )
         self.metrics_ttp = registry.histogram(
             "kftpu_scheduler_time_to_place_seconds",
@@ -182,6 +208,126 @@ class GangScheduler:
     def growth_cap(self, job_uid: str) -> Optional[int]:
         with self._lock:
             return self._grow_caps.get(job_uid)
+
+    # ----------------- tenancy (ISSUE 13) -----------------
+
+    def _chips(self, slice_type: str) -> int:
+        c = self._chips_cache.get(slice_type)
+        if c is None:
+            try:
+                from kubeflow_tpu.topology import get_slice
+
+                c = get_slice(slice_type).num_chips
+            except Exception:  # noqa: BLE001 — unknown types count as 1
+                c = 1
+            self._chips_cache[slice_type] = c
+        return c
+
+    def _total_chips(self) -> int:
+        return sum(self.fleet.total(st) * self._chips(st)
+                   for st in self.fleet.slice_types())
+
+    def tenant_of(self, job) -> str:
+        """The job's leaf tenant (== its namespace when a Profile roots
+        it in the tree); "" = untenanted, tenant-blind behaviour."""
+        if self.tenants is None:
+            return ""
+        path = self.tenants.resolve(job.metadata.namespace)
+        return self.tenants.leaf_of_path(path)
+
+    #: Admitted=False reasons that block a gang BEFORE the scheduler —
+    #: quota/ledger gates. Such a gang is not schedulable demand: it
+    #: cannot consume capacity however large its tenant's deficit, so
+    #: it must neither earn its tenant a fair-share claim nor make
+    #: other tenants' gangs yield to it (the admission path would
+    #: otherwise idle free capacity behind a quota-starved tenant
+    #: indefinitely).
+    PRE_SCHEDULER_BLOCKS = ("QuotaExceeded", "InsufficientCapacity")
+
+    def _pre_scheduler_blocked(self, job) -> bool:
+        for c in job.status.conditions:
+            if c.type == "Admitted" and c.status == "False" \
+                    and c.reason in self.PRE_SCHEDULER_BLOCKS:
+                return True
+        return False
+
+    def tenant_shares(self, jobs):
+        """The fleet's weighted-DRF ledger right now: held slice-chips
+        per tenant (dominant resource) against hierarchical fair
+        fractions split among tenants with live, SCHEDULABLE demand
+        (quota-blocked gangs count for nothing — see
+        PRE_SCHEDULER_BLOCKS). None without a tree. The
+        ElasticController's grow ordering and `tpuctl queue` read this
+        surface too."""
+        if self.tenants is None or jobs is None:
+            return None
+        from kubeflow_tpu.tenancy.drf import compute_shares
+
+        held: Dict[str, int] = {}
+        demanding = set()
+        for j in jobs:
+            if j.status.phase in _TERMINAL:
+                continue
+            t = self.tenant_of(j)
+            if not t:
+                continue
+            units = self.fleet.assignment(j.metadata.uid)
+            if units:
+                held[t] = held.get(t, 0) + \
+                    len(units) * self._chips(j.spec.slice_type)
+            elif not self._pre_scheduler_blocked(j):
+                demanding.add(t)
+        return compute_shares(self.tenants, held_chips=held,
+                              demanding=demanding,
+                              total_chips=self._total_chips())
+
+    def _drf_blocked(self, job, jobs) -> Optional[Tuple[str, str]]:
+        """Weighted-DRF admission ordering: yield when a same-type gang
+        of a strictly-more-deficit tenant is waiting AND placeable right
+        now (the placeability test prevents a too-wide deficit gang from
+        head-of-line-blocking the fleet — DRF ordering, not FIFO).
+        Within one tenant, priority keeps deciding."""
+        shares = self.tenant_shares(jobs)
+        if shares is None:
+            return None
+        my = self.tenant_of(job)
+        if not my:
+            return None
+        my_deficit = shares.deficit(my)
+        st = job.spec.slice_type
+        # One placement search per distinct width: N pending peers of
+        # the same width must not cost N engine.find calls per attempt.
+        fits_width: Dict[int, bool] = {}
+        for other in jobs:
+            if other.metadata.uid == job.metadata.uid:
+                continue
+            if other.status.phase in _TERMINAL:
+                continue
+            if other.spec.slice_type != st:
+                continue
+            if self.fleet.assignment(other.metadata.uid) is not None:
+                continue
+            if self._pre_scheduler_blocked(other):
+                # Blocked by quota/ledger, not by placement: yielding
+                # to it would idle capacity nobody can take.
+                continue
+            ot = self.tenant_of(other)
+            if not ot or ot == my:
+                continue
+            if shares.deficit(ot) <= my_deficit + shares.eps:
+                continue
+            w = other.spec.num_slices
+            if w not in fits_width:
+                fits_width[w] = self.engine.find(st, w) is not None
+            if not fits_width[w]:
+                continue
+            return (
+                "TenantFairShare",
+                f"yielding to {other.metadata.namespace}/"
+                f"{other.metadata.name}: tenant {ot} deficit "
+                f"{shares.deficit(ot):.3f} > {my} {my_deficit:.3f}",
+            )
+        return None
 
     # ----------------- restart adoption -----------------
 
@@ -348,6 +494,14 @@ class GangScheduler:
                     self.metrics_queue_age.observe(
                         now - self._pending_since[uid])
                     return (None, blocked)
+            if self.policy == "priority" and self.tenants is not None \
+                    and self.drf:
+                blocked = self._drf_blocked(job, jobs or [])
+                if blocked is not None:
+                    self.metrics_queue_age.observe(
+                        now - self._pending_since[uid])
+                    self.metrics_placements.inc(outcome="tenant_yield")
+                    return (None, blocked)
 
             placement = self.engine.find(st, n)
             victims: List = []
@@ -463,8 +617,67 @@ class GangScheduler:
             p = self.engine.find(st, n, extra_free=set(extra_free))
             return p is not None
 
+        # Tenancy (ISSUE 13): victims are selected by weighted-DRF
+        # surplus first — the most-over-share tenant pays before anybody
+        # else; priority keeps breaking ties WITHIN a tenant. Under
+        # enforcement the candidate list is pruned by SIMULATING each
+        # planned eviction's share drop in selection order: a tenant may
+        # only pay down to its fair line, and a victim that would be
+        # protected AT ITS TURN never enters the set select_victims
+        # tests — so the chosen set is exactly executable, and when no
+        # executable set makes room NOTHING is evicted (a partial
+        # eviction that can never complete placement would otherwise
+        # retry-evict the restarted victim forever).
+        entry_shares = self.tenant_shares(jobs)
+        req_tenant = self.tenant_of(job)
+
+        def _order_key(j):
+            surplus = 0.0
+            if entry_shares is not None:
+                vt = self.tenant_of(j)
+                if vt:
+                    surplus = entry_shares.surplus(vt)
+            return (-surplus, j.spec.priority, len(units_of(j)),
+                    j.metadata.namespace, j.metadata.name)
+
+        if entry_shares is not None and self.drf:
+            held = dict(entry_shares.held_chips)
+            total = entry_shares.total_chips or 1
+            fair = entry_shares.fair
+            eps = entry_shares.eps
+            # The requester's share cannot change mid-round (it places
+            # only after the evictions), so over-fair is a constant.
+            req_over = bool(req_tenant) and (
+                held.get(req_tenant, 0) / total
+                > fair.get(req_tenant, 0.0) + eps)
+            allowed = []
+            for c in sorted(candidates, key=_order_key):
+                vt = self.tenant_of(c)
+                if req_over and vt and vt != req_tenant:
+                    if held.get(vt, 0) / total \
+                            <= fair.get(vt, 0.0) + eps:
+                        # Protected at this turn: the refusal the
+                        # kftpu_scheduler_tenant_protected_total counter
+                        # advertises happens HERE under enforcement (the
+                        # later per-victim re-check is belt-and-braces
+                        # and unreachable when this prune is correct).
+                        self.metrics_tenant_protected.inc()
+                        continue
+                allowed.append(c)
+                if vt:
+                    held[vt] = held.get(vt, 0) - \
+                        len(units_of(c)) * self._chips(c.spec.slice_type)
+            candidates = allowed
+            if not candidates:
+                return (None, [])
+        # Surplus-first ordering is part of the ENFORCED policy: the
+        # observe-only baseline (drf=False) must keep the raw
+        # lowest-priority-first order, or the A/B's baseline would be
+        # measured under half-enforced DRF.
         victims = preempt_mod.select_victims(
-            candidates, fits=fits, units_of=units_of)
+            candidates, fits=fits, units_of=units_of,
+            order_key=_order_key
+            if (entry_shares is not None and self.drf) else None)
         if victims is None:
             return (None, [])
         evicted: List = []
@@ -482,6 +695,27 @@ class GangScheduler:
                     "priority": job.spec.priority,
                 })
                 continue
+            # Fair-share re-check with FRESH shares (earlier evictions
+            # in this very round may have pushed the victim's tenant
+            # under its fair line): enforcement skips the eviction; the
+            # observe-only baseline executes it and records the
+            # violation — the count the tenant storm's A/B compares.
+            shares = self.tenant_shares(jobs)
+            victim_tenant = self.tenant_of(victim)
+            fair_violation = bool(
+                shares is not None and req_tenant and victim_tenant
+                and victim_tenant != req_tenant
+                and shares.over_fair(req_tenant)
+                and shares.at_or_below_fair(victim_tenant))
+            if fair_violation and self.drf:
+                self.metrics_tenant_protected.inc()
+                log.info("tenant fair-share protection", kv={
+                    "victim": victim.metadata.name,
+                    "victim_tenant": victim_tenant,
+                    "requester": job.metadata.name,
+                    "requester_tenant": req_tenant,
+                })
+                continue
             hit = preempt_mod.preempt_gang(api, victim)
             if hit == 0:
                 # Gang had no live pods (mid-transition): skip — the
@@ -492,14 +726,31 @@ class GangScheduler:
             freed.update(held)
             evicted.append(victim)
             self.metrics_preemptions.inc(reason="priority")
-            self._append(self.preemption_log, {
+            if fair_violation:
+                self.metrics_tenant_violations.inc()
+            entry = {
                 "victim": victim.metadata.name,
                 "victim_uid": victim.metadata.uid,
                 "victim_priority": victim.spec.priority,
                 "requester": job.metadata.name,
                 "requester_priority": job.spec.priority,
                 "units": held, "pods": hit, "reason": "priority",
-            })
+            }
+            if shares is not None:
+                entry.update({
+                    "victim_tenant": victim_tenant,
+                    "victim_share": round(shares.share(victim_tenant), 6)
+                    if victim_tenant else 0.0,
+                    "victim_fair": round(shares.fair_of(victim_tenant), 6)
+                    if victim_tenant else 0.0,
+                    "requester_tenant": req_tenant,
+                    "requester_share": round(shares.share(req_tenant), 6)
+                    if req_tenant else 0.0,
+                    "requester_fair": round(shares.fair_of(req_tenant), 6)
+                    if req_tenant else 0.0,
+                    "fair_violation": fair_violation,
+                })
+            self._append(self.preemption_log, entry)
             with self.tracer.span(
                 "schedule.preempt",
                 attrs={

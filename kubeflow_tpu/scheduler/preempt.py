@@ -92,22 +92,26 @@ def select_victims(
     *,
     fits,                    # Callable[[Set[str]], bool]: extra-free -> fit?
     units_of,                # Callable[[job], List[str]]: held unit uids
+    order_key=None,          # optional eviction-order override
 ) -> Optional[List]:
     """The minimal victim set whose freed units make the blocked gang
     place. ``candidates`` must already be filtered through
     :func:`is_restartable_victim`.
 
-    Greedy from the cheapest eviction up — lowest priority first, then
-    smallest gang, then name — adding victims until ``fits`` says the
-    gang places; then an inclusion-prune drops every victim whose units
-    turn out unnecessary (re-testing the fit without them), so no gang
-    is evicted that the placement did not need. Returns None when even
-    evicting every candidate cannot make room."""
-    ordered = sorted(
-        candidates,
-        key=lambda j: (j.spec.priority, len(units_of(j)),
-                       j.metadata.namespace, j.metadata.name),
-    )
+    Greedy from the cheapest eviction up — by default lowest priority
+    first, then smallest gang, then name (``order_key`` overrides the
+    default: the tenancy layer orders by weighted-DRF surplus so the
+    most-over-share tenant pays first) — adding victims until ``fits``
+    says the gang places; then an inclusion-prune drops every victim
+    whose units turn out unnecessary (re-testing the fit without them),
+    so no gang is evicted that the placement did not need. Returns None
+    when even evicting every candidate cannot make room."""
+    custom_order = order_key is not None
+    if order_key is None:
+        def order_key(j):
+            return (j.spec.priority, len(units_of(j)),
+                    j.metadata.namespace, j.metadata.name)
+    ordered = sorted(candidates, key=order_key)
     chosen: List = []
     freed: Set[str] = set()
     for job in ordered:
@@ -117,11 +121,14 @@ def select_victims(
         freed.update(units_of(job))
     if not fits(freed):
         return None
-    # Inclusion-prune, most expensive victims first: keep the set minimal.
+    # Inclusion-prune, most expensive victims first (the reverse of the
+    # greedy order): keep the set minimal.
     for job in sorted(
         chosen,
-        key=lambda j: (-j.spec.priority, -len(units_of(j)),
-                       j.metadata.namespace, j.metadata.name),
+        key=order_key if custom_order else
+        (lambda j: (-j.spec.priority, -len(units_of(j)),
+                    j.metadata.namespace, j.metadata.name)),
+        reverse=custom_order,
     ):
         trial = [j for j in chosen if j is not job]
         still: Set[str] = set()

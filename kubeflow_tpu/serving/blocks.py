@@ -52,6 +52,36 @@ def prefix_key(tokens: Sequence[int], n: int = PREFIX_KEY_TOKENS) -> str:
     return f"p:{h[:12]}"
 
 
+#: Chunk width of the prefix-key CHAIN (the radix-tree satellite of
+#: ISSUE 13): prefix identity is hashed at every PREFIX_CHAIN_BLOCK-token
+#: boundary up to PREFIX_KEY_TOKENS, so two prompts sharing only part of
+#: their head still share the chain keys covering the common blocks.
+PREFIX_CHAIN_BLOCK = 8
+
+
+def prefix_chain(tokens: Sequence[int],
+                 block_size: int = PREFIX_CHAIN_BLOCK,
+                 max_tokens: int = PREFIX_KEY_TOKENS) -> List[str]:
+    """Block-aligned prefix-key chain, shortest head first: key ``i``
+    hashes the first ``(i+1) * block_size`` token ids. This is the
+    compressed-radix identity of the prompt's head — matching the
+    LONGEST shared chain key is exactly a radix-tree longest-prefix
+    lookup, without storing raw token ids anywhere off the engine.
+    Prompts shorter than one block have no chain (no shared head worth
+    routing for). The exact 32-token :func:`prefix_key` remains the
+    session-grade identity; the chain generalises it to partial
+    overlaps."""
+    n_blocks = min(len(tokens), max_tokens) // block_size
+    out: List[str] = []
+    for i in range(n_blocks):
+        h = hashlib.sha1(
+            ",".join(str(int(t))
+                     for t in tokens[:(i + 1) * block_size]).encode()
+        ).hexdigest()
+        out.append(f"c:{h[:12]}:{i + 1}")
+    return out
+
+
 def blocks_for_tokens(tokens: int, block_size: int) -> int:
     """Blocks covering ``tokens`` KV positions (ceil division; a
     zero-token request still pins one block — every admitted sequence

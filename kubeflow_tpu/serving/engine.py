@@ -50,6 +50,7 @@ from kubeflow_tpu.serving.blocks import (
     BlocksExhausted,
     KVBlockAllocator,
     blocks_for_tokens,
+    prefix_chain,
     prefix_key,
 )
 from kubeflow_tpu.utils import get_logger
@@ -790,7 +791,11 @@ class ServingEngine:
         with self._load_lock:
             self._resident_prefixes.pop(key, None)
             self._resident_prefixes[key] = time.monotonic()
-            while len(self._resident_prefixes) > 32:
+            # 128, not 32: each admission now notes up to six keys
+            # (exact head + radix chain + session), so the LRU must be
+            # deeper to remember a comparable number of distinct
+            # prompts.
+            while len(self._resident_prefixes) > 128:
                 self._resident_prefixes.popitem(last=False)
 
     def load(self) -> dict:
@@ -933,6 +938,13 @@ class ServingEngine:
             self.metrics_queue_wait.observe(wait)
             self._recent_queue_waits.append((time.monotonic(), wait))
             self._note_resident(prefix_key(req.prompt))
+            # Radix chain keys too (ISSUE 13): the LB's longest-prefix
+            # lookup matches resident hints at every block-aligned head
+            # depth, so a partially overlapping prompt can re-learn the
+            # residency from the load report, not only from the LB's
+            # own pin map.
+            for chain_key in prefix_chain(req.prompt):
+                self._note_resident(chain_key)
             if req.session:
                 self._note_resident(f"s:{req.session}")
             if mid_step:

@@ -63,7 +63,7 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
-from kubeflow_tpu.serving.blocks import prefix_key
+from kubeflow_tpu.serving.blocks import prefix_chain, prefix_key
 from kubeflow_tpu.utils import get_logger
 from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
 from kubeflow_tpu.webapps.router import (
@@ -84,6 +84,31 @@ PREFIX_KEY_MIN_TOKENS = 8
 
 #: LB-side affinity map capacity (key -> last backend address). LRU.
 AFFINITY_MAP_SIZE = 4096
+
+#: Tenanted arrivals the fair-share window covers (ISSUE 13): large
+#: enough that a real burst cannot hide inside it, small enough that
+#: an hour-old traffic mix no longer decides who sheds now.
+TENANT_WINDOW = 4096
+
+
+def derive_affinity_keys(body: dict,
+                         prefix_match: str = "radix") -> List[str]:
+    """THE affinity-key derivation, most specific first — shared by the
+    LB's dispatch and the bench replicas' ground-truth hit counting
+    (tools/loadtest), so routing and measurement can never
+    desynchronize. Sessions keep their single sticky key; in radix mode
+    a token prompt carries its block-aligned prefix-key chain (longest
+    head first) behind the exact 32-token key."""
+    primary = ServingLoadBalancer.affinity_key(body)
+    keys = [primary] if primary else []
+    if prefix_match != "radix" or (primary or "").startswith("s:"):
+        return keys
+    tokens = body.get("tokens")
+    if (isinstance(tokens, list)
+            and len(tokens) >= PREFIX_KEY_MIN_TOKENS
+            and all(isinstance(t, int) for t in tokens)):
+        keys.extend(reversed(prefix_chain(tokens)))
+    return keys
 
 
 class Backend:
@@ -194,8 +219,27 @@ class ServingLoadBalancer:
         breaker_cooldown_s: float = 5.0,
         affinity: bool = True,
         affinity_weight: float = 2.0,
+        # Prefix-affinity matching (ISSUE 13 satellite): "radix" matches
+        # the LONGEST shared block-aligned head through the prefix-key
+        # chain (serving.blocks.prefix_chain) so partially overlapping
+        # prompts still credit affinity; "exact" keeps the PR-12
+        # 32-token-head hash alone — the A/B lever the affinity bench
+        # asserts on.
+        prefix_match: str = "radix",
+        # Multi-tenant shedding (ISSUE 13): tenant -> fair-share weight
+        # (a plain dict, or a tenancy.TenantTree whose leaf weights are
+        # used). At fleet saturation a tenant whose cumulative arrivals
+        # exceed its weighted fair fraction sheds FIRST — its burst pays,
+        # the in-share tenants' traffic keeps dispatching — with exact
+        # per-tenant shed accounting on /healthz. None = the pre-ISSUE-13
+        # blanket shedding, byte-identical.
+        tenants=None,
         registry: MetricsRegistry = global_registry,
     ):
+        if prefix_match not in ("radix", "exact"):
+            raise ValueError(
+                f"prefix_match must be 'radix' or 'exact', "
+                f"got {prefix_match!r}")
         self.connect_timeout_s = connect_timeout_s
         self.request_timeout_s = request_timeout_s
         self.health_timeout_s = health_timeout_s
@@ -221,8 +265,51 @@ class ServingLoadBalancer:
         # circuits and saturation always run first.
         self.affinity = affinity
         self.affinity_weight = affinity_weight
+        self.prefix_match = prefix_match
         self._affinity: "collections.OrderedDict[str, str]" = \
             collections.OrderedDict()
+        # Tenant market state (ISSUE 13): weights, the namespace->tenant
+        # resolver, cumulative arrival counts (the fair-share
+        # denominator) and the exact shed ledger.
+        self._tenant_weights: Dict[str, float] = {}
+        self._tenant_tree = None
+        if tenants is not None:
+            if hasattr(tenants, "resolve"):       # a tenancy.TenantTree
+                self._tenant_tree = tenants
+                self._tenant_weights = {
+                    name: tenants.node(name).weight
+                    for name in tenants.names()
+                }
+            else:
+                self._tenant_weights = {k: float(v)
+                                        for k, v in dict(tenants).items()}
+        self.tenant_arrivals: Dict[str, int] = {}
+        self.shed_by_tenant: Dict[str, int] = {}
+        self.shed_untenanted = 0
+        # Fair shares are computed over a SLIDING WINDOW of the last
+        # TENANT_WINDOW tenanted arrivals, not since-boot totals: on a
+        # long-lived LB, cumulative counts would let a long-quiet
+        # tenant's fresh burst dispatch while the historically-busy
+        # in-share tenant sheds — fairness inverted by ancient history.
+        self._tenant_window: "collections.deque[str]" = \
+            collections.deque()
+        self._tenant_window_counts: Dict[str, int] = {}
+        # Session registry: session id -> namespace, for traffic whose
+        # only identity is its session key (the "session key ->
+        # namespace -> tenant" resolution leg). Populated by the
+        # operator/front-end (e.g. at session issue time).
+        self.session_namespaces: Dict[str, str] = {}
+        # Over-share slack in REQUESTS: fair fractions are continuous
+        # but arrivals are integers, so whichever in-share tenant's
+        # request lands first in a round reads fractionally "over" —
+        # one request of slack absorbs that granularity without letting
+        # a real burst (many requests over) hide in it.
+        self.tenant_slack_requests = 1.0
+        self.metrics_tenant_sheds = registry.counter(
+            "kftpu_lb_tenant_sheds_total",
+            "Saturation sheds charged to an over-fair-share tenant",
+            labels=("tenant",),
+        )
         self.affinity_hits = 0              # routed onto resident blocks
         self.affinity_rerouted = 0          # key known, landed elsewhere
         self.affinity_new = 0               # first sighting of the key
@@ -251,6 +338,83 @@ class ServingLoadBalancer:
                 and all(isinstance(t, int) for t in tokens)):
             return prefix_key(tokens)
         return None
+
+    def affinity_keys(self, body: dict) -> List[str]:
+        """The request's affinity identities, most specific first
+        (:func:`derive_affinity_keys` under this LB's matching mode).
+        With ``prefix_match="radix"`` a prompt sharing only PART of its
+        head with earlier traffic still matches — the radix-tree
+        longest-prefix lookup of the ISSUE-13 satellite; "exact" keeps
+        the PR-12 identity alone (the A/B lever)."""
+        return derive_affinity_keys(body, self.prefix_match)
+
+    # ------------- tenant resolution (ISSUE 13) -------------
+
+    def resolve_tenant(self, body: dict,
+                       headers: Optional[Dict[str, str]] = None
+                       ) -> Optional[str]:
+        """Request -> tenant: the ``x-kftpu-tenant`` header or body
+        ``tenant`` wins; else a namespace (``x-kftpu-namespace`` header
+        or body ``namespace``) resolves through the tenant tree /
+        weight table. None = untenanted (or no tenant market
+        configured): tenant-blind behaviour."""
+        if not self._tenant_weights:
+            return None
+        headers = headers or {}
+        t = headers.get("x-kftpu-tenant") or body.get("tenant")
+        if isinstance(t, str) and t in self._tenant_weights:
+            return t
+        ns = headers.get("x-kftpu-namespace") or body.get("namespace")
+        if not ns:
+            session = body.get("session")
+            if isinstance(session, str) and session:
+                ns = self.session_namespaces.get(session)
+        if isinstance(ns, str) and ns:
+            if self._tenant_tree is not None:
+                path = self._tenant_tree.resolve(ns)
+                leaf = self._tenant_tree.leaf_of_path(path)
+                return leaf or None
+            if ns in self._tenant_weights:
+                return ns
+        return None
+
+    def note_tenant_arrival(self, tenant: Optional[str]) -> None:
+        """Count one offered request toward the tenant's demand — the
+        cumulative ledger (/healthz accounting) AND the sliding
+        fair-share window the shed decision divides by. Counted once
+        per request (never per dispatch retry)."""
+        if tenant is None:
+            return
+        with self._lock:
+            self.tenant_arrivals[tenant] = \
+                self.tenant_arrivals.get(tenant, 0) + 1
+            self._tenant_window.append(tenant)
+            self._tenant_window_counts[tenant] = \
+                self._tenant_window_counts.get(tenant, 0) + 1
+            while len(self._tenant_window) > TENANT_WINDOW:
+                old = self._tenant_window.popleft()
+                n = self._tenant_window_counts.get(old, 0) - 1
+                if n > 0:
+                    self._tenant_window_counts[old] = n
+                else:
+                    self._tenant_window_counts.pop(old, None)
+
+    def _tenant_overage_locked(self, tenant: str) -> float:
+        """Windowed arrivals beyond the tenant's weighted fair fraction
+        of the window's tenanted arrivals (> 0 = over share, the shed
+        trigger). Fair fractions split by weight among tenants present
+        in the window — work-conserving, like the scheduler's DRF."""
+        total = len(self._tenant_window)
+        if total <= 0:
+            return 0.0
+        weights = {t: self._tenant_weights.get(t, 1.0)
+                   for t, n in self._tenant_window_counts.items()
+                   if n > 0}
+        wsum = sum(weights.values())
+        if tenant not in weights or wsum <= 0:
+            return 0.0
+        fair = total * weights[tenant] / wsum
+        return self._tenant_window_counts.get(tenant, 0) - fair
 
     # ------------- backend set management -------------
 
@@ -297,7 +461,11 @@ class ServingLoadBalancer:
             interval = self.health_timeout_s
         return str(max(1, int(math.ceil(max(interval, drain_estimate_s)))))
 
-    def _acquire(self, key: Optional[str] = None) -> Backend:
+    def _acquire(self, key: Optional[str] = None, *,
+                 keys: Optional[List[str]] = None,
+                 tenant: Optional[str] = None) -> Backend:
+        lookup = list(keys) if keys is not None else (
+            [key] if key is not None else [])
         with self._lock:
             now = time.monotonic()
             live = [b for b in self._backends.values()
@@ -309,28 +477,58 @@ class ServingLoadBalancer:
             ready = [b for b in live
                      if not b.saturated(self.queue_watermark)]
             if not ready:
-                # Every live backend is past its depth watermark: shed.
-                # Admitted work keeps its SLO; the excess fails fast with
-                # an honest backoff: the SOONEST any backend's queue
+                # Every live backend is past its depth watermark.
+                # Tenant market (ISSUE 13): the most-over-share tenant's
+                # traffic sheds FIRST — a tenant whose cumulative
+                # arrivals exceed its weighted fair fraction pays for
+                # its own burst (exact per-tenant tally), while
+                # at-or-under-share tenants' requests keep dispatching
+                # onto the least-loaded live backend (the engine's own
+                # bounded admission still protects it). Without a
+                # tenant market (or for untenanted traffic) everything
+                # sheds, the pre-ISSUE-13 contract. The Retry-After is
+                # honest either way: the SOONEST any backend's queue
                 # drains (continuous-batching slot-free rate when
                 # reported) — the client can be served by whichever
-                # frees first, so min, not max; the step-boundary
-                # estimate this replaces overestimated the wait.
-                self.shed_total += 1
-                ests = [e for e in (b.drain_estimate_s() for b in live)
-                        if e > 0]
-                drain = min(ests, default=0.0)
-                raise RestError(
-                    503, "all serving backends saturated; shedding",
-                    headers={"Retry-After": self._retry_after(drain)})
+                # frees first, so min, not max.
+                in_share = (tenant is not None
+                            and self._tenant_overage_locked(tenant)
+                            <= self.tenant_slack_requests)
+                if not in_share:
+                    self.shed_total += 1
+                    if tenant is not None:
+                        self.shed_by_tenant[tenant] = \
+                            self.shed_by_tenant.get(tenant, 0) + 1
+                        self.metrics_tenant_sheds.inc(tenant=tenant)
+                    elif self._tenant_weights:
+                        self.shed_untenanted += 1
+                    ests = [e for e in (b.drain_estimate_s() for b in live)
+                            if e > 0]
+                    drain = min(ests, default=0.0)
+                    msg = ("all serving backends saturated; shedding"
+                           if tenant is None else
+                           f"fleet saturated; tenant {tenant} over fair "
+                           "share — shedding its burst first")
+                    raise RestError(
+                        503, msg,
+                        headers={"Retry-After": self._retry_after(drain)})
+                ready = live
             resident = None
-            if self.affinity and key is not None:
-                target = self._affinity.get(key)
+            if self.affinity and lookup:
+                # Longest-prefix (radix) lookup: the first key — they
+                # are ordered most specific first — found in the LB's
+                # own pin map decides the remembered target; a backend
+                # is "resident" when ANY key appears in its reported
+                # resident set.
+                target = next((self._affinity[k] for k in lookup
+                               if k in self._affinity), None)
                 resident = [b for b in ready
-                            if key in b.resident_prefixes
+                            if any(k in b.resident_prefixes
+                                   for k in lookup)
                             or b.addr == target]
                 known = target is not None or any(
-                    key in b.resident_prefixes for b in live)
+                    k in b.resident_prefixes
+                    for b in live for k in lookup)
                 bonus = {id(b): self.affinity_weight for b in resident}
                 b = min(ready, key=lambda b: b.score()
                         - bonus.get(id(b), 0.0))
@@ -347,8 +545,9 @@ class ServingLoadBalancer:
                     self.affinity_new += 1
                     outcome = "new"
                 self.metrics_affinity.inc(outcome=outcome)
-                self._affinity.pop(key, None)
-                self._affinity[key] = b.addr
+                for k in lookup:
+                    self._affinity.pop(k, None)
+                    self._affinity[k] = b.addr
                 while len(self._affinity) > AFFINITY_MAP_SIZE:
                     self._affinity.popitem(last=False)
             else:
@@ -458,7 +657,12 @@ class ServingLoadBalancer:
     def _generate(self, req: Request):
         body = json.dumps(req.body).encode()
         stream = bool(req.body.get("stream", False))
-        key = self.affinity_key(req.body)
+        keys = self.affinity_keys(req.body)
+        tenant = self.resolve_tenant(req.body,
+                                     getattr(req, "headers", None))
+        # One arrival per REQUEST (not per dispatch retry): the
+        # fair-share denominator must count offered load exactly.
+        self.note_tenant_arrival(tenant)
         # Failover: a backend that dies between health checks should cost
         # the client nothing — retry the next-least-loaded until none left.
         # Streams only fail over before the first upstream byte.
@@ -466,7 +670,7 @@ class ServingLoadBalancer:
         with self._lock:
             max_tries = max(1, len(self._backends))
         while True:
-            b = self._acquire(key)
+            b = self._acquire(keys=keys, tenant=tenant)
             tried += 1
             upstream = urllib.request.Request(
                 f"{b.url}/v1/generate", data=body,
@@ -564,6 +768,20 @@ class ServingLoadBalancer:
                    "affinity_hits": self.affinity_hits,
                    "affinity_rerouted": self.affinity_rerouted,
                    "affinity_new": self.affinity_new}
+        if self._tenant_weights:
+            # Exact per-tenant shed accounting (ISSUE 13): every
+            # saturation shed is charged to exactly one bucket, so
+            # shed_total == sum(tenant sheds) + shed_untenanted — the
+            # invariant the tenant-burst soak gates.
+            with self._lock:
+                payload["tenants"] = {
+                    t: {"weight": self._tenant_weights.get(t, 1.0),
+                        "arrivals": self.tenant_arrivals.get(t, 0),
+                        "sheds": self.shed_by_tenant.get(t, 0)}
+                    for t in sorted(set(self._tenant_weights)
+                                    | set(self.tenant_arrivals))
+                }
+                payload["shed_untenanted"] = self.shed_untenanted
         return payload if ok else (503, payload)
 
     def router(self) -> Router:

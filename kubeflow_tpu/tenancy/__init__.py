@@ -1,0 +1,39 @@
+"""Multi-tenant capacity market (ISSUE 13).
+
+Turns Profiles into a hierarchical tenant tree (org -> team -> user)
+and makes every allocation decision fair-share-aware:
+
+- :mod:`~kubeflow_tpu.tenancy.tree` — the quota tree: Profile.spec
+  grows ``parent``/``weight``/``goodput_slo``; ``TenantTree`` resolves
+  a namespace to its tenant path, validates hierarchical chip quotas
+  top-down (a child's quota can never exceed its parent's) and flags
+  over-commit (siblings summing past the parent) without forbidding it.
+- :mod:`~kubeflow_tpu.tenancy.drf` — weighted dominant-resource fair
+  sharing: dominant share = held slice-chips / fleet chips, divided by
+  weight; fair fractions split hierarchically by weight among tenants
+  with live demand. The scheduler's protection invariant (the bench
+  gate): no tenant at-or-below its weighted fair share is ever
+  preempted by a tenant above its fair share.
+- SLO burn rate (:func:`~kubeflow_tpu.tenancy.drf.slo_burn`): the
+  goodput ledger's per-tenant ratio against ``Profile.spec.goodput_slo``
+  drives the alert state ``tpuctl tenants`` shows.
+"""
+
+from kubeflow_tpu.tenancy.drf import (
+    SLO_PAGE_BURN,
+    TenantShares,
+    compute_shares,
+    slo_burn,
+    slo_state,
+)
+from kubeflow_tpu.tenancy.tree import TenantNode, TenantTree
+
+__all__ = [
+    "SLO_PAGE_BURN",
+    "TenantNode",
+    "TenantShares",
+    "TenantTree",
+    "compute_shares",
+    "slo_burn",
+    "slo_state",
+]

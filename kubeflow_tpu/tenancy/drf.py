@@ -1,0 +1,114 @@
+"""Weighted dominant-resource fair sharing + SLO burn math.
+
+The scarce resource the market arbitrates is whole-slice time (the
+concurrency-limits measurement of arxiv 2011.03641), so the dominant
+resource is SLICE-CHIPS: a tenant's dominant share is the chips its
+gangs currently hold divided by the fleet's chips. Weighted DRF divides
+that by the tenant's weight; the scheduler keeps every tenant's
+weighted share as equal as placement allows by
+
+- admitting the most-deficit tenant's placeable gang first, and
+- never letting a tenant ABOVE its fair share evict one at-or-below
+  (the protection invariant the bench count-gates — priority still
+  breaks ties within a tenant).
+
+Comparisons use an epsilon one chip wide (shares are ratios of small
+integers; exact float equality would misread a tenant sitting exactly
+at its fair line).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, Optional
+
+from kubeflow_tpu.tenancy.tree import TenantTree
+
+#: Burn rate at which the SLO state escalates from warn to page.
+SLO_PAGE_BURN = 2.0
+
+
+@dataclasses.dataclass
+class TenantShares:
+    """Point-in-time fair-share ledger over the ACTIVE tenants."""
+
+    shares: Dict[str, float]        # tenant -> held chips / fleet chips
+    fair: Dict[str, float]          # tenant -> weighted fair fraction
+    held_chips: Dict[str, int]
+    total_chips: int
+
+    @property
+    def eps(self) -> float:
+        # One chip of slack: below that resolution "over" vs "under"
+        # is noise, not policy.
+        return 1.0 / self.total_chips if self.total_chips > 0 else 1e-9
+
+    def share(self, tenant: str) -> float:
+        return self.shares.get(tenant, 0.0)
+
+    def fair_of(self, tenant: str) -> float:
+        return self.fair.get(tenant, 0.0)
+
+    def deficit(self, tenant: str) -> float:
+        """Fair fraction minus held share: positive = under-served (the
+        queue/grow ordering key — biggest deficit first)."""
+        return self.fair_of(tenant) - self.share(tenant)
+
+    def at_or_below_fair(self, tenant: str) -> bool:
+        return self.share(tenant) <= self.fair_of(tenant) + self.eps
+
+    def over_fair(self, tenant: str) -> bool:
+        return self.share(tenant) > self.fair_of(tenant) + self.eps
+
+    def surplus(self, tenant: str) -> float:
+        return self.share(tenant) - self.fair_of(tenant)
+
+
+def compute_shares(
+    tree: TenantTree,
+    *,
+    held_chips: Dict[str, int],
+    demanding: Iterable[str] = (),
+    total_chips: int,
+) -> TenantShares:
+    """Build the fair-share ledger: ``held_chips`` maps tenant (leaf
+    name == namespace) to chips its gangs hold; ``demanding`` names
+    tenants with queued-but-unplaced gangs (active even while holding
+    nothing — fair fractions are split only among tenants that want
+    capacity, the work-conserving rule)."""
+    active = {t for t, c in held_chips.items() if c > 0}
+    active.update(demanding)
+    fair = tree.fair_fractions(active)
+    shares = {
+        t: (held_chips.get(t, 0) / total_chips if total_chips > 0 else 0.0)
+        for t in active if tree.node(t) is not None
+    }
+    return TenantShares(
+        shares=shares, fair=fair,
+        held_chips={t: int(held_chips.get(t, 0)) for t in shares},
+        total_chips=int(total_chips),
+    )
+
+
+def slo_burn(goodput_ratio: float, slo: float) -> Optional[float]:
+    """Error-budget burn rate: the tenant's badput fraction
+    (1 - goodput) over the budget its SLO allows (1 - slo). 1.0 = the
+    budget burns exactly at its sustainable rate; above = alerting
+    territory. None when no SLO is declared (slo <= 0) or the SLO
+    leaves no budget (slo >= 1)."""
+    if slo <= 0.0 or slo >= 1.0:
+        return None
+    return (1.0 - goodput_ratio) / (1.0 - slo)
+
+
+def slo_state(burn: Optional[float]) -> str:
+    """The scoreboard state: ``-`` (no SLO), ``ok`` (inside budget),
+    ``warn`` (burning faster than sustainable), ``page`` (burning at
+    >= SLO_PAGE_BURN x)."""
+    if burn is None:
+        return "-"
+    if burn <= 1.0:
+        return "ok"
+    if burn < SLO_PAGE_BURN:
+        return "warn"
+    return "page"

@@ -1,0 +1,230 @@
+"""The hierarchical quota tree rooted at Profiles.
+
+A Profile IS a tenant: its name is the namespace it provisions, its new
+``spec.parent`` names another Profile (org -> team -> user chains of any
+depth), ``spec.weight`` is its fair-share weight among siblings, and
+``spec.tpu_chip_quota`` stays the hierarchical chip ceiling. The tree
+resolves every namespace to a tenant *path* (``org/team/user``) — the
+label the goodput ledger, the scheduler's fairness invariant and the
+serving LB all key on.
+
+Validation is top-down and non-fatal where the platform can keep
+running: a child quota larger than its parent's is an ERROR (a child can
+never out-quota its subtree's share); siblings whose quotas sum past the
+parent are OVER-COMMIT — allowed (the classic borrow-while-idle posture)
+but flagged, so ``tpuctl tenants`` and the profile controller surface
+it. Unknown parents and cycles degrade to root-attached tenants with a
+flag, never a crash: a half-applied org chart must not take scheduling
+down with it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class TenantNode:
+    name: str
+    parent: str = ""                  # "" = a root tenant
+    weight: float = 1.0
+    quota_chips: int = 0              # 0 = unlimited at this level
+    goodput_slo: float = 0.0          # 0 = no SLO declared
+    children: List[str] = dataclasses.field(default_factory=list)
+
+
+class TenantTree:
+    """Immutable-after-build tenant hierarchy + namespace resolution."""
+
+    def __init__(self, nodes: Dict[str, TenantNode]):
+        self._nodes = nodes
+        self._flags: List[str] = []
+        self._link()
+
+    # ----------------- construction -----------------
+
+    @classmethod
+    def from_profiles(cls, profiles: Iterable) -> "TenantTree":
+        """Build from live Profile objects (the platform path)."""
+        nodes: Dict[str, TenantNode] = {}
+        for p in profiles:
+            spec = p.spec
+            nodes[p.metadata.name] = TenantNode(
+                name=p.metadata.name,
+                parent=getattr(spec, "parent", "") or "",
+                weight=float(getattr(spec, "weight", 1.0) or 1.0),
+                quota_chips=int(getattr(spec, "tpu_chip_quota", 0) or 0),
+                goodput_slo=float(getattr(spec, "goodput_slo", 0.0) or 0.0),
+            )
+        return cls(nodes)
+
+    @classmethod
+    def from_specs(cls, specs: Iterable[dict]) -> "TenantTree":
+        """Build from plain dicts (benches/tests):
+        ``{"name": ..., "parent": ..., "weight": ..., "quota_chips": ...,
+        "goodput_slo": ...}``."""
+        nodes = {
+            s["name"]: TenantNode(
+                name=s["name"],
+                parent=s.get("parent", "") or "",
+                weight=float(s.get("weight", 1.0)),
+                quota_chips=int(s.get("quota_chips", 0)),
+                goodput_slo=float(s.get("goodput_slo", 0.0)),
+            )
+            for s in specs
+        }
+        return cls(nodes)
+
+    def _link(self) -> None:
+        for n in self._nodes.values():
+            if n.weight <= 0:
+                self._flags.append(
+                    f"tenant {n.name!r}: non-positive weight "
+                    f"{n.weight} treated as 1.0")
+                n.weight = 1.0
+        for n in self._nodes.values():
+            if n.parent and n.parent not in self._nodes:
+                self._flags.append(
+                    f"tenant {n.name!r}: unknown parent {n.parent!r} "
+                    "— attached at root")
+                n.parent = ""
+        # Cycle detection: walk each node to root; a revisit breaks the
+        # cycle at the revisited edge (root-attach) and flags it.
+        for name in sorted(self._nodes):
+            seen = set()
+            cur = name
+            while cur:
+                if cur in seen:
+                    self._flags.append(
+                        f"tenant cycle through {cur!r} — broken at root")
+                    self._nodes[cur].parent = ""
+                    break
+                seen.add(cur)
+                cur = self._nodes[cur].parent
+        for n in self._nodes.values():
+            n.children = []
+        for name in sorted(self._nodes):
+            parent = self._nodes[name].parent
+            if parent:
+                self._nodes[parent].children.append(name)
+
+    # ----------------- lookup -----------------
+
+    def node(self, name: str) -> Optional[TenantNode]:
+        return self._nodes.get(name)
+
+    def names(self) -> List[str]:
+        return sorted(self._nodes)
+
+    def flags(self) -> List[str]:
+        return list(self._flags)
+
+    def roots(self) -> List[str]:
+        return sorted(n.name for n in self._nodes.values() if not n.parent)
+
+    def ancestry(self, name: str) -> List[str]:
+        """Root-first chain of tenant names ending at ``name``; just
+        ``[name]`` for a root; [] for an unknown tenant."""
+        if name not in self._nodes:
+            return []
+        chain = []
+        cur: str = name
+        while cur:
+            chain.append(cur)
+            cur = self._nodes[cur].parent
+        return list(reversed(chain))
+
+    def resolve(self, namespace: str) -> str:
+        """Namespace -> tenant path (``org/team/user``). A namespace
+        without a Profile is untenanted: empty string (callers then
+        fall back to tenant-blind behaviour, the pre-ISSUE-13 contract)."""
+        if namespace not in self._nodes:
+            return ""
+        return "/".join(self.ancestry(namespace))
+
+    def leaf_of_path(self, path: str) -> str:
+        return path.rsplit("/", 1)[-1] if path else ""
+
+    # ----------------- validation -----------------
+
+    def validate(self) -> Tuple[List[str], List[str]]:
+        """(errors, overcommits). Errors are spec contradictions (child
+        quota > parent quota — a child can never exceed its subtree's
+        share); overcommits are allowed-but-flagged (children summing
+        past the parent's quota). Build-time flags (unknown parents,
+        cycles, bad weights) ride along as errors."""
+        errors = list(self._flags)
+        overcommit: List[str] = []
+        for name in sorted(self._nodes):
+            n = self._nodes[name]
+            if n.parent:
+                pq = self._nodes[n.parent].quota_chips
+                if pq > 0 and n.quota_chips > pq:
+                    errors.append(
+                        f"tenant {name!r}: quota {n.quota_chips} chips "
+                        f"exceeds parent {n.parent!r} quota {pq}")
+            if n.quota_chips > 0 and n.children:
+                child_sum = sum(
+                    self._nodes[c].quota_chips for c in n.children)
+                if child_sum > n.quota_chips:
+                    overcommit.append(
+                        f"tenant {name!r}: children declare {child_sum} "
+                        f"chips against a quota of {n.quota_chips} "
+                        "(over-commit allowed, flagged)")
+        return errors, overcommit
+
+    # ----------------- fair shares -----------------
+
+    def fair_fractions(self, active: Iterable[str]) -> Dict[str, float]:
+        """Hierarchical weighted fair split of the whole fleet among the
+        ``active`` tenants (those with live demand — held capacity or a
+        queued gang). At every level, a node's allocation divides among
+        its ACTIVE children by weight; a subtree with no active tenant
+        gets nothing (its share is available to siblings — work-
+        conserving fair sharing, the DRF posture). Returns
+        {tenant_name: fraction} for active tenants, summing to 1.0
+        (empty when nothing is active)."""
+        active_set = {a for a in active if a in self._nodes}
+        if not active_set:
+            return {}
+        live_subtree: Dict[str, bool] = {}
+
+        def subtree_active(name: str) -> bool:
+            if name in live_subtree:
+                return live_subtree[name]
+            n = self._nodes[name]
+            alive = name in active_set or any(
+                subtree_active(c) for c in n.children)
+            live_subtree[name] = alive
+            return alive
+
+        out: Dict[str, float] = {}
+
+        def spread(name: str, fraction: float) -> None:
+            n = self._nodes[name]
+            live_children = [c for c in n.children if subtree_active(c)]
+            # An ACTIVE node with active children keeps the weight-share
+            # it would have as one more sibling of its own children —
+            # the org's direct workloads compete with its teams.
+            claimants = list(live_children)
+            self_claims = name in active_set
+            total_w = sum(self._nodes[c].weight for c in claimants)
+            if self_claims:
+                total_w += n.weight
+            if not claimants:
+                if self_claims:
+                    out[name] = out.get(name, 0.0) + fraction
+                return
+            if self_claims and total_w > 0:
+                out[name] = out.get(name, 0.0) + \
+                    fraction * n.weight / total_w
+            for c in claimants:
+                spread(c, fraction * self._nodes[c].weight / total_w
+                       if total_w > 0 else 0.0)
+
+        live_roots = [r for r in self.roots() if subtree_active(r)]
+        root_w = sum(self._nodes[r].weight for r in live_roots)
+        for r in live_roots:
+            spread(r, self._nodes[r].weight / root_w if root_w > 0 else 0.0)
+        return out

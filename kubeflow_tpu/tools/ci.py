@@ -55,8 +55,10 @@ Stages (any failure exits non-zero — the merge gate contract):
    (exact accounting, KV-block conservation, non-vacuous mid-step
    admissions) and the seeded session-replay affinity A/B (hit-rate
    separation between affine and blind routing, conservation in both
-   runs); then the seeded drain/flap soak — zero requests routed to
-   draining/unhealthy backends (``--skip-serve``).
+   runs) plus the ISSUE-13 radix-vs-exact prefix-matching leg (radix
+   strictly wins the partial-overlap hit rate); then the seeded
+   drain/flap soak — zero requests routed to draining/unhealthy
+   backends (``--skip-serve``).
 8b. **schedule-smoke**: the gang-scheduler mixed-priority storm with a
    mid-storm slice-preemption burst (ISSUE 8) — exact gang accounting
    (placed + preempted + pending == submitted), zero priority
@@ -74,6 +76,13 @@ Stages (any failure exits non-zero — the merge gate contract):
    (``resumed_from_step`` never regresses, disk ends ahead of the last
    resume); goodput ledger conservation-exact with every resize
    attributed (``--skip-elastic``).
+8d. **tenant-smoke**: the multi-tenant capacity market (ISSUE 13) —
+   the seeded tenant storm under weighted-DRF enforcement, count-gated
+   on ZERO fairness violations (no at-or-below-fair-share tenant
+   evicted by one above fair share), exact accounting, bit-exact
+   goodput conservation with the per-tenant rollup non-vacuous; plus
+   the two-tenant 2x-burst serving soak gated on EXACT per-tenant shed
+   accounting (``--skip-tenant``).
 9. **bench-gate**: if --bench-json is given, require
    ``vs_baseline >= --min-vs-baseline`` for every record — the perf
    regression gate SURVEY §7.8 prescribes.
@@ -420,6 +429,19 @@ def run_affinity_smoke(seed: int = 12) -> None:
             f"{aff['blind']['hit_rate']}")
     if aff["affine"]["prefix_hits"] == 0:
         raise GateFailure("affinity-smoke: zero prefix hits — vacuous")
+    # Radix prefix-matching leg (ISSUE 13 satellite): the seeded
+    # PARTIAL-overlap family trace through radix vs exact matching over
+    # identical chain-aware replicas — the SAME gate contract bench.py
+    # enforces (loadtest.prefix_tree_gate_failures), raised CI-style.
+    from kubeflow_tpu.tools.loadtest import (
+        prefix_tree_gate_failures,
+        run_prefix_tree_bench,
+    )
+
+    ptree = run_prefix_tree_bench(duration_s=2.0)
+    failures = prefix_tree_gate_failures(ptree)
+    if failures:
+        raise GateFailure("affinity-smoke: " + "; ".join(failures))
 
 
 def run_serving_soak_smoke(seed: int = 20260803) -> None:
@@ -611,6 +633,44 @@ def run_elastic_smoke(seed: int = 20260803) -> None:
             f"but the ledger attributed {attributed}")
 
 
+def run_tenant_smoke(seed: int = 1, num_jobs: int = 24) -> None:
+    """Multi-tenant market smoke (ISSUE 13): the seeded tenant storm
+    under weighted-DRF enforcement — count-gated on ZERO fairness
+    violations (no at-or-below-fair-share tenant evicted by one above
+    fair share), exact gang accounting, zero inversions, bit-exact
+    goodput conservation with >= 2 tenant subtrees attributed — plus
+    the two-tenant 2x-burst serving soak gated on EXACT per-tenant shed
+    accounting (the burster's sheds cover its overage, the in-share
+    tenant sheds zero, every shed reconciles with the LB ledger)."""
+    from kubeflow_tpu.chaos.serving_soak import run_tenant_burst_soak
+    from kubeflow_tpu.scheduler.benchmark import (
+        DEFAULT_TENANT_SPECS,
+        check_tenant_gates,
+        run_schedule_storm,
+    )
+
+    rep = run_schedule_storm(
+        policy="priority", num_jobs=num_jobs, seed=seed,
+        tenants=list(DEFAULT_TENANT_SPECS), drf=True)
+    try:
+        check_tenant_gates(rep)
+    except SystemExit as e:
+        raise GateFailure(f"tenant-smoke (storm): {e}") from e
+    if not rep.converged:
+        raise GateFailure(
+            f"tenant-smoke (storm): did not converge in {rep.ticks} "
+            f"ticks ({rep.succeeded}+{rep.failed} of {rep.submitted})")
+    soak = run_tenant_burst_soak()
+    if not soak.clean:
+        raise GateFailure(
+            "tenant-smoke (serving shed): "
+            f"accounting_ok={soak.accounting_ok} "
+            f"ledger_ok={soak.ledger_ok} errors={soak.errors} "
+            f"in_share_sheds={soak.shed.get(soak.in_share_tenant, 0)} "
+            f"burst_sheds={soak.shed.get(soak.burst_tenant, 0)} "
+            f"overage={soak.burst_overage:.1f}")
+
+
 def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
              skip_smoke: bool = False, skip_chaos: bool = False,
              chaos_seed: int = 20260803, chaos_latency_s: float = 0.0,
@@ -620,7 +680,8 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
              skip_shard: bool = False,
              skip_serve: bool = False,
              skip_schedule: bool = False,
-             skip_elastic: bool = False) -> List[str]:
+             skip_elastic: bool = False,
+             skip_tenant: bool = False) -> List[str]:
     """Run all stages; returns the list of passed stages, raises
     GateFailure on the first failing one."""
     passed: List[str] = []
@@ -730,6 +791,11 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
         run_elastic_smoke(seed=chaos_seed)
         passed.append("elastic-smoke")
 
+    if not skip_tenant:
+        _stage("tenant-smoke")
+        run_tenant_smoke()
+        passed.append("tenant-smoke")
+
     if not skip_serve:
         _stage("serve-bench-smoke")
         run_serve_bench_smoke()
@@ -793,6 +859,9 @@ def main(argv=None) -> int:
                    help="skip the gang-scheduler storm smoke")
     g.add_argument("--skip-elastic", action="store_true",
                    help="skip the elastic capacity-oscillation soak smoke")
+    g.add_argument("--skip-tenant", action="store_true",
+                   help="skip the multi-tenant fairness storm + "
+                        "tenant-shed serving soak smoke")
     args = p.parse_args(argv)
     try:
         passed = run_gate(
@@ -809,6 +878,7 @@ def main(argv=None) -> int:
             skip_serve=args.skip_serve,
             skip_schedule=args.skip_schedule,
             skip_elastic=args.skip_elastic,
+            skip_tenant=args.skip_tenant,
         )
     except GateFailure as e:
         print(f"[ci] FAIL: {e}", file=sys.stderr)

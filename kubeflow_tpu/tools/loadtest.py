@@ -363,11 +363,14 @@ class SimServingReplica:
     # ------------- token-model shared pieces -------------
 
     def _parse_token_req(self, q) -> tuple:
-        """(demand_tokens, gen_tokens, affinity_key) from the body.
+        """(demand_tokens, gen_tokens, affinity_keys) from the body.
         ``prompt_tokens`` (int) wins; a real ``tokens`` list counts its
-        length. The affinity key mirrors the LB's derivation so replica
-        hit counts are ground truth for the routed key."""
-        from kubeflow_tpu.serving.lb import ServingLoadBalancer
+        length. The affinity keys are the LB's OWN radix derivation
+        (serving.lb.derive_affinity_keys — one code path, so replica
+        hit counts stay ground truth for the routed key no matter
+        which matching mode the LB runs: the A/B's replicas are
+        identical, only routing differs)."""
+        from kubeflow_tpu.serving.lb import derive_affinity_keys
 
         body = q.body or {}
         gen = max(1, int(body.get("gen_tokens", 1)))
@@ -376,7 +379,7 @@ class SimServingReplica:
             toks = body.get("tokens")
             prompt = len(toks) if isinstance(toks, list) else 16
         demand = min(int(prompt) + gen, self.max_len)
-        return demand, gen, ServingLoadBalancer.affinity_key(body)
+        return demand, gen, derive_affinity_keys(body)
 
     def _kv_demand(self, demand_tokens: int) -> int:
         """Positions reserved for a sequence: its actual demand under
@@ -402,21 +405,25 @@ class SimServingReplica:
             return 0.0
         return (len(ts) - 1) / (ts[-1] - ts[0])
 
-    def _prefix_lookup(self, key) -> bool:
-        """Hit test against the resident LRU (caller holds the lock)."""
-        if key is None or self._prefix_cache_size <= 0:
+    def _prefix_lookup(self, keys) -> bool:
+        """Hit test against the resident LRU: the FIRST resident key in
+        the (most-specific-first) candidate list wins — the replica half
+        of the radix longest-prefix match (caller holds the lock)."""
+        if not keys or self._prefix_cache_size <= 0:
             return False
-        if key in self._resident:
-            self._resident.pop(key)
-            self._resident[key] = time.monotonic()
-            return True
+        for key in keys:
+            if key in self._resident:
+                self._resident.pop(key)
+                self._resident[key] = time.monotonic()
+                return True
         return False
 
-    def _prefix_note(self, key) -> None:
-        if key is None or self._prefix_cache_size <= 0:
+    def _prefix_note(self, keys) -> None:
+        if not keys or self._prefix_cache_size <= 0:
             return
-        self._resident.pop(key, None)
-        self._resident[key] = time.monotonic()
+        for key in keys:
+            self._resident.pop(key, None)
+            self._resident[key] = time.monotonic()
         while len(self._resident) > self._prefix_cache_size:
             self._resident.popitem(last=False)
 
@@ -437,7 +444,7 @@ class SimServingReplica:
 
     def _generate_continuous(self, q):
         t0 = time.monotonic()
-        demand, gen, key = self._parse_token_req(q)
+        demand, gen, keys = self._parse_token_req(q)
         with self._cond:
             if self.max_queue and self._queued >= self.max_queue:
                 self._shed_429()
@@ -462,8 +469,8 @@ class SimServingReplica:
                 self.midstep_admissions += 1
             self._active += 1
             self.blocks.alloc(ticket, self._kv_demand(demand))
-            hit = self._prefix_lookup(key)
-            if key is not None:
+            hit = self._prefix_lookup(keys)
+            if keys:
                 if hit:
                     self.prefix_hits += 1
                 else:
@@ -480,7 +487,7 @@ class SimServingReplica:
                 self.served += 1
                 self.blocks.free(ticket)
                 self._retires.append(time.monotonic())
-                self._prefix_note(key)
+                self._prefix_note(keys)
                 self._cond.notify_all()
         return {"tokens": [1] * gen, "ttft_s": round(ttft, 6),
                 "prefix_hit": hit, "backend": self.name}
@@ -489,7 +496,7 @@ class SimServingReplica:
 
     def _generate_stepbatch(self, q):
         t0 = time.monotonic()
-        demand, gen, key = self._parse_token_req(q)
+        demand, gen, keys = self._parse_token_req(q)
         with self._cond:
             if self.max_queue and self._queued >= self.max_queue:
                 self._shed_429()
@@ -528,8 +535,8 @@ class SimServingReplica:
                     raise self._RestError(503, "replica stopping")
                 self._cond.wait(self.batch_linger_s / 2)
                 self._maybe_seal_locked()
-            hit = self._prefix_lookup(key)
-            if key is not None:
+            hit = self._prefix_lookup(keys)
+            if keys:
                 if hit:
                     self.prefix_hits += 1
                 else:
@@ -544,7 +551,7 @@ class SimServingReplica:
             with self._cond:
                 self._wave_done += 1
                 self.served += 1
-                self._prefix_note(key)
+                self._prefix_note(keys)
                 if self._wave_done >= self._wave_size:
                     # The LONGEST member just finished: only now do the
                     # wave's slots and block tables free — the capacity
@@ -1265,6 +1272,183 @@ def run_affinity_bench(
         "ttft_p99_separation_s": round(
             blind["ttft_ok_s"]["p99"] - affine["ttft_ok_s"]["p99"], 4),
     }
+
+
+def gen_prefix_family_trace(
+    *,
+    families: int = 6,
+    rate_qps: float = 45.0,
+    duration_s: float = 3.0,
+    seed: int = 13,
+    head_blocks_choices: tuple = (1, 2, 3, 4),
+    tail_tokens: int = 24,
+    gen_tokens_choices: tuple = (2, 4, 8),
+) -> List[dict]:
+    """Seeded PARTIAL-overlap trace (the radix satellite's workload):
+    ``families`` shared 32-token heads; every request takes a seeded
+    PREFIX of its family's head (1-4 blocks of 8 tokens) plus a fresh
+    unique tail, as an explicit ``tokens`` list. Two family members
+    with different head depths share only the shorter head — the exact
+    32-token-head hash almost never matches (the first 32 tokens
+    include the unique tail unless the head is full-depth), while the
+    block-aligned prefix chain matches every shared block. Same seed =
+    byte-identical trace."""
+    import random as _random
+
+    rng = _random.Random(seed)
+    heads = [[rng.randrange(1000, 30000) for _ in range(32)]
+             for _ in range(families)]
+    n = max(1, int(rate_qps * duration_s))
+    events: List[dict] = []
+    for i in range(n):
+        fam = rng.randrange(families)
+        blocks = int(rng.choice(head_blocks_choices))
+        tail = [rng.randrange(30000, 32000) for _ in range(tail_tokens)]
+        events.append({
+            "t": round(i / rate_qps, 4),
+            "tokens": heads[fam][:blocks * 8] + tail,
+            "gen_tokens": int(rng.choice(gen_tokens_choices)),
+        })
+    return events
+
+
+def run_prefix_tree_bench(
+    *,
+    replicas: int = 3,
+    families: int = 6,
+    rate_qps: float = 45.0,
+    duration_s: float = 3.0,
+    seed: int = 13,
+    max_batch: int = 2,
+    max_queue: int = 16,
+    token_time_s: float = 0.004,
+    prefill_time_s: float = 0.04,
+    prefill_hit_time_s: float = 0.004,
+    max_len: int = 512,
+    kv_block_size: int = 16,
+    prefix_cache_size: int = 24,
+    client_timeout_s: float = 5.0,
+    scrape_interval_s: float = 0.1,
+) -> Dict[str, object]:
+    """Radix-vs-exact prefix matching A/B (ISSUE 13 satellite): the
+    SAME seeded partial-overlap family trace twice through the real LB
+    over IDENTICAL chain-aware replicas — once with the radix
+    longest-prefix lookup (``prefix_match="radix"``), once with the
+    PR-12 exact 32-token-head hash alone. Hit counts land at the
+    replicas (ground truth); the separation under test is that
+    partially overlapping prompts only credit affinity when the LB can
+    match the shared PART of the head."""
+    import threading
+
+    from kubeflow_tpu.serving.blocks import BlockAccountingError
+    from kubeflow_tpu.serving.lb import ServingLoadBalancer
+    from kubeflow_tpu.webapps.router import JsonHttpServer
+
+    trace = gen_prefix_family_trace(
+        families=families, rate_qps=rate_qps, duration_s=duration_s,
+        seed=seed)
+
+    def one_run(mode: str) -> Dict[str, object]:
+        sims = [SimServingReplica(
+            engine="continuous", dense_kv=False, max_batch=max_batch,
+            max_queue=max_queue, token_time_s=token_time_s,
+            prefill_time_s=prefill_time_s,
+            prefill_hit_time_s=prefill_hit_time_s,
+            max_len=max_len, kv_block_size=kv_block_size,
+            prefix_cache_size=prefix_cache_size,
+            name=f"r{i}") for i in range(replicas)]
+        lb = ServingLoadBalancer([s.addr for s in sims],
+                                 retry_after_s=scrape_interval_s,
+                                 affinity=True, prefix_match=mode)
+        front = JsonHttpServer(lb.router(), port=0).start()
+        stop = threading.Event()
+
+        def health_loop():
+            while not stop.is_set():
+                lb.health_check()
+                stop.wait(scrape_interval_s)
+
+        hc = threading.Thread(target=health_loop, daemon=True)
+        hc.start()
+        lb.health_check()
+        res = _drive_trace(f"http://127.0.0.1:{front.port}/v1/generate",
+                           trace, client_timeout_s=client_timeout_s)
+        stop.set()
+        hc.join(timeout=5)
+        conservation_ok = True
+        for s in sims:
+            try:
+                s.blocks.check_conservation()
+            except BlockAccountingError:
+                conservation_ok = False
+        counts = res["counts"]
+        hits = sum(s.prefix_hits for s in sims)
+        misses = sum(s.prefix_misses for s in sims)
+        out = {
+            "prefix_match": mode,
+            "offered": len(trace),
+            "ok": counts["ok"],
+            "shed": counts["shed"],
+            "timeouts": counts["timeout"],
+            "errors": counts["error"],
+            "accounting_ok": sum(counts.values()) == len(trace),
+            "prefix_hits": hits,
+            "prefix_misses": misses,
+            "hit_rate": round(hits / max(1, hits + misses), 3),
+            "ttft_ok_s": {"p50": _pctq(res["ok_ttft"], 0.5),
+                          "p99": _pctq(res["ok_ttft"], 0.99)},
+            "lb_affinity": {"hits": lb.affinity_hits,
+                            "rerouted": lb.affinity_rerouted,
+                            "new": lb.affinity_new},
+            "kv_conservation_ok": conservation_ok,
+        }
+        front.stop()
+        for s in sims:
+            s.stop()
+        return out
+
+    radix = one_run("radix")
+    exact = one_run("exact")
+    return {
+        "trace": {"families": families, "rate_qps": rate_qps,
+                  "duration_s": duration_s, "seed": seed,
+                  "requests": len(trace)},
+        "replicas": replicas,
+        "radix": radix,
+        "exact": exact,
+        "hit_rate_separation": round(
+            radix["hit_rate"] - exact["hit_rate"], 3),
+        "ttft_p50_separation_s": round(
+            exact["ttft_ok_s"]["p50"] - radix["ttft_ok_s"]["p50"], 4),
+    }
+
+
+def prefix_tree_gate_failures(ptree: Dict[str, object]) -> List[str]:
+    """The radix-vs-exact A/B's gate conditions, shared by bench.py and
+    the CI affinity smoke (one contract, two enforcement points):
+    exact accounting + zero errors/timeouts + KV conservation in BOTH
+    legs, and a STRICT radix hit-rate win on the partial-overlap trace.
+    Returns failure strings (empty = pass); callers raise their own
+    exception type."""
+    out: List[str] = []
+    for tag in ("radix", "exact"):
+        run = ptree[tag]
+        if not run["accounting_ok"]:
+            out.append(f"prefix-tree[{tag}]: accounting broken: {run}")
+        if run["errors"] or run["timeouts"]:
+            out.append(
+                f"prefix-tree[{tag}]: errors={run['errors']} "
+                f"timeouts={run['timeouts']} (must both be 0)")
+        if not run["kv_conservation_ok"]:
+            out.append(
+                f"prefix-tree[{tag}]: KV-block conservation broken")
+    if ptree["radix"]["hit_rate"] <= ptree["exact"]["hit_rate"]:
+        out.append(
+            f"prefix-tree: radix hit rate "
+            f"{ptree['radix']['hit_rate']} did not beat exact "
+            f"{ptree['exact']['hit_rate']} on the partial-overlap "
+            "trace")
+    return out
 
 
 def main(argv=None) -> int:

@@ -14,7 +14,11 @@ Usage:
   tpuctl get    <kind> [-n NAMESPACE] --state-dir .tpuctl
   tpuctl status --state-dir .tpuctl
   tpuctl queue  [-n ns] [-o json] --state-dir .tpuctl  (pending gangs:
-                priority, slices, blocking reason, time-in-queue)
+                priority, slices, blocking reason, time-in-queue,
+                tenant + fair-share deficit)
+  tpuctl tenants [-o json] --state-dir .tpuctl  (capacity-market
+                scoreboard: share vs weighted fair share, deficit,
+                goodput, SLO burn — conservation-gated)
   tpuctl delete -f job.yaml | --kind TpuJob --name x -n ns  --state-dir .tpuctl
   tpuctl metrics --state-dir .tpuctl
   tpuctl goodput [-o json] --state-dir .tpuctl  (fleet goodput
@@ -269,6 +273,22 @@ def cmd_queue(args) -> int:
         platform = _load_platform(args)
         jobs = platform.api.list("TpuJob", namespace=args.namespace,
                                  copy=False)
+    # Tenant columns (ISSUE 13): the queue view names each gang's
+    # tenant path and its tenant's fair-share DEFICIT (fair fraction
+    # minus held usage share, from the goodput ledger's rollup — the
+    # same rows `tpuctl tenants` renders), so a starved tenant is
+    # visible right where its gangs wait.
+    tree = None
+    tenant_info = {}
+    if args.backend != "kubectl":
+        profiles = platform.api.list("Profile", copy=False)
+        if profiles:
+            from kubeflow_tpu.tenancy import TenantTree
+
+            tree = TenantTree.from_profiles(profiles)
+            if platform.goodput is not None:
+                tenant_info = platform.goodput.tenant_snapshot(
+                    tree=tree)["tenants"]
     now = _time.time()
     rows = []
     for job in jobs:
@@ -279,6 +299,8 @@ def cmd_queue(args) -> int:
             if c.type == "Admitted" and c.status == "False":
                 reason, message = c.reason, c.message
                 since = c.last_transition_time or since
+        path = tree.resolve(job.metadata.namespace) if tree else ""
+        deficit = tenant_info.get(path, {}).get("deficit")
         rows.append({
             "namespace": job.metadata.namespace,
             "name": job.metadata.name,
@@ -288,7 +310,27 @@ def cmd_queue(args) -> int:
             "reason": reason or job.status.phase,
             "message": message,
             "queued_seconds": round(max(0.0, now - since), 1),
+            "tenant": path,
+            "fair_share_deficit": deficit,
         })
+    if tree is not None:
+        # A tenant starved since submission has NO attributed ledger
+        # ticks and therefore no tenant_snapshot row — exactly the
+        # tenant this column exists to expose. Its deficit is its full
+        # fair fraction (share 0), computed over every tenant active in
+        # the ledger OR waiting in this queue.
+        # Only DIRECT claimants count — a rollup row for an org whose
+        # teams run the jobs must not self-claim a sibling share (that
+        # understated exactly the starved tenant's deficit).
+        active = {p.rsplit("/", 1)[-1]
+                  for p, e in tenant_info.items() if e.get("direct")}
+        active |= {r["tenant"].rsplit("/", 1)[-1]
+                   for r in rows if r["tenant"]}
+        fair = tree.fair_fractions(active)
+        for r in rows:
+            if r["fair_share_deficit"] is None and r["tenant"]:
+                leaf = r["tenant"].rsplit("/", 1)[-1]
+                r["fair_share_deficit"] = round(fair.get(leaf, 0.0), 6)
     rows.sort(key=lambda r: (-r["priority"], -r["queued_seconds"],
                              r["namespace"], r["name"]))
     if args.output == "json":
@@ -297,13 +339,17 @@ def cmd_queue(args) -> int:
     if not rows:
         print("queue empty: no pending gangs")
         return 0
-    fmt = "{:<12} {:<16} {:>8} {:<12} {:>9} {:<22} {}"
+    fmt = "{:<12} {:<16} {:>8} {:<12} {:>9} {:<18} {:>8} {:<20} {}"
     print(fmt.format("NAMESPACE", "NAME", "PRIORITY", "SLICES",
-                     "QUEUED_S", "REASON", "MESSAGE"))
+                     "QUEUED_S", "TENANT", "DEFICIT", "REASON",
+                     "MESSAGE"))
     for r in rows:
+        d = r["fair_share_deficit"]
         print(fmt.format(r["namespace"], r["name"], r["priority"],
-                         r["slices"], r["queued_seconds"], r["reason"],
-                         r["message"]))
+                         r["slices"], r["queued_seconds"],
+                         r["tenant"] or "-",
+                         f"{d:+.3f}" if d is not None else "-",
+                         r["reason"], r["message"]))
     # Queue-age summary (the starvation/aging surface — the histogram
     # twin is kftpu_scheduler_queue_age_seconds on /metrics).
     from kubeflow_tpu.utils.monitoring import nearest_rank_quantile
@@ -417,6 +463,89 @@ def cmd_goodput(args) -> int:
             print(f"{key:<28} {j['slice_seconds']:>10.3f} "
                   f"{j['goodput_ratio']:>6.3f} {j.get('resizes', 0):>7} "
                   f"{j.get('counterfactual_saved_s', 0.0):>8.3f}  {cats}")
+    return 0 if snap["conserved"] else 3
+
+
+def cmd_tenants(args) -> int:
+    """Per-tenant capacity-market scoreboard (ISSUE 13): every node of
+    the Profile-rooted tenant tree with its weight, hierarchical quota,
+    usage SHARE vs weighted FAIR fraction (and the deficit between
+    them), attributed slice-seconds, goodput ratio, and — where the
+    Profile declares ``goodput_slo`` — the error-budget burn rate and
+    alert state. All of it renders from the SAME goodput-ledger rows
+    `tpuctl goodput` reads (one source of truth, conservation-gated:
+    rc 3 on a broken ledger, like goodput)."""
+    if args.backend == "kubectl":
+        print("tenants is a state-backend command (the ledger lives "
+              "with the embedded platform)", file=sys.stderr)
+        return 2
+    platform = _load_platform(args)
+    platform.reconcile()
+    profiles = platform.api.list("Profile", copy=False)
+    if not profiles:
+        print("no Profiles: the tenant tree is empty (create Profiles "
+              "with spec.parent/weight to root one)", file=sys.stderr)
+        return 1
+    from kubeflow_tpu.tenancy import TenantTree
+
+    tree = TenantTree.from_profiles(profiles)
+    errors, overcommit = tree.validate()
+    acc = platform.goodput
+    if acc is not None:
+        snap = acc.tenant_snapshot(tree=tree)
+    else:
+        snap = {"tenants": {}, "conserved": True, "tracked_ticks": 0}
+    # Every tree node appears, usage or not — a quiet tenant's row is
+    # how you see its unexercised share.
+    entries = dict(snap["tenants"])
+    for name in tree.names():
+        path = tree.resolve(name)
+        if path not in entries:
+            node = tree.node(name)
+            entries[path] = {
+                "slice_seconds": 0.0, "share": 0.0, "fair_share": 0.0,
+                "deficit": 0.0, "goodput_ratio": 0.0,
+                "weight": node.weight,
+                **({"goodput_slo": node.goodput_slo,
+                    "slo_burn": None, "slo_state": "-"}
+                   if node.goodput_slo > 0 else {}),
+            }
+    if args.output == "json":
+        print(json.dumps({
+            "tenants": {k: entries[k] for k in sorted(entries)},
+            "tracked_ticks": snap["tracked_ticks"],
+            "conserved": snap["conserved"],
+            "tree_errors": errors,
+            "overcommit": overcommit,
+        }, indent=2, sort_keys=True))
+        return 0 if snap["conserved"] else 3
+    fmt = ("{:<26} {:>6} {:>6} {:>7} {:>7} {:>8} {:>10} {:>7} "
+           "{:>5} {:>6} {:<5}")
+    print(fmt.format("TENANT", "WEIGHT", "QUOTA", "SHARE", "FAIR",
+                     "DEFICIT", "SLICE_S", "GOODPUT", "SLO", "BURN",
+                     "STATE"))
+    for path in sorted(entries):
+        e = entries[path]
+        node = tree.node(path.rsplit("/", 1)[-1])
+        quota = node.quota_chips if node is not None else 0
+        burn = e.get("slo_burn")
+        print(fmt.format(
+            path,
+            f"{e.get('weight', node.weight if node else 1.0):g}",
+            quota if quota else "-",
+            f"{e['share']:.3f}", f"{e['fair_share']:.3f}",
+            f"{e['deficit']:+.3f}", f"{e['slice_seconds']:.3f}",
+            f"{e['goodput_ratio']:.3f}",
+            f"{e['goodput_slo']:g}" if e.get("goodput_slo") else "-",
+            f"{burn:.2f}" if burn is not None else "-",
+            e.get("slo_state", "-"),
+        ))
+    for msg in overcommit:
+        print(f"OVERCOMMIT: {msg}")
+    for msg in errors:
+        print(f"TREE ERROR: {msg}", file=sys.stderr)
+    print(f"conservation {'OK' if snap['conserved'] else 'BROKEN'}  "
+          f"({snap['tracked_ticks']} tracked ticks)")
     return 0 if snap["conserved"] else 3
 
 
@@ -841,6 +970,14 @@ def build_parser() -> argparse.ArgumentParser:
     gd.add_argument("-o", "--output", choices=("table", "json"),
                     default="table")
     gd.set_defaults(fn=cmd_goodput)
+
+    tn = sub.add_parser(
+        "tenants", help="per-tenant capacity-market scoreboard: share "
+                        "vs weighted fair share, deficit, goodput, SLO "
+                        "burn — from the goodput ledger's tenant rollup")
+    tn.add_argument("-o", "--output", choices=("table", "json"),
+                    default="table")
+    tn.set_defaults(fn=cmd_tenants)
 
     tp = sub.add_parser(
         "trace", help="causal write->watch->reconcile timeline for one "

@@ -1,0 +1,521 @@
+"""Multi-tenant capacity market (ISSUE 13): the tenant tree, weighted
+DRF math, scheduler fairness protection, goodput tenant rollup with
+versioned journal records (old journals replay byte-identically), the
+LB's tenant-weighted shedding, and the radix prefix-matching A/B."""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.controlplane.api.meta import ObjectMeta
+from kubeflow_tpu.controlplane.api.types import (
+    MeshAxesSpec,
+    Profile,
+    ProfileSpec,
+    TpuJob,
+    TpuJobSpec,
+)
+from kubeflow_tpu.obs.goodput import GoodputAccountant
+from kubeflow_tpu.scheduler.core import GangScheduler
+from kubeflow_tpu.scheduler.fleet import Fleet
+from kubeflow_tpu.tenancy import (
+    TenantTree,
+    compute_shares,
+    slo_burn,
+    slo_state,
+)
+from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+SPECS = [
+    {"name": "org", "weight": 1.0, "quota_chips": 64},
+    {"name": "team-a", "parent": "org", "weight": 2.0, "quota_chips": 48,
+     "goodput_slo": 0.5},
+    {"name": "team-b", "parent": "org", "weight": 1.0, "quota_chips": 32},
+    {"name": "solo", "weight": 1.0},
+]
+
+
+class TestTenantTree:
+    def test_resolve_and_ancestry(self):
+        tree = TenantTree.from_specs(SPECS)
+        assert tree.resolve("team-a") == "org/team-a"
+        assert tree.resolve("solo") == "solo"
+        assert tree.resolve("unknown-ns") == ""
+        assert tree.ancestry("team-b") == ["org", "team-b"]
+        assert tree.roots() == ["org", "solo"]
+
+    def test_fair_fractions_weighted_and_work_conserving(self):
+        tree = TenantTree.from_specs(SPECS)
+        # Both teams active: org's share (1/2 vs solo) splits 2:1.
+        f = tree.fair_fractions({"team-a", "team-b", "solo"})
+        assert f["solo"] == pytest.approx(0.5)
+        assert f["team-a"] == pytest.approx(0.5 * 2 / 3)
+        assert f["team-b"] == pytest.approx(0.5 * 1 / 3)
+        assert sum(f.values()) == pytest.approx(1.0)
+        # team-b idle: its share flows to team-a, NOT to solo (the
+        # hierarchical split is per level).
+        f = tree.fair_fractions({"team-a", "solo"})
+        assert f["team-a"] == pytest.approx(0.5)
+        assert "team-b" not in f
+
+    def test_active_internal_node_competes_with_children(self):
+        tree = TenantTree.from_specs(SPECS)
+        f = tree.fair_fractions({"org", "team-a"})
+        # org's own workloads claim a sibling share next to team-a.
+        assert f["org"] == pytest.approx(1.0 / 3)
+        assert f["team-a"] == pytest.approx(2.0 / 3)
+
+    def test_validate_overcommit_flagged_not_fatal(self):
+        tree = TenantTree.from_specs(SPECS)
+        errors, over = tree.validate()
+        assert errors == []
+        assert len(over) == 1 and "org" in over[0]   # 48+32 > 64
+
+    def test_validate_child_exceeding_parent_is_error(self):
+        specs = [{"name": "p", "quota_chips": 16},
+                 {"name": "c", "parent": "p", "quota_chips": 32}]
+        errors, _ = TenantTree.from_specs(specs).validate()
+        assert any("exceeds parent" in e for e in errors)
+
+    def test_unknown_parent_and_cycle_degrade_to_root(self):
+        specs = [{"name": "a", "parent": "ghost"},
+                 {"name": "b", "parent": "c"},
+                 {"name": "c", "parent": "b"}]
+        tree = TenantTree.from_specs(specs)
+        # Everything still resolves (root-attached), flags recorded.
+        assert tree.resolve("a") == "a"
+        assert tree.resolve("b") != ""
+        errors, _ = tree.validate()
+        assert any("unknown parent" in e for e in errors)
+        assert any("cycle" in e for e in errors)
+
+    def test_bad_weight_flagged_and_defaulted(self):
+        tree = TenantTree.from_specs([{"name": "x", "weight": -2}])
+        assert tree.node("x").weight == 1.0
+        errors, _ = tree.validate()
+        assert any("non-positive weight" in e for e in errors)
+
+
+class TestDRFMath:
+    def test_shares_deficit_and_protection_predicates(self):
+        tree = TenantTree.from_specs(SPECS)
+        shares = compute_shares(
+            tree, held_chips={"team-a": 48, "team-b": 8},
+            demanding={"solo"}, total_chips=64)
+        assert shares.share("team-a") == pytest.approx(0.75)
+        assert shares.over_fair("team-a")          # fair = 1/3
+        assert shares.at_or_below_fair("team-b")   # 0.125 <= 1/6
+        assert shares.at_or_below_fair("solo")     # holds nothing
+        assert shares.deficit("solo") == pytest.approx(0.5)
+
+    def test_eps_is_one_chip(self):
+        tree = TenantTree.from_specs([{"name": "a"}, {"name": "b"}])
+        shares = compute_shares(tree, held_chips={"a": 32, "b": 32},
+                                total_chips=64)
+        # Exactly at fair: neither over.
+        assert not shares.over_fair("a") and not shares.over_fair("b")
+
+    def test_slo_burn_and_state(self):
+        assert slo_burn(0.8, 0.6) == pytest.approx(0.5)
+        assert slo_state(slo_burn(0.8, 0.6)) == "ok"
+        assert slo_state(slo_burn(0.4, 0.6)) == "warn"
+        assert slo_state(slo_burn(0.1, 0.6)) == "page"
+        assert slo_burn(0.5, 0.0) is None
+        assert slo_state(None) == "-"
+
+
+def _job(name, ns, *, uid=None, priority=0, slices=1, phase="Running"):
+    j = TpuJob(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=TpuJobSpec(slice_type="v5e-16", num_slices=slices,
+                        mesh=MeshAxesSpec(dp=-1), priority=priority,
+                        preemption_policy="restart"),
+    )
+    j.metadata.uid = uid or f"uid-{ns}-{name}"
+    j.status.phase = phase
+    return j
+
+
+class TestSchedulerDRF:
+    """The protection invariant and DRF ordering on a bare scheduler
+    (no control plane: fleet state driven directly)."""
+
+    def _world(self, *, drf=True):
+        tree = TenantTree.from_specs(
+            [{"name": "hog"}, {"name": "meek"}, {"name": "newbie"}])
+        fleet = Fleet.from_capacity({"v5e-16": 4}, pool_size=4)
+        sched = GangScheduler(fleet, registry=MetricsRegistry(),
+                              tenants=tree, drf=drf)
+        return sched, fleet
+
+    def _fill(self, sched, fleet):
+        """hog holds 3 of 4 units, meek holds 1 — hog over fair
+        (3/4 > ~1/3), meek at-or-below (1/4 <= 1/3)."""
+        jobs = []
+        for i in range(3):
+            j = _job(f"hog-{i}", "hog", priority=5)
+            rendered, blocked = sched.assign(j, jobs=jobs)
+            assert blocked is None
+            jobs.append(j)
+        m = _job("meek-0", "meek", priority=0)
+        rendered, blocked = sched.assign(m, jobs=jobs)
+        assert blocked is None
+        jobs.append(m)
+        return jobs
+
+    def test_over_fair_requester_cannot_evict_below_fair_tenant(self):
+        sched, fleet = self._world(drf=True)
+        jobs = self._fill(sched, fleet)
+
+        class _Api:                     # preempt_gang sees no pods
+            def list(self, *a, **k):
+                return []
+
+            def update_status(self, obj):
+                pass
+
+        req = _job("hog-new", "hog", priority=9, phase="Pending")
+        jobs2 = jobs + [req]
+        rendered, blocked = sched.assign(req, jobs=jobs2, api=_Api())
+        # The only viable victim set includes meek's gang (hog's own
+        # gangs alone can free at most... they CAN free enough; hog may
+        # preempt its own lower-priority gangs) — but meek must never
+        # be chosen while hog is over fair.
+        assert all(e.get("victim_tenant") != "meek"
+                   for e in sched.preemption_log)
+        assert not any(e.get("fair_violation")
+                       for e in sched.preemption_log)
+
+    def test_observe_mode_records_violation_instead_of_blocking(self):
+        sched, fleet = self._world(drf=False)
+        jobs = self._fill(sched, fleet)
+        shares = sched.tenant_shares(jobs)
+        assert shares.over_fair("hog")
+        assert shares.at_or_below_fair("meek")
+
+    def test_drf_admission_yields_to_more_deficit_tenant(self):
+        sched, fleet = self._world(drf=True)
+        # hog fills the whole fleet minus one unit; meek and newbie
+        # both queue a 1-wide gang; newbie (deficit, placeable) should
+        # make hog's NEXT gang yield.
+        jobs = self._fill(sched, fleet)
+        # Free one unit by releasing meek's gang: one unit free now.
+        sched.release(jobs[-1].metadata.uid)
+        jobs = jobs[:-1]
+        pending_newbie = _job("nb-0", "newbie", phase="Pending")
+        req = _job("hog-more", "hog", phase="Pending")
+        jobs2 = jobs + [pending_newbie, req]
+        rendered, blocked = sched.assign(req, jobs=jobs2)
+        assert blocked is not None and blocked[0] == "TenantFairShare"
+        # The deficit tenant itself places straight into the free unit.
+        rendered, blocked = sched.assign(pending_newbie, jobs=jobs2)
+        assert blocked is None
+
+    def test_no_tree_byte_identical_contract(self):
+        fleet = Fleet.from_capacity({"v5e-16": 4}, pool_size=4)
+        sched = GangScheduler(fleet, registry=MetricsRegistry())
+        j = _job("a", "anywhere", phase="Pending")
+        rendered, blocked = sched.assign(j, jobs=[j])
+        assert blocked is None
+        assert sched.tenant_shares([j]) is None
+        assert sched.tenant_of(j) == ""
+
+
+class TestGoodputTenantRollup:
+    def _tree(self):
+        return TenantTree.from_specs(SPECS)
+
+    def test_tenant_attribution_and_rollup(self):
+        import types as _types
+
+        acc = GoodputAccountant.from_capacity({"v5e-16": 2},
+                                              tenants=self._tree())
+        ja = _job("a", "team-a", phase="Running")
+        jb = _job("b", "team-b", phase="Running")
+        for j in (ja, jb):
+            acc.apply_event(_types.SimpleNamespace(type="ADDED", object=j))
+        acc.tick(10)
+        snap = acc.tenant_snapshot()
+        assert snap["conserved"]
+        t = snap["tenants"]
+        assert t["org/team-a"]["categories_ticks"]["productive"] == 10
+        # The org rollup sums both teams.
+        assert t["org"]["categories_ticks"]["productive"] == 20
+        assert t["org"]["share"] == pytest.approx(1.0)
+        # SLO state present where declared.
+        assert t["org/team-a"]["slo_state"] in ("ok", "warn", "page")
+        # The full snapshot carries the same rollup.
+        assert acc.snapshot()["tenants"]["org"]["held_ticks"] == 20
+
+    def test_journal_tn_records_versioned_and_replayed(self, tmp_path):
+        import types as _types
+
+        path = str(tmp_path / "goodput.jsonl")
+        acc = GoodputAccountant.from_capacity(
+            {"v5e-16": 1}, tenants=self._tree(), journal_path=path,
+            fsync=False)
+        j = _job("a", "team-a", phase="Running")
+        acc.apply_event(_types.SimpleNamespace(type="ADDED", object=j))
+        acc.tick(5)
+        acc.close()
+        recs = [json.loads(line) for line in open(path)]
+        tn = [r for r in recs if r["op"] == "tn"]
+        assert tn and tn[0]["v"] == 2 \
+            and tn[0]["tenant"] == "org/team-a"
+        # Replay into a fresh accountant: byte-identical fingerprint,
+        # tenant rollup included.
+        twin = GoodputAccountant.from_capacity({"v5e-16": 1})
+        twin.replay_from(path)
+        assert twin.fingerprint() == acc.fingerprint()
+        assert twin.tenant_snapshot()["tenants"]["org/team-a"][
+            "categories_ticks"]["productive"] == 5
+
+    def test_pre_tenant_journal_replays_byte_identically(self, tmp_path):
+        """The regression contract: a journal written BEFORE ISSUE 13
+        (no tn records — exactly what a tenant-less accountant writes)
+        replays through a tenant-enabled accountant to the SAME
+        fingerprint a pre-ISSUE-13 accountant produces."""
+        import types as _types
+
+        path = str(tmp_path / "old.jsonl")
+        old = GoodputAccountant.from_capacity(
+            {"v5e-16": 2}, journal_path=path, fsync=False)
+        j = _job("a", "team-a", phase="Running")
+        old.apply_event(_types.SimpleNamespace(type="ADDED", object=j))
+        old.tick(7)
+        old.close()
+        assert all(json.loads(line)["op"] != "tn" for line in open(path))
+        # Pre-ISSUE-13 replayer (no tree) vs tenant-enabled replayer:
+        # identical fingerprints — replay applies records, it never
+        # invents tenant attributions the journal does not carry.
+        plain = GoodputAccountant.from_capacity({"v5e-16": 2})
+        plain.replay_from(path)
+        aware = GoodputAccountant.from_capacity(
+            {"v5e-16": 2}, tenants=self._tree())
+        aware.replay_from(path)
+        assert plain.fingerprint() == aware.fingerprint() \
+            == old.fingerprint()
+        assert aware.tenant_snapshot()["tenants"] == {} or \
+            "org/team-a" not in aware.tenant_snapshot()["tenants"]
+
+    def test_set_tenants_resolves_known_jobs_and_journals(self, tmp_path):
+        import types as _types
+
+        path = str(tmp_path / "g.jsonl")
+        acc = GoodputAccountant.from_capacity(
+            {"v5e-16": 1}, journal_path=path, fsync=False)
+        j = _job("a", "team-b", phase="Running")
+        acc.apply_event(_types.SimpleNamespace(type="ADDED", object=j))
+        acc.tick(3)
+        acc.set_tenants(self._tree())
+        acc.close()
+        recs = [json.loads(line) for line in open(path)]
+        assert any(r["op"] == "tn" and r["tenant"] == "org/team-b"
+                   for r in recs)
+
+
+class TestLBTenantMarket:
+    def test_resolve_tenant_paths(self):
+        from kubeflow_tpu.serving.lb import ServingLoadBalancer
+
+        tree = TenantTree.from_specs(SPECS)
+        lb = ServingLoadBalancer(tenants=tree)
+        assert lb.resolve_tenant({"tenant": "team-a"}) == "team-a"
+        assert lb.resolve_tenant({"namespace": "team-b"}) == "team-b"
+        assert lb.resolve_tenant(
+            {}, {"x-kftpu-namespace": "solo"}) == "solo"
+        assert lb.resolve_tenant({"namespace": "ghost"}) is None
+        # Session key -> namespace -> tenant (the registry leg).
+        lb.session_namespaces["sess-9"] = "team-a"
+        assert lb.resolve_tenant({"session": "sess-9"}) == "team-a"
+        assert lb.resolve_tenant({"session": "unknown"}) is None
+        blind = ServingLoadBalancer()
+        assert blind.resolve_tenant({"tenant": "team-a"}) is None
+
+    def test_overage_math_weighted(self):
+        from kubeflow_tpu.serving.lb import ServingLoadBalancer
+
+        lb = ServingLoadBalancer(tenants={"big": 3.0, "small": 1.0})
+        for _ in range(4):
+            lb.note_tenant_arrival("big")
+        for _ in range(4):
+            lb.note_tenant_arrival("small")
+        # fair(big) = 8 * 3/4 = 6 -> under; fair(small) = 2 -> over by 2.
+        assert lb._tenant_overage_locked("big") == pytest.approx(-2.0)
+        assert lb._tenant_overage_locked("small") == pytest.approx(2.0)
+
+    def test_tenant_burst_soak_exact_accounting(self):
+        from kubeflow_tpu.chaos.serving_soak import run_tenant_burst_soak
+
+        rep = run_tenant_burst_soak(warmup_rounds=2, burst_rounds=5,
+                                    cooldown_rounds=2)
+        assert rep.accounting_ok and rep.ledger_ok
+        assert rep.errors == 0
+        assert rep.shed.get(rep.in_share_tenant, 0) == 0
+        assert rep.shed.get(rep.burst_tenant, 0) >= rep.burst_overage
+        assert rep.clean
+
+
+class TestRadixPrefixMatching:
+    def test_prefix_chain_shapes(self):
+        from kubeflow_tpu.serving.blocks import prefix_chain
+
+        assert prefix_chain(list(range(5))) == []
+        assert len(prefix_chain(list(range(8)))) == 1
+        assert len(prefix_chain(list(range(40)))) == 4   # capped at 32
+        # Shared head -> shared chain prefix; divergence after block 1.
+        a = prefix_chain(list(range(24)))
+        b = prefix_chain(list(range(8)) + [99] * 16)
+        assert a[0] == b[0] and a[1] != b[1]
+
+    def test_affinity_keys_ordering_and_modes(self):
+        from kubeflow_tpu.serving.blocks import prefix_chain, prefix_key
+        from kubeflow_tpu.serving.lb import ServingLoadBalancer
+
+        toks = list(range(24))
+        lb = ServingLoadBalancer()                     # radix default
+        keys = lb.affinity_keys({"tokens": toks})
+        assert keys[0] == prefix_key(toks)
+        assert keys[1:] == list(reversed(prefix_chain(toks)))
+        # Sessions keep their single sticky key.
+        assert lb.affinity_keys({"session": "s1"}) == ["s:s1"]
+        exact = ServingLoadBalancer(prefix_match="exact")
+        assert exact.affinity_keys({"tokens": toks}) == [prefix_key(toks)]
+        with pytest.raises(ValueError):
+            ServingLoadBalancer(prefix_match="fuzzy")
+
+    def test_radix_matches_partially_overlapping_prompt(self):
+        from kubeflow_tpu.serving.blocks import prefix_chain
+        from kubeflow_tpu.serving.lb import ServingLoadBalancer
+
+        lb = ServingLoadBalancer(["a:1", "b:1"])
+        head = list(range(100, 132))
+        # Backend b reports the 2-block chain key resident (an earlier
+        # family member's head lives there).
+        with lb._lock:
+            lb._backends["b:1"].resident_prefixes = frozenset(
+                [prefix_chain(head)[1]])
+        # A DIFFERENT prompt sharing only 2 head blocks must land on b.
+        probe = head[:16] + [7] * 16
+        picked = lb._acquire(keys=lb.affinity_keys({"tokens": probe}))
+        assert picked.addr == "b:1"
+        assert lb.affinity_hits == 1
+        # Exact-mode LB ignores the chain hint for the same probe.
+        lb2 = ServingLoadBalancer(["a:1", "b:1"], prefix_match="exact")
+        with lb2._lock:
+            lb2._backends["b:1"].resident_prefixes = frozenset(
+                [prefix_chain(head)[1]])
+        lb2._acquire(keys=lb2.affinity_keys({"tokens": probe}))
+        assert lb2.affinity_hits == 0
+
+
+class TestProfileTenantValidation:
+    def _world(self):
+        from kubeflow_tpu.controlplane.controllers.profile import (
+            ProfileController,
+        )
+        from kubeflow_tpu.controlplane.runtime import (
+            ControllerManager,
+            InMemoryApiServer,
+        )
+
+        api = InMemoryApiServer()
+        reg = MetricsRegistry()
+        mgr = ControllerManager(api, reg)
+        mgr.register(ProfileController(api, reg))
+        return api, mgr
+
+    def test_weight_must_be_positive(self):
+        api, mgr = self._world()
+        api.create(Profile(metadata=ObjectMeta(name="bad"),
+                           spec=ProfileSpec(owner="o@x", weight=0.0)))
+        mgr.run_until_idle()
+        assert api.get("Profile", "bad").status.phase == "Failed"
+        mgr.close()
+
+    def test_child_quota_exceeding_parent_fails(self):
+        api, mgr = self._world()
+        api.create(Profile(metadata=ObjectMeta(name="p"),
+                           spec=ProfileSpec(owner="o@x",
+                                            tpu_chip_quota=16)))
+        api.create(Profile(metadata=ObjectMeta(name="c"),
+                           spec=ProfileSpec(owner="o@x", parent="p",
+                                            tpu_chip_quota=32)))
+        mgr.run_until_idle()
+        assert api.get("Profile", "c").status.phase == "Failed"
+        assert api.get("Profile", "p").status.phase == "Ready"
+        mgr.close()
+
+    def test_overcommit_flagged_on_parent_not_fatal(self):
+        api, mgr = self._world()
+        api.create(Profile(metadata=ObjectMeta(name="p"),
+                           spec=ProfileSpec(owner="o@x",
+                                            tpu_chip_quota=32)))
+        for name in ("c1", "c2"):
+            api.create(Profile(
+                metadata=ObjectMeta(name=name),
+                spec=ProfileSpec(owner="o@x", parent="p",
+                                 tpu_chip_quota=24)))
+        mgr.run_until_idle()
+        parent = api.get("Profile", "p")
+        assert parent.status.phase == "Ready"
+        cond = {c.type: c.status for c in parent.status.conditions}
+        assert cond.get("QuotaOvercommitted") == "True"
+        for name in ("c1", "c2"):
+            assert api.get("Profile", name).status.phase == "Ready"
+        mgr.close()
+
+    def test_unknown_parent_parks_then_resolves(self):
+        api, mgr = self._world()
+        api.create(Profile(metadata=ObjectMeta(name="child"),
+                           spec=ProfileSpec(owner="o@x",
+                                            parent="later")))
+        mgr.run_until_idle()
+        child = api.get("Profile", "child")
+        cond = {c.type: (c.status, c.reason)
+                for c in child.status.conditions}
+        assert cond.get("TenantTree") == ("False", "UnknownParent")
+        api.create(Profile(metadata=ObjectMeta(name="later"),
+                           spec=ProfileSpec(owner="o@x")))
+        mgr.run_until_idle(include_timers_within=60.0)
+        child = api.get("Profile", "child")
+        assert child.status.phase == "Ready"
+        cond = {c.type: c.status for c in child.status.conditions}
+        assert cond.get("TenantTree") == "True"
+        mgr.close()
+
+    def test_self_parent_and_cycle_fail(self):
+        api, mgr = self._world()
+        api.create(Profile(metadata=ObjectMeta(name="narcissus"),
+                           spec=ProfileSpec(owner="o@x",
+                                            parent="narcissus")))
+        mgr.run_until_idle()
+        assert api.get("Profile", "narcissus").status.phase == "Failed"
+        mgr.close()
+
+
+class TestTenantStormSmoke:
+    """One small DRF-enforced tenant storm through the REAL control
+    plane: the acceptance gate's invariants at test scale."""
+
+    def test_small_tenant_storm_gates(self):
+        from kubeflow_tpu.scheduler.benchmark import (
+            DEFAULT_TENANT_SPECS,
+            check_tenant_gates,
+            run_schedule_storm,
+        )
+
+        rep = run_schedule_storm(
+            policy="priority", num_jobs=24, seed=1,
+            tenants=list(DEFAULT_TENANT_SPECS), drf=True)
+        check_tenant_gates(rep)            # raises on any gate breach
+        assert rep.converged
+        assert rep.fairness_violations == 0
+        assert rep.inversions == 0
+        assert rep.goodput["conserved"]
+        tenants = rep.goodput["tenants"]
+        assert len(tenants) >= 2
+        # Shares/fair/deficit render from the same rows.
+        for entry in tenants.values():
+            assert entry["deficit"] == pytest.approx(
+                entry["fair_share"] - entry["share"], abs=1e-6)
