@@ -149,6 +149,9 @@ class ShardPreemptor:
         # Goodput ledger replay (ISSUE 10): True while every killed
         # shard's accountant came back byte-identical from its journal.
         self.goodput_replay_identical = True
+        # Alert journal replay (ISSUE 15): True while every killed
+        # shard's SLO engine came back byte-identical from alerts.jsonl.
+        self.alerts_replay_identical = True
         self.metrics_kills = registry.counter(
             "kftpu_chaos_shard_kills_total",
             "Whole-shard process kills injected",
@@ -156,6 +159,10 @@ class ShardPreemptor:
 
     def _goodput_fp(self, shard_id: int):
         fp = getattr(self.plane, "shard_goodput_fingerprint", None)
+        return fp(shard_id) if fp is not None else None
+
+    def _slo_fp(self, shard_id: int):
+        fp = getattr(self.plane, "shard_slo_fingerprint", None)
         return fp(shard_id) if fp is not None else None
 
     def kill_random(self, *, restart: bool = True) -> Optional[int]:
@@ -172,6 +179,7 @@ class ShardPreemptor:
         # gate, not a heuristic.
         pre = self.plane.shard_fingerprint(victim)
         pre_goodput = self._goodput_fp(victim)
+        pre_slo = self._slo_fp(victim)
         self.plane.kill(victim)
         self.kills += 1
         self.metrics_kills.inc()
@@ -189,6 +197,12 @@ class ShardPreemptor:
                 log.error("goodput ledger replay diverged", kv={
                     "shard": victim, "pre": pre_goodput,
                     "post": post_goodput,
+                })
+            post_slo = self._slo_fp(victim)
+            if pre_slo is not None and post_slo != pre_slo:
+                self.alerts_replay_identical = False
+                log.error("alert journal replay diverged", kv={
+                    "shard": victim, "pre": pre_slo, "post": post_slo,
                 })
         log.warning("shard preempted", kv={"shard": victim,
                                            "restarted": restart})

@@ -61,6 +61,14 @@ class SoakReport:
     # the soak's tracked capacity, conservation-checked exactly. Empty
     # when the soak runs unconstrained (no capacity to attribute).
     goodput: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # SLO engine (ISSUE 15): the tick-scaled burn-rate evaluation run
+    # once per round — pages per objective, transition totals, final
+    # states. The CI slo-smoke stage count-gates this both ways (clean
+    # soak: zero transitions; fault soak: the expected page set).
+    slo: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # Flight dumps written during the soak (alert pages / tripped
+    # guards; paths under ``state_dir`` when one was given).
+    flight_dumps: List[str] = dataclasses.field(default_factory=list)
 
     def stuck_jobs(self) -> Dict[str, str]:
         return {n: p for n, p in self.phases.items() if p not in TERMINAL}
@@ -86,6 +94,11 @@ def run_soak(
     latency_s: float = 0.0,          # per-verb injected API latency
     watch_lag_s: float = 0.0,        # injected watch-delivery lag
     workers: int = 1,                # reconcile worker-pool size (ISSUE 5)
+    # SLO engine (ISSUE 15): when set, alerts.jsonl and flight dumps
+    # land under this dir (a page writes a flight-*.jsonl crash dump);
+    # "" keeps the engine in-memory only. The engine itself always
+    # runs — the soak IS the slo-smoke substrate.
+    state_dir: str = "",
     registry: Optional[MetricsRegistry] = None,
 ) -> SoakReport:
     registry = registry or MetricsRegistry()
@@ -161,6 +174,33 @@ def run_soak(
         goodput_acc = GoodputAccountant.from_capacity(
             dict(capacity), registry=registry, track_rollback=False)
         goodput_acc.attach(inner)
+    # SLO engine + flight recorder (ISSUE 15): tick-scaled windows, one
+    # evaluation per soak round — the deterministic substrate the CI
+    # slo-smoke stage count-gates in both directions (a clean soak
+    # fires nothing; injected watch lag and preemption bursts page
+    # their objectives exactly once each). The recorder watches the
+    # RAW store like the goodput accountant.
+    from kubeflow_tpu.obs.flight import FlightRecorder
+    from kubeflow_tpu.obs.slo import ALERTS_JOURNAL, SLOEngine, soak_objectives
+
+    slo_tick = {"now": 0}
+    recorder = FlightRecorder(registry=registry,
+                              now_fn=lambda: slo_tick["now"])
+    recorder.attach(inner)
+    slo_engine = SLOEngine(
+        registry,
+        objectives=soak_objectives(goodput_acc),
+        journal_path=(os.path.join(state_dir, ALERTS_JOURNAL)
+                      if state_dir else ""),
+        recorder=recorder,
+        dump_dir=state_dir,
+    )
+    if goodput_acc is not None:
+        slo_engine.add_guard(
+            "goodput-conservation",
+            lambda: goodput_acc.conservation()["exact"])
+    if state_dir:
+        os.makedirs(state_dir, exist_ok=True)
     prober = AvailabilityProber({}, registry, interval_s=1e9)
     prober.add_target("tpujob-controller",
                       controller_target(mgr, job_ctl), registry)
@@ -221,6 +261,15 @@ def run_soak(
             goodput_acc.set_capacity(dict(capacity))
             goodput_acc.pump()
             goodput_acc.tick(rounds)
+        # One SLO evaluation per round (logical-tick clock): the flight
+        # ring folds in this round's watch events and metric movement
+        # FIRST so a page's dump shows the lead-up, not just the
+        # verdict. The recorder's clock is the ROUND tick — one clock
+        # domain per process keeps the stitched timeline causal.
+        slo_tick["now"] = rounds
+        recorder.pump()
+        recorder.record_metric_deltas()
+        slo_engine.evaluate(rounds)
         phases = {j.metadata.name: j.status.phase
                   for j in inner.list("TpuJob", copy=False)}
         if not chaos.enabled and all(p in TERMINAL for p in phases.values()):
@@ -254,7 +303,11 @@ def run_soak(
             "kftpu_watch_delivery_lag_seconds"),
         workers=workers,
         goodput=goodput_acc.snapshot() if goodput_acc is not None else {},
+        slo=slo_engine.snapshot(),
+        flight_dumps=list(recorder.dumps),
     )
+    slo_engine.close()
+    recorder.detach()
     if goodput_acc is not None:
         goodput_acc.close()
     log.info("soak done", kv={
@@ -531,6 +584,11 @@ class ShardedSoakReport:
     goodput_conserved: bool = True   # exact per-shard AND union
     goodput_replay_identical: bool = True  # journal replay across kills
     goodput: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # SLO engine (ISSUE 15): per-shard alert state unioned, plus the
+    # alerts.jsonl replay gate across the shard SIGKILL.
+    alerts_replay_identical: bool = True
+    slo: Dict[str, object] = dataclasses.field(default_factory=dict)
+    flight_dumps: List[str] = dataclasses.field(default_factory=list)
 
 
 def run_sharded_soak(
@@ -628,6 +686,7 @@ def run_sharded_soak(
             for k, v in info["injected"].items():
                 injected[k] = injected.get(k, 0) + v
         goodput_union = cp.goodput_union() or {}
+        slo_union = cp.slo_union()
         counts, signature = cp.fingerprint()
         phases = dict(counts.get("TpuJob", {}))
         converged = sum(phases.values()) == num_jobs and all(
@@ -654,6 +713,9 @@ def run_sharded_soak(
         goodput_conserved=goodput_union.get("conserved", True),
         goodput_replay_identical=shard_killer.goodput_replay_identical,
         goodput=goodput_union,
+        alerts_replay_identical=shard_killer.alerts_replay_identical,
+        slo=slo_union,
+        flight_dumps=slo_union.get("flight_dumps", []),
     )
     log.info("sharded soak done", kv={
         "converged": converged, "rounds": rounds, "shards": shards,

@@ -99,12 +99,17 @@ class Platform:
         self.kfam: Optional[AccessManagement] = None
         self.scheduler = None    # GangScheduler when a fleet is configured
         self.goodput = None      # GoodputAccountant when capacity is known
+        self.slo = None          # SLOEngine (ISSUE 15)
+        self.flight = None       # FlightRecorder (ISSUE 15)
         self.jwa = None          # NotebookWebApp when enabled
         self.dashboard = None    # DashboardApi when enabled
         self.prober = None       # AvailabilityProber when enabled
         self.wal = None          # WriteAheadLog when attached
         self.components: List[str] = []
         self._config: Optional[PlatformConfig] = None
+        # Known only on the load() path: where the alert journal,
+        # flight dumps, and other durable observability artifacts live.
+        self._state_dir = ""
 
     def attach_wal(self, state_dir: str, *, fsync: bool = True):
         """Journal every committed API write to ``<state_dir>/wal.jsonl``
@@ -263,6 +268,40 @@ class Platform:
             if self.goodput is not None:
                 self.goodput.attach(self.api)
                 self.goodput.reset_clock(time.monotonic_ns())
+            # SLO engine + flight recorder (ISSUE 15): the
+            # detect-and-explain layer over everything the registry
+            # records. Real-time windows (evaluated per reconcile()
+            # pass with a monotonic clock); the alert journal and
+            # flight dumps live under the state dir when one is known
+            # (the tpuctl load path).
+            from kubeflow_tpu.obs.flight import FlightRecorder
+            from kubeflow_tpu.obs.slo import (
+                ALERTS_JOURNAL,
+                SLOEngine,
+                default_objectives,
+            )
+
+            self.flight = FlightRecorder(tracer=self.tracer,
+                                         registry=reg)
+            self.flight.attach(self.api)
+            self.slo = SLOEngine(
+                reg,
+                objectives=default_objectives(goodput=self.goodput),
+                recorder=self.flight,
+                dump_dir=self._state_dir,
+            )
+            if self.goodput is not None:
+                acc = self.goodput
+                self.slo.add_guard(
+                    "goodput-conservation",
+                    lambda: acc.conservation()["exact"])
+            if self._state_dir:
+                # The dir may not exist yet (first apply): the journal
+                # appends lazily, but its directory must be there
+                # before the first alert fires, not first save().
+                os.makedirs(self._state_dir, exist_ok=True)
+                self.slo.set_journal(
+                    os.path.join(self._state_dir, ALERTS_JOURNAL))
         elif name == "studyjob-controller":
             self.manager.register(StudyJobController(self.api, reg))
         elif name == "notebook-controller":
@@ -416,6 +455,15 @@ class Platform:
         if self.goodput is not None:
             self.goodput.pump()
             self.goodput.tick(time.monotonic_ns())
+        # SLO evaluation rides every reconcile pass: fold fresh watch
+        # events into the flight ring, note metric movement, then run
+        # the burn-rate state machine (which journals transitions and
+        # dumps the ring on a page or a tripped guard).
+        if self.flight is not None:
+            self.flight.pump()
+            self.flight.record_metric_deltas()
+        if self.slo is not None:
+            self.slo.evaluate(time.monotonic())
         return n
 
     def substrate_spec(self, name: str):
@@ -513,6 +561,9 @@ class Platform:
 
         path = os.path.join(state_dir, "state.yaml")
         platform = cls()
+        # Components started below (apply_config) anchor their durable
+        # observability artifacts — alerts.jsonl, flight dumps — here.
+        platform._state_dir = state_dir
         has_wal = os.path.exists(wal_path(state_dir))
         if os.path.exists(path):
             with open(path) as f:
@@ -551,4 +602,10 @@ class Platform:
             # contributes nothing.
             with open(gp_path) as f:
                 platform.goodput.load_state(json.load(f))
+            if platform.slo is not None:
+                # The interruption-delta SLI baselined before the
+                # tallies above were restored — re-anchor, or every
+                # tpuctl invocation would read the whole persisted
+                # interruption history as one fresh burst.
+                platform.slo.rebaseline_sources()
         return platform

@@ -537,8 +537,12 @@ class ControllerManager:
                 if ev.ts_mono > 0:
                     # Write-time → drain-time lag; under chaos watch-lag
                     # injection this provably includes the injected delay.
+                    # The writing span's trace id rides along as the
+                    # bucket exemplar (ISSUE 15) — a burning watch-lag
+                    # objective then names the exact write→watch trace.
                     self.metrics_watch_lag.observe(
-                        max(0.0, now - ev.ts_mono), controller=ctl.NAME)
+                        max(0.0, now - ev.ts_mono), controller=ctl.NAME,
+                        exemplar=ev.span_ctx[0] if ev.span_ctx else None)
                 if primary:
                     key = (ev.object.metadata.namespace, ev.object.metadata.name)
                 else:
@@ -661,9 +665,10 @@ class ControllerManager:
                         meta: Optional[Tuple[float, List[SpanContext]]]) -> None:
         links: List[SpanContext] = []
         if meta is not None:
-            self.metrics_queue_wait.observe(
-                max(0.0, time.monotonic() - meta[0]), controller=ctl.NAME)
             links = meta[1]
+            self.metrics_queue_wait.observe(
+                max(0.0, time.monotonic() - meta[0]), controller=ctl.NAME,
+                exemplar=links[0][0] if links else None)
         lkey = (ctl.NAME, key)
         # The reconcile span ADOPTS the trace of the write that enqueued it
         # (first link), so one trace id covers write → watch → reconcile →
@@ -721,7 +726,8 @@ class ControllerManager:
                 self._schedule(ctl, key, delay)
             span.attrs["outcome"] = outcome
         self.metrics_reconcile_latency.observe(
-            span.duration_s, controller=ctl.NAME, result=outcome)
+            span.duration_s, controller=ctl.NAME, result=outcome,
+            exemplar=span.trace_id)
         ctl.heartbeat.beat()
 
     # ------------- worker-pool dispatch -------------

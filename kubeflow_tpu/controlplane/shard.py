@@ -293,6 +293,51 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
         # must not read as fresh interruptions).
         goodput_acc.attach(api)
 
+    # Per-shard SLO engine + flight recorder (ISSUE 15): tick-driven
+    # like the goodput ledger, alert journal under the shard dir with
+    # the same fsync discipline — a SIGKILLed shard's engine replays
+    # alerts.jsonl byte-identically (the slo-smoke/shard gate). A
+    # respawn (wal_replayed > 0) dumps the flight ring immediately:
+    # the fresh incarnation records what it knows about the crash it
+    # replaced, stitched cross-shard by `tpuctl flight show`.
+    slo_engine = None
+    recorder = None
+    if spec.capacity:
+        from kubeflow_tpu.obs.flight import FlightRecorder
+        from kubeflow_tpu.obs.slo import (
+            ALERTS_JOURNAL,
+            SLOEngine,
+            soak_objectives,
+        )
+
+        sdir = _wal_dir(spec) if spec.state_dir else ""
+        # The recorder's clock is the shard's goodput tick, so every
+        # ring entry (events, metric deltas, alerts) shares one clock
+        # domain and cross-shard stitches stay causally ordered.
+        recorder = FlightRecorder(shard=f"sh{spec.shard_id:02d}",
+                                  tracer=tracer, registry=registry,
+                                  now_fn=lambda: goodput_tick)
+        recorder.attach(api)
+        slo_engine = SLOEngine(
+            registry,
+            objectives=soak_objectives(goodput_acc),
+            journal_path=(os.path.join(sdir, ALERTS_JOURNAL)
+                          if sdir else ""),
+            fsync=spec.wal_fsync,
+            recorder=recorder,
+            dump_dir=sdir,
+        )
+        if sdir and os.path.exists(os.path.join(sdir, ALERTS_JOURNAL)):
+            slo_engine.replay_from(os.path.join(sdir, ALERTS_JOURNAL))
+        if goodput_acc is not None:
+            slo_engine.add_guard(
+                "goodput-conservation",
+                lambda: goodput_acc.conservation()["exact"])
+        if sdir and wal_replayed > 0:
+            recorder.record("respawn", {"shard": spec.shard_id,
+                                        "wal_replayed": wal_replayed})
+            recorder.dump(sdir, reason="shard-respawn")
+
     class _Singleton(Controller):
         NAME = ShardSingleton.NAME
         WATCH_KINDS = ("PlatformConfig",)
@@ -364,6 +409,10 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
                 goodput_acc.pump()
                 goodput_tick += 1
                 goodput_acc.tick(goodput_tick)
+            if slo_engine is not None:
+                recorder.pump()
+                recorder.record_metric_deltas()
+                slo_engine.evaluate(goodput_tick)
             if spec.state_dir:
                 # Spans (reconciles, ledger round-trips) land in the
                 # shard's trace file so shard-aware `tpuctl trace` can
@@ -439,6 +488,16 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
                 "summary": goodput_acc.snapshot(),
                 "tick": goodput_tick,
             }
+        if cmd == "slo":
+            if slo_engine is None:
+                return None
+            return {
+                "fingerprint": slo_engine.fingerprint(),
+                "states": slo_engine.states(),
+                "pages": slo_engine.pages_by_objective(),
+                "transitions": slo_engine.transitions_total(),
+                "flight_dumps": list(recorder.dumps),
+            }
         if cmd == "info":
             return {
                 "shard_id": spec.shard_id,
@@ -470,6 +529,10 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
         mgr.close()
         if ledger_service is not None:
             ledger_service.stop()
+        if slo_engine is not None:
+            slo_engine.close()
+        if recorder is not None:
+            recorder.detach()
         if wal is not None:
             wal.close()
 
@@ -770,6 +833,34 @@ class ShardedControlPlane:
     def shard_goodput_fingerprint(self, shard_id: int) -> Optional[str]:
         payload = self.shard_goodput(shard_id)
         return payload["fingerprint"] if payload else None
+
+    def shard_slo(self, shard_id: int) -> Optional[Dict[str, Any]]:
+        """One shard's SLO engine payload (alert fingerprint, states,
+        page counts, flight dumps); None when the shard runs none."""
+        return self._call(shard_id, "slo")
+
+    def shard_slo_fingerprint(self, shard_id: int) -> Optional[str]:
+        payload = self.shard_slo(shard_id)
+        return payload["fingerprint"] if payload else None
+
+    def slo_union(self) -> Dict[str, Any]:
+        """Every live shard's alert state folded into one view: pages
+        summed per objective, states keyed ``shNN:series``."""
+        pages: Dict[str, int] = {}
+        states: Dict[str, str] = {}
+        transitions = 0
+        dumps: List[str] = []
+        for shard_id, payload in sorted(self._broadcast("slo").items()):
+            if payload is None:
+                continue
+            for base, n in payload["pages"].items():
+                pages[base] = pages.get(base, 0) + n
+            for key, st in payload["states"].items():
+                states[f"sh{shard_id:02d}:{key}"] = st
+            transitions += payload["transitions"]
+            dumps.extend(payload["flight_dumps"])
+        return {"pages": pages, "states": states,
+                "transitions": transitions, "flight_dumps": dumps}
 
     def goodput_union(self) -> Optional[Dict[str, Any]]:
         """The fleet goodput ledger as the UNION of every live shard's

@@ -1,18 +1,42 @@
 """Observability subsystems that sit ABOVE the span/metric primitives:
 `utils/tracing.py` and `utils/monitoring.py` record what happened;
-modules here turn those streams into operator-facing accounts (the
-fleet goodput ledger first — ISSUE 10)."""
+modules here turn those streams into operator-facing accounts — the
+fleet goodput ledger (ISSUE 10), and the detect-and-explain layer on
+top of it (ISSUE 15): the SLO engine with burn-rate alerting
+(`obs/slo.py`) and the crash-dump flight recorder (`obs/flight.py`)."""
 
+from kubeflow_tpu.obs.flight import FlightRecorder, flight_paths, stitch
 from kubeflow_tpu.obs.goodput import (
     CATEGORIES,
     GoodputAccountant,
     chaos_policy_parity_report,
     goodput_rows_digest,
 )
+from kubeflow_tpu.obs.slo import (
+    ALERTS_JOURNAL,
+    DEFAULT_WINDOWS,
+    TICK_WINDOWS,
+    Objective,
+    SLOEngine,
+    Windows,
+    default_objectives,
+    soak_objectives,
+)
 
 __all__ = [
+    "ALERTS_JOURNAL",
     "CATEGORIES",
+    "DEFAULT_WINDOWS",
+    "FlightRecorder",
     "GoodputAccountant",
+    "Objective",
+    "SLOEngine",
+    "TICK_WINDOWS",
+    "Windows",
     "chaos_policy_parity_report",
+    "default_objectives",
+    "flight_paths",
     "goodput_rows_digest",
+    "soak_objectives",
+    "stitch",
 ]

@@ -1,0 +1,319 @@
+"""Crash-dump flight recorder (ISSUE 15).
+
+When a soak gate trips, an alert pages, or a shard dies, the evidence —
+the last few hundred watch events, spans, metric movements — is exactly
+what a human (or the CI log) needs and exactly what was gone by the time
+anyone looked. The :class:`FlightRecorder` keeps a bounded, causally
+ordered ring of recent happenings per process and dumps it to a
+``flight-*.jsonl`` file on demand or on trigger:
+
+- **alert page** — the SLO engine (obs/slo.py) dumps on every ok/warn →
+  page transition;
+- **conservation-gate failure** — a registered guard (the goodput
+  ledger's exact-conservation check) flipping false dumps once;
+- **shard SIGKILL respawn** — a shard worker that replayed a WAL on
+  start dumps what the fresh incarnation knows under its shard dir;
+- **operator demand** — ``tpuctl flight dump``.
+
+Ring entries are ``{"seq", "shard", "t", "kind", "data"[, "trace_id"]}``:
+``seq`` is a per-recorder monotone counter (causal order WITHIN a
+process is exact), ``t`` is wall-clock (the only cross-process ordering
+there is), ``shard`` tags the process. :func:`stitch` merges dumps from
+many shards the way the PR-10 trace union merges span files: sort by
+``(t, shard, seq)``, dedup on identity — within one shard the order is
+causal, across shards it is wall-clock honest.
+
+Everything here is bounded: the ring evicts oldest-first, a dump is at
+most ring + a bounded tail of recent tracer spans, and metric-delta
+records are capped per sample.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+from kubeflow_tpu.utils import get_logger
+
+log = get_logger("flight")
+
+FLIGHT_GLOB = "flight-*.jsonl"
+
+#: Spans pulled from the tracer ring into a dump (newest kept).
+DUMP_SPAN_TAIL = 256
+
+#: Changed counter samples recorded per ``record_metric_deltas`` call.
+METRIC_DELTA_CAP = 64
+
+
+class FlightRecorder:
+    """Bounded per-process ring of recent events/spans/metric deltas.
+
+    ``shard`` tags every entry (and the dump header) so cross-shard
+    stitches stay attributable; ``tracer`` (optional) contributes its
+    newest spans to dumps; ``registry`` (optional) powers
+    :meth:`record_metric_deltas`. ``now_fn`` is THE clock for entries
+    recorded without an explicit ``t`` — tick-driven drivers hand in
+    their logical clock so every ring entry of a process lives in ONE
+    clock domain (mixing wall-clock events with tick-stamped alerts
+    would scramble the stitched timeline's ``(t, shard, seq)`` order);
+    default: wall clock.
+    """
+
+    def __init__(self, *, capacity: int = 2048, shard: str = "",
+                 tracer=None, registry=None,
+                 now_fn: Optional[Any] = None):
+        self._now = now_fn or time.time
+        self._ring: "deque[Dict[str, Any]]" = deque(maxlen=int(capacity))
+        self._seq = 0
+        self._lock = threading.Lock()
+        self.shard = shard
+        self.tracer = tracer
+        self.registry = registry
+        self._metric_base: Dict[tuple, float] = {}
+        self._metric_baselined = False
+        self._api = None
+        self._queue = None
+        self.dumps: List[str] = []      # paths written by this recorder
+        # Latched guard failures: a guard that flips false dumps ONCE
+        # (the conservation gate would otherwise dump every tick until
+        # someone fixed the ledger).
+        self._guards_tripped: set = set()
+
+    # ----------------- recording -----------------
+
+    def record(self, kind: str, data: Dict[str, Any], *,
+               t: Optional[float] = None, trace_id: str = "") -> None:
+        entry: Dict[str, Any] = {
+            "shard": self.shard,
+            "t": round(float(self._now() if t is None else t), 6),
+            "kind": kind,
+            "data": data,
+        }
+        if trace_id:
+            entry["trace_id"] = trace_id
+        with self._lock:
+            self._seq += 1
+            entry["seq"] = self._seq
+            self._ring.append(entry)
+
+    def attach(self, api) -> "FlightRecorder":
+        """Subscribe to the control plane's full watch stream (one
+        kind=None subscription, like the goodput accountant) so
+        :meth:`pump` can fold recent object transitions into the ring."""
+        self._api = api
+        self._queue = api.watch(None)
+        return self
+
+    def detach(self) -> None:
+        if self._api is not None and self._queue is not None:
+            try:
+                self._api.stop_watch(self._queue)
+            except AttributeError:
+                pass
+            self._queue = None
+
+    def pump(self, *, t: Optional[float] = None) -> int:
+        """Drain pending watch events into the ring (non-blocking),
+        summarized to one bounded line each. Tick-driven drivers pass
+        their logical clock as ``t`` so EVERY ring entry of the process
+        lives in one clock domain — mixing wall-clock events with
+        tick-stamped metric/alert entries would scramble the stitched
+        timeline's (t, shard, seq) order."""
+        if self._queue is None:
+            return 0
+        import queue as _queue
+
+        n = 0
+        while True:
+            try:
+                ev = self._queue.get_nowait()
+            except _queue.Empty:
+                return n
+            obj = getattr(ev, "object", None)
+            if obj is None:             # BOOKMARK / RELIST sentinels
+                continue
+            data = {
+                "type": getattr(ev, "type", ""),
+                "kind": getattr(obj, "kind", ""),
+                "namespace": obj.metadata.namespace,
+                "name": obj.metadata.name,
+                "rv": obj.metadata.resource_version,
+            }
+            phase = getattr(getattr(obj, "status", None), "phase", "")
+            if phase:
+                data["phase"] = phase
+            ctx = getattr(ev, "span_ctx", None)
+            self.record("event", data, t=t,
+                        trace_id=ctx[0] if ctx else "")
+            n += 1
+
+    def record_metric_deltas(self, *, t: Optional[float] = None) -> int:
+        """Record which ``*_total`` counters moved since the last call
+        (one bounded entry), so a dump shows metric MOVEMENT around the
+        incident, not just a final snapshot. Returns deltas recorded."""
+        if self.registry is None:
+            return 0
+        first = not self._metric_baselined
+        self._metric_baselined = True
+        deltas: Dict[str, float] = {}
+        for name, labels, value in self.registry.snapshot():
+            if not name.endswith("_total"):
+                continue
+            key = (name, labels)
+            prev = self._metric_base.get(key)
+            self._metric_base[key] = value
+            if first:
+                continue            # pure baseline pass
+            # A series born after the baseline moved from an implicit 0.
+            base = prev if prev is not None else 0.0
+            if value == base:
+                continue
+            if len(deltas) < METRIC_DELTA_CAP:
+                lbl = ",".join(f"{k}={v}" for k, v in labels)
+                deltas[f"{name}{{{lbl}}}" if lbl else name] = \
+                    round(value - base, 6)
+        if deltas:
+            self.record("metrics", {"deltas": deltas}, t=t)
+        return len(deltas)
+
+    # ----------------- guards -----------------
+
+    def check_guards(self, guards: Dict[str, Any],
+                     dump_dir: str = "") -> List[str]:
+        """Evaluate named guard callables (True = healthy). A guard
+        observed False for the FIRST time records a ``guard`` entry and
+        — when ``dump_dir`` is set — dumps the ring (latched: one dump
+        per guard per process lifetime). Returns the newly tripped
+        names."""
+        tripped = []
+        for name, fn in sorted(guards.items()):
+            if name in self._guards_tripped:
+                continue
+            try:
+                ok = bool(fn())
+            except Exception as e:  # noqa: BLE001 — a broken guard trips
+                ok = False
+                self.record("guard_error", {"guard": name,
+                                            "error": repr(e)})
+            if ok:
+                continue
+            self._guards_tripped.add(name)
+            tripped.append(name)
+            self.record("guard", {"guard": name, "ok": False})
+            if dump_dir:
+                self.dump(dump_dir, reason=f"guard:{name}")
+        return tripped
+
+    # ----------------- dumping -----------------
+
+    def dump(self, dir_path: str, *, reason: str = "manual") -> str:
+        """Write the ring (plus a bounded tail of recent tracer spans) to
+        ``<dir>/flight-<millis>-<n>-<reason>.jsonl``, fsync'd. The
+        header line carries the full reason/shard/time; every later
+        line is one ring entry or one span. Filenames and the header
+        are ALWAYS wall-clock — ring entries keep their caller's clock
+        domain, but dump names must sort consistently under one state
+        dir no matter which driver (tick or live) wrote them — and the
+        slugged reason in the name lets `ls` (and the CI respawn gate)
+        tell a shard-respawn dump from an alert-page one without
+        opening the file."""
+        import re as _re
+
+        os.makedirs(dir_path, exist_ok=True)
+        now = time.time()
+        with self._lock:
+            entries = list(self._ring)
+            n_dumps = len(self.dumps) + 1
+        slug = _re.sub(r"[^a-zA-Z0-9_-]+", "-", reason).strip("-")[:48] \
+            or "manual"
+        fname = (f"flight-{int(now * 1000):013d}-{n_dumps:03d}-"
+                 f"{slug}.jsonl")
+        path = os.path.join(dir_path, fname)
+        spans: List[Dict[str, Any]] = []
+        if self.tracer is not None:
+            for s in self.tracer.spans()[-DUMP_SPAN_TAIL:]:
+                spans.append({"shard": self.shard, "t": s.start_unix,
+                              "kind": "span", "seq": 0,
+                              "trace_id": s.trace_id,
+                              "data": {"name": s.name,
+                                       "span_id": s.span_id,
+                                       "duration_s": s.duration_s,
+                                       "attrs": s.attrs}})
+        header = {"kind": "flight", "reason": reason, "shard": self.shard,
+                  "t": round(now, 6), "entries": len(entries),
+                  "spans": len(spans), "seq": 0}
+        with open(path, "w") as f:
+            for rec in [header] + entries + spans:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        with self._lock:
+            self.dumps.append(path)
+        log.warning("flight recorder dumped", kv={
+            "path": path, "reason": reason, "entries": len(entries),
+        })
+        return path
+
+    # ----------------- reading / stitching -----------------
+
+    @staticmethod
+    def load(path: str) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    break       # torn tail: crash mid-write
+        return out
+
+
+def flight_paths(state_dir: str) -> List[str]:
+    """Every flight dump under a state dir — the root's own plus each
+    shard's (``shard-NN/flight-*.jsonl``), sorted by name (time)."""
+    import glob as _glob
+
+    paths = _glob.glob(os.path.join(state_dir, FLIGHT_GLOB))
+    paths += _glob.glob(os.path.join(state_dir, "shard-*", FLIGHT_GLOB))
+    return sorted(paths)
+
+
+def stitch(paths: Sequence[str]) -> List[Dict[str, Any]]:
+    """Merge flight dumps from many processes into ONE causally honest
+    timeline: entries sort by ``(t, shard, seq)`` — exact causal order
+    within a shard (seq), wall-clock order across shards — and entries
+    appearing in overlapping dumps of the same shard dedup on their
+    ``(shard, seq, kind, t)`` identity."""
+    seen = set()
+    out: List[Dict[str, Any]] = []
+    for p in paths:
+        for rec in FlightRecorder.load(p):
+            kind = rec.get("kind", "")
+            if kind == "flight":
+                rec = dict(rec)
+                rec["source"] = os.path.basename(p)
+                out.append(rec)      # headers are per-dump, never dedup
+                continue
+            if kind == "span":
+                # Spans carry no ring seq; their own ids identify them.
+                ident = (rec.get("shard", ""), "span",
+                         rec.get("trace_id", ""),
+                         rec.get("data", {}).get("span_id", ""))
+            else:
+                ident = (rec.get("shard", ""), rec.get("seq", 0),
+                         kind, rec.get("t", 0.0))
+            if ident in seen:
+                continue
+            seen.add(ident)
+            out.append(rec)
+    out.sort(key=lambda r: (r.get("t", 0.0), r.get("shard", ""),
+                            r.get("seq", 0)))
+    return out

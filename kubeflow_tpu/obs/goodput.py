@@ -79,6 +79,14 @@ TERMINAL_PHASES = ("Succeeded", "Failed")
 GOODPUT_JOURNAL = "goodput.jsonl"
 GOODPUT_STATE = "goodput.json"
 
+#: Journal rollover threshold (ISSUE 15 satellite): past this byte size
+#: the journal moves to ``<path>.1`` (the single-generation
+#: ``Tracer.rotate_jsonl`` discipline from PR 10) and the fresh
+#: generation opens with a compacting ``state`` record — so the CURRENT
+#: file is always self-contained and replay stays byte-identical even
+#: after the ``.1`` generation is itself replaced.
+JOURNAL_ROTATE_BYTES = 4 << 20
+
 
 def goodput_rows_digest(rows: Iterable[Tuple]) -> str:
     """Order-independent sha256 over ledger rows — per-shard accountants'
@@ -90,7 +98,12 @@ def goodput_rows_digest(rows: Iterable[Tuple]) -> str:
 
 class _Journal:
     """fsync'd jsonl appender with torn-tail-tolerant replay (the same
-    discipline as ``controlplane/ledger.py``)."""
+    discipline as ``controlplane/ledger.py``) and single-generation
+    rollover (the ``Tracer.rotate_jsonl`` discipline): past
+    ``rotate_bytes`` the file moves to ``<path>.1`` and appends restart
+    fresh — owners write a compacting state record as the new head so
+    the current generation is always self-contained. Shared by the
+    goodput ledger and the SLO engine's ``alerts.jsonl``."""
 
     def __init__(self, path: str, fsync: bool):
         self.path = path
@@ -107,6 +120,31 @@ class _Journal:
         if self.fsync:
             os.fsync(self._f.fileno())
 
+    def maybe_rotate(self, max_bytes: int) -> bool:
+        """Roll the journal to ``<path>.1`` once it outgrows
+        ``max_bytes`` (atomic rename replacing any prior generation).
+        Callers check BEFORE appending a new record and, on True, write
+        their state-compaction record as the fresh generation's head —
+        every record journaled so far has already been applied, so that
+        head covers the rotated-out generation exactly and the current
+        file is self-contained even after ``.1`` is itself replaced."""
+        if not self.path or self._f is None or max_bytes <= 0:
+            return False
+        if self._f.tell() <= max_bytes:
+            return False
+        self._f.close()
+        self._f = None
+        os.replace(self.path, self.path + ".1")
+        return True
+
+    @staticmethod
+    def generations(path: str) -> List[str]:
+        """On-disk generations, oldest first (``<path>.1`` then
+        ``<path>``), existing files only — replay reads ALL of them."""
+        if not path:
+            return []
+        return [p for p in (path + ".1", path) if os.path.exists(p)]
+
     @staticmethod
     def read(path: str) -> List[dict]:
         out: List[dict] = []
@@ -122,6 +160,28 @@ class _Journal:
                 except ValueError:
                     break       # torn tail record: crash mid-append
         return out
+
+    @classmethod
+    def read_generations(cls, path: str) -> List[dict]:
+        out: List[dict] = []
+        for p in cls.generations(path):
+            out.extend(cls.read(p))
+        return out
+
+    @staticmethod
+    def compact(path: str, head_rec: dict) -> None:
+        """Replace the journal (and any ``.1`` generation it covers)
+        with one state record: temp write, fsync, atomic rename — the
+        ONE compaction discipline the goodput ledger and the SLO
+        engine's alert journal share."""
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(head_rec, sort_keys=True) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        if os.path.exists(path + ".1"):
+            os.remove(path + ".1")
 
     def close(self) -> None:
         if self._f is not None:
@@ -178,6 +238,7 @@ class GoodputAccountant:
         registry: Optional[MetricsRegistry] = None,
         journal_path: str = "",
         fsync: bool = True,
+        rotate_bytes: int = JOURNAL_ROTATE_BYTES,
         explicit_assignments: bool = False,
         track_rollback: bool = True,
         # Tenant tree (ISSUE 13): when set, every job is attributed to
@@ -246,6 +307,7 @@ class GoodputAccountant:
         self._api = None
         self._queue = None
         self._journal = _Journal(journal_path, fsync)
+        self._rotate_bytes = int(rotate_bytes)
         self._replaying = False
         self.metrics_seconds = None
         self.metrics_ratio = None
@@ -676,8 +738,18 @@ class GoodputAccountant:
     # ----------------- record application (live AND replay) -----------------
 
     def _journal_rec(self, rec: dict) -> None:
-        if not self._replaying:
-            self._journal.append(rec)
+        if self._replaying:
+            return
+        # Rotation check BEFORE appending: every record journaled so
+        # far has been applied (journal-then-apply per record), so the
+        # compacting state head written into the fresh generation
+        # covers the rotated-out file EXACTLY — the current file then
+        # replays alone even after .1 is replaced by the next rollover.
+        if rec.get("op") != "state" \
+                and self._journal.maybe_rotate(self._rotate_bytes):
+            self._journal.append({"op": "state", "t": self._last,
+                                  "state": self.dump_state()})
+        self._journal.append(rec)
 
     def _apply_record(self, rec: dict) -> None:
         op = rec.get("op")
@@ -792,8 +864,11 @@ class GoodputAccountant:
         compacted to one state record (the ledger.jsonl discipline): a
         respawn's replay cost stays bounded by ledger size, not by how
         many ticks the previous incarnations lived. Returns records
-        applied."""
-        recs = _Journal.read(journal_path)
+        applied. Rotated journals replay BOTH generations (``<path>.1``
+        then ``<path>`` — the single-generation rollover discipline),
+        and compaction removes the stale ``.1`` the state record now
+        covers."""
+        recs = _Journal.read_generations(journal_path)
         with self._lock:
             self._replaying = True
             try:
@@ -803,15 +878,9 @@ class GoodputAccountant:
                 self._replaying = False
             if recs and journal_path == self._journal.path:
                 self._journal.close()
-                tmp = journal_path + ".tmp"
-                with open(tmp, "w") as f:
-                    f.write(json.dumps(
-                        {"op": "state", "t": self._last,
-                         "state": self.dump_state()},
-                        sort_keys=True) + "\n")
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.replace(tmp, journal_path)
+                _Journal.compact(journal_path,
+                                 {"op": "state", "t": self._last,
+                                  "state": self.dump_state()})
         if recs:
             log.info("goodput journal replayed", kv={
                 "records": len(recs), "last_tick": self._last,

@@ -1,0 +1,753 @@
+"""Fleet SLO engine: declarative objectives, burn-rate alerts (ISSUE 15).
+
+The platform *records* everything — verb/reconcile/watch-lag histograms
+(PR 4), the goodput ledger (PR 10), tenant SLO burn (PR 13) — but until
+now nothing *watched* it. This module is the detect-and-explain layer:
+
+- **Objectives** (:class:`Objective`) are declarative SLIs over the
+  metrics registry: a latency histogram + threshold ("99% of admissions
+  under 250ms"), a gauge family ("every tenant's goodput ratio >= 0.5",
+  one series per ``tenant`` label), or an arbitrary value source (the
+  goodput ledger's interruption delta). ``group_by`` fans one objective
+  out per label value — the starvation objective watches
+  ``kftpu_scheduler_queue_age_seconds`` per ``priority`` class.
+
+- **Multi-window burn rates**: each evaluation appends one
+  ``(t, good, bad)`` sample per series; burn over a window is the bad
+  fraction divided by the error budget ``(1 - slo)``. Four windows — a
+  fast pair (5m/1h real time) and a slow pair (6h/3d) — follow the SRE
+  multi-window discipline: the fast pair must BOTH burn past
+  ``page_burn`` to page (a blip in one window cannot), the slow pair
+  past ``warn_burn`` to warn. Windows are declarative seconds in live
+  runs and tick-scaled (:data:`TICK_WINDOWS`) in benches/soaks, so the
+  same state machine is deterministic under seeded ticks.
+
+- **Alert state machine** with hysteresis: escalation (ok→warn→page) is
+  immediate when the condition holds; de-escalation requires
+  ``clear_after`` consecutive quiet evaluations — a series flapping
+  across its threshold holds its state instead of re-paging every tick.
+  Every transition is journaled to ``alerts.jsonl`` (fsync'd, the
+  goodput-ledger/WAL discipline) and :meth:`SLOEngine.replay_from`
+  rebuilds states/counters byte-identically through the same apply path
+  — a SIGKILLed shard's engine comes back with an identical
+  :meth:`fingerprint`. The journal rotates with the single-generation
+  rollover (state-record head, both generations replayed).
+
+- **Exemplars**: histogram-backed objectives resolve their alert to the
+  newest over-threshold exemplar the histogram retained
+  (``Histogram.exemplar_over`` — the trace id captured at observe
+  time), so a fired alert carries the concrete trace ``tpuctl trace``
+  renders into the write→watch→reconcile (or submit→admit→decode)
+  causal timeline.
+
+- **Flight-recorder triggers**: a page transition (and any registered
+  guard flipping false — the goodput conservation gate) dumps the
+  attached :class:`~kubeflow_tpu.obs.flight.FlightRecorder` ring.
+
+Surfaces: ``tpuctl slo`` scoreboard (rc 3 on any page),
+``kftpu_slo_burn_rate{objective,window}`` gauges and
+``kftpu_alerts_total{objective,state}`` counters, plus ``slo`` sections
+in soak/storm reports. CI gates both directions in ``slo-smoke``: a
+clean seeded soak fires ZERO alerts, the fault-injected soak fires the
+expected objective set exactly once each (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import hashlib
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kubeflow_tpu.obs.goodput import JOURNAL_ROTATE_BYTES, _Journal
+from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.utils.monitoring import Gauge, Histogram, MetricsRegistry
+
+log = get_logger("slo")
+
+ALERTS_JOURNAL = "alerts.jsonl"
+
+#: Alert severities, escalation order.
+ALERT_STATES = ("ok", "warn", "page")
+_RANK = {s: i for i, s in enumerate(ALERT_STATES)}
+
+
+@dataclasses.dataclass(frozen=True)
+class Windows:
+    """The four burn-rate windows (seconds — or ticks, in tick-driven
+    drivers; the engine never converts, the caller picks the unit its
+    ``evaluate(now)`` clock uses)."""
+
+    fast_short: float = 300.0        # 5m
+    fast_long: float = 3600.0        # 1h
+    slow_short: float = 21600.0      # 6h
+    slow_long: float = 259200.0      # 3d
+
+    def items(self) -> Tuple[Tuple[str, float], ...]:
+        return (("fast_short", self.fast_short),
+                ("fast_long", self.fast_long),
+                ("slow_short", self.slow_short),
+                ("slow_long", self.slow_long))
+
+    @property
+    def longest(self) -> float:
+        return max(self.fast_short, self.fast_long,
+                   self.slow_short, self.slow_long)
+
+
+#: Real-time production windows.
+DEFAULT_WINDOWS = Windows()
+
+#: Tick-scaled windows for seeded soaks/benches (one evaluation per
+#: driver tick): short enough that a 40-round soak exercises the whole
+#: state machine, long enough that one startup tick cannot page.
+TICK_WINDOWS = Windows(fast_short=3.0, fast_long=6.0,
+                       slow_short=9.0, slow_long=18.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class Objective:
+    """One declarative SLO. Exactly one SLI source must be set:
+
+    - ``metric``: a histogram name; an event is GOOD when its observed
+      value <= ``threshold_s`` (the latency contract);
+    - ``gauge``: a gauge(-family) name; each evaluation samples every
+      series, GOOD when the value sits inside [min_value, max_value];
+    - ``value_fn``: an arbitrary callable; None = no sample this round.
+
+    ``slo`` is the target good fraction — the error budget is
+    ``1 - slo``. ``group_by`` fans the objective out per label value
+    (series key ``name[label=value]``)."""
+
+    name: str
+    description: str = ""
+    metric: str = ""
+    threshold_s: float = 0.0
+    gauge: str = ""
+    value_fn: Optional[Callable[[], Optional[float]]] = None
+    min_value: Optional[float] = None
+    max_value: Optional[float] = None
+    group_by: str = ""
+    slo: float = 0.99
+    page_burn: float = 14.4
+    warn_burn: float = 2.0
+    windows: Windows = DEFAULT_WINDOWS
+    clear_after: int = 3
+
+    def __post_init__(self):
+        sources = sum(1 for s in (self.metric, self.gauge,
+                                  self.value_fn) if s)
+        if sources != 1:
+            raise ValueError(
+                f"objective {self.name!r}: exactly one of metric/gauge/"
+                f"value_fn must be set, got {sources}")
+        if not 0.0 < self.slo < 1.0:
+            raise ValueError(
+                f"objective {self.name!r}: slo must be in (0, 1), "
+                f"got {self.slo}")
+        if self.value_fn is not None and self.group_by:
+            raise ValueError(
+                f"objective {self.name!r}: group_by needs a metric/gauge "
+                "family to enumerate")
+
+    def good_value(self, v: float) -> bool:
+        if self.min_value is not None and v < self.min_value:
+            return False
+        if self.max_value is not None and v > self.max_value:
+            return False
+        return True
+
+
+class _Series:
+    """Evaluation state for one (objective, group) series."""
+
+    __slots__ = ("key", "base", "labels", "samples", "prev_good",
+                 "prev_total", "state", "calm", "transitions", "pages",
+                 "burns", "exemplar", "last_t")
+
+    def __init__(self, key: str, base: str):
+        self.key = key
+        self.base = base
+        self.labels: Dict[str, str] = {}   # the group_by filter, if any
+        self.samples: "deque[Tuple[float, int, int]]" = deque()
+        self.prev_good: Optional[float] = None
+        self.prev_total: Optional[float] = None
+        self.state = "ok"
+        self.calm = 0                # consecutive quiet evaluations
+        self.transitions = 0
+        self.pages = 0
+        self.burns: Dict[str, Optional[float]] = {}
+        self.exemplar = ""           # trace id of the last transition
+        self.last_t = 0.0
+
+
+class SLOEngine:
+    """Evaluates a set of :class:`Objective` s against a metrics
+    registry, runs the alert state machine, journals transitions.
+
+    ``evaluate(now)`` is the one clock input: monotone seconds live
+    (``time.monotonic()``), integer ticks in seeded drivers — windows
+    are in the same unit. Deterministic: same metric/tick sequence, same
+    transitions, byte-identical journal."""
+
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        *,
+        objectives: List[Objective],
+        journal_path: str = "",
+        fsync: bool = True,
+        rotate_bytes: int = JOURNAL_ROTATE_BYTES,
+        recorder=None,                  # obs.flight.FlightRecorder
+        dump_dir: str = "",             # flight dumps land here on page
+        max_samples: int = 8192,
+    ):
+        names = [o.name for o in objectives]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate objective names in {names}")
+        self.registry = registry
+        self.objectives: Dict[str, Objective] = {
+            o.name: o for o in objectives}
+        self.recorder = recorder
+        self.dump_dir = dump_dir
+        self.max_samples = int(max_samples)
+        self.guards: Dict[str, Callable[[], bool]] = {}
+        self._series: Dict[str, _Series] = {}
+        self._journal = _Journal(journal_path, fsync)
+        self._rotate_bytes = int(rotate_bytes)
+        self._replaying = False
+        self._lock = threading.RLock()
+        self.metrics_burn = registry.gauge(
+            "kftpu_slo_burn_rate",
+            "Error-budget burn rate per objective series and window "
+            "(bad fraction over the window / (1 - slo))",
+            labels=("objective", "window"),
+        )
+        self.metrics_alerts = registry.counter(
+            "kftpu_alerts_total",
+            "Alert state transitions per objective series, labeled by "
+            "the state ENTERED",
+            labels=("objective", "state"),
+        )
+
+    # ----------------- wiring -----------------
+
+    def add_guard(self, name: str, fn: Callable[[], bool]) -> None:
+        """Register an invariant (True = healthy) checked every
+        evaluation; the FIRST False records + dumps the flight ring
+        (latched per guard — see FlightRecorder.check_guards)."""
+        self.guards[name] = fn
+
+    def rebaseline_sources(self) -> int:
+        """Re-anchor every value source that supports it (closures
+        carrying a ``rebaseline`` attribute) — called after persisted
+        state is restored INTO an already-built source, so history does
+        not read as a fresh delta. Returns sources re-anchored."""
+        n = 0
+        for obj in self.objectives.values():
+            hook = getattr(obj.value_fn, "rebaseline", None)
+            if hook is not None:
+                hook()
+                n += 1
+        return n
+
+    def set_journal(self, path: str, *, replay: bool = True) -> int:
+        """(Re)attach the alert journal — the platform wires this once
+        it knows its state dir. ``replay`` first rebuilds state from any
+        existing generations through the same apply path."""
+        with self._lock:
+            n = self.replay_from(path) if replay else 0
+            self._journal.close()
+            self._journal = _Journal(path, self._journal.fsync)
+            return n
+
+    # ----------------- measurement -----------------
+
+    def _series_for(self, key: str, base: str) -> _Series:
+        s = self._series.get(key)
+        if s is None:
+            s = self._series[key] = _Series(key, base)
+        return s
+
+    def _measure(self, obj: Objective) -> List[Tuple[str, int, int]]:
+        """This evaluation's ``(series_key, good, bad)`` samples for one
+        objective — [] when the source has no data yet (window-restart
+        semantics: no sample is not a good sample)."""
+        if obj.value_fn is not None:
+            v = obj.value_fn()
+            if v is None:
+                return []
+            good = obj.good_value(float(v))
+            return [(obj.name, 1 if good else 0, 0 if good else 1)]
+        if obj.gauge:
+            g = self.registry.get(obj.gauge)
+            if not isinstance(g, Gauge):
+                return []
+            out = []
+            for _name, labels, value in sorted(g.samples()):
+                ld = dict(labels)
+                if obj.group_by:
+                    gv = ld.get(obj.group_by)
+                    if gv is None:
+                        continue
+                    key = f"{obj.name}[{obj.group_by}={gv}]"
+                else:
+                    key = obj.name
+                good = obj.good_value(float(value))
+                out.append((key, 1 if good else 0, 0 if good else 1))
+            return out
+        h = self.registry.get(obj.metric)
+        if not isinstance(h, Histogram):
+            return []
+        groups: List[Tuple[str, Dict[str, str]]] = []
+        if obj.group_by:
+            values = sorted({
+                dict(ls).get(obj.group_by)
+                for ls in h.labelsets()
+            } - {None})
+            groups = [(f"{obj.name}[{obj.group_by}={v}]",
+                       {obj.group_by: v}) for v in values]
+        else:
+            groups = [(obj.name, {})]
+        out = []
+        # Largest finite bucket bound <= threshold: observations at or
+        # under it are the GOOD events (band granularity — thresholds
+        # should sit on bucket bounds for exactness).
+        idx = bisect.bisect_right(h.buckets, obj.threshold_s) - 1
+        for key, flt in groups:
+            pairs = h.cumulative(**flt)
+            total = pairs[-1][1]
+            good_cum = pairs[idx][1] if idx >= 0 else 0.0
+            s = self._series_for(key, obj.name)
+            s.labels = flt
+            if s.prev_total is None:
+                # Baseline sighting: history before the engine attached
+                # is not this engine's SLI window.
+                s.prev_good, s.prev_total = good_cum, total
+                continue
+            d_total = total - s.prev_total
+            d_good = good_cum - s.prev_good
+            s.prev_good, s.prev_total = good_cum, total
+            if d_total <= 0:
+                continue            # no events since last evaluation
+            d_good = max(0.0, min(d_good, d_total))
+            out.append((key, int(d_good), int(d_total - d_good)))
+        return out
+
+    def _window_burns(self, obj: Objective, s: _Series,
+                      now: float) -> Dict[str, Optional[float]]:
+        """All four windows' burns in ONE reverse traversal of the
+        sample deque (this rides every Platform.reconcile() pass; four
+        separate scans of an 8k-sample window per series added up)."""
+        items = sorted(obj.windows.items(), key=lambda kv: kv[1])
+        sums = {w: [0, 0] for w, _ in items}       # window -> [good, bad]
+        budget = 1.0 - obj.slo
+        good = bad = 0
+        i = 0
+        for t, g, b in reversed(s.samples):
+            age = now - t
+            while i < len(items) and age >= items[i][1]:
+                # This sample ages out of the i-th (shortest-first)
+                # window: freeze that window's sums.
+                sums[items[i][0]] = [good, bad]
+                i += 1
+            if i >= len(items):
+                break
+            good += g
+            bad += b
+        for w, _span in items[i:]:
+            sums[w] = [good, bad]
+        return {
+            w: ((b / (g + b)) / budget if (g + b) > 0 else None)
+            for w, (g, b) in sums.items()
+        }
+
+    def _exemplar_for(self, obj: Objective, s: _Series) -> str:
+        """The newest over-threshold exemplar trace id a burning
+        histogram objective retained, scoped to THIS series' group
+        labels — a grouped alert must not hand the operator a trace
+        from a sibling group's blip ("" for value/gauge objectives)."""
+        if not obj.metric:
+            return ""
+        h = self.registry.get(obj.metric)
+        if not isinstance(h, Histogram):
+            return ""
+        ex = h.exemplar_over(obj.threshold_s, **s.labels)
+        return str(ex["trace_id"]) if ex else ""
+
+    # ----------------- evaluation -----------------
+
+    def evaluate(self, now: float) -> List[dict]:
+        """One evaluation pass: sample every objective, age the windows,
+        run the state machine. Returns the transitions fired (already
+        journaled / recorded / dumped)."""
+        with self._lock:
+            now = float(now)
+            for obj in self.objectives.values():
+                for key, good, bad in self._measure(obj):
+                    s = self._series_for(key, obj.name)
+                    s.samples.append((now, good, bad))
+                    while len(s.samples) > self.max_samples:
+                        s.samples.popleft()
+            fired: List[dict] = []
+            for key in sorted(self._series):
+                s = self._series[key]
+                obj = self.objectives.get(s.base)
+                if obj is None:
+                    continue        # replayed series of a retired objective
+                cutoff = now - obj.windows.longest
+                while s.samples and s.samples[0][0] <= cutoff:
+                    s.samples.popleft()
+                burns = self._window_burns(obj, s, now)
+                s.burns = burns
+                for wname, b in burns.items():
+                    self.metrics_burn.set(
+                        b if b is not None else 0.0,
+                        objective=key, window=wname)
+                page = all(
+                    burns[w] is not None and burns[w] >= obj.page_burn
+                    for w in ("fast_short", "fast_long"))
+                warn = all(
+                    burns[w] is not None and burns[w] >= obj.warn_burn
+                    for w in ("slow_short", "slow_long"))
+                target = "page" if page else ("warn" if warn else "ok")
+                rec = self._step(obj, s, target, now)
+                if rec is not None:
+                    fired.append(rec)
+            if self.guards and self.recorder is not None:
+                for g in self.recorder.check_guards(self.guards,
+                                                    self.dump_dir):
+                    log.error("slo guard tripped", kv={"guard": g})
+            return fired
+
+    def _step(self, obj: Objective, s: _Series, target: str,
+              now: float) -> Optional[dict]:
+        """Hysteresis state machine: escalate immediately, de-escalate
+        only after ``clear_after`` consecutive quiet evaluations."""
+        new = None
+        if _RANK[target] > _RANK[s.state]:
+            new = target
+            s.calm = 0
+        elif _RANK[target] < _RANK[s.state]:
+            s.calm += 1
+            if s.calm >= obj.clear_after:
+                new = target
+                s.calm = 0
+        else:
+            s.calm = 0
+        if new is None or new == s.state:
+            return None
+        exemplar = (self._exemplar_for(obj, s)
+                    if _RANK[new] > 0 else s.exemplar)
+        rec = {
+            "op": "alert",
+            "t": round(now, 6),
+            "objective": s.key,
+            "base": s.base,
+            "from": s.state,
+            "to": new,
+            "burn": {w: (round(b, 4) if b is not None else None)
+                     for w, b in s.burns.items()},
+            "exemplar": exemplar,
+        }
+        self._journal_rec(rec)
+        self._apply_alert(rec)
+        if self.recorder is not None:
+            # No explicit t: the recorder's own clock keeps the ring in
+            # one domain (tick drivers hand their logical clock to the
+            # FlightRecorder, live platforms stay wall-clock).
+            self.recorder.record("alert", {
+                "objective": s.key, "from": rec["from"], "to": new,
+                "burn": rec["burn"]}, trace_id=exemplar)
+            if new == "page" and self.dump_dir:
+                self.recorder.dump(self.dump_dir,
+                                   reason=f"alert-page:{s.key}")
+        log.warning("slo alert transition", kv={
+            "objective": s.key, "from": rec["from"], "to": new,
+            "exemplar": exemplar or "-",
+        })
+        return rec
+
+    # ----------------- journal / replay -----------------
+
+    def _journal_rec(self, rec: dict) -> None:
+        if self._replaying:
+            return
+        # Rotate BEFORE appending (see goodput._Journal.maybe_rotate):
+        # the state head then covers the rotated generation exactly.
+        if rec.get("op") != "state" \
+                and self._journal.maybe_rotate(self._rotate_bytes):
+            self._journal.append({"op": "state", "series":
+                                  self._state_dict()})
+        self._journal.append(rec)
+
+    def _state_dict(self) -> Dict[str, dict]:
+        return {
+            key: {"base": s.base, "state": s.state,
+                  "transitions": s.transitions, "pages": s.pages,
+                  "exemplar": s.exemplar, "t": s.last_t}
+            for key, s in sorted(self._series.items())
+        }
+
+    def _apply_alert(self, rec: dict) -> None:
+        s = self._series_for(rec["objective"],
+                             rec.get("base", rec["objective"]))
+        s.state = rec["to"]
+        s.transitions += 1
+        s.last_t = float(rec.get("t", 0.0))
+        if rec.get("exemplar"):
+            s.exemplar = rec["exemplar"]
+        if rec["to"] == "page":
+            s.pages += 1
+        self.metrics_alerts.inc(objective=s.key, state=rec["to"])
+
+    def _apply_state(self, rec: dict) -> None:
+        for key, st in rec.get("series", {}).items():
+            s = self._series_for(key, st.get("base", key))
+            s.state = st.get("state", "ok")
+            s.transitions = int(st.get("transitions", 0))
+            s.pages = int(st.get("pages", 0))
+            s.exemplar = st.get("exemplar", "")
+            s.last_t = float(st.get("t", 0.0))
+
+    def replay_from(self, journal_path: str) -> int:
+        """Rebuild alert state by re-applying the journal through the
+        SAME apply path the live engine used (byte-identical
+        ``fingerprint()`` — the shard-SIGKILL gate). Reads both rotated
+        generations; replaying our OWN journal then compacts it to one
+        state record."""
+        recs = _Journal.read_generations(journal_path)
+        with self._lock:
+            self._replaying = True
+            try:
+                for rec in recs:
+                    op = rec.get("op")
+                    if op == "alert":
+                        self._apply_alert(rec)
+                    elif op == "state":
+                        self._apply_state(rec)
+            finally:
+                self._replaying = False
+            if recs and journal_path == self._journal.path:
+                self._journal.close()
+                _Journal.compact(journal_path,
+                                 {"op": "state",
+                                  "series": self._state_dict()})
+        if recs:
+            log.info("alert journal replayed",
+                     kv={"records": len(recs)})
+        return len(recs)
+
+    def close(self) -> None:
+        self._journal.close()
+
+    # ----------------- read surfaces -----------------
+
+    def states(self) -> Dict[str, str]:
+        with self._lock:
+            return {key: s.state for key, s in sorted(self._series.items())}
+
+    def pages_by_objective(self) -> Dict[str, int]:
+        """Objective (base) name -> page transitions fired, grouped
+        series summed — the count the slo-smoke gates compare."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            for s in self._series.values():
+                if s.pages:
+                    out[s.base] = out.get(s.base, 0) + s.pages
+        return out
+
+    def transitions_total(self) -> int:
+        with self._lock:
+            return sum(s.transitions for s in self._series.values())
+
+    def any_paging(self) -> bool:
+        with self._lock:
+            return any(s.state == "page" for s in self._series.values())
+
+    def fingerprint(self) -> str:
+        """Order-independent digest over the JOURNAL-DERIVED state (per
+        transitioned series: state, transition/page counts, exemplar) —
+        what the shard-SIGKILL replay gate compares pre/post. Series
+        that never transitioned carry no journal-observable state and
+        are excluded (a replayed engine hasn't re-measured them yet)."""
+        with self._lock:
+            rows = sorted(
+                f"{k}|{s.base}|{s.state}|{s.transitions}|{s.pages}|"
+                f"{s.exemplar}|{s.last_t}"
+                for k, s in self._series.items() if s.transitions > 0)
+        return hashlib.sha256("\n".join(rows).encode()).hexdigest()
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The scoreboard: every series with its burns, state, counts,
+        exemplar — plus objective metadata and totals."""
+        with self._lock:
+            series: Dict[str, Any] = {}
+            for key in sorted(self._series):
+                s = self._series[key]
+                obj = self.objectives.get(s.base)
+                series[key] = {
+                    "objective": s.base,
+                    "slo": obj.slo if obj else None,
+                    "state": s.state,
+                    "burn": {w: (round(b, 4) if b is not None else None)
+                             for w, b in s.burns.items()},
+                    "transitions": s.transitions,
+                    "pages": s.pages,
+                    "exemplar": s.exemplar,
+                    "samples": len(s.samples),
+                }
+            return {
+                "series": series,
+                "objectives": {
+                    name: {"description": o.description, "slo": o.slo,
+                           "source": o.metric or o.gauge or "value_fn",
+                           "threshold_s": o.threshold_s,
+                           "page_burn": o.page_burn,
+                           "warn_burn": o.warn_burn}
+                    for name, o in sorted(self.objectives.items())
+                },
+                "transitions": self.transitions_total(),
+                "pages": self.pages_by_objective(),
+                "paging": sorted(k for k, s in self._series.items()
+                                 if s.state == "page"),
+                "fingerprint": self.fingerprint(),
+            }
+
+
+# --------------------------------------------------------------------------
+# Stock objective sets
+# --------------------------------------------------------------------------
+
+
+def interruption_delta_source(accountant) -> Callable[[], Optional[float]]:
+    """Per-evaluation delta of the goodput ledger's interruption tally:
+    0.0 on a clean interval, >0 when a preemption/migration/restart
+    landed since the last evaluation. The ``max_value=0`` objective over
+    it is the deterministic goodput SLI the soaks page on (a cumulative
+    ratio dips too slowly to alert on, and per-tick ratios misread
+    normal gang startup as badput)."""
+    # Baseline NOW, not on first call: a respawned shard's first
+    # evaluation may coincide with the first post-replay interruption —
+    # a first-call baseline would swallow exactly that bump (found by
+    # the sharded slo-smoke probe).
+    state = {"prev": sum(accountant.interruptions.values())}
+
+    def fn() -> Optional[float]:
+        cur = sum(accountant.interruptions.values())
+        prev = state["prev"]
+        state["prev"] = cur
+        return float(cur - prev)
+
+    def rebaseline() -> None:
+        state["prev"] = sum(accountant.interruptions.values())
+
+    # Platform.load restores the ledger's persisted tallies AFTER the
+    # engine (and this closure) exist — rebaseline_sources() re-anchors
+    # so restored history never reads as a fresh interruption burst.
+    fn.rebaseline = rebaseline
+    return fn
+
+
+def default_objectives(*, goodput=None,
+                       windows: Windows = DEFAULT_WINDOWS,
+                       ) -> List[Objective]:
+    """The platform's stock fleet objectives (docs/observability.md
+    carries the table). Objectives whose source metric never appears
+    (no scheduler, no serving engine in-process) stay silently quiet —
+    no data is not an alert."""
+    objs = [
+        Objective(
+            name="admission-latency",
+            description="99% of apiserver verbs complete within 250ms",
+            metric="kftpu_apiserver_request_duration_seconds",
+            threshold_s=0.25, slo=0.99, windows=windows),
+        Objective(
+            name="watch-delivery-lag",
+            description="95% of watch events drain within 1s of their "
+                        "write",
+            metric="kftpu_watch_delivery_lag_seconds",
+            threshold_s=1.0, slo=0.95, windows=windows),
+        Objective(
+            name="time-to-placement",
+            description="90% of gangs place within 30s of admission",
+            metric="kftpu_scheduler_time_to_place_seconds",
+            threshold_s=30.0, slo=0.90, windows=windows),
+        Objective(
+            name="queue-age",
+            description="starvation: 90% of blocked placement attempts "
+                        "observe a queue age under 30min, per priority "
+                        "class (the ROADMAP item-3 aging signal)",
+            metric="kftpu_scheduler_queue_age_seconds",
+            threshold_s=1800.0, slo=0.90, group_by="priority",
+            windows=windows),
+        Objective(
+            name="serving-ttft",
+            description="95% of requests see their first token within "
+                        "500ms",
+            metric="kftpu_serving_ttft_seconds",
+            threshold_s=0.5, slo=0.95, windows=windows),
+        Objective(
+            name="serving-queue-wait",
+            description="95% of admitted requests wait under 250ms for "
+                        "a slot",
+            metric="kftpu_serving_queue_wait_seconds",
+            threshold_s=0.25, slo=0.95, windows=windows),
+        Objective(
+            name="tenant-goodput",
+            description="every tenant's rollup goodput ratio holds "
+                        ">= 0.5 (per-tenant series from the ledger "
+                        "gauge)",
+            gauge="kftpu_tenant_goodput_ratio", group_by="tenant",
+            min_value=0.5, slo=0.90, windows=windows),
+    ]
+    if goodput is not None:
+        objs.append(Objective(
+            name="goodput-interruptions",
+            description="interruption-free fleet time: no "
+                        "preemption/migration/restart lands in 90% of "
+                        "intervals",
+            value_fn=interruption_delta_source(goodput),
+            max_value=0.0, slo=0.90, page_burn=3.0, warn_burn=1.5,
+            windows=windows))
+    return objs
+
+
+def soak_objectives(accountant=None, *,
+                    watch_lag_threshold_s: float = 0.5,
+                    windows: Windows = TICK_WINDOWS) -> List[Objective]:
+    """The tick-scaled objective set the seeded chaos soaks evaluate
+    once per round — the CI ``slo-smoke`` contract: a clean soak fires
+    NOTHING; injected watch lag pages ``watch-delivery-lag`` and a
+    preemption burst pages ``goodput-interruptions``, each exactly
+    once (hysteresis holds the state through the fault window).
+
+    The watch-lag SLI is inherently WALL-CLOCK (write→drain time), so
+    its threshold needs headroom against host stalls on loaded CI
+    machines: 0.5s sits ~5000x above an in-process drain and 2x under
+    the 1.0s lag the fault soak injects — a sub-half-second scheduler
+    stall cannot fail the clean soak's zero-alert gate, the injected
+    lag still pages decisively."""
+    objs = [
+        Objective(
+            name="watch-delivery-lag",
+            description="90% of watch events drain within "
+                        f"{watch_lag_threshold_s}s",
+            metric="kftpu_watch_delivery_lag_seconds",
+            threshold_s=watch_lag_threshold_s, slo=0.90,
+            page_burn=5.0, warn_burn=2.0, windows=windows,
+            clear_after=2),
+    ]
+    if accountant is not None:
+        objs.append(Objective(
+            name="goodput-interruptions",
+            description="no interruption lands in 90% of soak rounds",
+            value_fn=interruption_delta_source(accountant),
+            # A soak is short: ONE preemption burst inside the fast
+            # windows must already page (burn of a single bad round
+            # over the 6-tick fast_long window is 1/6/0.1 ≈ 1.67).
+            max_value=0.0, slo=0.90, page_burn=1.5, warn_burn=1.0,
+            windows=windows, clear_after=2))
+    return objs

@@ -215,6 +215,12 @@ class StormReport:
     # kftpu_scheduler_queue_age_seconds observations (the aging surface
     # — asserted non-empty by the contended storm bench).
     queue_age_count: int = 0
+    # Starvation SLO (ISSUE 15): the tick-scaled queue-age objective
+    # evaluated per storm tick, one series per priority class. A `page`
+    # on the batch class under the priority policy IS the expected red
+    # alert the ROADMAP item-3 aging fix will land against — surfaced,
+    # not CI-gated, until aging exists.
+    slo: Dict[str, object] = dataclasses.field(default_factory=dict)
     # Elastic gangs (ISSUE 11): resize tallies. ``resizes`` sums
     # status.resizes across the fleet; shrinks/grows split the
     # scheduler's partial-release / partial-grow decisions.
@@ -271,6 +277,7 @@ class StormReport:
             "fairness_violations": self.fairness_violations,
             "tenant_protected": self.tenant_protected,
             "tenant_yields": self.tenant_yields,
+            "slo": dict(self.slo),
         }
 
 
@@ -338,6 +345,11 @@ def run_schedule_storm(
     drf: bool = True,
     burst_factor: int = 10,
     burst_tick: int = 4,
+    # Starvation SLO bound (ISSUE 15): a gang still waiting for its
+    # FIRST placement this many ticks after arrival counts against its
+    # priority class's queue-age objective. The per-class alert is the
+    # aging signal ROADMAP item 3 names.
+    starvation_bound_ticks: int = 50,
     registry: Optional[MetricsRegistry] = None,
 ) -> StormReport:
     fleet_capacity = dict(fleet_capacity or {slice_type: 8})
@@ -396,6 +408,31 @@ def run_schedule_storm(
         fleet, registry=registry, track_rollback=ckpt_every_ticks > 0,
         tenants=tree)
     accountant.attach(api)
+
+    # Starvation SLO (ISSUE 15): a per-priority-class gauge of the
+    # OLDEST still-unplaced gang's age in LOGICAL ticks (the wall-clock
+    # queue-age histogram is meaningless inside a tick-compressed
+    # storm), fed to a tick-windowed objective. Under the raw priority
+    # policy the batch class is expected to page on contended storms —
+    # the red alert the aging fix (ROADMAP item 3) lands against.
+    from kubeflow_tpu.obs.slo import TICK_WINDOWS, Objective, SLOEngine
+
+    queue_age_ticks = registry.gauge(
+        "kftpu_scheduler_queue_age_ticks",
+        "Oldest still-unplaced gang's age in storm ticks, per "
+        "priority class (the tick-domain twin of "
+        "kftpu_scheduler_queue_age_seconds)",
+        labels=("priority",),
+    )
+    slo_engine = SLOEngine(registry, objectives=[Objective(
+        name="queue-age",
+        description="starvation: the oldest waiting gang per priority "
+                    f"class stays under {starvation_bound_ticks} ticks",
+        gauge="kftpu_scheduler_queue_age_ticks", group_by="priority",
+        max_value=float(starvation_bound_ticks), slo=0.90,
+        page_burn=2.0, warn_burn=1.2, windows=TICK_WINDOWS,
+        clear_after=2,
+    )])
 
     by_name = {j.name: j for j in storm}
     # A gang runs for duration_ticks ticks of full placement, then its
@@ -553,6 +590,23 @@ def run_schedule_storm(
         for uid in completed_saves:
             accountant.checkpoint_saved(uid)
             accountant.set_checkpointing(uid, False)
+        # Starvation gauge + SLO evaluation: oldest FIRST-placement wait
+        # per priority class among arrived, live, never-placed gangs.
+        placed_names_now = {uid_to_name[uid] for uid in placed_tick}
+        oldest: Dict[int, int] = {}
+        for j in storm:
+            if j.arrival_tick > t or j.name in placed_names_now:
+                continue
+            job = jobs_now.get(j.name)
+            if job is not None and job.status.phase in ("Succeeded",
+                                                        "Failed"):
+                continue
+            age = t - j.arrival_tick
+            oldest[j.priority] = max(oldest.get(j.priority, 0), age)
+        for _name, prio, _w in PRIORITY_CLASSES:
+            queue_age_ticks.set(float(oldest.get(prio, 0)),
+                                priority=str(prio))
+        slo_engine.evaluate(t + 1)
         util_sum += 1.0 - len(fleet.free()) / total_units
         util_ticks += 1
         if stop_when_done and len(jobs_now) == total_jobs and all(
@@ -656,8 +710,10 @@ def run_schedule_storm(
         tenant_yields=int(registry.get(
             "kftpu_scheduler_placements_total").value(
                 outcome="tenant_yield")),
+        slo=slo_engine.snapshot(),
     )
     accountant.close()
+    slo_engine.close()
     mgr.close()
     return report
 

@@ -140,14 +140,25 @@ class GangScheduler:
             "Evictions of an at-or-below-fair-share tenant's gang by an "
             "above-fair-share tenant (must stay 0 under DRF enforcement)",
         )
+        # TTP extends past the default latency bands: a gang behind a
+        # full fleet legitimately waits tens of seconds to minutes, and
+        # the ISSUE-15 time-to-placement objective's 30s threshold must
+        # sit ON a bucket bound (the SLI counts good events at band
+        # granularity — a 5s-max histogram would silently enforce a 6x
+        # stricter contract).
+        from kubeflow_tpu.utils.monitoring import DEFAULT_LATENCY_BUCKETS
+
         self.metrics_ttp = registry.histogram(
             "kftpu_scheduler_time_to_place_seconds",
             "Pending-to-placed latency per gang",
+            buckets=DEFAULT_LATENCY_BUCKETS + (10.0, 30.0, 120.0, 600.0),
         )
         self.metrics_queue_age = registry.histogram(
             "kftpu_scheduler_queue_age_seconds",
             "Age of still-waiting gangs (time since Admitted=False), "
-            "observed on every blocked placement attempt",
+            "observed on every blocked placement attempt, per priority "
+            "class — the starvation SLO objective's signal (ISSUE 15)",
+            labels=("priority",),
             buckets=QUEUE_AGE_BUCKETS,
         )
         self.metrics_utilization = registry.gauge(
@@ -492,14 +503,16 @@ class GangScheduler:
                 blocked = self._fifo_blocked(job, jobs or [])
                 if blocked is not None:
                     self.metrics_queue_age.observe(
-                        now - self._pending_since[uid])
+                        now - self._pending_since[uid],
+                        priority=str(job.spec.priority))
                     return (None, blocked)
             if self.policy == "priority" and self.tenants is not None \
                     and self.drf:
                 blocked = self._drf_blocked(job, jobs or [])
                 if blocked is not None:
                     self.metrics_queue_age.observe(
-                        now - self._pending_since[uid])
+                        now - self._pending_since[uid],
+                        priority=str(job.spec.priority))
                     self.metrics_placements.inc(outcome="tenant_yield")
                     return (None, blocked)
 
@@ -522,11 +535,14 @@ class GangScheduler:
                         break
             if placement is None:
                 # Queue-age surface: every blocked attempt observes how
-                # long this gang has already waited — the aging signal
-                # `tpuctl queue` summarizes and the storm bench gates
-                # non-empty.
+                # long this gang has already waited, labeled with the
+                # gang's priority class — the aging signal `tpuctl
+                # queue` summarizes, the storm bench gates non-empty,
+                # and the ISSUE-15 starvation objective evaluates per
+                # class.
                 self.metrics_queue_age.observe(
-                    now - self._pending_since[uid])
+                    now - self._pending_since[uid],
+                    priority=str(job.spec.priority))
                 self.metrics_placements.inc(outcome="no_fit")
                 frag = self.fleet.fragmentation(st)
                 free = len(self.fleet.free(st))

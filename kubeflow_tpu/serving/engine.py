@@ -935,7 +935,11 @@ class ServingEngine:
             self._queue.popleft()
             self._slots[i] = _Slot(req)
             wait = max(0.0, now - req.submitted_at)
-            self.metrics_queue_wait.observe(wait)
+            # Exemplar = the request id (ISSUE 15): serving runs no
+            # tracer spans, so the queue-wait/TTFT buckets carry the
+            # submit→admit→decode identity directly.
+            self.metrics_queue_wait.observe(
+                wait, exemplar=f"req:{req.request_id}")
             self._recent_queue_waits.append((time.monotonic(), wait))
             self._note_resident(prefix_key(req.prompt))
             # Radix chain keys too (ISSUE 13): the LB's longest-prefix
@@ -1439,7 +1443,8 @@ class ServingEngine:
         if done_eos or done_len or done_cap:
             now = time.time()
             ttft = (slot.first_token_at or now) - req.submitted_at
-            self.metrics_ttft.observe(max(0.0, ttft))
+            self.metrics_ttft.observe(max(0.0, ttft),
+                                      exemplar=f"req:{req.request_id}")
             if len(slot.generated) > 1 and slot.first_token_at is not None:
                 self.metrics_per_token.observe(
                     max(0.0, now - slot.first_token_at)

@@ -61,7 +61,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from kubeflow_tpu.serving.blocks import prefix_chain, prefix_key
 from kubeflow_tpu.utils import get_logger
@@ -85,10 +85,23 @@ PREFIX_KEY_MIN_TOKENS = 8
 #: LB-side affinity map capacity (key -> last backend address). LRU.
 AFFINITY_MAP_SIZE = 4096
 
-#: Tenanted arrivals the fair-share window covers (ISSUE 13): large
-#: enough that a real burst cannot hide inside it, small enough that
-#: an hour-old traffic mix no longer decides who sheds now.
+#: Tenanted arrivals the fair-share window covers (ISSUE 13,
+#: ``share_window="count"``): large enough that a real burst cannot hide
+#: inside it, small enough that an hour-old traffic mix no longer
+#: decides who sheds now.
 TENANT_WINDOW = 4096
+
+#: Half-life of the time-decayed fair-share window (ISSUE 15,
+#: ``share_window="decay"``, the default): each tenant's windowed
+#: arrival mass halves every this-many seconds of monotonic time. On a
+#: low-QPS fleet the count window's last-4096 arrivals can span hours —
+#: a morning burst then decides the evening's sheds; exponential decay
+#: over TIME forgets at the same rate regardless of traffic volume.
+TENANT_SHARE_HALF_LIFE_S = 60.0
+
+#: Decayed arrival mass below this is dropped from the table (a tenant
+#: quiet for ~20 half-lives no longer exists to the fair-share split).
+_DECAY_FLOOR = 1e-6
 
 
 def derive_affinity_keys(body: dict,
@@ -234,12 +247,27 @@ class ServingLoadBalancer:
         # per-tenant shed accounting on /healthz. None = the pre-ISSUE-13
         # blanket shedding, byte-identical.
         tenants=None,
+        # Fair-share window mode (ISSUE 15, closing the PR-13
+        # follow-up): "decay" (default) weighs each tenant's arrivals
+        # with an exponential decay over MONOTONIC TIME (half-life
+        # ``share_half_life_s``) — low-QPS fleets forget old traffic at
+        # the same rate as busy ones; "count" keeps the PR-13 fixed
+        # last-TENANT_WINDOW-arrivals window (the A/B lever).
+        share_window: str = "decay",
+        share_half_life_s: float = TENANT_SHARE_HALF_LIFE_S,
+        share_clock=time.monotonic,
         registry: MetricsRegistry = global_registry,
     ):
         if prefix_match not in ("radix", "exact"):
             raise ValueError(
                 f"prefix_match must be 'radix' or 'exact', "
                 f"got {prefix_match!r}")
+        if share_window not in ("decay", "count"):
+            raise ValueError(
+                f"share_window must be 'decay' or 'count', "
+                f"got {share_window!r}")
+        if share_half_life_s <= 0:
+            raise ValueError("share_half_life_s must be > 0")
         self.connect_timeout_s = connect_timeout_s
         self.request_timeout_s = request_timeout_s
         self.health_timeout_s = health_timeout_s
@@ -286,14 +314,27 @@ class ServingLoadBalancer:
         self.tenant_arrivals: Dict[str, int] = {}
         self.shed_by_tenant: Dict[str, int] = {}
         self.shed_untenanted = 0
-        # Fair shares are computed over a SLIDING WINDOW of the last
-        # TENANT_WINDOW tenanted arrivals, not since-boot totals: on a
-        # long-lived LB, cumulative counts would let a long-quiet
-        # tenant's fresh burst dispatch while the historically-busy
-        # in-share tenant sheds — fairness inverted by ancient history.
+        # Fair shares are computed over a WINDOW of recent tenanted
+        # arrivals, not since-boot totals: on a long-lived LB,
+        # cumulative counts would let a long-quiet tenant's fresh burst
+        # dispatch while the historically-busy in-share tenant sheds —
+        # fairness inverted by ancient history. Two window modes:
+        # "count" (PR 13) keeps the last TENANT_WINDOW arrivals in a
+        # deque; "decay" (the default) keeps one exponentially-decayed
+        # mass per tenant over monotonic time — the low-QPS-honest
+        # window the per-tenant SLO objective reads cleanly.
+        self.share_window = share_window
+        self.share_half_life_s = float(share_half_life_s)
+        self._share_clock = share_clock
         self._tenant_window: "collections.deque[str]" = \
             collections.deque()
         self._tenant_window_counts: Dict[str, int] = {}
+        # Decay mode state: tenant -> (mass, last_update_t). Decay is
+        # applied LAZILY per tenant on its own arrivals (exponential
+        # decay is per-tenant independent, so the math is identical);
+        # only the read paths (shed decision, /healthz) sweep the whole
+        # table — arrivals stay O(1) however many tenants are active.
+        self._tenant_decayed: Dict[str, Tuple[float, float]] = {}
         # Session registry: session id -> namespace, for traffic whose
         # only identity is its session key (the "session key ->
         # namespace -> tenant" resolution leg). Populated by the
@@ -378,16 +419,34 @@ class ServingLoadBalancer:
                 return ns
         return None
 
+    def _decayed_mass_locked(self, tenant: str, now: float) -> float:
+        """One tenant's arrival mass decayed to ``now`` (lazy: each
+        tenant's record carries its own last-update time)."""
+        rec = self._tenant_decayed.get(tenant)
+        if rec is None:
+            return 0.0
+        mass, last = rec
+        dt = now - last
+        if dt <= 0:
+            return mass
+        return mass * 0.5 ** (dt / self.share_half_life_s)
+
     def note_tenant_arrival(self, tenant: Optional[str]) -> None:
         """Count one offered request toward the tenant's demand — the
-        cumulative ledger (/healthz accounting) AND the sliding
-        fair-share window the shed decision divides by. Counted once
-        per request (never per dispatch retry)."""
+        cumulative ledger (/healthz accounting) AND the fair-share
+        window the shed decision divides by (decayed mass or count
+        deque per ``share_window``). Counted once per request (never
+        per dispatch retry)."""
         if tenant is None:
             return
         with self._lock:
             self.tenant_arrivals[tenant] = \
                 self.tenant_arrivals.get(tenant, 0) + 1
+            if self.share_window == "decay":
+                now = float(self._share_clock())
+                self._tenant_decayed[tenant] = (
+                    self._decayed_mass_locked(tenant, now) + 1.0, now)
+                return
             self._tenant_window.append(tenant)
             self._tenant_window_counts[tenant] = \
                 self._tenant_window_counts.get(tenant, 0) + 1
@@ -399,22 +458,55 @@ class ServingLoadBalancer:
                 else:
                     self._tenant_window_counts.pop(old, None)
 
+    def _window_counts_locked(self) -> Dict[str, float]:
+        """The fair-share numerators: per-tenant windowed arrival mass
+        (decayed, or deque counts in "count" mode). The decay sweep
+        happens HERE — on the read paths (shed decision, /healthz) —
+        dropping dust so a long-quiet tenant stops existing to the
+        fair split; arrivals never pay the full-table walk."""
+        if self.share_window == "decay":
+            now = float(self._share_clock())
+            out: Dict[str, float] = {}
+            for t in list(self._tenant_decayed):
+                m = self._decayed_mass_locked(t, now)
+                if m < _DECAY_FLOOR:
+                    del self._tenant_decayed[t]
+                else:
+                    self._tenant_decayed[t] = (m, now)
+                    out[t] = m
+            return out
+        return {t: float(n)
+                for t, n in self._tenant_window_counts.items() if n > 0}
+
+    def tenant_shares_snapshot(self) -> Dict[str, float]:
+        """Each windowed tenant's share of the windowed arrival mass —
+        the live fair-share read surface (/healthz, and the per-tenant
+        SLO objective on low-QPS fleets)."""
+        with self._lock:
+            counts = self._window_counts_locked()
+            total = sum(counts.values())
+            if total <= 0:
+                return {}
+            return {t: round(m / total, 6)
+                    for t, m in sorted(counts.items())}
+
     def _tenant_overage_locked(self, tenant: str) -> float:
-        """Windowed arrivals beyond the tenant's weighted fair fraction
-        of the window's tenanted arrivals (> 0 = over share, the shed
-        trigger). Fair fractions split by weight among tenants present
-        in the window — work-conserving, like the scheduler's DRF."""
-        total = len(self._tenant_window)
+        """Windowed arrival mass beyond the tenant's weighted fair
+        fraction of the window's tenanted mass (> 0 = over share, the
+        shed trigger). Fair fractions split by weight among tenants
+        present in the window — work-conserving, like the scheduler's
+        DRF. Identical math in both window modes; only the mass
+        bookkeeping differs."""
+        counts = self._window_counts_locked()
+        total = sum(counts.values())
         if total <= 0:
             return 0.0
-        weights = {t: self._tenant_weights.get(t, 1.0)
-                   for t, n in self._tenant_window_counts.items()
-                   if n > 0}
+        weights = {t: self._tenant_weights.get(t, 1.0) for t in counts}
         wsum = sum(weights.values())
         if tenant not in weights or wsum <= 0:
             return 0.0
         fair = total * weights[tenant] / wsum
-        return self._tenant_window_counts.get(tenant, 0) - fair
+        return counts.get(tenant, 0.0) - fair
 
     # ------------- backend set management -------------
 
@@ -774,14 +866,19 @@ class ServingLoadBalancer:
             # shed_total == sum(tenant sheds) + shed_untenanted — the
             # invariant the tenant-burst soak gates.
             with self._lock:
+                shares = self._window_counts_locked()
+                share_total = sum(shares.values()) or 1.0
                 payload["tenants"] = {
                     t: {"weight": self._tenant_weights.get(t, 1.0),
                         "arrivals": self.tenant_arrivals.get(t, 0),
-                        "sheds": self.shed_by_tenant.get(t, 0)}
+                        "sheds": self.shed_by_tenant.get(t, 0),
+                        "window_share": round(
+                            shares.get(t, 0.0) / share_total, 6)}
                     for t in sorted(set(self._tenant_weights)
                                     | set(self.tenant_arrivals))
                 }
                 payload["shed_untenanted"] = self.shed_untenanted
+                payload["share_window"] = self.share_window
         return payload if ok else (503, payload)
 
     def router(self) -> Router:

@@ -83,6 +83,14 @@ Stages (any failure exits non-zero — the merge gate contract):
    goodput conservation with the per-tenant rollup non-vacuous; plus
    the two-tenant 2x-burst serving soak gated on EXACT per-tenant shed
    accounting (``--skip-tenant``).
+8e. **slo-smoke**: the SLO engine (ISSUE 15), gated in both
+   directions — the CLEAN seeded soak fires ZERO alert transitions
+   (false-positive gate) while the fault-injected soak (watch lag +
+   preemption bursts) pages exactly the expected objective set exactly
+   once each with a resolvable exemplar trace id and a written flight
+   dump (true-positive gate); alerts.jsonl replays byte-identically
+   into a fresh engine AND across a whole-shard SIGKILL, whose respawn
+   leaves its own flight dump (``--skip-slo``).
 9. **bench-gate**: if --bench-json is given, require
    ``vs_baseline >= --min-vs-baseline`` for every record — the perf
    regression gate SURVEY §7.8 prescribes.
@@ -305,6 +313,105 @@ def run_shard_smoke(seed: int = 20260803, shards: int = 2) -> None:
             f"shard smoke ({tag}): goodput conservation broken across "
             f"the shard union: {rep.goodput}"
         )
+
+
+def run_slo_smoke(seed: int = 20260803) -> None:
+    """SLO-engine smoke (ISSUE 15), count-gated in BOTH directions:
+
+    - **false-positive gate**: the clean seeded soak (conflicts and
+      transients, but no preemptions and no watch lag) fires ZERO alert
+      transitions and writes no flight dump;
+    - **true-positive gate**: the fault-injected soak (1.0s watch lag
+      against the 0.5s threshold, + the preemption bursts) pages
+      EXACTLY the expected objective set
+      exactly once each, the paged latency objective carries a
+      resolvable exemplar trace id, and a flight dump was written;
+    - **replay gate**: alerts.jsonl replays byte-identically into a
+      fresh engine (fingerprint equality), and across a whole-shard
+      SIGKILL the respawned shard's engine replays identically too —
+      with the respawn itself leaving a flight dump.
+    """
+    import os as _os
+    import shutil
+    import tempfile
+
+    from kubeflow_tpu.chaos import run_sharded_soak, run_soak
+    from kubeflow_tpu.obs.slo import ALERTS_JOURNAL, SLOEngine, soak_objectives
+    from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+    clean_sd = tempfile.mkdtemp(prefix="kftpu-slo-smoke-clean-")
+    try:
+        # The clean soak gets a REAL state dir: with dump_dir unset the
+        # recorder could never dump and the no-dump gate would be
+        # vacuous — it must be able to fail.
+        clean = run_soak(num_jobs=4, seed=seed, conflict_rate=0.3,
+                         transient_rate=0.05, preempt_every=0,
+                         fault_rounds=9, max_rounds=40,
+                         state_dir=clean_sd)
+        if clean.slo.get("transitions", 0) != 0:
+            raise GateFailure(
+                f"slo-smoke: clean soak fired alert transitions — "
+                f"false-positive gate broken: {clean.slo.get('series')}")
+        if clean.flight_dumps:
+            raise GateFailure(
+                f"slo-smoke: clean soak wrote flight dumps "
+                f"{clean.flight_dumps} with nothing paging")
+    finally:
+        shutil.rmtree(clean_sd, ignore_errors=True)
+
+    sd = tempfile.mkdtemp(prefix="kftpu-slo-smoke-")
+    try:
+        # Injected lag 1.0s against the 0.5s objective threshold: 2x
+        # detection margin, and a clean-soak false fire would need
+        # sustained >0.5s host stalls inside the write→drain window.
+        rep = run_soak(num_jobs=4, seed=seed, conflict_rate=0.3,
+                       transient_rate=0.05, preempt_every=3,
+                       fault_rounds=9, max_rounds=40,
+                       watch_lag_s=1.0, state_dir=sd)
+        pages = rep.slo.get("pages", {})
+        expected = {"goodput-interruptions": 1, "watch-delivery-lag": 1}
+        if pages != expected:
+            raise GateFailure(
+                f"slo-smoke: fault soak paged {pages}, expected exactly "
+                f"{expected} (series: { {k: v['state'] for k, v in rep.slo.get('series', {}).items()} })")
+        if not rep.flight_dumps:
+            raise GateFailure(
+                "slo-smoke: fault soak paged but wrote NO flight dump")
+        lag_series = rep.slo["series"].get("watch-delivery-lag", {})
+        if not lag_series.get("exemplar"):
+            raise GateFailure(
+                "slo-smoke: the paged watch-delivery-lag alert carries "
+                "no exemplar trace id — the metric→trace edge is broken")
+        journal = _os.path.join(sd, ALERTS_JOURNAL)
+        fresh = SLOEngine(MetricsRegistry(),
+                          objectives=soak_objectives(None))
+        fresh.replay_from(journal)
+        if fresh.fingerprint() != rep.slo["fingerprint"]:
+            raise GateFailure(
+                "slo-smoke: alerts.jsonl replay produced a DIFFERENT "
+                "fingerprint than the live engine — the journal/apply "
+                "path diverged")
+    finally:
+        shutil.rmtree(sd, ignore_errors=True)
+
+    shard = run_sharded_soak(num_jobs=4, shards=2, seed=seed,
+                             conflict_rate=0.3, transient_rate=0.05,
+                             preempt_every=3, kill_shard_round=4,
+                             fault_rounds=8, max_rounds=40)
+    if not shard.alerts_replay_identical:
+        raise GateFailure(
+            "slo-smoke: the killed shard's SLO engine did NOT replay "
+            "alerts.jsonl to a byte-identical fingerprint")
+    if shard.slo.get("transitions", 0) < 1:
+        raise GateFailure(
+            "slo-smoke: the sharded fault soak journaled no alert "
+            "transitions — the shard replay gate would be vacuous")
+    if not any("shard-respawn" in p for p in shard.flight_dumps):
+        raise GateFailure(
+            "slo-smoke: the respawned shard left no shard-respawn "
+            f"flight dump (dumps: {shard.flight_dumps}) — matching any "
+            "dump here would let an alert-page dump mask a broken "
+            "respawn path")
 
 
 def run_serve_bench_smoke(rate_qps: float = 60.0,
@@ -681,7 +788,8 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
              skip_serve: bool = False,
              skip_schedule: bool = False,
              skip_elastic: bool = False,
-             skip_tenant: bool = False) -> List[str]:
+             skip_tenant: bool = False,
+             skip_slo: bool = False) -> List[str]:
     """Run all stages; returns the list of passed stages, raises
     GateFailure on the first failing one."""
     passed: List[str] = []
@@ -796,6 +904,11 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
         run_tenant_smoke()
         passed.append("tenant-smoke")
 
+    if not skip_slo:
+        _stage("slo-smoke")
+        run_slo_smoke(seed=chaos_seed)
+        passed.append("slo-smoke")
+
     if not skip_serve:
         _stage("serve-bench-smoke")
         run_serve_bench_smoke()
@@ -862,6 +975,9 @@ def main(argv=None) -> int:
     g.add_argument("--skip-tenant", action="store_true",
                    help="skip the multi-tenant fairness storm + "
                         "tenant-shed serving soak smoke")
+    g.add_argument("--skip-slo", action="store_true",
+                   help="skip the SLO-engine false/true-positive soak "
+                        "gates and the alert-journal replay gate")
     args = p.parse_args(argv)
     try:
         passed = run_gate(
@@ -879,6 +995,7 @@ def main(argv=None) -> int:
             skip_schedule=args.skip_schedule,
             skip_elastic=args.skip_elastic,
             skip_tenant=args.skip_tenant,
+            skip_slo=args.skip_slo,
         )
     except GateFailure as e:
         print(f"[ci] FAIL: {e}", file=sys.stderr)

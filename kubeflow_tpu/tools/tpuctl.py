@@ -549,6 +549,123 @@ def cmd_tenants(args) -> int:
     return 0 if snap["conserved"] else 3
 
 
+def cmd_slo(args) -> int:
+    """Fleet SLO scoreboard (ISSUE 15): every objective series with its
+    multi-window burn rates, alert state, page count, and the exemplar
+    trace id a burning latency objective retained (resolve it with
+    ``tpuctl trace --id <trace_id>``). rc 3 when ANY series is paging —
+    the scriptable "is the fleet inside its objectives" check."""
+    if args.backend == "kubectl":
+        print("slo is a state-backend command (the engine lives with "
+              "the embedded platform)", file=sys.stderr)
+        return 2
+    platform = _load_platform(args)
+    platform.reconcile()
+    eng = platform.slo
+    if eng is None:
+        print("slo engine is off: start the tpujob-controller component "
+              "(it carries the fleet objectives)", file=sys.stderr)
+        return 1
+    snap = eng.snapshot()
+    if args.output == "json":
+        print(json.dumps(snap, indent=2, sort_keys=True))
+        return 3 if snap["paging"] else 0
+    if not snap["series"]:
+        print("no SLI data yet: objectives are declared but no source "
+              "metric has observations")
+        for name, o in snap["objectives"].items():
+            print(f"  {name:<24} slo={o['slo']:g} "
+                  f"source={o['source']} — {o['description']}")
+        return 0
+
+    def b(v):
+        return f"{v:.2f}" if v is not None else "-"
+
+    fmt = "{:<34} {:>5} {:<5} {:>7} {:>7} {:>7} {:>7} {:>5} {}"
+    print(fmt.format("SERIES", "SLO", "STATE", "FAST_S", "FAST_L",
+                     "SLOW_S", "SLOW_L", "PAGES", "EXEMPLAR"))
+    for key, e in snap["series"].items():
+        burn = e["burn"]
+        print(fmt.format(
+            key, f"{e['slo']:g}" if e["slo"] else "-", e["state"],
+            b(burn.get("fast_short")), b(burn.get("fast_long")),
+            b(burn.get("slow_short")), b(burn.get("slow_long")),
+            e["pages"], e["exemplar"] or "-"))
+    print(f"{snap['transitions']} alert transitions; paging: "
+          f"{', '.join(snap['paging']) or 'none'}")
+    return 3 if snap["paging"] else 0
+
+
+def cmd_flight(args) -> int:
+    """Flight recorder (ISSUE 15): ``dump`` writes this invocation's
+    ring (recent watch events, metric movement, spans) to
+    ``flight-*.jsonl`` under the state dir; ``ls`` lists every dump
+    (shard dirs included); ``show`` stitches them — cross-shard, like
+    the PR-10 trace union — into one causally ordered timeline."""
+    from kubeflow_tpu.obs.flight import flight_paths, stitch
+
+    if args.backend == "kubectl":
+        print("flight is a state-backend command", file=sys.stderr)
+        return 2
+    if args.action == "dump":
+        platform = _load_platform(args)
+        platform.reconcile()
+        if platform.flight is None:
+            print("flight recorder is off: start the tpujob-controller "
+                  "component", file=sys.stderr)
+            return 1
+        path = platform.flight.dump(args.state_dir, reason="tpuctl")
+        print(path)
+        return 0
+    paths = [args.path] if args.path else flight_paths(args.state_dir)
+    if args.action == "ls":
+        for p in paths:
+            print(p)
+        return 0 if paths else 1
+    if not paths:
+        print(f"no flight dumps under {args.state_dir} (an alert page, "
+              "a tripped guard, a shard respawn, or `tpuctl flight "
+              "dump` writes one)", file=sys.stderr)
+        return 1
+    recs = stitch(paths)
+    if args.output == "json":
+        print(json.dumps(recs))
+        return 0
+    for r in recs:
+        shard = r.get("shard") or "-"
+        kind = r.get("kind", "?")
+        if kind == "flight":
+            what = (f"=== dump {r.get('source', '')} "
+                    f"reason={r.get('reason', '?')} "
+                    f"({r.get('entries', 0)} entries)")
+        elif kind == "event":
+            d = r.get("data", {})
+            what = (f"{d.get('type', '?')} {d.get('kind', '')} "
+                    f"{d.get('namespace') or '-'}/{d.get('name', '')}"
+                    + (f" phase={d['phase']}" if d.get("phase") else "")
+                    + f" rv={d.get('rv', '')}")
+        elif kind == "alert":
+            d = r.get("data", {})
+            what = (f"ALERT {d.get('objective', '?')} "
+                    f"{d.get('from', '?')}->{d.get('to', '?')}")
+        elif kind == "metrics":
+            d = r.get("data", {}).get("deltas", {})
+            what = "metrics " + " ".join(
+                f"{k}+{v:g}" for k, v in sorted(d.items())[:4])
+            if len(d) > 4:
+                what += f" (+{len(d) - 4} more)"
+        elif kind == "span":
+            d = r.get("data", {})
+            what = (f"span {d.get('name', '?')} "
+                    f"{max(d.get('duration_s', 0), 0) * 1e3:.2f}ms")
+        else:
+            what = f"{kind} {json.dumps(r.get('data', {}))[:80]}"
+        tid = r.get("trace_id", "")
+        print(f"t={r.get('t', 0):.3f} sh={shard:<5} seq={r.get('seq', 0):>5} "
+              f"{what}" + (f" [{tid[-10:]}]" if tid else ""))
+    return 0
+
+
 def cmd_delete(args) -> int:
     targets = []
     if args.filename:
@@ -598,10 +715,18 @@ def cmd_trace(args) -> int:
     from kubeflow_tpu.controlplane.platform import TRACE_FILE
     from kubeflow_tpu.utils.tracing import Tracer, assemble_trace
 
-    if "/" not in args.target:
-        print("trace target must be <kind>/<name>", file=sys.stderr)
+    by_trace_id = bool(getattr(args, "id", False))
+    if by_trace_id:
+        # Exemplar resolution (ISSUE 15): an SLO alert carries the trace
+        # id a histogram captured at observe time; `--id` renders THAT
+        # trace without needing to know which object it belongs to.
+        kind, name = "", args.target
+    elif "/" not in args.target:
+        print("trace target must be <kind>/<name> (or pass --id with a "
+              "raw trace id, e.g. an SLO exemplar)", file=sys.stderr)
         return 2
-    kind, name = args.target.split("/", 1)
+    else:
+        kind, name = args.target.split("/", 1)
     # Shard-aware: a sharded state dir keeps one trace file per shard
     # (shard-NN/trace.jsonl). The object's own lifecycle lives on one
     # shard (the router's colocation contract); cross-shard spans (the
@@ -621,6 +746,27 @@ def cmd_trace(args) -> int:
     spans = []
     for p in paths:
         spans.extend(Tracer.load_jsonl(p))
+    if by_trace_id:
+        trace = sorted((s for s in spans if s.trace_id == name),
+                       key=lambda s: (s.start_unix, s.span_id))
+        if not trace:
+            print(f"no spans recorded for trace id {name}",
+                  file=sys.stderr)
+            return 1
+        if args.output == "json":
+            print(json.dumps([s.to_dict() for s in trace]))
+            return 0
+        t0 = min(s.start_unix for s in trace)
+        print(f"TRACE id={name} — {len(trace)} spans")
+        for s in trace:
+            a = s.attrs
+            detail = " ".join(f"{k}={v}" for k, v in sorted(a.items())
+                              if k in ("verb", "kind", "namespace",
+                                       "name", "controller", "outcome"))
+            print(f"  t+{(s.start_unix - t0) * 1e3:9.3f}ms "
+                  f"{max(s.duration_s, 0.0) * 1e3:9.3f}ms  {s.name} "
+                  f"{detail} [{s.span_id[-6:]}]")
+        return 0
     if not args.namespace:
         # Without -n the reference filter matches every namespace; two
         # same-named objects would silently merge into one timeline whose
@@ -981,12 +1127,38 @@ def build_parser() -> argparse.ArgumentParser:
 
     tp = sub.add_parser(
         "trace", help="causal write->watch->reconcile timeline for one "
-                      "object from the recorded spans")
-    tp.add_argument("target", help="<kind>/<name>, e.g. TpuJob/train1")
+                      "object (or one raw trace id) from the recorded "
+                      "spans")
+    tp.add_argument("target", help="<kind>/<name>, e.g. TpuJob/train1 — "
+                                   "or a raw trace id with --id (the "
+                                   "SLO exemplar resolution path)")
+    tp.add_argument("--id", action="store_true",
+                    help="treat target as a raw trace id (resolve an "
+                         "SLO alert's exemplar)")
     tp.add_argument("-n", "--namespace", default=None)
     tp.add_argument("-o", "--output", choices=("timeline", "json"),
                     default="timeline")
     tp.set_defaults(fn=cmd_trace)
+
+    sl = sub.add_parser(
+        "slo", help="fleet SLO scoreboard: per-objective burn rates "
+                    "(multi-window), alert state, exemplar trace ids "
+                    "(rc 3 when any objective pages)")
+    sl.add_argument("-o", "--output", choices=("table", "json"),
+                    default="table")
+    sl.set_defaults(fn=cmd_slo)
+
+    fl = sub.add_parser(
+        "flight", help="crash-dump flight recorder: dump the recent-"
+                       "history ring, list dumps, or stitch them "
+                       "(cross-shard) into one timeline")
+    fl.add_argument("action", choices=("dump", "show", "ls"))
+    fl.add_argument("--path", default="",
+                    help="show one specific dump instead of stitching "
+                         "every dump under the state dir")
+    fl.add_argument("-o", "--output", choices=("timeline", "json"),
+                    default="timeline")
+    fl.set_defaults(fn=cmd_flight)
 
     top = sub.add_parser(
         "top", help="per-controller reconcile latency percentiles from "

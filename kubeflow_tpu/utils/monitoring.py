@@ -16,6 +16,11 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+# Exemplar capture (ISSUE 15): the span currently open on this thread
+# donates its trace id to the observed bucket. tracing is stdlib-only
+# and imports nothing back from monitoring — no cycle.
+from kubeflow_tpu.utils.tracing import current_span as _current_span
+
 LabelKV = Tuple[Tuple[str, str], ...]
 
 #: Default latency buckets (seconds). Tuned for an in-process control
@@ -205,6 +210,12 @@ class Heartbeat:
         return [(self.name, (), self.last())]
 
 
+#: Labelsets per histogram whose exemplars are retained (latest-wins per
+#: band beyond this many labelsets would grow with cardinality; the cap
+#: keeps the exemplar store bounded no matter what labels traffic mints).
+EXEMPLAR_LABELSET_CAP = 64
+
+
 class Histogram:
     """A Prometheus histogram: cumulative ``_bucket{le=...}`` counts plus
     ``_sum``/``_count``, rendered in the text exposition format.
@@ -216,6 +227,15 @@ class Histogram:
     same estimate a PromQL ``histogram_quantile`` would produce, which is
     what lets ``tpuctl top`` (scraping text) and the in-process benches
     (reading this object) report the same numbers.
+
+    **Exemplars (ISSUE 15).** ``observe()`` captures the current trace id
+    (the span open on this thread, or an explicit ``exemplar=``) per
+    bucket band, latest-wins — so every percentile, and every SLO alert
+    computed from these buckets, can name ONE concrete trace that landed
+    in the band. Bounded: one exemplar per band per labelset, at most
+    :data:`EXEMPLAR_LABELSET_CAP` labelsets; the text exposition is
+    untouched (exemplars are an in-process read surface, `tpuctl slo`
+    and the SLO engine read them back).
     """
 
     def __init__(self, name: str, help_: str,
@@ -234,6 +254,9 @@ class Histogram:
         # per-labelset state: [per-bucket counts..., +Inf count], sum
         self._counts: Dict[LabelKV, List[int]] = {}
         self._sums: Dict[LabelKV, float] = {}
+        # per-labelset, per-band: (seq, trace_id, value) — latest-wins.
+        self._exemplars: Dict[LabelKV, Dict[int, Tuple[int, str, float]]] = {}
+        self._exemplar_seq = 0
         self._lock = threading.Lock()
 
     def _key(self, labels: Dict[str, str]) -> LabelKV:
@@ -244,9 +267,18 @@ class Histogram:
             )
         return tuple(sorted(labels.items()))
 
-    def observe(self, value: float, **labels: str) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                **labels: str) -> None:
+        """Record one observation. ``exemplar`` optionally names the
+        trace id to pin to the observation's bucket band; when omitted,
+        the trace id of the span currently open on this thread (if any)
+        is captured — the metric→trace edge the SLO engine resolves."""
         key = self._key(labels)
         v = float(value)
+        if exemplar is None:
+            span = _current_span()
+            if span is not None:
+                exemplar = span.trace_id
         with self._lock:
             counts = self._counts.get(key)
             if counts is None:
@@ -254,18 +286,99 @@ class Histogram:
                 self._sums[key] = 0.0
             # Non-cumulative per-band tally internally; cumulated at render
             # so observe stays O(log b) not O(b).
-            counts[bisect.bisect_left(self.buckets, v)] += 1
+            band = bisect.bisect_left(self.buckets, v)
+            counts[band] += 1
             self._sums[key] += v
+            if exemplar:
+                ex = self._exemplars.get(key)
+                if ex is None:
+                    if len(self._exemplars) >= EXEMPLAR_LABELSET_CAP:
+                        return
+                    ex = self._exemplars[key] = {}
+                self._exemplar_seq += 1
+                ex[band] = (self._exemplar_seq, exemplar, v)
 
     def count(self, **labels: str) -> int:
+        """Observation count. An exact labelset returns that series; a
+        SUBSET of the label names (including none) aggregates across the
+        matching family — so ``count()`` on a labeled histogram is the
+        family-wide total."""
+        if set(labels) != set(self.label_names):
+            bands, _ = self._merged(self._subset(labels))
+            return sum(bands)
         key = self._key(labels)
         with self._lock:
             return sum(self._counts.get(key, ()))
 
     def sum(self, **labels: str) -> float:
+        """Observation sum; subset labels aggregate like :meth:`count`."""
+        if set(labels) != set(self.label_names):
+            _, total = self._merged(self._subset(labels))
+            return total
         key = self._key(labels)
         with self._lock:
             return self._sums.get(key, 0.0)
+
+    def _subset(self, labels: Dict[str, str]) -> Dict[str, str]:
+        if not set(labels) <= set(self.label_names):
+            raise ValueError(
+                f"histogram {self.name} expects a subset of labels "
+                f"{self.label_names}, got {tuple(sorted(labels))}")
+        return labels
+
+    # ------------- exemplars / SLI read surface (ISSUE 15) -------------
+
+    def labelsets(self) -> List[LabelKV]:
+        """Every labelset this family has observed (point-in-time copy) —
+        how the SLO engine enumerates ``group_by`` series."""
+        with self._lock:
+            return list(self._counts.keys())
+
+    def cumulative(self, **labels: str) -> List[Tuple[float, float]]:
+        """Ascending ``(upper_bound, cumulative_count)`` pairs ending with
+        the ``+Inf`` bucket, aggregated over every labelset matching the
+        given label SUBSET — the SLI input the SLO engine differentiates
+        between evaluations (and the same shape ``quantile_from_buckets``
+        consumes)."""
+        bands, _ = self._merged(self._subset(labels))
+        pairs: List[Tuple[float, float]] = []
+        cum = 0
+        for le, c in zip(self.buckets, bands):
+            cum += c
+            pairs.append((le, float(cum)))
+        cum += bands[-1]
+        pairs.append((float("inf"), float(cum)))
+        return pairs
+
+    def exemplars(self, **labels: str) -> List[Dict[str, object]]:
+        """The retained exemplars for every labelset matching the label
+        subset, newest first: ``{"le", "trace_id", "value", "labels"}``
+        per bucket band (latest-wins within a band)."""
+        want = set(self._subset(labels).items())
+        out = []
+        with self._lock:
+            for key, ex in self._exemplars.items():
+                if not want <= set(key):
+                    continue
+                for band, (seq, trace_id, v) in ex.items():
+                    le = (self.buckets[band] if band < len(self.buckets)
+                          else float("inf"))
+                    out.append({"seq": seq, "le": le, "trace_id": trace_id,
+                                "value": v, "labels": dict(key)})
+        out.sort(key=lambda e: -e["seq"])
+        for e in out:
+            del e["seq"]
+        return out
+
+    def exemplar_over(self, threshold: float,
+                      **labels: str) -> Optional[Dict[str, object]]:
+        """The NEWEST exemplar whose observed value exceeds ``threshold``
+        — the trace a burning latency objective hands to ``tpuctl trace``
+        (None when no over-threshold observation retained one)."""
+        for e in self.exemplars(**labels):
+            if e["value"] > threshold:
+                return e
+        return None
 
     def _merged(self, labels: Dict[str, str]) -> Tuple[List[int], float]:
         """Aggregate (band counts, sum) across every labelset matching the
@@ -285,15 +398,7 @@ class Histogram:
     def quantile(self, q: float, **labels: str) -> Optional[float]:
         """Estimated q-quantile (0 < q < 1) aggregated over every labelset
         matching the given label subset; None with no observations."""
-        bands, _ = self._merged(labels)
-        pairs = []
-        cum = 0
-        for le, c in zip(self.buckets, bands):
-            cum += c
-            pairs.append((le, cum))
-        cum += bands[-1]
-        pairs.append((float("inf"), cum))
-        return quantile_from_buckets(pairs, q)
+        return quantile_from_buckets(self.cumulative(**labels), q)
 
     def percentiles(self, qs: Sequence[float] = (0.5, 0.95, 0.99),
                     **labels: str) -> Dict[str, float]:

@@ -1,0 +1,606 @@
+"""Fleet SLO engine + flight recorder (ISSUE 15): burn-rate windows,
+alert-state-machine hysteresis edges, exemplar capture bounds and
+resolution, alert-journal replay byte-identity (torn tail and rotation
+included), flight-ring overflow/ordering, and cross-shard stitching."""
+
+import json
+import os
+
+from kubeflow_tpu.obs.flight import FlightRecorder, flight_paths, stitch
+from kubeflow_tpu.obs.slo import (
+    ALERTS_JOURNAL,
+    Objective,
+    SLOEngine,
+    Windows,
+    interruption_delta_source,
+    soak_objectives,
+)
+from kubeflow_tpu.utils.monitoring import (
+    EXEMPLAR_LABELSET_CAP,
+    MetricsRegistry,
+)
+from kubeflow_tpu.utils.tracing import Tracer
+
+#: Tiny deterministic windows: fast pair (2, 4), slow pair (6, 12).
+W = Windows(fast_short=2, fast_long=4, slow_short=6, slow_long=12)
+
+
+def _engine(reg, *, threshold=0.25, slo=0.9, page_burn=2.0,
+            warn_burn=1.0, clear_after=2, **kw):
+    return SLOEngine(reg, objectives=[Objective(
+        name="lat", metric="lat", threshold_s=threshold, slo=slo,
+        page_burn=page_burn, warn_burn=warn_burn, windows=W,
+        clear_after=clear_after)], **kw)
+
+
+class TestObjectiveValidation:
+    def test_exactly_one_source(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            Objective(name="x")
+        with pytest.raises(ValueError):
+            Objective(name="x", metric="m", gauge="g")
+        with pytest.raises(ValueError):
+            Objective(name="x", metric="m", slo=1.0)
+        with pytest.raises(ValueError):
+            Objective(name="x", value_fn=lambda: 0.0, group_by="t")
+
+    def test_duplicate_names_rejected(self):
+        import pytest
+
+        reg = MetricsRegistry()
+        objs = [Objective(name="a", metric="m"),
+                Objective(name="a", metric="m2")]
+        with pytest.raises(ValueError):
+            SLOEngine(reg, objectives=objs)
+
+
+class TestStateMachine:
+    """Hysteresis edges: flap across the threshold, window restart."""
+
+    def _feed(self, h, eng, t, value):
+        h.observe(value)
+        return eng.evaluate(t)
+
+    def test_escalates_immediately_and_pages_once(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", "t", buckets=(0.25, 1.0))
+        eng = _engine(reg)
+        eng.evaluate(0)                       # baseline
+        for t in range(1, 5):
+            self._feed(h, eng, t, 2.0)        # all bad
+        assert eng.states()["lat"] == "page"
+        assert eng.pages_by_objective() == {"lat": 1}
+        # Still burning: no second page, no transition churn.
+        for t in range(5, 8):
+            self._feed(h, eng, t, 2.0)
+        assert eng.pages_by_objective() == {"lat": 1}
+
+    def test_flap_across_threshold_holds_state(self):
+        """Alternating good/bad samples around a burn that keeps the
+        page condition true must NOT flap: one page transition."""
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", "t", buckets=(0.25, 1.0))
+        eng = _engine(reg)                    # budget 0.1, page at 2.0
+        eng.evaluate(0)
+        # 50% bad = burn 5.0 >= 2.0: alternating samples keep paging.
+        for t in range(1, 12):
+            self._feed(h, eng, t, 2.0 if t % 2 else 0.01)
+        assert eng.states()["lat"] == "page"
+        assert eng.pages_by_objective() == {"lat": 1}
+        snap = eng.snapshot()["series"]["lat"]
+        assert snap["transitions"] == 1
+
+    def test_deescalation_needs_consecutive_quiet_evals(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", "t", buckets=(0.25, 1.0))
+        eng = _engine(reg, clear_after=3)
+        eng.evaluate(0)
+        for t in range(1, 4):
+            self._feed(h, eng, t, 2.0)
+        assert eng.states()["lat"] == "page"
+        # One quiet eval, then bad again: calm resets, still paged.
+        self._feed(h, eng, 4, 0.01)
+        self._feed(h, eng, 5, 0.01)
+        self._feed(h, eng, 6, 2.0)            # burn back over page
+        assert eng.states()["lat"] == "page"
+        # Now a long quiet run: windows drain, clear_after=3 quiet
+        # evals step the state down (page -> warn -> ok as the slow
+        # windows dilute).
+        for t in range(7, 40):
+            self._feed(h, eng, t, 0.01)
+        assert eng.states()["lat"] == "ok"
+        assert eng.pages_by_objective() == {"lat": 1}
+
+    def test_window_restart_no_data_deescalates(self):
+        """A source that stops producing events entirely: burns go
+        None, the state machine still walks back to ok."""
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", "t", buckets=(0.25, 1.0))
+        eng = _engine(reg)
+        eng.evaluate(0)
+        for t in range(1, 4):
+            self._feed(h, eng, t, 2.0)
+        assert eng.states()["lat"] == "page"
+        for t in range(4, 20):                # no observations at all
+            eng.evaluate(t)
+        assert eng.states()["lat"] == "ok"
+        burns = eng.snapshot()["series"]["lat"]["burn"]
+        assert all(b is None for b in burns.values())
+
+    def test_fast_pair_must_both_burn(self):
+        """One bad sample inside fast_short but diluted over fast_long
+        must not page (the multi-window guard against blips)."""
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", "t", buckets=(0.25, 1.0))
+        eng = _engine(reg, page_burn=4.0, warn_burn=10.0)
+        eng.evaluate(0)
+        for t in range(1, 4):
+            self._feed(h, eng, t, 0.01)       # good history
+        self._feed(h, eng, 4, 2.0)            # one blip
+        # fast_short (2): 1 bad / 1 -> burn 10; fast_long (4): 1/4 ->
+        # 2.5 < 4.0 -> NO page.
+        assert eng.states()["lat"] == "ok"
+
+    def test_value_objective_bounds(self):
+        reg = MetricsRegistry()
+        vals = {"v": 0.0}
+        eng = SLOEngine(reg, objectives=[Objective(
+            name="ratio", value_fn=lambda: vals["v"], min_value=0.5,
+            slo=0.5, page_burn=1.5, warn_burn=1.0, windows=W,
+            clear_after=2)])
+        for t in range(1, 4):
+            vals["v"] = 0.1                   # bad ticks
+            eng.evaluate(t)
+        assert eng.states()["ratio"] == "page"
+
+    def test_gauge_group_by_series(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("ratio", "t", labels=("tenant",))
+        eng = SLOEngine(reg, objectives=[Objective(
+            name="tenant-goodput", gauge="ratio", group_by="tenant",
+            min_value=0.5, slo=0.5, page_burn=1.5, warn_burn=1.0,
+            windows=W, clear_after=2)])
+        g.set(0.9, tenant="acme")
+        g.set(0.1, tenant="startup")
+        for t in range(1, 4):
+            eng.evaluate(t)
+        states = eng.states()
+        assert states["tenant-goodput[tenant=acme]"] == "ok"
+        assert states["tenant-goodput[tenant=startup]"] == "page"
+        assert eng.pages_by_objective() == {"tenant-goodput": 1}
+
+    def test_interruption_delta_source_baselines_at_creation(self):
+        class Acc:
+            interruptions = {"preempt": 3}
+
+        acc = Acc()
+        fn = interruption_delta_source(acc)
+        assert fn() == 0.0                    # pre-existing history clean
+        acc.interruptions = {"preempt": 4}
+        assert fn() == 1.0
+        assert fn() == 0.0
+
+
+class TestExemplars:
+    def test_latest_wins_per_band_and_over_threshold(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", "t", buckets=(0.25, 1.0))
+        h.observe(2.0, exemplar="old")
+        h.observe(3.0, exemplar="new")
+        h.observe(0.1, exemplar="good")
+        ex = h.exemplar_over(0.25)
+        assert ex["trace_id"] == "new" and ex["value"] == 3.0
+        # Under-threshold exemplar exists but is not "over".
+        assert {e["trace_id"] for e in h.exemplars()} == {"new", "good"}
+
+    def test_current_span_auto_capture(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", "t", buckets=(0.25, 1.0))
+        tr = Tracer()
+        with tr.span("write") as s:
+            h.observe(2.0)
+        assert h.exemplar_over(0.25)["trace_id"] == s.trace_id
+        # No span, no explicit exemplar: nothing captured.
+        h2 = reg.histogram("lat2", "t", buckets=(0.25,))
+        h2.observe(2.0)
+        assert h2.exemplars() == []
+
+    def test_labelset_cap_bounds_the_store(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", "t", labels=("k",), buckets=(0.25,))
+        for i in range(EXEMPLAR_LABELSET_CAP + 50):
+            h.observe(2.0, exemplar=f"e{i}", k=str(i))
+        # Counts are unbounded; the exemplar store is capped.
+        assert h.count() == EXEMPLAR_LABELSET_CAP + 50
+        assert len(h.exemplars()) <= EXEMPLAR_LABELSET_CAP
+
+    def test_count_and_sum_aggregate_label_subsets(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", "t", labels=("k",), buckets=(0.25,))
+        h.observe(0.1, k="a")
+        h.observe(0.2, k="b")
+        assert h.count() == 2
+        assert abs(h.sum() - 0.3) < 1e-9
+        assert h.count(k="a") == 1
+        pairs = h.cumulative()
+        assert pairs[-1] == (float("inf"), 2.0)
+
+    def test_grouped_alert_exemplar_scoped_to_its_group(self):
+        """A grouped objective's alert must carry a trace from ITS
+        label group — never a sibling group's blip."""
+        reg = MetricsRegistry()
+        h = reg.histogram("age", "t", labels=("priority",),
+                          buckets=(0.25, 1.0))
+        eng = SLOEngine(reg, objectives=[Objective(
+            name="queue-age", metric="age", threshold_s=0.25,
+            group_by="priority", slo=0.9, page_burn=2.0, warn_burn=1.0,
+            windows=W, clear_after=2)])
+        eng.evaluate(0)
+        # priority=0 burns (and will page); priority=10 has ONE newer
+        # over-threshold blip whose exemplar must NOT be borrowed.
+        for t in range(1, 5):
+            h.observe(2.0, exemplar=f"p0-{t}", priority="0")
+            if t == 4:
+                h.observe(3.0, exemplar="p10-blip", priority="10")
+            eng.evaluate(t)
+        series = eng.snapshot()["series"]
+        paged = series["queue-age[priority=0]"]
+        assert paged["state"] == "page"
+        assert paged["exemplar"].startswith("p0-")
+
+    def test_alert_carries_resolvable_exemplar(self, tmp_path):
+        """The paged objective's exemplar is a trace id whose spans the
+        tpuctl trace --id path resolves from the recorded jsonl."""
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", "t", buckets=(0.25, 1.0))
+        tr = Tracer()
+        eng = _engine(reg)
+        eng.evaluate(0)
+        with tr.span("apiserver.update",
+                     attrs={"kind": "TpuJob", "name": "train1",
+                            "namespace": "ml"}) as s:
+            h.observe(2.0)
+        for t in range(1, 4):
+            h.observe(2.0, exemplar=s.trace_id)
+            eng.evaluate(t)
+        snap = eng.snapshot()["series"]["lat"]
+        assert snap["state"] == "page"
+        assert snap["exemplar"] == s.trace_id
+        # Resolve through the CLI: trace --id renders that trace.
+        trace_file = tmp_path / "trace.jsonl"
+        tr.export_jsonl(str(trace_file))
+        from kubeflow_tpu.tools.tpuctl import main as tpuctl_main
+
+        rc = tpuctl_main(["--state-dir", str(tmp_path), "trace",
+                          "--id", snap["exemplar"]])
+        assert rc == 0
+
+
+class TestJournal:
+    def _page(self, tmp_path, fname=ALERTS_JOURNAL, rotate=4 << 20):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", "t", buckets=(0.25, 1.0))
+        eng = _engine(reg, journal_path=str(tmp_path / fname),
+                      rotate_bytes=rotate)
+        eng.evaluate(0)
+        for t in range(1, 5):
+            h.observe(2.0)
+            eng.evaluate(t)
+        for t in range(5, 30):                # walk back down to ok
+            h.observe(0.01)
+            eng.evaluate(t)
+        return eng
+
+    def test_replay_byte_identity(self, tmp_path):
+        eng = self._page(tmp_path)
+        assert eng.transitions_total() >= 2   # up and back down
+        fresh = SLOEngine(MetricsRegistry(),
+                          objectives=soak_objectives(None))
+        n = fresh.replay_from(str(tmp_path / ALERTS_JOURNAL))
+        assert n == eng.transitions_total()
+        assert fresh.fingerprint() == eng.fingerprint()
+        assert fresh.states()["lat"] == "ok"
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        eng = self._page(tmp_path)
+        path = tmp_path / ALERTS_JOURNAL
+        raw = path.read_bytes()
+        # Crash mid-append: truncate inside the last record.
+        path.write_bytes(raw[:-7])
+        lines = [ln for ln in raw.decode().splitlines() if ln]
+        fresh = SLOEngine(MetricsRegistry(),
+                          objectives=soak_objectives(None))
+        n = fresh.replay_from(str(path))
+        assert n == len(lines) - 1            # the torn record dropped
+        # The complete prefix applied; last full transition's state.
+        prefix = [json.loads(ln) for ln in lines[:-1]]
+        assert fresh.states()["lat"] == prefix[-1]["to"]
+
+    def test_rotation_keeps_replay_identical(self, tmp_path):
+        # Tiny rotate threshold: every transition rolls the journal.
+        eng = self._page(tmp_path, rotate=64)
+        assert os.path.exists(str(tmp_path / (ALERTS_JOURNAL + ".1")))
+        fresh = SLOEngine(MetricsRegistry(),
+                          objectives=soak_objectives(None))
+        fresh.replay_from(str(tmp_path / ALERTS_JOURNAL))
+        assert fresh.fingerprint() == eng.fingerprint()
+
+    def test_rotated_current_generation_is_self_contained(self, tmp_path):
+        """After rotation the CURRENT file opens with a state record —
+        deleting the .1 generation must not change the replayed state
+        (the discipline that makes repeated rollover safe)."""
+        eng = self._page(tmp_path, rotate=64)
+        os.remove(str(tmp_path / (ALERTS_JOURNAL + ".1")))
+        fresh = SLOEngine(MetricsRegistry(),
+                          objectives=soak_objectives(None))
+        fresh.replay_from(str(tmp_path / ALERTS_JOURNAL))
+        assert fresh.fingerprint() == eng.fingerprint()
+
+    def test_own_journal_replay_compacts(self, tmp_path):
+        eng = self._page(tmp_path, rotate=64)
+        fp = eng.fingerprint()
+        eng.close()
+        path = str(tmp_path / ALERTS_JOURNAL)
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", "t", buckets=(0.25, 1.0))  # noqa: F841
+        eng2 = _engine(reg, journal_path=path)
+        eng2.replay_from(path)
+        assert eng2.fingerprint() == fp
+        # Compacted: one state record, no stale .1 generation left.
+        recs = [json.loads(ln) for ln in open(path) if ln.strip()]
+        assert len(recs) == 1 and recs[0]["op"] == "state"
+        assert not os.path.exists(path + ".1")
+
+
+class TestGoodputJournalRotation:
+    def test_goodput_rotation_replays_both_generations(self, tmp_path):
+        from kubeflow_tpu.obs.goodput import GoodputAccountant
+
+        path = str(tmp_path / "goodput.jsonl")
+        acc = GoodputAccountant.from_capacity(
+            {"v5e-16": 2}, journal_path=path, fsync=False,
+            rotate_bytes=256)
+        for t in range(1, 60):
+            acc.tick(t)
+        fp = acc.fingerprint()
+        assert os.path.exists(path + ".1")    # rotation happened
+        twin = GoodputAccountant.from_capacity({"v5e-16": 2})
+        twin.replay_from(path)
+        assert twin.fingerprint() == fp
+        assert twin.conservation()["exact"]
+
+    def test_goodput_rotated_head_is_state_record(self, tmp_path):
+        from kubeflow_tpu.obs.goodput import GoodputAccountant
+
+        path = str(tmp_path / "goodput.jsonl")
+        acc = GoodputAccountant.from_capacity(
+            {"v5e-16": 2}, journal_path=path, fsync=False,
+            rotate_bytes=256)
+        for t in range(1, 60):
+            acc.tick(t)
+        first = json.loads(open(path).readline())
+        assert first["op"] == "state"
+        # Current generation alone already replays to the full state.
+        os.remove(path + ".1")
+        twin = GoodputAccountant.from_capacity({"v5e-16": 2})
+        twin.replay_from(path)
+        assert twin.fingerprint() == acc.fingerprint()
+
+
+class TestFlightRecorder:
+    def test_ring_overflow_keeps_newest_in_order(self):
+        rec = FlightRecorder(capacity=8)
+        for i in range(20):
+            rec.record("event", {"i": i})
+        entries = list(rec._ring)
+        assert len(entries) == 8
+        assert [e["data"]["i"] for e in entries] == list(range(12, 20))
+        # seq stays globally monotone (causal order survives eviction).
+        assert [e["seq"] for e in entries] == list(range(13, 21))
+
+    def test_dump_and_load_roundtrip(self, tmp_path):
+        rec = FlightRecorder(capacity=8, shard="sh00")
+        rec.record("event", {"i": 1}, t=10.0)
+        rec.record("alert", {"objective": "lat"}, t=11.0,
+                   trace_id="tid")
+        path = rec.dump(str(tmp_path), reason="test")
+        recs = FlightRecorder.load(path)
+        assert recs[0]["kind"] == "flight"
+        assert recs[0]["reason"] == "test"
+        kinds = [r["kind"] for r in recs[1:]]
+        assert kinds == ["event", "alert"]
+        assert recs[2]["trace_id"] == "tid"
+
+    def test_guards_latch_one_dump(self, tmp_path):
+        rec = FlightRecorder(capacity=8)
+        state = {"ok": True}
+        guards = {"conservation": lambda: state["ok"]}
+        assert rec.check_guards(guards, str(tmp_path)) == []
+        state["ok"] = False
+        assert rec.check_guards(guards, str(tmp_path)) == \
+            ["conservation"]
+        # Latched: still broken, but no second dump.
+        assert rec.check_guards(guards, str(tmp_path)) == []
+        assert len(rec.dumps) == 1
+
+    def test_metric_deltas_record_movement_only(self):
+        reg = MetricsRegistry()
+        c = reg.counter("kftpu_test_total", "t")
+        rec = FlightRecorder(registry=reg)
+        assert rec.record_metric_deltas() == 0   # baseline
+        c.inc(3)
+        assert rec.record_metric_deltas() == 1
+        assert rec.record_metric_deltas() == 0   # no movement
+        entry = [e for e in rec._ring if e["kind"] == "metrics"][-1]
+        assert entry["data"]["deltas"]["kftpu_test_total"] == 3
+
+    def test_cross_shard_stitch_ordering_and_dedup(self, tmp_path):
+        a = FlightRecorder(capacity=8, shard="sh00")
+        b = FlightRecorder(capacity=8, shard="sh01")
+        a.record("event", {"i": "a1"}, t=1.0)
+        b.record("event", {"i": "b1"}, t=2.0)
+        a.record("event", {"i": "a2"}, t=3.0)
+        # Same-shard causal order beats a skewed wall clock: a3 records
+        # with an EARLIER t than a2 but a later seq.
+        a.record("event", {"i": "a3"}, t=3.0)
+        da1 = a.dump(str(tmp_path / "shard-00"))
+        db = b.dump(str(tmp_path / "shard-01"))
+        # Overlapping second dump of shard a: entries must dedup.
+        da2 = a.dump(str(tmp_path / "shard-00"))
+        merged = stitch([da1, db, da2])
+        seq = [(r.get("shard"), r["data"]["i"]) for r in merged
+               if r["kind"] == "event"]
+        assert seq == [("sh00", "a1"), ("sh01", "b1"), ("sh00", "a2"),
+                       ("sh00", "a3")]
+        paths = flight_paths(str(tmp_path))
+        assert set(paths) == {da1, da2, db}
+
+    def test_engine_pages_dump_the_ring(self, tmp_path):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", "t", buckets=(0.25, 1.0))
+        rec = FlightRecorder(capacity=16)
+        eng = _engine(reg, recorder=rec, dump_dir=str(tmp_path))
+        eng.evaluate(0)
+        for t in range(1, 5):
+            h.observe(2.0)
+            eng.evaluate(t)
+        assert eng.states()["lat"] == "page"
+        assert len(rec.dumps) == 1
+        recs = FlightRecorder.load(rec.dumps[0])
+        assert recs[0]["reason"] == "alert-page:lat"
+        assert any(r["kind"] == "alert" for r in recs)
+
+
+class TestSoakIntegration:
+    """The slo-smoke substrate at tier-1 scale: the seeded soak carries
+    an slo section; clean soak quiet, fault soak pages (the full CI
+    gates run in slo-smoke)."""
+
+    def test_clean_soak_fires_nothing(self):
+        from kubeflow_tpu.chaos import run_soak
+
+        rep = run_soak(num_jobs=2, seed=7, preempt_every=0,
+                       fault_rounds=5, max_rounds=30)
+        assert rep.converged
+        assert rep.slo["transitions"] == 0
+        assert rep.flight_dumps == []
+
+    def test_fault_soak_pages_and_dumps(self, tmp_path):
+        from kubeflow_tpu.chaos import run_soak
+
+        rep = run_soak(num_jobs=4, seed=20260803, preempt_every=3,
+                       fault_rounds=9, max_rounds=40,
+                       state_dir=str(tmp_path))
+        assert rep.converged
+        pages = rep.slo["pages"]
+        assert pages.get("goodput-interruptions", 0) == 1
+        assert rep.flight_dumps
+        assert os.path.exists(str(tmp_path / ALERTS_JOURNAL))
+        # Journal replays byte-identically into a fresh engine.
+        fresh = SLOEngine(MetricsRegistry(),
+                          objectives=soak_objectives(None))
+        fresh.replay_from(str(tmp_path / ALERTS_JOURNAL))
+        assert fresh.fingerprint() == rep.slo["fingerprint"]
+
+
+class TestStormIntegration:
+    def test_storm_reports_starvation_slo(self):
+        from kubeflow_tpu.scheduler.benchmark import run_schedule_storm
+
+        rep = run_schedule_storm(num_jobs=12, policy="priority", seed=1,
+                                 fleet_capacity={"v5e-16": 4},
+                                 pool_size=4, max_ticks=120,
+                                 starvation_bound_ticks=5)
+        assert "series" in rep.slo
+        keys = set(rep.slo["series"])
+        # One series per priority class that ever queued.
+        assert any(k.startswith("queue-age[priority=") for k in keys)
+
+
+class TestPlatformIntegration:
+    def test_platform_wires_engine_and_journal(self, tmp_path):
+        import yaml
+
+        from kubeflow_tpu.tools.tpuctl import main as tpuctl_main
+
+        state = tmp_path / "st"
+        cfg = {
+            "kind": "PlatformConfig",
+            "metadata": {"name": "kubeflow-tpu"},
+            "spec": {"components": [
+                {"name": "tpujob-controller", "enabled": True,
+                 "params": {"capacity": "v5e-16=2"}},
+                {"name": "fake-kubelet", "enabled": True},
+            ]},
+        }
+        f = tmp_path / "platform.yaml"
+        f.write_text(yaml.safe_dump(cfg))
+        assert tpuctl_main(["--state-dir", str(state), "apply",
+                            "-f", str(f)]) == 0
+        # The scoreboard renders (quiet fleet: rc 0, nothing paging).
+        assert tpuctl_main(["--state-dir", str(state), "slo"]) == 0
+        assert tpuctl_main(["--state-dir", str(state), "slo",
+                            "-o", "json"]) == 0
+        # flight dump + show round-trip.
+        assert tpuctl_main(["--state-dir", str(state), "flight",
+                            "dump"]) == 0
+        assert flight_paths(str(state))
+        assert tpuctl_main(["--state-dir", str(state), "flight",
+                            "show"]) == 0
+        assert tpuctl_main(["--state-dir", str(state), "flight",
+                            "ls"]) == 0
+
+    def test_restored_interruption_history_reads_clean(self, tmp_path):
+        """Platform.load restores the goodput ledger AFTER the SLO
+        engine's delta source baselined — rebaseline_sources() must
+        keep persisted interruption history from reading as one fresh
+        burst on every tpuctl invocation."""
+        import yaml
+
+        from kubeflow_tpu.controlplane.platform import Platform
+
+        state = str(tmp_path / "st")
+        cfg = {
+            "kind": "PlatformConfig",
+            "metadata": {"name": "kubeflow-tpu"},
+            "spec": {"components": [
+                {"name": "tpujob-controller", "enabled": True,
+                 "params": {"capacity": "v5e-16=2"}},
+            ]},
+        }
+        p = Platform.load(state)
+        from kubeflow_tpu.controlplane.api import object_from_dict
+
+        p.apply_config(object_from_dict(cfg))
+        # Fake persisted interruption history on the live accountant
+        # and save WITHOUT evaluating (the history predates this
+        # engine): the fresh process's first evaluations must read
+        # delta 0, not 3.
+        p.goodput.interruptions["preempt"] = 3
+        p.save(state)
+        p2 = Platform.load(state)
+        assert p2.goodput.interruptions["preempt"] == 3
+        for _ in range(4):
+            p2.reconcile()
+        series = p2.slo.snapshot()["series"].get(
+            "goodput-interruptions", {})
+        assert series.get("state", "ok") == "ok"
+        assert p2.slo.transitions_total() == 0
+        _ = yaml  # silence unused-import lint in minimal envs
+
+    def test_platform_reconcile_evaluates(self):
+        from kubeflow_tpu.controlplane.api.meta import ObjectMeta
+        from kubeflow_tpu.controlplane.api.types import PlatformConfig
+        from kubeflow_tpu.controlplane.platform import Platform
+
+        p = Platform()
+        p.apply_config(PlatformConfig(
+            metadata=ObjectMeta(name="kubeflow-tpu")))
+        p.reconcile()
+        assert p.slo is not None and p.flight is not None
+        snap = p.slo.snapshot()
+        assert "admission-latency" in snap["objectives"]
+        assert "queue-age" in snap["objectives"]
+        assert not snap["paging"]

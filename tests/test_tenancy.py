@@ -335,14 +335,78 @@ class TestLBTenantMarket:
     def test_overage_math_weighted(self):
         from kubeflow_tpu.serving.lb import ServingLoadBalancer
 
-        lb = ServingLoadBalancer(tenants={"big": 3.0, "small": 1.0})
-        for _ in range(4):
+        # Both window modes share one overage formula; a frozen clock
+        # makes the decayed masses equal the raw counts exactly.
+        clock = {"t": 0.0}
+        for mode in ("decay", "count"):
+            lb = ServingLoadBalancer(tenants={"big": 3.0, "small": 1.0},
+                                     share_window=mode,
+                                     share_clock=lambda: clock["t"])
+            for _ in range(4):
+                lb.note_tenant_arrival("big")
+            for _ in range(4):
+                lb.note_tenant_arrival("small")
+            # fair(big) = 8 * 3/4 = 6 -> under; fair(small) = 2 ->
+            # over by 2.
+            assert lb._tenant_overage_locked("big") == \
+                pytest.approx(-2.0), mode
+            assert lb._tenant_overage_locked("small") == \
+                pytest.approx(2.0), mode
+
+    def test_decayed_window_forgets_by_time_not_volume(self):
+        """ISSUE 15 (the PR-13 follow-up): on a low-QPS fleet an old
+        burst must stop deciding sheds once TIME passes — even though
+        a 4096-request count window would still be full of it."""
+        from kubeflow_tpu.serving.lb import ServingLoadBalancer
+
+        clock = {"t": 0.0}
+        lb = ServingLoadBalancer(tenants={"big": 1.0, "small": 1.0},
+                                 share_half_life_s=10.0,
+                                 share_clock=lambda: clock["t"])
+        assert lb.share_window == "decay"
+        for _ in range(100):                  # the morning burst
             lb.note_tenant_arrival("big")
-        for _ in range(4):
-            lb.note_tenant_arrival("small")
-        # fair(big) = 8 * 3/4 = 6 -> under; fair(small) = 2 -> over by 2.
-        assert lb._tenant_overage_locked("big") == pytest.approx(-2.0)
-        assert lb._tenant_overage_locked("small") == pytest.approx(2.0)
+        lb.note_tenant_arrival("small")
+        assert lb._tenant_overage_locked("big") > 0
+        # Ten half-lives later, one fresh arrival each: the burst mass
+        # decayed to ~0.1 — "big" is no longer the over-share tenant.
+        clock["t"] = 100.0
+        lb.note_tenant_arrival("small")
+        lb.note_tenant_arrival("small")
+        assert lb._tenant_overage_locked("big") < 0
+        assert lb._tenant_overage_locked("small") > 0
+        shares = lb.tenant_shares_snapshot()
+        assert shares["small"] > shares["big"]
+        # The count window, by contrast, still blames the burst.
+        lbc = ServingLoadBalancer(tenants={"big": 1.0, "small": 1.0},
+                                  share_window="count")
+        for _ in range(100):
+            lbc.note_tenant_arrival("big")
+        for _ in range(3):
+            lbc.note_tenant_arrival("small")
+        assert lbc._tenant_overage_locked("big") > 0
+
+    def test_decay_quiet_tenant_drops_off_the_table(self):
+        from kubeflow_tpu.serving.lb import ServingLoadBalancer
+
+        clock = {"t": 0.0}
+        lb = ServingLoadBalancer(tenants={"a": 1.0, "b": 1.0},
+                                 share_half_life_s=1.0,
+                                 share_clock=lambda: clock["t"])
+        lb.note_tenant_arrival("a")
+        clock["t"] = 60.0                     # 60 half-lives: dust
+        lb.note_tenant_arrival("b")
+        # "a" no longer participates in the fair split at all.
+        assert lb.tenant_shares_snapshot() == {"b": 1.0}
+        assert lb._tenant_overage_locked("a") == 0.0
+
+    def test_share_window_validation(self):
+        from kubeflow_tpu.serving.lb import ServingLoadBalancer
+
+        with pytest.raises(ValueError):
+            ServingLoadBalancer(share_window="sliding")
+        with pytest.raises(ValueError):
+            ServingLoadBalancer(share_half_life_s=0.0)
 
     def test_tenant_burst_soak_exact_accounting(self):
         from kubeflow_tpu.chaos.serving_soak import run_tenant_burst_soak
