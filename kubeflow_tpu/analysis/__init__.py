@@ -1,0 +1,36 @@
+"""kftpu-verify: the project-invariant static analyzer (ISSUE 16).
+
+AST-based lint engine with project-specific rules encoding the
+invariants CHANGES.md kept re-finding by hand — clock domains (KF101),
+journal discipline (KF102), metric hygiene (KF103), ``copy=False``
+read-aliasing (KF104) and vacuous CI gates (KF105). Run it as::
+
+    python -m kubeflow_tpu.analysis kubeflow_tpu/
+    tpuctl lint
+
+Rule catalog, suppression policy and the bug history behind each rule:
+docs/static-analysis.md. Inline suppressions::
+
+    # kftpu: allow(KF101): <reason — mandatory>
+
+The runtime companion (lock-order cycles, leaked threads, the workqueue
+per-key oracle) lives in ``kubeflow_tpu.utils.locktrace`` and is
+asserted by the chaos soaks, not by this static pass.
+"""
+
+from kubeflow_tpu.analysis.engine import (
+    Finding,
+    run_analysis,
+    scan_file,
+    scan_tree,
+)
+from kubeflow_tpu.analysis.rules import RULES, all_rules
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "all_rules",
+    "run_analysis",
+    "scan_file",
+    "scan_tree",
+]

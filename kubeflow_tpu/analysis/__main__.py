@@ -1,0 +1,79 @@
+"""``python -m kubeflow_tpu.analysis [path...]`` — the lint CLI.
+
+Exit codes: 0 clean (suppressions within budget), 1 unsuppressed
+findings (or over the suppression budget), 2 usage error. ``tpuctl
+lint`` and the CI lint-smoke stage are thin wrappers over :func:`main`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from kubeflow_tpu.analysis.engine import (
+    render_human,
+    render_json,
+    run_analysis,
+)
+
+#: The PR-16 acceptance budget: the tree ships with at most this many
+#: justified suppressions. CI fails when the count creeps past it even
+#: if every one carries a reason — a growing allow-list is a rot signal.
+DEFAULT_MAX_SUPPRESSIONS = 10
+
+
+def default_root() -> str:
+    """The installed package itself — `python -m kubeflow_tpu.analysis`
+    with no arguments lints the real tree, wherever it is."""
+    import kubeflow_tpu
+
+    return os.path.dirname(os.path.abspath(kubeflow_tpu.__file__))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m kubeflow_tpu.analysis",
+        description="kftpu-verify: project-invariant static analysis "
+                    "(rule catalog: docs/static-analysis.md)")
+    p.add_argument("paths", nargs="*",
+                   help="package dirs or files to scan "
+                        "(default: the kubeflow_tpu package)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings on stdout")
+    p.add_argument("--max-suppressions", type=int,
+                   default=DEFAULT_MAX_SUPPRESSIONS, metavar="N",
+                   help="fail when more than N findings are suppressed "
+                        "(default %(default)s; -1 disables)")
+    p.add_argument("--docs-inventory", default=None, metavar="PATH",
+                   help="observability.md to cross-check KF103 against "
+                        "(default: docs/ next to the scanned package; "
+                        "'' disables)")
+    args = p.parse_args(argv)
+
+    paths = args.paths or [default_root()]
+    findings = []
+    for path in paths:
+        if not os.path.exists(path):
+            print(f"error: no such path: {path}", file=sys.stderr)
+            return 2
+        findings.extend(run_analysis(
+            path, docs_inventory=args.docs_inventory))
+
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+    print(render_json(findings) if args.json
+          else render_human(findings))
+    if active:
+        return 1
+    if 0 <= args.max_suppressions < len(suppressed):
+        print(f"error: {len(suppressed)} suppressions exceed the "
+              f"budget of {args.max_suppressions} — fix code instead "
+              "of growing the allow-list", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,217 @@
+"""The lint engine: file walking, suppression handling, output.
+
+The engine owns everything rule-independent — parsing, the
+``# kftpu: allow(<RULE>): <reason>`` suppression contract, stable
+sorting, JSON/human rendering and the exit-code policy — so a rule is
+just "AST in, findings out" (``rules.py``).
+
+Suppression contract (enforced HERE, uniformly):
+
+- a finding at line L is suppressed when an allow-comment for its rule
+  sits on line L itself, or on the contiguous run of comment/blank
+  lines immediately above L (multi-line justifications are the norm);
+- the reason after ``):`` is MANDATORY. An allow-comment without one
+  does not suppress anything and is itself reported (rule ``KF100``) —
+  a suppression whose justification nobody wrote is how machine-checked
+  invariants rot back into reviewer memory.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+#: Matches one allow-comment. Group 1: comma-separated rule ids;
+#: group 2: the reason (may be absent — that's the KF100 case).
+_ALLOW_RE = re.compile(
+    r"#\s*kftpu:\s*allow\(\s*([A-Za-z0-9_,\s]+?)\s*\)\s*(?::\s*(\S.*))?$"
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str           # as scanned (relative to the scan root's parent)
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""    # the allow-comment's justification, if suppressed
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        tag = " (suppressed: %s)" % self.reason if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: " \
+               f"{self.rule} {self.message}{tag}"
+
+
+@dataclasses.dataclass
+class Module:
+    """One parsed source file as the rules see it."""
+
+    path: str           # display path (what findings carry)
+    relpath: str        # posix path relative to the scanned package root
+    tree: ast.AST
+    lines: List[str]    # raw source lines, 1-indexed via lines[i-1]
+
+
+class Rule:
+    """Base class. ``check`` runs per module; ``finalize`` runs once
+    after every module was checked (cross-file rules: KF103's
+    register-once and docs cross-checks)."""
+
+    ID = "KF000"
+    TITLE = ""
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        return ()
+
+
+def _allow_on_line(line: str) -> Optional[Tuple[List[str], str]]:
+    m = _ALLOW_RE.search(line)
+    if not m:
+        return None
+    rules = [r.strip() for r in m.group(1).split(",") if r.strip()]
+    return rules, (m.group(2) or "").strip()
+
+
+def find_suppression(lines: List[str], line_no: int,
+                     rule_id: str) -> Optional[Tuple[str, int]]:
+    """The (reason, comment line) of an allow-comment covering
+    ``rule_id`` at ``line_no``: on the line itself, or on the contiguous
+    comment/blank block directly above. Empty reason is returned as ""
+    (the caller turns that into a KF100 finding, not a suppression)."""
+    if 1 <= line_no <= len(lines):
+        hit = _allow_on_line(lines[line_no - 1])
+        if hit and rule_id in hit[0]:
+            return hit[1], line_no
+    i = line_no - 1
+    while i >= 1:
+        stripped = lines[i - 1].strip()
+        if not stripped:
+            i -= 1
+            continue
+        if not stripped.startswith("#"):
+            break
+        hit = _allow_on_line(stripped)
+        if hit and rule_id in hit[0]:
+            return hit[1], i
+        i -= 1
+    return None
+
+
+def _apply_suppressions(module: Module,
+                        findings: List[Finding]) -> List[Finding]:
+    out: List[Finding] = []
+    reasonless_reported = set()
+    for f in findings:
+        sup = find_suppression(module.lines, f.line, f.rule)
+        if sup is None:
+            out.append(f)
+            continue
+        reason, at_line = sup
+        if reason:
+            f.suppressed = True
+            f.reason = reason
+            out.append(f)
+        else:
+            out.append(f)   # an allow without a reason suppresses nothing
+            if at_line not in reasonless_reported:
+                reasonless_reported.add(at_line)
+                out.append(Finding(
+                    rule="KF100", path=f.path, line=at_line, col=0,
+                    message="suppression without a reason — "
+                            "`# kftpu: allow(%s): <why>` is mandatory"
+                            % f.rule,
+                ))
+    return out
+
+
+def scan_file(path: str, rules: List[Rule], *,
+              relpath: Optional[str] = None,
+              display_path: Optional[str] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    display = display_path or path
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(rule="KF001", path=display,
+                        line=e.lineno or 0, col=e.offset or 0,
+                        message=f"does not parse: {e.msg}")]
+    module = Module(path=display,
+                    relpath=(relpath or os.path.basename(path)).replace(
+                        os.sep, "/"),
+                    tree=tree, lines=source.splitlines())
+    findings: List[Finding] = []
+    for rule in rules:
+        for f in rule.check(module):
+            f.path = display
+            findings.append(f)
+    return _apply_suppressions(module, findings)
+
+
+def scan_tree(root: str, rules: List[Rule]) -> List[Finding]:
+    """Walk ``root`` (a package directory or a single file) through
+    ``rules``, then run their cross-file ``finalize`` passes."""
+    findings: List[Finding] = []
+    if os.path.isfile(root):
+        findings.extend(scan_file(root, rules))
+    else:
+        base = os.path.abspath(root)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__",))
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, base)
+                display = os.path.join(root.rstrip(os.sep), rel)
+                findings.extend(scan_file(full, rules, relpath=rel,
+                                          display_path=display))
+    for rule in rules:
+        findings.extend(rule.finalize())
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def run_analysis(root: str, *, rules: Optional[List[Rule]] = None,
+                 docs_inventory: Optional[str] = None) -> List[Finding]:
+    """Scan ``root`` with the full rule set (fresh rule instances — the
+    cross-file rules carry state). ``docs_inventory`` overrides KF103's
+    auto-detected docs/observability.md path ("" disables the
+    cross-check)."""
+    from kubeflow_tpu.analysis.rules import all_rules
+
+    return scan_tree(root, all_rules(root, docs_inventory=docs_inventory)
+                     if rules is None else rules)
+
+
+def render_human(findings: List[Finding]) -> str:
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+    out = [f.render() for f in active]
+    out.append(
+        f"{len(active)} finding(s), {len(suppressed)} suppressed"
+    )
+    if suppressed:
+        out.append("suppressed:")
+        out.extend("  " + f.render() for f in suppressed)
+    return "\n".join(out)
+
+
+def render_json(findings: List[Finding]) -> str:
+    return json.dumps({
+        "findings": [f.to_dict() for f in findings if not f.suppressed],
+        "suppressed": [f.to_dict() for f in findings if f.suppressed],
+    }, indent=2, sort_keys=True)

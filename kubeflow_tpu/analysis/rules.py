@@ -1,0 +1,563 @@
+"""The five project rules (KF101–KF105).
+
+Each rule encodes an invariant this repo already broke once and fixed
+by hand — the rule is the fix's regression test, generalized. The bug
+history and rationale for every rule live in docs/static-analysis.md;
+the docstrings here only state what is checked.
+
+Rule IDs are STABLE: suppressions, CI logs and the docs reference them,
+so a rule is never renumbered — retired ids are left as tombstones.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from kubeflow_tpu.analysis.engine import Finding, Module, Rule
+
+# ----------------------------------------------------------------------
+# KF101 — clock domains
+# ----------------------------------------------------------------------
+
+#: Modules whose timelines are driven by logical ticks (seeded soaks,
+#: benchmark sweeps) or an injected ``now_fn``. A raw wall-clock CALL
+#: here splits the module across two clock domains — the PR-15 flight
+#: recorder stitched timelines found exactly this class of bug.
+#: Referencing ``time.time`` as a DEFAULT (``now_fn or time.time``) is
+#: fine: that is the injection seam itself.
+TICK_DOMAIN = frozenset({
+    "scheduler/benchmark.py",
+    "chaos/soak.py",
+    "chaos/serving_soak.py",
+    "obs/flight.py",
+    "obs/slo.py",
+    "obs/goodput.py",
+})
+
+_WALL_TIME_ATTRS = {
+    ("time", "time"), ("time", "monotonic"), ("time", "perf_counter"),
+    ("datetime", "now"), ("datetime", "utcnow"), ("datetime", "today"),
+}
+
+
+class ClockDomainRule(Rule):
+    """KF101: no raw wall-clock calls in tick-domain modules.
+
+    ``time.time()``/``time.monotonic()``/``time.perf_counter()`` and
+    ``datetime.now()/utcnow()/today()`` calls are flagged in the modules
+    listed in :data:`TICK_DOMAIN`; time must arrive through the injected
+    ``now_fn``/``share_clock`` seam instead."""
+
+    ID = "KF101"
+    TITLE = "wall-clock call in a tick-domain module"
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if module.relpath not in TICK_DOMAIN:
+            return
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            func = node.func
+            base = func.value
+            # `time.time()` / `_time.monotonic()` / `datetime.now()` /
+            # `datetime.datetime.now()`.
+            if isinstance(base, ast.Name):
+                mod = base.id.lstrip("_")
+            elif isinstance(base, ast.Attribute):
+                mod = base.attr
+            else:
+                continue
+            if (mod, func.attr) not in _WALL_TIME_ATTRS:
+                continue
+            yield Finding(
+                rule=self.ID, path=module.path,
+                line=node.lineno, col=node.col_offset,
+                message=f"wall-clock call {mod}.{func.attr}() in "
+                        "tick-domain module — inject time via the "
+                        "now_fn/share_clock seam",
+            )
+
+
+# ----------------------------------------------------------------------
+# KF102 — journal discipline
+# ----------------------------------------------------------------------
+
+_APPEND_MODES = ("a", "ab", "a+", "ab+", "a+b")
+
+
+def _module_jsonl_constants(tree: ast.AST) -> bool:
+    """True when the module binds a top-level ``NAME = \"...jsonl\"``
+    constant — the idiom every journal file in this repo uses to name
+    its on-disk artifact."""
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str) \
+                and node.value.value.endswith(".jsonl"):
+            return True
+    return False
+
+
+class JournalDisciplineRule(Rule):
+    """KF102: every ``.jsonl`` append routes through the shared
+    discipline, and journal-write precedes state-apply.
+
+    (a) ``open(..., \"a\"/\"ab\")`` in a module that handles ``.jsonl``
+    artifacts (a ``.jsonl`` literal in the call, or a module-level
+    ``NAME = \"*.jsonl\"`` constant) is an error outside ``obs/`` and
+    ``utils/`` — hand-rolled appenders forked the fsync/rotation/replay
+    semantics twice before ``utils/journal.py`` unified them.
+
+    (b) In any function that both journals (``*journal*`` call) and
+    applies (``_apply_*`` call), the journal call must come FIRST — a
+    crash between apply and journal otherwise loses the record replay
+    depends on."""
+
+    ID = "KF102"
+    TITLE = "journal discipline"
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        in_discipline_layer = (
+            module.relpath.startswith("obs/")
+            or module.relpath.startswith("utils/"))
+        has_jsonl_constant = _module_jsonl_constants(module.tree)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) and not in_discipline_layer:
+                f = self._check_open_append(node, has_jsonl_constant,
+                                            module)
+                if f:
+                    yield f
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_ordering(node, module)
+
+    def _check_open_append(self, node: ast.Call, has_jsonl_constant: bool,
+                           module: Module) -> Optional[Finding]:
+        if not (isinstance(node.func, ast.Name)
+                and node.func.id == "open"):
+            return None
+        mode = None
+        if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+            mode = node.args[1].value
+        for kw in node.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        if not (isinstance(mode, str) and mode in _APPEND_MODES):
+            return None
+        jsonl_in_call = any(
+            isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+            and sub.value.endswith(".jsonl")
+            for arg in node.args for sub in ast.walk(arg))
+        if not (jsonl_in_call or has_jsonl_constant):
+            return None
+        return Finding(
+            rule=self.ID, path=module.path,
+            line=node.lineno, col=node.col_offset,
+            message="open-for-append on a jsonl artifact outside the "
+                    "shared journal discipline — use "
+                    "utils.journal.JsonlJournal (or Tracer's rotation)",
+        )
+
+    def _check_ordering(self, fn: ast.AST,
+                        module: Module) -> Iterable[Finding]:
+        first_journal: Optional[int] = None
+        first_apply: Optional[Tuple[int, int, str]] = None
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            name = node.func.attr
+            if "journal" in name and (first_journal is None
+                                      or node.lineno < first_journal):
+                first_journal = node.lineno
+            if name.startswith("_apply_") and (
+                    first_apply is None or node.lineno < first_apply[0]):
+                first_apply = (node.lineno, node.col_offset, name)
+        if first_apply is not None and first_journal is not None \
+                and first_apply[0] < first_journal:
+            yield Finding(
+                rule=self.ID, path=module.path,
+                line=first_apply[0], col=first_apply[1],
+                message=f"state apply ({first_apply[2]}) precedes the "
+                        "journal write — a crash in between loses the "
+                        "record replay depends on",
+            )
+
+
+# ----------------------------------------------------------------------
+# KF103 — metric hygiene
+# ----------------------------------------------------------------------
+
+_METRIC_NAME_RE = re.compile(r"kftpu_[a-z0-9_]+\Z")
+_LABEL_RE = re.compile(r"[a-z_][a-z0-9_]*\Z")
+_REGISTER_METHODS = {"counter", "gauge", "histogram"}
+_MAX_LABELS = 5
+
+#: The registry implementation itself — it registers whatever callers
+#: hand it; the callers are where the literals live.
+_KF103_SKIP = ("utils/monitoring.py",)
+
+
+def _docs_inventory_patterns(path: str) -> Optional[List[re.Pattern]]:
+    """The metric-name inventory from docs/observability.md: every
+    backticked ``kftpu_*`` token in the ``## Metric name inventory``
+    SECTION (a prose mention elsewhere is not documentation), with
+    ``<placeholder>`` segments widened to ``[a-z0-9_]+`` (pattern rows
+    for dynamic name families)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return None
+    m = re.search(r"^## Metric name inventory.*?(?=^## |\Z)", text,
+                  re.MULTILINE | re.DOTALL)
+    if m:
+        text = m.group(0)
+    pats: List[re.Pattern] = []
+    for tok in re.findall(r"`(kftpu_[a-z0-9_<>]+)`", text):
+        pats.append(re.compile(
+            re.sub(r"<[a-z0-9_]+>", "[a-z0-9_]+", tok) + r"\Z"))
+    return pats or None
+
+
+class MetricHygieneRule(Rule):
+    """KF103: metric names are literal ``kftpu_[a-z0-9_]+`` strings,
+    registered at one site, with a small literal label set, and present
+    in the docs/observability.md inventory table.
+
+    Findings anchor at the NAME argument's line (suppression comments
+    sit inside the call, directly above the name)."""
+
+    ID = "KF103"
+    TITLE = "metric hygiene"
+
+    def __init__(self, docs_inventory: Optional[str] = None):
+        self._docs_path = docs_inventory
+        #: literal name -> [(path, line)] registration sites.
+        self._sites: Dict[str, List[Tuple[str, int]]] = {}
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if module.relpath in _KF103_SKIP:
+            return
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _REGISTER_METHODS
+                    and node.args):
+                continue
+            name_arg = node.args[0]
+            if not (isinstance(name_arg, ast.Constant)
+                    and isinstance(name_arg.value, str)):
+                yield Finding(
+                    rule=self.ID, path=module.path,
+                    line=name_arg.lineno, col=name_arg.col_offset,
+                    message="metric name is not a string literal — "
+                            "dynamic names defeat grep, the docs "
+                            "inventory and cardinality review",
+                )
+                continue
+            name = name_arg.value
+            if not _METRIC_NAME_RE.fullmatch(name):
+                yield Finding(
+                    rule=self.ID, path=module.path,
+                    line=name_arg.lineno, col=name_arg.col_offset,
+                    message=f"metric name {name!r} does not match "
+                            "kftpu_[a-z0-9_]+",
+                )
+            else:
+                self._sites.setdefault(name, []).append(
+                    (module.path, name_arg.lineno))
+            yield from self._check_labels(node, module)
+
+    def _check_labels(self, node: ast.Call,
+                      module: Module) -> Iterable[Finding]:
+        for kw in node.keywords:
+            if kw.arg != "labels":
+                continue
+            v = kw.value
+            if not isinstance(v, (ast.Tuple, ast.List)):
+                yield Finding(
+                    rule=self.ID, path=module.path,
+                    line=v.lineno, col=v.col_offset,
+                    message="labels must be a literal tuple/list of "
+                            "label names (bounded, reviewable set)",
+                )
+                return
+            if len(v.elts) > _MAX_LABELS:
+                yield Finding(
+                    rule=self.ID, path=module.path,
+                    line=v.lineno, col=v.col_offset,
+                    message=f"{len(v.elts)} labels — more than "
+                            f"{_MAX_LABELS} label dimensions is a "
+                            "cardinality hazard",
+                )
+            for el in v.elts:
+                if not (isinstance(el, ast.Constant)
+                        and isinstance(el.value, str)
+                        and _LABEL_RE.fullmatch(el.value)):
+                    yield Finding(
+                        rule=self.ID, path=module.path,
+                        line=el.lineno, col=el.col_offset,
+                        message="label names must be [a-z_][a-z0-9_]* "
+                                "string literals",
+                    )
+
+    def finalize(self) -> Iterable[Finding]:
+        for name, sites in sorted(self._sites.items()):
+            if len(sites) > 1:
+                first = sites[0]
+                for path, line in sites[1:]:
+                    yield Finding(
+                        rule=self.ID, path=path, line=line, col=0,
+                        message=f"metric {name!r} registered at more "
+                                f"than one site (first: {first[0]}:"
+                                f"{first[1]}) — register once, share "
+                                "the handle",
+                    )
+        if self._docs_path == "":
+            return
+        pats = _docs_inventory_patterns(self._docs_path or "")
+        if pats is None:
+            if self._docs_path:
+                yield Finding(
+                    rule=self.ID, path=self._docs_path, line=0, col=0,
+                    message="metric inventory not found/empty — cannot "
+                            "cross-check registered names",
+                )
+            return
+        for name, sites in sorted(self._sites.items()):
+            if any(p.fullmatch(name) for p in pats):
+                continue
+            path, line = sites[0]
+            yield Finding(
+                rule=self.ID, path=path, line=line, col=0,
+                message=f"metric {name!r} is not in the "
+                        "docs/observability.md inventory table",
+            )
+
+
+# ----------------------------------------------------------------------
+# KF104 — copy=False read aliasing
+# ----------------------------------------------------------------------
+
+_MUTATING_METHODS = {
+    "append", "add", "extend", "insert", "update", "pop", "remove",
+    "clear", "setdefault", "popitem", "discard", "sort", "reverse",
+}
+
+
+def _is_copy_false_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and any(kw.arg == "copy"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is False
+                    for kw in node.keywords))
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """The base Name of an attribute/subscript chain
+    (``job.status.conditions`` -> ``job``)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class ReadAliasingRule(Rule):
+    """KF104: objects from ``copy=False`` reads are shared snapshots —
+    they must not be mutated, and must not be stored past the call
+    frame (``self.*`` / augmenting a ``self.*`` container).
+
+    Tracked aliases: names bound by ``x = api.list(..., copy=False)``
+    and ``for x in api.list(..., copy=False):``. Flagged uses: any
+    assignment through the alias (``x.a = ..``, ``x[k] = ..``), calls
+    to mutating container methods rooted at the alias, and storing the
+    alias (or the raw call) into a ``self.*`` target.
+
+    Binding resolution is lexical-nearest: rebinding the name to a
+    private copy (``pod = api.try_get(...)``, no ``copy=False``) CLEARS
+    the alias for later lines — the peek-then-reread idiom the
+    controllers use is the sanctioned pattern, not a violation."""
+
+    ID = "KF104"
+    TITLE = "copy=False alias mutated or stored"
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_fn(node, module)
+
+    @staticmethod
+    def _aliased_at(bindings: Dict[str, List[Tuple[int, bool]]],
+                    name: Optional[str], line: int) -> bool:
+        """Whether ``name``'s lexically nearest binding at/above
+        ``line`` is a copy=False alias."""
+        if name is None:
+            return False
+        best: Optional[Tuple[int, bool]] = None
+        for b in bindings.get(name, ()):
+            if b[0] <= line and (best is None or b[0] > best[0]):
+                best = b
+        return best is not None and best[1]
+
+    def _check_fn(self, fn: ast.AST, module: Module) -> Iterable[Finding]:
+        #: name -> [(binding line, binds a copy=False alias)]
+        bindings: Dict[str, List[Tuple[int, bool]]] = {}
+        escapes: List[Tuple[int, int, str]] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                is_alias = _is_copy_false_call(node.value)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        bindings.setdefault(tgt.id, []).append(
+                            (node.lineno, is_alias))
+                    elif isinstance(tgt, ast.Attribute) and is_alias:
+                        escapes.append((
+                            node.lineno, node.col_offset,
+                            "copy=False result stored on an attribute "
+                            "— the shared snapshot now outlives the "
+                            "call frame"))
+            elif isinstance(node, ast.For) \
+                    and isinstance(node.target, ast.Name):
+                bindings.setdefault(node.target.id, []).append(
+                    (node.lineno, _is_copy_false_call(node.iter)))
+        for line, col, msg in escapes:
+            yield Finding(rule=self.ID, path=module.path,
+                          line=line, col=col, message=msg)
+        if not any(b[1] for bs in bindings.values() for b in bs):
+            return
+        aliased = lambda name, line: self._aliased_at(  # noqa: E731
+            bindings, name, line)
+        # Pass 2: flag mutations/stores through live aliases.
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    if isinstance(tgt, (ast.Attribute, ast.Subscript)) \
+                            and aliased(_root_name(tgt), tgt.lineno):
+                        yield Finding(
+                            rule=self.ID, path=module.path,
+                            line=tgt.lineno, col=tgt.col_offset,
+                            message="mutation through a copy=False "
+                                    "alias — re-read with copy=True "
+                                    "before writing",
+                        )
+                    if isinstance(tgt, ast.Attribute) \
+                            and isinstance(node, ast.Assign) \
+                            and isinstance(node.value, ast.Name) \
+                            and aliased(node.value.id, node.lineno):
+                        yield Finding(
+                            rule=self.ID, path=module.path,
+                            line=node.lineno, col=node.col_offset,
+                            message="copy=False alias stored on an "
+                                    "attribute — the shared snapshot "
+                                    "now outlives the call frame",
+                        )
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATING_METHODS \
+                    and isinstance(node.func.value,
+                                   (ast.Attribute, ast.Subscript)) \
+                    and aliased(_root_name(node.func.value),
+                                node.lineno):
+                yield Finding(
+                    rule=self.ID, path=module.path,
+                    line=node.lineno, col=node.col_offset,
+                    message=f".{node.func.attr}() on a container "
+                            "reached through a copy=False alias — "
+                            "mutating the shared snapshot",
+                )
+
+
+# ----------------------------------------------------------------------
+# KF105 — vacuous gates
+# ----------------------------------------------------------------------
+
+_GATE_NAME_RE = re.compile(r"(\A_?check_\w*gates?\Z)|(\w*_gate_failures\Z)")
+
+
+def _has_zero_observation_guard(fn: ast.AST) -> bool:
+    """True when the gate compares something against a 0/1 constant
+    (the ``report.submitted == 0`` / ``len(x) < 2`` idiom) or delegates
+    to another gate function."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Compare):
+            for side in [node.left] + list(node.comparators):
+                if isinstance(side, ast.Constant) \
+                        and isinstance(side.value, (int, float)) \
+                        and not isinstance(side.value, bool) \
+                        and side.value in (0, 1, 2):
+                    return True
+        elif isinstance(node, ast.Call):
+            callee = node.func
+            name = callee.attr if isinstance(callee, ast.Attribute) \
+                else callee.id if isinstance(callee, ast.Name) else ""
+            if name and _GATE_NAME_RE.match(name) \
+                    and name != getattr(fn, "name", ""):
+                return True
+    return False
+
+
+class VacuousGateRule(Rule):
+    """KF105: a gate that can pass on zero observations is not a gate.
+
+    Functions named ``check_*gates`` / ``*_gate_failures`` must contain
+    an explicit zero-observation guard (a comparison against a small
+    constant: ``report.submitted == 0``, ``len(tenants) < 2``) or
+    delegate to a gate that does. The PR-15 ``dump_dir=\"\"`` incident
+    and the storm-gate's zero-gang pass are this bug class."""
+
+    ID = "KF105"
+    TITLE = "vacuous gate"
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _GATE_NAME_RE.match(node.name):
+                continue
+            if _has_zero_observation_guard(node):
+                continue
+            yield Finding(
+                rule=self.ID, path=module.path,
+                line=node.lineno, col=node.col_offset,
+                message=f"gate {node.name}() has no zero-observation "
+                        "guard — it passes vacuously when nothing was "
+                        "exercised",
+            )
+
+
+# ----------------------------------------------------------------------
+# registry
+# ----------------------------------------------------------------------
+
+RULES: Dict[str, type] = {
+    "KF101": ClockDomainRule,
+    "KF102": JournalDisciplineRule,
+    "KF103": MetricHygieneRule,
+    "KF104": ReadAliasingRule,
+    "KF105": VacuousGateRule,
+}
+
+
+def all_rules(root: str = "",
+              docs_inventory: Optional[str] = None) -> List[Rule]:
+    """Fresh rule instances for one scan. ``docs_inventory`` overrides
+    the docs/observability.md location (resolved as a sibling ``docs/``
+    of the scanned package by default); pass ``""`` to disable the
+    docs cross-check."""
+    if docs_inventory is None and root:
+        base = os.path.dirname(os.path.abspath(root.rstrip(os.sep)))
+        cand = os.path.join(base, "docs", "observability.md")
+        docs_inventory = cand if os.path.exists(cand) else ""
+    return [
+        ClockDomainRule(),
+        JournalDisciplineRule(),
+        MetricHygieneRule(docs_inventory),
+        ReadAliasingRule(),
+        VacuousGateRule(),
+    ]

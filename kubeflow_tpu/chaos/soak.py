@@ -30,7 +30,7 @@ from kubeflow_tpu.controlplane.runtime import (
     ExponentialBackoffLimiter,
     InMemoryApiServer,
 )
-from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.utils import get_logger, locktrace
 from kubeflow_tpu.utils.monitoring import MetricsRegistry
 
 log = get_logger("chaos-soak")
@@ -69,6 +69,12 @@ class SoakReport:
     # Flight dumps written during the soak (alert pages / tripped
     # guards; paths under ``state_dir`` when one was given).
     flight_dumps: List[str] = dataclasses.field(default_factory=list)
+    # Lock-order/thread-leak/workqueue-oracle verdict (ISSUE 16): the
+    # ``locktrace.report()`` dict plus ``leaked_threads`` and the
+    # oracle summary. Empty unless the soak ran with
+    # ``locktrace_check=True`` (the soak RAISES on violations — this
+    # field is the evidence trail for the clean case).
+    locktrace: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     def stuck_jobs(self) -> Dict[str, str]:
         return {n: p for n, p in self.phases.items() if p not in TERMINAL}
@@ -100,7 +106,19 @@ def run_soak(
     # runs — the soak IS the slo-smoke substrate.
     state_dir: str = "",
     registry: Optional[MetricsRegistry] = None,
+    # ISSUE 16: trace the named hot locks + install the workqueue
+    # oracle, and RAISE at the end on any lock-order cycle, leaked
+    # thread/executor, or per-key double-dispatch. Off by default —
+    # seeded tier-1 runs stay byte-identical to the untraced seeds.
+    locktrace_check: bool = False,
 ) -> SoakReport:
+    import threading as _threading
+
+    if locktrace_check:
+        # Before ANY traced lock is constructed: the factories consult
+        # the flag at construction time.
+        locktrace.enable()
+    baseline_threads = {t.ident for t in _threading.enumerate()}
     registry = registry or MetricsRegistry()
     inner = InMemoryApiServer(registry=registry)
     # ``latency_s`` models a slow apiserver on every chaos-visible verb —
@@ -142,6 +160,10 @@ def run_soak(
         limiter=ExponentialBackoffLimiter(seed=seed + 1),
         workers=workers,
     )
+    if locktrace_check:
+        # The per-key never-concurrent CHECK (not trust): _execute
+        # brackets every reconcile with enter/exit.
+        mgr.oracle = locktrace.WorkqueueOracle()
     job_ctl = TpuJobController(chaos, registry, capacity=capacity,
                                hbm_check=False)
     mgr.register(job_ctl)
@@ -310,6 +332,23 @@ def run_soak(
     recorder.detach()
     if goodput_acc is not None:
         goodput_acc.close()
+    if locktrace_check:
+        # Everything that owns threads is closed — any thread that
+        # appeared since the baseline and is still alive leaked (the
+        # worker pool's ThreadPoolExecutor threads are non-daemon, so
+        # this covers leaked executors too).
+        lt = locktrace.report()
+        lt["leaked_threads"] = sorted(
+            t.name for t in _threading.enumerate()
+            if t.is_alive() and t.ident not in baseline_threads)
+        lt["oracle"] = mgr.oracle.summary()
+        report.locktrace = lt
+        locktrace.disable()
+        problems = locktrace.violations(lt)
+        if problems:
+            raise RuntimeError(
+                "chaos soak concurrency invariants violated: "
+                + "; ".join(problems))
     log.info("soak done", kv={
         "converged": converged, "rounds": rounds,
         "injected": sum(report.injected.values()),
@@ -589,6 +628,11 @@ class ShardedSoakReport:
     alerts_replay_identical: bool = True
     slo: Dict[str, object] = dataclasses.field(default_factory=dict)
     flight_dumps: List[str] = dataclasses.field(default_factory=list)
+    # Per-shard lock-order/oracle verdicts (ISSUE 16), keyed by shard
+    # id. Populated (and violations RAISED on) only with
+    # ``locktrace_check=True``.
+    locktrace: Dict[int, Dict[str, object]] = dataclasses.field(
+        default_factory=dict)
 
 
 def run_sharded_soak(
@@ -606,6 +650,7 @@ def run_sharded_soak(
     workers: int = 1,
     slice_type: str = "v5e-16",
     state_dir: str = "",             # "" = private temp dir (WAL home)
+    locktrace_check: bool = False,   # ISSUE 16: per-shard lock tracing
 ) -> ShardedSoakReport:
     """The chaos soak, horizontally sharded (ISSUE 6): the fleet is routed
     across ``shards`` shard processes, every shard injects seeded
@@ -655,6 +700,7 @@ def run_sharded_soak(
         shards, workers=workers, state_dir=state_dir, seed=seed,
         conflict_rate=conflict_rate, transient_rate=transient_rate,
         work_ticks=work_ticks, capacity_by_shard=capacity_by_shard,
+        locktrace=locktrace_check,
     )
     shard_killer = ShardPreemptor(cp, seed=seed + 11)
     slice_preemptions = 0
@@ -693,6 +739,8 @@ def run_sharded_soak(
             p in TERMINAL for p in phases
         )
         epochs = cp.epoch
+        # Collect BEFORE close() — the shard processes answer this.
+        lt_by_shard = (cp.locktrace_reports() if locktrace_check else {})
     finally:
         cp.close()
         if own_state:
@@ -716,7 +764,18 @@ def run_sharded_soak(
         alerts_replay_identical=shard_killer.alerts_replay_identical,
         slo=slo_union,
         flight_dumps=slo_union.get("flight_dumps", []),
+        locktrace=lt_by_shard,
     )
+    if locktrace_check:
+        problems = [
+            f"shard {sid}: {p}"
+            for sid, rep in sorted(lt_by_shard.items())
+            for p in locktrace.violations(rep)
+        ]
+        if problems:
+            raise RuntimeError(
+                "sharded soak concurrency invariants violated: "
+                + "; ".join(problems))
     log.info("sharded soak done", kv={
         "converged": converged, "rounds": rounds, "shards": shards,
         "kills": report.shard_kills,

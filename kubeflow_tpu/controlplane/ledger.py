@@ -35,13 +35,13 @@ timeout is exactly the fail-closed path.
 
 from __future__ import annotations
 
-import json
 import os
 import threading
 from multiprocessing.connection import wait as conn_wait
 from typing import Dict, Optional, Tuple
 
-from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.utils import get_logger, locktrace
+from kubeflow_tpu.utils.journal import JsonlJournal
 
 log = get_logger("ledger")
 
@@ -121,63 +121,22 @@ class CapacityLedger:
             }
 
 
-class _Journal:
-    def __init__(self, path: str, fsync: bool):
-        self.path = path
-        self.fsync = fsync
-        self._f = None
+class _Journal(JsonlJournal):
+    """The shared fsync'd-jsonl discipline (utils/journal.py) plus the
+    ledger-specific replay: re-apply reserve/release records into a
+    :class:`CapacityLedger`. Before PR 16 this was a second hand-rolled
+    appender — exactly the duplication KF102 now flags."""
 
     def replay_into(self, ledger: CapacityLedger) -> int:
-        if not self.path or not os.path.exists(self.path):
-            return 0
         n = 0
-        with open(self.path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    break       # torn tail record: crash mid-append
-                if rec.get("op") == "reserve":
-                    ledger.try_reserve(rec["uid"], rec["slice_type"],
-                                       rec["num_slices"])
-                elif rec.get("op") == "release":
-                    ledger.release(rec["uid"])
-                n += 1
+        for rec in self.read(self.path):
+            if rec.get("op") == "reserve":
+                ledger.try_reserve(rec["uid"], rec["slice_type"],
+                                   rec["num_slices"])
+            elif rec.get("op") == "release":
+                ledger.release(rec["uid"])
+            n += 1
         return n
-
-    def append(self, rec: dict) -> None:
-        if not self.path:
-            return
-        if self._f is None:
-            self._f = open(self.path, "a")
-        self._f.write(json.dumps(rec, sort_keys=True) + "\n")
-        self._f.flush()
-        if self.fsync:
-            os.fsync(self._f.fileno())
-
-    def rewrite(self, records: list) -> None:
-        """Compact: replace the log with exactly the live reservations
-        (atomic temp+rename, same discipline as Platform.save) — the
-        replay-everything cost of a failover stays bounded by live
-        reservations, not by history."""
-        if not self.path:
-            return
-        self.close()
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            for rec in records:
-                f.write(json.dumps(rec, sort_keys=True) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
-
-    def close(self) -> None:
-        if self._f is not None:
-            self._f.close()
-            self._f = None
 
 
 class LedgerService:
@@ -417,7 +376,9 @@ class LedgerRelay:
         self.serve_conns = dict(serve_conns)
         self.leader_of = leader_of          # () -> Optional[int]
         self.leader_timeout_s = leader_timeout_s
-        self._conn_lock = threading.Lock()
+        # locktrace factory: the relay's connection lock is the shard
+        # transport's hot lock — traced under the sharded chaos soak.
+        self._conn_lock = locktrace.lock("ledger.relay")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.forwarded = 0

@@ -29,11 +29,26 @@ from kubeflow_tpu.utils.monitoring import (
     Heartbeat,
     MetricsRegistry,
     global_registry,
+    sanitize_metric_name,
 )
 
 log = get_logger("prober")
 
 ProbeFn = Callable[[], bool]
+
+
+def _target_gauge(registry: MetricsRegistry, name: str):
+    """The per-target up/down gauge. Targets are named by operators
+    ("kfam", "fake-kubelet", ...), so the interpolated fragment goes
+    through sanitize_metric_name — `.replace('-', '_')` alone let a
+    dotted target name reach the exposition illegally (KF103's harvest)."""
+    return registry.gauge(
+        # kftpu: allow(KF103): per-target name family
+        # `kftpu_component_up_<target>` — sanitized here, documented as a
+        # pattern row in docs/observability.md.
+        f"kftpu_component_up_{sanitize_metric_name(name)}",
+        f"1 when the {name} probe passes",
+    )
 
 
 def http_target(url: str, timeout: float = 5.0) -> ProbeFn:
@@ -89,10 +104,7 @@ class AvailabilityProber:
         # the background loop iterates in probe().
         self._targets_lock = threading.Lock()
         self._gauges = {
-            name: registry.gauge(
-                f"kftpu_component_up_{name.replace('-', '_')}",
-                f"1 when the {name} probe passes",
-            )
+            name: _target_gauge(registry, name)
             for name in self.targets
         }
         self.availability = registry.gauge(
@@ -106,10 +118,7 @@ class AvailabilityProber:
 
     def add_target(self, name: str, probe: ProbeFn,
                    registry: MetricsRegistry = global_registry) -> None:
-        gauge = registry.gauge(
-            f"kftpu_component_up_{name.replace('-', '_')}",
-            f"1 when the {name} probe passes",
-        )
+        gauge = _target_gauge(registry, name)
         with self._targets_lock:
             self.targets[name] = probe
             self._gauges[name] = gauge

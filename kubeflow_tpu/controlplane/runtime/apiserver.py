@@ -81,6 +81,7 @@ from copy import deepcopy
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from kubeflow_tpu.controlplane.api.meta import fresh_identity
+from kubeflow_tpu.utils import locktrace
 from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
 from kubeflow_tpu.utils.tracing import SpanContext, Tracer, global_tracer
 
@@ -265,7 +266,10 @@ class InMemoryApiServer:
         self._by_kind_ns: Dict[Tuple[str, str], Dict[Key, Any]] = {}
         self._by_owner: Dict[str, Dict[Key, Any]] = {}   # owner uid -> deps
         self._rv = 0
-        self._lock = threading.RLock()
+        # Reentrant (watch-notify paths re-enter reads) and built through
+        # the locktrace factory so the chaos soaks' lock-order detector
+        # sees the store lock's position in every ordering edge.
+        self._lock = locktrace.rlock("apiserver.store")
         # (kind filter, queue, wants_bookmarks)
         self._watchers: List[
             Tuple[Optional[str], "queue.Queue[WatchEvent]", bool]

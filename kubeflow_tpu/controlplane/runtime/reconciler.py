@@ -36,7 +36,7 @@ from kubeflow_tpu.controlplane.runtime.apiserver import (
 from kubeflow_tpu.controlplane.runtime.ratelimiter import (
     ExponentialBackoffLimiter,
 )
-from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.utils import get_logger, locktrace
 from kubeflow_tpu.utils.monitoring import (
     MetricsRegistry,
     global_registry,
@@ -288,11 +288,17 @@ class Controller:
         # the scrape).
         mname = sanitize_metric_name(self.NAME)
         self.metrics_reconcile = registry.counter(
+            # kftpu: allow(KF103): per-controller name family
+            # `kftpu_<controller>_reconcile_total` — NAME is a class
+            # constant fed through sanitize_metric_name, and the family
+            # is documented as a pattern row in docs/observability.md.
             f"kftpu_{mname}_reconcile_total",
             f"Reconcile outcomes for {self.NAME}",
             labels=("result",),
         )
         self.metrics_retries = registry.counter(
+            # kftpu: allow(KF103): same pattern family as above
+            # (`kftpu_<controller>_retries_total`), sanitized + documented.
             f"kftpu_{mname}_retries_total",
             f"Requeues after failed reconciles for {self.NAME}",
             labels=("reason",),
@@ -406,7 +412,14 @@ class ControllerManager:
         self._timer_seq = 0
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        # Built through the locktrace factory: a plain Lock normally, a
+        # traced one under the chaos soaks' lock-order detector.
+        self._lock = locktrace.lock("manager.pending")
+        # Optional workqueue oracle (utils/locktrace.WorkqueueOracle):
+        # when installed, _execute brackets every reconcile with
+        # enter/exit so the per-key never-concurrent invariant is
+        # CHECKED under the parallel soaks instead of trusted.
+        self.oracle = None
         self.log = get_logger("manager")
         # Queue-health gauges (client-go workqueue_depth analogues). On a
         # shared registry the first manager's callbacks win, matching the
@@ -653,12 +666,17 @@ class ControllerManager:
 
     def _execute(self, ctl: Controller, key: Tuple[str, str],
                  meta: Optional[Tuple[float, List[SpanContext]]]) -> None:
+        oracle = self.oracle
+        if oracle is not None:
+            oracle.enter(ctl.NAME, key)
         try:
             self._reconcile_once(ctl, key, meta)
         finally:
             # The in-flight reservation MUST release even on an exception
             # escaping the handler ladder (BaseException), or the key
             # wedges un-reconcilable forever.
+            if oracle is not None:
+                oracle.exit(ctl.NAME, key)
             self._finish_key(ctl, key)
 
     def _reconcile_once(self, ctl: Controller, key: Tuple[str, str],

@@ -58,6 +58,7 @@ import hashlib
 import multiprocessing
 import os
 import signal
+import threading
 import time
 import traceback
 from typing import Any, Dict, Iterable, List, Optional, Tuple
@@ -147,6 +148,10 @@ class ShardSpec:
     global_capacity: Optional[Dict[str, int]] = None
     ledger_conn: Any = None
     ledger_serve_conn: Any = None
+    # ISSUE 16: build the shard's hot locks through traced wrappers and
+    # install the workqueue oracle; the parent collects each shard's
+    # lock-order graph + oracle verdict via the "locktrace" command.
+    locktrace: bool = False
 
 
 class ShardSingleton:
@@ -180,9 +185,15 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
     )
     from kubeflow_tpu.controlplane.runtime.reconciler import Controller
     from kubeflow_tpu.controlplane.wal import WriteAheadLog, wal_path
+    from kubeflow_tpu.utils import locktrace
     from kubeflow_tpu.utils.monitoring import MetricsRegistry
     from kubeflow_tpu.utils.tracing import Tracer
 
+    if spec.locktrace:
+        # Before ANY traced lock exists in this process — the apiserver
+        # store lock and the manager queue lock are built through the
+        # locktrace factories, which consult the flag at construction.
+        locktrace.enable()
     registry = MetricsRegistry()
     tracer = Tracer()
     api = InMemoryApiServer(registry=registry, tracer=tracer,
@@ -235,6 +246,8 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
         limiter=ExponentialBackoffLimiter(seed=spec.seed + 101
                                           + spec.shard_id),
     )
+    if spec.locktrace:
+        mgr.oracle = locktrace.WorkqueueOracle()
     capacity = dict(spec.capacity) if spec.capacity else None
     ledger_client = None
     if spec.global_capacity is not None:
@@ -498,6 +511,17 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
                 "transitions": slo_engine.transitions_total(),
                 "flight_dumps": list(recorder.dumps),
             }
+        if cmd == "locktrace":
+            if not spec.locktrace:
+                return None
+            rep = locktrace.report()
+            rep["oracle"] = mgr.oracle.summary()
+            # Diagnostic only — the parent cannot see child threads, so
+            # the shard names its own. The worker pool is alive between
+            # rounds by design; leak checks happen after close().
+            rep["threads"] = sorted(
+                t.name for t in threading.enumerate() if t.is_alive())
+            return rep
         if cmd == "info":
             return {
                 "shard_id": spec.shard_id,
@@ -573,6 +597,7 @@ class ShardedControlPlane:
         global_capacity: Optional[Dict[str, int]] = None,
         wal_fsync: bool = True,
         start_method: str = "fork",
+        locktrace: bool = False,
     ):
         self.router = ShardRouter(num_shards)
         self.num_shards = int(num_shards)
@@ -580,6 +605,7 @@ class ShardedControlPlane:
             workers=workers, rtt_us=rtt_us, state_dir=state_dir, seed=seed,
             conflict_rate=conflict_rate, transient_rate=transient_rate,
             work_ticks=work_ticks, wal_fsync=wal_fsync,
+            locktrace=locktrace,
         )
         self._capacity_by_shard = dict(capacity_by_shard or {})
         if start_method not in multiprocessing.get_all_start_methods():
@@ -802,6 +828,14 @@ class ShardedControlPlane:
 
     def info(self) -> Dict[int, Dict[str, Any]]:
         return {i: self._call(i, "info") for i in self.alive()}
+
+    def locktrace_reports(self) -> Dict[int, Dict[str, Any]]:
+        """Every live shard's lock-order graph + workqueue-oracle
+        verdict (``utils.locktrace.report()`` shape, plus ``oracle``).
+        Empty payloads when the plane runs without ``locktrace=True``."""
+        return {i: rep
+                for i, rep in self._broadcast("locktrace").items()
+                if rep is not None}
 
     def ledger_snapshot(self) -> Optional[Dict[str, Any]]:
         """The leader's admission-ledger state (None when no global

@@ -61,6 +61,9 @@ class WriteAheadLog:
         self.fsync = bool(fsync)
         self._lock = threading.Lock()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # kftpu: allow(KF102): the WAL IS the journal discipline — this
+        # append-only fsync'd stream is what JsonlJournal models; routing
+        # it through the shared class would invert the layering.
         self._f = open(path, "a", encoding="utf-8")
         #: Records appended by THIS process (not the on-disk total).
         self.appended = 0
@@ -149,6 +152,8 @@ class WriteAheadLog:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.path)
+            # kftpu: allow(KF102): reopening the WAL's own stream after
+            # compaction — same in-discipline append as __init__.
             self._f = open(self.path, "a", encoding="utf-8")
         return len(keep)
 

@@ -225,6 +225,10 @@ class FlightRecorder:
         import re as _re
 
         os.makedirs(dir_path, exist_ok=True)
+        # kftpu: allow(KF101): dump filenames/headers are wall-clock BY
+        # CONTRACT (docstring above) — they must sort consistently across
+        # tick and live drivers under one state dir; ring ENTRIES keep
+        # the injected now_fn clock.
         now = time.time()
         with self._lock:
             entries = list(self._ring)

@@ -56,6 +56,7 @@ import threading
 from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
 
 from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.utils.journal import JsonlJournal
 from kubeflow_tpu.utils.monitoring import MetricsRegistry
 
 log = get_logger("goodput")
@@ -96,97 +97,10 @@ def goodput_rows_digest(rows: Iterable[Tuple]) -> str:
     return hashlib.sha256("\n".join(joined).encode()).hexdigest()
 
 
-class _Journal:
-    """fsync'd jsonl appender with torn-tail-tolerant replay (the same
-    discipline as ``controlplane/ledger.py``) and single-generation
-    rollover (the ``Tracer.rotate_jsonl`` discipline): past
-    ``rotate_bytes`` the file moves to ``<path>.1`` and appends restart
-    fresh — owners write a compacting state record as the new head so
-    the current generation is always self-contained. Shared by the
-    goodput ledger and the SLO engine's ``alerts.jsonl``."""
-
-    def __init__(self, path: str, fsync: bool):
-        self.path = path
-        self.fsync = fsync
-        self._f = None
-
-    def append(self, rec: dict) -> None:
-        if not self.path:
-            return
-        if self._f is None:
-            self._f = open(self.path, "a")
-        self._f.write(json.dumps(rec, sort_keys=True) + "\n")
-        self._f.flush()
-        if self.fsync:
-            os.fsync(self._f.fileno())
-
-    def maybe_rotate(self, max_bytes: int) -> bool:
-        """Roll the journal to ``<path>.1`` once it outgrows
-        ``max_bytes`` (atomic rename replacing any prior generation).
-        Callers check BEFORE appending a new record and, on True, write
-        their state-compaction record as the fresh generation's head —
-        every record journaled so far has already been applied, so that
-        head covers the rotated-out generation exactly and the current
-        file is self-contained even after ``.1`` is itself replaced."""
-        if not self.path or self._f is None or max_bytes <= 0:
-            return False
-        if self._f.tell() <= max_bytes:
-            return False
-        self._f.close()
-        self._f = None
-        os.replace(self.path, self.path + ".1")
-        return True
-
-    @staticmethod
-    def generations(path: str) -> List[str]:
-        """On-disk generations, oldest first (``<path>.1`` then
-        ``<path>``), existing files only — replay reads ALL of them."""
-        if not path:
-            return []
-        return [p for p in (path + ".1", path) if os.path.exists(p)]
-
-    @staticmethod
-    def read(path: str) -> List[dict]:
-        out: List[dict] = []
-        if not path or not os.path.exists(path):
-            return out
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    out.append(json.loads(line))
-                except ValueError:
-                    break       # torn tail record: crash mid-append
-        return out
-
-    @classmethod
-    def read_generations(cls, path: str) -> List[dict]:
-        out: List[dict] = []
-        for p in cls.generations(path):
-            out.extend(cls.read(p))
-        return out
-
-    @staticmethod
-    def compact(path: str, head_rec: dict) -> None:
-        """Replace the journal (and any ``.1`` generation it covers)
-        with one state record: temp write, fsync, atomic rename — the
-        ONE compaction discipline the goodput ledger and the SLO
-        engine's alert journal share."""
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(json.dumps(head_rec, sort_keys=True) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        if os.path.exists(path + ".1"):
-            os.remove(path + ".1")
-
-    def close(self) -> None:
-        if self._f is not None:
-            self._f.close()
-            self._f = None
+# The shared fsync'd-jsonl discipline (utils/journal.py since PR 16;
+# the `_Journal` name stays importable — obs/slo.py and the tests bind
+# it from here).
+_Journal = JsonlJournal
 
 
 class _JobTrack:

@@ -722,7 +722,17 @@ def check_storm_gates(report: StormReport) -> None:
     """The hard gates (raise, not assert — python -O must not skip):
     exact gang accounting, priority-inversion freedom, and goodput
     conservation (attributed slice-ticks sum EXACTLY to tracked
-    capacity-ticks — integer equality, never tolerance)."""
+    capacity-ticks — integer equality, never tolerance).
+
+    Non-vacuity first: a zero-gang storm trivially satisfies every gate
+    below (0 == 0 accounting, zero inversions, an empty ledger
+    conserves), so an empty report must FAIL, not pass — the KF105
+    contract (PR 15's ``dump_dir=""`` clean-soak fix is the same bug
+    class: a gate that cannot fire is not a gate)."""
+    if report.submitted == 0:
+        raise SystemExit(
+            f"[{report.policy}] storm gates are vacuous: zero gangs "
+            "submitted — nothing was exercised")
     if not report.accounting_exact:
         raise SystemExit(
             f"[{report.policy}] gang accounting broken: "

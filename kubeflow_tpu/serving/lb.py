@@ -64,7 +64,7 @@ import urllib.request
 from typing import Dict, List, Optional, Tuple
 
 from kubeflow_tpu.serving.blocks import prefix_chain, prefix_key
-from kubeflow_tpu.utils import get_logger
+from kubeflow_tpu.utils import get_logger, locktrace
 from kubeflow_tpu.utils.monitoring import MetricsRegistry, global_registry
 from kubeflow_tpu.webapps.router import (
     JsonHttpServer,
@@ -360,7 +360,9 @@ class ServingLoadBalancer:
             labels=("outcome",),
         )
         self._backends: Dict[str, Backend] = {}
-        self._lock = threading.Lock()
+        # locktrace factory: the LB state lock shows up in the serving
+        # soak's lock-order graph when tracing is enabled.
+        self._lock = locktrace.lock("lb.state")
         if backends:
             self.set_backends(backends)
 

@@ -8,6 +8,10 @@ py/kubeflow/ci, testing/kfctl/kf_is_ready_test.py:76-185):
       --min-vs-baseline 0.9] [--skip-smoke]
 
 Stages (any failure exits non-zero — the merge gate contract):
+0. **lint-smoke**: the project's static analyzer
+   (``python -m kubeflow_tpu.analysis``, docs/static-analysis.md) over
+   the whole package — fails on any active finding or when the
+   justified-suppression count exceeds the budget (``--skip-lint``).
 1. **apply**: bring the platform up from a default PlatformConfig.
 2. **ready**: assert the readiness list — every expected component
    applied, availability gauge 1 (kf_is_ready_test.py:98-114 analogue).
@@ -23,7 +27,10 @@ Stages (any failure exits non-zero — the merge gate contract):
    (per-verb injected API latency; docs/chaos.md); ``--chaos-workers``
    (default 4) adds a **chaos-parallel-smoke** stage running the same
    seeded soak through the reconcile worker pool, so injected faults
-   race concurrent reconciles.
+   race concurrent reconciles. Both soak stages run with the runtime
+   lock-order tracer + workqueue per-key oracle armed
+   (utils/locktrace.py): zero lock-order cycles, zero leaked
+   threads/executors, zero double-dispatches or the stage fails.
 5b. **shard-smoke**: the seeded chaos soak across 2 control-plane shard
    processes with a whole-shard SIGKILL mid-soak (ISSUE 6) — fails unless
    the fleet converges all-Succeeded AND the killed shard replayed its
@@ -100,6 +107,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import List
 
@@ -121,20 +129,50 @@ def _stage(name: str):
     print(f"[ci] {name} ...", flush=True)
 
 
+def run_lint_smoke(max_suppressions: int = 10) -> None:
+    """The static analyzer (docs/static-analysis.md) over the whole
+    package: zero active findings, suppressions within budget and every
+    one justified. GateFailure carries the rendered findings so the CI
+    log IS the lint report."""
+    import kubeflow_tpu
+    from kubeflow_tpu.analysis import run_analysis
+    from kubeflow_tpu.analysis.engine import render_human
+
+    pkg = os.path.dirname(os.path.abspath(kubeflow_tpu.__file__))
+    findings = run_analysis(pkg)
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+    if active:
+        raise GateFailure(
+            "lint smoke: %d active finding(s):\n%s"
+            % (len(active), render_human(findings)))
+    if len(suppressed) > max_suppressions:
+        raise GateFailure(
+            f"lint smoke: {len(suppressed)} suppressions exceed the "
+            f"budget of {max_suppressions} — prune before adding more")
+
+
 def run_chaos_smoke(seed: int = 20260803, latency_s: float = 0.0,
-                    workers: int = 1) -> None:
+                    workers: int = 1, locktrace: bool = True) -> None:
     """Seeded soak with a fixed budget; raises GateFailure on any job
     stuck non-terminal, a non-idle manager, or degraded availability.
     ``latency_s`` > 0 selects the latency soak profile (every chaos-visible
     verb sleeps that long before executing); ``workers`` > 1 runs the
     soak against the reconcile worker pool — per-key serialization and
-    dirty-requeue must hold while faults race concurrent reconciles."""
+    dirty-requeue must hold while faults race concurrent reconciles.
+    ``locktrace`` arms the runtime lock-order tracer + workqueue oracle
+    (utils/locktrace.py): the soak itself raises on any lock-order
+    cycle, leaked thread/executor or per-key double-dispatch."""
     from kubeflow_tpu.chaos import run_soak
 
     tag = f"seed={seed}, workers={workers}"
-    rep = run_soak(num_jobs=4, seed=seed, conflict_rate=0.3,
-                   transient_rate=0.05, preempt_every=3, fault_rounds=9,
-                   max_rounds=40, latency_s=latency_s, workers=workers)
+    try:
+        rep = run_soak(num_jobs=4, seed=seed, conflict_rate=0.3,
+                       transient_rate=0.05, preempt_every=3,
+                       fault_rounds=9, max_rounds=40, latency_s=latency_s,
+                       workers=workers, locktrace_check=locktrace)
+    except RuntimeError as e:
+        raise GateFailure(f"chaos smoke ({tag}): {e}")
     if not rep.converged:
         raise GateFailure(
             f"chaos smoke ({tag}): stuck jobs after {rep.rounds} "
@@ -268,7 +306,8 @@ def run_goodput_smoke(seed: int = 20260803) -> None:
         )
 
 
-def run_shard_smoke(seed: int = 20260803, shards: int = 2) -> None:
+def run_shard_smoke(seed: int = 20260803, shards: int = 2,
+                    locktrace: bool = True) -> None:
     """Sharded-control-plane smoke (ISSUE 6): the seeded chaos soak across
     ``shards`` shard processes with a whole-shard SIGKILL mid-soak.
     Gates — counts and fingerprints, never wall-clock:
@@ -277,15 +316,22 @@ def run_shard_smoke(seed: int = 20260803, shards: int = 2) -> None:
     - the killed shard replayed its WAL to a byte-identical per-shard
       ``state_fingerprint()`` (``replay_identical``);
     - exactly the expected kill was injected, and leadership moved only
-      through the election (epoch accounting).
+      through the election (epoch accounting);
+    - with ``locktrace`` (the default), every shard's lock-order graph
+      is cycle-free and its workqueue oracle clean — the soak raises on
+      a violation.
     """
     from kubeflow_tpu.chaos import run_sharded_soak
 
-    rep = run_sharded_soak(num_jobs=4, shards=shards, seed=seed,
-                           conflict_rate=0.3, transient_rate=0.05,
-                           preempt_every=3, kill_shard_round=4,
-                           fault_rounds=8, max_rounds=40)
     tag = f"seed={seed}, shards={shards}"
+    try:
+        rep = run_sharded_soak(num_jobs=4, shards=shards, seed=seed,
+                               conflict_rate=0.3, transient_rate=0.05,
+                               preempt_every=3, kill_shard_round=4,
+                               fault_rounds=8, max_rounds=40,
+                               locktrace_check=locktrace)
+    except RuntimeError as e:
+        raise GateFailure(f"shard smoke ({tag}): {e}")
     if not rep.converged:
         raise GateFailure(
             f"shard smoke ({tag}): fleet not terminal after "
@@ -789,10 +835,16 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
              skip_schedule: bool = False,
              skip_elastic: bool = False,
              skip_tenant: bool = False,
-             skip_slo: bool = False) -> List[str]:
+             skip_slo: bool = False,
+             skip_lint: bool = False) -> List[str]:
     """Run all stages; returns the list of passed stages, raises
     GateFailure on the first failing one."""
     passed: List[str] = []
+
+    if not skip_lint:
+        _stage("lint-smoke")
+        run_lint_smoke()
+        passed.append("lint-smoke")
 
     _stage("apply")
     platform = Platform()
@@ -978,6 +1030,8 @@ def main(argv=None) -> int:
     g.add_argument("--skip-slo", action="store_true",
                    help="skip the SLO-engine false/true-positive soak "
                         "gates and the alert-journal replay gate")
+    g.add_argument("--skip-lint", action="store_true",
+                   help="skip the static-analyzer lint smoke")
     args = p.parse_args(argv)
     try:
         passed = run_gate(
@@ -996,6 +1050,7 @@ def main(argv=None) -> int:
             skip_elastic=args.skip_elastic,
             skip_tenant=args.skip_tenant,
             skip_slo=args.skip_slo,
+            skip_lint=args.skip_lint,
         )
     except GateFailure as e:
         print(f"[ci] FAIL: {e}", file=sys.stderr)

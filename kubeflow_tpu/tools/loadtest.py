@@ -1429,8 +1429,16 @@ def prefix_tree_gate_failures(ptree: Dict[str, object]) -> List[str]:
     exact accounting + zero errors/timeouts + KV conservation in BOTH
     legs, and a STRICT radix hit-rate win on the partial-overlap trace.
     Returns failure strings (empty = pass); callers raise their own
-    exception type."""
+    exception type.
+
+    Non-vacuity (KF105): a zero-request trace is itself a failure —
+    every downstream condition would trivially hold on a run that
+    exercised nothing."""
     out: List[str] = []
+    if int(ptree.get("trace", {}).get("requests", 0)) == 0:
+        out.append("prefix-tree: vacuous — zero requests in the trace, "
+                   "nothing was exercised")
+        return out
     for tag in ("radix", "exact"):
         run = ptree[tag]
         if not run["accounting_ok"]:

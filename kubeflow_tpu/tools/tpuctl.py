@@ -1044,6 +1044,20 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """`tpuctl lint` — the project static analyzer (KF101-KF105,
+    docs/static-analysis.md). Thin forwarder onto
+    `python -m kubeflow_tpu.analysis` so both entry points share one
+    exit-code contract (0 clean, 1 findings/over-budget, 2 bad path)."""
+    from kubeflow_tpu.analysis.__main__ import main as lint_main
+
+    fwd = list(args.paths)
+    if args.json:
+        fwd.append("--json")
+    fwd += ["--max-suppressions", str(args.max_suppressions)]
+    return lint_main(fwd)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpuctl",
                                 description="TPU-native Kubeflow control CLI")
@@ -1174,6 +1188,19 @@ def build_parser() -> argparse.ArgumentParser:
     lp.add_argument("name")
     lp.add_argument("-n", "--namespace", default=None)
     lp.set_defaults(fn=cmd_logs)
+
+    li = sub.add_parser(
+        "lint", help="run the static analyzer (KF101-KF105) over the "
+                     "package (or the given paths); exits non-zero on "
+                     "findings or an over-budget suppression count")
+    li.add_argument("paths", nargs="*",
+                    help="files/packages to scan (default: the "
+                         "installed kubeflow_tpu package)")
+    li.add_argument("--json", action="store_true",
+                    help="machine-readable findings")
+    li.add_argument("--max-suppressions", type=int, default=10,
+                    help="justified-suppression budget (-1 disables)")
+    li.set_defaults(fn=cmd_lint)
     return p
 
 

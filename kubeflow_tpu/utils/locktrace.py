@@ -1,0 +1,369 @@
+"""Runtime lock-order tracing + the workqueue per-key oracle (ISSUE 16).
+
+The static rules in ``kubeflow_tpu/analysis`` catch invariants visible
+in source; this module catches the ones only visible under load on the
+multi-threaded control plane (PR 5's worker pool, PR 6's shard relay):
+
+- :func:`lock` / :func:`rlock` — drop-in factories the named hot locks
+  are built through (``ControllerManager``'s queue lock, the apiserver
+  store lock, the serving LB state lock, the ledger relay's connection
+  lock). Disabled (the default) they return plain ``threading``
+  primitives — zero overhead. Enabled (:func:`enable` or
+  ``KFTPU_LOCKTRACE=1``) they return traced wrappers that record, per
+  acquisition: the owning thread, the acquisition stack, and — for every
+  lock the thread already held — a lock-order edge ``held -> acquired``.
+- :class:`LockTraceRegistry` — the edge graph. :meth:`cycles` reports
+  any cycle in it (two threads taking the same pair of locks in opposite
+  orders is a deadlock waiting for the right interleaving — the classic
+  lock-order-inversion detector, cf. TSan's deadlock detector);
+  :meth:`long_holds` reports acquisitions held past a threshold with the
+  stack that took them (the hot-spot surface).
+- :class:`WorkqueueOracle` — the per-key never-concurrent invariant
+  (client-go workqueue semantics, PR 5): ``enter(ctl, key)`` /
+  ``exit(ctl, key)`` around every reconcile; a second concurrent enter
+  for the same (controller, key) is recorded as a violation with both
+  stacks. The chaos soaks install one and assert it stays empty at
+  ``workers=4``.
+
+The chaos soaks (``chaos/soak.py``) enable tracing, run, and fold
+:func:`report` into their reports; CI's chaos-smoke/shard-smoke stages
+gate on zero cycles, zero leaked threads and a clean oracle.
+
+Timing here is ``time.monotonic()`` on purpose: hold durations are
+host-side diagnostics, not tick-domain state — this module is in
+``utils/`` precisely so KF101's tick-domain rule never sees it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+#: Stack depth kept per acquisition — enough to name the caller chain
+#: without making every acquire O(full stack render).
+_STACK_LIMIT = 12
+
+_enabled = bool(int(os.environ.get("KFTPU_LOCKTRACE", "0") or "0"))
+
+
+def _stack(skip: int = 2) -> List[str]:
+    return [
+        f"{f.filename.rsplit('/', 1)[-1]}:{f.lineno}:{f.name}"
+        for f in traceback.extract_stack(limit=_STACK_LIMIT + skip)[:-skip]
+    ]
+
+
+class LockTraceRegistry:
+    """Process-wide acquisition bookkeeping for traced locks.
+
+    Its own mutex guards only this bookkeeping and is never held while
+    blocking on a traced lock, so the tracer cannot introduce the
+    ordering problems it exists to find."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        # thread ident -> [(lock name, t_acquired)] in acquisition order.
+        self._held: Dict[int, List[Tuple[str, float]]] = {}
+        # (held name, acquired name) -> (count, sample stack).
+        self._edges: Dict[Tuple[str, str], Tuple[int, List[str]]] = {}
+        self._acquisitions: Dict[str, int] = {}
+        # (name, held_s, release stack) past the threshold.
+        self._long_holds: List[Tuple[str, float, List[str]]] = []
+        self.long_hold_threshold_s = 0.5
+
+    # ---------------- wrapper callbacks ----------------
+
+    def note_acquired(self, name: str) -> None:
+        ident = threading.get_ident()
+        now = time.monotonic()
+        stack: Optional[List[str]] = None
+        with self._mu:
+            self._acquisitions[name] = self._acquisitions.get(name, 0) + 1
+            held = self._held.setdefault(ident, [])
+            for prior, _t in held:
+                if prior == name:
+                    continue    # same-named pair: not an ordering edge
+                key = (prior, name)
+                if key not in self._edges:
+                    if stack is None:
+                        stack = _stack(skip=3)
+                    self._edges[key] = (1, stack)
+                else:
+                    n, s = self._edges[key]
+                    self._edges[key] = (n + 1, s)
+            held.append((name, now))
+
+    def note_released(self, name: str) -> None:
+        ident = threading.get_ident()
+        now = time.monotonic()
+        with self._mu:
+            held = self._held.get(ident, [])
+            for i in range(len(held) - 1, -1, -1):
+                if held[i][0] == name:
+                    _n, t0 = held.pop(i)
+                    if now - t0 >= self.long_hold_threshold_s:
+                        self._long_holds.append(
+                            (name, now - t0, _stack(skip=3)))
+                    break
+            if not held:
+                self._held.pop(ident, None)
+
+    # ---------------- reporting ----------------
+
+    def edges(self) -> Dict[Tuple[str, str], int]:
+        with self._mu:
+            return {k: n for k, (n, _s) in self._edges.items()}
+
+    def cycles(self) -> List[List[str]]:
+        """Cycles in the lock-order graph, each as the lock-name path
+        (``[a, b, a]`` = some thread took a then b while another took b
+        then a). Deterministic: nodes visited in sorted order."""
+        with self._mu:
+            adj: Dict[str, List[str]] = {}
+            for (a, b) in self._edges:
+                adj.setdefault(a, []).append(b)
+        for dsts in adj.values():
+            dsts.sort()
+        found: List[List[str]] = []
+        seen_cycles = set()
+        done = set()
+
+        def dfs(node: str, path: List[str], on_path: set) -> None:
+            for nxt in adj.get(node, ()):
+                if nxt in on_path:
+                    cyc = path[path.index(nxt):] + [nxt]
+                    # Canonicalize on the smallest rotation so the same
+                    # cycle found from two entry points dedups.
+                    ring = cyc[:-1]
+                    k = min(tuple(ring[i:] + ring[:i])
+                            for i in range(len(ring)))
+                    if k not in seen_cycles:
+                        seen_cycles.add(k)
+                        found.append(cyc)
+                elif nxt not in done:
+                    on_path.add(nxt)
+                    dfs(nxt, path + [nxt], on_path)
+                    on_path.remove(nxt)
+            done.add(node)
+
+        for start in sorted(adj):
+            if start not in done:
+                dfs(start, [start], {start})
+        return found
+
+    def long_holds(self) -> List[Tuple[str, float, List[str]]]:
+        with self._mu:
+            return list(self._long_holds)
+
+    def acquisitions(self) -> Dict[str, int]:
+        with self._mu:
+            return dict(self._acquisitions)
+
+    def edge_stacks(self) -> Dict[Tuple[str, str], List[str]]:
+        with self._mu:
+            return {k: list(s) for k, (_n, s) in self._edges.items()}
+
+    def reset(self) -> None:
+        with self._mu:
+            self._held.clear()
+            self._edges.clear()
+            self._acquisitions.clear()
+            self._long_holds.clear()
+
+
+_registry = LockTraceRegistry()
+
+
+class TracedLock:
+    """``threading.Lock`` wrapper feeding the trace registry."""
+
+    def __init__(self, name: str,
+                 registry: Optional[LockTraceRegistry] = None):
+        self.name = name
+        self._registry = registry or _registry
+        self._inner = threading.Lock()
+
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._registry.note_acquired(self.name)
+        return got
+
+    def release(self) -> None:
+        self._registry.note_released(self.name)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class TracedRLock:
+    """``threading.RLock`` wrapper. Only the outermost acquire/release
+    of a reentrant hold is traced: inner re-entries cannot change the
+    ordering relation and would self-edge the graph."""
+
+    def __init__(self, name: str,
+                 registry: Optional[LockTraceRegistry] = None):
+        self.name = name
+        self._registry = registry or _registry
+        self._inner = threading.RLock()
+        self._depth: Dict[int, int] = {}
+        # _depth is only ever touched while holding _inner, so it needs
+        # no lock of its own.
+
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            ident = threading.get_ident()
+            d = self._depth.get(ident, 0)
+            self._depth[ident] = d + 1
+            if d == 0:
+                self._registry.note_acquired(self.name)
+        return got
+
+    def release(self) -> None:
+        ident = threading.get_ident()
+        d = self._depth.get(ident, 0) - 1
+        if d <= 0:
+            self._depth.pop(ident, None)
+            self._registry.note_released(self.name)
+        else:
+            self._depth[ident] = d
+        self._inner.release()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+# ---------------- module-level switch + factories ----------------
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(reset: bool = True) -> None:
+    """Turn tracing ON for locks created AFTER this call (the factories
+    consult the flag at construction, keeping the disabled path free)."""
+    global _enabled
+    _enabled = True
+    if reset:
+        _registry.reset()
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def registry() -> LockTraceRegistry:
+    return _registry
+
+
+def lock(name: str):
+    """A mutex for the named role: plain ``threading.Lock`` while
+    tracing is off, a :class:`TracedLock` while it is on."""
+    return TracedLock(name) if _enabled else threading.Lock()
+
+
+def rlock(name: str):
+    return TracedRLock(name) if _enabled else threading.RLock()
+
+
+def report() -> Dict[str, object]:
+    """The soak-end summary the chaos reports embed: cycles (must be
+    empty), long holds, and per-lock acquisition counts."""
+    return {
+        "enabled": _enabled,
+        "cycles": _registry.cycles(),
+        "long_holds": [
+            {"lock": n, "held_s": round(s, 3), "stack": st}
+            for n, s, st in _registry.long_holds()
+        ],
+        "acquisitions": _registry.acquisitions(),
+        "edges": {f"{a}->{b}": n
+                  for (a, b), n in sorted(_registry.edges().items())},
+    }
+
+
+def violations(summary: Dict[str, object]) -> List[str]:
+    """Human-readable problems in a soak-end locktrace summary (the
+    dict :func:`report` returns, optionally extended with
+    ``leaked_threads`` and an ``oracle`` summary by the soak drivers).
+    Empty list = the soak's concurrency invariants held."""
+    out: List[str] = []
+    for cyc in summary.get("cycles", []):     # type: ignore[union-attr]
+        out.append("lock-order cycle: " + " -> ".join(cyc))
+    for name in summary.get("leaked_threads", []):
+        out.append(f"leaked thread/executor: {name}")
+    oracle = summary.get("oracle") or {}
+    for v in oracle.get("violations", []):    # type: ignore[union-attr]
+        out.append(
+            "workqueue double-dispatch: "
+            f"{v.get('controller')} key={v.get('key')} threads "
+            f"{v.get('first_thread')}/{v.get('second_thread')}")
+    return out
+
+
+class WorkqueueOracle:
+    """Verifies the workqueue's per-key never-concurrent invariant: at
+    most one in-flight reconcile per (controller, key), however many
+    workers drain the pool. ``ControllerManager`` calls enter/exit
+    around ``_reconcile_once`` when an oracle is installed."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._inflight: Dict[Tuple[str, Tuple[str, str]],
+                             Tuple[int, List[str]]] = {}
+        self.entries = 0
+        self.violations: List[Dict[str, object]] = []
+
+    def enter(self, controller: str, key: Tuple[str, str]) -> None:
+        ident = threading.get_ident()
+        k = (controller, tuple(key))
+        with self._mu:
+            self.entries += 1
+            prior = self._inflight.get(k)
+            if prior is not None:
+                self.violations.append({
+                    "controller": controller,
+                    "key": list(key),
+                    "first_thread": prior[0],
+                    "first_stack": prior[1],
+                    "second_thread": ident,
+                    "second_stack": _stack(skip=3),
+                })
+            else:
+                self._inflight[k] = (ident, _stack(skip=3))
+
+    def exit(self, controller: str, key: Tuple[str, str]) -> None:
+        k = (controller, tuple(key))
+        with self._mu:
+            ent = self._inflight.get(k)
+            if ent is not None and ent[0] == threading.get_ident():
+                del self._inflight[k]
+
+    def clean(self) -> bool:
+        return not self.violations
+
+    def summary(self) -> Dict[str, object]:
+        with self._mu:
+            return {
+                "entries": self.entries,
+                "violations": list(self.violations),
+                "inflight_now": len(self._inflight),
+            }

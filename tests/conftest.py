@@ -67,6 +67,35 @@ def _clear_jax_caches_per_module():
     gc.collect()
 
 
+@pytest.fixture(autouse=True)
+def _no_leaked_threads(request):
+    """Leaked-thread/executor detector (ISSUE 16): every test that
+    starts a manager, service thread or worker pool must close it.
+
+    A non-daemon thread (ThreadPoolExecutor workers are non-daemon, so
+    this covers leaked executors) that appeared during the test and is
+    still alive after a short grace join fails the test that leaked it
+    — at the leak site, instead of as a suite-teardown hang or a
+    cross-test lock-order artifact in the locktrace soaks."""
+    import threading
+
+    before = {t.ident for t in threading.enumerate()}
+    yield
+    candidates = [
+        t for t in threading.enumerate()
+        if t.ident not in before and t.is_alive() and not t.daemon
+    ]
+    # Grace period: close() paths that were just invoked may still be
+    # joining their workers.
+    for t in candidates:
+        t.join(timeout=2.0)
+    leaked = [t for t in candidates if t.is_alive()]
+    assert not leaked, (
+        "test leaked non-daemon threads: "
+        + ", ".join(sorted(t.name for t in leaked))
+    )
+
+
 @pytest.fixture(scope="session")
 def devices8():
     import jax

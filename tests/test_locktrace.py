@@ -1,0 +1,179 @@
+"""Tests for the runtime lock-order tracer and workqueue oracle
+(ISSUE 16, utils/locktrace.py)."""
+
+import threading
+
+from kubeflow_tpu.utils import locktrace
+from kubeflow_tpu.utils.locktrace import (
+    LockTraceRegistry,
+    TracedLock,
+    TracedRLock,
+    WorkqueueOracle,
+)
+
+
+def _acquire_pair(first, second):
+    with first:
+        with second:
+            pass
+
+
+class TestLockOrderGraph:
+    def test_opposite_order_pair_is_a_cycle(self):
+        reg = LockTraceRegistry()
+        a = TracedLock("a", registry=reg)
+        b = TracedLock("b", registry=reg)
+        # Thread 1 takes a->b, thread 2 takes b->a: the classic
+        # inversion. Sequential execution suffices — the GRAPH has the
+        # cycle even though no deadlock fired this run.
+        _acquire_pair(a, b)
+        t = threading.Thread(target=_acquire_pair, args=(b, a))
+        t.start()
+        t.join()
+        cycles = reg.cycles()
+        assert cycles == [["a", "b", "a"]]
+
+    def test_consistent_order_is_clean(self):
+        reg = LockTraceRegistry()
+        a = TracedLock("a", registry=reg)
+        b = TracedLock("b", registry=reg)
+        for _ in range(3):
+            _acquire_pair(a, b)
+        t = threading.Thread(target=_acquire_pair, args=(a, b))
+        t.start()
+        t.join()
+        assert reg.cycles() == []
+        assert reg.edges() == {("a", "b"): 4}
+        assert reg.acquisitions() == {"a": 4, "b": 4}
+
+    def test_three_lock_cycle_detected(self):
+        reg = LockTraceRegistry()
+        a = TracedLock("a", registry=reg)
+        b = TracedLock("b", registry=reg)
+        c = TracedLock("c", registry=reg)
+        _acquire_pair(a, b)
+        _acquire_pair(b, c)
+        _acquire_pair(c, a)
+        cycles = reg.cycles()
+        assert len(cycles) == 1
+        # Canonicalized: one cycle, not three rotations of it.
+        assert set(cycles[0]) == {"a", "b", "c"}
+
+    def test_rlock_reentry_no_self_edge(self):
+        reg = LockTraceRegistry()
+        r = TracedRLock("r", registry=reg)
+        with r:
+            with r:        # re-entry: must not trace a second acquire
+                pass
+        assert reg.edges() == {}
+        assert reg.acquisitions() == {"r": 1}
+        assert reg.cycles() == []
+
+    def test_long_hold_recorded_with_stack(self):
+        reg = LockTraceRegistry()
+        reg.long_hold_threshold_s = 0.0   # everything is "long"
+        lk = TracedLock("hot", registry=reg)
+        with lk:
+            pass
+        holds = reg.long_holds()
+        assert len(holds) == 1
+        name, held_s, stack = holds[0]
+        assert name == "hot"
+        assert held_s >= 0.0
+        assert stack   # the release stack names the holder
+
+    def test_factories_respect_enable_flag(self):
+        was = locktrace.enabled()
+        try:
+            locktrace.disable()
+            assert isinstance(locktrace.lock("x"),
+                              type(threading.Lock()))
+            locktrace.enable()
+            assert isinstance(locktrace.lock("x"), TracedLock)
+            assert isinstance(locktrace.rlock("x"), TracedRLock)
+        finally:
+            if was:
+                locktrace.enable(reset=False)
+            else:
+                locktrace.disable()
+            locktrace.registry().reset()
+
+
+class TestWorkqueueOracle:
+    def test_bracketed_reconciles_clean(self):
+        o = WorkqueueOracle()
+        for i in range(5):
+            o.enter("tpujob", ("ns", f"j{i}"))
+            o.exit("tpujob", ("ns", f"j{i}"))
+        assert o.clean()
+        s = o.summary()
+        assert s["entries"] == 5
+        assert s["violations"] == []
+        assert s["inflight_now"] == 0
+
+    def test_same_key_different_controllers_ok(self):
+        o = WorkqueueOracle()
+        o.enter("tpujob", ("ns", "j"))
+        o.enter("study", ("ns", "j"))    # distinct queue — fine
+        o.exit("tpujob", ("ns", "j"))
+        o.exit("study", ("ns", "j"))
+        assert o.clean()
+
+    def test_injected_double_dispatch_caught(self):
+        """The fault the oracle exists for: two workers concurrently
+        in-flight on the same (controller, key)."""
+        o = WorkqueueOracle()
+        first_in = threading.Event()
+        release = threading.Event()
+
+        def worker_one():
+            o.enter("tpujob", ("ns", "dup"))
+            first_in.set()
+            release.wait(timeout=5)
+            o.exit("tpujob", ("ns", "dup"))
+
+        t = threading.Thread(target=worker_one)
+        t.start()
+        assert first_in.wait(timeout=5)
+        o.enter("tpujob", ("ns", "dup"))   # second dispatch, same key
+        release.set()
+        t.join()
+        o.exit("tpujob", ("ns", "dup"))
+        assert not o.clean()
+        v = o.summary()["violations"]
+        assert len(v) == 1
+        assert v[0]["controller"] == "tpujob"
+        assert v[0]["key"] == ["ns", "dup"]
+        assert v[0]["first_thread"] != v[0]["second_thread"]
+        assert v[0]["first_stack"] and v[0]["second_stack"]
+
+
+class TestViolationsHelper:
+    def test_clean_summary_empty(self):
+        assert locktrace.violations(
+            {"cycles": [], "leaked_threads": [],
+             "oracle": {"violations": []}}) == []
+
+    def test_each_problem_class_rendered(self):
+        out = locktrace.violations({
+            "cycles": [["a", "b", "a"]],
+            "leaked_threads": ["pool-worker-3"],
+            "oracle": {"violations": [{
+                "controller": "tpujob", "key": ["ns", "j"],
+                "first_thread": 1, "second_thread": 2,
+            }]},
+        })
+        assert len(out) == 3
+        assert any("a -> b -> a" in line for line in out)
+        assert any("pool-worker-3" in line for line in out)
+        assert any("double-dispatch" in line for line in out)
+
+
+class TestReport:
+    def test_report_shape(self):
+        reg = locktrace.registry()
+        reg.reset()
+        rep = locktrace.report()
+        assert set(rep) == {"enabled", "cycles", "long_holds",
+                            "acquisitions", "edges"}
+        assert rep["cycles"] == []

@@ -677,3 +677,28 @@ class TestQueueAgeFooter:
         assert rc == 0
         assert "QUEUE AGE: 1 pending" in out
         assert "p50" in out and "max" in out
+
+
+class TestLint:
+    """`tpuctl lint` forwards onto the static analyzer (ISSUE 16)."""
+
+    def test_lint_clean_package_exits_zero(self, capsys):
+        rc, out = _run(["lint"], capsys)
+        assert rc == 0
+        assert "0 finding(s)" in out
+
+    def test_lint_json_shape(self, capsys):
+        rc, out = _run(["lint", "--json"], capsys)
+        assert rc == 0
+        doc = json.loads(out)
+        assert doc["findings"] == []
+        assert all(f["reason"] for f in doc["suppressed"])
+
+    def test_lint_dirty_path_exits_one(self, tmp_path, capsys):
+        bad = tmp_path / "chaos"
+        bad.mkdir()
+        (bad / "soak.py").write_text(
+            "import time\n\ndef t():\n    return time.time()\n")
+        rc, out = _run(["lint", str(tmp_path)], capsys)
+        assert rc == 1
+        assert "KF101" in out
