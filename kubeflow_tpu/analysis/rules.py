@@ -1,4 +1,4 @@
-"""The five project rules (KF101–KF105).
+"""The six project rules (KF101–KF106).
 
 Each rule encodes an invariant this repo already broke once and fixed
 by hand — the rule is the fix's regression test, generalized. The bug
@@ -35,6 +35,7 @@ TICK_DOMAIN = frozenset({
     "obs/flight.py",
     "obs/slo.py",
     "obs/goodput.py",
+    "obs/remediate.py",
 })
 
 _WALL_TIME_ATTRS = {
@@ -532,6 +533,122 @@ class VacuousGateRule(Rule):
 
 
 # ----------------------------------------------------------------------
+# KF106 — journal-before-mutate in the remediation module
+# ----------------------------------------------------------------------
+
+#: Modules that actuate fleet mutations on behalf of an automated
+#: policy loop. Every mutation leaving one of these must be preceded by
+#: a journal append — the crash-consistency contract the remediate-smoke
+#: replay gate depends on (an action that mutated but never journaled
+#: replays as "never happened": silent divergence).
+REMEDIATION_MODULES = frozenset({
+    "obs/remediate.py",
+})
+
+#: The actuation seams the stock playbooks reach (plus ``action``, the
+#: controller's own dispatch into a playbook). Matched as attribute
+#: calls (``lb.set_backends(..)``, ``pb.action(..)``) and as bare-name
+#: calls (``preempt_slice_group(..)``).
+_SEAM_CALLS = frozenset({
+    "set_backends",          # serving LB drain
+    "kick_timers",           # PR-8 park-path requeue
+    "sweep",                 # ElasticController grow
+    "preempt_slice_group",   # the one eviction seam
+    "kill", "restart",       # sharded-plane respawn
+    "action",                # Playbook dispatch (the journaled path)
+})
+
+
+def _seam_call_name(node: ast.Call) -> Optional[str]:
+    if isinstance(node.func, ast.Attribute):
+        name = node.func.attr
+    elif isinstance(node.func, ast.Name):
+        name = node.func.id
+    else:
+        return None
+    return name if name in _SEAM_CALLS else None
+
+
+class JournalBeforeMutateRule(Rule):
+    """KF106: a mutation leaving the remediation module must land in
+    the action journal FIRST.
+
+    In :data:`REMEDIATION_MODULES`, every call to a mutating seam
+    (:data:`_SEAM_CALLS`) must either
+
+    (a) follow a ``*journal*`` call in the same function (the
+        controller's ``_journal_rec(rec)`` -> ``pb.action(rec)``
+        ordering), or
+    (b) sit in a closure that a ``Playbook(...)`` constructor binds as
+        ``action=`` — the controller journals before dispatching into
+        it, so the factory closures are covered by (a) one frame up.
+
+    A seam call in a ``precheck=`` closure is flagged: prechecks are
+    READ-ONLY feasibility probes and run before anything is journaled.
+    """
+
+    ID = "KF106"
+    TITLE = "remediation mutation without a preceding journal write"
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if module.relpath not in REMEDIATION_MODULES:
+            return
+        # The names Playbook(...) binds as action= closures anywhere in
+        # the module — those run strictly after the journal write.
+        action_bound: set = set()
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "Playbook"):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "action" and isinstance(kw.value, ast.Name):
+                    action_bound.add(kw.value.id)
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield from self._check_fn(fn, action_bound, module)
+
+    def _check_fn(self, fn: ast.AST, action_bound: set,
+                  module: Module) -> Iterable[Finding]:
+        # Only this function's OWN statements: nested functions are
+        # their own frames (their seam calls don't execute when the
+        # outer factory body does, and vice versa).
+        own_calls: List[ast.Call] = []
+        stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(node, ast.Call):
+                own_calls.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        first_journal: Optional[int] = None
+        for call in own_calls:
+            if isinstance(call.func, ast.Attribute) \
+                    and "journal" in call.func.attr \
+                    and (first_journal is None
+                         or call.lineno < first_journal):
+                first_journal = call.lineno
+        for call in sorted(own_calls, key=lambda c: c.lineno):
+            name = _seam_call_name(call)
+            if name is None:
+                continue
+            if first_journal is not None and first_journal < call.lineno:
+                continue
+            if getattr(fn, "name", "") in action_bound:
+                continue
+            yield Finding(
+                rule=self.ID, path=module.path,
+                line=call.lineno, col=call.col_offset,
+                message=f"mutating seam call {name}() without a "
+                        "preceding journal write — journal the action "
+                        "record first (or bind the closure as a "
+                        "Playbook action so the controller does)",
+            )
+
+
+# ----------------------------------------------------------------------
 # registry
 # ----------------------------------------------------------------------
 
@@ -541,6 +658,7 @@ RULES: Dict[str, type] = {
     "KF103": MetricHygieneRule,
     "KF104": ReadAliasingRule,
     "KF105": VacuousGateRule,
+    "KF106": JournalBeforeMutateRule,
 }
 
 
@@ -560,4 +678,5 @@ def all_rules(root: str = "",
         MetricHygieneRule(docs_inventory),
         ReadAliasingRule(),
         VacuousGateRule(),
+        JournalBeforeMutateRule(),
     ]

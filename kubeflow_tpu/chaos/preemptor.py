@@ -152,6 +152,10 @@ class ShardPreemptor:
         # Alert journal replay (ISSUE 15): True while every killed
         # shard's SLO engine came back byte-identical from alerts.jsonl.
         self.alerts_replay_identical = True
+        # Action journal replay (ISSUE 17): True while every killed
+        # shard's remediation controller came back byte-identical from
+        # actions.jsonl (pending verdicts re-armed at original dues).
+        self.actions_replay_identical = True
         self.metrics_kills = registry.counter(
             "kftpu_chaos_shard_kills_total",
             "Whole-shard process kills injected",
@@ -163,6 +167,10 @@ class ShardPreemptor:
 
     def _slo_fp(self, shard_id: int):
         fp = getattr(self.plane, "shard_slo_fingerprint", None)
+        return fp(shard_id) if fp is not None else None
+
+    def _remediation_fp(self, shard_id: int):
+        fp = getattr(self.plane, "shard_remediation_fingerprint", None)
         return fp(shard_id) if fp is not None else None
 
     def kill_random(self, *, restart: bool = True) -> Optional[int]:
@@ -180,6 +188,7 @@ class ShardPreemptor:
         pre = self.plane.shard_fingerprint(victim)
         pre_goodput = self._goodput_fp(victim)
         pre_slo = self._slo_fp(victim)
+        pre_actions = self._remediation_fp(victim)
         self.plane.kill(victim)
         self.kills += 1
         self.metrics_kills.inc()
@@ -203,6 +212,13 @@ class ShardPreemptor:
                 self.alerts_replay_identical = False
                 log.error("alert journal replay diverged", kv={
                     "shard": victim, "pre": pre_slo, "post": post_slo,
+                })
+            post_actions = self._remediation_fp(victim)
+            if pre_actions is not None and post_actions != pre_actions:
+                self.actions_replay_identical = False
+                log.error("action journal replay diverged", kv={
+                    "shard": victim, "pre": pre_actions,
+                    "post": post_actions,
                 })
         log.warning("shard preempted", kv={"shard": victim,
                                            "restarted": restart})

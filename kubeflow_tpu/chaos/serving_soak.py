@@ -53,6 +53,11 @@ class _SoakBackend:
         self.max_queue = max_queue
         self.excluded = False
         self.reported_queued = 0      # what /healthz claims is queued
+        # Gray failure (ISSUE 17): slow-but-alive. A sick replica keeps
+        # answering probes OK — the binary health check cannot catch it
+        # — but reports a degraded p50 queue wait, the TTFT proxy the
+        # SLO engine watches and the drain playbook remediates.
+        self.sick = False
         self.requests = 0
         self.misrouted = 0
         # Sessions this stub has served: reported as resident_prefixes
@@ -91,7 +96,7 @@ class _SoakBackend:
             "queued": self.reported_queued,
             "free_slots": 0,
             "max_queue": self.max_queue,
-            "p50_queue_wait_s": 0.05,
+            "p50_queue_wait_s": 5.0 if self.sick else 0.05,
             "resident_prefixes": resident,
         }}
 
@@ -117,6 +122,13 @@ class ServingSoakReport:
     # affinity map and resident-prefix hints chase a churning fleet.
     affinity_hits: int = 0
     affinity_rerouted: int = 0
+    # Gray-failure remediation (ISSUE 17): sick injections, the SLO
+    # engine's verdict on the backend-queue-wait objective, and the
+    # remediation controller's scoreboard. Empty unless the soak runs
+    # with ``sick=True`` / ``remediate=True``.
+    sicks: int = 0
+    slo: Dict[str, object] = dataclasses.field(default_factory=dict)
+    remediation: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     @property
     def accounting_ok(self) -> bool:
@@ -137,17 +149,122 @@ def run_serving_soak(
     rounds: int = 10,
     requests_per_round: int = 6,
     seed: int = 20260803,
+    sick: bool = False,            # ISSUE 17: inject gray failures
+    remediate: bool = False,       # ISSUE 17: SLO-paged auto-drain
+    state_dir: str = "",           # actions.jsonl / flight dumps home
 ) -> ServingSoakReport:
     """Seeded drain/flap/saturation soak against a live LB + stub fleet.
     Deterministic in its action SCHEDULE (the RNG); request interleaving
-    within a burst is free — the invariants asserted don't depend on it."""
+    within a burst is free — the invariants asserted don't depend on it.
+
+    ``sick=True`` adds the gray-failure action to the schedule (off by
+    default so existing seeds keep their exact action sequence): a
+    replica that answers probes but reports a degraded p50 queue wait.
+    ``remediate=True`` wires the closed loop — a per-backend
+    ``backend-queue-wait`` SLO over the reported wait, paged series
+    remediated by the drain-backend playbook, verdicts settled against
+    a quiet tail after the traffic rounds end."""
     rng = random.Random(seed)
     fleet = [_SoakBackend(f"b{i}") for i in range(backends)]
     all_addrs = [b.addr for b in fleet]
-    lb = ServingLoadBalancer(list(all_addrs), retry_after_s=1.0)
+    from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+    registry = MetricsRegistry()
+    lb = ServingLoadBalancer(list(all_addrs), retry_after_s=1.0,
+                             registry=registry)
     front = JsonHttpServer(lb.router(), port=0).start()
     url = f"http://127.0.0.1:{front.port}/v1/generate"
     rep = ServingSoakReport()
+
+    engine = None
+    remediation = None
+    wait_gauge = None
+    soak_tick = 0
+    if remediate:
+        import os
+
+        from kubeflow_tpu.obs.flight import FlightRecorder
+        from kubeflow_tpu.obs.remediate import (
+            ACTIONS_JOURNAL,
+            RemediationController,
+            drain_backend_playbook,
+            remediation_objective,
+        )
+        from kubeflow_tpu.obs.slo import (
+            ALERTS_JOURNAL,
+            Objective,
+            SLOEngine,
+            TICK_WINDOWS,
+        )
+
+        wait_gauge = registry.gauge(
+            "kftpu_serving_backend_queue_wait_seconds",
+            "Per-backend p50 queue wait from the last load report "
+            "(0 while the backend is out of the dispatch set)",
+            labels=("backend",),
+        )
+        recorder = FlightRecorder(registry=registry,
+                                  now_fn=lambda: soak_tick)
+        engine = SLOEngine(
+            registry,
+            objectives=[
+                Objective(
+                    name="backend-queue-wait",
+                    description="per-backend p50 queue wait (the TTFT "
+                                "proxy a gray-failed replica degrades)",
+                    gauge="kftpu_serving_backend_queue_wait_seconds",
+                    group_by="backend",
+                    max_value=1.0,
+                    slo=0.90, page_burn=1.5, warn_burn=1.0,
+                    windows=TICK_WINDOWS, clear_after=2,
+                ),
+                remediation_objective(),
+            ],
+            journal_path=(os.path.join(state_dir, ALERTS_JOURNAL)
+                          if state_dir else ""),
+            recorder=recorder,
+            dump_dir=state_dir,
+        )
+        remediation = RemediationController(
+            registry,
+            engine=engine,
+            # verify_after must outlast the burn-window decay: a bad
+            # sample stays inside fast_long (6 ticks) after the drain,
+            # plus clear_after quiet evals — verdicts read before ~9
+            # ticks would call a working drain unpaid.
+            playbooks=[drain_backend_playbook(
+                lb, budget=2, cooldown=4.0, verify_after=10.0)],
+            journal_path=(os.path.join(state_dir, ACTIONS_JOURNAL)
+                          if state_dir else ""),
+            recorder=recorder,
+            dump_dir=state_dir,
+            # The serving soak runs no goodput ledger; an action "pays"
+            # iff the page cleared by verify time.
+            cost_fn=lambda: 0.0,
+        )
+
+    seen_addrs: set = set()
+
+    def observe_and_remediate() -> None:
+        """One SLO tick: gauge in the fleet's reported queue waits
+        (0 for replicas out of the dispatch set, so a drained series
+        clears), evaluate, and let the controller act."""
+        nonlocal soak_tick
+        if engine is None:
+            return
+        soak_tick += 1
+        snap = {b["addr"]: b for b in lb.backends()}
+        seen_addrs.update(snap)
+        for addr in seen_addrs:
+            b = snap.get(addr)
+            in_set = b is not None and b["healthy"] and not b["draining"]
+            # A fully-drained backend leaves lb.backends() entirely —
+            # zero its series explicitly or the page it caused would
+            # never clear.
+            wait_gauge.set(b["p50_queue_wait_s"] if in_set else 0.0,
+                           backend=addr)
+        fired = engine.evaluate(soak_tick)
+        remediation.tick(soak_tick, fired=fired)
 
     def fire(results: List[tuple], session: str):
         try:
@@ -179,11 +296,20 @@ def run_serving_soak(
 
     drained: List[str] = []
     saturated = False
+    # "sick" joins the schedule only when asked: existing seeds keep
+    # their exact rng.choice sequence.
+    action_pool = ["flap", "drain", "saturate", "heal", "restore"]
+    if sick:
+        action_pool.append("sick")
     try:
         for rnd in range(rounds):
-            action = rng.choice(
-                ["flap", "drain", "saturate", "heal", "restore"])
-            if action == "flap":
+            action = rng.choice(action_pool)
+            if action == "sick":
+                healthy = [b for b in fleet if not b.sick]
+                if len(healthy) > 1:
+                    healthy[rng.randrange(len(healthy))].sick = True
+                    rep.sicks += 1
+            elif action == "flap":
                 live = [b["addr"] for b in lb.backends()
                         if b["healthy"] and not b["draining"]]
                 if len(live) > 1:
@@ -207,6 +333,7 @@ def run_serving_soak(
             elif action == "heal":
                 for b in fleet:
                     b.reported_queued = 0
+                    b.sick = False     # gray failures heal too
                 saturated = False
                 # health_check below re-probes flapped backends (their
                 # stubs still answer /healthz) and ingests load reports.
@@ -225,6 +352,11 @@ def run_serving_soak(
                 for addr in down:
                     lb.set_backend_health(addr, False,
                                           "chaos: still flapped")
+            # Remediate BEFORE the exclusion stamp + burst: a drain the
+            # controller just issued must be reflected in the stubs'
+            # excluded flags, or this round's traffic would miscount a
+            # correct remediation as a misroute.
+            observe_and_remediate()
             sync_excluded()
 
             results: List[tuple] = []
@@ -253,6 +385,21 @@ def run_serving_soak(
             log.info("soak round", kv={
                 "round": rnd, "action": action, "ok": rep.ok,
                 "shed": rep.shed, "saturated": saturated})
+        if engine is not None:
+            # Quiet tail: cure the injected gray failures (the fault
+            # window ends; what remains is the remediation's own state),
+            # then keep evaluating until every page clears and every
+            # action's verdict lands — the closed-loop gate is
+            # page -> act -> CLEAR, without an operator call.
+            for b in fleet:
+                b.sick = False
+                b.reported_queued = 0
+            lb.health_check()
+            for _ in range(24):
+                observe_and_remediate()
+                if (not engine.any_paging()
+                        and not remediation.snapshot()["pending"]):
+                    break
     finally:
         front.stop()
         for b in fleet:
@@ -260,6 +407,16 @@ def run_serving_soak(
     rep.misrouted = sum(b.misrouted for b in fleet)
     rep.affinity_hits = lb.affinity_hits
     rep.affinity_rerouted = lb.affinity_rerouted
+    if engine is not None:
+        rep.slo = {
+            "pages": engine.pages_by_objective(),
+            "transitions": engine.transitions_total(),
+            "paging": sorted(k for k, v in engine.states().items()
+                             if v == "page"),
+        }
+        rep.remediation = remediation.snapshot()
+        remediation.close()
+        engine.close()
     return rep
 
 

@@ -75,6 +75,12 @@ class SoakReport:
     # ``locktrace_check=True`` (the soak RAISES on violations — this
     # field is the evidence trail for the clean case).
     locktrace: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # Self-healing remediation (ISSUE 17): the controller's scoreboard
+    # — per-playbook action/verdict counts, disables, fingerprint —
+    # when the soak ran with ``remediate=True``. The CI remediate-smoke
+    # stage gates this both ways (clean soak: zero actions; fault soak:
+    # page -> journaled action -> clear, every action verdicted).
+    remediation: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     def stuck_jobs(self) -> Dict[str, str]:
         return {n: p for n, p in self.phases.items() if p not in TERMINAL}
@@ -111,6 +117,12 @@ def run_soak(
     # thread/executor, or per-key double-dispatch. Off by default —
     # seeded tier-1 runs stay byte-identical to the untraced seeds.
     locktrace_check: bool = False,
+    # ISSUE 17: close the loop — a RemediationController rides the SLO
+    # engine and answers pages through the park-path/redrive seams,
+    # journaling to actions.jsonl (under state_dir) before each apply.
+    # Off by default: remediation actions change timer scheduling, so
+    # existing seed contracts stay byte-identical.
+    remediate: bool = False,
 ) -> SoakReport:
     import threading as _threading
 
@@ -209,9 +221,16 @@ def run_soak(
     recorder = FlightRecorder(registry=registry,
                               now_fn=lambda: slo_tick["now"])
     recorder.attach(inner)
+    objectives = soak_objectives(goodput_acc)
+    if remediate:
+        from kubeflow_tpu.obs.remediate import remediation_objective
+
+        # The watchdog-on-the-watchdog: a disabled playbook pages
+        # remediation-disabled through the same FSM it serves.
+        objectives = objectives + [remediation_objective()]
     slo_engine = SLOEngine(
         registry,
-        objectives=soak_objectives(goodput_acc),
+        objectives=objectives,
         journal_path=(os.path.join(state_dir, ALERTS_JOURNAL)
                       if state_dir else ""),
         recorder=recorder,
@@ -223,6 +242,48 @@ def run_soak(
             lambda: goodput_acc.conservation()["exact"])
     if state_dir:
         os.makedirs(state_dir, exist_ok=True)
+    remediation = None
+    if remediate:
+        from kubeflow_tpu.obs.remediate import (
+            ACTIONS_JOURNAL,
+            Playbook,
+            RemediationController,
+            requeue_playbook,
+        )
+
+        # An interruption burst parks gangs on capacity backoff; the
+        # remediation is the PR-8 park path itself: fire the parked
+        # requeue timers so admission retries THIS tick. A lagging
+        # watch pipeline gets one extra bounded drain pass — the
+        # in-process analogue of restarting the informer (the sharded
+        # soak's shards respawn instead; see respawn_shard_playbook).
+        def _redrive(rec: dict) -> dict:
+            n = mgr.run_until_idle(max_iterations=50000,
+                                   include_timers_within=fault_window)
+            return {"reconciles": int(n)}
+
+        # Cadence: cooldown/verify windows sized to the tick-scaled SLO
+        # windows — a page needs ``clear_after`` quiet evaluations to
+        # clear, so a verify window shorter than fault+clear reads every
+        # action as unpaid and auto-disables a playbook that was
+        # actually working.
+        remediation = RemediationController(
+            registry,
+            engine=slo_engine,
+            playbooks=(
+                requeue_playbook(mgr, budget=3, cooldown=4.0,
+                                 verify_after=4.0),
+                Playbook(name="redrive-watch",
+                         objective="watch-delivery-lag",
+                         action=_redrive, budget=3, cooldown=4.0,
+                         verify_after=4.0),
+            ),
+            journal_path=(os.path.join(state_dir, ACTIONS_JOURNAL)
+                          if state_dir else ""),
+            recorder=recorder,
+            dump_dir=state_dir,
+            accountant=goodput_acc,
+        )
     prober = AvailabilityProber({}, registry, interval_s=1e9)
     prober.add_target("tpujob-controller",
                       controller_target(mgr, job_ctl), registry)
@@ -291,10 +352,23 @@ def run_soak(
         slo_tick["now"] = rounds
         recorder.pump()
         recorder.record_metric_deltas()
-        slo_engine.evaluate(rounds)
+        fired = slo_engine.evaluate(rounds)
+        if remediation is not None:
+            # The closed loop (ISSUE 17): pages fired this round map to
+            # budgeted, journaled playbook actions — same tick clock.
+            # An action that enqueued work (kicked park timers) is
+            # drained in-round, so the convergence check never reads a
+            # queue the remediation itself just filled.
+            if remediation.tick(rounds, fired=fired):
+                mgr.run_until_idle(max_iterations=50000,
+                                   include_timers_within=window)
         phases = {j.metadata.name: j.status.phase
                   for j in inner.list("TpuJob", copy=False)}
-        if not chaos.enabled and all(p in TERMINAL for p in phases.values()):
+        if not chaos.enabled and all(p in TERMINAL for p in phases.values()) \
+                and (remediation is None or not slo_engine.any_paging()):
+            # With remediation on, run the FSM to quiescence too: the
+            # closed-loop gate is page -> act -> CLEAR, not page ->
+            # act -> report-while-still-paging.
             break
 
     phases = {j.metadata.name: j.status.phase
@@ -305,6 +379,16 @@ def run_soak(
         if name.endswith("_retries_total")
     )
     availability = 1.0 if prober.probe() else 0.0
+    if remediation is not None:
+        # Settle still-open verify windows against the final alert
+        # state (verdicts only — no new actions): every journaled
+        # action leaves the soak with a journaled goodput verdict.
+        settle_t = float(rounds)
+        for _ in range(100):
+            if not remediation.snapshot()["pending"]:
+                break
+            settle_t += 1.0
+            remediation.tick(settle_t, act=False)
     mgr.close()     # release the soak's watch queues (throwaway manager)
     report = SoakReport(
         converged=converged,
@@ -327,9 +411,13 @@ def run_soak(
         goodput=goodput_acc.snapshot() if goodput_acc is not None else {},
         slo=slo_engine.snapshot(),
         flight_dumps=list(recorder.dumps),
+        remediation=(remediation.snapshot()
+                     if remediation is not None else {}),
     )
     slo_engine.close()
     recorder.detach()
+    if remediation is not None:
+        remediation.close()
     if goodput_acc is not None:
         goodput_acc.close()
     if locktrace_check:
@@ -633,6 +721,10 @@ class ShardedSoakReport:
     # ``locktrace_check=True``.
     locktrace: Dict[int, Dict[str, object]] = dataclasses.field(
         default_factory=dict)
+    # Remediation (ISSUE 17): per-shard controller scoreboards unioned,
+    # plus the actions.jsonl replay gate across the shard SIGKILL.
+    actions_replay_identical: bool = True
+    remediation: Dict[str, object] = dataclasses.field(default_factory=dict)
 
 
 def run_sharded_soak(
@@ -651,6 +743,7 @@ def run_sharded_soak(
     slice_type: str = "v5e-16",
     state_dir: str = "",             # "" = private temp dir (WAL home)
     locktrace_check: bool = False,   # ISSUE 16: per-shard lock tracing
+    remediate: bool = False,         # ISSUE 17: per-shard remediation
 ) -> ShardedSoakReport:
     """The chaos soak, horizontally sharded (ISSUE 6): the fleet is routed
     across ``shards`` shard processes, every shard injects seeded
@@ -700,7 +793,7 @@ def run_sharded_soak(
         shards, workers=workers, state_dir=state_dir, seed=seed,
         conflict_rate=conflict_rate, transient_rate=transient_rate,
         work_ticks=work_ticks, capacity_by_shard=capacity_by_shard,
-        locktrace=locktrace_check,
+        locktrace=locktrace_check, remediate=remediate,
     )
     shard_killer = ShardPreemptor(cp, seed=seed + 11)
     slice_preemptions = 0
@@ -733,6 +826,10 @@ def run_sharded_soak(
                 injected[k] = injected.get(k, 0) + v
         goodput_union = cp.goodput_union() or {}
         slo_union = cp.slo_union()
+        # Settle outstanding verdicts first so every journaled action
+        # carries a journaled goodput verdict in the report.
+        remediation_union = (cp.remediation_union(settle=True)
+                             if remediate else {})
         counts, signature = cp.fingerprint()
         phases = dict(counts.get("TpuJob", {}))
         converged = sum(phases.values()) == num_jobs and all(
@@ -765,6 +862,8 @@ def run_sharded_soak(
         slo=slo_union,
         flight_dumps=slo_union.get("flight_dumps", []),
         locktrace=lt_by_shard,
+        actions_replay_identical=shard_killer.actions_replay_identical,
+        remediation=remediation_union,
     )
     if locktrace_check:
         problems = [

@@ -101,6 +101,7 @@ class Platform:
         self.goodput = None      # GoodputAccountant when capacity is known
         self.slo = None          # SLOEngine (ISSUE 15)
         self.flight = None       # FlightRecorder (ISSUE 15)
+        self.remediate = None    # RemediationController (ISSUE 17)
         self.jwa = None          # NotebookWebApp when enabled
         self.dashboard = None    # DashboardApi when enabled
         self.prober = None       # AvailabilityProber when enabled
@@ -275,8 +276,15 @@ class Platform:
             # flight dumps live under the state dir when one is known
             # (the tpuctl load path).
             from kubeflow_tpu.obs.flight import FlightRecorder
+            from kubeflow_tpu.obs.remediate import (
+                ACTIONS_JOURNAL,
+                RemediationController,
+                remediation_objective,
+                requeue_playbook,
+            )
             from kubeflow_tpu.obs.slo import (
                 ALERTS_JOURNAL,
+                DEFAULT_WINDOWS,
                 SLOEngine,
                 default_objectives,
             )
@@ -286,7 +294,9 @@ class Platform:
             self.flight.attach(self.api)
             self.slo = SLOEngine(
                 reg,
-                objectives=default_objectives(goodput=self.goodput),
+                objectives=default_objectives(goodput=self.goodput)
+                + [remediation_objective(windows=DEFAULT_WINDOWS,
+                                         clear_after=3)],
                 recorder=self.flight,
                 dump_dir=self._state_dir,
             )
@@ -295,13 +305,30 @@ class Platform:
                 self.slo.add_guard(
                     "goodput-conservation",
                     lambda: acc.conservation()["exact"])
+            # Remediation controller (ISSUE 17): closes the loop from
+            # SLO page to a budgeted, journaled action. The live
+            # platform's one in-process seam is the park-path requeue;
+            # cadences are real seconds to match DEFAULT_WINDOWS burn
+            # decay. Operators inspect/override via `tpuctl remediate`.
+            self.remediate = RemediationController(
+                reg,
+                engine=self.slo,
+                playbooks=[requeue_playbook(
+                    self.manager, budget=3, cooldown=60.0,
+                    verify_after=300.0)],
+                recorder=self.flight,
+                dump_dir=self._state_dir,
+                accountant=self.goodput,
+            )
             if self._state_dir:
-                # The dir may not exist yet (first apply): the journal
-                # appends lazily, but its directory must be there
+                # The dir may not exist yet (first apply): the journals
+                # append lazily, but their directory must be there
                 # before the first alert fires, not first save().
                 os.makedirs(self._state_dir, exist_ok=True)
                 self.slo.set_journal(
                     os.path.join(self._state_dir, ALERTS_JOURNAL))
+                self.remediate.set_journal(
+                    os.path.join(self._state_dir, ACTIONS_JOURNAL))
         elif name == "studyjob-controller":
             self.manager.register(StudyJobController(self.api, reg))
         elif name == "notebook-controller":
@@ -463,7 +490,14 @@ class Platform:
             self.flight.pump()
             self.flight.record_metric_deltas()
         if self.slo is not None:
-            self.slo.evaluate(time.monotonic())
+            fired = self.slo.evaluate(time.monotonic())
+            if self.remediate is not None and self.remediate.tick(
+                    time.monotonic(), fired=fired):
+                # An action ran (requeue fills the workqueue): drain it
+                # in THIS pass so the remediation's effect is visible to
+                # the caller's convergence checks, not the next one's.
+                n += self.manager.run_until_idle(
+                    include_timers_within=0.2)
         return n
 
     def substrate_spec(self, name: str):

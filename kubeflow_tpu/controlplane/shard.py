@@ -152,6 +152,11 @@ class ShardSpec:
     # install the workqueue oracle; the parent collects each shard's
     # lock-order graph + oracle verdict via the "locktrace" command.
     locktrace: bool = False
+    # ISSUE 17: per-shard remediation controller next to the SLO engine.
+    # Off by default so existing sharded soaks keep their seed contracts
+    # — a paging objective with remediation on fires requeue actions
+    # that change timer scheduling.
+    remediate: bool = False
 
 
 class ShardSingleton:
@@ -331,9 +336,16 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
                                   tracer=tracer, registry=registry,
                                   now_fn=lambda: goodput_tick)
         recorder.attach(api)
+        objectives = soak_objectives(goodput_acc)
+        if spec.remediate:
+            # ISSUE 17: watch the remediation controller's own disable
+            # gauge, so an auto-disabled playbook pages like any SLO.
+            from kubeflow_tpu.obs.remediate import remediation_objective
+
+            objectives = objectives + [remediation_objective()]
         slo_engine = SLOEngine(
             registry,
-            objectives=soak_objectives(goodput_acc),
+            objectives=objectives,
             journal_path=(os.path.join(sdir, ALERTS_JOURNAL)
                           if sdir else ""),
             fsync=spec.wal_fsync,
@@ -350,6 +362,40 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
             recorder.record("respawn", {"shard": spec.shard_id,
                                         "wal_replayed": wal_replayed})
             recorder.dump(sdir, reason="shard-respawn")
+
+    # Per-shard remediation controller (ISSUE 17): subscribes to the
+    # shard's own SLO engine and acts through the shard's own seams
+    # (its manager's park-path timers). The action journal lives under
+    # the shard dir with WAL fsync discipline — a SIGKILLed shard
+    # replays actions.jsonl byte-identically (pending verdicts re-arm
+    # at their original due ticks), gated by remediate-smoke.
+    remediation = None
+    if spec.capacity and spec.remediate and slo_engine is not None:
+        from kubeflow_tpu.obs.remediate import (
+            ACTIONS_JOURNAL,
+            RemediationController,
+            requeue_playbook,
+        )
+
+        act_journal = (os.path.join(sdir, ACTIONS_JOURNAL) if sdir else "")
+        remediation = RemediationController(
+            registry,
+            engine=slo_engine,
+            playbooks=[
+                # Same cadence as the serial soak wiring: the verify
+                # window must cover fault + clear_after quiet evals, or
+                # a working playbook reads as unpaid and auto-disables.
+                requeue_playbook(mgr, budget=3, cooldown=4.0,
+                                 verify_after=4.0),
+            ],
+            journal_path=act_journal,
+            fsync=spec.wal_fsync,
+            recorder=recorder,
+            dump_dir=sdir,
+            accountant=goodput_acc,
+        )
+        if act_journal and os.path.exists(act_journal):
+            remediation.replay_from(act_journal)
 
     class _Singleton(Controller):
         NAME = ShardSingleton.NAME
@@ -425,7 +471,15 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
             if slo_engine is not None:
                 recorder.pump()
                 recorder.record_metric_deltas()
-                slo_engine.evaluate(goodput_tick)
+                fired = slo_engine.evaluate(goodput_tick)
+                if remediation is not None and remediation.tick(
+                        goodput_tick, fired=fired):
+                    # An action ran (requeue fills the workqueue):
+                    # drain again so this round's terminal/phase report
+                    # reflects the remediated state, not the backlog
+                    # the remediation just created.
+                    n += mgr.run_until_idle(max_iterations=500000,
+                                            include_timers_within=window)
             if spec.state_dir:
                 # Spans (reconciles, ledger round-trips) land in the
                 # shard's trace file so shard-aware `tpuctl trace` can
@@ -511,6 +565,24 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
                 "transitions": slo_engine.transitions_total(),
                 "flight_dumps": list(recorder.dumps),
             }
+        if cmd == "remediate":
+            if remediation is None:
+                return None
+            if len(msg) > 1 and msg[1] == "settle":
+                # Drain outstanding verdicts (advancing a settle-local
+                # clock, never touching goodput_tick) so every journaled
+                # action carries a journaled verdict before the parent
+                # reads the scoreboard — the soak's end-of-run contract.
+                t = float(goodput_tick)
+                for _ in range(100):
+                    if not remediation.snapshot()["pending"]:
+                        break
+                    t += 1.0
+                    remediation.tick(t, act=False)
+            return {
+                "fingerprint": remediation.fingerprint(),
+                "snapshot": remediation.snapshot(),
+            }
         if cmd == "locktrace":
             if not spec.locktrace:
                 return None
@@ -553,6 +625,8 @@ def _shard_worker(conn, spec: ShardSpec) -> None:
         mgr.close()
         if ledger_service is not None:
             ledger_service.stop()
+        if remediation is not None:
+            remediation.close()
         if slo_engine is not None:
             slo_engine.close()
         if recorder is not None:
@@ -598,6 +672,7 @@ class ShardedControlPlane:
         wal_fsync: bool = True,
         start_method: str = "fork",
         locktrace: bool = False,
+        remediate: bool = False,
     ):
         self.router = ShardRouter(num_shards)
         self.num_shards = int(num_shards)
@@ -605,7 +680,7 @@ class ShardedControlPlane:
             workers=workers, rtt_us=rtt_us, state_dir=state_dir, seed=seed,
             conflict_rate=conflict_rate, transient_rate=transient_rate,
             work_ticks=work_ticks, wal_fsync=wal_fsync,
-            locktrace=locktrace,
+            locktrace=locktrace, remediate=remediate,
         )
         self._capacity_by_shard = dict(capacity_by_shard or {})
         if start_method not in multiprocessing.get_all_start_methods():
@@ -876,6 +951,49 @@ class ShardedControlPlane:
     def shard_slo_fingerprint(self, shard_id: int) -> Optional[str]:
         payload = self.shard_slo(shard_id)
         return payload["fingerprint"] if payload else None
+
+    def shard_remediation(self, shard_id: int,
+                          settle: bool = False) -> Optional[Dict[str, Any]]:
+        """One shard's remediation payload (action-journal fingerprint +
+        scoreboard snapshot); None when the shard runs no controller.
+        ``settle=True`` first drains outstanding verdicts so every
+        journaled action carries a journaled verdict."""
+        if settle:
+            return self._call(shard_id, "remediate", "settle")
+        return self._call(shard_id, "remediate")
+
+    def shard_remediation_fingerprint(self, shard_id: int) -> Optional[str]:
+        payload = self.shard_remediation(shard_id)
+        return payload["fingerprint"] if payload else None
+
+    def remediation_union(self, settle: bool = False) -> Dict[str, Any]:
+        """Every live shard's remediation scoreboard folded into one
+        view: actions/verdicts summed per playbook, disabled playbooks
+        unioned, pending counted fleet-wide."""
+        playbooks: Dict[str, Dict[str, Any]] = {}
+        actions = 0
+        pending = 0
+        disabled: List[str] = []
+        msg = ("remediate", "settle") if settle else ("remediate",)
+        for shard_id, payload in sorted(self._broadcast(*msg).items()):
+            if payload is None:
+                continue
+            snap = payload["snapshot"]
+            actions += snap["actions"]
+            pending += snap["pending"]
+            for name in snap["disabled"]:
+                if name not in disabled:
+                    disabled.append(name)
+            for name, row in snap["playbooks"].items():
+                agg = playbooks.setdefault(
+                    name, {"actions": 0, "paid": 0, "unpaid": 0,
+                           "disabled": False})
+                agg["actions"] += row["actions"]
+                agg["paid"] += row["paid"]
+                agg["unpaid"] += row["unpaid"]
+                agg["disabled"] = agg["disabled"] or bool(row["disabled"])
+        return {"playbooks": playbooks, "actions_total": actions,
+                "pending": pending, "disabled": sorted(disabled)}
 
     def slo_union(self) -> Dict[str, Any]:
         """Every live shard's alert state folded into one view: pages

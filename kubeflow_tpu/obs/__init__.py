@@ -6,6 +6,12 @@ top of it (ISSUE 15): the SLO engine with burn-rate alerting
 (`obs/slo.py`) and the crash-dump flight recorder (`obs/flight.py`)."""
 
 from kubeflow_tpu.obs.flight import FlightRecorder, flight_paths, stitch
+from kubeflow_tpu.obs.remediate import (
+    ACTIONS_JOURNAL,
+    Playbook,
+    RemediationController,
+    remediation_objective,
+)
 from kubeflow_tpu.obs.goodput import (
     CATEGORIES,
     GoodputAccountant,
@@ -24,12 +30,15 @@ from kubeflow_tpu.obs.slo import (
 )
 
 __all__ = [
+    "ACTIONS_JOURNAL",
     "ALERTS_JOURNAL",
     "CATEGORIES",
     "DEFAULT_WINDOWS",
     "FlightRecorder",
     "GoodputAccountant",
     "Objective",
+    "Playbook",
+    "RemediationController",
     "SLOEngine",
     "TICK_WINDOWS",
     "Windows",
@@ -37,6 +46,7 @@ __all__ = [
     "default_objectives",
     "flight_paths",
     "goodput_rows_digest",
+    "remediation_objective",
     "soak_objectives",
     "stitch",
 ]

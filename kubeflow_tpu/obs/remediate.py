@@ -1,0 +1,739 @@
+"""Self-healing remediation: SLO pages drive budgeted playbooks
+(ISSUE 17).
+
+PR 15 made the platform *notice* (burn-rate pages, exemplars, flight
+dumps); this module makes it *act* — and makes every action defensible:
+
+- The :class:`RemediationController` subscribes to the
+  :class:`~kubeflow_tpu.obs.slo.SLOEngine` alert FSM: after each
+  ``evaluate(now)`` pass the driver hands the clock (and optionally the
+  fired transitions / an external state map) to ``tick(now)``, which
+  maps each PAGING objective to its registered :class:`Playbook`.
+- A playbook is an *actuation seam the platform already has*, wrapped
+  in guardrails: drain a sick serving backend (``lb.set_backends``),
+  requeue parked gangs (the PR-8 park path's ``kick_timers``), grow an
+  under-SLO elastic gang (``ElasticController.sweep`` ->
+  ``try_grow``), shrink a gang via the ONE eviction seam
+  (``scheduler.preempt.preempt_slice_group``), respawn a wedged shard
+  (``ShardedControlPlane.kill``/``restart``). Factories for all five
+  live at the bottom of this module; custom playbooks are one dataclass.
+- Guardrails are the point, not the actions: a per-playbook action
+  BUDGET, a COOLDOWN between actions, one outstanding action at a time,
+  a fsync'd ``actions.jsonl`` journaled **before** each apply (the
+  KF102/KF106 discipline; rotate-before-append with a state head,
+  byte-identical :meth:`RemediationController.replay_from`,
+  shard-SIGKILL-safe), FlightRecorder dumps bracketing every action
+  (``remediate-pre-<playbook>`` / ``remediate-post-<playbook>``) as
+  evidence, and a goodput-ledger "did it pay off" VERDICT journaled
+  ``verify_after`` clock units later: paid iff the paged series cleared
+  AND the ledger-measured cost stayed within the playbook's
+  ``cost_budget``. A playbook whose cost goes unrepaid
+  ``unpaid_disable_after`` actions in a row auto-disables itself and
+  pages ``remediation-disabled`` (via the
+  ``kftpu_remediation_disabled`` gauge + :func:`remediation_objective`)
+  instead of flapping the fleet.
+
+Clock discipline: like the SLOEngine, ``tick(now)`` is the one clock
+input — monotone seconds on a live platform, integer rounds in seeded
+soaks. No wall-clock reads here (KF101: this file is in the tick
+domain). Deterministic: same alert sequence, same actions, byte
+identical journal.
+
+See docs/remediation.md for the operator-facing contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from kubeflow_tpu.obs.goodput import JOURNAL_ROTATE_BYTES, _Journal
+from kubeflow_tpu.obs.slo import Objective, TICK_WINDOWS, Windows
+from kubeflow_tpu.utils.logging import get_logger
+
+log = get_logger("remediate")
+
+#: The action journal's filename under a state dir — next to
+#: ``alerts.jsonl`` and ``goodput.jsonl``.
+ACTIONS_JOURNAL = "actions.jsonl"
+
+
+@dataclasses.dataclass(frozen=True)
+class Playbook:
+    """One objective -> action mapping plus its guardrails.
+
+    ``action`` receives the (already-journaled) action record and
+    actuates through an existing platform seam, returning a small
+    detail dict for the scoreboard. ``precheck`` (optional) is a
+    READ-ONLY feasibility probe run BEFORE anything is journaled — a
+    playbook that cannot act right now (e.g. draining the last live
+    backend) skips without burning budget. Budgets/cooldowns are in
+    the driver's clock units (ticks in soaks, seconds live)."""
+
+    name: str
+    objective: str                      # base objective name it answers
+    action: Callable[[dict], Optional[dict]]
+    precheck: Optional[Callable[[dict], bool]] = None
+    budget: int = 3                     # lifetime action cap
+    cooldown: float = 2.0               # min clock between actions
+    verify_after: float = 2.0           # clock until the verdict
+    cost_budget: float = 0.0            # ledger cost a paid action may incur
+    unpaid_disable_after: int = 3       # unpaid streak -> auto-disable
+
+    def __post_init__(self):
+        if not self.name or not self.objective:
+            raise ValueError("playbook needs a name and an objective")
+        if self.budget < 1:
+            raise ValueError(f"playbook {self.name!r}: budget must be >= 1")
+        if self.unpaid_disable_after < 1:
+            raise ValueError(
+                f"playbook {self.name!r}: unpaid_disable_after must be >= 1")
+
+
+class _PBState:
+    """Journal-observable runtime state for one playbook."""
+
+    __slots__ = ("name", "actions", "paid", "unpaid", "streak",
+                 "disabled", "disabled_source", "last_t", "last_verdict")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.actions = 0
+        self.paid = 0
+        self.unpaid = 0
+        self.streak = 0              # consecutive unpaid verdicts
+        self.disabled = ""           # reason; "" = armed
+        self.disabled_source = ""    # "auto" | "operator"
+        self.last_t: Optional[float] = None
+        self.last_verdict: Optional[dict] = None
+
+
+def series_base(series_key: str) -> str:
+    """``sh03:backend-queue-wait[backend=b1]`` -> ``backend-queue-wait``
+    — the base objective name a playbook is registered under. Shard
+    prefixes (``shNN:`` from ``slo_union``) and ``group_by`` suffixes
+    are routing detail, not identity."""
+    key = series_key
+    head, sep, rest = key.partition(":")
+    if sep and head.startswith("sh") and head[2:].isdigit():
+        key = rest
+    return key.partition("[")[0]
+
+
+def series_label(series_key: str) -> str:
+    """The ``group_by`` value of a grouped series key ("" when the
+    series is ungrouped) — how the drain playbook learns WHICH backend
+    paged and the respawn playbook WHICH shard."""
+    _, sep, rest = series_key.partition("[")
+    if not sep:
+        return ""
+    body = rest.rstrip("]")
+    return body.partition("=")[2]
+
+
+class RemediationController:
+    """Maps paging SLO objectives to budgeted, journaled, verified
+    playbook actions. Thread-safe; starts no threads of its own.
+
+    The journal (``actions.jsonl``) carries four ops — ``action``
+    (written BEFORE the seam is touched), ``verdict``, ``disable`` /
+    ``enable`` and the rotation ``state`` head — and replays through
+    the same apply path the live controller used, so
+    :meth:`fingerprint` is byte-identical across a SIGKILL mid-write
+    (torn tails drop at the reader, exactly like the alert journal)."""
+
+    def __init__(
+        self,
+        registry=None,                  # utils.monitoring.MetricsRegistry
+        *,
+        engine=None,                    # obs.slo.SLOEngine (optional)
+        playbooks=(),
+        journal_path: str = "",
+        fsync: bool = True,
+        rotate_bytes: int = JOURNAL_ROTATE_BYTES,
+        recorder=None,                  # obs.flight.FlightRecorder
+        dump_dir: str = "",
+        accountant=None,                # obs.goodput.GoodputAccountant
+        cost_fn: Optional[Callable[[], float]] = None,
+        history_limit: int = 256,
+    ):
+        self.engine = engine
+        self.recorder = recorder
+        self.dump_dir = dump_dir
+        self._accountant = accountant
+        if cost_fn is not None:
+            self._cost = cost_fn
+        elif accountant is not None:
+            self._cost = lambda: float(
+                sum(accountant.interruptions.values()))
+        else:
+            self._cost = lambda: 0.0
+        self._journal = _Journal(journal_path, fsync)
+        self._rotate_bytes = int(rotate_bytes)
+        self._replaying = False
+        self._playbooks: Dict[str, Playbook] = {}
+        self._by_objective: Dict[str, Playbook] = {}
+        self._state: Dict[str, _PBState] = {}
+        self._pending: List[dict] = []   # actions awaiting a verdict
+        self._next_id = 1
+        self._history_limit = int(history_limit)
+        self._history: List[dict] = []
+        self._lock = threading.RLock()
+        self.metrics_actions = self.metrics_verdicts = None
+        self.metrics_disabled = None
+        if registry is not None:
+            self.metrics_actions = registry.counter(
+                "kftpu_remediation_actions_total",
+                "Remediation playbook actions applied",
+                labels=("playbook",),
+            )
+            self.metrics_verdicts = registry.counter(
+                "kftpu_remediation_verdicts_total",
+                "Goodput verdicts on remediation actions "
+                "(did the action pay off?)",
+                labels=("playbook", "verdict"),
+            )
+            self.metrics_disabled = registry.gauge(
+                "kftpu_remediation_disabled",
+                "1 when the playbook is disabled (auto or operator) — "
+                "the remediation-disabled objective pages on it",
+                labels=("playbook",),
+            )
+        for pb in playbooks:
+            self.register(pb)
+
+    # ----------------- wiring -----------------
+
+    def register(self, pb: Playbook) -> None:
+        with self._lock:
+            if pb.name in self._playbooks:
+                raise ValueError(f"duplicate playbook {pb.name!r}")
+            other = self._by_objective.get(pb.objective)
+            if other is not None:
+                raise ValueError(
+                    f"objective {pb.objective!r} already handled by "
+                    f"playbook {other.name!r}")
+            self._playbooks[pb.name] = pb
+            self._by_objective[pb.objective] = pb
+            self._state.setdefault(pb.name, _PBState(pb.name))
+            if self.metrics_disabled is not None:
+                st = self._state[pb.name]
+                self.metrics_disabled.set(
+                    1.0 if st.disabled else 0.0, playbook=pb.name)
+
+    def set_journal(self, path: str, *, replay: bool = True) -> int:
+        """(Re)attach the action journal once the state dir is known —
+        the Platform wiring path, mirroring ``SLOEngine.set_journal``."""
+        with self._lock:
+            n = self.replay_from(path) if replay else 0
+            self._journal.close()
+            self._journal = _Journal(path, self._journal.fsync)
+            return n
+
+    # ----------------- the control loop -----------------
+
+    def tick(self, now: float, *, fired=None,
+             states: Optional[Dict[str, str]] = None,
+             act: bool = True) -> List[dict]:
+        """One remediation pass, called right after the SLO engine's
+        ``evaluate(now)``. Settles due verdicts first (an action's
+        outcome is judged before new actions are considered), then maps
+        every paging series to its playbook through the guardrails.
+        Returns the action records applied this tick. ``states``
+        overrides the engine's series map — how the sharded soak's
+        parent feeds ``slo_union`` state in; ``fired`` is accepted for
+        symmetry with ``evaluate``'s return and future triggers.
+        ``act=False`` settles verdicts only — the drivers' end-of-run
+        flush, so every journaled action leaves with a verdict."""
+        del fired  # paging STATE decides; transitions are advisory
+        with self._lock:
+            now = float(now)
+            if states is None:
+                states = self.engine.states() if self.engine else {}
+            self._settle_verdicts(now, states)
+            if not act:
+                return []
+            applied: List[dict] = []
+            for series in sorted(k for k, v in states.items()
+                                 if v == "page"):
+                pb = self._by_objective.get(series_base(series))
+                if pb is None:
+                    continue
+                st = self._state[pb.name]
+                if st.disabled:
+                    continue
+                if any(p["playbook"] == pb.name for p in self._pending):
+                    continue        # one outstanding action at a time
+                if st.actions >= pb.budget:
+                    continue        # budget exhausted: stop, don't flap
+                if st.last_t is not None \
+                        and now - st.last_t < pb.cooldown:
+                    continue
+                rec = {"op": "action", "t": round(now, 6),
+                       "id": self._next_id, "playbook": pb.name,
+                       "objective": series,
+                       "cost0": round(self._cost(), 6)}
+                if pb.precheck is not None and not pb.precheck(dict(rec)):
+                    continue        # read-only probe: no budget burned
+                self._dump(f"remediate-pre-{pb.name}")
+                # KF102/KF106: the journal record lands (fsync'd)
+                # BEFORE the seam is touched — a crash mid-action
+                # replays as "attempted", never as silent mutation.
+                self._journal_rec(rec)
+                self._apply_action(rec)
+                try:
+                    detail = pb.action(dict(rec))
+                except Exception as e:  # noqa: BLE001 — a playbook
+                    # must never take the control loop down with it
+                    detail = {"error": repr(e)}
+                    log.error("remediation action failed", kv={
+                        "playbook": pb.name, "err": repr(e)})
+                self._dump(f"remediate-post-{pb.name}")
+                self._pending.append({
+                    "id": rec["id"], "playbook": pb.name,
+                    "objective": series, "due": now + pb.verify_after,
+                    "cost0": rec["cost0"]})
+                shown = dict(rec)
+                if detail:
+                    shown["detail"] = detail
+                self._remember(shown)
+                log.warning("remediation action applied", kv={
+                    "playbook": pb.name, "objective": series,
+                    "action": rec["id"],
+                    "budget": f"{st.actions}/{pb.budget}"})
+                applied.append(shown)
+            return applied
+
+    def _settle_verdicts(self, now: float,
+                         states: Dict[str, str]) -> None:
+        due = [p for p in self._pending if p["due"] <= now]
+        if not due:
+            return
+        self._pending = [p for p in self._pending if p["due"] > now]
+        for p in due:
+            pb = self._playbooks.get(p["playbook"])
+            cleared = states.get(p["objective"], "ok") != "page"
+            cost = round(self._cost() - p["cost0"], 6)
+            budget = pb.cost_budget if pb is not None else 0.0
+            paid = bool(cleared and cost <= budget + 1e-9)
+            vrec = {"op": "verdict", "t": round(now, 6),
+                    "action": p["id"], "playbook": p["playbook"],
+                    "objective": p["objective"], "cleared": cleared,
+                    "cost": cost, "paid": paid}
+            self._journal_rec(vrec)
+            self._apply_verdict(vrec)
+            self._remember(vrec)
+            st = self._state.get(p["playbook"])
+            if (pb is not None and st is not None and not st.disabled
+                    and st.streak >= pb.unpaid_disable_after):
+                self._disable_locked(
+                    p["playbook"], now, source="auto",
+                    reason=f"cost unrepaid over {st.streak} "
+                           "consecutive actions")
+
+    # ----------------- operator overrides -----------------
+
+    def disable(self, name: str, *, now: float = 0.0,
+                reason: str = "operator override") -> None:
+        """Journal + apply an operator disable (``tpuctl remediate
+        --disable``). Unknown names raise — a typo must not silently
+        journal a no-op."""
+        with self._lock:
+            if name not in self._state and name not in self._playbooks:
+                raise KeyError(f"unknown playbook {name!r}")
+            self._disable_locked(name, float(now), source="operator",
+                                 reason=reason)
+
+    def enable(self, name: str, *, now: float = 0.0) -> None:
+        """Re-arm a disabled playbook (operator decision; also resets
+        the unpaid streak — re-enabling into an instant re-disable
+        would be a trap)."""
+        with self._lock:
+            if name not in self._state and name not in self._playbooks:
+                raise KeyError(f"unknown playbook {name!r}")
+            rec = {"op": "enable", "t": round(float(now), 6),
+                   "playbook": name}
+            self._journal_rec(rec)
+            self._apply_enable(rec)
+            self._remember(rec)
+
+    def _disable_locked(self, name: str, now: float, *, source: str,
+                        reason: str) -> None:
+        rec = {"op": "disable", "t": round(now, 6), "playbook": name,
+               "source": source, "reason": reason}
+        self._journal_rec(rec)
+        self._apply_disable(rec)
+        self._remember(rec)
+        log.error("remediation playbook disabled", kv={
+            "playbook": name, "source": source, "reason": reason})
+
+    # ----------------- journal / replay -----------------
+
+    def _journal_rec(self, rec: dict) -> None:
+        if self._replaying:
+            return
+        # Rotate BEFORE appending (the alert-journal discipline): the
+        # state head then covers the rotated generation exactly.
+        if rec.get("op") != "state" \
+                and self._journal.maybe_rotate(self._rotate_bytes):
+            self._journal.append({"op": "state",
+                                  "playbooks": self._state_dict()})
+        self._journal.append(rec)
+
+    def _state_dict(self) -> Dict[str, dict]:
+        return {
+            name: {"actions": st.actions, "paid": st.paid,
+                   "unpaid": st.unpaid, "streak": st.streak,
+                   "disabled": st.disabled,
+                   "disabled_source": st.disabled_source,
+                   "t": st.last_t}
+            for name, st in sorted(self._state.items())
+        }
+
+    def _st(self, name: str) -> _PBState:
+        st = self._state.get(name)
+        if st is None:
+            # Replay of a journal mentioning a playbook this controller
+            # has not (yet) registered: state still accrues — the
+            # fingerprint gate must not depend on registration order.
+            st = self._state[name] = _PBState(name)
+        return st
+
+    def _apply_action(self, rec: dict) -> None:
+        st = self._st(rec["playbook"])
+        st.actions += 1
+        st.last_t = float(rec["t"])
+        self._next_id = max(self._next_id, int(rec["id"]) + 1)
+        if self.metrics_actions is not None:
+            self.metrics_actions.inc(playbook=rec["playbook"])
+
+    def _apply_verdict(self, rec: dict) -> None:
+        st = self._st(rec["playbook"])
+        if rec["paid"]:
+            st.paid += 1
+            st.streak = 0
+        else:
+            st.unpaid += 1
+            st.streak += 1
+        st.last_verdict = rec
+        if self.metrics_verdicts is not None:
+            self.metrics_verdicts.inc(
+                playbook=rec["playbook"],
+                verdict="paid" if rec["paid"] else "unpaid")
+
+    def _apply_disable(self, rec: dict) -> None:
+        st = self._st(rec["playbook"])
+        st.disabled = rec.get("reason", "disabled")
+        st.disabled_source = rec.get("source", "")
+        if self.metrics_disabled is not None:
+            self.metrics_disabled.set(1.0, playbook=rec["playbook"])
+
+    def _apply_enable(self, rec: dict) -> None:
+        st = self._st(rec["playbook"])
+        st.disabled = ""
+        st.disabled_source = ""
+        st.streak = 0
+        if self.metrics_disabled is not None:
+            self.metrics_disabled.set(0.0, playbook=rec["playbook"])
+
+    def _apply_state(self, rec: dict) -> None:
+        for name, d in rec.get("playbooks", {}).items():
+            st = self._st(name)
+            st.actions = int(d.get("actions", 0))
+            st.paid = int(d.get("paid", 0))
+            st.unpaid = int(d.get("unpaid", 0))
+            st.streak = int(d.get("streak", 0))
+            st.disabled = d.get("disabled", "")
+            st.disabled_source = d.get("disabled_source", "")
+            st.last_t = d.get("t")
+
+    def replay_from(self, journal_path: str) -> int:
+        """Rebuild playbook state by re-applying the journal through
+        the SAME apply path the live controller used — byte-identical
+        :meth:`fingerprint`, the shard-SIGKILL gate. Actions whose
+        verdict never landed (the process died inside the verify
+        window) are re-armed at their ORIGINAL due time, so the next
+        tick settles them from the journal's own clock."""
+        recs = _Journal.read_generations(journal_path)
+        with self._lock:
+            self._replaying = True
+            try:
+                verdicts = {r.get("action") for r in recs
+                            if r.get("op") == "verdict"}
+                for rec in recs:
+                    op = rec.get("op")
+                    if op == "action":
+                        self._apply_action(rec)
+                        self._remember(rec)
+                        pb = self._playbooks.get(rec["playbook"])
+                        if rec["id"] not in verdicts and pb is not None:
+                            self._pending.append({
+                                "id": rec["id"],
+                                "playbook": rec["playbook"],
+                                "objective": rec["objective"],
+                                "due": float(rec["t"]) + pb.verify_after,
+                                "cost0": rec.get("cost0", 0.0)})
+                    elif op == "verdict":
+                        self._apply_verdict(rec)
+                        self._remember(rec)
+                    elif op == "disable":
+                        self._apply_disable(rec)
+                        self._remember(rec)
+                    elif op == "enable":
+                        self._apply_enable(rec)
+                        self._remember(rec)
+                    elif op == "state":
+                        self._apply_state(rec)
+            finally:
+                self._replaying = False
+        if recs:
+            log.info("action journal replayed", kv={"records": len(recs)})
+        return len(recs)
+
+    def close(self) -> None:
+        self._journal.close()
+
+    # ----------------- read surfaces -----------------
+
+    def _remember(self, rec: dict) -> None:
+        self._history.append(rec)
+        del self._history[:-self._history_limit]
+
+    def _dump(self, reason: str) -> None:
+        if self.recorder is not None and self.dump_dir:
+            self.recorder.dump(self.dump_dir, reason=reason)
+
+    def history(self, limit: int = 50) -> List[dict]:
+        with self._lock:
+            return list(self._history[-int(limit):])
+
+    def actions_total(self) -> int:
+        with self._lock:
+            return sum(st.actions for st in self._state.values())
+
+    def disabled_playbooks(self) -> List[str]:
+        with self._lock:
+            return sorted(n for n, st in self._state.items()
+                          if st.disabled)
+
+    def fingerprint(self) -> str:
+        """Order-independent digest over the JOURNAL-DERIVED state —
+        what the shard-SIGKILL replay gate compares pre/post. Playbooks
+        that never acted and were never disabled carry no
+        journal-observable state and are excluded (a replayed
+        controller may register a different playbook set)."""
+        with self._lock:
+            rows = sorted(
+                f"{n}|{st.actions}|{st.paid}|{st.unpaid}|{st.streak}|"
+                f"{st.disabled}|{st.disabled_source}|{st.last_t}"
+                for n, st in self._state.items()
+                if st.actions > 0 or st.disabled)
+        return hashlib.sha256("\n".join(rows).encode()).hexdigest()
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The scoreboard ``tpuctl remediate`` renders."""
+        with self._lock:
+            playbooks: Dict[str, Any] = {}
+            for name in sorted(set(self._state) | set(self._playbooks)):
+                st = self._state.get(name) or _PBState(name)
+                pb = self._playbooks.get(name)
+                playbooks[name] = {
+                    "objective": pb.objective if pb else "",
+                    "actions": st.actions,
+                    "budget": pb.budget if pb else None,
+                    "cooldown": pb.cooldown if pb else None,
+                    "paid": st.paid,
+                    "unpaid": st.unpaid,
+                    "streak": st.streak,
+                    "disabled": st.disabled,
+                    "disabled_source": st.disabled_source,
+                    "last_t": st.last_t,
+                    "last_verdict": st.last_verdict,
+                    "pending": sum(1 for p in self._pending
+                                   if p["playbook"] == name),
+                }
+            return {
+                "playbooks": playbooks,
+                "actions": sum(p["actions"] for p in playbooks.values()),
+                "paid": sum(p["paid"] for p in playbooks.values()),
+                "unpaid": sum(p["unpaid"] for p in playbooks.values()),
+                "pending": len(self._pending),
+                "disabled": self.disabled_playbooks(),
+                "fingerprint": self.fingerprint(),
+            }
+
+
+def remediation_objective(windows: Windows = TICK_WINDOWS,
+                          clear_after: int = 2) -> Objective:
+    """The watchdog-on-the-watchdog: an objective over the
+    ``kftpu_remediation_disabled`` gauge family that PAGES
+    ``remediation-disabled[playbook=X]`` while a playbook is disabled —
+    the self-healing loop giving itself back to the operator instead of
+    flapping. Append it to the engine's objective set wherever a
+    RemediationController shares the registry."""
+    return Objective(
+        name="remediation-disabled",
+        description="a remediation playbook auto-disabled (cost "
+                    "unrepaid) or was disabled by an operator",
+        gauge="kftpu_remediation_disabled",
+        group_by="playbook",
+        max_value=0.0,
+        slo=0.90,
+        page_burn=1.5,
+        warn_burn=1.0,
+        clear_after=clear_after,
+        windows=windows,
+    )
+
+
+# --------------------------------------------------------------------------
+# Stock playbooks: the five actuation seams, wrapped
+# --------------------------------------------------------------------------
+
+
+def drain_backend_playbook(lb, *, objective: str = "backend-queue-wait",
+                           min_live: int = 1, budget: int = 3,
+                           cooldown: float = 3.0, verify_after: float = 3.0,
+                           unpaid_disable_after: int = 3) -> Playbook:
+    """Drain the paged serving backend out of the dispatch set
+    (``lb.set_backends`` keeps it draining until in-flight hits zero);
+    cache-affine re-route happens on the next dispatch — affinity
+    yields to eligibility, so the drained replica's sessions land on
+    survivors. Refuses (precheck) to go below ``min_live`` live
+    backends: remediation must never drain the fleet dark."""
+
+    def _candidates(rec: dict):
+        addr = series_label(rec["objective"])
+        current = [b["addr"] for b in lb.backends() if not b["draining"]]
+        if addr in current and len(current) - 1 >= min_live:
+            return addr, current
+        return None, current
+
+    def _precheck(rec: dict) -> bool:
+        addr, _ = _candidates(rec)
+        return addr is not None
+
+    def _act(rec: dict) -> dict:
+        addr, current = _candidates(rec)
+        if addr is None:
+            return {"skipped": "backend gone or fleet too small"}
+        keep = [a for a in current if a != addr]
+        lb.set_backends(keep)
+        return {"drained": addr, "kept": len(keep)}
+
+    return Playbook(name="drain-backend", objective=objective,
+                    action=_act, precheck=_precheck, budget=budget,
+                    cooldown=cooldown, verify_after=verify_after,
+                    unpaid_disable_after=unpaid_disable_after)
+
+
+def requeue_playbook(manager, *, objective: str = "goodput-interruptions",
+                     within: float = 3600.0, budget: int = 3,
+                     cooldown: float = 3.0, verify_after: float = 3.0,
+                     cost_budget: float = 0.0,
+                     unpaid_disable_after: int = 3) -> Playbook:
+    """Fire the PR-8 park path's retry timers now
+    (``ControllerManager.kick_timers``): gangs parked on capacity /
+    ledger backoff re-attempt admission this tick instead of waiting
+    out the park interval — the requeue answer to an interruption
+    burst."""
+
+    def _act(rec: dict) -> dict:
+        manager.kick_timers(within)
+        return {"kicked_within_s": within}
+
+    return Playbook(name="requeue-parked", objective=objective,
+                    action=_act, budget=budget, cooldown=cooldown,
+                    verify_after=verify_after, cost_budget=cost_budget,
+                    unpaid_disable_after=unpaid_disable_after)
+
+
+def grow_elastic_playbook(elastic, *, objective: str = "tenant-goodput",
+                          budget: int = 3, cooldown: float = 3.0,
+                          verify_after: float = 3.0,
+                          unpaid_disable_after: int = 3) -> Playbook:
+    """Grow the most-deserving under-sized elastic gang through the
+    one growth seam (``ElasticController.sweep`` ->
+    ``scheduler.try_grow`` + commit) — the VirtualFlow move: remediate
+    by resize, not restart."""
+
+    def _act(rec: dict) -> dict:
+        return {"grown": int(elastic.sweep())}
+
+    return Playbook(name="grow-elastic", objective=objective,
+                    action=_act, budget=budget, cooldown=cooldown,
+                    verify_after=verify_after,
+                    unpaid_disable_after=unpaid_disable_after)
+
+
+def shrink_gang_playbook(api, pick_victim, *,
+                         objective: str = "queue-age",
+                         budget: int = 2, cooldown: float = 4.0,
+                         verify_after: float = 4.0,
+                         cost_budget: float = 4.0,
+                         unpaid_disable_after: int = 2) -> Playbook:
+    """Shrink (or free for migration) one slice group of a victim gang
+    through the ONE eviction seam
+    (``scheduler.preempt.preempt_slice_group``) — never ad-hoc pod
+    deletion. ``pick_victim() -> (job, group) | None`` owns the policy
+    (lowest priority above its elastic floor, defrag's
+    ``_pick_migration`` choice, ...); eviction has a real ledger cost,
+    so the default ``cost_budget`` is nonzero and the disable trigger
+    tight."""
+
+    def _precheck(rec: dict) -> bool:
+        return pick_victim() is not None
+
+    def _act(rec: dict) -> dict:
+        victim = pick_victim()
+        if victim is None:
+            return {"skipped": "no eligible victim"}
+        from kubeflow_tpu.scheduler.preempt import preempt_slice_group
+        job, group = victim
+        n = preempt_slice_group(api, job, group)
+        return {"job": f"{job.metadata.namespace}/{job.metadata.name}",
+                "group": group, "pods": n}
+
+    return Playbook(name="shrink-gang", objective=objective,
+                    action=_act, precheck=_precheck, budget=budget,
+                    cooldown=cooldown, verify_after=verify_after,
+                    cost_budget=cost_budget,
+                    unpaid_disable_after=unpaid_disable_after)
+
+
+def respawn_shard_playbook(plane, *, objective: str = "watch-delivery-lag",
+                           budget: int = 2, cooldown: float = 4.0,
+                           verify_after: float = 4.0,
+                           cost_budget: float = 4.0,
+                           unpaid_disable_after: int = 2) -> Playbook:
+    """Restart a wedged shard through ``ShardedControlPlane``'s
+    kill/restart respawn — WAL + journal replay is the recovery
+    mechanism, so the restart is safe by construction (the ISSUE-6
+    contract). The paging series must carry the ``shNN:`` prefix
+    ``slo_union`` adds; an unprefixed series means the caller wired
+    this playbook to a non-sharded engine, and the precheck refuses."""
+
+    def _shard_of(rec: dict) -> Optional[int]:
+        head, sep, _ = rec["objective"].partition(":")
+        if sep and head.startswith("sh") and head[2:].isdigit():
+            return int(head[2:])
+        return None
+
+    def _precheck(rec: dict) -> bool:
+        return _shard_of(rec) is not None
+
+    def _act(rec: dict) -> dict:
+        sid = _shard_of(rec)
+        if sid is None:
+            return {"skipped": "series carries no shard prefix"}
+        plane.kill(sid)
+        plane.restart(sid)
+        return {"respawned_shard": sid}
+
+    return Playbook(name="respawn-shard", objective=objective,
+                    action=_act, precheck=_precheck, budget=budget,
+                    cooldown=cooldown, verify_after=verify_after,
+                    cost_budget=cost_budget,
+                    unpaid_disable_after=unpaid_disable_after)

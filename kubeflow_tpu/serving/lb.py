@@ -211,6 +211,7 @@ class Backend:
             "kv_blocks_live": self.kv_blocks_live,
             "kv_blocks_total": self.kv_blocks_total,
             "slot_free_rate": self.slot_free_rate,
+            "p50_queue_wait_s": self.p50_queue_wait_s,
             "resident_prefixes": len(self.resident_prefixes),
         }
 
@@ -335,11 +336,25 @@ class ServingLoadBalancer:
         # only the read paths (shed decision, /healthz) sweep the whole
         # table — arrivals stay O(1) however many tenants are active.
         self._tenant_decayed: Dict[str, Tuple[float, float]] = {}
-        # Session registry: session id -> namespace, for traffic whose
-        # only identity is its session key (the "session key ->
-        # namespace -> tenant" resolution leg). Populated by the
-        # operator/front-end (e.g. at session issue time).
+        # Session registry: session id -> namespace. Originally (PR 13)
+        # a pure resolution shortcut; since ISSUE 17 it is an
+        # AUTHENTICATION binding: a session key is bound to the first
+        # namespace that presents it (or via register_session), a
+        # request pairing the session with a DIFFERENT namespace/tenant
+        # is rejected 403, and a bound session presented alone (the
+        # spoof shape: an attacker who learned the id but not the
+        # namespace) gets neither cache affinity nor the victim's
+        # tenant share — it routes untenanted, on load alone.
         self.session_namespaces: Dict[str, str] = {}
+        self.session_rejects = 0
+        self.metrics_session_rejects = registry.counter(
+            "kftpu_lb_session_rejects_total",
+            "Session-identity failures: 'mismatch' = session bound to a "
+            "different namespace/tenant (403), 'unproven' = bound "
+            "session presented without its namespace (demoted to "
+            "untenanted, affinity stripped)",
+            labels=("mode",),
+        )
         # Over-share slack in REQUESTS: fair fractions are continuous
         # but arrivals are integers, so whichever in-share tenant's
         # request lands first in a round reads fractionally "over" —
@@ -413,13 +428,87 @@ class ServingLoadBalancer:
             if isinstance(session, str) and session:
                 ns = self.session_namespaces.get(session)
         if isinstance(ns, str) and ns:
-            if self._tenant_tree is not None:
-                path = self._tenant_tree.resolve(ns)
-                leaf = self._tenant_tree.leaf_of_path(path)
-                return leaf or None
-            if ns in self._tenant_weights:
-                return ns
+            return self._tenant_of_namespace(ns)
         return None
+
+    def _tenant_of_namespace(self, ns: str) -> Optional[str]:
+        """The ``resolve_tenant`` namespace leg alone: ns -> tenant
+        through the tree / weight table, None when unmapped."""
+        if not self._tenant_weights or not ns:
+            return None
+        if self._tenant_tree is not None:
+            path = self._tenant_tree.resolve(ns)
+            leaf = self._tenant_tree.leaf_of_path(path)
+            return leaf or None
+        return ns if ns in self._tenant_weights else None
+
+    def register_session(self, session: str, namespace: str) -> None:
+        """Bind a session key to its owning namespace at issue time —
+        the explicit half of the ISSUE-17 session authentication (the
+        implicit half is trust-on-first-use in ``_resolve_identity``)."""
+        if not session or not namespace:
+            raise ValueError("register_session needs a session and "
+                             "a namespace")
+        with self._lock:
+            self.session_namespaces[session] = namespace
+
+    def _resolve_identity(self, body: dict,
+                          headers: Optional[Dict[str, str]]
+                          ) -> Tuple[List[str], Optional[str]]:
+        """Authenticated (affinity_keys, tenant) for one request —
+        the ISSUE-17 close of the PR-13 spoofing follow-up.
+
+        A bare ``s:<id>`` used to be a bearer credential: anyone who
+        learned the id inherited the owner's cache affinity AND (via
+        the session registry) the owner's tenant share, dodging
+        tenant-weighted shedding. Now the registry is a binding:
+
+        - unbound session + namespace: trust-on-first-use, bind it;
+        - bound session + MATCHING namespace/tenant: full identity
+          (affinity + tenant share), the honest-client path;
+        - bound session + DIFFERENT namespace/tenant: 403, counted as
+          ``mode="mismatch"`` — affinity and shed ledgers untouched;
+        - bound session ALONE (the spoof shape): demoted — session
+          affinity key stripped, tenant None — counted
+          ``mode="unproven"``. Session identity dominates key
+          derivation, so the spoofer routes anonymously; prompt-only
+          traffic keeps its prefix-hash keys (they encode the prompt,
+          not a stolen identity).
+
+        Unregistered sessions without a namespace keep the PR-12
+        behaviour byte-identical: affinity works, traffic untenanted.
+        """
+        headers = headers or {}
+        keys = self.affinity_keys(body)
+        session = body.get("session")
+        if isinstance(session, str) and session:
+            ns = headers.get("x-kftpu-namespace") or body.get("namespace")
+            declared = (headers.get("x-kftpu-tenant")
+                        or body.get("tenant"))
+            ns = ns if isinstance(ns, str) else None
+            declared = declared if isinstance(declared, str) else None
+            with self._lock:
+                bound = self.session_namespaces.get(session)
+                if bound is None:
+                    if ns:
+                        self.session_namespaces[session] = ns
+                else:
+                    bound_tenant = self._tenant_of_namespace(bound)
+                    if (ns and ns != bound) or (
+                            declared and bound_tenant is not None
+                            and declared != bound_tenant):
+                        self.session_rejects += 1
+                        self.metrics_session_rejects.inc(mode="mismatch")
+                        raise RestError(
+                            403,
+                            f"session {session!r} is bound to another "
+                            f"namespace")
+                    if not ns and not declared:
+                        self.session_rejects += 1
+                        self.metrics_session_rejects.inc(mode="unproven")
+                        return ([k for k in keys
+                                 if k != f"s:{session}"], None)
+        return keys, self.resolve_tenant(body, headers)
 
     def _decayed_mass_locked(self, tenant: str, now: float) -> float:
         """One tenant's arrival mass decayed to ``now`` (lazy: each
@@ -751,9 +840,8 @@ class ServingLoadBalancer:
     def _generate(self, req: Request):
         body = json.dumps(req.body).encode()
         stream = bool(req.body.get("stream", False))
-        keys = self.affinity_keys(req.body)
-        tenant = self.resolve_tenant(req.body,
-                                     getattr(req, "headers", None))
+        keys, tenant = self._resolve_identity(
+            req.body, getattr(req, "headers", None))
         # One arrival per REQUEST (not per dispatch retry): the
         # fair-share denominator must count offered load exactly.
         self.note_tenant_arrival(tenant)

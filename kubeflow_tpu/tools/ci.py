@@ -98,6 +98,16 @@ Stages (any failure exits non-zero — the merge gate contract):
    dump (true-positive gate); alerts.jsonl replays byte-identically
    into a fresh engine AND across a whole-shard SIGKILL, whose respawn
    leaves its own flight dump (``--skip-slo``).
+8f. **remediate-smoke**: the self-healing controller (ISSUE 17) — the
+   CLEAN armed soak takes ZERO actions (do-no-harm) while the
+   fault-injected soak closes the loop (page -> journaled budgeted
+   action -> pre+post flight dumps -> goodput verdict -> CLEAR without
+   an operator); actions.jsonl replays byte-identically into a fresh
+   controller AND across a whole-shard SIGKILL; an injected
+   always-unprofitable playbook auto-disables within budget and pages
+   remediation-disabled; the serving soak's gray-failure (sick
+   backend) leg pages backend-queue-wait and the drain playbook
+   clears it with routing invariants intact (``--skip-remediate``).
 9. **bench-gate**: if --bench-json is given, require
    ``vs_baseline >= --min-vs-baseline`` for every record — the perf
    regression gate SURVEY §7.8 prescribes.
@@ -458,6 +468,231 @@ def run_slo_smoke(seed: int = 20260803) -> None:
             f"flight dump (dumps: {shard.flight_dumps}) — matching any "
             "dump here would let an alert-page dump mask a broken "
             "respawn path")
+
+
+def run_remediate_smoke(seed: int = 20260803) -> None:
+    """Self-healing remediation smoke (ISSUE 17), count-gated in BOTH
+    directions like slo-smoke:
+
+    - **do-no-harm gate**: the CLEAN seeded soak with the controller
+      ARMED takes ZERO actions (an idle fleet must never be "healed");
+    - **closed-loop gate**: the fault-injected soak pages exactly the
+      expected objectives, every page maps to a journaled budgeted
+      action, every action carries a pre+post flight dump AND a
+      journaled goodput verdict (no pending, >=1 paid), and the run
+      ends with NOTHING paging — page -> act -> clear without an
+      operator;
+    - **replay gate**: actions.jsonl replays byte-identically into a
+      fresh controller (fingerprint equality), and across a whole-shard
+      SIGKILL the respawned shard's controller replays identically too;
+    - **auto-disable gate**: an injected always-unprofitable playbook
+      disables itself after ``unpaid_disable_after`` unpaid verdicts —
+      within its action budget — and pages ``remediation-disabled``;
+    - **gray-failure gate**: the serving soak's sick backend (healthy
+      probes, pathological queue wait) pages backend-queue-wait, the
+      drain playbook removes it, and the page clears with routing
+      invariants intact.
+    """
+    import os as _os
+    import shutil
+    import tempfile
+
+    from kubeflow_tpu.chaos import run_sharded_soak, run_soak
+    from kubeflow_tpu.chaos.serving_soak import run_serving_soak
+    from kubeflow_tpu.obs.remediate import (
+        ACTIONS_JOURNAL,
+        Playbook,
+        RemediationController,
+        remediation_objective,
+    )
+    from kubeflow_tpu.obs.slo import SLOEngine
+    from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+    clean_sd = tempfile.mkdtemp(prefix="kftpu-remediate-smoke-clean-")
+    try:
+        clean = run_soak(num_jobs=4, seed=seed, conflict_rate=0.3,
+                         transient_rate=0.05, preempt_every=0,
+                         fault_rounds=9, max_rounds=40,
+                         remediate=True, state_dir=clean_sd)
+        if clean.remediation.get("actions", 0) != 0:
+            raise GateFailure(
+                f"remediate-smoke: the clean soak took "
+                f"{clean.remediation.get('actions')} remediation "
+                f"action(s) with nothing paging — do-no-harm gate "
+                f"broken: {clean.remediation.get('playbooks')}")
+        if clean.slo.get("transitions", 0) != 0:
+            raise GateFailure(
+                "remediate-smoke: clean soak fired alert transitions — "
+                "the do-no-harm gate above would be vacuous")
+    finally:
+        shutil.rmtree(clean_sd, ignore_errors=True)
+
+    sd = tempfile.mkdtemp(prefix="kftpu-remediate-smoke-")
+    try:
+        rep = run_soak(num_jobs=4, seed=seed, conflict_rate=0.3,
+                       transient_rate=0.05, preempt_every=3,
+                       fault_rounds=9, max_rounds=40,
+                       watch_lag_s=1.0, remediate=True, state_dir=sd)
+        pages = rep.slo.get("pages", {})
+        expected = {"goodput-interruptions": 1, "watch-delivery-lag": 1}
+        if pages != expected:
+            raise GateFailure(
+                f"remediate-smoke: fault soak paged {pages}, expected "
+                f"exactly {expected}")
+        still_paging = [k for k, v in rep.slo.get("series", {}).items()
+                       if v.get("state") == "page"]
+        if still_paging:
+            raise GateFailure(
+                f"remediate-smoke: the soak ENDED with {still_paging} "
+                "still paging — the closed loop did not close")
+        snap = rep.remediation
+        for objective in expected:
+            acted = any(row["objective"] == objective and row["actions"]
+                        for row in snap.get("playbooks", {}).values())
+            if not acted:
+                raise GateFailure(
+                    f"remediate-smoke: {objective} paged but no "
+                    "playbook acted on it")
+        if snap.get("pending", 0) != 0:
+            raise GateFailure(
+                f"remediate-smoke: {snap.get('pending')} action(s) left "
+                "WITHOUT a journaled verdict")
+        if snap.get("paid", 0) + snap.get("unpaid", 0) != snap.get("actions"):
+            raise GateFailure(
+                f"remediate-smoke: verdicts (paid={snap.get('paid')} "
+                f"unpaid={snap.get('unpaid')}) do not account for all "
+                f"{snap.get('actions')} action(s)")
+        if snap.get("paid", 0) < 1:
+            raise GateFailure(
+                "remediate-smoke: no action PAID for itself — the "
+                "pages cleared for some other reason and the verdict "
+                "gate would be vacuous")
+        if snap.get("disabled"):
+            raise GateFailure(
+                f"remediate-smoke: playbooks {snap['disabled']} "
+                "auto-disabled during a soak they were sized for")
+        pre = sum(1 for p in rep.flight_dumps if "remediate-pre" in p)
+        post = sum(1 for p in rep.flight_dumps if "remediate-post" in p)
+        if pre != snap.get("actions") or post != snap.get("actions"):
+            raise GateFailure(
+                f"remediate-smoke: {snap.get('actions')} action(s) but "
+                f"{pre} pre / {post} post flight dumps — the "
+                "evidence-before-and-after contract is broken")
+        fresh = RemediationController()
+        fresh.replay_from(_os.path.join(sd, ACTIONS_JOURNAL))
+        if fresh.fingerprint() != snap.get("fingerprint"):
+            raise GateFailure(
+                "remediate-smoke: actions.jsonl replay produced a "
+                "DIFFERENT fingerprint than the live controller — the "
+                "journal/apply path diverged")
+    finally:
+        shutil.rmtree(sd, ignore_errors=True)
+
+    # Auto-disable: a playbook whose action NEVER clears its page must
+    # bench itself after ``unpaid_disable_after`` unpaid verdicts —
+    # within its action budget — and page remediation-disabled through
+    # the engine watching the controller's own gauge.
+    reg = MetricsRegistry()
+    eng = SLOEngine(reg, objectives=[remediation_objective()])
+    ctl = RemediationController(
+        reg,
+        playbooks=[Playbook(name="futile", objective="synthetic",
+                            action=lambda rec: {}, budget=10,
+                            cooldown=1.0, verify_after=1.0,
+                            unpaid_disable_after=3)],
+        cost_fn=lambda: 0.0)
+    try:
+        t = 0.0
+        for _ in range(20):
+            t += 1.0
+            ctl.tick(t, states={"synthetic": "page"})
+            eng.evaluate(t)
+            if ctl.disabled_playbooks():
+                break
+        snap = ctl.snapshot()
+        row = snap["playbooks"]["futile"]
+        if not row["disabled"] or row["disabled_source"] != "auto":
+            raise GateFailure(
+                f"remediate-smoke: the always-unprofitable playbook "
+                f"never auto-disabled (state: {row})")
+        if row["actions"] >= 10:
+            raise GateFailure(
+                f"remediate-smoke: auto-disable burned the WHOLE "
+                f"budget ({row['actions']} actions) — the unpaid "
+                "streak must trip first")
+        for _ in range(6):      # let the burn windows see the gauge
+            t += 1.0
+            eng.evaluate(t)
+        disabled_pages = eng.pages_by_objective().get(
+            "remediation-disabled", 0)
+        if disabled_pages < 1:
+            raise GateFailure(
+                "remediate-smoke: a playbook auto-disabled but "
+                "remediation-disabled never paged — the "
+                "watchdog-on-the-watchdog is broken")
+    finally:
+        ctl.close()
+        eng.close()
+
+    # Gray failure: a sick backend answers health checks but serves
+    # with pathological queue wait. The SLO engine pages
+    # backend-queue-wait[backend=...]; the drain playbook removes it.
+    serve = run_serving_soak(backends=3, rounds=12, seed=seed,
+                             sick=True, remediate=True)
+    if not serve.clean:
+        raise GateFailure(
+            f"remediate-smoke: serving soak routing invariants broke "
+            f"under remediation: misrouted={serve.misrouted} "
+            f"errors={serve.errors}")
+    if serve.sicks < 1:
+        raise GateFailure(
+            "remediate-smoke: the sick-backend soak injected no gray "
+            "failure — every serving gate below would be vacuous")
+    if serve.slo.get("pages", {}).get("backend-queue-wait", 0) < 1:
+        raise GateFailure(
+            f"remediate-smoke: gray failure never paged "
+            f"backend-queue-wait (pages: {serve.slo.get('pages')})")
+    if serve.remediation.get("actions", 0) < 1:
+        raise GateFailure(
+            "remediate-smoke: backend-queue-wait paged but the drain "
+            "playbook never acted")
+    if serve.slo.get("paging"):
+        raise GateFailure(
+            f"remediate-smoke: serving soak ended with "
+            f"{serve.slo['paging']} still paging — the drain did not "
+            "clear the gray failure")
+    if serve.remediation.get("pending", 0) != 0:
+        raise GateFailure(
+            "remediate-smoke: serving drain action(s) left without a "
+            "journaled verdict")
+
+    shard = run_sharded_soak(num_jobs=4, shards=2, seed=seed,
+                             conflict_rate=0.3, transient_rate=0.05,
+                             preempt_every=3, kill_shard_round=4,
+                             fault_rounds=8, max_rounds=40,
+                             remediate=True)
+    if not shard.actions_replay_identical:
+        raise GateFailure(
+            "remediate-smoke: the killed shard's controller did NOT "
+            "replay actions.jsonl to a byte-identical fingerprint")
+    if not shard.alerts_replay_identical:
+        raise GateFailure(
+            "remediate-smoke: the killed shard's SLO engine did NOT "
+            "replay alerts.jsonl to a byte-identical fingerprint")
+    if shard.remediation.get("actions_total", 0) < 1:
+        raise GateFailure(
+            "remediate-smoke: the sharded fault soak journaled no "
+            "remediation action — the shard replay gate would be "
+            "vacuous")
+    if shard.remediation.get("pending", 0) != 0:
+        raise GateFailure(
+            f"remediate-smoke: {shard.remediation.get('pending')} "
+            "sharded action(s) left without a journaled verdict")
+    if shard.remediation.get("disabled"):
+        raise GateFailure(
+            f"remediate-smoke: sharded playbooks "
+            f"{shard.remediation['disabled']} auto-disabled during a "
+            "soak they were sized for")
 
 
 def run_serve_bench_smoke(rate_qps: float = 60.0,
@@ -836,6 +1071,7 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
              skip_elastic: bool = False,
              skip_tenant: bool = False,
              skip_slo: bool = False,
+             skip_remediate: bool = False,
              skip_lint: bool = False) -> List[str]:
     """Run all stages; returns the list of passed stages, raises
     GateFailure on the first failing one."""
@@ -961,6 +1197,11 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
         run_slo_smoke(seed=chaos_seed)
         passed.append("slo-smoke")
 
+    if not skip_remediate:
+        _stage("remediate-smoke")
+        run_remediate_smoke(seed=chaos_seed)
+        passed.append("remediate-smoke")
+
     if not skip_serve:
         _stage("serve-bench-smoke")
         run_serve_bench_smoke()
@@ -1030,6 +1271,10 @@ def main(argv=None) -> int:
     g.add_argument("--skip-slo", action="store_true",
                    help="skip the SLO-engine false/true-positive soak "
                         "gates and the alert-journal replay gate")
+    g.add_argument("--skip-remediate", action="store_true",
+                   help="skip the self-healing remediation smoke "
+                        "(do-no-harm, closed-loop, journal-replay and "
+                        "auto-disable gates)")
     g.add_argument("--skip-lint", action="store_true",
                    help="skip the static-analyzer lint smoke")
     args = p.parse_args(argv)
@@ -1050,6 +1295,7 @@ def main(argv=None) -> int:
             skip_elastic=args.skip_elastic,
             skip_tenant=args.skip_tenant,
             skip_slo=args.skip_slo,
+            skip_remediate=args.skip_remediate,
             skip_lint=args.skip_lint,
         )
     except GateFailure as e:

@@ -43,6 +43,7 @@ import argparse
 import json
 import os
 import sys
+import time
 from typing import List
 
 import yaml
@@ -594,6 +595,69 @@ def cmd_slo(args) -> int:
     print(f"{snap['transitions']} alert transitions; paging: "
           f"{', '.join(snap['paging']) or 'none'}")
     return 3 if snap["paging"] else 0
+
+
+def cmd_remediate(args) -> int:
+    """Remediation scoreboard (ISSUE 17): per-playbook action budgets,
+    paid/unpaid goodput verdicts, unpaid streaks, and the action
+    history journaled to ``actions.jsonl``. ``--disable``/``--enable``
+    are the operator overrides (journaled like every other mutation).
+    rc 3 when any playbook is disabled — auto-disable means the loop
+    stopped paying for itself and a human should look."""
+    if args.backend == "kubectl":
+        print("remediate is a state-backend command (the controller "
+              "lives with the embedded platform)", file=sys.stderr)
+        return 2
+    platform = _load_platform(args)
+    platform.reconcile()
+    ctl = platform.remediate
+    if ctl is None:
+        print("remediation controller is off: start the "
+              "tpujob-controller component (it carries the fleet "
+              "playbooks)", file=sys.stderr)
+        return 1
+    if args.disable:
+        try:
+            ctl.disable(args.disable, now=time.monotonic())
+        except KeyError as e:
+            print(f"unknown playbook: {e.args[0]}", file=sys.stderr)
+            return 1
+    if args.enable:
+        try:
+            ctl.enable(args.enable, now=time.monotonic())
+        except KeyError as e:
+            print(f"unknown playbook: {e.args[0]}", file=sys.stderr)
+            return 1
+    snap = ctl.snapshot()
+    history = ctl.history(args.history)
+    if args.output == "json":
+        print(json.dumps({"scoreboard": snap, "history": history},
+                         indent=2, sort_keys=True))
+        return 3 if snap["disabled"] else 0
+    fmt = "{:<18} {:<26} {:>7} {:>5} {:>7} {:>7} {:<10} {}"
+    print(fmt.format("PLAYBOOK", "OBJECTIVE", "ACTIONS", "PAID",
+                     "UNPAID", "STREAK", "STATE", "LAST_VERDICT"))
+    for name, row in snap["playbooks"].items():
+        budget = (f"{row['actions']}/{row['budget']}"
+                  if row["budget"] is not None else str(row["actions"]))
+        state = (f"disabled({row['disabled_source']})"
+                 if row["disabled"] else "armed")
+        print(fmt.format(
+            name, row["objective"] or "-", budget, row["paid"],
+            row["unpaid"], row["streak"], state,
+            row["last_verdict"] or "-"))
+    print(f"{snap['actions']} actions ({snap['paid']} paid, "
+          f"{snap['unpaid']} unpaid), {snap['pending']} verdicts "
+          f"pending; disabled: {', '.join(snap['disabled']) or 'none'}")
+    if history:
+        print(f"-- last {len(history)} journal records --")
+        for rec in history:
+            extra = {k: v for k, v in rec.items()
+                     if k not in ("op", "t", "playbook", "id")}
+            print(f"  t={rec.get('t', 0):g} {rec.get('op', '?'):<8} "
+                  f"{rec.get('playbook', '-'):<18} "
+                  + " ".join(f"{k}={v}" for k, v in sorted(extra.items())))
+    return 3 if snap["disabled"] else 0
 
 
 def cmd_flight(args) -> int:
@@ -1161,6 +1225,23 @@ def build_parser() -> argparse.ArgumentParser:
     sl.add_argument("-o", "--output", choices=("table", "json"),
                     default="table")
     sl.set_defaults(fn=cmd_slo)
+
+    rm = sub.add_parser(
+        "remediate", help="remediation scoreboard: per-playbook budgets, "
+                          "goodput verdicts, action history, operator "
+                          "disable/enable (rc 3 when any playbook is "
+                          "disabled)")
+    rm.add_argument("-o", "--output", choices=("table", "json"),
+                    default="table")
+    rm.add_argument("--history", type=int, default=10,
+                    help="journal records to print (0 = none)")
+    rm.add_argument("--disable", default="",
+                    help="disable a playbook by name (journaled "
+                         "operator override)")
+    rm.add_argument("--enable", default="",
+                    help="re-arm a disabled playbook (resets its "
+                         "unpaid streak)")
+    rm.set_defaults(fn=cmd_remediate)
 
     fl = sub.add_parser(
         "flight", help="crash-dump flight recorder: dump the recent-"

@@ -18,6 +18,7 @@ from kubeflow_tpu.analysis import run_analysis, scan_file, scan_tree
 from kubeflow_tpu.analysis.engine import render_human, render_json
 from kubeflow_tpu.analysis.rules import (
     ClockDomainRule,
+    JournalBeforeMutateRule,
     JournalDisciplineRule,
     MetricHygieneRule,
     ReadAliasingRule,
@@ -464,6 +465,104 @@ class TestVacuousGate:
         """
         fs = _scan(tmp_path, src, [VacuousGateRule()], relpath="x.py")
         assert sorted(f.rule for f in _active(fs)) == ["KF100", "KF105"]
+
+
+# ---------------------------------------------------------------- KF106
+
+
+class TestJournalBeforeMutate:
+    def test_seam_call_without_journal_flagged(self, tmp_path):
+        src = """
+            def kick(self, manager):
+                manager.kick_timers(60.0)
+        """
+        fs = _scan(tmp_path, src, [JournalBeforeMutateRule()],
+                   relpath="obs/remediate.py")
+        assert [f.rule for f in _active(fs)] == ["KF106"]
+        assert "kick_timers" in fs[0].message
+
+    def test_journal_before_seam_ok(self, tmp_path):
+        src = """
+            def tick(self, pb, rec):
+                self._journal_rec(rec)
+                pb.action(rec)
+        """
+        fs = _scan(tmp_path, src, [JournalBeforeMutateRule()],
+                   relpath="obs/remediate.py")
+        assert fs == []
+
+    def test_seam_before_journal_flagged(self, tmp_path):
+        # The ordering matters, not mere presence of a journal call —
+        # acting first loses the record a crash-replay depends on.
+        src = """
+            def tick(self, pb, rec):
+                pb.action(rec)
+                self._journal_rec(rec)
+        """
+        fs = _scan(tmp_path, src, [JournalBeforeMutateRule()],
+                   relpath="obs/remediate.py")
+        assert [f.rule for f in _active(fs)] == ["KF106"]
+
+    def test_action_bound_closure_ok(self, tmp_path):
+        # Factory closures bound as Playbook(action=...) run strictly
+        # after the controller's journal write — covered one frame up.
+        src = """
+            def drain(lb):
+                def _act(rec):
+                    lb.set_backends([])
+                    return {}
+                return Playbook(name="d", objective="o", action=_act)
+        """
+        fs = _scan(tmp_path, src, [JournalBeforeMutateRule()],
+                   relpath="obs/remediate.py")
+        assert fs == []
+
+    def test_seam_in_precheck_closure_flagged(self, tmp_path):
+        # Prechecks are READ-ONLY probes that run before anything is
+        # journaled — a mutation there is exactly the bug class.
+        src = """
+            def drain(lb):
+                def _precheck(rec):
+                    lb.set_backends([])
+                    return True
+                def _act(rec):
+                    return {}
+                return Playbook(name="d", objective="o", action=_act,
+                                precheck=_precheck)
+        """
+        fs = _scan(tmp_path, src, [JournalBeforeMutateRule()],
+                   relpath="obs/remediate.py")
+        assert [f.rule for f in _active(fs)] == ["KF106"]
+
+    def test_outside_remediation_module_not_flagged(self, tmp_path):
+        src = """
+            def kick(self, manager):
+                manager.kick_timers(60.0)
+        """
+        fs = _scan(tmp_path, src, [JournalBeforeMutateRule()],
+                   relpath="controlplane/manager.py")
+        assert fs == []
+
+    def test_suppression_with_reason_honored(self, tmp_path):
+        src = """
+            def kick(self, manager):
+                # kftpu: allow(KF106): replay path; journaled upstream
+                manager.kick_timers(60.0)
+        """
+        fs = _scan(tmp_path, src, [JournalBeforeMutateRule()],
+                   relpath="obs/remediate.py")
+        assert _active(fs) == []
+        assert fs[0].suppressed
+
+    def test_reasonless_suppression_rejected(self, tmp_path):
+        src = """
+            def kick(self, manager):
+                # kftpu: allow(KF106)
+                manager.kick_timers(60.0)
+        """
+        fs = _scan(tmp_path, src, [JournalBeforeMutateRule()],
+                   relpath="obs/remediate.py")
+        assert sorted(f.rule for f in _active(fs)) == ["KF100", "KF106"]
 
 
 # ------------------------------------------------------------- engine

@@ -673,3 +673,31 @@ class TestServingSoak:
                                seed=7)
         assert rep.clean, rep
         assert rep.accounting_ok
+
+    def test_gray_failure_paged_drained_and_cleared(self):
+        """ISSUE 17: a *sick* backend passes health checks while its
+        queue wait is pathological — the flap/kill model can't see it.
+        The backend-queue-wait objective pages, the drain playbook
+        removes it, and the page CLEARS, all with routing invariants
+        intact."""
+        from kubeflow_tpu.chaos import run_serving_soak
+
+        rep = run_serving_soak(backends=3, rounds=12, seed=20260803,
+                               sick=True, remediate=True)
+        assert rep.clean, rep
+        assert rep.sicks >= 1                  # fault actually injected
+        assert rep.slo["pages"].get("backend-queue-wait", 0) >= 1
+        assert rep.remediation["actions"] >= 1
+        assert rep.slo["paging"] == []         # cleared, no operator
+        assert rep.remediation["pending"] == 0
+
+    def test_armed_clean_serving_soak_takes_no_actions(self):
+        """Do-no-harm: the same soak with the controller armed but no
+        sick injection must page nothing and act never."""
+        from kubeflow_tpu.chaos import run_serving_soak
+
+        rep = run_serving_soak(backends=3, rounds=12, seed=20260803,
+                               remediate=True)
+        assert rep.clean, rep
+        assert rep.slo["transitions"] == 0
+        assert rep.remediation["actions"] == 0

@@ -174,6 +174,23 @@ class TestLeaderElectionAndCrashReplay:
         assert rep.replay_identical
         assert sum(rep.injected.values()) > 0     # chaos actually fired
 
+    def test_sharded_soak_remediation_survives_shard_kill(self):
+        """ISSUE 17: with per-shard remediation armed, the mid-soak
+        SIGKILL must also replay actions.jsonl byte-identically — the
+        action journal rides the same WAL-dir recovery as alerts."""
+        from kubeflow_tpu.chaos import run_sharded_soak
+
+        rep = run_sharded_soak(num_jobs=4, shards=2, seed=3,
+                               kill_shard_round=4, fault_rounds=8,
+                               max_rounds=40, remediate=True)
+        assert rep.converged, rep.phases
+        assert rep.shard_kills == 1
+        assert rep.actions_replay_identical
+        assert rep.alerts_replay_identical
+        assert rep.remediation["actions_total"] >= 1
+        assert rep.remediation["pending"] == 0
+        assert rep.remediation["disabled"] == []
+
     def test_ci_shard_smoke_stage(self):
         from kubeflow_tpu.tools.ci import run_shard_smoke
 

@@ -332,6 +332,75 @@ class TestLBTenantMarket:
         blind = ServingLoadBalancer()
         assert blind.resolve_tenant({"tenant": "team-a"}) is None
 
+    def test_session_registry_tofu_binds_and_matching_ns_resolves(self):
+        from kubeflow_tpu.serving.lb import ServingLoadBalancer
+
+        lb = ServingLoadBalancer(tenants=TenantTree.from_specs(SPECS))
+        # Unbound session WITHOUT a namespace: PR-12 behaviour
+        # byte-identical — affinity works, traffic untenanted.
+        keys, tenant = lb._resolve_identity({"session": "s1"}, None)
+        assert "s:s1" in keys and tenant is None
+        assert "s1" not in lb.session_namespaces
+        # Unbound session WITH a namespace: trust-on-first-use bind.
+        keys, tenant = lb._resolve_identity(
+            {"session": "s1", "namespace": "team-a"}, None)
+        assert "s:s1" in keys and tenant == "team-a"
+        assert lb.session_namespaces["s1"] == "team-a"
+        # Bound + matching namespace: the honest-client path.
+        keys, tenant = lb._resolve_identity(
+            {"session": "s1", "namespace": "team-a"}, None)
+        assert "s:s1" in keys and tenant == "team-a"
+        assert lb.session_rejects == 0
+
+    def test_cross_tenant_session_spoof_rejected_403(self):
+        from kubeflow_tpu.serving.lb import RestError, ServingLoadBalancer
+
+        lb = ServingLoadBalancer(tenants=TenantTree.from_specs(SPECS))
+        lb.register_session("owner-sess", "team-a")
+        # A team-b client replaying team-a's session id must NOT
+        # inherit team-a's share (the PR-13 spoofing follow-up).
+        with pytest.raises(RestError) as ei:
+            lb._resolve_identity(
+                {"session": "owner-sess", "namespace": "team-b"}, None)
+        assert ei.value.status == 403
+        # Declared-tenant spoofing through the header leg too.
+        with pytest.raises(RestError) as ei:
+            lb._resolve_identity(
+                {"session": "owner-sess"},
+                {"x-kftpu-tenant": "team-b"})
+        assert ei.value.status == 403
+        assert lb.session_rejects == 2
+
+    def test_bare_bound_session_demoted_not_trusted(self):
+        from kubeflow_tpu.serving.blocks import prefix_key
+        from kubeflow_tpu.serving.lb import ServingLoadBalancer
+
+        lb = ServingLoadBalancer(tenants=TenantTree.from_specs(SPECS))
+        lb.register_session("owner-sess", "team-a")
+        # Session id alone (the stolen-bearer shape): the session
+        # affinity key is stripped and the request is untenanted.
+        # Session identity dominates key derivation, so nothing is
+        # left — the spoofer gets anonymous round-robin routing.
+        toks = list(range(64))
+        keys, tenant = lb._resolve_identity(
+            {"session": "owner-sess", "tokens": toks}, None)
+        assert "s:owner-sess" not in keys
+        assert keys == []
+        assert tenant is None
+        assert lb.session_rejects == 1
+        # Prompt-only traffic keeps its prefix-hash keys: those encode
+        # the prompt, not a stolen identity.
+        assert prefix_key(toks) in lb.affinity_keys({"tokens": toks})
+
+    def test_register_session_validates(self):
+        from kubeflow_tpu.serving.lb import ServingLoadBalancer
+
+        lb = ServingLoadBalancer()
+        with pytest.raises(ValueError):
+            lb.register_session("", "ns")
+        with pytest.raises(ValueError):
+            lb.register_session("s", "")
+
     def test_overage_math_weighted(self):
         from kubeflow_tpu.serving.lb import ServingLoadBalancer
 
