@@ -323,11 +323,26 @@ def bench_serving8b(args) -> None:
     # (bs48 1,945 tok/s at BETTER TTFT than bf16 bs40's 1,631; ladder to
     # 2,804 @ bs96). --quantize-kv '' selects the bf16 cache.
     kv = args.quantize_kv if args.quantize_kv is not None else "int8"
+    bs = args.batch_size or 48
+    # --paged (ISSUE 18): the decode cache is the physically paged pool.
+    # Dense HBM is bs x max_len rows per layer whether used or not (the
+    # bs112 OOM wall of r04); the pool is kv_blocks x kv_block_size rows
+    # TOTAL, sized to actual demand — ceil((prompt+gen)/block) blocks per
+    # concurrent sequence plus one fork-slack block each — so bs112 and
+    # 32k max_len fit the same 16G chip.
+    paged = getattr(args, "paged", False)
+    pbs = args.kv_block_size
+    blocks_per_seq = -(-(args.prompt_len + args.gen_len) // pbs)
+    kv_blocks = args.kv_blocks or bs * (blocks_per_seq + 1)
+    paged_model_kw = (
+        {"paged_kv_blocks": kv_blocks, "paged_kv_block_size": pbs}
+        if paged else {})
     model, mcfg = get_model(
         "llama3-8b", param_dtype="bfloat16",
         max_seq_len=args.max_len, scan_layers=False, remat=False,
         kv_cache_dtype=kv,
         decode_staging=args.decode_chunk,
+        **paged_model_kw,
     )
 
     def params():
@@ -343,9 +358,10 @@ def bench_serving8b(args) -> None:
     # 64 2,152 -> 80 2,509 -> 96 2,804 -> 112 OOM. bf16-KV tops at bs40
     # 1,631. int8 KV is also what makes max_len 1024 x 512-token prompts
     # possible at all: 898 tok/s at bs24.
-    bs = args.batch_size or 48
     requests = args.requests or 2 * bs
     bucket = 1 << (args.prompt_len - 1).bit_length()
+    paged_serve_kw = (
+        {"kv_blocks": kv_blocks, "kv_block_size": pbs} if paged else {})
     engine = ServingEngine(
         model, params,
         ServingConfig(
@@ -354,12 +370,20 @@ def bench_serving8b(args) -> None:
             quantize=args.quantize or "int8",
             param_dtype="bfloat16",
             prefill_buckets=(bucket,),
+            **paged_serve_kw,
         ),
     )
     kv_note = {"quantize_kv": kv} if kv else {}
     rng = np.random.default_rng(0)
+    # --shared-prefix-len: the prefix-heavy COW leg — every prompt opens
+    # with the same head (system-prompt shape), so in paged mode the
+    # sharers' leading blocks map to the SAME physical pages and the
+    # pool holds more concurrent sequences than its no-sharing capacity.
+    shared = min(args.shared_prefix_len, args.prompt_len)
+    head = rng.integers(1, mcfg.vocab_size, size=shared).tolist()
     prompts = [
-        rng.integers(1, mcfg.vocab_size, size=args.prompt_len).tolist()
+        head + rng.integers(
+            1, mcfg.vocab_size, size=args.prompt_len - shared).tolist()
         for _ in range(requests)
     ]
     engine.warmup(args.prompt_len)
@@ -380,6 +404,25 @@ def bench_serving8b(args) -> None:
     def pct(xs, p):
         return xs[min(len(xs) - 1, int(p * len(xs)))]
 
+    paged_note = {}
+    if paged:
+        # Hard gates the bench leg rides on: the two-layer COW
+        # conservation invariant must hold after the drain, and a
+        # prefix-heavy leg must actually have shared pages (non-vacuous).
+        engine.blocks.check_conservation()
+        snap = engine.blocks.snapshot()
+        if shared >= pbs:
+            assert snap["kv_shared_refs_total"] > 0, (
+                "prefix-heavy paged leg shared zero blocks")
+        paged_note = {
+            "paged": True, "kv_blocks": kv_blocks, "kv_block_size": pbs,
+            "kv_pool_rows": (kv_blocks + 1) * pbs,
+            "dense_cache_rows": bs * args.max_len,
+            "cow_copies_total": snap["kv_cow_copies_total"],
+            "shared_refs_total": snap["kv_shared_refs_total"],
+        }
+        if shared:
+            paged_note["shared_prefix_len"] = shared
     _emit(
         "llama3_8b_serving_tokens_per_sec_per_chip",
         gen_tokens / dt / ndev, "tokens/s/chip",
@@ -394,6 +437,7 @@ def bench_serving8b(args) -> None:
         prompt_len=args.prompt_len, gen_len=args.gen_len,
         decode_chunk=args.decode_chunk, max_len=args.max_len,
         **kv_note,
+        **paged_note,
     )
 
 
@@ -1645,6 +1689,22 @@ def main() -> None:
                         "model-generic)")
     p.add_argument("--max-len", type=int, default=512,
                    help="serving8b engine max_len (KV-cache bound)")
+    p.add_argument("--paged", action="store_true",
+                   help="serving8b: physically paged KV pool (ISSUE 18) "
+                        "— HBM is kv_blocks x kv_block_size rows total "
+                        "instead of batch x max_len, breaking the bs112 "
+                        "OOM wall and opening 32k max_len on 16G")
+    p.add_argument("--kv-blocks", type=int, default=None,
+                   help="serving8b --paged: physical pool blocks "
+                        "(default: batch x (blocks(prompt+gen) + 1 "
+                        "fork-slack))")
+    p.add_argument("--kv-block-size", type=int, default=16,
+                   help="serving8b --paged: tokens per physical block "
+                        "(max_len must divide evenly)")
+    p.add_argument("--shared-prefix-len", type=int, default=0,
+                   help="serving8b: all prompts open with this many "
+                        "common tokens (the prefix-heavy COW leg; "
+                        "effective at >= one kv block)")
     p.add_argument("--quantize", default="", choices=["", "int8"],
                    help="serving weight-only quantization")
     p.add_argument("--quantize-kv", default=None, choices=["", "int8"],

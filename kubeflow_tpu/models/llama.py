@@ -25,6 +25,13 @@ from jax.ad_checkpoint import checkpoint_name
 from kubeflow_tpu.ops.attention import mha_reference
 from kubeflow_tpu.ops.flash_attention import flash_attention
 from kubeflow_tpu.ops.norms import rms_norm
+from kubeflow_tpu.ops.paged_attention import (
+    gather_kv_pages,
+    paged_decode_attention,
+    physical_rows,
+    pool_shape,
+    scatter_kv_rows,
+)
 from kubeflow_tpu.ops.rope import apply_rope, rope_frequencies
 from kubeflow_tpu.parallel.context import constrain, get_context
 from kubeflow_tpu.parallel.pipeline import PipelinedLayers
@@ -114,6 +121,17 @@ class LlamaConfig:
     # ``stage_step`` and flush (ServingEngine does); 0 = classic per-step
     # writes.
     decode_staging: int = 0
+    # >0: the decode KV cache is a PHYSICALLY PAGED pool (ISSUE 18) —
+    # one [paged_kv_blocks + 1, paged_kv_block_size, Hkv, D] pool per
+    # layer shared by every slot instead of a dense [B, max_seq_len,
+    # Hkv, D] cache, with block id paged_kv_blocks reserved as the
+    # scratch page (see ops/paged_attention.py for the layout and
+    # exactness contract). Requires the caller to thread
+    # ``block_tables`` [B, max_blocks] (ServingEngine does, backed by
+    # serving/blocks.py tables with copy-on-write prefix sharing);
+    # shrinking the pool shrinks actual HBM, not just admission.
+    paged_kv_blocks: int = 0
+    paged_kv_block_size: int = 16
 
     @classmethod
     def llama3_8b(cls, **kw) -> "LlamaConfig":
@@ -230,6 +248,8 @@ class Attention(nn.Module):
         *,
         decode: bool = False,
         stage_step=None,
+        block_tables=None,
+        write_lens=None,
     ) -> jax.Array:
         cfg = self.cfg
         H, Hkv, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -265,8 +285,13 @@ class Attention(nn.Module):
         if decode:
             # decode is True (single-step against filled cache) or
             # "prefill" (fresh rows — causal over the incoming block).
-            out = self._decode_attention(q, k, v, mode=decode,
-                                         stage_step=stage_step)
+            if cfg.paged_kv_blocks > 0:
+                out = self._paged_decode_attention(
+                    q, k, v, mode=decode, stage_step=stage_step,
+                    block_tables=block_tables, write_lens=write_lens)
+            else:
+                out = self._decode_attention(q, k, v, mode=decode,
+                                             stage_step=stage_step)
         else:
             out = self._train_attention(q, k, v)
         out = constrain(out, ("act_batch", "act_seq", "act_heads", "act_kv"))
@@ -443,6 +468,119 @@ class Attention(nn.Module):
                                  mask=mask[:, None, :, :])
         return mha_reference(q, k, v, causal=True)
 
+    def _paged_decode_attention(self, q, k, v, mode=True, stage_step=None,
+                                block_tables=None, write_lens=None):
+        """Decode/prefill attention against the PHYSICALLY PAGED pool
+        (cfg.paged_kv_blocks > 0; layout + exactness contract in
+        ops/paged_attention.py).
+
+        Cache layout per layer: cached_key/cached_value are one
+        [P + 1, block_size, Hkv, Dh] pool shared by every slot (block P
+        = the scratch page); cache_index stays per-slot [B]. Writes land
+        at the physical rows ``block_tables`` maps each position to —
+        ``write_lens`` (prefill) redirects pad columns past each row's
+        true length to scratch, and positions past a table's allocated
+        span redirect automatically, so no junk write can touch a live
+        (possibly SHARED, copy-on-write) page. Reads gather the pages
+        back into dense position order and run the same reference
+        attention the dense cache runs — including the int8-KV
+        fused-dequant path via gathered scale pools."""
+        cfg = self.cfg
+        B = q.shape[0]
+        quant = cfg.kv_cache_dtype == "int8"
+        store_dtype = jnp.int8 if quant else cfg.dtype
+        P, bs = cfg.paged_kv_blocks, cfg.paged_kv_block_size
+        Hkv, Dh = cfg.num_kv_heads, cfg.head_dim
+        is_init = not self.has_variable("cache", "cached_key")
+        cached_key = self.variable(
+            "cache", "cached_key",
+            jnp.zeros, pool_shape(P, bs, Hkv, Dh), store_dtype,
+        )
+        cached_value = self.variable(
+            "cache", "cached_value",
+            jnp.zeros, pool_shape(P, bs, Hkv, Dh), store_dtype,
+        )
+        if quant:
+            key_scale = self.variable(
+                "cache", "key_scale",
+                jnp.zeros, pool_shape(P, bs, Hkv, Dh, trailing=1),
+                jnp.float32,
+            )
+            value_scale = self.variable(
+                "cache", "value_scale",
+                jnp.zeros, pool_shape(P, bs, Hkv, Dh, trailing=1),
+                jnp.float32,
+            )
+        staging = cfg.decode_staging
+        if staging > 0:
+            stage_key = self.variable(
+                "cache", "stage_key",
+                jnp.zeros, (B, staging, Hkv, Dh), cfg.dtype,
+            )
+            stage_value = self.variable(
+                "cache", "stage_value",
+                jnp.zeros, (B, staging, Hkv, Dh), cfg.dtype,
+            )
+        cache_index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((B,), jnp.int32)
+        )
+        if is_init or block_tables is None:
+            # Shape-only init (engine _init_cache) or a caller that never
+            # wired tables: no pool I/O, plain causal attention.
+            return mha_reference(q, k, v, causal=True)
+        idx = cache_index.value                    # [B]
+        S_new = q.shape[1]
+        if mode is True and staging > 0 and stage_step is not None:
+            # Staged decode step: stage write is identical to dense
+            # (per-slot staging rows are NOT paged — they are B x C
+            # working rows, not cache residency); attention gathers the
+            # pool into dense order and joins [pool | staged] in one
+            # softmax exactly as the dense staged path does.
+            stage_key.value = jax.lax.dynamic_update_slice_in_dim(
+                stage_key.value, k.astype(cfg.dtype), stage_step, axis=1)
+            stage_value.value = jax.lax.dynamic_update_slice_in_dim(
+                stage_value.value, v.astype(cfg.dtype), stage_step, axis=1)
+            return _staged_decode_attention(
+                cfg, q, idx, stage_step,
+                gather_kv_pages(cached_key.value, block_tables, bs),
+                gather_kv_pages(cached_value.value, block_tables, bs),
+                stage_key.value, stage_value.value,
+                gather_kv_pages(key_scale.value, block_tables, bs)
+                if quant else None,
+                gather_kv_pages(value_scale.value, block_tables, bs)
+                if quant else None,
+            )
+        positions = idx[:, None] + jnp.arange(S_new)[None, :]   # [B, S]
+        valid = None
+        if write_lens is not None:
+            valid = positions < write_lens[:, None]
+        rows = physical_rows(block_tables, positions, bs,
+                             num_blocks=P, valid=valid)
+        if quant:
+            k8, ks = quantize_kv_rows(k)
+            v8, vs = quantize_kv_rows(v)
+            cached_key.value = scatter_kv_rows(cached_key.value, rows, k8)
+            cached_value.value = scatter_kv_rows(
+                cached_value.value, rows, v8)
+            key_scale.value = scatter_kv_rows(key_scale.value, rows, ks)
+            value_scale.value = scatter_kv_rows(value_scale.value, rows, vs)
+        else:
+            cached_key.value = scatter_kv_rows(
+                cached_key.value, rows, k.astype(cfg.dtype))
+            cached_value.value = scatter_kv_rows(
+                cached_value.value, rows, v.astype(cfg.dtype))
+        cache_index.value = idx + S_new
+        if mode == "prefill":
+            # Fresh rows attend only the LIVE k/v (same as dense prefill:
+            # no cache read, quantization-independent accuracy).
+            return flash_attention(q, k, v, causal=True)
+        return paged_decode_attention(
+            q, cached_key.value, cached_value.value, block_tables,
+            positions, bs,
+            key_scale_pool=key_scale.value if quant else None,
+            value_scale_pool=value_scale.value if quant else None,
+        )
+
 
 def quantize_kv_rows(x):
     """Absmax int8 per (.., position, kv-head) row: returns (int8 rows,
@@ -528,12 +666,14 @@ class DecoderLayer(nn.Module):
     @nn.compact
     def __call__(
         self, x: jax.Array, positions: jax.Array, decode: bool = False,
-        stage_step=None,
+        stage_step=None, block_tables=None, write_lens=None,
     ) -> jax.Array:
         cfg = self.cfg
         h = RMSNorm(cfg, name="input_norm")(x)
         h = Attention(cfg, name="attn")(h, positions, decode=decode,
-                                        stage_step=stage_step)
+                                        stage_step=stage_step,
+                                        block_tables=block_tables,
+                                        write_lens=write_lens)
         x = x + h
         h = RMSNorm(cfg, name="post_attn_norm")(x)
         h = Mlp(cfg, name="mlp")(h)
@@ -568,6 +708,8 @@ class Llama(nn.Module):
         decode: bool = False,
         return_hidden: bool = False,
         stage_step=None,
+        block_tables=None,
+        write_lens=None,
     ) -> jax.Array:
         cfg = self.cfg
         B, S = tokens.shape
@@ -628,7 +770,8 @@ class Llama(nn.Module):
         elif cfg.scan_layers:
             x, _ = nn.scan(
                 lambda mdl, carry, _: (
-                    mdl(carry, positions, decode, stage_step), None),
+                    mdl(carry, positions, decode, stage_step,
+                        block_tables, write_lens), None),
                 variable_axes={c: 0 for c in self.SCAN_COLLECTIONS},
                 split_rngs={r: True for r in self.SCAN_RNGS},
                 length=cfg.num_layers,
@@ -637,7 +780,8 @@ class Llama(nn.Module):
         else:
             for i in range(cfg.num_layers):
                 x = layer_cls(cfg, name=f"layer_{i}")(
-                    x, positions, decode, stage_step)
+                    x, positions, decode, stage_step,
+                    block_tables, write_lens)
 
         x = RMSNorm(cfg, name="final_norm")(x)
         if return_hidden:

@@ -139,12 +139,14 @@ class MixtralLayer(nn.Module):
     @nn.compact
     def __call__(
         self, x: jax.Array, positions: jax.Array, decode: bool = False,
-        stage_step=None,
+        stage_step=None, block_tables=None, write_lens=None,
     ) -> jax.Array:
         cfg = self.cfg
         h = RMSNorm(cfg, name="input_norm")(x)
         h = Attention(cfg, name="attn")(h, positions, decode=decode,
-                                        stage_step=stage_step)
+                                        stage_step=stage_step,
+                                        block_tables=block_tables,
+                                        write_lens=write_lens)
         x = x + h
         h = RMSNorm(cfg, name="post_attn_norm")(x)
         h = MoeMlp(cfg, name="moe")(h)

@@ -12,6 +12,13 @@ from kubeflow_tpu.ops.attention import (
     segment_mask,
 )
 from kubeflow_tpu.ops.norms import rms_norm
+from kubeflow_tpu.ops.paged_attention import (
+    copy_block,
+    gather_kv_pages,
+    paged_decode_attention,
+    physical_rows,
+    scatter_kv_rows,
+)
 from kubeflow_tpu.ops.rope import apply_rope, rope_frequencies
 
 __all__ = [
@@ -21,4 +28,9 @@ __all__ = [
     "rms_norm",
     "apply_rope",
     "rope_frequencies",
+    "gather_kv_pages",
+    "paged_decode_attention",
+    "physical_rows",
+    "scatter_kv_rows",
+    "copy_block",
 ]

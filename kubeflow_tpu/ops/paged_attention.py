@@ -1,0 +1,159 @@
+"""Block-gathered decode attention over a physically paged KV pool.
+
+The serving engine's dense decode cache is ``[B, max_len, Hkv, D]`` per
+layer — ``max_batch x max_len`` rows resident whether or not any
+sequence uses them, which is what OOMs the int8-KV batch ladder at bs112
+on a 16G chip (SERVING8B_r04). Here the cache is ONE physical pool
+
+    ``[kv_blocks + 1, block_size, Hkv, D]``
+
+shared by every slot, and each sequence maps its logical positions onto
+pool pages through a **block table** ``[B, max_blocks]`` of physical
+block ids (serving/blocks.py allocates them; copy-on-write prefix
+sharing maps common prompt heads to the SAME page). Shrinking
+``kv_blocks`` now shrinks actual HBM, not just admission.
+
+Layout contract:
+- logical position ``p`` of slot ``b`` lives at pool row
+  ``table[b, p // block_size] * block_size + p % block_size``;
+- physical block id ``kv_blocks`` (the LAST block) is the **scratch
+  page**: writes that must go nowhere — inactive slots, prefill pad
+  columns past a row's true length, speculative decode tail past a
+  table's allocated span — are redirected there, so the jitted steps
+  keep static shapes without ever touching a live sequence's pages.
+  Nothing ever reads scratch: gathered scratch rows sit behind the
+  causal/live-length mask.
+
+Exactness contract (the dense-vs-paged token parity gate): the gather
+reproduces dense position order ``[0, max_blocks * block_size)``; junk
+rows differ from the dense cache's junk but every junk column is masked
+to ``-inf`` before the softmax in BOTH paths, so logits, weights and
+output are bitwise identical when ``max_len == max_blocks * block_size``
+(the engine asserts ``max_len % block_size == 0`` in paged mode).
+Attention math is deliberately NOT reimplemented — the gather feeds
+:func:`kubeflow_tpu.ops.attention.mha_reference`, including its int8-KV
+fused-dequant path (pool enters the einsums through a bare dtype
+convert, per-row scales apply on the logits/weights side).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.ops.attention import mha_reference
+
+
+def pool_shape(kv_blocks: int, block_size: int, num_kv_heads: int,
+               head_dim: int, *, trailing: int = 0) -> Tuple[int, ...]:
+    """Physical pool shape: ``kv_blocks`` live pages plus the trailing
+    scratch page. ``trailing`` overrides the last axis (1 for the f32
+    scale pools of the int8 KV path, head_dim for K/V)."""
+    return (int(kv_blocks) + 1, int(block_size), int(num_kv_heads),
+            int(trailing) if trailing else int(head_dim))
+
+
+def scratch_block_id(kv_blocks: int) -> int:
+    """Physical id of the scratch page (always the pool's last block)."""
+    return int(kv_blocks)
+
+
+def physical_rows(tables: jax.Array, positions: jax.Array,
+                  block_size: int, *,
+                  num_blocks: int,
+                  valid: Optional[jax.Array] = None) -> jax.Array:
+    """Flat pool-row index of each logical position.
+
+    tables: [B, max_blocks] int32 physical block ids (scratch-padded);
+    positions: [B, S] logical positions; valid: optional [B, S] bool —
+    False rows redirect to the scratch page (row 0 of it; scratch
+    content is never read, only overwritten). Positions past the table
+    width also redirect, so speculative decode past a sequence's
+    allocated span can never touch another sequence's pages."""
+    bs = int(block_size)
+    blk = positions // bs
+    off = positions % bs
+    in_table = blk < tables.shape[1]
+    blk_safe = jnp.minimum(blk, tables.shape[1] - 1)
+    phys_blk = jnp.take_along_axis(tables, blk_safe, axis=1)
+    rows = phys_blk * bs + off
+    scratch_row = jnp.int32(scratch_block_id(num_blocks) * bs)
+    ok = in_table if valid is None else (in_table & valid)
+    return jnp.where(ok, rows, scratch_row)
+
+
+def gather_kv_pages(pool: jax.Array, tables: jax.Array,
+                    block_size: int) -> jax.Array:
+    """Gather each slot's pages into dense position order.
+
+    pool: [kv_blocks + 1, block_size, Hkv, trailing];
+    tables: [B, max_blocks] -> [B, max_blocks * block_size, Hkv,
+    trailing]. One ``jnp.take`` over the block axis — the whole gather
+    is a single XLA gather the TPU runs at HBM bandwidth; cost model:
+    decode reads exactly the same bytes the dense cache read
+    (max_blocks * block_size rows per slot), the win is RESIDENCY (the
+    pool holds kv_blocks pages total, not B * max_len rows)."""
+    B = tables.shape[0]
+    g = jnp.take(pool, tables, axis=0)    # [B, max_blocks, bs, Hkv, t]
+    return g.reshape(B, tables.shape[1] * int(block_size), *pool.shape[2:])
+
+
+def scatter_kv_rows(pool: jax.Array, rows: jax.Array,
+                    values: jax.Array) -> jax.Array:
+    """Write per-position rows into the pool. rows: [B, S] flat pool-row
+    ids (from :func:`physical_rows`); values: [B, S, Hkv, trailing].
+    Duplicate row ids only ever carry identical values (idempotent
+    prefill rewrites of a shared prefix; scratch junk) — the scatter is
+    deterministic for those by construction."""
+    flat = pool.reshape((-1,) + pool.shape[2:])
+    flat = flat.at[rows.reshape(-1)].set(
+        values.reshape((-1,) + values.shape[2:]))
+    return flat.reshape(pool.shape)
+
+
+def copy_block(pool: jax.Array, src_block, dst_block) -> jax.Array:
+    """Copy one physical page src -> dst (the copy half of copy-on-
+    write: a writer forking a shared block gets the page's current
+    contents — shared prefix rows it must keep attending over — in its
+    private copy before its first write lands)."""
+    page = jax.lax.dynamic_slice_in_dim(
+        pool, jnp.asarray(src_block, jnp.int32), 1, axis=0)
+    return jax.lax.dynamic_update_slice_in_dim(
+        pool, page, jnp.asarray(dst_block, jnp.int32), axis=0)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    key_pool: jax.Array,
+    value_pool: jax.Array,
+    tables: jax.Array,
+    q_positions: jax.Array,
+    block_size: int,
+    *,
+    key_scale_pool: Optional[jax.Array] = None,
+    value_scale_pool: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Decode attention with the KV context gathered by block table.
+
+    q: [B, S, H, D] (S = 1 single-step, or a chunk for chunked prefill);
+    key/value_pool: [kv_blocks + 1, block_size, Hkv, D];
+    tables: [B, max_blocks]; q_positions: [B, S] absolute positions of
+    the query rows (per-slot cache_index offsets). Masks every gathered
+    column past each query's position — junk pages (scratch, another
+    sequence's not-yet-shared rows, beyond-live-length) never reach the
+    softmax — then runs the standard GQA-folded reference attention,
+    with the int8-KV scale pools gathered alongside and applied on the
+    small logits/weights side exactly as the dense path does."""
+    k = gather_kv_pages(key_pool, tables, block_size)
+    v = gather_kv_pages(value_pool, tables, block_size)
+    Lp = k.shape[1]
+    kv_pos = jnp.arange(Lp)[None, None, :]
+    mask = kv_pos <= q_positions[:, :, None]          # [B, S, Lp]
+    ks = vs = None
+    if key_scale_pool is not None:
+        ks = gather_kv_pages(key_scale_pool, tables, block_size)
+        vs = gather_kv_pages(value_scale_pool, tables, block_size)
+    return mha_reference(q, k, v, mask=mask[:, None, :, :],
+                         k_scale=ks, v_scale=vs)

@@ -1,0 +1,39 @@
+"""Version shims for JAX APIs that moved between releases.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the top-level
+``jax`` namespace, and its replication-check kwarg was renamed
+``check_rep`` -> ``check_vma`` in the same move. The callers here are
+written against the new spelling; this shim translates for the pinned
+older JAX in the container.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis, usable inside shard_map bodies.
+
+    ``lax.axis_size`` only exists in newer JAX; on the pinned 0.4.x the
+    static size is what ``jax.core.axis_frame`` resolves for the name.
+    """
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return jax.core.axis_frame(axis_name)
+
+if hasattr(jax, "shard_map"):
+    def shard_map(fn, *, mesh, in_specs, out_specs, check_vma=False):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+else:  # JAX <= 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(fn, *, mesh, in_specs, out_specs, check_vma=False):
+        return _shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )

@@ -23,6 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kubeflow_tpu.parallel.compat import axis_size, shard_map
+
 _NEG = -1e30  # finite stand-in for -inf: keeps exp() NaN-free when a whole
               # block is masked (see online-softmax update below)
 
@@ -157,7 +159,7 @@ def ring_attention(
         raise ValueError(f"query heads {H} not a multiple of kv heads {Hkv}")
     scale_ = (D ** -0.5) if scale is None else scale
 
-    P_ = lax.axis_size(axis_name)
+    P_ = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     q_offset = idx * Sq
 
@@ -305,7 +307,7 @@ def ring_attention_sharded(
         ring_attention, axis_name=axis_name, causal=causal, scale=scale,
         zigzag=zigzag,
     )
-    return jax.shard_map(
+    return shard_map(
         fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -21,6 +21,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kubeflow_tpu.parallel.compat import axis_size, shard_map
+
 from kubeflow_tpu.ops.flash_attention import flash_attention
 
 
@@ -37,7 +39,7 @@ def ulysses_attention(
     ``axis_name``. Per-device shapes: q [B, S/P, H, D], k/v [B, S/P, Hkv, D].
     Requires H % P == 0 (and Hkv repeated up to P if needed).
     """
-    P_ = lax.axis_size(axis_name)
+    P_ = axis_size(axis_name)
     B, Sq, H, D = q.shape
     _, _, Hkv, _ = k.shape
     if H % P_ != 0:
@@ -86,7 +88,7 @@ def ulysses_attention_sharded(
     fn = functools.partial(
         ulysses_attention, axis_name=axis_name, causal=causal, scale=scale
     )
-    return jax.shard_map(
+    return shard_map(
         fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),

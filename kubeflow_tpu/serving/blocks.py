@@ -16,11 +16,24 @@ count is an integer, and the conservation invariant
 
 is checked structurally — ``check_conservation`` additionally proves the
 free list and the live tables partition the block id space exactly
-(no block leaked, none resident in two tables, none both free and live).
+(no block leaked, none both free and live).
 A double free or a free of an unknown sequence raises
 ``BlockAccountingError`` instead of silently corrupting the free list:
 use-after-free across the retire/admit race is an invariant violation,
 never a shrug.
+
+Blocks are **refcounted** for copy-on-write prefix sharing (ISSUE 18):
+sequences whose prompts share a block-aligned prefix map the shared
+leading blocks to the SAME physical block ids (``alloc(..., shared=)``),
+so N sessions over one system prompt pin the prefix pages once. A write
+into a shared block must fork it first (``write_fork``), which claims a
+fresh physical block for the writer and decrefs the original. The
+ledger distinguishes *physical* events (pops from / returns to the free
+list — what HBM sees) from *logical* references (table entries):
+``blocks_live`` is unique physical blocks, ``table_refs`` is the sum of
+table lengths, and ``check_conservation`` proves both layers — the free
+list + unique live blocks partition the id space AND refcounts sum
+exactly to table references with every live refcount ≥ 1.
 
 Shared by the real ``ServingEngine`` (admission gating + load reports)
 and the bench's ``SimServingReplica`` double (tools/loadtest.py), so the
@@ -119,12 +132,24 @@ class KVBlockAllocator:
         # rows are the ones most likely still warm in HBM/cache).
         self._free: List[int] = list(range(self.total_blocks - 1, -1, -1))
         self._tables: Dict[object, List[int]] = {}
+        # Refcount per LIVE physical block id (present iff live). A block
+        # referenced by k tables has refcount k; it returns to the free
+        # list only when the count reaches zero.
+        self._ref: Dict[int, int] = {}
         self._lock = threading.Lock()
         # Cumulative ledger counters (ints, monotone): the conservation
-        # invariant is allocated == freed + live at every instant.
+        # invariant is allocated == freed + live at every instant, where
+        # allocated/freed count PHYSICAL free-list pops/returns (a shared
+        # reference is not an allocation — HBM did not grow).
         self.blocks_allocated_total = 0
         self.blocks_freed_total = 0
         self.high_water_blocks = 0
+        # COW ledger: forks taken because a writer hit a block whose
+        # refcount was > 1.
+        self.cow_copies_total = 0
+        # Logical sharing ledger: shared references taken via alloc(...,
+        # shared=) — each is one table entry that cost zero free blocks.
+        self.shared_refs_total = 0
 
     # ------------- sizing -------------
 
@@ -137,8 +162,25 @@ class KVBlockAllocator:
 
     @property
     def blocks_live(self) -> int:
+        """UNIQUE physical blocks held by live tables — the HBM-governing
+        count. Equal to the sum of table lengths only when nothing is
+        shared."""
+        with self._lock:
+            return len(self._ref)
+
+    @property
+    def table_refs(self) -> int:
+        """Logical references: sum of table lengths (≥ blocks_live; the
+        gap is sharing)."""
         with self._lock:
             return sum(len(t) for t in self._tables.values())
+
+    @property
+    def blocks_shared(self) -> int:
+        """Physical blocks currently referenced by more than one table —
+        the pages COW sharing is saving."""
+        with self._lock:
+            return sum(1 for c in self._ref.values() if c > 1)
 
     @property
     def blocks_free(self) -> int:
@@ -155,32 +197,71 @@ class KVBlockAllocator:
             t = self._tables.get(seq_id)
             return list(t) if t is not None else None
 
-    def can_alloc(self, tokens: int) -> bool:
+    def refcount(self, block_id: int) -> int:
+        """Live refcount of a physical block (0 = free/unknown). The
+        engine's COW-prepare pass uses this to find the shared blocks a
+        dispatch's write range is about to touch."""
         with self._lock:
-            return self.blocks_for_tokens(tokens) <= len(self._free)
+            return self._ref.get(int(block_id), 0)
+
+    def can_alloc(self, tokens: int, shared: int = 0) -> bool:
+        """Whether a request of ``tokens`` positions is admissible.
+        ``shared`` leading blocks (already live, to be referenced via
+        ``alloc(..., shared=)``) cost nothing from the free list."""
+        with self._lock:
+            need = max(0, self.blocks_for_tokens(tokens) - int(shared))
+            return need <= len(self._free)
 
     # ------------- mutation -------------
 
-    def alloc(self, seq_id, tokens: int) -> List[int]:
+    def alloc(self, seq_id, tokens: int,
+              shared: Optional[Sequence[int]] = None) -> List[int]:
         """Claim the blocks covering ``tokens`` positions for ``seq_id``.
-        Raises BlocksExhausted when the free list cannot cover it (the
-        request stays queued) and BlockAccountingError when the sequence
-        already holds a table (an admit/retire bookkeeping bug)."""
+
+        ``shared`` maps the sequence's LEADING blocks onto already-live
+        physical ids (COW prefix sharing): each listed id gets its
+        refcount bumped instead of a free-list pop, so only the remainder
+        costs physical blocks. Every shared id must currently be live.
+
+        Raises BlocksExhausted when the free list cannot cover the
+        non-shared remainder (the request stays queued) and
+        BlockAccountingError when the sequence already holds a table or
+        a shared id is not live (an admit/retire bookkeeping bug)."""
         n = self.blocks_for_tokens(tokens)
+        shared = list(shared or [])
+        if len(shared) > n:
+            raise BlockAccountingError(
+                f"sequence {seq_id!r}: {len(shared)} shared blocks exceed "
+                f"the {n}-block table for {tokens} tokens"
+            )
         with self._lock:
             if seq_id in self._tables:
                 raise BlockAccountingError(
                     f"sequence {seq_id!r} already holds "
                     f"{len(self._tables[seq_id])} blocks — double alloc"
                 )
-            if n > len(self._free):
+            for b in shared:
+                if b not in self._ref:
+                    raise BlockAccountingError(
+                        f"shared block {b} is not live — cannot take a "
+                        "prefix reference on a free or unknown block"
+                    )
+            fresh_n = n - len(shared)
+            if fresh_n > len(self._free):
                 raise BlocksExhausted(
-                    f"need {n} blocks for {tokens} tokens, "
+                    f"need {fresh_n} blocks for {tokens} tokens "
+                    f"({len(shared)} shared), "
                     f"{len(self._free)}/{self.total_blocks} free"
                 )
-            got = [self._free.pop() for _ in range(n)]
+            for b in shared:
+                self._ref[b] += 1
+            fresh = [self._free.pop() for _ in range(fresh_n)]
+            for b in fresh:
+                self._ref[b] = 1
+            got = [int(b) for b in shared] + fresh
             self._tables[seq_id] = got
-            self.blocks_allocated_total += n
+            self.blocks_allocated_total += fresh_n
+            self.shared_refs_total += len(shared)
             live = self.total_blocks - len(self._free)
             if live > self.high_water_blocks:
                 self.high_water_blocks = live
@@ -206,6 +287,8 @@ class KVBlockAllocator:
                     f"need {need} more blocks, {len(self._free)} free"
                 )
             got = [self._free.pop() for _ in range(need)]
+            for b in got:
+                self._ref[b] = 1
             t.extend(got)
             self.blocks_allocated_total += need
             live = self.total_blocks - len(self._free)
@@ -213,10 +296,61 @@ class KVBlockAllocator:
                 self.high_water_blocks = live
             return list(got)
 
+    def write_fork(self, seq_id, block_pos: int) -> Optional[tuple]:
+        """Copy-on-write: ensure ``seq_id`` exclusively owns the block at
+        table position ``block_pos`` before a KV write lands in it.
+
+        If the block's refcount is 1 the write is already safe and this
+        returns None. Otherwise a fresh physical block is claimed, the
+        table entry is swapped to it, the original is decref'd, and
+        ``(old_id, new_id)`` is returned so the caller can copy the
+        page's contents old→new in the physical pool. Raises
+        BlocksExhausted when no free block exists to fork into and
+        BlockAccountingError for an unknown sequence or bad position."""
+        with self._lock:
+            t = self._tables.get(seq_id)
+            if t is None:
+                raise BlockAccountingError(
+                    f"write_fork of unknown sequence {seq_id!r} — "
+                    "use-after-free or never-admitted"
+                )
+            if not (0 <= block_pos < len(t)):
+                raise BlockAccountingError(
+                    f"write_fork position {block_pos} outside "
+                    f"{seq_id!r}'s {len(t)}-block table"
+                )
+            old = t[block_pos]
+            if self._ref.get(old, 0) <= 0:
+                raise BlockAccountingError(
+                    f"block {old} in {seq_id!r}'s table has no live "
+                    "refcount — ledger corruption"
+                )
+            if self._ref[old] == 1:
+                return None
+            if not self._free:
+                raise BlocksExhausted(
+                    f"COW fork of block {old} needs a free block, "
+                    f"0/{self.total_blocks} free"
+                )
+            new = self._free.pop()
+            self._ref[old] -= 1
+            self._ref[new] = 1
+            t[block_pos] = new
+            self.blocks_allocated_total += 1
+            self.cow_copies_total += 1
+            live = self.total_blocks - len(self._free)
+            if live > self.high_water_blocks:
+                self.high_water_blocks = live
+            return (old, new)
+
     def free(self, seq_id) -> int:
-        """Return every block ``seq_id`` holds to the free list; returns
-        the count. A second free of the same sequence (or a free of one
-        never admitted) raises — each block is freed exactly once."""
+        """Drop every reference ``seq_id`` holds; blocks whose refcount
+        reaches zero return to the free list. Returns the PHYSICAL count
+        freed (≤ table length when blocks were shared — retiring one
+        reader of a shared prefix must not free pages its siblings still
+        attend over). A second free of the same sequence (or a free of
+        one never admitted) raises — each reference is dropped exactly
+        once."""
         with self._lock:
             t = self._tables.pop(seq_id, None)
             if t is None:
@@ -224,52 +358,98 @@ class KVBlockAllocator:
                     f"free of unknown sequence {seq_id!r} — double free "
                     "or never-admitted"
                 )
-            self._free.extend(reversed(t))
-            self.blocks_freed_total += len(t)
-            return len(t)
+            physical = 0
+            for b in reversed(t):
+                c = self._ref.get(b, 0)
+                if c <= 0:
+                    raise BlockAccountingError(
+                        f"block {b} freed by {seq_id!r} has no live "
+                        "refcount — double free of a shared block"
+                    )
+                if c == 1:
+                    del self._ref[b]
+                    self._free.append(b)
+                    physical += 1
+                else:
+                    self._ref[b] = c - 1
+            self.blocks_freed_total += physical
+            return physical
 
     # ------------- invariants -------------
 
     def conservation_ok(self) -> bool:
         with self._lock:
-            live = sum(len(t) for t in self._tables.values())
+            live = len(self._ref)
             return (self.blocks_allocated_total
                     == self.blocks_freed_total + live)
 
     def check_conservation(self) -> None:
         """Raise BlockAccountingError unless the full ledger invariant
-        holds: allocated == freed + live (integer-exact), free + live
-        == total, and the free list + live tables PARTITION the block id
-        space (every id exactly once across both)."""
+        holds, both layers:
+
+        physical — allocated == freed + unique live (integer-exact),
+        free + unique live == total, and the free list + UNIQUE live
+        blocks PARTITION the block id space (every id exactly once
+        across both);
+
+        logical — every table entry has a live refcount, refcounts sum
+        exactly to the number of table references, and every live
+        refcount is ≥ 1 (no orphaned count, no zero-ref live block)."""
         with self._lock:
-            live_ids: List[int] = []
+            refs_from_tables: Dict[int, int] = {}
+            table_refs = 0
             for t in self._tables.values():
-                live_ids.extend(t)
-            live = len(live_ids)
-            if self.blocks_allocated_total != self.blocks_freed_total + live:
+                table_refs += len(t)
+                for b in t:
+                    refs_from_tables[b] = refs_from_tables.get(b, 0) + 1
+            unique_live = len(refs_from_tables)
+            if (self.blocks_allocated_total
+                    != self.blocks_freed_total + unique_live):
                 raise BlockAccountingError(
                     f"conservation broken: allocated "
                     f"{self.blocks_allocated_total} != freed "
-                    f"{self.blocks_freed_total} + live {live}"
+                    f"{self.blocks_freed_total} + live {unique_live}"
                 )
-            if len(self._free) + live != self.total_blocks:
+            if len(self._free) + unique_live != self.total_blocks:
                 raise BlockAccountingError(
-                    f"pool leak: free {len(self._free)} + live {live} "
-                    f"!= total {self.total_blocks}"
+                    f"pool leak: free {len(self._free)} + live "
+                    f"{unique_live} != total {self.total_blocks}"
                 )
             seen = set(self._free)
             if len(seen) != len(self._free):
                 raise BlockAccountingError("free list holds duplicates")
-            for b in live_ids:
+            for b in refs_from_tables:
                 if b in seen:
                     raise BlockAccountingError(
-                        f"block {b} is both free and live (or live in "
-                        "two tables)"
+                        f"block {b} is both free and live"
                     )
                 seen.add(b)
             if seen != set(range(self.total_blocks)):
                 raise BlockAccountingError(
                     "free list + tables do not cover the block id space"
+                )
+            if refs_from_tables != self._ref:
+                for b, c in refs_from_tables.items():
+                    rc = self._ref.get(b, 0)
+                    if rc != c:
+                        raise BlockAccountingError(
+                            f"block {b}: refcount {rc} != {c} table "
+                            "references"
+                        )
+                orphans = set(self._ref) - set(refs_from_tables)
+                raise BlockAccountingError(
+                    f"refcounts held for blocks in no table: "
+                    f"{sorted(orphans)}"
+                )
+            for b, c in self._ref.items():
+                if c < 1:
+                    raise BlockAccountingError(
+                        f"live block {b} has refcount {c} < 1"
+                    )
+            if sum(self._ref.values()) != table_refs:
+                raise BlockAccountingError(
+                    f"refcount sum {sum(self._ref.values())} != "
+                    f"{table_refs} table references"
                 )
 
     # ------------- reporting -------------
@@ -278,7 +458,9 @@ class KVBlockAllocator:
         """Point-in-time ledger view (the engine load() / bench report
         shape)."""
         with self._lock:
-            live = sum(len(t) for t in self._tables.values())
+            live = len(self._ref)
+            table_refs = sum(len(t) for t in self._tables.values())
+            shared = sum(1 for c in self._ref.values() if c > 1)
             return {
                 "kv_block_size": self.block_size,
                 "kv_blocks_total": self.total_blocks,
@@ -287,6 +469,10 @@ class KVBlockAllocator:
                 "kv_blocks_allocated_total": self.blocks_allocated_total,
                 "kv_blocks_freed_total": self.blocks_freed_total,
                 "kv_blocks_high_water": self.high_water_blocks,
+                "kv_blocks_shared": shared,
+                "kv_table_refs": table_refs,
+                "kv_cow_copies_total": self.cow_copies_total,
+                "kv_shared_refs_total": self.shared_refs_total,
                 "kv_sequences_live": len(self._tables),
                 "kv_conservation_ok": (
                     self.blocks_allocated_total

@@ -33,6 +33,7 @@ import collections
 import contextlib
 import dataclasses
 import functools
+import hashlib
 import itertools
 import threading
 import time
@@ -46,6 +47,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from kubeflow_tpu.parallel.context import parallel_context
 from kubeflow_tpu.parallel.sharding import DEFAULT_RULES, Rules, param_shardings
+from kubeflow_tpu.ops.paged_attention import (
+    copy_block,
+    physical_rows,
+    scatter_kv_rows,
+)
 from kubeflow_tpu.serving.blocks import (
     BlocksExhausted,
     KVBlockAllocator,
@@ -338,6 +344,40 @@ class ServingEngine:
                 "decode_staging requires scan_layers=False (the serving "
                 "layout; see models/layout.py for checkpoint adaptation)"
             )
+        # Physically paged HBM (ISSUE 18): a model built with
+        # paged_kv_blocks > 0 stores its decode cache as ONE
+        # [kv_blocks + 1, block_size, Hkv, D] pool per layer and the
+        # engine's block tables govern real memory — the allocator's
+        # ledger and the pool are the same blocks. The geometry must
+        # agree exactly or the physical rows the tables address don't
+        # exist.
+        self._paged = int(getattr(model.cfg, "paged_kv_blocks", 0) or 0) > 0
+        if self._paged:
+            if getattr(model.cfg, "scan_layers", False):
+                raise ValueError(
+                    "paged_kv_blocks requires scan_layers=False (the "
+                    "serving layout; the paged tree surgery walks per-"
+                    "layer cache dicts)"
+                )
+            if model.cfg.paged_kv_block_size != cfg.kv_block_size:
+                raise ValueError(
+                    f"model paged_kv_block_size "
+                    f"{model.cfg.paged_kv_block_size} != engine "
+                    f"kv_block_size {cfg.kv_block_size}"
+                )
+            if cfg.kv_blocks != model.cfg.paged_kv_blocks:
+                raise ValueError(
+                    f"engine kv_blocks {cfg.kv_blocks} != model pool "
+                    f"paged_kv_blocks {model.cfg.paged_kv_blocks} — the "
+                    "accounting ledger and the physical pool must be the "
+                    "same blocks"
+                )
+            if cfg.max_len % cfg.kv_block_size != 0:
+                raise ValueError(
+                    f"paged serving needs max_len {cfg.max_len} divisible "
+                    f"by kv_block_size {cfg.kv_block_size} (the dense-vs-"
+                    "paged exactness contract; see ops/paged_attention.py)"
+                )
         self.model = model
         self.cfg = cfg
         self.mesh = mesh
@@ -395,6 +435,42 @@ class ServingEngine:
             "KV-cache blocks in the pool",
         )
         self.metrics_kv_blocks_total.set(float(self.blocks.total_blocks))
+        self.metrics_kv_blocks_shared = registry.gauge(
+            "kftpu_serving_kv_blocks_shared",
+            "Physical KV blocks referenced by more than one sequence "
+            "(copy-on-write prefix sharing)",
+        )
+        self.metrics_kv_cow_copies = registry.counter(
+            "kftpu_serving_kv_cow_copies_total",
+            "Copy-on-write forks: a shared KV block copied to a private "
+            "page before a sequence's first write into it",
+        )
+        self.cow_copies = 0
+        # Physical paging state (paged mode only, but always constructed —
+        # the numpy table is a few KB). One table row per batch slot,
+        # scratch-filled: a row is the device-visible mirror of the
+        # allocator's per-sequence table, positions past the allocated
+        # span stay pointed at the scratch page.
+        self._max_table_blocks = blocks_per_slot
+        self._scratch_block = cfg.kv_blocks      # pool's last physical id
+        self._block_tables = np.full(
+            (cfg.max_batch, blocks_per_slot), self._scratch_block, np.int32)
+        self._tables_dev = None                  # device mirror (lazy)
+        self._tables_dirty = True
+        self._dummy_tables = None                # dense-mode placeholder
+        # COW prefix sharing: engine-internal registry of block-aligned
+        # prompt identities -> the live request currently holding those
+        # KV blocks. Keys are hashed at kv_block_size granularity over
+        # the WHOLE prompt (unlike the LB's routing-hint chain, which
+        # stops at the 32-token head) plus an exact full-prompt key that
+        # unlocks tail-block sharing.
+        self._share_registry: Dict[str, int] = {}
+        self._rid_share_keys: Dict[int, List[str]] = {}
+        # Fork reservation: admission keeps free >= _outstanding_forks()
+        # — the copy-on-write forks live sequences may still need — so a
+        # mid-decode write_fork can never hit BlocksExhausted (which
+        # would deadlock a running sequence on memory admission already
+        # promised it).
         self.metrics_admissions_midstep = registry.counter(
             "kftpu_serving_admissions_midstep_total",
             "Admissions that claimed a slot while other sequences were "
@@ -489,7 +565,11 @@ class ServingEngine:
         self._cache = self._init_cache()
         self._decode_fn = jax.jit(self._decode_step, donate_argnums=(1,))
         self._prefill_fns: Dict[tuple, object] = {}  # (bucket, k) -> jit
-        self._extend_fn = jax.jit(self._extend_step, donate_argnums=(1,))
+        self._extend_fn = jax.jit(
+            self._extend_step_paged if self._paged else self._extend_step,
+            donate_argnums=(1,))
+        self._copy_block_fn = jax.jit(
+            self._copy_cache_block, donate_argnums=(0,))
         self.tokens_generated = 0
         self.decode_dispatches = 0
 
@@ -596,6 +676,13 @@ class ServingEngine:
             if leaf.dtype == jnp.int32:          # cache_index [.., B]
                 if shard_slots:
                     spec[-1] = batch_rule
+            elif (self._paged
+                    and leaf.shape[0] == self.cfg.kv_blocks + 1):
+                # Physical pool [P+1, bs, Hkv, t]: the block axis is
+                # GLOBAL (any slot's table may point at any page), so it
+                # must not shard over dp — only the KV-head axis splits.
+                if shard_heads:
+                    spec[-2] = tp_rule
             else:                                 # K/V [.., B, S, H, D]
                 if shard_slots:
                     spec[-4] = batch_rule
@@ -750,12 +837,22 @@ class ServingEngine:
 
     def _head_admissible(self) -> bool:
         """True when the queue head could claim a slot AND its block
-        table right now — the only time a pipeline flush buys anything."""
+        table right now — the only time a pipeline flush buys anything.
+        In paged mode this mirrors _admit_paged's full gate (prefix
+        sharing discount AND the copy-on-write fork reservation), so
+        run() never flushes the pipeline for a head the gate then
+        refuses."""
         if not self._queue or not any(s is None for s in self._slots):
             return False
         head = self._queue[0]
-        return self.blocks.can_alloc(
-            self._demand_tokens(head.prompt, head.max_new_tokens))
+        demand = self._demand_tokens(head.prompt, head.max_new_tokens)
+        if not self._paged:
+            return self.blocks.can_alloc(demand)
+        n = self.blocks.blocks_for_tokens(demand)
+        shared, _, tail_shared = self._find_shared_prefix(head.prompt, n)
+        fresh = n - len(shared)
+        reserve = self._outstanding_forks() + (2 if tail_shared else 0)
+        return fresh + reserve <= self.blocks.blocks_free
 
     def _demand_tokens(self, prompt: List[int], max_new_tokens: int) -> int:
         """KV positions this request can ever hold: prompt plus requested
@@ -763,6 +860,190 @@ class ServingEngine:
         max_len - 1). The block table covers THIS, not max_len — the
         whole point of paged accounting."""
         return min(len(prompt) + max(1, max_new_tokens), self.cfg.max_len)
+
+    # ------------- physical paging / copy-on-write -------------
+
+    def _share_keys(self, prompt: List[int]) -> List[str]:
+        """Block-aligned prefix identities of ``prompt``: one key per
+        whole kv_block_size-token prefix (incremental hash — each key
+        covers the FULL prefix up to its boundary) plus an exact
+        full-prompt key. Unlike the LB's routing chain (prefix_chain,
+        which stops at the 32-token head), these run the whole prompt:
+        sharing real pages needs the real identity, not a routing
+        hint."""
+        bs = self.cfg.kv_block_size
+        h = hashlib.blake2b(digest_size=16)
+        keys: List[str] = []
+        done = 0
+        for end in range(bs, len(prompt) + 1, bs):
+            h.update(np.asarray(prompt[done:end], np.int64).tobytes())
+            done = end
+            keys.append(f"pb:{end}:{h.hexdigest()}")
+        h.update(np.asarray(prompt[done:], np.int64).tobytes())
+        keys.append(f"px:{len(prompt)}:{h.hexdigest()}")
+        return keys
+
+    def _find_shared_prefix(self, prompt: List[int], n_blocks: int):
+        """Longest live prefix match for copy-on-write sharing.
+
+        Returns (shared physical block ids, holder rid, tail_shared).
+        An exact full-prompt match shares every block the prompt spans
+        INCLUDING a partial tail block (tail_shared=True: the first
+        decode write of either party lands there and must fork); a
+        block-aligned head match shares only whole blocks strictly
+        below both prompts' ends, which decode never writes — no fork
+        ever needed. Holders are always live: the registry is scrubbed
+        at retirement."""
+        if not self._paged:
+            return [], None, False
+        bs = self.cfg.kv_block_size
+        keys = self._share_keys(prompt)
+        holder = self._share_registry.get(keys[-1])
+        if holder is not None:
+            t = self.blocks.table(holder)
+            if t is not None:
+                matched = min(self.blocks.blocks_for_tokens(len(prompt)),
+                              len(t), n_blocks)
+                # The tail block is shared iff the match extends past
+                # the prompt end — then decode writes land in it.
+                return t[:matched], holder, matched * bs > len(prompt)
+        for key in reversed(keys[:-1]):
+            holder = self._share_registry.get(key)
+            if holder is None:
+                continue
+            t = self.blocks.table(holder)
+            if t is None:
+                continue
+            end = int(key.split(":", 2)[1])
+            matched = min(end // bs, len(t), n_blocks)
+            if matched > 0:
+                return t[:matched], holder, False
+        return [], None, False
+
+    def _outstanding_forks(self) -> int:
+        """Free blocks that must stay reserved for copy-on-write forks:
+        for every live sequence, the shared (refcount > 1) blocks at or
+        past its next write block — each may need one private copy
+        before a decode write can land in it. Admission keeps
+        free >= this, so write_fork never raises mid-decode (which
+        would deadlock a sequence on memory admission promised it)."""
+        total = 0
+        bs = self.cfg.kv_block_size
+        for slot in self._slots:
+            if slot is None:
+                continue
+            t = self.blocks.table(slot.req.request_id)
+            if not t:
+                continue
+            first = slot.pos // bs
+            total += sum(
+                1 for b in t[first:] if self.blocks.refcount(b) > 1)
+        return total
+
+    def _cow_prepare(self, positions: np.ndarray) -> None:
+        """Fork every shared block the next decode chunk will write.
+
+        ``positions`` is the dispatch-time [B, 1] position array (a
+        chained dispatch is decode_chunk ahead of host slot state, so
+        slot.pos alone would miss its window). After this pass every
+        block the chunk can touch — speculative tail included — has
+        refcount 1 owned by the writer, so no in-flight device write
+        ever aliases a sibling's live pages. The fork reservation made
+        at admission guarantees the free blocks exist."""
+        K = max(1, self.cfg.decode_chunk)
+        bs = self.cfg.kv_block_size
+        forked = False
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            rid = slot.req.request_id
+            t = self.blocks.table(rid)
+            if not t:
+                continue
+            p = max(0, int(positions[i, 0]))
+            last = min((p + K - 1) // bs, len(t) - 1)
+            for bp in range(p // bs, last + 1):
+                if self.blocks.refcount(t[bp]) <= 1:
+                    continue
+                pair = self.blocks.write_fork(rid, bp)
+                if pair is None:
+                    continue
+                old, new = pair
+                with self._mesh_ctx():
+                    self._cache = self._copy_block_fn(
+                        self._cache, jnp.int32(old), jnp.int32(new))
+                self._block_tables[i, bp] = new
+                self._tables_dirty = True
+                self.cow_copies += 1
+                self.metrics_kv_cow_copies.inc()
+                forked = True
+        if forked:
+            self.metrics_kv_blocks_shared.set(
+                float(self.blocks.blocks_shared))
+
+    def _copy_cache_block(self, cache, src, dst):
+        """One COW device copy: duplicate physical page src -> dst in
+        every layer's pool leaves (K/V and the int8 scale pools alike).
+        Jitted with the cache donated; src/dst are traced scalars so
+        every fork reuses one compiled program."""
+        from collections.abc import Mapping
+
+        def walk(node):
+            if not isinstance(node, Mapping):
+                return node
+            if "cached_key" not in node:
+                return {k: walk(v) for k, v in node.items()}
+            node = dict(node)
+            for key in ("cached_key", "cached_value",
+                        "key_scale", "value_scale"):
+                if key in node:
+                    node[key] = copy_block(node[key], src, dst)
+            return node
+
+        return walk(cache)
+
+    def _tables_device(self):
+        """Device mirror of the block tables, refreshed only when the
+        host copy changed (admission, retirement, COW fork)."""
+        if self._tables_dirty or self._tables_dev is None:
+            self._tables_dev = jnp.asarray(self._block_tables)
+            self._tables_dirty = False
+        return self._tables_dev
+
+    def _admit_paged(self, slot_idx: int, req: "GenerationRequest",
+                     demand: int) -> bool:
+        """Claim blocks for ``req`` against the PHYSICAL pool. The
+        longest live block-aligned prefix match maps the shared head
+        onto the holder's pages (refcounted — zero free-list cost); the
+        remainder pops fresh blocks; and the gate holds back enough
+        free blocks to cover every outstanding copy-on-write fork (up
+        to two more for the new pair's shared tail: the sharer's first
+        decode write forks it, and the holder's own next write may
+        too). False = the head waits, FIFO intact."""
+        rid = req.request_id
+        n = self.blocks.blocks_for_tokens(demand)
+        shared, holder, tail_shared = self._find_shared_prefix(
+            req.prompt, n)
+        fresh = n - len(shared)
+        reserve = self._outstanding_forks() + (2 if tail_shared else 0)
+        if fresh + reserve > self.blocks.blocks_free:
+            return False
+        try:
+            self.blocks.alloc(rid, demand, shared=shared)
+        except BlocksExhausted:
+            return False
+        keys = self._share_keys(req.prompt)
+        for key in keys:
+            self._share_registry[key] = rid
+        self._rid_share_keys[rid] = keys
+        t = self.blocks.table(rid)
+        self._block_tables[slot_idx, :] = self._scratch_block
+        self._block_tables[slot_idx, : len(t)] = t
+        self._tables_dirty = True
+        if shared:
+            self.metrics_kv_blocks_shared.set(
+                float(self.blocks.blocks_shared))
+        return True
 
     def slot_free_rate(self) -> float:
         """Recent slot retirements per second (the continuous-batching
@@ -820,6 +1101,13 @@ class ServingEngine:
             "kv_blocks_live": blocks["kv_blocks_live"],
             "kv_blocks_total": blocks["kv_blocks_total"],
             "kv_block_size": blocks["kv_block_size"],
+            # Physically paged HBM (ISSUE 18): whether the blocks above
+            # govern real pool memory, how many pages copy-on-write
+            # prefix sharing is pinning once, and the forks taken.
+            "kv_paged": self._paged,
+            "kv_blocks_shared": blocks["kv_blocks_shared"],
+            "kv_table_refs": blocks["kv_table_refs"],
+            "kv_cow_copies_total": blocks["kv_cow_copies_total"],
             "slot_free_rate": round(self.slot_free_rate(), 4),
             "resident_prefixes": self._resident_snapshot(),
         }
@@ -850,6 +1138,20 @@ class ServingEngine:
         big = self.cfg.prefill_buckets[-1]
         chunked = prompt_len > big
         bucket = self._bucket(min(prompt_len, big))
+
+        def warm_tables(rows: int) -> tuple:
+            # Scratch-filled dummy tables: warmup's junk writes land in
+            # the scratch page and the gathers read finite junk that the
+            # discarded outputs never propagate — while the compiled
+            # trace is EXACTLY the one real dispatches hit (a
+            # tables=None call would compile a different program).
+            if not self._paged:
+                return ()
+            return (jnp.full((rows, self._max_table_blocks),
+                             self._scratch_block, jnp.int32),)
+
+        prefill_step = (self._prefill_step_paged if self._paged
+                        else self._prefill_step)
         with self._mesh_ctx():
             if chunked:
                 # Long prompts take the chunked-prefill path: warm the
@@ -859,7 +1161,7 @@ class ServingEngine:
                     self.params, self._cache,
                     jnp.ones((1, big), jnp.int32),
                     jnp.int32(0), jnp.int32(big), jnp.int32(0),
-                    sub, jnp.zeros((1, 3), jnp.float32),
+                    sub, jnp.zeros((1, 3), jnp.float32), *warm_tables(1),
                 )
                 toks.block_until_ready()
             ks = []
@@ -871,7 +1173,7 @@ class ServingEngine:
             for k in ks:
                 fn = self._prefill_fns.setdefault(
                     (bucket, k),
-                    jax.jit(self._prefill_step, donate_argnums=(1,)),
+                    jax.jit(prefill_step, donate_argnums=(1,)),
                 )
                 self._rng, sub = jax.random.split(self._rng)
                 toks, _, self._cache = fn(
@@ -880,7 +1182,7 @@ class ServingEngine:
                     jnp.full((k,), bucket, jnp.int32),
                     jnp.zeros((k,), jnp.int32),
                     sub,
-                    jnp.zeros((k, 3), jnp.float32),
+                    jnp.zeros((k, 3), jnp.float32), *warm_tables(k),
                 )
                 toks.block_until_ready()
             B = self.cfg.max_batch
@@ -890,7 +1192,7 @@ class ServingEngine:
                 jnp.zeros((B, 1), jnp.int32),
                 jnp.full((B, 1), bucket, jnp.int32),
                 sub,
-                jnp.zeros((B, 3), jnp.float32),
+                jnp.zeros((B, 3), jnp.float32), *warm_tables(B),
             )
             np.asarray(toks)      # host fetch = reliable sync on remote TPUs
         # Dummy rows polluted the cache (junk K/V, advanced indices):
@@ -926,12 +1228,15 @@ class ServingEngine:
             # (no smaller request jumps it; its blocks arrive as running
             # sequences retire mid-step).
             req = self._queue[0]
-            try:
-                self.blocks.alloc(
-                    req.request_id,
-                    self._demand_tokens(req.prompt, req.max_new_tokens))
-            except BlocksExhausted:
-                break
+            demand = self._demand_tokens(req.prompt, req.max_new_tokens)
+            if self._paged:
+                if not self._admit_paged(i, req, demand):
+                    break
+            else:
+                try:
+                    self.blocks.alloc(req.request_id, demand)
+                except BlocksExhausted:
+                    break
             self._queue.popleft()
             self._slots[i] = _Slot(req)
             wait = max(0.0, now - req.submitted_at)
@@ -1073,11 +1378,101 @@ class ServingEngine:
                                         rng, samp)
         return toks, lps, cache
 
+    def _prefill_step_paged(self, params, cache, tokens, lengths,
+                            slot_idxs, rng, samp, tables):
+        """Grouped prefill against the PHYSICAL pool: the model writes
+        each row's K/V straight through its block table — ``write_lens``
+        redirects pad columns past a row's true length to the scratch
+        page, so no junk write can touch a live (possibly shared) page
+        — and there are no per-slot cache rows to install: only the
+        mutated pool leaves come back, plus cache_index set to the true
+        lengths at ``slot_idxs``. k-padding repeats row 0, which
+        rewrites row 0's pages with identical values (same tokens, same
+        positions — idempotent, exactly like a sharer's prefix
+        rewrite)."""
+        from collections.abc import Mapping
+
+        k = tokens.shape[0]
+
+        def sub(node):
+            # Pool leaves pass through SHARED; per-slot leaves (stage
+            # rows, cache_index) rebuild at the group's batch size k.
+            if not isinstance(node, Mapping):
+                return node
+            if "cached_key" not in node:
+                return {key: sub(v) for key, v in node.items()}
+            out = {}
+            for key, v in node.items():
+                if key == "cache_index":
+                    out[key] = jnp.zeros((k,), jnp.int32)
+                elif key.startswith("stage_"):
+                    out[key] = jnp.zeros((k,) + v.shape[1:], v.dtype)
+                else:
+                    out[key] = v
+            return out
+
+        rows = sub(cache)
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1]), tokens.shape
+        )
+        params_m = self._materialize(params)
+        head_fn = getattr(type(self.model), "HEAD_LOGITS", None)
+        split_head = callable(head_fn)
+        with self._pctx():
+            if split_head:
+                hidden, mut = self.model.apply(
+                    {"params": params_m["params"], "cache": rows}, tokens,
+                    positions=positions, decode="prefill",
+                    mutable=["cache"], return_hidden=True,
+                    block_tables=tables, write_lens=lengths,
+                )
+            else:
+                logits, mut = self.model.apply(
+                    {"params": params_m["params"], "cache": rows}, tokens,
+                    positions=positions, decode="prefill",
+                    mutable=["cache"],
+                    block_tables=tables, write_lens=lengths,
+                )
+
+        def merge(old, new):
+            if not isinstance(old, Mapping):
+                return old
+            if "cached_key" not in old:
+                return {key: merge(old[key], new[key]) for key in old}
+            out = {}
+            for key, v in old.items():
+                if key == "cache_index":
+                    out[key] = v.at[slot_idxs].set(lengths)
+                elif key.startswith("stage_"):
+                    out[key] = v              # prefill never stages
+                else:
+                    out[key] = new[key]       # the mutated pool
+            return out
+
+        cache = merge(cache, mut["cache"])
+        if split_head:
+            last_h = jnp.take_along_axis(
+                hidden, (lengths - 1)[:, None, None], axis=1
+            )                                 # [k, 1, E]
+            with self._pctx():
+                last_logits = head_fn(
+                    self.model.cfg, params_m["params"], last_h
+                )[:, 0]                       # [k, V]
+        else:
+            last_logits = jnp.take_along_axis(
+                logits, (lengths - 1)[:, None, None], axis=1
+            )[:, 0]                           # [k, V]
+        toks, lps = self._sample_logits(last_logits.astype(jnp.float32),
+                                        rng, samp)
+        return toks, lps, cache
+
     def _prefill_group(self, bucket: int, group: List[tuple]) -> None:
         k = self._k_pad(len(group))
         if (bucket, k) not in self._prefill_fns:
+            step = (self._prefill_step_paged if self._paged
+                    else self._prefill_step)
             self._prefill_fns[(bucket, k)] = jax.jit(
-                self._prefill_step, donate_argnums=(1,)
+                step, donate_argnums=(1,)
             )
         fn = self._prefill_fns[(bucket, k)]
 
@@ -1096,11 +1491,16 @@ class ServingEngine:
             slot_idxs[row] = slot_idxs[0]
             samp[row] = samp[0]
         self._rng, sub = jax.random.split(self._rng)
+        extra = ()
+        if self._paged:
+            # Each row's freshly written table row (scratch-padded past
+            # its span); pad rows repeat row 0's.
+            extra = (jnp.asarray(self._block_tables[slot_idxs]),)
         with self._mesh_ctx():
             toks, lps, self._cache = fn(
                 self.params, self._cache, jnp.asarray(tokens),
                 jnp.asarray(lengths), jnp.asarray(slot_idxs),
-                sub, jnp.asarray(samp),
+                sub, jnp.asarray(samp), *extra,
             )
         toks = np.asarray(toks)
         lps = np.asarray(lps) if self.cfg.logprobs else None
@@ -1174,6 +1574,84 @@ class ServingEngine:
             last_logits.astype(jnp.float32), rng, samp)
         return toks, lps, cache
 
+    def _extend_step_paged(self, params, cache, tokens, start, true_len,
+                           slot_idx, rng, samp, table):
+        """One chunked-prefill chunk for ONE slot against the PHYSICAL
+        pool: the model writes through ``table`` ([1, max_blocks]) at
+        absolute position ``start`` — the slide-back final chunk's
+        overlapped positions rewrite identical values (same tokens,
+        same positions), exactly the idempotence a sharer's prefix
+        rewrite relies on — then cache_index[slot_idx] := start +
+        true_len. Pool leaves need no slicing: they are global."""
+        from collections.abc import Mapping
+
+        def sub(node):
+            if not isinstance(node, Mapping):
+                return node
+            if "cached_key" not in node:
+                return {key: sub(v) for key, v in node.items()}
+            out = {}
+            for key, v in node.items():
+                if key == "cache_index":
+                    out[key] = jnp.full((1,), start, jnp.int32)
+                elif key.startswith("stage_"):
+                    out[key] = jnp.zeros((1,) + v.shape[1:], v.dtype)
+                else:
+                    out[key] = v
+            return out
+
+        rows = sub(cache)
+        C = tokens.shape[1]
+        positions = start + jnp.arange(C)[None, :]
+        mat = self._materialize(params)
+        head_fn = getattr(type(self.model), "HEAD_LOGITS", None)
+        split_head = callable(head_fn)
+        with self._pctx():
+            if split_head:
+                hidden, mut = self.model.apply(
+                    {"params": mat["params"], "cache": rows}, tokens,
+                    positions=positions, decode=True, mutable=["cache"],
+                    return_hidden=True, block_tables=table,
+                )
+            else:
+                logits, mut = self.model.apply(
+                    {"params": mat["params"], "cache": rows}, tokens,
+                    positions=positions, decode=True, mutable=["cache"],
+                    block_tables=table,
+                )
+        total = start + true_len
+
+        def merge(old, new):
+            if not isinstance(old, Mapping):
+                return old
+            if "cached_key" not in old:
+                return {key: merge(old[key], new[key]) for key in old}
+            out = {}
+            for key, v in old.items():
+                if key == "cache_index":
+                    out[key] = jax.lax.dynamic_update_slice_in_dim(
+                        v, jnp.full((1,), total, jnp.int32),
+                        slot_idx, axis=-1)
+                elif key.startswith("stage_"):
+                    out[key] = v
+                else:
+                    out[key] = new[key]
+            return out
+
+        cache = merge(cache, mut["cache"])
+        pick = jnp.reshape(jnp.asarray(true_len - 1, jnp.int32), (1, 1, 1))
+        if split_head:
+            last_h = jnp.take_along_axis(hidden, pick, axis=1)  # [1,1,E]
+            with self._pctx():
+                last_logits = head_fn(
+                    self.model.cfg, mat["params"], last_h)[:, 0]
+        else:
+            last_logits = jnp.take_along_axis(
+                logits, pick, axis=1)[:, 0]                     # [1, V]
+        toks, lps = self._sample_logits(
+            last_logits.astype(jnp.float32), rng, samp)
+        return toks, lps, cache
+
     def _prefill_long(self, slot_idx: int, req: "GenerationRequest") -> None:
         """Chunked prefill for a prompt longer than the largest bucket:
         bucket-width chunks stream through _extend_step against the
@@ -1196,6 +1674,10 @@ class ServingEngine:
         if starts[-1] + big > len(prompt):
             starts[-1] = len(prompt) - big
         toks = lps = None
+        extra = ()
+        if self._paged:
+            extra = (jnp.asarray(
+                self._block_tables[slot_idx:slot_idx + 1]),)
         with self._mesh_ctx():
             for off in starts:
                 chunk = prompt[off:off + big]
@@ -1204,7 +1686,7 @@ class ServingEngine:
                     self.params, self._cache,
                     jnp.asarray(np.asarray([chunk], np.int32)),
                     jnp.int32(off), jnp.int32(big),
-                    jnp.int32(slot_idx), sub, jnp.asarray(samp),
+                    jnp.int32(slot_idx), sub, jnp.asarray(samp), *extra,
                 )
         self._record_token(
             slot_idx, int(np.asarray(toks)[0]),
@@ -1280,7 +1762,8 @@ class ServingEngine:
     def _samp_row(req: "GenerationRequest") -> tuple:
         return (req.temperature, float(req.top_k), req.top_p)
 
-    def _decode_step(self, params, cache, tokens, positions, rng, samp):
+    def _decode_step(self, params, cache, tokens, positions, rng, samp,
+                     tables=None):
         """Decode ``decode_chunk`` tokens in one device program: a lax.scan
         whose carry is (last token, position, cache) — one dispatch per
         chunk instead of per token. With a staging-enabled model
@@ -1299,6 +1782,8 @@ class ServingEngine:
             # meant to remove).
             mat = self._materialize(params)
             kw = {"stage_step": step_i} if staging else {}
+            if self._paged:
+                kw["block_tables"] = tables
             with self._pctx():
                 logits, mut = self.model.apply(
                     {"params": mat["params"], "cache": cache_c}, toks,
@@ -1312,7 +1797,7 @@ class ServingEngine:
             (toks, _, cache), (out, lp) = body(
                 (tokens, positions, cache), (rng, jnp.int32(0)))
             if staging:
-                cache = self._flush_staging(cache, 1)
+                cache = self._flush_staging(cache, 1, tables)
             return out[:, None], lp[:, None], cache
         rngs = jax.random.split(rng, K)
         (_, _, cache), (out, lp) = jax.lax.scan(
@@ -1320,10 +1805,10 @@ class ServingEngine:
             (rngs, jnp.arange(K, dtype=jnp.int32)),
         )
         if staging:
-            cache = self._flush_staging(cache, K)
+            cache = self._flush_staging(cache, K, tables)
         return out.T, lp.T, cache                  # [B, K] each
 
-    def _flush_staging(self, cache, steps: int):
+    def _flush_staging(self, cache, steps: int, tables=None):
         """Scatter each layer's staging rows [B, :steps] into its main
         cache at the per-slot cache_index, in one steps-row granule per
         slot (the per-step per-slot scatters this replaces were 25% of
@@ -1341,6 +1826,10 @@ class ServingEngine:
 
         from collections.abc import Mapping
 
+        paged = self._paged
+        bs = self.cfg.kv_block_size
+        P = self.cfg.kv_blocks
+
         def flush(node):
             if not isinstance(node, Mapping):
                 return node
@@ -1350,6 +1839,35 @@ class ServingEngine:
             idx = node["cache_index"]
             sk = node["stage_key"][:, :steps]
             sv = node["stage_value"][:, :steps]
+            if paged:
+                # Paged flush: the staged rows scatter at the PHYSICAL
+                # rows the tables map positions idx..idx+steps to —
+                # inactive slots' scratch-filled tables and past-span
+                # positions all redirect to the scratch page, and every
+                # live block a flush can write has refcount 1 by the
+                # dispatch-time COW pass.
+                positions = idx[:, None] + jnp.arange(steps)[None, :]
+                rows = physical_rows(tables, positions, bs, num_blocks=P)
+                if quant:
+                    k8, ks = quantize_kv_rows(sk)
+                    v8, vs = quantize_kv_rows(sv)
+                    node["cached_key"] = scatter_kv_rows(
+                        node["cached_key"], rows, k8)
+                    node["cached_value"] = scatter_kv_rows(
+                        node["cached_value"], rows, v8)
+                    node["key_scale"] = scatter_kv_rows(
+                        node["key_scale"], rows, ks)
+                    node["value_scale"] = scatter_kv_rows(
+                        node["value_scale"], rows, vs)
+                else:
+                    node["cached_key"] = scatter_kv_rows(
+                        node["cached_key"], rows,
+                        sk.astype(node["cached_key"].dtype))
+                    node["cached_value"] = scatter_kv_rows(
+                        node["cached_value"], rows,
+                        sv.astype(node["cached_value"].dtype))
+                node["cache_index"] = idx + steps
+                return node
             if quant:
                 k8, ks = quantize_kv_rows(sk)
                 v8, vs = quantize_kv_rows(sv)
@@ -1396,11 +1914,17 @@ class ServingEngine:
                 tokens[i, 0] = (slot.generated or slot.req.prompt)[-1]
                 positions[i, 0] = slot.pos
             tokens_dev = jnp.asarray(tokens)
+        extra = ()
+        if self._paged:
+            # COW first (forks mutate tables + cache), THEN the device
+            # mirror — the dispatch must see the post-fork tables.
+            self._cow_prepare(positions)
+            extra = (self._tables_device(),)
         self._rng, sub = jax.random.split(self._rng)
         with self._mesh_ctx():
             toks, lps, self._cache = self._decode_fn(
                 self.params, self._cache, tokens_dev,
-                jnp.asarray(positions), sub, jnp.asarray(samp),
+                jnp.asarray(positions), sub, jnp.asarray(samp), *extra,
             )
         # Hardware-independent cost metric: dispatches/token pins the part
         # of serving latency a ~110ms-per-dispatch tunnel multiplies.
@@ -1465,6 +1989,19 @@ class ServingEngine:
             # _admit refills from the queue without a full re-forward of
             # the survivors. The retire timestamp feeds slot_free_rate.
             self.blocks.free(req.request_id)
+            if self._paged:
+                # Point the slot's table row back at scratch (in-flight
+                # speculative writes still carry the OLD device tables;
+                # they land in freed pages, which stay un-reallocated
+                # until the next admission — a pipeline flush point) and
+                # scrub the prefix-share registry of this rid.
+                self._block_tables[slot_idx, :] = self._scratch_block
+                self._tables_dirty = True
+                for key in self._rid_share_keys.pop(req.request_id, []):
+                    if self._share_registry.get(key) == req.request_id:
+                        self._share_registry.pop(key)
+                self.metrics_kv_blocks_shared.set(
+                    float(self.blocks.blocks_shared))
             with self._load_lock:
                 self._recent_retires.append(time.monotonic())
             self.metrics_kv_blocks_live.set(float(self.blocks.blocks_live))

@@ -65,7 +65,10 @@ Stages (any failure exits non-zero — the merge gate contract):
    runs) plus the ISSUE-13 radix-vs-exact prefix-matching leg (radix
    strictly wins the partial-overlap hit rate); then the seeded
    drain/flap soak — zero requests routed to draining/unhealthy
-   backends (``--skip-serve``).
+   backends; then **paged-smoke** (ISSUE 18) — dense-vs-paged
+   token exactness on a real engine, non-vacuous copy-on-write
+   sharing + fork with the two-layer conservation invariant, and
+   the sim COW occupancy leg (``--skip-serve``).
 8b. **schedule-smoke**: the gang-scheduler mixed-priority storm with a
    mid-storm slice-preemption burst (ISSUE 8) — exact gang accounting
    (placed + preempted + pending == submitted), zero priority
@@ -786,6 +789,97 @@ def run_serve_bench_smoke(rate_qps: float = 60.0,
             "continuous batching never engaged")
 
 
+def run_paged_smoke() -> None:
+    """Physically paged HBM smoke (ISSUE 18). Three count-exact gates,
+    no wall-clock:
+
+    - **token exactness**: a mixed trace through a REAL tiny engine,
+      dense cache vs paged pool, same seed — byte-identical output
+      tokens (the parity gate the serving8b --paged bench leg rides);
+    - **copy-on-write conservation**: identical prompts share physical
+      blocks (non-vacuous: shared refs > 0 AND at least one write-fork
+      taken) and the two-layer refcount/partition invariant holds after
+      the drain with the pool fully freed;
+    - **sim occupancy**: the loadtest COW leg on the production
+      allocator — shared refs non-vacuous, conservation clean.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import Llama, LlamaConfig
+    from kubeflow_tpu.serving import ServingConfig, ServingEngine
+
+    bs, max_len = 8, 64
+    kv_blocks = 4 * (max_len // bs)
+    params = None
+
+    def engine(paged):
+        nonlocal params
+        mc = dict(max_seq_len=128)
+        sc = dict(max_batch=4, max_len=max_len)
+        if paged:
+            mc.update(paged_kv_blocks=kv_blocks, paged_kv_block_size=bs)
+            sc.update(kv_blocks=kv_blocks, kv_block_size=bs)
+        model = Llama(LlamaConfig.tiny(**mc))
+        if params is None:
+            params = {"params": model.init(
+                jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32)
+            )["params"]}
+        return ServingEngine(model, params, ServingConfig(**sc))
+
+    def run(eng, prompts, n_new):
+        rids = [eng.submit(list(p), max_new_tokens=n_new)
+                for p in prompts]
+        res = {r.request_id: r.tokens for r in eng.run()}
+        return [res[r] for r in rids]
+
+    trace = [[7, 3, 9, 1, 4], [2] * 17, [250, 100, 3],
+             [11, 22, 33, 44, 55, 66, 77]]
+    dense, paged = engine(False), engine(True)
+    if run(dense, trace, 8) != run(paged, trace, 8):
+        raise GateFailure(
+            "paged-smoke: dense vs paged tokens DIVERGED on the mixed "
+            "trace — the block-gather exactness contract is broken")
+    paged.blocks.check_conservation()
+
+    cow = engine(True)
+    shared_trace = [[(7 * i + 3) % 250 for i in range(17)]] * 4
+    if run(engine(False), shared_trace, 10) != run(cow, shared_trace, 10):
+        raise GateFailure(
+            "paged-smoke: COW sharing changed tokens — a fork either "
+            "aliased a sibling's pages or lost the shared prefix")
+    if cow.blocks.shared_refs_total == 0:
+        raise GateFailure(
+            "paged-smoke: identical prompts shared ZERO blocks — "
+            "prefix sharing is vacuous")
+    if cow.blocks.cow_copies_total == 0:
+        raise GateFailure(
+            "paged-smoke: no copy-on-write fork taken — the shared "
+            "partial tail block was never forked")
+    cow.blocks.check_conservation()
+    if cow.blocks.blocks_live or cow.blocks.blocks_free != kv_blocks:
+        raise GateFailure(
+            f"paged-smoke: pool not fully freed after drain — live="
+            f"{cow.blocks.blocks_live} free={cow.blocks.blocks_free}"
+            f"/{kv_blocks}")
+
+    from kubeflow_tpu.tools.loadtest import run_continuous_bench
+
+    sim = run_continuous_bench(
+        mode="continuous", dense_kv=False, cow_sharing=True,
+        duration_s=1.5, sessions=4, kv_blocks=48, max_batch=8,
+        rate_qps=40.0)
+    kv = sim["kv"]
+    if not kv["conservation_ok"] or kv["blocks_leaked"]:
+        raise GateFailure(
+            f"paged-smoke[sim]: conservation broken under COW — "
+            f"ok={kv['conservation_ok']} leaked={kv['blocks_leaked']}")
+    if kv["shared_refs_total"] == 0:
+        raise GateFailure(
+            "paged-smoke[sim]: session trace shared zero blocks — the "
+            "sim's physical-occupancy model is vacuous")
+
+
 def run_affinity_smoke(seed: int = 12) -> None:
     """Cache-affinity smoke (ISSUE 12): the seeded session-replay A/B
     (affine vs blind routing over prefix-caching replicas). Gates are
@@ -1212,6 +1306,9 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
         _stage("serving-soak-smoke")
         run_serving_soak_smoke(seed=chaos_seed)
         passed.append("serving-soak-smoke")
+        _stage("paged-smoke")
+        run_paged_smoke()
+        passed.append("paged-smoke")
 
     if bench_json:
         _stage("bench-gate")

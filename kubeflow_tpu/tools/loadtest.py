@@ -251,6 +251,7 @@ class SimServingReplica:
                  kv_block_size: int = 16,
                  kv_blocks: int = 0,
                  dense_kv: bool = False,
+                 cow_sharing: bool = False,
                  batch_linger_s: float = 0.02,
                  prefix_cache_size: int = 0,
                  name: str = ""):
@@ -299,6 +300,14 @@ class SimServingReplica:
         blocks_per_seq = blocks_for_tokens(max_len, kv_block_size)
         self.blocks = KVBlockAllocator(
             kv_blocks or max_batch * blocks_per_seq, kv_block_size)
+        # Physically paged occupancy (ISSUE 18): with cow_sharing the
+        # sim maps a request's block-aligned prompt head onto a LIVE
+        # holder's physical blocks via alloc(shared=...) — the same
+        # refcounted ledger the real engine's copy-on-write sharing
+        # runs, so pool occupancy reflects resident pages, not table
+        # entries. Forces dense_kv=False semantics per request.
+        self.cow_sharing = cow_sharing
+        self._prefix_holders: dict = {}   # affinity key -> live ticket
         self._tickets = itertools_count()
         self._fifo: collections.deque = collections.deque()
         # stepbatch wave state
@@ -387,6 +396,27 @@ class SimServingReplica:
         pre-ISSUE-12 sizing this bench's A/B contrasts)."""
         return self.max_len if self.dense_kv else demand_tokens
 
+    def _cow_candidate(self, keys, demand: int, gen: int) -> list:
+        """Physical block ids this request's prompt head can SHARE: the
+        block-aligned leading blocks of a live holder of its most
+        specific affinity key (the real engine's no-fork sharing path —
+        decode writes land past the shared span). Caller holds the
+        lock; re-evaluated every admission poll because the holder may
+        retire mid-wait."""
+        if not self.cow_sharing or self.dense_kv:
+            return []
+        nfull = max(0, demand - gen) // self.blocks.block_size
+        if nfull <= 0:
+            return []
+        for key in keys or []:
+            holder = self._prefix_holders.get(key)
+            if holder is None:
+                continue
+            t = self.blocks.table(holder)
+            if t:
+                return list(t[:min(nfull, len(t))])
+        return []
+
     def _shed_429(self):
         self.shed += 1
         rate = self._slot_free_rate_locked()
@@ -454,10 +484,16 @@ class SimServingReplica:
             deadline = t0 + 30.0
             # FIFO continuous admission: the head claims a slot AND its
             # block table the instant both fit — typically freed by a
-            # retirement in the middle of other sequences' decode.
-            while not (self._fifo and self._fifo[0] == ticket
-                       and self._active < self.max_batch
-                       and self.blocks.can_alloc(self._kv_demand(demand))):
+            # retirement in the middle of other sequences' decode. With
+            # cow_sharing, a live prefix holder shrinks the physical
+            # cost to the non-shared remainder.
+            while True:
+                shared = self._cow_candidate(keys, demand, gen)
+                if (self._fifo and self._fifo[0] == ticket
+                        and self._active < self.max_batch
+                        and self.blocks.can_alloc(
+                            self._kv_demand(demand), shared=len(shared))):
+                    break
                 if self._stopping or time.monotonic() > deadline:
                     self._fifo.remove(ticket)
                     self._queued -= 1
@@ -468,7 +504,11 @@ class SimServingReplica:
             if self._active > 0:
                 self.midstep_admissions += 1
             self._active += 1
-            self.blocks.alloc(ticket, self._kv_demand(demand))
+            self.blocks.alloc(ticket, self._kv_demand(demand),
+                              shared=shared or None)
+            if self.cow_sharing and not self.dense_kv:
+                for key in keys or []:
+                    self._prefix_holders[key] = ticket   # latest wins
             hit = self._prefix_lookup(keys)
             if keys:
                 if hit:
@@ -486,6 +526,13 @@ class SimServingReplica:
                 self._active -= 1
                 self.served += 1
                 self.blocks.free(ticket)
+                # Scrub only the holder entries still pointing at this
+                # ticket (a later sharer may have taken the key over) so
+                # registered holders are always live — exactly the real
+                # engine's retirement discipline.
+                for key in keys or []:
+                    if self._prefix_holders.get(key) == ticket:
+                        self._prefix_holders.pop(key)
                 self._retires.append(time.monotonic())
                 self._prefix_note(keys)
                 self._cond.notify_all()
@@ -611,6 +658,9 @@ class SimServingReplica:
                 "kv_blocks_live": snap["kv_blocks_live"],
                 "kv_blocks_total": snap["kv_blocks_total"],
                 "kv_block_size": snap["kv_block_size"],
+                "kv_blocks_shared": snap["kv_blocks_shared"],
+                "kv_table_refs": snap["kv_table_refs"],
+                "kv_cow_copies_total": snap["kv_cow_copies_total"],
                 "slot_free_rate": round(self._slot_free_rate_locked(), 4),
                 "resident_prefixes": list(self._resident),
             }
@@ -1005,6 +1055,7 @@ def run_continuous_bench(
     *,
     mode: str = "continuous",          # "continuous" | "stepbatch"
     dense_kv: bool = True,
+    cow_sharing: bool = False,
     rate_qps: Optional[float] = None,
     duration_s: float = 4.0,
     replicas: int = 1,
@@ -1035,6 +1086,12 @@ def run_continuous_bench(
     - ``mode="continuous", dense_kv=False``: the full plane — paged
       block tables sized by actual demand, so concurrency (and
       goodput) is bounded by real request sizes, not max_len.
+    - ``mode="continuous", dense_kv=False, cow_sharing=True``: the
+      physically paged plane (ISSUE 18) — session-mates additionally
+      map their block-aligned prompt heads onto the SAME live physical
+      blocks (refcounted, via the production allocator's shared
+      alloc), so pool occupancy models resident pages and a prefix-
+      heavy trace holds more concurrent sequences at fixed kv_blocks.
 
     Defaults offer 2x the dense capacity. Hard gates live in bench.py /
     ci.py; this function reports counts plus the block-ledger
@@ -1065,7 +1122,8 @@ def run_continuous_bench(
             seed=seed)
 
     sims = [SimServingReplica(
-        engine=mode, dense_kv=dense_kv, max_batch=max_batch,
+        engine=mode, dense_kv=dense_kv, cow_sharing=cow_sharing,
+        max_batch=max_batch,
         max_queue=max_queue, token_time_s=token_time_s,
         prefill_time_s=prefill_time_s, max_len=max_len,
         kv_block_size=kv_block_size, kv_blocks=kv_blocks,
@@ -1104,6 +1162,7 @@ def run_continuous_bench(
     out = {
         "mode": mode,
         "dense_kv": dense_kv,
+        "cow_sharing": cow_sharing,
         "offered": offered,
         "rate_qps": round(rate_qps, 1),
         "duration_s": duration_s,
@@ -1137,6 +1196,10 @@ def run_continuous_bench(
                    s.blocks.blocks_freed_total for s in sims),
                "high_water": max(
                    s.blocks.high_water_blocks for s in sims),
+               "shared_refs_total": sum(
+                   s.blocks.shared_refs_total for s in sims),
+               "cow_copies_total": sum(
+                   s.blocks.cow_copies_total for s in sims),
                "conservation_ok": conservation_ok,
                "blocks_leaked": blocks_leaked},
         "mean_service_s": round(mean_service, 4),

@@ -992,12 +992,17 @@ def cmd_top(args) -> int:
     # occupancy, mid-step admission count and affinity outcomes, summed
     # across scrapes/shards; printed only when the series exist.
     kv_live = kv_total = midstep = None
+    kv_shared = kv_cow = None
     affinity = {}
     for name, labels, value in samples:
         if name == "kftpu_serving_kv_blocks_live":
             kv_live = (kv_live or 0.0) + value
         elif name == "kftpu_serving_kv_blocks_total":
             kv_total = (kv_total or 0.0) + value
+        elif name == "kftpu_serving_kv_blocks_shared":
+            kv_shared = (kv_shared or 0.0) + value
+        elif name == "kftpu_serving_kv_cow_copies_total":
+            kv_cow = (kv_cow or 0.0) + value
         elif name == "kftpu_serving_admissions_midstep_total":
             midstep = (midstep or 0.0) + value
         elif (name == "kftpu_lb_affinity_hits_total"
@@ -1010,6 +1015,12 @@ def cmd_top(args) -> int:
         if kv_total is not None:
             print(f"{'kv blocks live/total':24} "
                   f"{f'{int(kv_live or 0)}/{int(kv_total)}':>12}")
+        # PAGED HBM (ISSUE 18): pool occupancy is physical — live blocks
+        # are RESIDENT pages, shared counts pages pinned once but
+        # referenced by >1 sequence, cow is total write-forks taken.
+        if kv_shared is not None or kv_cow is not None:
+            print(f"{'PAGED HBM shared/cow':24} "
+                  f"{f'{int(kv_shared or 0)}/{int(kv_cow or 0)}':>12}")
         if midstep is not None:
             print(f"{'mid-step admissions':24} {int(midstep):>12}")
         for outcome in sorted(affinity):

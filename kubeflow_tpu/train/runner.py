@@ -106,6 +106,17 @@ def run(cfg: dict) -> int:
         jax.config.update("jax_platforms", plat)
 
     if cfg["num_processes"] > 1:
+        # Multi-process CPU gangs (local/e2e) need an explicit collectives
+        # transport: the default CPU client refuses cross-process
+        # computations ("Multiprocess computations aren't implemented on
+        # the CPU backend") unless gloo is selected before distributed
+        # init. No-op on TPU, where ICI collectives are built in.
+        if "cpu" in (plat or os.environ.get("JAX_PLATFORMS", "")):
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except Exception:
+                pass  # older/newer jax without the knob: keep going
         jax.distributed.initialize(
             coordinator_address=cfg["coordinator"],
             num_processes=cfg["num_processes"],

@@ -146,6 +146,157 @@ class TestConservationUnderChurn:
         assert snap["kv_sequences_live"] == 1
 
 
+class TestCopyOnWriteSharing:
+    """Refcounted COW prefix sharing (ISSUE 18): shared leading blocks
+    map to the SAME physical ids, a write forks first, and conservation
+    extends to prove free + unique live blocks partition the id space
+    with refcounts summing to table references."""
+
+    def test_shared_alloc_pins_physical_blocks_once(self):
+        a = KVBlockAllocator(8, 16)
+        t1 = a.alloc("s1", 48)              # 3 physical blocks
+        t2 = a.alloc("s2", 48, shared=t1[:2])
+        assert t2[:2] == t1[:2]             # same physical ids
+        assert t2[2] not in t1
+        assert a.blocks_live == 4           # 3 + 1 unique, not 6
+        assert a.table_refs == 6
+        assert a.blocks_shared == 2
+        assert a.blocks_allocated_total == 4  # physical pops only
+        assert a.shared_refs_total == 2
+        a.check_conservation()
+
+    def test_free_shared_reader_keeps_pages_live(self):
+        """Retiring one reader of a shared prefix must not free pages
+        its siblings still attend over."""
+        a = KVBlockAllocator(8, 16)
+        t1 = a.alloc("s1", 32)
+        a.alloc("s2", 48, shared=t1)
+        assert a.free("s2") == 1            # only its private tail block
+        assert a.blocks_live == 2
+        assert a.table("s1") == t1
+        a.check_conservation()
+        assert a.free("s1") == 2            # last reference frees
+        assert a.blocks_free == a.total_blocks
+        assert a.blocks_allocated_total == a.blocks_freed_total
+        a.check_conservation()
+
+    def test_double_free_of_shared_block_raises(self):
+        """Forging a duplicate reference (the double-free-of-shared
+        corruption) trips the refcount check instead of returning the
+        block to the free list twice."""
+        a = KVBlockAllocator(8, 16)
+        t1 = a.alloc("s1", 16)
+        a.alloc("s2", 16, shared=t1)
+        a.free("s1")
+        a.free("s2")                        # refcount hits 0, freed once
+        with pytest.raises(BlockAccountingError, match="double free"):
+            a.free("s2")
+        a.check_conservation()
+
+    def test_write_fork_under_shared_refcount_copies(self):
+        a = KVBlockAllocator(8, 16)
+        t1 = a.alloc("s1", 32)
+        t2 = a.alloc("s2", 32, shared=t1)
+        fork = a.write_fork("s2", 1)
+        assert fork is not None
+        old, new = fork
+        assert old == t1[1] and new not in t1
+        assert a.table("s2") == [t2[0], new]
+        assert a.table("s1") == t1          # owner untouched
+        assert a.cow_copies_total == 1
+        assert a.blocks_shared == 1         # block 0 still shared
+        a.check_conservation()
+
+    def test_write_fork_exclusive_owner_is_noop(self):
+        a = KVBlockAllocator(8, 16)
+        t = a.alloc("s", 32)
+        assert a.write_fork("s", 0) is None
+        assert a.table("s") == t
+        assert a.cow_copies_total == 0
+        a.check_conservation()
+
+    def test_write_fork_exhausted_raises(self):
+        a = KVBlockAllocator(2, 16)
+        t1 = a.alloc("s1", 16)
+        a.alloc("s2", 32, shared=t1)        # pool now full
+        with pytest.raises(BlocksExhausted):
+            a.write_fork("s2", 0)
+        a.check_conservation()
+
+    def test_write_fork_unknown_sequence_raises(self):
+        a = KVBlockAllocator(4, 16)
+        with pytest.raises(BlockAccountingError):
+            a.write_fork("ghost", 0)
+        a.alloc("s", 16)
+        with pytest.raises(BlockAccountingError, match="table"):
+            a.write_fork("s", 5)
+
+    def test_shared_alloc_of_free_block_raises(self):
+        """A prefix reference on a block that is not live (registry
+        staleness across retire) is an accounting error, never a silent
+        alias of someone else's future allocation."""
+        a = KVBlockAllocator(4, 16)
+        t = a.alloc("s1", 16)
+        a.free("s1")
+        with pytest.raises(BlockAccountingError, match="not live"):
+            a.alloc("s2", 16, shared=t)
+        a.check_conservation()
+
+    def test_retire_while_shared_churn_conserves(self):
+        """Seeded storm over a shared-prefix family: admits referencing a
+        live owner's head, COW forks, and retires in random order — the
+        two-layer invariant must hold after EVERY operation and the pool
+        drains exactly."""
+        rng = random.Random(20260807)
+        a = KVBlockAllocator(32, 8)
+        owner = a.alloc("owner", 32)        # 4-block shared head
+        live = {}
+        for i in range(400):
+            op = rng.random()
+            if op < 0.40:
+                sid = f"s{i}"
+                k = rng.randrange(0, 5)
+                tokens = 32 + rng.randrange(0, 40)
+                try:
+                    a.alloc(sid, tokens, shared=owner[:k])
+                    live[sid] = tokens
+                except BlocksExhausted:
+                    pass
+            elif op < 0.60 and live:
+                sid = rng.choice(list(live))
+                pos = rng.randrange(
+                    0, a.blocks_for_tokens(live[sid]))
+                try:
+                    a.write_fork(sid, pos)
+                except BlocksExhausted:
+                    pass
+            elif live:
+                sid = rng.choice(list(live))
+                a.free(sid)
+                del live[sid]
+            a.check_conservation()
+        for sid in list(live):
+            a.free(sid)
+        a.free("owner")
+        a.check_conservation()
+        assert a.blocks_live == 0 and a.blocks_shared == 0
+        assert a.blocks_free == a.total_blocks
+        assert a.blocks_allocated_total == a.blocks_freed_total
+
+    def test_snapshot_reports_sharing(self):
+        a = KVBlockAllocator(8, 16)
+        t1 = a.alloc("s1", 32)
+        a.alloc("s2", 32, shared=t1)
+        a.write_fork("s2", 1)
+        snap = a.snapshot()
+        assert snap["kv_blocks_shared"] == 1
+        assert snap["kv_table_refs"] == 4
+        assert snap["kv_blocks_live"] == 3
+        assert snap["kv_cow_copies_total"] == 1
+        assert snap["kv_shared_refs_total"] == 2
+        assert snap["kv_conservation_ok"] is True
+
+
 class TestPrefixKey:
     def test_shared_head_shares_key(self):
         sys_prompt = list(range(100, 164))

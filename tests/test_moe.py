@@ -314,7 +314,10 @@ class TestGatherCustomVjp:
                             self._plain_combine_out)
         g_plain = jax.grad(loss, argnums=(0, 1))(x, logits)
         for a, b, name in zip(g_custom, g_plain, ("dx", "dlogits")):
+            # atol must absorb f32 re-association noise on dropped-token
+            # logits (scatter-add vs gather backward); real VJP bugs show
+            # up at the gradient's own magnitude, orders above this.
             np.testing.assert_allclose(
-                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6,
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=5e-6,
                 err_msg=f"{name} (skew={skew}, cf={cf}, group={group})",
             )

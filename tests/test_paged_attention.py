@@ -1,0 +1,309 @@
+"""ISSUE 18: physically paged HBM.
+
+Two layers of coverage:
+
+- ``TestPagedOps``: the block-gather kernel in isolation — layout
+  contract (logical position -> pool row, scratch redirection),
+  scatter/gather round trip, page copy, and the exactness contract
+  (paged_decode_attention bitwise-equal to the dense reference when the
+  gathered span equals the dense span).
+- ``TestDenseVsPagedTokens`` / ``TestCopyOnWriteServing``: the engine
+  end to end — same trace + same seed on a dense-cache engine and a
+  paged-pool engine must emit byte-identical tokens (the gate the
+  serving8b bench leg and CI paged-smoke reuse), copy-on-write prefix
+  sharing must be non-vacuous (shared refs AND forks actually happen)
+  with the two-layer conservation invariant clean afterwards.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import Llama, LlamaConfig
+from kubeflow_tpu.ops.attention import mha_reference
+from kubeflow_tpu.ops.paged_attention import (
+    copy_block,
+    gather_kv_pages,
+    paged_decode_attention,
+    physical_rows,
+    pool_shape,
+    scatter_kv_rows,
+    scratch_block_id,
+)
+from kubeflow_tpu.serving import ServingConfig, ServingEngine
+
+BS = 8                       # kv block size used throughout
+MAX_LEN = 64
+KV_BLOCKS = 4 * (MAX_LEN // BS)   # enough for max_batch=4 full slots
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    """Params are shared dense/paged — paging changes only cache vars."""
+    model = Llama(LlamaConfig.tiny(max_seq_len=128))
+    return {
+        "params": model.init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32)
+        )["params"]
+    }
+
+
+def make_engine(params, paged, model_kw=None, serve_kw=None):
+    mc = dict(max_seq_len=128)
+    mc.update(model_kw or {})
+    if paged:
+        mc.update(paged_kv_blocks=KV_BLOCKS, paged_kv_block_size=BS)
+    model = Llama(LlamaConfig.tiny(**mc))
+    sc = dict(max_batch=4, max_len=MAX_LEN)
+    sc.update(serve_kw or {})
+    if paged:
+        sc.update(kv_blocks=KV_BLOCKS, kv_block_size=BS)
+    return ServingEngine(model, params, ServingConfig(**sc))
+
+
+def run_trace(eng, prompts, n_new=8):
+    rids = [eng.submit(list(p), max_new_tokens=n_new) for p in prompts]
+    results = {r.request_id: r.tokens for r in eng.run()}
+    return [results[r] for r in rids]
+
+
+MIXED_TRACE = [
+    [7, 3, 9, 1, 4],
+    [2] * 17,
+    [250, 100, 3],
+    [11, 22, 33, 44, 55, 66, 77],
+]
+
+
+class TestPagedOps:
+    def test_pool_shape_and_scratch(self):
+        assert pool_shape(32, 8, 2, 16) == (33, 8, 2, 16)
+        assert pool_shape(32, 8, 2, 16, trailing=1) == (33, 8, 2, 1)
+        assert scratch_block_id(32) == 32
+
+    def test_physical_rows_layout_and_redirects(self):
+        # Slot 0 owns physical blocks [5, 2]; slot 1 only [7].
+        scratch = scratch_block_id(8)
+        tables = jnp.asarray([[5, 2], [7, scratch]], jnp.int32)
+        positions = jnp.asarray([[0, 3, 4, 7], [1, 4, 9, 0]], jnp.int32)
+        valid = jnp.asarray(
+            [[True, True, True, True], [True, True, True, False]])
+        rows = physical_rows(tables, positions, 4, num_blocks=8,
+                             valid=valid)
+        srow = scratch * 4
+        # p // bs picks the table column, p % bs the in-page offset.
+        assert rows[0].tolist() == [5 * 4 + 0, 5 * 4 + 3, 2 * 4 + 0,
+                                    2 * 4 + 3]
+        # Slot 1: position 4 falls on its scratch-padded column, position
+        # 9 is past the table width, position 0 is masked invalid — all
+        # three must redirect to the scratch page, never another slot's.
+        assert rows[1].tolist() == [7 * 4 + 1, srow, srow, srow]
+
+    def test_scatter_gather_round_trip(self):
+        rng = np.random.default_rng(0)
+        pool = jnp.zeros(pool_shape(6, 4, 2, 3), jnp.float32)
+        tables = jnp.asarray([[4, 1], [0, 3]], jnp.int32)
+        positions = jnp.tile(jnp.arange(8)[None, :], (2, 1))
+        vals = jnp.asarray(rng.normal(size=(2, 8, 2, 3)), jnp.float32)
+        rows = physical_rows(tables, positions, 4, num_blocks=6)
+        pool = scatter_kv_rows(pool, rows, vals)
+        out = gather_kv_pages(pool, tables, 4)
+        # Gather reproduces dense position order exactly.
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(vals))
+
+    def test_copy_block_copies_one_page(self):
+        pool = jnp.arange(6 * 4 * 2 * 3, dtype=jnp.float32).reshape(
+            pool_shape(5, 4, 2, 3))
+        out = copy_block(pool, 1, 3)
+        np.testing.assert_array_equal(np.asarray(out[3]),
+                                      np.asarray(pool[1]))
+        for b in (0, 1, 2, 4, 5):
+            np.testing.assert_array_equal(np.asarray(out[b]),
+                                          np.asarray(pool[b]))
+
+    def test_paged_decode_matches_dense_reference_bitwise(self):
+        """Exactness contract: gathered attention == dense attention on
+        the same logical KV, even with junk in unused pool pages."""
+        rng = np.random.default_rng(1)
+        B, S, H, Hkv, D, bs, nblk = 2, 1, 4, 2, 16, 4, 6
+        L = 2 * bs
+        q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, L, Hkv, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, L, Hkv, D)), jnp.float32)
+        # Junk-filled pool: only the tabled pages get real rows.
+        kp = jnp.asarray(rng.normal(size=pool_shape(nblk, bs, Hkv, D)),
+                         jnp.float32)
+        vp = jnp.asarray(rng.normal(size=pool_shape(nblk, bs, Hkv, D)),
+                         jnp.float32)
+        tables = jnp.asarray([[5, 0], [2, 4]], jnp.int32)
+        positions = jnp.tile(jnp.arange(L)[None, :], (B, 1))
+        rows = physical_rows(tables, positions, bs, num_blocks=nblk)
+        kp = scatter_kv_rows(kp, rows, k)
+        vp = scatter_kv_rows(vp, rows, v)
+        # Mid-page live lengths: junk PAST the query position must mask.
+        q_pos = jnp.asarray([[5], [L - 1]], jnp.int32)
+        out = paged_decode_attention(q, kp, vp, tables, q_pos, bs)
+        mask = (jnp.arange(L)[None, None, :] <= q_pos[:, :, None])
+        ref = mha_reference(q, k, v, mask=mask[:, None, :, :])
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+class TestDenseVsPagedTokens:
+    """Satellite 3: same trace, same seed, dense cache vs paged pool —
+    byte-identical output tokens at a batch point both reach."""
+
+    def test_mixed_trace_token_exact(self, tiny_params):
+        dense = make_engine(tiny_params, paged=False)
+        paged = make_engine(tiny_params, paged=True)
+        assert run_trace(dense, MIXED_TRACE) == \
+            run_trace(paged, MIXED_TRACE)
+        paged.blocks.check_conservation()
+        assert paged.blocks.blocks_live == 0
+
+    def test_int8_kv_staged_chunked_token_exact(self, tiny_params):
+        """The SERVING8B config shape: int8 KV + decode staging +
+        decode_chunk>1 + pipelined dispatch, dense vs paged."""
+        mkw = dict(kv_cache_dtype="int8", decode_staging=4)
+        skw = dict(decode_chunk=4, pipeline_depth=2)
+        dense = make_engine(tiny_params, False, mkw, skw)
+        paged = make_engine(tiny_params, True, mkw, skw)
+        assert run_trace(dense, MIXED_TRACE) == \
+            run_trace(paged, MIXED_TRACE)
+        paged.blocks.check_conservation()
+
+    def test_chunked_prefill_token_exact(self, tiny_params):
+        """Prompt longer than the largest prefill bucket exercises the
+        paged _extend_step path."""
+        skw = dict(prefill_buckets=(16, 32))
+        long_prompt = [(5 * i + 2) % 250 for i in range(50)]
+        trace = [long_prompt, [4, 5, 6]]
+        dense = make_engine(tiny_params, False, serve_kw=skw)
+        paged = make_engine(tiny_params, True, serve_kw=skw)
+        assert run_trace(dense, trace, n_new=6) == \
+            run_trace(paged, trace, n_new=6)
+        paged.blocks.check_conservation()
+
+    def test_pool_governs_real_memory(self, tiny_params):
+        """The tentpole's point: the paged cache leaves are sized by the
+        pool (kv_blocks + scratch), NOT by max_batch * max_len — so
+        shrinking kv_blocks shrinks actual HBM."""
+        paged = make_engine(tiny_params, paged=True)
+        leaves = [l for l in jax.tree_util.tree_leaves(paged._cache)
+                  if l.ndim == 4]
+        assert leaves, "no pool leaves found"
+        assert all(l.shape[0] == KV_BLOCKS + 1 and l.shape[1] == BS
+                   for l in leaves)
+        # The dense cache materialises max_batch x model.max_seq_len
+        # rows per layer regardless of how many are live.
+        dense = make_engine(tiny_params, paged=False)
+        dl = [l for l in jax.tree_util.tree_leaves(dense._cache)
+              if l.ndim == 4]
+        assert all(l.shape[:2] == (4, 128) for l in dl)
+
+    def test_geometry_validation(self, tiny_params):
+        params = tiny_params
+        model = Llama(LlamaConfig.tiny(
+            max_seq_len=128, paged_kv_blocks=KV_BLOCKS,
+            paged_kv_block_size=BS))
+        with pytest.raises(ValueError, match="divisible"):
+            ServingEngine(model, params, ServingConfig(
+                max_batch=4, max_len=60,       # 60 % 8 != 0
+                kv_blocks=KV_BLOCKS, kv_block_size=BS))
+        with pytest.raises(ValueError, match="kv_block_size"):
+            ServingEngine(model, params, ServingConfig(
+                max_batch=4, max_len=MAX_LEN,
+                kv_blocks=KV_BLOCKS, kv_block_size=16))
+        with pytest.raises(ValueError, match="paged_kv_blocks"):
+            ServingEngine(model, params, ServingConfig(
+                max_batch=4, max_len=MAX_LEN,
+                kv_blocks=KV_BLOCKS // 2, kv_block_size=BS))
+
+
+class TestCopyOnWriteServing:
+    """COW prefix sharing through the live engine: matching prompts map
+    to the SAME physical pages; the first decode write into a shared
+    page forks it; tokens stay byte-identical to dense throughout."""
+
+    def test_identical_prompts_share_fork_and_stay_exact(self, tiny_params):
+        # 17 tokens with BS=8: blocks 0-1 fully shared, block 2 is a
+        # shared PARTIAL tail — every sharer's first decode write lands
+        # in it and must fork.
+        trace = [[(7 * i + 3) % 250 for i in range(17)]] * 4
+        dense = make_engine(tiny_params, paged=False)
+        paged = make_engine(tiny_params, paged=True)
+        assert run_trace(dense, trace, n_new=10) == \
+            run_trace(paged, trace, n_new=10)
+        # Non-vacuity: sharing AND forking actually happened.
+        assert paged.blocks.shared_refs_total >= 3, "no blocks shared"
+        assert paged.blocks.cow_copies_total >= 3, "no COW fork happened"
+        paged.blocks.check_conservation()
+        assert paged.blocks.blocks_live == 0
+        assert paged.blocks.blocks_free == KV_BLOCKS
+
+    def test_block_aligned_prefix_shares_without_fork(self, tiny_params):
+        """Prompts that agree on exactly the first block but then
+        diverge: the shared page is never written past (each sequence's
+        private tail starts in its own fresh block), so sharing needs no
+        fork and the idempotent prefill rewrite is exempt from COW."""
+        head = [9, 8, 7, 6, 5, 4, 3, 2]            # exactly one block
+        trace = [head + [100 + i] for i in range(3)]
+        paged = make_engine(tiny_params, paged=True)
+        dense = make_engine(tiny_params, paged=False)
+        assert run_trace(dense, trace, n_new=6) == \
+            run_trace(paged, trace, n_new=6)
+        assert paged.blocks.shared_refs_total >= 2
+        assert paged.blocks.cow_copies_total == 0
+        paged.blocks.check_conservation()
+
+    def test_sharing_lifts_effective_batch(self, tiny_params):
+        """At fixed kv_blocks, a prefix-heavy trace admits sequences a
+        no-sharing pool could not hold simultaneously — COW lifts
+        effective batch (the bench COW leg's claim, engine-level)."""
+        # Pool of 12 blocks; each request demands 3 blocks (17 prompt +
+        # 6 new = 23 tokens -> ceil(23/8) = 3). Without sharing, 4
+        # concurrent sequences need 12 blocks; WITH sharing the 2 fully
+        # shared head blocks are counted once.
+        prompt = [(3 * i + 1) % 250 for i in range(17)]
+        model = Llama(LlamaConfig.tiny(
+            max_seq_len=128, paged_kv_blocks=9, paged_kv_block_size=BS))
+        eng = ServingEngine(model, tiny_params, ServingConfig(
+            max_batch=4, max_len=MAX_LEN, kv_blocks=9, kv_block_size=BS))
+        for _ in range(4):
+            eng.submit(list(prompt), max_new_tokens=6)
+        eng._admit()
+        # 4 sequences x 3 blocks = 12 table refs on only 9 physical
+        # blocks, minus fork reserve — sharing made >9 refs admissible.
+        assert eng.active_slots >= 3
+        assert eng.blocks.table_refs > eng.blocks.blocks_live
+        res = eng.run()
+        assert len(res) == 4 and all(len(r.tokens) == 6 for r in res)
+        eng.blocks.check_conservation()
+        assert eng.blocks.blocks_live == 0
+
+    def test_load_and_metrics_report_paging(self, tiny_params):
+        from kubeflow_tpu.utils.monitoring import MetricsRegistry
+
+        reg = MetricsRegistry()
+        model = Llama(LlamaConfig.tiny(
+            max_seq_len=128, paged_kv_blocks=KV_BLOCKS,
+            paged_kv_block_size=BS))
+        eng = ServingEngine(model, tiny_params, ServingConfig(
+            max_batch=4, max_len=MAX_LEN, kv_blocks=KV_BLOCKS,
+            kv_block_size=BS), registry=reg)
+        trace = [[(7 * i + 3) % 250 for i in range(17)]] * 3
+        run_trace(eng, trace, n_new=6)
+        load = eng.load()
+        assert load["kv_paged"] is True
+        assert load["kv_blocks_shared"] == 0           # drained
+        assert load["kv_cow_copies_total"] >= 2
+        assert load["kv_table_refs"] == 0
+        assert reg.counter(
+            "kftpu_serving_kv_cow_copies_total",
+            "Copy-on-write block forks").value() >= 2.0
+        assert reg.gauge(
+            "kftpu_serving_kv_blocks_shared",
+            "KV blocks referenced by more than one sequence",
+        ).value() == 0.0
+        snap = eng.blocks.snapshot()
+        assert snap["kv_conservation_ok"] is True
