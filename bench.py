@@ -21,6 +21,7 @@ matched" contract.
 from __future__ import annotations
 
 import argparse
+import itertools
 import json
 import time
 
@@ -184,7 +185,6 @@ def bench_train(args) -> None:
         extra = {"loader": "native",
                  "loader_stalls": loader.stalls - stalls_before,
                  "corpus": args.data_path or "synthetic-native"}
-        loader.close()
     _emit(
         "llama_700m_train_tokens_per_sec_per_chip", tps_chip, "tokens/s/chip",
         BASELINES["train"],
@@ -193,6 +193,81 @@ def bench_train(args) -> None:
         attn=args.attn,
         **extra,
     )
+
+    if args.profile:
+        # Profiled leg, SAME compiled fn and state. Session throughput
+        # fluctuates at a seconds timescale far more than the profiler
+        # costs (BASELINE.md: A/B in ONE process, min-of-3), so a single
+        # sequential A/B measures the noise, not the overhead: run many
+        # short alternating control/profiled windows — ABBA order, so a
+        # slow OS/XLA state or a drift trend hits both legs equally —
+        # and compare best window against best window (the noise-floor
+        # estimator). The control runs a DISABLED profiler, i.e. the
+        # exact hot-loop cost production pays with profiling off, and
+        # the gate is one-sided: profiled merely *faster* is noise.
+        from kubeflow_tpu.obs.profiler import Profiler
+
+        prof = Profiler()
+        null_prof = Profiler(enabled=False)
+        pairs = 6
+        leg_steps = max(1, args.steps // 2)
+        leg_tokens = bs * ndev * args.seq_len * leg_steps
+        step_no = itertools.count(1)  # unique across windows
+
+        def _leg(profiler, state):
+            t0 = time.perf_counter()
+            for _ in range(leg_steps):
+                h = profiler.start_step("train", next(step_no))
+                if loader:
+                    raw = next(it)
+                    h.mark("data_load")
+                    b = trainer.shard_batch(
+                        {k: jnp.asarray(v) for k, v in raw.items()})
+                    h.mark("host_to_device")
+                else:
+                    b = batch
+                    h.mark("data_load")
+                    h.mark("host_to_device")
+                state, metrics = trainer.step(state, b)
+                h.mark("step_compute")
+                profiler.finish_step(h)
+            float(metrics["loss"])
+            return state, leg_tokens / (time.perf_counter() - t0) / ndev
+
+        ctl, prf = [], []
+        for r in range(pairs):
+            order = [(null_prof, ctl), (prof, prf)]
+            if r % 2:
+                order.reverse()
+            for profiler, series in order:
+                state, t = _leg(profiler, state)
+                series.append(t)
+        prof_tps = max(prf)
+        prof_mfu = prof.set_train_mfu(tokens_per_sec=prof_tps,
+                                      flops_per_token=flops_per_token)
+        overhead = max(0.0, 1.0 - prof_tps / max(ctl))
+        if overhead > 0.02:
+            raise SystemExit(
+                f"train --profile: profiler overhead {overhead:.1%} "
+                f"exceeds the 2% budget ({prof_tps:.0f} vs "
+                f"{max(ctl):.0f} tok/s/chip, best of {pairs} "
+                f"interleaved windows each)")
+        s = prof.summary()["train"]
+        if not s["conservation_ok"] or s["steps"] != pairs * leg_steps:
+            raise SystemExit(
+                f"train --profile: phase/step conservation broken or "
+                f"steps lost — {s['steps']}/{pairs * leg_steps} steps, "
+                f"conservation_ok={s['conservation_ok']}")
+        _emit(
+            "llama_700m_train_profiled_tokens_per_sec_per_chip",
+            prof_tps, "tokens/s/chip", 0.0,
+            profile_overhead_pct=round(overhead * 100, 2),
+            phase_fractions={k: round(v, 4)
+                             for k, v in sorted(s["fractions"].items())},
+            mfu=round(prof_mfu, 4),
+        )
+    if loader is not None:
+        loader.close()
 
 
 # ---------------------------------------------------------------- config 5
@@ -439,6 +514,79 @@ def bench_serving8b(args) -> None:
         **kv_note,
         **paged_note,
     )
+
+    if args.profile:
+        # Profiled leg on the SAME engine (same compiled fns, same pool):
+        # re-play the workload alternating unprofiled control and
+        # profiled passes, best-of-3 each (BASELINE.md: session
+        # throughput drifts ±5-25%, so A/B in ONE process, min-of-3 —
+        # a single sequential pair measures the drift, not the
+        # overhead). One-sided gate: only profiled *slower* than the
+        # best control counts. Hard gates: <= 2% throughput overhead,
+        # phase/step conservation, and the structural track floor the
+        # ISSUE prescribes (>= 4 phase tracks, >= 2 counter tracks).
+        from kubeflow_tpu.obs.profiler import (
+            Profiler,
+            perfetto_track_counts,
+            serving_cost_catalog,
+        )
+
+        prof = Profiler()
+        if paged:
+            prof.set_catalog(serving_cost_catalog(
+                mcfg, context_len=args.prompt_len, kv_block_size=pbs,
+                blocks_per_seq=blocks_per_seq, batch=bs))
+
+        def _leg(profiler):
+            engine.attach_profiler(profiler)
+            t0 = time.perf_counter()
+            rids = [engine.submit(p, max_new_tokens=args.gen_len)
+                    for p in prompts]
+            engine.run()
+            leg_dt = time.perf_counter() - t0
+            engine.attach_profiler(None)
+            toks = sum(len(engine.result(r).tokens) for r in rids)
+            return toks / leg_dt / ndev
+
+        pairs = 3
+        ctl = [gen_tokens / dt / ndev]  # the main bench window counts too
+        prf = []
+        for r in range(pairs):
+            # ABBA order: a slow scheduler state or drift trend hits
+            # both legs equally instead of always taxing the second.
+            if r % 2:
+                prf.append(_leg(prof))
+                ctl.append(_leg(None))
+            else:
+                ctl.append(_leg(None))
+                prf.append(_leg(prof))
+        prof_tps = max(prf)
+        overhead = max(0.0, 1.0 - prof_tps / max(ctl))
+        if overhead > 0.02:
+            raise SystemExit(
+                f"serving8b --profile: profiler overhead {overhead:.1%} "
+                f"exceeds the 2% budget ({prof_tps:.0f} vs "
+                f"{max(ctl):.0f} tok/s/chip, best of {pairs} "
+                f"interleaved windows each)")
+        s = prof.summary().get("serve")
+        if s is None or s["steps"] == 0 or not s["conservation_ok"]:
+            raise SystemExit(
+                f"serving8b --profile: no profiled steps or phase/step "
+                f"conservation broken — {s}")
+        counts = perfetto_track_counts(prof.export_perfetto())
+        if counts["phase_tracks"] < 4 or counts["counter_tracks"] < 2:
+            raise SystemExit(
+                f"serving8b --profile: export too thin — {counts} "
+                "(need >= 4 phase tracks and >= 2 counter tracks)")
+        _emit(
+            "llama3_8b_serving_profiled_tokens_per_sec_per_chip",
+            prof_tps, "tokens/s/chip", 0.0,
+            profile_overhead_pct=round(overhead * 100, 2),
+            profiled_steps=s["steps"],
+            phase_fractions={k: round(v, 4)
+                             for k, v in sorted(s["fractions"].items())},
+            **{f"perfetto_{k}": v for k, v in sorted(counts.items())},
+        )
 
 
 # ---------------------------------------------------------------- config 1
@@ -1714,6 +1862,14 @@ def main() -> None:
                         "every measured scale; '' selects the bf16 cache")
     p.add_argument("--trace-dir", default="",
                    help="write a jax.profiler trace of the timed steps")
+    p.add_argument("--profile", action="store_true",
+                   help="train/serving8b: run a second, profiled leg on "
+                        "the same compiled fns (obs.profiler phase "
+                        "timelines + HBM counters) — hard-gated at <= 2% "
+                        "throughput overhead vs the unprofiled control, "
+                        "phase/step conservation, and (serving8b) the "
+                        ">= 4 phase / >= 2 counter perfetto track floor; "
+                        "emits a phase-fraction record")
     # Round-3 measured defaults (decisive same-session sweep, min-of-3):
     # qkv_attn policy (save q/k/v + attention context, replay the MLP)
     # + bf16 Adam mu + bf16 logits beat full remat 55.9% vs 53.4% MFU.

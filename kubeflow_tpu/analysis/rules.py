@@ -36,6 +36,7 @@ TICK_DOMAIN = frozenset({
     "obs/slo.py",
     "obs/goodput.py",
     "obs/remediate.py",
+    "obs/profiler.py",
 })
 
 _WALL_TIME_ATTRS = {

@@ -3,9 +3,24 @@
 modules here turn those streams into operator-facing accounts — the
 fleet goodput ledger (ISSUE 10), and the detect-and-explain layer on
 top of it (ISSUE 15): the SLO engine with burn-rate alerting
-(`obs/slo.py`) and the crash-dump flight recorder (`obs/flight.py`)."""
+(`obs/slo.py`), the crash-dump flight recorder (`obs/flight.py`), and
+the data-plane step profiler (`obs/profiler.py`, ISSUE 19)."""
 
 from kubeflow_tpu.obs.flight import FlightRecorder, flight_paths, stitch
+from kubeflow_tpu.obs.profiler import (
+    NULL_STEP,
+    SERVING_PHASES,
+    TRAIN_PHASES,
+    Profiler,
+    TickClock,
+    perfetto_json,
+    perfetto_track_counts,
+    profile_gate_failures,
+    seeded_serving_profile,
+    seeded_train_profile,
+    serving_cost_catalog,
+    train_cost_catalog,
+)
 from kubeflow_tpu.obs.remediate import (
     ACTIONS_JOURNAL,
     Playbook,
@@ -36,17 +51,29 @@ __all__ = [
     "DEFAULT_WINDOWS",
     "FlightRecorder",
     "GoodputAccountant",
+    "NULL_STEP",
     "Objective",
     "Playbook",
+    "Profiler",
     "RemediationController",
+    "SERVING_PHASES",
     "SLOEngine",
     "TICK_WINDOWS",
+    "TRAIN_PHASES",
+    "TickClock",
     "Windows",
     "chaos_policy_parity_report",
     "default_objectives",
     "flight_paths",
     "goodput_rows_digest",
+    "perfetto_json",
+    "perfetto_track_counts",
+    "profile_gate_failures",
     "remediation_objective",
+    "seeded_serving_profile",
+    "seeded_train_profile",
+    "serving_cost_catalog",
     "soak_objectives",
     "stitch",
+    "train_cost_catalog",
 ]

@@ -46,6 +46,10 @@ FLIGHT_GLOB = "flight-*.jsonl"
 #: Spans pulled from the tracer ring into a dump (newest kept).
 DUMP_SPAN_TAIL = 256
 
+#: Phase records pulled from an attached profiler's ring into a dump
+#: (newest kept) — SLO pages arrive with step-phase evidence attached.
+DUMP_PHASE_TAIL = 256
+
 #: Changed counter samples recorded per ``record_metric_deltas`` call.
 METRIC_DELTA_CAP = 64
 
@@ -78,6 +82,7 @@ class FlightRecorder:
         self._metric_baselined = False
         self._api = None
         self._queue = None
+        self._profiler = None
         self.dumps: List[str] = []      # paths written by this recorder
         # Latched guard failures: a guard that flips false dumps ONCE
         # (the conservation gate would otherwise dump every tick until
@@ -107,6 +112,19 @@ class FlightRecorder:
         :meth:`pump` can fold recent object transitions into the ring."""
         self._api = api
         self._queue = api.watch(None)
+        return self
+
+    def attach_profiler(self, profiler) -> "FlightRecorder":
+        """Attach a step profiler (obs/profiler.py) sharing this
+        recorder's ``now_fn`` clock domain: every dump then appends the
+        profiler's recent phase ring (bounded by
+        :data:`DUMP_PHASE_TAIL`) so an alert page or tripped guard
+        lands with the step-phase evidence attached. Phase entries
+        carry the PROFILER's monotone seq in the same ``t`` domain, so
+        ``stitch()``'s ``(t, shard, seq)`` ordering and its
+        ``(shard, seq, kind, t)`` dedup hold unchanged across
+        overlapping dumps."""
+        self._profiler = profiler
         return self
 
     def detach(self) -> None:
@@ -248,11 +266,20 @@ class FlightRecorder:
                                        "span_id": s.span_id,
                                        "duration_s": s.duration_s,
                                        "attrs": s.attrs}})
+        phases: List[Dict[str, Any]] = []
+        if self._profiler is not None:
+            for rec in self._profiler.recent_phases(DUMP_PHASE_TAIL):
+                phases.append({
+                    "shard": self.shard, "t": rec["t"], "kind": "phase",
+                    "seq": rec["seq"],
+                    "data": {"track": rec["track"],
+                             "phase": rec["phase"],
+                             "step": rec["step"], "dur": rec["dur"]}})
         header = {"kind": "flight", "reason": reason, "shard": self.shard,
                   "t": round(now, 6), "entries": len(entries),
-                  "spans": len(spans), "seq": 0}
+                  "spans": len(spans), "phases": len(phases), "seq": 0}
         with open(path, "w") as f:
-            for rec in [header] + entries + spans:
+            for rec in [header] + entries + phases + spans:
                 f.write(json.dumps(rec, sort_keys=True) + "\n")
             f.flush()
             os.fsync(f.fileno())

@@ -1,0 +1,728 @@
+"""kftpu-prof: the deterministic data-plane step profiler (ISSUE 19).
+
+The platform watches itself from the outside (SLO engine, goodput
+ledger, control-plane tracing) but has been blind *inside* the step:
+"tok/s dropped" with nothing to say where. This module decomposes every
+train step and every serving engine step into phases and attributes
+cost to them — the lens the TPU-concurrency study (arxiv 2011.03641)
+uses to explain step time, and the collective-bytes baseline ROADMAP
+item 2 (EQuARX, arxiv 2506.17615) needs before quantized allreduce can
+claim a bandwidth win.
+
+Design rules, in order of precedence:
+
+1. **Conservation by construction.** A step handle samples the clock
+   once at ``start_step`` and once per ``mark(phase)``; each phase is
+   the half-open interval since the previous mark, and the step span is
+   ``[t0, last_mark]``. Phase durations therefore *tile* the step —
+   ``sum(phase) == step`` is an identity, not an aspiration — and the
+   regression gate checks it as an integer-domain invariant.
+
+2. **One clock seam, two domains.** ``now_fn`` defaults to
+   ``time.monotonic`` (the injection seam itself; this module is in the
+   KF101 tick domain so no wall-clock *call* appears here). Production
+   passes nothing and gets real seconds; seeded scenarios pass a
+   :class:`TickClock` and get byte-deterministic integer ticks — every
+   clock read costs exactly one tick, so phase durations become event
+   counts and the whole profile (and its perfetto export) is
+   reproducible byte-for-byte.
+
+3. **Zero overhead when off.** A disabled profiler hands out the
+   :data:`NULL_STEP` singleton whose methods are no-ops; hot loops
+   guard with ``if h is not None``. Importing this module imports no
+   jax — the cost-catalog builders lazy-import ``train/flops.py`` and
+   friends only when called (asserted by test).
+
+4. **No wall-clock absolutes in gates.** :func:`profile_gate_failures`
+   compares phase *fractions* (one-sided: a phase that grew its share
+   beyond budget is a regression; the complement shrink is not) plus
+   count-based structure (steps observed, phases present,
+   conservation). Chaos latency injected into one phase therefore trips
+   exactly that phase — the non-vacuity contract the CI ``prof-smoke``
+   stage asserts both ways.
+
+Perfetto export: :meth:`Profiler.export_perfetto` writes Chrome
+trace-event JSON — one process per track ("train", "serve"), one thread
+per phase, counter tracks for the HBM/KV occupancy samples. Tracer span
+ids embed a per-process random stamp (utils/tracing.py), so the export
+serialises only ring data (ticks, names, step numbers) — never raw
+span ids — to stay byte-identical across processes.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kubeflow_tpu.utils import tracing
+
+# Canonical phase order — also the perfetto thread (track) order, so
+# exports are stable even when phases first appear in different orders.
+TRAIN_PHASES: Tuple[str, ...] = (
+    "data_load", "host_to_device", "step_compute", "eval",
+    "checkpoint_save",
+)
+SERVING_PHASES: Tuple[str, ...] = (
+    "queue_wait", "prefill", "decode_chunk", "block_gather", "sample",
+    "retire",
+)
+_PHASE_ORDER: Tuple[str, ...] = TRAIN_PHASES + SERVING_PHASES
+
+#: Host-side phase durations span ~100us (a mark around a dict build)
+#: to ~10s (a checkpoint save); the SLO-engine default latency buckets
+#: stop at 10s which is fine, but phases need the fine low end.
+PHASE_SECONDS_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+class TickClock:
+    """Deterministic logical clock: every call returns the current tick
+    and advances by ``step``. Injected as ``now_fn`` it puts the whole
+    profile in an integer tick domain where a phase's duration equals
+    the number of clock reads it contained — seeded runs become
+    byte-reproducible, which is what the CI gate diffs."""
+
+    def __init__(self, start: int = 0, step: int = 1):
+        self._t = int(start)
+        self._step = int(step)
+
+    def __call__(self) -> int:
+        t = self._t
+        self._t += self._step
+        return t
+
+    def advance(self, n: int) -> None:
+        """Consume ``n`` extra ticks (simulated latency)."""
+        self._t += int(n) * self._step
+
+    def peek(self) -> int:
+        return self._t
+
+
+class _NullStep:
+    """No-op step handle handed out by a disabled profiler so hot loops
+    pay one attribute lookup and a no-op call, nothing else."""
+
+    __slots__ = ()
+
+    def mark(self, phase: str) -> None:  # pragma: no cover - trivial
+        return None
+
+
+NULL_STEP = _NullStep()
+
+
+class _Step:
+    """An open step: phases accumulate as (name, start, duration) tiles
+    between consecutive clock samples. Not thread-safe — one handle per
+    driving loop, which is how both runners use it."""
+
+    __slots__ = ("prof", "track", "step", "trace_id", "t0", "last",
+                 "phases")
+
+    def __init__(self, prof: "Profiler", track: str, step: int,
+                 trace_id: str, t0) -> None:
+        self.prof = prof
+        self.track = track
+        self.step = step
+        self.trace_id = trace_id
+        self.t0 = t0
+        self.last = t0
+        self.phases: List[Tuple[str, Any, Any]] = []
+
+    def mark(self, phase: str) -> None:
+        """Close the phase running since the previous mark (or step
+        start). Chaos latency for this phase is injected *before* the
+        closing sample so the extra ticks land inside the phase."""
+        prof = self.prof
+        extra = prof.chaos_extra_ticks.get(phase, 0)
+        for _ in range(extra):
+            prof._now()
+        t = prof._now()
+        self.phases.append((phase, self.last, t - self.last))
+        self.last = t
+
+
+class Profiler:
+    """Low-overhead phase profiler over the existing Tracer seam.
+
+    Ring buffers (phase, step, counter) are bounded deques stamped with
+    a per-profiler monotone ``seq``; :meth:`summary` reports how many
+    steps fell off the ring (no silent caps). ``flight=`` attaches this
+    profiler to a FlightRecorder sharing the same ``now_fn`` clock
+    domain (``attach_profiler``): alert-page and guard dumps then
+    append the recent phase ring, so SLO pages arrive with step-phase
+    evidence and ``stitch()`` ordering holds by construction.
+    """
+
+    def __init__(self, *, enabled: bool = True,
+                 tracer: Optional[tracing.Tracer] = None,
+                 registry=None,
+                 now_fn: Optional[Callable[[], Any]] = None,
+                 shard: str = "",
+                 capacity: int = 4096,
+                 flight=None,
+                 chaos_extra_ticks: Optional[Dict[str, int]] = None):
+        self.enabled = bool(enabled)
+        self.shard = shard
+        # Reference-only default: the KF101 injection seam.
+        self._now = now_fn if now_fn is not None else time.monotonic
+        self.tracer = tracer
+        self.flight = flight
+        if self.enabled and flight is not None:
+            flight.attach_profiler(self)
+        self.chaos_extra_ticks = dict(chaos_extra_ticks or {})
+        cap = max(int(capacity), 1)
+        # Phases outnumber steps ~6:1; size the step/counter rings down
+        # so a full phase ring never strands step records whose phases
+        # were already evicted more than transiently.
+        self._phases: deque = deque(maxlen=cap)
+        self._steps: deque = deque(maxlen=cap)
+        self._counters: deque = deque(maxlen=cap)
+        # Lifetime finished-step count per track: the ring may evict but
+        # the LEDGER may not — summary()'s steps_dropped is derived from
+        # this total, so eviction is always visible (no silent caps).
+        self._finished: Dict[str, int] = {}
+        self._seq = 0
+        self._catalog: Dict[str, Dict[str, Any]] = {}
+        self._run_trace_id = ""
+        self._hist_train = self._hist_serve = self._mfu_gauge = None
+        if self.enabled and registry is not None:
+            self._hist_train = registry.histogram(
+                "kftpu_train_phase_seconds",
+                "Train step time decomposed by phase (profiler tiles).",
+                buckets=PHASE_SECONDS_BUCKETS, labels=("phase",))
+            self._hist_serve = registry.histogram(
+                "kftpu_serving_phase_seconds",
+                "Serving engine step time decomposed by phase.",
+                buckets=PHASE_SECONDS_BUCKETS, labels=("phase",))
+            self._mfu_gauge = registry.gauge(
+                "kftpu_train_mfu_ratio",
+                "Model-FLOPs utilization: achieved model FLOP/s over "
+                "device peak (0 when the peak is unknown).")
+
+    # ----------------------------- stepping -----------------------------
+
+    def start_step(self, track: str, step: int, *, trace_id: str = ""):
+        """Open a step on ``track`` ("train"/"serve"). Returns a handle
+        whose :meth:`_Step.mark` closes consecutive phases; a disabled
+        profiler returns :data:`NULL_STEP` without reading the clock."""
+        if not self.enabled:
+            return NULL_STEP
+        return _Step(self, track, int(step), trace_id, self._now())
+
+    def finish_step(self, handle) -> Optional[Dict[str, Any]]:
+        """Close the step: ring the phases + step record, observe the
+        phase histograms, emit tracer spans under the adopted trace id,
+        and (if attached) land one flight-recorder entry."""
+        if handle is NULL_STEP or handle is None or not self.enabled:
+            return None
+        h = handle
+        step_dur = h.last - h.t0
+        hist = (self._hist_train if h.track == "train"
+                else self._hist_serve if h.track == "serve" else None)
+        trace_id = h.trace_id or self._run_trace()
+        by_phase: Dict[str, Any] = {}
+        for phase, t0, dur in h.phases:
+            self._seq += 1
+            rec = {"track": h.track, "phase": phase, "step": h.step,
+                   "t": t0, "dur": dur, "seq": self._seq}
+            self._phases.append(rec)
+            by_phase[phase] = by_phase.get(phase, 0) + dur
+            if hist is not None:
+                hist.observe(float(dur), exemplar=trace_id, phase=phase)
+            if self.tracer is not None:
+                s = self.tracer.start(
+                    f"{h.track}/{phase}", trace_id=trace_id,
+                    attrs={"step": h.step, "tick": t0, "ticks": dur,
+                           "shard": self.shard})
+                self.tracer.finish(s)
+        self._seq += 1
+        srec = {"track": h.track, "step": h.step, "t": h.t0,
+                "dur": step_dur, "seq": self._seq, "phases": by_phase}
+        self._steps.append(srec)
+        self._finished[h.track] = self._finished.get(h.track, 0) + 1
+        return srec
+
+    def request_event(self, name: str, trace_id: str, *,
+                      attrs: Optional[Dict[str, Any]] = None):
+        """Emit an instant span under an *existing* request/job trace id
+        (``req:<n>`` / job names) so the phase evidence stitches into
+        the timelines ``tpuctl trace --id`` already renders."""
+        if not self.enabled or self.tracer is None:
+            return None
+        s = self.tracer.start(name, trace_id=trace_id,
+                              attrs=dict(attrs or {}))
+        self.tracer.finish(s)
+        return s
+
+    def sample_counters(self, values: Dict[str, float], *,
+                        track: str = "serve",
+                        step: Optional[int] = None) -> None:
+        """Sample counter-track values (HBM occupancy, blocks shared,
+        scratch pressure) at one clock read — a single timeline tick
+        shared by all the values in this sample."""
+        if not self.enabled or not values:
+            return
+        t = self._now()
+        for name in sorted(values):
+            self._seq += 1
+            self._counters.append(
+                {"track": track, "name": name, "t": t,
+                 "value": float(values[name]), "step": step,
+                 "seq": self._seq})
+
+    def _run_trace(self) -> str:
+        """One root span per profiler run: steps with no request/job id
+        of their own share its trace id, forming a single timeline."""
+        if not self._run_trace_id:
+            if self.tracer is None:
+                self._run_trace_id = "profile:run"
+            else:
+                s = self.tracer.start("profile/run",
+                                      attrs={"shard": self.shard})
+                self.tracer.finish(s)
+                self._run_trace_id = s.trace_id
+        return self._run_trace_id
+
+    # --------------------------- cost catalog ---------------------------
+
+    @property
+    def catalog(self) -> Dict[str, Dict[str, Any]]:
+        return self._catalog
+
+    def set_catalog(self, catalog: Dict[str, Dict[str, Any]]) -> None:
+        """Attach a per-compiled-fn cost catalog (see
+        :func:`train_cost_catalog` / :func:`serving_cost_catalog`);
+        merged, not replaced, so train and serving catalogs compose."""
+        self._catalog.update(catalog)
+
+    def set_train_mfu(self, *, tokens_per_sec: float,
+                      flops_per_token: float,
+                      peak_tflops: Optional[float] = None) -> float:
+        """Publish achieved MFU to ``kftpu_train_mfu_ratio`` and the
+        catalog. ``peak_tflops=None`` asks the device (lazy jax import);
+        an unknown peak (CPU) reports 0 rather than a fiction."""
+        if peak_tflops is None:
+            from kubeflow_tpu.train.flops import device_peak_tflops
+            peak_tflops = device_peak_tflops()
+        ratio = 0.0
+        if peak_tflops and peak_tflops > 0:
+            ratio = (tokens_per_sec * flops_per_token
+                     / (peak_tflops * 1e12))
+        entry = self._catalog.setdefault("train_step", {})
+        entry["mfu"] = ratio
+        entry["peak_tflops"] = float(peak_tflops or 0.0)
+        if self._mfu_gauge is not None:
+            self._mfu_gauge.set(ratio)
+        return ratio
+
+    # ------------------------- read/export surface -----------------------
+
+    def recent_phases(self, n: int = 64) -> List[Dict[str, Any]]:
+        """Newest-last tail of the phase ring — the slice FlightRecorder
+        dumps append as SLO-page evidence (bounded by ``n``)."""
+        if n <= 0:
+            return []
+        return list(self._phases)[-int(n):]
+
+    def summary(self) -> Dict[str, Any]:
+        """Per-track rollup over *complete* steps (steps whose phase
+        tiles are still fully resident in the ring): total ticks, ticks
+        and fraction per phase, the conservation verdict, and how many
+        steps fell off the ring (no silent caps)."""
+        oldest_phase_t = self._phases[0]["t"] if self._phases else None
+        phases_by_step: Dict[Tuple[str, int], Any] = {}
+        for rec in self._phases:
+            key = (rec["track"], rec["step"])
+            phases_by_step[key] = phases_by_step.get(key, 0) + rec["dur"]
+        out: Dict[str, Any] = {}
+        # Every track that ever finished a step appears, even if the
+        # rings have since evicted all of it — the lifetime ledger is
+        # what keeps eviction visible.
+        for track in self._finished:
+            out[track] = {
+                "steps": 0, "steps_dropped": 0, "step_ticks": 0,
+                "phase_ticks": {}, "fractions": {},
+                "conservation_ok": True,
+            }
+        complete_keys = set()
+        for srec in self._steps:
+            track = srec["track"]
+            tr = out[track]
+            complete = (oldest_phase_t is not None
+                        and not srec["t"] < oldest_phase_t)
+            if not complete:
+                continue
+            complete_keys.add((track, srec["step"]))
+            tr["steps"] += 1
+            tr["step_ticks"] += srec["dur"]
+            dur = srec["dur"]
+            tiled = phases_by_step.get((track, srec["step"]), 0)
+            if isinstance(dur, int) and isinstance(tiled, int):
+                ok = tiled == dur      # tick domain: exact identity
+            else:                      # wall clock: telescoped floats
+                ok = abs(tiled - dur) <= 1e-9 + 1e-6 * abs(dur)
+            if not ok:
+                tr["conservation_ok"] = False
+        for rec in self._phases:
+            if (rec["track"], rec["step"]) not in complete_keys:
+                continue
+            ticks = out[rec["track"]]["phase_ticks"]
+            ticks[rec["phase"]] = ticks.get(rec["phase"], 0) + rec["dur"]
+        for track, tr in out.items():
+            tr["steps_dropped"] = (self._finished.get(track, 0)
+                                   - tr["steps"])
+            total = tr["step_ticks"]
+            if total:
+                tr["fractions"] = {
+                    p: t / total for p, t in sorted(tr["phase_ticks"].items())
+                }
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        """The whole profile as one JSON-able dict — what ``tpuctl
+        profile record`` saves and ``show``/``export`` read back."""
+        return {
+            "version": 1,
+            "shard": self.shard,
+            "phases": list(self._phases),
+            "steps": list(self._steps),
+            "counters": list(self._counters),
+            "catalog": self._catalog,
+            "summary": self.summary(),
+        }
+
+    def export_perfetto(self, path: Optional[str] = None) -> str:
+        """Chrome trace-event JSON of the rings (see
+        :func:`perfetto_json`); optionally written to ``path``."""
+        text = perfetto_json(self.to_dict())
+        if path:
+            with open(path, "w") as f:
+                f.write(text)
+        return text
+
+
+# -------------------------- perfetto rendering ---------------------------
+
+def _phase_tid(phase: str, extra: Dict[str, int]) -> int:
+    try:
+        return 1 + _PHASE_ORDER.index(phase)
+    except ValueError:
+        return extra.setdefault(phase,
+                                1 + len(_PHASE_ORDER) + len(extra))
+
+
+def perfetto_json(data: Dict[str, Any]) -> str:
+    """Render a :meth:`Profiler.to_dict` profile as Chrome trace-event
+    JSON (the format Perfetto/chrome://tracing open directly).
+
+    Layout: one *process* per track ("train", "serve" — per
+    replica/shard, named ``track:shard``), one *thread* per phase in
+    canonical order, thread 0 carrying the step spans, plus one counter
+    track per sampled counter name. Only ring data is serialised —
+    ticks, names, step numbers — never tracer span ids (those embed a
+    per-process random stamp and would break byte determinism). Output
+    is fully sorted and separator-canonical: same profile, same bytes.
+    """
+    shard = data.get("shard", "")
+    tracks = sorted({r["track"] for r in data.get("steps", [])}
+                    | {r["track"] for r in data.get("phases", [])}
+                    | {r["track"] for r in data.get("counters", [])})
+    pid_of = {t: i + 1 for i, t in enumerate(tracks)}
+    events: List[Dict[str, Any]] = []
+    extra_tids: Dict[str, int] = {}
+    phase_threads = set()
+    for rec in data.get("phases", []):
+        pid = pid_of[rec["track"]]
+        tid = _phase_tid(rec["phase"], extra_tids)
+        phase_threads.add((pid, tid, rec["phase"]))
+        events.append({
+            "ph": "X", "pid": pid, "tid": tid, "name": rec["phase"],
+            "cat": rec["track"], "ts": rec["t"], "dur": rec["dur"],
+            "args": {"step": rec["step"]},
+        })
+    for rec in data.get("steps", []):
+        pid = pid_of[rec["track"]]
+        events.append({
+            "ph": "X", "pid": pid, "tid": 0, "name": "step",
+            "cat": rec["track"], "ts": rec["t"], "dur": rec["dur"],
+            "args": {"step": rec["step"]},
+        })
+    for rec in data.get("counters", []):
+        pid = pid_of[rec["track"]]
+        events.append({
+            "ph": "C", "pid": pid, "tid": 0, "name": rec["name"],
+            "ts": rec["t"], "args": {"value": rec["value"]},
+        })
+    meta: List[Dict[str, Any]] = []
+    for track, pid in sorted(pid_of.items(), key=lambda kv: kv[1]):
+        pname = f"{track}:{shard}" if shard else track
+        meta.append({"ph": "M", "pid": pid, "tid": 0,
+                     "name": "process_name",
+                     "args": {"name": pname}})
+        meta.append({"ph": "M", "pid": pid, "tid": 0,
+                     "name": "thread_name", "args": {"name": "step"}})
+    for pid, tid, phase in sorted(phase_threads):
+        meta.append({"ph": "M", "pid": pid, "tid": tid,
+                     "name": "thread_name", "args": {"name": phase}})
+    events.sort(key=lambda e: (e["ts"], e["pid"], e["tid"], e["name"],
+                               e.get("dur", 0)))
+    doc = {
+        "displayTimeUnit": "ms",
+        "traceEvents": meta + events,
+        "metadata": {"kftpu_profile_version": data.get("version", 1)},
+    }
+    return json.dumps(doc, sort_keys=True, separators=(",", ":"))
+
+
+def perfetto_track_counts(text: str) -> Dict[str, int]:
+    """Structural census of a perfetto export: distinct phase tracks
+    (named threads other than "step") and counter tracks — the counts
+    the acceptance gate asserts (>=4 phase, >=2 counter for a seeded
+    serving run)."""
+    doc = json.loads(text)
+    phase_tracks = set()
+    counter_tracks = set()
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            name = ev.get("args", {}).get("name", "")
+            if name and name != "step":
+                phase_tracks.add((ev["pid"], name))
+        elif ev.get("ph") == "C":
+            counter_tracks.add((ev["pid"], ev["name"]))
+    return {"phase_tracks": len(phase_tracks),
+            "counter_tracks": len(counter_tracks)}
+
+
+# --------------------------- regression gate -----------------------------
+
+def profile_gate_failures(summary: Dict[str, Any],
+                          baseline: Dict[str, Any], *,
+                          default_budget: float = 0.08) -> List[str]:
+    """Phase-budget regression gate: compare a :meth:`Profiler.summary`
+    against a recorded baseline (PROFILE_r19.json ``gates`` section).
+
+    Checks, all count/ratio-based (never wall-clock-absolute):
+
+    - zero-observation guard: a track in the baseline with no observed
+      steps fails loudly (a gate that can pass on nothing is KF105's
+      bug class, and this one cannot);
+    - conservation: phase tiles must sum to the step span on every
+      complete step;
+    - phase presence: every baseline phase must have been observed;
+    - phase-fraction regression, ONE-SIDED: a phase whose share of step
+      time *grew* more than its budget over the baseline fraction
+      fails. One-sided is what makes chaos injection surgical — ticks
+      added to one phase shrink every other phase's share, and shrinking
+      is the complement of the regression, not a second regression.
+    """
+    failures: List[str] = []
+    for track in sorted(baseline):
+        base = baseline[track]
+        s = summary.get(track)
+        if s is None or s.get("steps", 0) == 0:
+            failures.append(f"{track}: no profiled steps observed "
+                            "(gate would be vacuous)")
+            continue
+        if not s.get("conservation_ok", False):
+            failures.append(f"{track}: phase/step conservation violated")
+        base_fracs = base.get("phase_fractions", {})
+        if len(base_fracs) == 0:
+            failures.append(f"{track}: baseline has no phase fractions "
+                            "(vacuous baseline)")
+            continue
+        budgets = base.get("phase_budgets", {})
+        fracs = s.get("fractions", {})
+        for phase in sorted(base_fracs):
+            bf = float(base_fracs[phase])
+            budget = float(budgets.get(phase, base.get(
+                "budget", default_budget)))
+            f = fracs.get(phase)
+            if f is None:
+                failures.append(f"{track}.{phase}: phase absent from "
+                                "profile (baseline expects it)")
+                continue
+            if f - bf > budget:
+                failures.append(
+                    f"{track}.{phase}: fraction {f:.4f} grew past "
+                    f"baseline {bf:.4f} + budget {budget:.4f}")
+    return failures
+
+
+# ---------------------------- cost catalogs ------------------------------
+
+def train_cost_catalog(cfg: Any, *, seq_len: int, global_batch: int,
+                       mesh_axes: Optional[Dict[str, int]] = None,
+                       param_bytes: Optional[int] = None,
+                       measured: Optional[Dict[str, float]] = None,
+                       moe: bool = False) -> Dict[str, Dict[str, Any]]:
+    """Analytic cost entry for the compiled train step: model FLOPs
+    (train = 3x fwd, causal), gradient-allreduce bytes by mesh axis
+    (first-order ring model from ``parallel/costs.py``), optionally the
+    XLA-measured dict from ``Trainer.step_cost_analysis`` under
+    ``measured`` (kept verbatim; XLA's numbers vary across versions so
+    goldens pin only the analytic side). Lazy imports: calling this —
+    not importing this module — pulls jax-adjacent code."""
+    from kubeflow_tpu.parallel.costs import allreduce_bytes_by_axis
+    from kubeflow_tpu.train.flops import (llama_matmul_params,
+                                          moe_matmul_params_active,
+                                          train_flops_per_token)
+    tokens = int(global_batch) * int(seq_len)
+    fpt = train_flops_per_token(cfg, seq_len, moe=moe)
+    n_params = (moe_matmul_params_active(cfg) if moe
+                else llama_matmul_params(cfg))
+    grad_bytes = int(param_bytes) if param_bytes is not None \
+        else 4 * n_params
+    entry: Dict[str, Any] = {
+        "fn": "train_step",
+        "flops_per_token": fpt,
+        "tokens_per_call": tokens,
+        "flops": fpt * tokens,
+        "matmul_params": n_params,
+        "collective_bytes": allreduce_bytes_by_axis(
+            grad_bytes, mesh_axes or {}),
+    }
+    if measured:
+        entry["measured"] = {k: float(v) for k, v in measured.items()}
+    return {"train_step": entry}
+
+
+def serving_cost_catalog(cfg: Any, *, context_len: int,
+                         kv_block_size: int, blocks_per_seq: int,
+                         batch: int, kv_dtype_bytes: int = 2,
+                         ) -> Dict[str, Dict[str, Any]]:
+    """Analytic cost entries for the serving compiled fns: per-token
+    forward FLOPs for prefill (full-context attention) and decode
+    (attention against the whole cache), and bytes moved per
+    block-gather dispatch (``ops/paged_attention.py`` cost fn — the
+    residency bill the paged pool pays each decode step)."""
+    from kubeflow_tpu.ops.paged_attention import paged_gather_bytes
+    from kubeflow_tpu.train.flops import serving_flops_per_token
+    prefill_fpt = serving_flops_per_token(cfg, context_len)
+    decode_fpt = serving_flops_per_token(cfg, context_len, causal=False)
+    gather = paged_gather_bytes(
+        num_layers=cfg.num_layers, batch=batch,
+        blocks_per_seq=blocks_per_seq, block_size=kv_block_size,
+        num_kv_heads=cfg.num_kv_heads, head_dim=cfg.head_dim,
+        dtype_bytes=kv_dtype_bytes)
+    return {
+        "prefill": {"fn": "prefill", "flops_per_token": prefill_fpt,
+                    "context_len": int(context_len)},
+        "decode_chunk": {"fn": "decode_chunk",
+                         "flops_per_token": decode_fpt,
+                         "batch": int(batch)},
+        "block_gather": {"fn": "gather_kv_pages",
+                         "bytes_per_dispatch": gather,
+                         "blocks_per_seq": int(blocks_per_seq),
+                         "kv_block_size": int(kv_block_size)},
+    }
+
+
+# --------------------------- seeded scenarios ----------------------------
+
+def seeded_serving_profile(*, seed: int = 0, requests: int = 4,
+                           max_new_tokens: int = 6,
+                           chaos_extra_ticks: Optional[Dict[str, int]]
+                           = None,
+                           registry=None, tracer=None, flight=None,
+                           ) -> Profiler:
+    """Drive a tiny Llama through the real serving engine with a
+    :class:`TickClock` profiler attached — the shared seeded scenario
+    behind ``tests/test_profiler.py``, ``tpuctl profile record`` and
+    the CI ``prof-smoke`` gate. Deterministic: fixed seed, fixed
+    prompts, integer tick domain; two runs export byte-identical
+    perfetto JSON. Lazy-imports jax (module import stays jax-free)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import Llama, LlamaConfig
+    from kubeflow_tpu.serving import ServingConfig, ServingEngine
+
+    block, max_len = 8, 64
+    kv_blocks = 4 * (max_len // block)
+    cfg = LlamaConfig.tiny(max_seq_len=128, paged_kv_blocks=kv_blocks,
+                           paged_kv_block_size=block)
+    model = Llama(cfg)
+    params = {"params": model.init(
+        jax.random.PRNGKey(seed), jnp.ones((1, 8), jnp.int32))["params"]}
+    prof = Profiler(now_fn=TickClock(), registry=registry, tracer=tracer,
+                    flight=flight, chaos_extra_ticks=chaos_extra_ticks)
+    engine = ServingEngine(
+        model, params,
+        ServingConfig(max_batch=2, max_len=max_len, kv_blocks=kv_blocks,
+                      kv_block_size=block),
+        profiler=prof)
+    prof.set_catalog(serving_cost_catalog(
+        cfg, context_len=max_len, kv_block_size=block,
+        blocks_per_seq=engine.blocks.blocks_for_tokens(max_len),
+        batch=2))
+    # One block-aligned shared prefix across all requests: COW prefix
+    # sharing engages, so the kv_blocks_shared counter track is
+    # non-vacuous and a write-fork exercises the paged path.
+    head = [3 + seed % 5] * block
+    for i in range(int(requests)):
+        prompt = head + [2 + (seed + i) % 7, 5 + i % 3, 9]
+        engine.submit(prompt, max_new_tokens=max_new_tokens)
+    engine.run()
+    return prof
+
+
+def seeded_train_profile(*, steps: int = 4, seed: int = 0,
+                         checkpoint_every: int = 2,
+                         chaos_extra_ticks: Optional[Dict[str, int]]
+                         = None,
+                         registry=None, tracer=None, flight=None,
+                         ) -> Profiler:
+    """Tiny training loop (real Trainer, synthetic text batches) under
+    a :class:`TickClock` profiler: data_load / host_to_device /
+    step_compute per step plus checkpoint_save every
+    ``checkpoint_every`` steps (marked without touching disk — the
+    phase timeline is the subject here, not the checkpoint codec)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import Llama, LlamaConfig
+    from kubeflow_tpu.topology.mesh import AxisSpec, make_host_local_mesh
+    from kubeflow_tpu.train import TrainConfig, Trainer
+    from kubeflow_tpu.train.data import SyntheticTextConfig, synthetic_text
+
+    cfg = LlamaConfig.tiny(max_seq_len=32)
+    # dp over all local devices (1 on a bare CPU run, 8 under the test
+    # harness's virtual devices): device count changes the mesh, never
+    # the tick counts — ticks are clock reads, and the mark sequence is
+    # identical — so the recorded baseline holds in both environments.
+    ndev = jax.device_count()
+    mesh = make_host_local_mesh(AxisSpec(dp=-1))
+    trainer = Trainer(
+        Llama(cfg),
+        TrainConfig(task="lm", learning_rate=1e-3, warmup_steps=2,
+                    total_steps=max(int(steps), 3)),
+        mesh)
+    it = synthetic_text(SyntheticTextConfig(
+        batch_size=2 * ndev, seq_len=16, vocab_size=cfg.vocab_size,
+        seed=seed))
+    batch0 = trainer.shard_batch(
+        {k: jnp.asarray(v) for k, v in next(it).items()})
+    state = trainer.init_state(jax.random.PRNGKey(seed), batch0)
+    prof = Profiler(now_fn=TickClock(), registry=registry, tracer=tracer,
+                    flight=flight, chaos_extra_ticks=chaos_extra_ticks)
+    prof.set_catalog(train_cost_catalog(
+        cfg, seq_len=16, global_batch=2 * ndev, mesh_axes={"dp": ndev}))
+    for i in range(int(steps)):
+        h = prof.start_step("train", i)
+        raw = next(it)
+        h.mark("data_load")
+        batch = trainer.shard_batch(
+            {k: jnp.asarray(v) for k, v in raw.items()})
+        h.mark("host_to_device")
+        state, _ = trainer.step(state, batch)
+        h.mark("step_compute")
+        if checkpoint_every and (i + 1) % checkpoint_every == 0:
+            h.mark("checkpoint_save")
+        prof.finish_step(h)
+    return prof

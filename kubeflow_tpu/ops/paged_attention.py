@@ -100,6 +100,24 @@ def gather_kv_pages(pool: jax.Array, tables: jax.Array,
     return g.reshape(B, tables.shape[1] * int(block_size), *pool.shape[2:])
 
 
+def paged_gather_bytes(*, num_layers: int, batch: int,
+                       blocks_per_seq: int, block_size: int,
+                       num_kv_heads: int, head_dim: int,
+                       dtype_bytes: int = 2) -> int:
+    """Analytic HBM bytes one decode dispatch pays for the block gather
+    (the profiler's cost-catalog entry for ``gather_kv_pages``).
+
+    Per the cost model above: K and V pools are gathered per layer,
+    ``batch * blocks_per_seq * block_size`` rows each, every row
+    ``num_kv_heads * head_dim * dtype_bytes`` — read once from the pool
+    and written once to the gathered intermediate (the round-trip the
+    ROADMAP's fused-gather follow-up would eliminate), so x2 for K+V
+    and x2 for read+write."""
+    rows = int(batch) * int(blocks_per_seq) * int(block_size)
+    row_bytes = int(num_kv_heads) * int(head_dim) * int(dtype_bytes)
+    return int(num_layers) * rows * row_bytes * 2 * 2
+
+
 def scatter_kv_rows(pool: jax.Array, rows: jax.Array,
                     values: jax.Array) -> jax.Array:
     """Write per-position rows into the pool. rows: [B, S] flat pool-row

@@ -18,6 +18,12 @@ from kubeflow_tpu.parallel.sharding import (
     param_shardings,
     merge_rules,
 )
+from kubeflow_tpu.parallel.costs import (
+    allreduce_bytes_by_axis,
+    ring_allgather_bytes,
+    ring_allreduce_bytes,
+    ring_reduce_scatter_bytes,
+)
 from kubeflow_tpu.parallel.policy import choose_sp_impl
 from kubeflow_tpu.parallel.ring_attention import ring_attention
 from kubeflow_tpu.parallel.ulysses import ulysses_attention
@@ -33,6 +39,10 @@ __all__ = [
     "constrain",
     "param_shardings",
     "merge_rules",
+    "allreduce_bytes_by_axis",
+    "ring_allgather_bytes",
+    "ring_allreduce_bytes",
+    "ring_reduce_scatter_bytes",
     "choose_sp_impl",
     "ring_attention",
     "ulysses_attention",

@@ -1,0 +1,67 @@
+"""First-order collective-cost models for the profiler's cost catalog.
+
+Bytes-on-the-wire estimates for the standard ring algorithms — the
+collective-bandwidth baseline ROADMAP item 2 (EQuARX, arxiv 2506.17615)
+needs before a quantized allreduce can claim a measured win, and the
+denominator behind the profiler's collective-bandwidth-fraction
+attribution. Pure arithmetic: no jax import, callable from host-side
+tooling (tpuctl, ci) without touching an accelerator runtime.
+
+Model: a ring over ``n`` participants moves ``2*(n-1)/n`` of the
+payload per allreduce (reduce-scatter + allgather), ``(n-1)/n`` for
+either half alone. These are per-participant egress bytes — the number
+the interconnect bandwidth bill is paid in.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+#: Mesh axes a gradient allreduce reduces over (data-parallel replicas
+#: and FSDP shards); tp/sp collectives move activations, not gradients,
+#: and are attributed per-op instead.
+GRAD_REDUCE_AXES = ("dp", "fsdp")
+
+
+def ring_allreduce_bytes(payload_bytes: int, n: int) -> int:
+    """Per-participant bytes for a ring allreduce of ``payload_bytes``
+    over ``n`` participants (0 when the axis is trivial)."""
+    n = int(n)
+    if n <= 1:
+        return 0
+    return int(2 * (n - 1) * int(payload_bytes) // n)
+
+
+def ring_allgather_bytes(payload_bytes: int, n: int) -> int:
+    """Per-participant bytes for the allgather half alone."""
+    n = int(n)
+    if n <= 1:
+        return 0
+    return int((n - 1) * int(payload_bytes) // n)
+
+
+def ring_reduce_scatter_bytes(payload_bytes: int, n: int) -> int:
+    """Per-participant bytes for the reduce-scatter half alone (same
+    wire cost as the allgather half under the ring model)."""
+    return ring_allgather_bytes(payload_bytes, n)
+
+
+def allreduce_bytes_by_axis(
+        payload_bytes: int, mesh_axes: Dict[str, int], *,
+        reduce_axes: Optional[Iterable[str]] = None) -> Dict[str, int]:
+    """Gradient-allreduce bytes broken down by reduction axis.
+
+    ``mesh_axes`` maps axis name -> extent (the ``AxisSpec.as_dict()``
+    shape); only ``reduce_axes`` (default :data:`GRAD_REDUCE_AXES`)
+    contribute. Axes reduce sequentially in the ring model, each over
+    the full payload — a deliberate upper bound; XLA may fuse them into
+    one replica-group reduce, which the profiler reports as the
+    measured side when ``step_cost_analysis`` provides it."""
+    axes = tuple(reduce_axes) if reduce_axes is not None \
+        else GRAD_REDUCE_AXES
+    out: Dict[str, int] = {}
+    for axis in axes:
+        n = int(mesh_axes.get(axis, 1))
+        if n > 1:
+            out[axis] = ring_allreduce_bytes(payload_bytes, n)
+    return out

@@ -322,6 +322,7 @@ class ServingEngine:
         mesh: Optional[Mesh] = None,
         rules: Rules = DEFAULT_RULES,
         registry: MetricsRegistry = global_registry,
+        profiler=None,
     ):
         if model.cfg.max_seq_len < cfg.max_len:
             raise ValueError(
@@ -440,6 +441,21 @@ class ServingEngine:
             "Physical KV blocks referenced by more than one sequence "
             "(copy-on-write prefix sharing)",
         )
+        # Total-pool pressure as a first-class signal (ISSUE 19, the
+        # PR-18 follow-up): live/total as a ratio so dashboards and the
+        # profiler's counter track read occupancy without knowing the
+        # pool size. Updated wherever live-block count changes hands
+        # (admission, retirement).
+        self.metrics_hbm_occupancy = registry.gauge(
+            "kftpu_serving_hbm_pool_occupancy_ratio",
+            "Paged KV pool occupancy: blocks live over blocks total",
+        )
+        self.metrics_hbm_occupancy.set(0.0)
+        # Data-plane step profiler (obs/profiler.py), duck-typed so the
+        # serving package never imports obs. None = zero overhead: hot
+        # loops hand around a None handle and skip every mark.
+        self._prof = profiler
+        self._prof_step = 0
         self.metrics_kv_cow_copies = registry.counter(
             "kftpu_serving_kv_cow_copies_total",
             "Copy-on-write forks: a shared KV block copied to a private "
@@ -748,15 +764,49 @@ class ServingEngine:
         ))
         return rid
 
+    def attach_profiler(self, profiler) -> None:
+        """Late-bind a step profiler (duck-typed — serving never imports
+        obs). The bench's --profile leg uses this to time an unprofiled
+        pass and a profiled pass on the SAME engine, so the 2% overhead
+        gate compares like with like (no re-init, no re-compile)."""
+        self._prof = profiler
+
+    def _start_profile_step(self):
+        """Open a profiler step handle (None when unprofiled — the hot
+        loops guard every mark on it)."""
+        if self._prof is None:
+            return None
+        self._prof_step += 1
+        return self._prof.start_step("serve", self._prof_step)
+
+    def _finish_profile_step(self, h) -> None:
+        """Close the step and sample the HBM/KV occupancy counter track
+        at the same timeline tick."""
+        if h is None:
+            return
+        self._prof.finish_step(h)
+        snap = self.blocks.snapshot()
+        total = max(1, snap["kv_blocks_total"])
+        self._prof.sample_counters({
+            "hbm_pool_occupancy_ratio": snap["kv_blocks_live"] / total,
+            "hbm_pool_high_water_ratio":
+                snap["kv_blocks_high_water"] / total,
+            "kv_blocks_shared": float(snap["kv_blocks_shared"]),
+            "kv_blocks_free": float(snap["kv_blocks_free"]),
+        }, step=self._prof_step)
+
     def step(self) -> int:
         """One engine iteration: admit waiting requests into free slots
         (prefill), then decode one token for every active slot. Returns the
         number of active slots."""
-        self._admit()
+        h = self._start_profile_step()
+        self._admit(h)
         active = [i for i, s in enumerate(self._slots) if s is not None]
         if not active:
+            self._finish_profile_step(h)
             return 0
-        self._decode_once()
+        self._decode_once(h)
+        self._finish_profile_step(h)
         return len(active)
 
     def run(self) -> List[GenerationResult]:
@@ -769,6 +819,7 @@ class ServingEngine:
         depth = max(1, self.cfg.pipeline_depth)
         while self._queue or any(s is not None for s in self._slots) \
                 or pending:
+            h = self._start_profile_step()
             # Admission is a pipeline flush point: a fresh dispatch takes
             # its tokens/positions from host-side slot state, which lags by
             # one chunk per undrained in-flight dispatch, and a chained
@@ -781,17 +832,19 @@ class ServingEngine:
             # waits on blocks would serialise every chunk for nothing.
             if self._head_admissible():
                 while pending:
-                    self._drain_decode(pending.popleft())
-                self._admit()
+                    self._drain_decode(pending.popleft(), h)
+                self._admit(h)
             while (
                 len(pending) < depth
                 and any(s is not None for s in self._slots)
             ):
                 pending.append(
-                    self._dispatch_decode(pending[-1] if pending else None)
+                    self._dispatch_decode(
+                        pending[-1] if pending else None, h)
                 )
             if pending:
-                self._drain_decode(pending.popleft())
+                self._drain_decode(pending.popleft(), h)
+            self._finish_profile_step(h)
             for rid in self._results:
                 if rid not in known:
                     known.add(rid)
@@ -1108,6 +1161,13 @@ class ServingEngine:
             "kv_blocks_shared": blocks["kv_blocks_shared"],
             "kv_table_refs": blocks["kv_table_refs"],
             "kv_cow_copies_total": blocks["kv_cow_copies_total"],
+            # Total-pool pressure (ISSUE 19, PR-18 follow-up): occupancy
+            # ratio + high-water mark make HBM headroom a first-class
+            # /healthz signal and feed the profiler's counter track.
+            "kv_blocks_high_water": blocks["kv_blocks_high_water"],
+            "hbm_pool_occupancy_ratio": round(
+                blocks["kv_blocks_live"]
+                / max(1, blocks["kv_blocks_total"]), 6),
             "slot_free_rate": round(self.slot_free_rate(), 4),
             "resident_prefixes": self._resident_snapshot(),
         }
@@ -1210,7 +1270,7 @@ class ServingEngine:
             f"{self.cfg.prefill_buckets[-1]}"
         )
 
-    def _admit(self) -> None:
+    def _admit(self, prof_h=None) -> None:
         # Gather every admissible request, group by prompt bucket, and
         # prefill each group in ONE dispatch (k rows padded to a small set
         # of k-buckets so compile count stays bounded). Under load this
@@ -1245,6 +1305,14 @@ class ServingEngine:
             # submit→admit→decode identity directly.
             self.metrics_queue_wait.observe(
                 wait, exemplar=f"req:{req.request_id}")
+            if self._prof is not None:
+                # Phase evidence under the request's own trace id: the
+                # profiler span stitches into the same `tpuctl trace
+                # --id req:N` timeline the exemplar above points at.
+                self._prof.request_event(
+                    "serve/queue_wait", f"req:{req.request_id}",
+                    attrs={"wait_s": wait, "slot": i,
+                           "step": self._prof_step})
             self._recent_queue_waits.append((time.monotonic(), wait))
             self._note_resident(prefix_key(req.prompt))
             # Radix chain keys too (ISSUE 13): the LB's longest-prefix
@@ -1262,6 +1330,10 @@ class ServingEngine:
             admissions.append((i, req))
         if admissions:
             self.metrics_kv_blocks_live.set(float(self.blocks.blocks_live))
+            self.metrics_hbm_occupancy.set(
+                self.blocks.blocks_live / max(1, self.blocks.total_blocks))
+            if prof_h is not None:
+                prof_h.mark("queue_wait")
         by_bucket: Dict[int, List[tuple]] = {}
         for i, req in admissions:
             if len(req.prompt) > self.cfg.prefill_buckets[-1]:
@@ -1275,6 +1347,8 @@ class ServingEngine:
             )
         for bucket, group in sorted(by_bucket.items()):
             self._prefill_group(bucket, group)
+        if admissions and prof_h is not None:
+            prof_h.mark("prefill")
 
     def _k_pad(self, n: int) -> int:
         """Pad group size to a power of two (1,2,4,8,...), capped at
@@ -1889,7 +1963,7 @@ class ServingEngine:
         return flush(cache)
 
     def _dispatch_decode(
-        self, chain: Optional["_InFlight"] = None
+        self, chain: Optional["_InFlight"] = None, prof_h=None
     ) -> "_InFlight":
         """Queue one decode chunk on the device and return the in-flight
         handle WITHOUT fetching results. When ``chain`` is the previous
@@ -1920,6 +1994,8 @@ class ServingEngine:
             # mirror — the dispatch must see the post-fork tables.
             self._cow_prepare(positions)
             extra = (self._tables_device(),)
+            if prof_h is not None:
+                prof_h.mark("block_gather")
         self._rng, sub = jax.random.split(self._rng)
         with self._mesh_ctx():
             toks, lps, self._cache = self._decode_fn(
@@ -1929,12 +2005,16 @@ class ServingEngine:
         # Hardware-independent cost metric: dispatches/token pins the part
         # of serving latency a ~110ms-per-dispatch tunnel multiplies.
         self.decode_dispatches += 1
+        if prof_h is not None:
+            prof_h.mark("decode_chunk")
         return _InFlight(out=toks, lps=lps, positions=positions,
                          snapshot=list(self._slots))
 
-    def _drain_decode(self, inflight: "_InFlight") -> None:
+    def _drain_decode(self, inflight: "_InFlight", prof_h=None) -> None:
         toks = np.asarray(inflight.out)            # [B, K] (blocks here)
         lps = np.asarray(inflight.lps) if self.cfg.logprobs else None
+        if prof_h is not None:
+            prof_h.mark("sample")
         for k in range(toks.shape[1]):
             for i, slot in enumerate(self._slots):
                 # Record only for the slot objects that were active at
@@ -1946,9 +2026,11 @@ class ServingEngine:
                 self._record_token(
                     i, int(toks[i, k]),
                     float(lps[i, k]) if lps is not None else 0.0)
+        if prof_h is not None:
+            prof_h.mark("retire")
 
-    def _decode_once(self) -> None:
-        self._drain_decode(self._dispatch_decode())
+    def _decode_once(self, prof_h=None) -> None:
+        self._drain_decode(self._dispatch_decode(None, prof_h), prof_h)
 
     def _record_token(self, slot_idx: int, token: int,
                       logprob: float = 0.0) -> None:
@@ -2005,3 +2087,5 @@ class ServingEngine:
             with self._load_lock:
                 self._recent_retires.append(time.monotonic())
             self.metrics_kv_blocks_live.set(float(self.blocks.blocks_live))
+            self.metrics_hbm_occupancy.set(
+                self.blocks.blocks_live / max(1, self.blocks.total_blocks))

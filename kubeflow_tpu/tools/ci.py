@@ -111,6 +111,13 @@ Stages (any failure exits non-zero — the merge gate contract):
    remediation-disabled; the serving soak's gray-failure (sick
    backend) leg pages backend-queue-wait and the drain playbook
    clears it with routing invariants intact (``--skip-remediate``).
+8g. **prof-smoke**: the data-plane step profiler (ISSUE 19) — seeded
+   serving and training profiles (integer tick clock) must pass the
+   PROFILE_r19.json phase-fraction gates with conservation intact, the
+   perfetto export must be byte-identical across two runs with the
+   recorded phase/counter track counts, and a chaos leg that injects
+   extra ticks into decode_chunk must trip the gate naming EXACTLY
+   that phase — non-vacuous in both directions (``--skip-prof``).
 9. **bench-gate**: if --bench-json is given, require
    ``vs_baseline >= --min-vs-baseline`` for every record — the perf
    regression gate SURVEY §7.8 prescribes.
@@ -880,6 +887,82 @@ def run_paged_smoke() -> None:
             "sim's physical-occupancy model is vacuous")
 
 
+def run_prof_smoke() -> None:
+    """Step-profiler smoke (ISSUE 19), non-vacuous in BOTH directions.
+
+    Clean legs: the seeded serving and training profiles (integer tick
+    clock — byte-reproducible) must pass the PROFILE_r19.json gates
+    (zero-observation guard, phase/step conservation, phase presence,
+    one-sided fraction budgets) and the perfetto export must be
+    byte-identical across two runs with the recorded track counts.
+
+    Chaos leg: extra ticks injected into ONE serving phase
+    (decode_chunk) must trip the gate naming exactly that phase —
+    proving the gate fires on a real regression while the one-sided
+    budget keeps the complement phases (whose shares shrink when one
+    phase inflates) quiet. All gates are count/ratio-based; there is no
+    wall-clock absolute anywhere in this stage.
+    """
+    import kubeflow_tpu
+    from kubeflow_tpu.obs.profiler import (
+        perfetto_track_counts,
+        profile_gate_failures,
+        seeded_serving_profile,
+        seeded_train_profile,
+    )
+
+    root = os.path.dirname(
+        os.path.dirname(os.path.abspath(kubeflow_tpu.__file__)))
+    with open(os.path.join(root, "PROFILE_r19.json")) as f:
+        baseline = json.load(f)
+    gates = baseline["gates"]
+
+    # Clean serving leg: gate + determinism + structure.
+    prof = seeded_serving_profile()
+    fails = profile_gate_failures(prof.summary(), {"serve": gates["serve"]})
+    if fails:
+        raise GateFailure("prof-smoke[serve]: clean leg tripped the "
+                          "gate: " + "; ".join(fails))
+    text = prof.export_perfetto()
+    if seeded_serving_profile().export_perfetto() != text:
+        raise GateFailure(
+            "prof-smoke[serve]: two seeded runs exported different "
+            "perfetto bytes — the tick domain leaked nondeterminism")
+    counts = perfetto_track_counts(text)
+    want = baseline["export"]["serve"]
+    if counts["phase_tracks"] < 4 or counts["counter_tracks"] < 2:
+        raise GateFailure(
+            f"prof-smoke[serve]: export too thin — {counts} (need >=4 "
+            "phase tracks and >=2 counter tracks)")
+    if counts != want:
+        raise GateFailure(
+            f"prof-smoke[serve]: track counts {counts} != recorded "
+            f"{want}")
+
+    # Clean training leg.
+    tprof = seeded_train_profile()
+    fails = profile_gate_failures(tprof.summary(),
+                                  {"train": gates["train"]})
+    if fails:
+        raise GateFailure("prof-smoke[train]: clean leg tripped the "
+                          "gate: " + "; ".join(fails))
+
+    # Chaos leg: slow ONE phase; the gate must name it and nothing else.
+    slow = seeded_serving_profile(
+        chaos_extra_ticks={"decode_chunk": 7})
+    fails = profile_gate_failures(slow.summary(),
+                                  {"serve": gates["serve"]})
+    if not fails:
+        raise GateFailure(
+            "prof-smoke[chaos]: injected decode_chunk slowdown did NOT "
+            "trip the gate — the regression gate is vacuous")
+    wrong = [f for f in fails if "decode_chunk" not in f]
+    if wrong:
+        raise GateFailure(
+            "prof-smoke[chaos]: gate flagged phases other than the "
+            "slowed one: " + "; ".join(wrong))
+
+
 def run_affinity_smoke(seed: int = 12) -> None:
     """Cache-affinity smoke (ISSUE 12): the seeded session-replay A/B
     (affine vs blind routing over prefix-caching replicas). Gates are
@@ -1166,6 +1249,7 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
              skip_tenant: bool = False,
              skip_slo: bool = False,
              skip_remediate: bool = False,
+             skip_prof: bool = False,
              skip_lint: bool = False) -> List[str]:
     """Run all stages; returns the list of passed stages, raises
     GateFailure on the first failing one."""
@@ -1310,6 +1394,11 @@ def run_gate(bench_json: str = "", min_vs_baseline: float = 0.9,
         run_paged_smoke()
         passed.append("paged-smoke")
 
+    if not skip_prof:
+        _stage("prof-smoke")
+        run_prof_smoke()
+        passed.append("prof-smoke")
+
     if bench_json:
         _stage("bench-gate")
         with open(bench_json) as f:
@@ -1372,6 +1461,11 @@ def main(argv=None) -> int:
                    help="skip the self-healing remediation smoke "
                         "(do-no-harm, closed-loop, journal-replay and "
                         "auto-disable gates)")
+    g.add_argument("--skip-prof", action="store_true",
+                   help="skip the step-profiler smoke (seeded phase "
+                        "timelines vs PROFILE_r19.json, byte-identical "
+                        "perfetto export, chaos-trips-exactly-one-phase "
+                        "non-vacuity)")
     g.add_argument("--skip-lint", action="store_true",
                    help="skip the static-analyzer lint smoke")
     args = p.parse_args(argv)
@@ -1393,6 +1487,7 @@ def main(argv=None) -> int:
             skip_tenant=args.skip_tenant,
             skip_slo=args.skip_slo,
             skip_remediate=args.skip_remediate,
+            skip_prof=args.skip_prof,
             skip_lint=args.skip_lint,
         )
     except GateFailure as e:

@@ -1070,6 +1070,7 @@ def run_continuous_bench(
     sessions: int = 16,
     client_timeout_s: float = 3.0,
     scrape_interval_s: float = 0.1,
+    profiler=None,
 ) -> Dict[str, object]:
     """One leg of the continuous-batching A/B (ISSUE 12): a seeded
     variable-length trace, open-loop, through the real LB over
@@ -1095,7 +1096,13 @@ def run_continuous_bench(
 
     Defaults offer 2x the dense capacity. Hard gates live in bench.py /
     ci.py; this function reports counts plus the block-ledger
-    conservation verdict (checked on the production allocator class)."""
+    conservation verdict (checked on the production allocator class).
+
+    ``profiler`` (duck-typed ``obs.profiler.Profiler`` — loadtest never
+    imports obs): each health-check tick also samples the sim pool's
+    fleet-wide occupancy and high-water into the profiler's ``sim``
+    counter track, so the sim A/B legs land on the same perfetto
+    timeline the real engine's HBM track uses."""
     import threading
 
     from kubeflow_tpu.serving.blocks import (
@@ -1136,6 +1143,15 @@ def run_continuous_bench(
     def health_loop():
         while not stop.is_set():
             lb.health_check()
+            if profiler is not None:
+                live = sum(s.blocks.snapshot()["kv_blocks_live"]
+                           for s in sims)
+                high = max(s.blocks.high_water_blocks for s in sims)
+                total = max(1, replicas * kv_blocks)
+                profiler.sample_counters({
+                    "hbm_pool_occupancy_ratio": live / total,
+                    "hbm_pool_high_water_ratio": high / max(1, kv_blocks),
+                }, track="sim")
             stop.wait(scrape_interval_s)
 
     hc = threading.Thread(target=health_loop, daemon=True)

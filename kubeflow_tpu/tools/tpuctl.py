@@ -29,6 +29,8 @@ Usage:
                 timeline from the state dir's recorded spans)
   tpuctl top    --url http://host:port/metrics  (per-controller reconcile
                 p50/p95/p99 from a live exposition scrape)
+  tpuctl profile record|show|export  (data-plane step profiler: seeded
+                tick-domain phase timelines + perfetto export)
 
 Backends (--backend):
   state    (default) the embedded Platform: in-memory apiserver + local
@@ -1026,6 +1028,97 @@ def cmd_top(args) -> int:
         for outcome in sorted(affinity):
             print(f"{'affinity ' + outcome:24} "
                   f"{int(affinity[outcome]):>12}")
+    # Step profiler surfaces (ISSUE 19): the TRAIN line is achieved MFU
+    # (published from the profiler's cost catalog + wall throughput) next
+    # to the phase-time decomposition; SERVING phases come from the same
+    # profiler's histogram. Printed only when the series exist so plain
+    # control planes keep the bare table.
+    mfu = None
+    for name, labels, value in samples:
+        if name == "kftpu_train_mfu_ratio":
+            mfu = max(mfu or 0.0, value)
+    tphase = _hist_series(samples, "kftpu_train_phase_seconds", "phase")
+    sphase = _hist_series(samples, "kftpu_serving_phase_seconds", "phase")
+    if mfu is not None or tphase or sphase:
+        print()
+        print(f"{'STEP PHASES':24} {'COUNT':>8} {'P50(ms)':>8} "
+              f"{'P95(ms)':>8}")
+        if mfu is not None:
+            print(f"{'TRAIN mfu':24} {'-':>8} "
+                  f"{f'{mfu * 100:.1f}%':>8} {'-':>8}")
+        for title, series in (("train", tphase), ("serving", sphase)):
+            for phase in sorted(series):
+                pairs = series[phase]
+                count = int(pairs[-1][1]) if pairs else 0
+                print(f"{title + ' ' + phase:24} {count:>8} "
+                      f"{ms(pairs, 0.50)} {ms(pairs, 0.95)}")
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """Step profiler (ISSUE 19): ``record`` drives the seeded serving or
+    training scenario (tick domain — byte-reproducible) and writes
+    ``profile-<scenario>.json`` plus its perfetto render; ``show``
+    summarises a saved profile (phase fractions, conservation, cost
+    catalog); ``export`` re-renders a saved profile as Chrome
+    trace-event JSON for ui.perfetto.dev / chrome://tracing."""
+    from kubeflow_tpu.obs.profiler import (
+        perfetto_json,
+        perfetto_track_counts,
+        seeded_serving_profile,
+        seeded_train_profile,
+    )
+
+    if args.action == "record":
+        os.makedirs(args.dir, exist_ok=True)
+        prof = (seeded_serving_profile() if args.scenario == "serving"
+                else seeded_train_profile())
+        path = os.path.join(args.dir, f"profile-{args.scenario}.json")
+        with open(path, "w") as f:
+            json.dump(prof.to_dict(), f, sort_keys=True)
+        ppath = os.path.join(args.dir,
+                             f"profile-{args.scenario}.perfetto.json")
+        prof.export_perfetto(ppath)
+        print(path)
+        print(ppath)
+        return 0
+    if not args.path:
+        print("show/export need --path <profile.json> (written by "
+              "`tpuctl profile record` or KFTPU_PROFILE_DIR)",
+              file=sys.stderr)
+        return 2
+    with open(args.path) as f:
+        data = json.load(f)
+    if args.action == "export":
+        text = perfetto_json(data)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text)
+            print(args.out)
+        else:
+            print(text)
+        return 0
+    # show
+    counts = perfetto_track_counts(perfetto_json(data))
+    print(f"{'TRACK/PHASE':24} {'STEPS':>7} {'TICKS':>9} {'FRACTION':>9}")
+    for track, s in sorted(data.get("summary", {}).items()):
+        cons = "ok" if s.get("conservation_ok") else "VIOLATED"
+        dropped = s.get("steps_dropped", 0)
+        note = f" (+{dropped} dropped)" if dropped else ""
+        print(f"{track:24} {s.get('steps', 0):>7} "
+              f"{s.get('step_ticks', 0):>9} conservation={cons}{note}")
+        for phase, frac in sorted(s.get("fractions", {}).items()):
+            ticks = s.get("phase_ticks", {}).get(phase, 0)
+            print(f"  {phase:22} {'':>7} {ticks:>9} {frac:>9.4f}")
+    print(f"tracks: {counts['phase_tracks']} phase, "
+          f"{counts['counter_tracks']} counter")
+    catalog = data.get("catalog", {})
+    if catalog:
+        print(f"{'COST CATALOG':24}")
+        for fn, entry in sorted(catalog.items()):
+            kv = " ".join(f"{k}={entry[k]}" for k in sorted(entry)
+                          if not isinstance(entry[k], dict))
+            print(f"  {fn:22} {kv}")
     return 0
 
 
@@ -1275,6 +1368,23 @@ def build_parser() -> argparse.ArgumentParser:
                           "repeatable — multiple scrapes aggregate into "
                           "fleet-wide percentiles")
     top.set_defaults(fn=cmd_top)
+
+    pf = sub.add_parser(
+        "profile", help="data-plane step profiler: record a seeded "
+                        "train/serving profile (tick domain, "
+                        "byte-reproducible), summarise a saved one, or "
+                        "export it as perfetto/Chrome trace JSON")
+    pf.add_argument("action", choices=("record", "show", "export"))
+    pf.add_argument("--scenario", choices=("serving", "train"),
+                    default="serving",
+                    help="which seeded scenario `record` drives")
+    pf.add_argument("--dir", default=".",
+                    help="output directory for record")
+    pf.add_argument("--path", default="",
+                    help="saved profile.json for show/export")
+    pf.add_argument("-o", "--out", default="",
+                    help="export: write here instead of stdout")
+    pf.set_defaults(fn=cmd_profile)
 
     lp = sub.add_parser("logs", help="worker logs for a pod / TpuJob gang")
     lp.add_argument("name")

@@ -60,6 +60,20 @@ def train_flops_per_token(cfg: Any, seq_len: int, *, causal: bool = True,
     return 3 * fwd
 
 
+def serving_flops_per_token(cfg: Any, context_len: int, *,
+                            causal: bool = True,
+                            moe: bool = False) -> int:
+    """Forward-only model FLOPs per generated/prefilled token at the
+    given attention context — the serving-side counterpart of
+    :func:`train_flops_per_token` (no backward, no 3x). Prefill uses
+    ``causal=True`` (average S/2 context per query inside the prompt);
+    decode attends to the whole resident cache, so pass
+    ``causal=False`` with ``context_len`` = current cache length."""
+    n = moe_matmul_params_active(cfg) if moe else llama_matmul_params(cfg)
+    return 2 * n + attention_flops_per_token(cfg, context_len,
+                                             causal=causal)
+
+
 _KIND_TO_GENERATION = {
     # device_kind substrings -> topology.slices generation (single source of
     # truth for per-chip peaks: TpuGeneration.bf16_tflops_per_chip)

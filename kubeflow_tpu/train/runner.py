@@ -72,6 +72,14 @@ def env_config() -> dict:
         # it; SURVEY §5 Tracing).
         "trace_dir": os.environ.get("KFTPU_TRACE_DIR", ""),
         "trace_steps": int(os.environ.get("KFTPU_TRACE_STEPS", "5")),
+        # Data-plane step profiler (obs/profiler.py, ISSUE 19): worker 0
+        # brackets data_load / host_to_device / step_compute / eval /
+        # checkpoint_save per step and writes profile.json +
+        # profile.perfetto.json here at exit (`tpuctl profile show`).
+        # Complementary to KFTPU_TRACE_DIR: that captures XLA's own
+        # device trace for a step window; this one is the whole-run
+        # host-side phase timeline + cost catalog.
+        "profile_dir": os.environ.get("KFTPU_PROFILE_DIR", ""),
         # Input pipeline: "native" uses the C++ ring-buffer loader
         # (train.native_loader); data_path points it at a tokenised corpus
         # (raw int32 dump). Default stays the in-process synthetic stream.
@@ -293,6 +301,21 @@ def run(cfg: dict) -> int:
             state = restored
             log.info("auto-resumed", kv={"step": int(state.step)})
 
+    profiler = None
+    if cfg["profile_dir"] and cfg["process_id"] == 0:
+        from kubeflow_tpu.obs.profiler import Profiler, train_cost_catalog
+        from kubeflow_tpu.utils.monitoring import global_registry
+        from kubeflow_tpu.utils.tracing import global_tracer
+
+        profiler = Profiler(registry=global_registry,
+                            tracer=global_tracer,
+                            shard=f"proc{cfg['process_id']}")
+        profiler.set_catalog(train_cost_catalog(
+            model_cfg, seq_len=cfg["seq_len"], global_batch=batch_size,
+            mesh_axes={k: int(v) for k, v in (cfg["mesh"] or {}).items()},
+            moe=hasattr(model_cfg, "num_experts")))
+        log.info("step profiler active", kv={"dir": cfg["profile_dir"]})
+
     start_step = int(state.step)
     last_eval = None               # (step, metrics) of the newest eval
     t0 = time.time()
@@ -307,10 +330,24 @@ def run(cfg: dict) -> int:
             trace_active = True
             log.info("trace started", kv={"dir": cfg["trace_dir"],
                                           "step": i})
+        h = profiler.start_step("train", i) if profiler is not None \
+            else None
+        raw = next(it)
+        if h is not None:
+            h.mark("data_load")
         batch = trainer.shard_batch(
-            {k: jnp.asarray(v) for k, v in next(it).items()}
+            {k: jnp.asarray(v) for k, v in raw.items()}
         )
+        if h is not None:
+            h.mark("host_to_device")
         state, metrics = trainer.step(state, batch)
+        if h is not None:
+            # Async dispatch: this phase is the host-side dispatch cost;
+            # device time the step didn't wait for surfaces as back-
+            # pressure in the NEXT step's host_to_device (documented in
+            # docs/profiling.md — no per-step sync, the profiler must
+            # not serialise the pipeline it measures).
+            h.mark("step_compute")
         if trace_active and i + 1 >= trace_from + cfg["trace_steps"]:
             float(metrics["loss"])          # sync before closing the trace
             jax.profiler.stop_trace()
@@ -318,10 +355,16 @@ def run(cfg: dict) -> int:
             log.info("trace written", kv={"dir": cfg["trace_dir"]})
         if ckpt is not None and (i + 1) % cfg["checkpoint_every"] == 0:
             ckpt.save(int(state.step), state)
+            if h is not None:
+                h.mark("checkpoint_save")
         if cfg["eval_every"] > 0 and (i + 1) % cfg["eval_every"] == 0:
             last_eval = (i + 1, run_eval(state))
+            if h is not None:
+                h.mark("eval")
             log.info("eval", kv={"step": i + 1, **{
                 k: f"{v:.4f}" for k, v in last_eval[1].items()}})
+        if profiler is not None:
+            profiler.finish_step(h)
         if (i + 1) % 10 == 0:
             loss = float(metrics["loss"])
             tps = (
@@ -340,6 +383,22 @@ def run(cfg: dict) -> int:
         cfg["batch_per_host"] * cfg["num_processes"] * cfg["seq_len"]
         * (cfg["steps"] - start_step) / max(time.time() - t0, 1e-9)
     )
+    if profiler is not None:
+        from kubeflow_tpu.train.flops import train_flops_per_token
+
+        mfu = profiler.set_train_mfu(
+            tokens_per_sec=tokens_per_sec / jax.device_count(),
+            flops_per_token=train_flops_per_token(
+                model_cfg, cfg["seq_len"],
+                moe=hasattr(model_cfg, "num_experts")))
+        os.makedirs(cfg["profile_dir"], exist_ok=True)
+        ppath = os.path.join(cfg["profile_dir"], "profile.json")
+        with open(ppath, "w") as f:
+            json.dump(profiler.to_dict(), f, sort_keys=True)
+        profiler.export_perfetto(
+            os.path.join(cfg["profile_dir"], "profile.perfetto.json"))
+        log.info("profile written", kv={"path": ppath,
+                                        "mfu": f"{mfu:.4f}"})
     # Final held-out score: a COLLECTIVE computation over the gang mesh,
     # so every process must participate (worker 0 alone would hang on the
     # collectives); only worker 0 reports it.

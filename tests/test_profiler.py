@@ -1,0 +1,429 @@
+"""Data-plane step profiler (ISSUE 19): conservation-by-construction in
+the tick domain, trace-id adoption into request timelines, byte-identical
+seeded perfetto export with the acceptance track structure, bounded-ring
+overflow accounting, zero-overhead-when-disabled (including no jax at
+module import), cost-catalog goldens for the tiny model, flight-recorder
+phase evidence, and the one-sided regression gate's non-vacuity both
+ways (clean passes; chaos in one phase trips exactly that phase)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kubeflow_tpu.obs.flight import DUMP_PHASE_TAIL, FlightRecorder, stitch
+from kubeflow_tpu.obs.profiler import (
+    NULL_STEP,
+    Profiler,
+    TickClock,
+    perfetto_json,
+    perfetto_track_counts,
+    profile_gate_failures,
+    seeded_serving_profile,
+    seeded_train_profile,
+    serving_cost_catalog,
+    train_cost_catalog,
+)
+from kubeflow_tpu.utils.monitoring import MetricsRegistry
+from kubeflow_tpu.utils.tracing import Tracer
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _baseline():
+    with open(os.path.join(REPO_ROOT, "PROFILE_r19.json")) as f:
+        return json.load(f)
+
+
+def _tick_profiler(**kw):
+    return Profiler(now_fn=TickClock(), **kw)
+
+
+def _drive(prof, *, track="serve", steps=3,
+           phases=("prefill", "decode_chunk", "retire")):
+    for i in range(steps):
+        h = prof.start_step(track, i + 1)
+        for p in phases:
+            h.mark(p)
+        prof.finish_step(h)
+
+
+class TestTickDomain:
+    def test_phases_tile_the_step_exactly(self):
+        prof = _tick_profiler()
+        h = prof.start_step("serve", 1)
+        h.mark("prefill")
+        h.mark("decode_chunk")
+        h.mark("retire")
+        srec = prof.finish_step(h)
+        # Every clock read is one tick: 3 marks -> 3 ticks of step span,
+        # one per phase, and the tiles sum to the span by construction.
+        assert srec["dur"] == 3
+        assert srec["phases"] == {"prefill": 1, "decode_chunk": 1,
+                                  "retire": 1}
+        s = prof.summary()["serve"]
+        assert s["conservation_ok"]
+        assert s["step_ticks"] == sum(s["phase_ticks"].values())
+
+    def test_chaos_ticks_land_inside_the_named_phase(self):
+        prof = _tick_profiler(chaos_extra_ticks={"decode_chunk": 5})
+        _drive(prof, steps=2)
+        s = prof.summary()["serve"]
+        assert s["conservation_ok"]  # chaos ticks are *inside* the tile
+        assert s["phase_ticks"]["decode_chunk"] == 2 * (1 + 5)
+        assert s["phase_ticks"]["prefill"] == 2
+        assert s["phase_ticks"]["retire"] == 2
+
+    def test_fractions_sum_to_one(self):
+        prof = _tick_profiler()
+        _drive(prof, steps=4)
+        s = prof.summary()["serve"]
+        assert sum(s["fractions"].values()) == pytest.approx(1.0)
+
+    def test_ring_overflow_is_reported_not_silent(self):
+        # 3 phases/step, phase ring of 6 -> only the last 2 steps stay
+        # fully resident; older steps must be counted as dropped and
+        # excluded from the fractions (else conservation would lie).
+        prof = _tick_profiler(capacity=6)
+        _drive(prof, steps=10)
+        s = prof.summary()["serve"]
+        assert s["steps_dropped"] > 0
+        assert s["steps"] + s["steps_dropped"] == 10
+        assert s["conservation_ok"]
+        assert s["step_ticks"] == sum(s["phase_ticks"].values())
+
+    def test_multi_track_rollup_is_independent(self):
+        prof = _tick_profiler()
+        _drive(prof, track="serve", steps=2)
+        _drive(prof, track="train", steps=3,
+               phases=("data_load", "step_compute"))
+        s = prof.summary()
+        assert s["serve"]["steps"] == 2
+        assert s["train"]["steps"] == 3
+        assert s["train"]["phase_ticks"] == {"data_load": 3,
+                                             "step_compute": 3}
+
+
+class TestDisabled:
+    def test_null_handle_no_clock_no_spans_no_rings(self):
+        calls = []
+
+        def counting_now():
+            calls.append(1)
+            return len(calls)
+
+        tracer = Tracer()
+        prof = Profiler(enabled=False, now_fn=counting_now, tracer=tracer)
+        h = prof.start_step("train", 1)
+        assert h is NULL_STEP
+        h.mark("data_load")
+        assert prof.finish_step(h) is None
+        prof.sample_counters({"x": 1.0})
+        assert calls == []            # the clock was never read
+        assert tracer.spans() == []
+        assert prof.summary() == {}
+
+    def test_module_import_pulls_no_jax(self):
+        # Zero overhead when off extends to import time: a process that
+        # only imports the profiler must not pay the jax import.
+        code = ("import sys; import kubeflow_tpu.obs.profiler; "
+                "assert 'jax' not in sys.modules, 'jax imported'")
+        subprocess.run([sys.executable, "-c", code], check=True,
+                       cwd=REPO_ROOT)
+
+
+class TestRegressionGate:
+    BASE = {"serve": {"budget": 0.1,
+                      "phase_fractions": {"prefill": 0.3,
+                                          "decode_chunk": 0.7}}}
+
+    def _summary(self, prefill, decode):
+        return {"serve": {"steps": 5, "steps_dropped": 0,
+                          "step_ticks": prefill + decode,
+                          "conservation_ok": True,
+                          "phase_ticks": {"prefill": prefill,
+                                          "decode_chunk": decode},
+                          "fractions": {
+                              "prefill": prefill / (prefill + decode),
+                              "decode_chunk":
+                                  decode / (prefill + decode)}}}
+
+    def test_clean_profile_passes(self):
+        assert profile_gate_failures(self._summary(30, 70),
+                                     self.BASE) == []
+
+    def test_one_sided_growth_trips_only_the_grown_phase(self):
+        fails = profile_gate_failures(self._summary(60, 40), self.BASE)
+        assert len(fails) == 1 and "prefill" in fails[0]
+        # the complement SHRANK by the same amount: not a regression
+        assert not any("decode_chunk" in f for f in fails)
+
+    def test_zero_observation_guard(self):
+        fails = profile_gate_failures({}, self.BASE)
+        assert fails and "vacuous" in fails[0]
+        empty = {"serve": {"steps": 0, "conservation_ok": True,
+                           "fractions": {}}}
+        assert profile_gate_failures(empty, self.BASE)
+
+    def test_conservation_violation_fails(self):
+        s = self._summary(30, 70)
+        s["serve"]["conservation_ok"] = False
+        assert any("conservation" in f
+                   for f in profile_gate_failures(s, self.BASE))
+
+    def test_missing_phase_fails(self):
+        s = self._summary(30, 70)
+        del s["serve"]["fractions"]["decode_chunk"]
+        assert any("absent" in f
+                   for f in profile_gate_failures(s, self.BASE))
+
+
+class TestCostCatalogGoldens:
+    """Analytic values for LlamaConfig.tiny (E=64 H=4 Hkv=2 Dh=16 M=128
+    L=2 V=256), hand-computed — these pin the formulas, so a silent
+    change to the FLOP model breaks here, not in a dashboard."""
+
+    def _cfg(self):
+        from kubeflow_tpu.models import LlamaConfig
+
+        return LlamaConfig.tiny()
+
+    def test_train_catalog(self):
+        cat = train_cost_catalog(self._cfg(), seq_len=16, global_batch=2,
+                                 mesh_axes={"dp": 2, "fsdp": 1})
+        e = cat["train_step"]
+        # per_layer = 4096(q) + 4096(kv) + 4096(o) + 24576(mlp) = 36864
+        # params = 2*36864 + 256*64 = 90112
+        assert e["matmul_params"] == 90112
+        # attn fwd/token @S=16 causal: 4*16*4*16*2 // 2 = 4096
+        # train fpt = 3 * (2*90112 + 4096) = 552960
+        assert e["flops_per_token"] == 552960
+        assert e["tokens_per_call"] == 32
+        assert e["flops"] == 552960 * 32
+        # grads: 4 bytes * params; ring allreduce on dp=2 moves
+        # 2*(n-1)/n = all of it; fsdp extent 1 contributes nothing.
+        assert e["collective_bytes"] == {"dp": 4 * 90112}
+
+    def test_serving_catalog(self):
+        cat = serving_cost_catalog(self._cfg(), context_len=64,
+                                   kv_block_size=8, blocks_per_seq=8,
+                                   batch=2)
+        # fwd fpt = 2*90112 + attn; prefill causal @64: 32768//2
+        assert cat["prefill"]["flops_per_token"] == 180224 + 16384
+        # decode attends the whole cache: full 32768
+        assert cat["decode_chunk"]["flops_per_token"] == 180224 + 32768
+        # gather: L * (B*blocks*bs rows) * (Hkv*Dh*2B) * K+V * R+W
+        #       = 2 * 128 * 64 * 2 * 2 = 65536
+        assert cat["block_gather"]["bytes_per_dispatch"] == 65536
+
+    def test_mfu_against_known_peak(self):
+        prof = _tick_profiler()
+        ratio = prof.set_train_mfu(tokens_per_sec=1e6,
+                                   flops_per_token=5e7,
+                                   peak_tflops=100.0)
+        assert ratio == pytest.approx(0.5)
+        assert prof.catalog["train_step"]["mfu"] == pytest.approx(0.5)
+
+    def test_unknown_peak_reports_zero_not_fiction(self):
+        prof = _tick_profiler()
+        assert prof.set_train_mfu(tokens_per_sec=1e6,
+                                  flops_per_token=5e7,
+                                  peak_tflops=0.0) == 0.0
+
+
+class TestFlightIntegration:
+    def test_dump_appends_bounded_phase_ring_and_stitches(self, tmp_path):
+        clock = TickClock()
+        fl = FlightRecorder(shard="s0", now_fn=clock)
+        prof = Profiler(now_fn=clock, flight=fl, shard="s0")
+        _drive(prof, steps=DUMP_PHASE_TAIL)   # 3x tail -> must truncate
+        fl.record("alert", {"state": "page"})
+        path = fl.dump(str(tmp_path), reason="alert-page")
+        recs = FlightRecorder.load(path)
+        header = recs[0]
+        phases = [r for r in recs if r.get("kind") == "phase"]
+        # bounded: exactly the tail, and the header advertises it
+        assert len(phases) == DUMP_PHASE_TAIL
+        assert header["phases"] == DUMP_PHASE_TAIL
+        assert phases[-1]["data"]["phase"] == "retire"
+        assert all(r["data"]["track"] == "serve" for r in phases)
+        # stitch keeps (t, shard, seq) order with phases interleaved
+        merged = [r for r in stitch([path]) if r.get("kind") != "flight"]
+        keys = [(r.get("t", 0), r.get("shard", ""), r.get("seq", 0))
+                for r in merged]
+        assert keys == sorted(keys)
+        # the alert entry and the phase evidence share one timeline
+        kinds = {r.get("kind") for r in merged}
+        assert {"alert", "phase"} <= kinds
+
+    def test_overlapping_dumps_dedup_phases(self, tmp_path):
+        clock = TickClock()
+        fl = FlightRecorder(shard="s0", now_fn=clock)
+        prof = Profiler(now_fn=clock, flight=fl, shard="s0")
+        _drive(prof, steps=2)
+        p1 = fl.dump(str(tmp_path), reason="first")
+        p2 = fl.dump(str(tmp_path), reason="second")
+        merged = stitch([p1, p2])
+        phases = [r for r in merged if r.get("kind") == "phase"]
+        assert len(phases) == 2 * 3   # deduped on (shard, seq, kind, t)
+
+
+def _export_step_conservation(text):
+    """Parse a perfetto export: per (pid, step), the phase spans (tid !=
+    0) must tile the step span (tid == 0) exactly — the acceptance
+    criterion's integer-tick conservation, checked on the EXPORT."""
+    doc = json.loads(text)
+    step_dur = {}
+    phase_sum = {}
+    for ev in doc["traceEvents"]:
+        if ev.get("ph") != "X":
+            continue
+        key = (ev["pid"], ev["args"]["step"])
+        if ev["tid"] == 0:
+            step_dur[key] = step_dur.get(key, 0) + ev["dur"]
+        else:
+            phase_sum[key] = phase_sum.get(key, 0) + ev["dur"]
+    assert step_dur, "export has no step spans"
+    for key, dur in step_dur.items():
+        assert phase_sum.get(key, 0) == dur, (key, dur, phase_sum)
+
+
+class TestPerfettoExport:
+    def test_tick_export_structure_and_conservation(self):
+        prof = _tick_profiler(shard="proc0")
+        _drive(prof, steps=3)
+        prof.sample_counters({"hbm_pool_occupancy_ratio": 0.5,
+                              "kv_blocks_shared": 2.0})
+        text = prof.export_perfetto()
+        counts = perfetto_track_counts(text)
+        assert counts == {"phase_tracks": 3, "counter_tracks": 2}
+        _export_step_conservation(text)
+        doc = json.loads(text)
+        names = {ev["args"]["name"] for ev in doc["traceEvents"]
+                 if ev.get("name") == "process_name"}
+        assert names == {"serve:proc0"}
+
+    def test_rendering_is_pure_and_path_write_matches(self, tmp_path):
+        prof = _tick_profiler()
+        _drive(prof, steps=2)
+        data = prof.to_dict()
+        assert perfetto_json(data) == perfetto_json(
+            json.loads(json.dumps(data)))  # survives a JSON round trip
+        p = tmp_path / "out.json"
+        text = prof.export_perfetto(str(p))
+        assert p.read_text() == text
+
+
+# ----------------------- seeded end-to-end scenarios ----------------------
+# One engine build per scenario (jax compile) — shared via module fixtures.
+
+
+@pytest.fixture(scope="module")
+def serving_bundle():
+    tracer = Tracer()
+    registry = MetricsRegistry()
+    prof = seeded_serving_profile(tracer=tracer, registry=registry)
+    return prof, tracer, registry
+
+
+@pytest.fixture(scope="module")
+def train_prof():
+    return seeded_train_profile()
+
+
+class TestSeededServing:
+    def test_summary_matches_recorded_baseline(self, serving_bundle):
+        prof, _, _ = serving_bundle
+        rec = _baseline()["recorded"]["serve"]
+        s = prof.summary()["serve"]
+        assert s["conservation_ok"] and s["steps_dropped"] == 0
+        assert s["steps"] == rec["steps"]
+        assert s["step_ticks"] == rec["step_ticks"]
+        assert s["phase_ticks"] == rec["phase_ticks"]
+
+    def test_gate_clean_leg_passes(self, serving_bundle):
+        prof, _, _ = serving_bundle
+        gates = _baseline()["gates"]
+        assert profile_gate_failures(
+            prof.summary(), {"serve": gates["serve"]}) == []
+
+    def test_trace_id_adoption(self, serving_bundle):
+        _, tracer, _ = serving_bundle
+        spans = tracer.spans()
+        # queue-wait instant events adopt the REQUEST's trace id, so
+        # they stitch into the `tpuctl trace req:<n>` timeline...
+        req_waits = [s for s in spans if s.name == "serve/queue_wait"
+                     and s.trace_id.startswith("req:")]
+        assert req_waits
+        # ...while anonymous engine steps share one profile/run root.
+        roots = [s for s in spans if s.name == "profile/run"]
+        assert len(roots) == 1
+        run_id = roots[0].trace_id
+        decode = [s for s in spans if s.name == "serve/decode_chunk"]
+        assert decode and all(s.trace_id == run_id for s in decode)
+
+    def test_phase_histogram_registered_and_observed(self, serving_bundle):
+        _, _, registry = serving_bundle
+        text = registry.render()
+        assert 'kftpu_serving_phase_seconds_count{phase="decode_chunk"}' \
+            in text
+        assert 'phase="block_gather"' in text
+
+    def test_counter_tracks_nonvacuous(self, serving_bundle):
+        prof, _, _ = serving_bundle
+        by_name = {}
+        for rec in prof.to_dict()["counters"]:
+            by_name.setdefault(rec["name"], []).append(rec["value"])
+        assert max(by_name["hbm_pool_occupancy_ratio"]) > 0.0
+        # the shared block-aligned prefix makes COW sharing observable
+        assert max(by_name["kv_blocks_shared"]) >= 1.0
+        assert max(by_name["hbm_pool_high_water_ratio"]) <= 1.0
+
+    def test_export_byte_identical_and_structured(self, serving_bundle):
+        prof, _, _ = serving_bundle
+        text = prof.export_perfetto()
+        assert seeded_serving_profile().export_perfetto() == text
+        counts = perfetto_track_counts(text)
+        exp = _baseline()["export"]["serve"]
+        assert counts["phase_tracks"] >= 4
+        assert counts["counter_tracks"] >= 2
+        assert counts == exp
+        _export_step_conservation(text)
+
+    def test_chaos_trips_exactly_the_slowed_phase(self):
+        slow = seeded_serving_profile(
+            chaos_extra_ticks={"decode_chunk": 7})
+        gates = _baseline()["gates"]
+        fails = profile_gate_failures(slow.summary(),
+                                      {"serve": gates["serve"]})
+        assert fails, "injected slowdown did not trip the gate"
+        assert all("decode_chunk" in f for f in fails), fails
+
+
+class TestSeededTrain:
+    def test_summary_matches_recorded_baseline(self, train_prof):
+        rec = _baseline()["recorded"]["train"]
+        s = train_prof.summary()["train"]
+        assert s["conservation_ok"] and s["steps_dropped"] == 0
+        assert s["steps"] == rec["steps"]
+        assert s["step_ticks"] == rec["step_ticks"]
+        assert s["phase_ticks"] == rec["phase_ticks"]
+
+    def test_gate_clean_leg_passes(self, train_prof):
+        gates = _baseline()["gates"]
+        assert profile_gate_failures(
+            train_prof.summary(), {"train": gates["train"]}) == []
+
+    def test_catalog_attached(self, train_prof):
+        import jax
+
+        e = train_prof.catalog["train_step"]
+        assert e["flops_per_token"] == 552960   # tiny @ seq 16 golden
+        # grad allreduce rides the dp axis: nothing to reduce on one
+        # device, the full ring bill 2*(n-1)/n * 4B*params otherwise.
+        ndev = jax.device_count()
+        expected = {} if ndev == 1 else {
+            "dp": 2 * (ndev - 1) * (4 * 90112) // ndev}
+        assert e["collective_bytes"] == expected

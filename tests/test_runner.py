@@ -120,6 +120,23 @@ class TestRunnerContract:
         profiles = list(trace.rglob("*.xplane.pb"))
         assert profiles, f"no trace written under {trace}"
 
+    def test_profile_dir_writes_step_profile(self, monkeypatch, tmp_path):
+        """KFTPU_PROFILE_DIR: the runner brackets its loop with the step
+        profiler (obs/profiler.py, ISSUE 19) and writes profile.json +
+        the perfetto render at exit — conservation holding in the real
+        wall-clock domain, every step present, cost catalog attached."""
+        pdir = tmp_path / "profile"
+        _run(monkeypatch, tmp_path, KFTPU_TRAIN_STEPS="3",
+             KFTPU_PROFILE_DIR=str(pdir))
+        data = json.loads((pdir / "profile.json").read_text())
+        s = data["summary"]["train"]
+        assert s["steps"] == 3 and s["steps_dropped"] == 0
+        assert s["conservation_ok"]
+        assert set(s["phase_ticks"]) >= {"data_load", "host_to_device",
+                                         "step_compute"}
+        assert data["catalog"]["train_step"]["flops_per_token"] > 0
+        assert (pdir / "profile.perfetto.json").exists()
+
     def test_pp_mesh_pipelines_dense_model(self, monkeypatch, tmp_path):
         # batch 8 = 2 microbatches x mb 4, mb divisible by dp=4 (8 devs / pp 2).
         report = _run(
